@@ -1,0 +1,26 @@
+"""bigdl_tpu — a TPU-native distributed deep learning framework.
+
+Re-imagines the capability surface of BigDL (reference: ram1991/BigDL, a fork of
+the intel-analytics/BigDL 2.x monorepo — see SURVEY.md; the reference mount was
+empty so all reference citations are upstream-layout paths marked unverified):
+
+- DLlib tensor/nn/optim core  ->  ``bigdl_tpu.tensor`` / ``bigdl_tpu.nn`` /
+  ``bigdl_tpu.optim`` (JAX/XLA, ``jax.grad`` instead of hand-written backward)
+- ``DistriOptimizer`` + BlockManager ``AllReduceParameter`` gradient sync
+  (scala: dllib/optim/DistriOptimizer.scala, optim/parameters/AllReduceParameter.scala,
+  unverified)  ->  ``shard_map`` train step with ``psum_scatter``/``all_gather``
+  over a ``jax.sharding.Mesh`` (ZeRO-1-style sharded update, same semantics)
+- Keras-style API (dllib/keras)  ->  ``bigdl_tpu.keras``
+- Orca Estimator (python/orca)  ->  ``bigdl_tpu.estimator``
+- Chronos time series (python/chronos)  ->  ``bigdl_tpu.forecast``
+- Cluster Serving (scala/serving)  ->  ``bigdl_tpu.serving``
+
+The compute path is pure JAX (jit/pjit/shard_map/pallas); the host-side runtime
+(data prefetch, serving queue) has a native C++ core under ``csrc/``.
+"""
+
+from bigdl_tpu.version import __version__
+
+from bigdl_tpu.runtime.engine import Engine, init_engine
+
+__all__ = ["__version__", "Engine", "init_engine"]

@@ -1,0 +1,20 @@
+from bigdl_tpu.nn.module import (
+    Module, Container, Sequential, Concat, ConcatTable, ParallelTable,
+    Identity, Lambda, CAddTable, CMulTable, JoinTable, SelectTable,
+)
+from bigdl_tpu.nn.layers import (
+    Linear, Dense, Conv2D, SpatialConvolution, Conv1D, TemporalConvolution,
+    MaxPool2D, AvgPool2D, GlobalAvgPool2D, SpatialMaxPooling,
+    SpatialAveragePooling, BatchNorm, BatchNormalization,
+    SpatialBatchNormalization, LayerNorm, RMSNorm, Dropout, Reshape, View,
+    Flatten, Squeeze, Unsqueeze, Transpose, Embedding, LookupTable,
+    ZeroPadding2D, ReLU, ReLU6, Tanh, Sigmoid, GELU, SiLU, Swish, SoftPlus,
+    SoftSign, HardSigmoid, SoftMax, LogSoftMax, LeakyReLU, ELU, HardTanh,
+    PReLU,
+)
+from bigdl_tpu.nn.criterion import (
+    Criterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,
+    AbsCriterion, SmoothL1Criterion, BCECriterion, BCEWithLogitsCriterion,
+    KLDivCriterion, CosineEmbeddingCriterion, MarginRankingCriterion,
+    ParallelCriterion, TimeDistributedCriterion,
+)

@@ -1,0 +1,67 @@
+"""Weight initialization methods.
+
+Reference analog (unverified — mount empty): ``dllib/nn/InitializationMethod.scala``
+— ``RandomUniform``, ``RandomNormal``, ``Xavier``, ``MsraFiller`` (Kaiming),
+``BilinearFiller``, ``Zeros``, ``Ones``, ``ConstInitMethod``.  Functional
+versions: ``init_fn(key, shape, fan_in, fan_out) -> array``.
+"""
+
+import math
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+InitFn = Callable[[jax.Array, Tuple[int, ...], int, int], jnp.ndarray]
+
+
+def zeros(key, shape, fan_in, fan_out):
+    return jnp.zeros(shape)
+
+
+def ones(key, shape, fan_in, fan_out):
+    return jnp.ones(shape)
+
+
+def const(value: float) -> InitFn:
+    def f(key, shape, fan_in, fan_out):
+        return jnp.full(shape, value)
+
+    return f
+
+
+def random_uniform(lower=-1e-2, upper=1e-2) -> InitFn:
+    def f(key, shape, fan_in, fan_out):
+        return jax.random.uniform(key, shape, minval=lower, maxval=upper)
+
+    return f
+
+
+def random_normal(mean=0.0, stdv=1e-2) -> InitFn:
+    def f(key, shape, fan_in, fan_out):
+        return mean + stdv * jax.random.normal(key, shape)
+
+    return f
+
+
+def xavier(key, shape, fan_in, fan_out):
+    """Glorot uniform — the reference's default for Linear/Conv (Xavier)."""
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, minval=-limit, maxval=limit)
+
+
+def msra(key, shape, fan_in, fan_out):
+    """Kaiming/He normal (MsraFiller) — used by the reference's ResNet."""
+    std = math.sqrt(2.0 / fan_out)
+    return std * jax.random.normal(key, shape)
+
+
+def kaiming_in(key, shape, fan_in, fan_out):
+    std = math.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(key, shape)
+
+
+def default_bias(key, shape, fan_in, fan_out):
+    """Reference Linear default: uniform(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    s = 1.0 / math.sqrt(max(fan_in, 1))
+    return jax.random.uniform(key, shape, minval=-s, maxval=s)

@@ -1,0 +1,296 @@
+"""Module protocol and containers.
+
+Reference analog (unverified — mount empty):
+``dllib/nn/abstractnn/AbstractModule.scala`` — the contract
+``forward/backward/updateOutput/updateGradInput/accGradParameters/parameters()``
+with mutable ``output``/``gradInput`` fields — plus containers
+``nn/Sequential.scala``, ``nn/Concat.scala``, ``nn/ConcatTable.scala``.
+
+TPU-native re-design: modules are **stateless descriptions**; parameters and
+mutable state (BN running stats) live in an explicit ``Variables`` pytree:
+
+    variables = module.init(rng, sample_input)          # {"params":…, "state":…}
+    y, new_state = module.apply(variables, x, training=True, rng=rng)
+
+There is no ``backward``: gradients come from ``jax.grad`` over
+``apply`` — the hand-written ``updateGradInput``/``accGradParameters`` pair in
+the reference's ~300 layers is replaced by autodiff.  ``training()`` /
+``evaluate()`` mode flags become the ``training=`` argument (pure function, so
+one compiled step can't silently flip mode).
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any  # nested dict pytree of jnp arrays
+State = Any
+
+EMPTY: Dict = {}
+
+
+def _fold(rng, i: int):
+    return None if rng is None else jax.random.fold_in(rng, i)
+
+
+class Module:
+    """Base class. Leaf modules override ``build`` (create params/state from a
+    concrete sample input) and ``forward`` (pure function of params/state)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__
+
+    # ---- leaf hooks -------------------------------------------------------
+    def build(self, rng, *inputs) -> Tuple[Params, State]:
+        """Create (params, state) for this module given sample inputs."""
+        return EMPTY, EMPTY
+
+    def forward(self, params: Params, state: State, *inputs, training: bool = False,
+                rng=None) -> Tuple[Any, State]:
+        """Pure forward. Returns (output, new_state)."""
+        raise NotImplementedError(type(self).__name__)
+
+    # ---- public API -------------------------------------------------------
+    def init(self, rng, *inputs) -> Dict[str, Any]:
+        params, state = self.build(rng, *_as_arrays(inputs))
+        return {"params": params, "state": state}
+
+    def apply(self, variables: Dict[str, Any], *inputs, training: bool = False,
+              rng=None) -> Tuple[Any, State]:
+        return self.forward(
+            variables.get("params", EMPTY), variables.get("state", EMPTY),
+            *inputs, training=training, rng=rng)
+
+    def __call__(self, variables, *inputs, training: bool = False, rng=None):
+        y, _ = self.apply(variables, *inputs, training=training, rng=rng)
+        return y
+
+    # ---- reference-parity helpers ----------------------------------------
+    def parameters(self, variables) -> List[jnp.ndarray]:
+        """Flat list of parameter arrays (reference: ``parameters()._1``)."""
+        return jax.tree_util.tree_leaves(variables.get("params", EMPTY))
+
+    def n_parameters(self, variables) -> int:
+        return sum(int(np.prod(p.shape)) for p in self.parameters(variables))
+
+    def summary(self, variables) -> str:
+        lines = [f"{self.name}: {self.n_parameters(variables):,} params"]
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class Container(Module):
+    """Module with sub-modules; params/state are dicts keyed by child index+name."""
+
+    def __init__(self, layers: Sequence[Module] = (), name: Optional[str] = None):
+        super().__init__(name)
+        self.layers: List[Module] = list(layers)
+
+    def add(self, layer: Module) -> "Container":
+        self.layers.append(layer)
+        return self
+
+    def _key(self, i: int) -> str:
+        return f"{i}_{self.layers[i].name}"
+
+    def _child_vars(self, params, state, i):
+        k = self._key(i)
+        return {"params": params.get(k, EMPTY), "state": state.get(k, EMPTY)}
+
+    def __repr__(self):
+        inner = ", ".join(repr(l) for l in self.layers)
+        return f"{type(self).__name__}({inner})"
+
+
+class Sequential(Container):
+    """Feed-forward chain — reference ``nn/Sequential.scala``."""
+
+    def init(self, rng, *inputs) -> Dict[str, Any]:
+        params, state = {}, {}
+        xs = _as_arrays(inputs)
+        for i, layer in enumerate(self.layers):
+            v = layer.init(_fold(rng, i), *xs)
+            k = self._key(i)
+            if v["params"]:
+                params[k] = v["params"]
+            if v["state"]:
+                state[k] = v["state"]
+            y, _ = layer.apply(v, *xs, training=False)
+            xs = (y,) if not isinstance(y, tuple) else y
+        return {"params": params, "state": state}
+
+    def forward(self, params, state, *inputs, training=False, rng=None):
+        new_state = dict(state)
+        xs = inputs
+        for i, layer in enumerate(self.layers):
+            k = self._key(i)
+            y, st = layer.forward(
+                params.get(k, EMPTY), state.get(k, EMPTY), *xs,
+                training=training, rng=_fold(rng, i))
+            if st:
+                new_state[k] = st
+            xs = (y,) if not isinstance(y, tuple) else y
+        return xs[0] if len(xs) == 1 else xs, new_state
+
+
+class ParallelApply(Container):
+    """Shared base for Concat-style containers: run every child on the same
+    input, combine outputs with ``_combine``."""
+
+    def _combine(self, ys: List[Any]):
+        raise NotImplementedError
+
+    def init(self, rng, *inputs) -> Dict[str, Any]:
+        params, state = {}, {}
+        for i, layer in enumerate(self.layers):
+            v = layer.init(_fold(rng, i), *_as_arrays(inputs))
+            k = self._key(i)
+            if v["params"]:
+                params[k] = v["params"]
+            if v["state"]:
+                state[k] = v["state"]
+        return {"params": params, "state": state}
+
+    def forward(self, params, state, *inputs, training=False, rng=None):
+        new_state = dict(state)
+        ys = []
+        for i, layer in enumerate(self.layers):
+            k = self._key(i)
+            y, st = layer.forward(
+                params.get(k, EMPTY), state.get(k, EMPTY), *inputs,
+                training=training, rng=_fold(rng, i))
+            if st:
+                new_state[k] = st
+            ys.append(y)
+        return self._combine(ys), new_state
+
+
+class Concat(ParallelApply):
+    """Run children on same input, concat outputs along ``dim`` — reference
+    ``nn/Concat.scala`` (dim is 1-indexed channel dim there; here 0-indexed,
+    default -1 = feature axis, NHWC-friendly)."""
+
+    def __init__(self, layers=(), dim: int = -1, name=None):
+        super().__init__(layers, name)
+        self.dim = dim
+
+    def _combine(self, ys):
+        return jnp.concatenate(ys, axis=self.dim)
+
+
+class ConcatTable(ParallelApply):
+    """Run children on same input, return tuple of outputs — reference
+    ``nn/ConcatTable.scala``."""
+
+    def _combine(self, ys):
+        return tuple(ys)
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th input — reference ``nn/ParallelTable.scala``."""
+
+    def init(self, rng, *inputs):
+        params, state = {}, {}
+        xs = _as_arrays(inputs)
+        if len(xs) == 1 and isinstance(xs[0], tuple):
+            xs = xs[0]
+        for i, layer in enumerate(self.layers):
+            v = layer.init(_fold(rng, i), xs[i])
+            k = self._key(i)
+            if v["params"]:
+                params[k] = v["params"]
+            if v["state"]:
+                state[k] = v["state"]
+        return {"params": params, "state": state}
+
+    def forward(self, params, state, *inputs, training=False, rng=None):
+        xs = inputs
+        if len(xs) == 1 and isinstance(xs[0], tuple):
+            xs = xs[0]
+        new_state = dict(state)
+        ys = []
+        for i, layer in enumerate(self.layers):
+            k = self._key(i)
+            y, st = layer.forward(
+                params.get(k, EMPTY), state.get(k, EMPTY), xs[i],
+                training=training, rng=_fold(rng, i))
+            if st:
+                new_state[k] = st
+            ys.append(y)
+        return tuple(ys), new_state
+
+
+class Identity(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return x, EMPTY
+
+
+class Lambda(Module):
+    """Wrap a pure function as a module (reference autograd/Lambda analog)."""
+
+    def __init__(self, fn: Callable, name=None):
+        super().__init__(name or getattr(fn, "__name__", "Lambda"))
+        self.fn = fn
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        return self.fn(*xs), EMPTY
+
+
+def _table(xs):
+    """Normalize varargs-vs-single-tuple input for table ops."""
+    if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+        return tuple(xs[0])
+    return xs
+
+
+class CAddTable(Module):
+    """Elementwise sum of a table input — reference ``nn/CAddTable.scala``."""
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out, EMPTY
+
+
+class CMulTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out, EMPTY
+
+
+class JoinTable(Module):
+    """Concatenate a table input along dim — reference ``nn/JoinTable.scala``."""
+
+    def __init__(self, dim: int = -1, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        return jnp.concatenate(list(_table(xs)), axis=self.dim), EMPTY
+
+
+class SelectTable(Module):
+    def __init__(self, index: int, name=None):
+        super().__init__(name)
+        self.index = index
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        return _table(xs)[self.index], EMPTY
+
+
+def _as_arrays(inputs):
+    out = []
+    for x in inputs:
+        if hasattr(x, "data") and not isinstance(x, jnp.ndarray):
+            x = x.data  # unwrap bigdl_tpu Tensor
+        out.append(x)
+    return tuple(out)

@@ -1,0 +1,4 @@
+from bigdl_tpu.runtime.engine import Engine, EngineConfig, init_engine
+from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+__all__ = ["Engine", "EngineConfig", "init_engine", "MeshSpec", "build_mesh"]

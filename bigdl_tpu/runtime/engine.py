@@ -1,0 +1,113 @@
+"""Engine: process/topology bootstrap.
+
+Reference analog (all unverified — mount empty): ``dllib/utils/Engine.scala``
+reads executor topology from SparkConf, pins MKL threads/affinity, and builds
+per-executor thread pools; ``Optimizer`` then refuses to run unless
+``Engine.init`` succeeded.  TPU-native replacement: one Python process per
+TPU-VM host (multi-controller), ``jax.distributed.initialize`` for rendezvous
+(replacing the Spark driver/barrier control plane), and a ``Mesh`` built over
+the slice.  There are no thread-pool model clones: per-host multi-chip
+parallelism is XLA replication over the mesh.
+"""
+
+import dataclasses
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.engine")
+
+
+@dataclass
+class EngineConfig:
+    """Typed config replacing the reference's three overlapping mechanisms
+    (SparkConf props / ``bigdl.*`` sysprops / env soup — SURVEY.md §6.6)."""
+
+    # multi-host rendezvous; None = single-process (or env-configured TPU pod)
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    # logical mesh
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    # numerics
+    compute_dtype: str = "bfloat16"  # matmul/conv compute dtype on TPU
+    param_dtype: str = "float32"
+    # failure handling (reference: bigdl.failure.retryTimes ~ 5, unverified)
+    failure_retry_times: int = 5
+    failure_retry_interval_s: float = 10.0
+
+    @staticmethod
+    def from_env() -> "EngineConfig":
+        cfg = EngineConfig()
+        if os.environ.get("BIGDL_TPU_COORDINATOR"):
+            cfg.coordinator_address = os.environ["BIGDL_TPU_COORDINATOR"]
+            cfg.num_processes = int(os.environ.get("BIGDL_TPU_NUM_PROCESSES", "1"))
+            cfg.process_id = int(os.environ.get("BIGDL_TPU_PROCESS_ID", "0"))
+        if os.environ.get("BIGDL_TPU_RETRY_TIMES"):
+            cfg.failure_retry_times = int(os.environ["BIGDL_TPU_RETRY_TIMES"])
+        return cfg
+
+
+class Engine:
+    """Singleton runtime: initialized once per process, owns the global mesh."""
+
+    _instance: Optional["Engine"] = None
+
+    _distributed_initialized = False
+
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        if config.coordinator_address is not None and not Engine._distributed_initialized:
+            jax.distributed.initialize(
+                coordinator_address=config.coordinator_address,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
+            )
+            Engine._distributed_initialized = True
+        self.mesh = build_mesh(config.mesh)
+        log.info(
+            "Engine initialized: %d devices (%s), %d processes, mesh %s",
+            jax.device_count(),
+            jax.devices()[0].platform,
+            jax.process_count(),
+            dict(self.mesh.shape),
+        )
+
+    # -- singleton plumbing -------------------------------------------------
+    @classmethod
+    def get(cls) -> "Engine":
+        if cls._instance is None:
+            cls._instance = Engine(EngineConfig.from_env())
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+    @property
+    def node_number(self) -> int:
+        return jax.process_count()
+
+    @property
+    def core_number(self) -> int:
+        """Devices per process — the analog of coresPerExecutor."""
+        return jax.local_device_count()
+
+
+def init_engine(config: Optional[EngineConfig] = None, **mesh_axes) -> Engine:
+    """Initialize (or re-initialize) the global Engine.
+
+    ``init_engine(model=2)`` resizes the logical mesh; the analog of
+    ``Engine.init`` + ``spark-bigdl.conf`` in the reference.
+    """
+    if config is None:
+        config = EngineConfig.from_env()
+    if mesh_axes:
+        config.mesh = dataclasses.replace(config.mesh, **mesh_axes)
+    Engine._instance = Engine(config)
+    return Engine._instance

@@ -1,0 +1,98 @@
+"""Device-mesh construction over ICI/DCN.
+
+Replaces the reference's Spark executor-topology inference
+(dllib/utils/Engine.scala, unverified — mount empty): where BigDL asks SparkConf
+for node/core counts and hard-fails if it cannot infer them, the TPU runtime
+introspects ``jax.devices()`` and lays the requested logical axes
+(data / model / seq / expert / pipe) out over the physical slice so that the
+heavy-traffic axes ride ICI, not DCN.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical logical axis names, inner-to-outer traffic intensity.  "data" is
+# the allreduce axis (the AllReduceParameter analog); model/seq/expert are the
+# tensor/sequence/expert-parallel axes; pipe is pipeline stages.
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape.  Any axis set to 1 is still present (size-1 axes are
+    free in XLA) so train steps can be written once against all five axes."""
+
+    data: int = -1  # -1: fill with remaining devices
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        fixed = {
+            AXIS_MODEL: self.model,
+            AXIS_SEQ: self.seq,
+            AXIS_EXPERT: self.expert,
+            AXIS_PIPE: self.pipe,
+        }
+        prod = int(np.prod(list(fixed.values())))
+        if self.data == -1:
+            if n_devices % prod != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by model*seq*expert*pipe={prod}"
+                )
+            data = n_devices // prod
+        else:
+            data = self.data
+            if data * prod != n_devices:
+                raise ValueError(
+                    f"mesh {data}x{prod} != device count {n_devices}"
+                )
+        return {AXIS_DATA: data, **fixed}
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with the canonical axis names.
+
+    Axis order is (pipe, data, expert, seq, model): the innermost (fastest
+    varying over physically-adjacent chips) axes are the ones with the most
+    traffic per step — model/seq collectives every layer, data allreduce once
+    per step, pipeline edges lightest — so `mesh_utils` places model/seq on
+    ICI-adjacent chips.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    order = (AXIS_PIPE, AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+    shape = tuple(sizes[a] for a in order)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, order)
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[AXIS_DATA]
+
+
+def local_batch_slice(mesh: Mesh, global_batch: int) -> Tuple[int, int]:
+    """(per-process batch start, size) for host-sharded input pipelines."""
+    n_proc = jax.process_count()
+    if global_batch % n_proc != 0:
+        raise ValueError(f"global batch {global_batch} % processes {n_proc} != 0")
+    per = global_batch // n_proc
+    return jax.process_index() * per, per

@@ -1,0 +1,4 @@
+from bigdl_tpu.tensor.tensor import Tensor
+from bigdl_tpu.tensor.numeric import TensorNumeric, get_default_dtype, set_default_dtype
+
+__all__ = ["Tensor", "TensorNumeric", "get_default_dtype", "set_default_dtype"]

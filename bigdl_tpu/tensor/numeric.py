@@ -1,0 +1,33 @@
+"""Dtype policy — the TensorNumeric analog.
+
+Reference analog (unverified — mount empty): BigDL's
+``tensor/TensorNumeric.scala`` is a typeclass routing Float/Double math to MKL
+JNI calls (``vsExp``/``sgemm``/...).  On TPU there is no JNI layer: every op
+lowers to XLA.  What remains of TensorNumeric is the *policy*: which dtype
+tensors default to, and which dtype matmuls/convs accumulate in.  bfloat16 is
+the native MXU input type; float32 accumulation is XLA's default
+(preferred_element_type) and what we use.
+"""
+
+import jax.numpy as jnp
+
+_DEFAULT_DTYPE = jnp.float32
+
+
+def set_default_dtype(dtype) -> None:
+    global _DEFAULT_DTYPE
+    _DEFAULT_DTYPE = jnp.dtype(dtype)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE
+
+
+class TensorNumeric:
+    """Named dtype bundles mirroring TensorNumeric.NumericFloat etc."""
+
+    NumericFloat = jnp.float32
+    NumericDouble = jnp.float64  # requires jax_enable_x64; kept for API parity
+    NumericBFloat16 = jnp.bfloat16
+    NumericInt = jnp.int32
+    NumericBool = jnp.bool_

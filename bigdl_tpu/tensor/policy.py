@@ -1,0 +1,39 @@
+"""Mixed-precision policy.
+
+TPU-first stance: params live in float32, matmuls/convs run with bfloat16
+inputs and float32 accumulation (native MXU mode).  The reference has no such
+policy (MKL float32 everywhere); this replaces the engineType
+``mklblas|mkldnn`` switch (dllib/utils/Engine.scala, unverified) as the
+"which compute path" knob.
+"""
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+_COMPUTE_DTYPE = [jnp.float32]
+
+
+def set_compute_dtype(dtype) -> None:
+    _COMPUTE_DTYPE[0] = jnp.dtype(dtype)
+
+
+def get_compute_dtype():
+    return _COMPUTE_DTYPE[0]
+
+
+@contextmanager
+def compute_dtype(dtype):
+    old = _COMPUTE_DTYPE[0]
+    set_compute_dtype(dtype)
+    try:
+        yield
+    finally:
+        _COMPUTE_DTYPE[0] = old
+
+
+def cast_compute(*arrays):
+    """Cast op inputs to the compute dtype (no-op when already matching)."""
+    dt = _COMPUTE_DTYPE[0]
+    out = tuple(a.astype(dt) if a.dtype != dt else a for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,4 @@
+from bigdl_tpu.utils.log import get_logger
+from bigdl_tpu.utils.table import T, Table
+
+__all__ = ["get_logger", "T", "Table"]

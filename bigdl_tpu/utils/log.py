@@ -1,0 +1,47 @@
+"""Logging setup.
+
+Reference analog: BigDL routes chatty Spark loggers away and emits per-iteration
+INFO lines from the driver (dllib/utils/LoggerFilter.scala, unverified — mount
+empty). Here: plain ``logging`` with a single concise formatter; in a
+multi-process (multi-host) job only process 0 logs at INFO by default.
+"""
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _is_primary() -> bool:
+    # Must NOT trigger JAX backend initialization (get_logger runs at import
+    # time, before jax.distributed.initialize). Read already-known process id
+    # only from env / distributed global state.
+    pid = os.environ.get("BIGDL_TPU_PROCESS_ID")
+    if pid is not None:
+        return int(pid) == 0
+    try:
+        from jax._src import distributed
+
+        return (distributed.global_state.process_id or 0) == 0
+    except Exception:
+        return True
+
+
+def get_logger(name: str = "bigdl_tpu") -> logging.Logger:
+    global _CONFIGURED
+    logger = logging.getLogger(name)
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s", "%H:%M:%S"
+            )
+        )
+        root = logging.getLogger("bigdl_tpu")
+        root.addHandler(handler)
+        root.propagate = False
+        level = os.environ.get("BIGDL_TPU_LOG_LEVEL", "INFO").upper()
+        root.setLevel(level if _is_primary() else "WARNING")
+        _CONFIGURED = True
+    return logger

@@ -1,0 +1,39 @@
+"""Heterogeneous activity container.
+
+Reference analog: BigDL's ``utils/Table.scala`` ``T()`` (unverified — mount
+empty): a lua-style 1-indexed table used to pass multi-input/multi-output
+activations between layers. TPU-native version: a thin dict that is a JAX
+pytree, so it can flow through ``jit``/``grad`` unchanged.
+"""
+
+from typing import Any, Dict
+
+import jax
+
+
+@jax.tree_util.register_pytree_node_class
+class Table(dict):
+    """Dict registered as a pytree; integer keys mimic the 1-indexed T()."""
+
+    def tree_flatten(self):
+        keys = sorted(self.keys(), key=repr)
+        return [self[k] for k in keys], tuple(keys)
+
+    @classmethod
+    def tree_unflatten(cls, keys, values):
+        return cls(zip(keys, values))
+
+    def __getattr__(self, item: str) -> Any:
+        try:
+            return self[item]
+        except KeyError as e:  # pragma: no cover
+            raise AttributeError(item) from e
+
+
+def T(*args: Any, **kwargs: Any) -> Table:
+    """``T(a, b)`` -> Table {1: a, 2: b} (1-indexed, like the reference)."""
+    t = Table()
+    for i, v in enumerate(args):
+        t[i + 1] = v
+    t.update(kwargs)
+    return t
