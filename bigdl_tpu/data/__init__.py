@@ -1,0 +1,9 @@
+from bigdl_tpu.data.dataset import (
+    DataSet, ArrayDataSet, Sample, MiniBatch, SampleToMiniBatch,
+)
+from bigdl_tpu.data.transformer import Transformer, Identity as IdentityTransformer
+
+__all__ = [
+    "DataSet", "ArrayDataSet", "Sample", "MiniBatch", "SampleToMiniBatch",
+    "Transformer", "IdentityTransformer",
+]

@@ -1,0 +1,163 @@
+"""DataSet / Sample / MiniBatch — the input pipeline.
+
+Reference analog (unverified — mount empty): ``dllib/feature/dataset/
+{DataSet,Sample,MiniBatch,SampleToMiniBatch}.scala``.  There, a
+``DistributedDataSet`` is a cached Spark RDD[Sample] re-shuffled per epoch and
+batched inside each task.  TPU-native: the dataset is a **per-host sharded
+index space** over host arrays (the grain-style recipe) — each process sees
+``indices[process_id::process_count]``, shuffled identically per epoch from a
+shared seed (so the global permutation is consistent without communication),
+then batched to the per-host batch and device_put onto the local devices by
+the optimizer.
+"""
+
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Sample:
+    """One training example — reference ``Sample.scala`` (feature+label
+    tensors)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label=None):
+        self.feature = np.asarray(feature)
+        self.label = None if label is None else np.asarray(label)
+
+    def __repr__(self):
+        ls = None if self.label is None else self.label.shape
+        return f"Sample(feature={self.feature.shape}, label={ls})"
+
+
+class MiniBatch(dict):
+    """Batch dict with 'input' / 'target' arrays — reference
+    ``MiniBatch.scala`` as a plain pytree-able dict."""
+
+    @property
+    def input(self):
+        return self["input"]
+
+    @property
+    def target(self):
+        return self.get("target")
+
+    def size(self) -> int:
+        x = self["input"]
+        return x[0].shape[0] if isinstance(x, (tuple, list)) else x.shape[0]
+
+
+class DataSet:
+    """Base dataset: sized, shardable, epoch-iterable."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def batches(self, batch_size: int, *, shuffle: bool = True, seed: int = 0,
+                epoch: int = 0, drop_last: bool = True,
+                process_id: int = 0, process_count: int = 1
+                ) -> Iterator[MiniBatch]:
+        raise NotImplementedError
+
+    # -- factories mirroring the reference DataSet.array / DataSet.rdd -----
+    @staticmethod
+    def array(data, labels=None) -> "ArrayDataSet":
+        return ArrayDataSet(data, labels)
+
+    @staticmethod
+    def from_samples(samples: Sequence[Sample]) -> "ArrayDataSet":
+        feats = np.stack([s.feature for s in samples])
+        labels = (np.stack([s.label for s in samples])
+                  if samples and samples[0].label is not None else None)
+        return ArrayDataSet(feats, labels)
+
+
+class ArrayDataSet(DataSet):
+    """In-memory (host RAM) dataset over numpy arrays, with optional
+    per-sample transform applied at batch time (the Transformer chain hook)."""
+
+    def __init__(self, data, labels=None,
+                 transform: Optional[Callable[[np.ndarray], np.ndarray]] = None):
+        if isinstance(data, (tuple, list)) and labels is None and len(data) == 2:
+            data, labels = data
+        self.data = np.asarray(data)
+        self.labels = None if labels is None else np.asarray(labels)
+        if self.labels is not None and len(self.labels) != len(self.data):
+            raise ValueError(
+                f"data/labels length mismatch: {len(self.data)} vs {len(self.labels)}")
+        self.transform = transform
+
+    def size(self) -> int:
+        return len(self.data)
+
+    def transformed(self, fn) -> "ArrayDataSet":
+        prev = self.transform
+        chain = fn if prev is None else (lambda x: fn(prev(x)))
+        return ArrayDataSet(self.data, self.labels, chain)
+
+    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                drop_last=True, process_id=0, process_count=1):
+        n = len(self.data)
+        idx = np.arange(n)
+        if shuffle:
+            # same global permutation on every host (shared seed), then shard
+            rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2 ** 31))
+            rng.shuffle(idx)
+        local = idx[process_id::process_count]
+        if batch_size % process_count != 0:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {process_count} hosts")
+        per_host = batch_size // process_count
+        n_batches = (len(local) // per_host if drop_last
+                     else math.ceil(len(local) / per_host))
+        for b in range(n_batches):
+            sel = local[b * per_host:(b + 1) * per_host]
+            n_real_sel = len(sel)
+            if not drop_last and n_real_sel < per_host and n_real_sel > 0:
+                # cyclic-pad to the static batch size; padded rows carry
+                # weight 0 so metrics stay exact per-sample
+                sel = np.resize(sel, per_host)
+            x = self.data[sel]
+            if self.transform is not None:
+                x = np.stack([self.transform(s) for s in x])
+            mb = MiniBatch(input=x)
+            if self.labels is not None:
+                mb["target"] = self.labels[sel]
+            if len(sel) != n_real_sel:
+                w = np.zeros(len(sel), np.float32)
+                w[:n_real_sel] = 1.0
+                mb["weight"] = w
+            yield mb
+
+    def steps_per_epoch(self, batch_size: int, process_count: int = 1,
+                        drop_last: bool = True) -> int:
+        per_host = batch_size // process_count
+        local_n = math.ceil(self.size() / process_count)
+        return (local_n // per_host if drop_last
+                else math.ceil(local_n / per_host))
+
+
+class SampleToMiniBatch:
+    """Kept for reference-API parity: batches an iterator of Samples."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def __call__(self, samples: Iterator[Sample]) -> Iterator[MiniBatch]:
+        buf: List[Sample] = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._make(buf)
+                buf = []
+        if buf:
+            yield self._make(buf)
+
+    @staticmethod
+    def _make(buf: List[Sample]) -> MiniBatch:
+        mb = MiniBatch(input=np.stack([s.feature for s in buf]))
+        if buf[0].label is not None:
+            mb["target"] = np.stack([s.label for s in buf])
+        return mb

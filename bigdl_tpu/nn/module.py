@@ -288,9 +288,6 @@ class SelectTable(Module):
 
 
 def _as_arrays(inputs):
-    out = []
-    for x in inputs:
-        if hasattr(x, "data") and not isinstance(x, jnp.ndarray):
-            x = x.data  # unwrap bigdl_tpu Tensor
-        out.append(x)
-    return tuple(out)
+    from bigdl_tpu.tensor.tensor import Tensor
+
+    return tuple(x.data if isinstance(x, Tensor) else x for x in inputs)

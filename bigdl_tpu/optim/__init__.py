@@ -1,0 +1,17 @@
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adam, ParallelAdam, AdamWeightDecay, Adagrad, RMSprop,
+    Ftrl, LarsSGD,
+)
+from bigdl_tpu.optim.schedules import (
+    LearningRateSchedule, Default, Step, MultiStep, Exponential, NaturalExp,
+    Poly, Warmup, SequentialSchedule,
+)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss, MAE,
+    MSE,
+)
+from bigdl_tpu.optim.optimizer import (
+    Optimizer, DistriOptimizer, LocalOptimizer, TrainedModel,
+)
+from bigdl_tpu.optim.train_step import GradientClipping, ShardedParameterStep

@@ -1,0 +1,106 @@
+"""Checkpoint save/load.
+
+Reference analog (unverified — mount empty): ``Optimizer.setCheckpoint(path,
+trigger)`` saving ``model.<iter>`` / ``optimMethod.<iter>`` via Java
+serialization (``dllib/utils/File.scala``), reloaded by the driver retry loop.
+
+TPU-native: step-tagged directories with npz blobs + a JSON manifest.  Flat
+params are replicated so process 0 writes them; the sharded optimizer state is
+gathered before write (cheap relative to training; an Orbax-style per-host
+sharded write is the planned optimization for pod scale).
+"""
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.checkpoint")
+
+
+def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arr = flat[key]
+        leaves.append(arr.astype(np.asarray(leaf).dtype).reshape(np.asarray(leaf).shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
+                    model_state, driver_state: Dict[str, Any],
+                    keep_last: int = 3) -> str:
+    """Write checkpoint dir ``<path>/ckpt-<step>``; returns the dir."""
+    if jax.process_index() != 0:
+        return ""
+    d = os.path.join(path, f"ckpt-{step}")
+    tmp = d + ".tmp"
+    os.makedirs(tmp, exist_ok=True)
+    np.savez(os.path.join(tmp, "params.npz"), flat=np.asarray(flat_params))
+    np.savez(os.path.join(tmp, "opt_state.npz"), **_flatten_with_paths(opt_state))
+    np.savez(os.path.join(tmp, "model_state.npz"),
+             **_flatten_with_paths(model_state))
+    manifest = {"step": step, "driver_state": {
+        k: v for k, v in driver_state.items()
+        if isinstance(v, (int, float, str, bool))}}
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    if os.path.exists(d):
+        shutil.rmtree(d)
+    os.rename(tmp, d)
+    _gc(path, keep_last)
+    log.info("checkpoint saved: %s", d)
+    return d
+
+
+def latest_checkpoint(path: str) -> Optional[str]:
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for name in os.listdir(path):
+        if name.startswith("ckpt-") and not name.endswith(".tmp"):
+            try:
+                steps.append((int(name.split("-")[1]), name))
+            except ValueError:
+                continue
+    if not steps:
+        return None
+    return os.path.join(path, max(steps)[1])
+
+
+def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
+                    ) -> Tuple[np.ndarray, Any, Any, Dict[str, Any]]:
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    flat = np.load(os.path.join(ckpt_dir, "params.npz"))["flat"]
+    opt_flat = dict(np.load(os.path.join(ckpt_dir, "opt_state.npz")))
+    mstate_flat = dict(np.load(os.path.join(ckpt_dir, "model_state.npz")))
+    opt_state = _unflatten_like(opt_state_template, opt_flat)
+    model_state = _unflatten_like(model_state_template, mstate_flat)
+    return flat, opt_state, model_state, manifest["driver_state"]
+
+
+def _gc(path: str, keep_last: int):
+    entries = []
+    for name in os.listdir(path):
+        if name.startswith("ckpt-") and not name.endswith(".tmp"):
+            try:
+                entries.append((int(name.split("-")[1]), name))
+            except ValueError:
+                continue
+    for _, name in sorted(entries)[:-keep_last] if keep_last > 0 else []:
+        shutil.rmtree(os.path.join(path, name), ignore_errors=True)

@@ -1,0 +1,69 @@
+"""Per-iteration driver metrics.
+
+Reference analog (unverified — mount empty): ``dllib/optim/Metrics.scala`` —
+named distributed counters ("computing time average", "get weights average",
+"put gradient") logged per iteration by DistriOptimizer.  Under XLA the whole
+iteration is one fused program, so the meaningful split is host-side: data
+time (input pipeline), dispatch time (python+transfer), device step time
+(block_until_ready deltas), throughput.
+"""
+
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+
+class Metrics:
+    def __init__(self):
+        self.sums: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, value: float):
+        self.sums[name] += value
+        self.counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        c = self.counts[name]
+        return self.sums[name] / c if c else 0.0
+
+    def reset(self):
+        self.sums.clear()
+        self.counts.clear()
+
+    def summary(self) -> Dict[str, float]:
+        return {k: self.mean(k) for k in self.sums}
+
+
+class Timer:
+    def __init__(self, metrics: Metrics, name: str):
+        self.metrics = metrics
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        self.metrics.add(self.name, time.perf_counter() - self.t0)
+
+
+class SummaryWriter:
+    """jsonl scalar summary — the TrainSummary/ValidationSummary analog
+    (reference writes TensorBoard event protobufs; jsonl is the primary
+    format here, TB export is additive later)."""
+
+    def __init__(self, log_dir: str, name: str):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, f"{name}.jsonl")
+        self._f = open(self.path, "a")
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._f.write(json.dumps(
+            {"step": step, "tag": tag, "value": float(value),
+             "wall": time.time()}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()

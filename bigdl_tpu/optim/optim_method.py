@@ -1,0 +1,290 @@
+"""Optimization methods.
+
+Reference analog (unverified — mount empty): ``dllib/optim/{SGD,Adam,
+ParallelAdam,Adagrad,RMSprop,Ftrl,AdamWeightDecay,LarsSGD}.scala`` — each an
+``OptimMethod`` with mutable internal state and
+``optimize(feval, parameter)``.
+
+TPU-native re-design: pure functions over pytrees —
+``init_state(params)`` / ``update(step, grads, params, state) -> (new_params,
+new_state)``.  Because they are elementwise-pytree pure functions they run
+unchanged on (a) full replicated params or (b) a 1-D parameter *slice* inside
+the sharded (ZeRO-1 / AllReduceParameter-style) train step.  Layer-wise
+methods (LARS) set ``elementwise = False`` and require the replicated path.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.schedules import Default, LearningRateSchedule
+
+Pytree = Any
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class OptimMethod:
+    elementwise: bool = True  # safe to run on an arbitrary 1-D slice
+
+    def init_state(self, params: Pytree) -> Pytree:
+        return {}
+
+    def update(self, step, grads: Pytree, params: Pytree, state: Pytree):
+        raise NotImplementedError
+
+    def get_learning_rate(self, step):
+        return getattr(self, "lr", 0.0)
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/dampening/nesterov/weight-decay and pluggable LR
+    schedule — reference ``optim/SGD.scala`` semantics."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.lr = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            # same constraint as the reference SGD
+            self.dampening = 0.0
+
+    def get_learning_rate(self, step):
+        return self.schedule(self.lr, step)
+
+    def init_state(self, params):
+        if self.momentum > 0:
+            return {"velocity": _tmap(jnp.zeros_like, params)}
+        return {}
+
+    def update(self, step, grads, params, state):
+        lr = self.schedule(self.lr, step)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        if self.momentum > 0:
+            vel = _tmap(
+                lambda v, g: self.momentum * v + (1 - self.dampening) * g,
+                state["velocity"], grads)
+            if self.nesterov:
+                grads = _tmap(lambda g, v: g + self.momentum * v, grads, vel)
+            else:
+                grads = vel
+            state = {"velocity": vel}
+        new_params = _tmap(lambda p, g: p - lr * g, params, grads)
+        return new_params, state
+
+
+class Adam(OptimMethod):
+    """Reference ``optim/Adam.scala`` (and ``ParallelAdam`` — parallelism is
+    free here: the sharded path runs the same math on slices)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.lr = learning_rate
+        self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+
+    def get_learning_rate(self, step):
+        return self.schedule(self.lr, step)
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        lr = self.schedule(self.lr, step)
+        t = step + 1
+        m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g,
+                  state["m"], grads)
+        v = _tmap(lambda v, g: self.beta2 * v + (1 - self.beta2) * g * g,
+                  state["v"], grads)
+        bc1 = 1 - self.beta1 ** t
+        bc2 = 1 - self.beta2 ** t
+        new_params = _tmap(
+            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.eps),
+            params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+ParallelAdam = Adam
+
+
+class AdamWeightDecay(OptimMethod):
+    """Decoupled weight decay + linear warmup/decay — reference
+    ``optim/AdamWeightDecay.scala`` (the BERT fine-tune method)."""
+
+    def __init__(self, learning_rate: float = 1e-3, warmup_portion: float = -1.0,
+                 total: int = -1, schedule: str = "linear", beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-6,
+                 weight_decay: float = 0.01):
+        self.lr = learning_rate
+        self.warmup_portion = warmup_portion
+        self.total = total
+        self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+
+    def get_learning_rate(self, step):
+        if self.total <= 0:
+            return self.lr
+        progress = step / self.total
+        warm = max(self.warmup_portion, 0.0)
+        warm_lr = self.lr * progress / warm if warm > 0 else self.lr
+        decay_lr = self.lr * (1.0 - progress)
+        return jnp.where(progress < warm, warm_lr, decay_lr)
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        lr = self.get_learning_rate(step)
+        m = _tmap(lambda m, g: self.beta1 * m + (1 - self.beta1) * g,
+                  state["m"], grads)
+        v = _tmap(lambda v, g: self.beta2 * v + (1 - self.beta2) * g * g,
+                  state["v"], grads)
+        new_params = _tmap(
+            lambda p, m_, v_: p - lr * (m_ / (jnp.sqrt(v_) + self.eps)
+                                        + self.weight_decay * p),
+            params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+class Adagrad(OptimMethod):
+    """Reference ``optim/Adagrad.scala``."""
+
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0, weight_decay: float = 0.0):
+        self.lr = learning_rate
+        self.decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {"accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        lr = self.lr / (1.0 + step * self.decay)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        accum = _tmap(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = _tmap(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10), params, grads,
+            accum)
+        return new_params, {"accum": accum}
+
+
+class RMSprop(OptimMethod):
+    """Reference ``optim/RMSprop.scala``."""
+
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0, decay_rate: float = 0.99,
+                 epsilon: float = 1e-8):
+        self.lr = learning_rate
+        self.decay = learning_rate_decay
+        self.rho = decay_rate
+        self.eps = epsilon
+
+    def init_state(self, params):
+        return {"rms": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        lr = self.lr / (1.0 + step * self.decay)
+        rms = _tmap(lambda r, g: self.rho * r + (1 - self.rho) * g * g,
+                    state["rms"], grads)
+        new_params = _tmap(
+            lambda p, g, r: p - lr * g / (jnp.sqrt(r) + self.eps), params,
+            grads, rms)
+        return new_params, {"rms": rms}
+
+
+class Ftrl(OptimMethod):
+    """Reference ``optim/Ftrl.scala`` (recsys sparse-ish method)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0):
+        self.lr = learning_rate
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+
+    def init_state(self, params):
+        return {"accum": _tmap(lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        def upd(p, g, n, z):
+            new_n = n + g * g
+            sigma = (new_n ** -self.lr_power - n ** -self.lr_power) / self.lr
+            new_z = z + g - sigma * p
+            new_p = jnp.where(
+                jnp.abs(new_z) > self.l1,
+                -(new_z - jnp.sign(new_z) * self.l1)
+                / (new_n ** -self.lr_power / self.lr + 2 * self.l2),
+                0.0)
+            return new_p, new_n, new_z
+
+        flat = _tmap(upd, params, grads, state["accum"], state["linear"])
+        leaves, treedef = jax.tree_util.tree_flatten(
+            flat, is_leaf=lambda x: isinstance(x, tuple))
+        new_p = treedef.unflatten([l[0] for l in leaves])
+        accum = treedef.unflatten([l[1] for l in leaves])
+        linear = treedef.unflatten([l[2] for l in leaves])
+        return new_p, {"accum": accum, "linear": linear}
+
+
+class LarsSGD(OptimMethod):
+    """Layer-wise adaptive rate scaling — reference ``optim/LarsSGD.scala``.
+    Needs per-layer norms so it runs on the replicated (non-ZeRO) path."""
+
+    elementwise = False
+
+    def __init__(self, learning_rate: float = 1e-1, momentum: float = 0.9,
+                 weight_decay: float = 5e-4, trust_coefficient: float = 1e-3,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.trust = trust_coefficient
+        self.schedule = learning_rate_schedule or Default(0.0)
+
+    def get_learning_rate(self, step):
+        return self.schedule(self.lr, step)
+
+    def init_state(self, params):
+        return {"velocity": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        lr = self.schedule(self.lr, step)
+
+        def upd(p, g, v):
+            p_norm = jnp.linalg.norm(p.ravel())
+            g_norm = jnp.linalg.norm(g.ravel())
+            local_lr = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                self.trust * p_norm / (g_norm + self.weight_decay * p_norm + 1e-12),
+                1.0)
+            new_v = self.momentum * v + lr * local_lr * (
+                g + self.weight_decay * p)
+            return p - new_v, new_v
+
+        flat = _tmap(upd, params, grads, state["velocity"])
+        leaves, treedef = jax.tree_util.tree_flatten(
+            flat, is_leaf=lambda x: isinstance(x, tuple))
+        new_p = treedef.unflatten([l[0] for l in leaves])
+        vel = treedef.unflatten([l[1] for l in leaves])
+        return new_p, {"velocity": vel}

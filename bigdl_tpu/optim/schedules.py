@@ -1,0 +1,124 @@
+"""Learning-rate schedules.
+
+Reference analog (unverified — mount empty): inner classes of
+``dllib/optim/SGD.scala`` — ``Default``, ``Step``, ``MultiStep``,
+``Exponential``, ``Poly``, ``Plateau``, ``Warmup``, ``SequentialSchedule``,
+``EpochDecay``, ``NaturalExp``.  Functional here: ``schedule(step) -> lr
+multiplier-resolved absolute lr``, traceable inside jit (pure jnp math on the
+step counter, no data-dependent python control flow).
+"""
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+class LearningRateSchedule:
+    def __call__(self, lr: float, step):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + step*decay) — SGD.Default in the reference."""
+
+    def __init__(self, learning_rate_decay: float = 0.0):
+        self.decay = learning_rate_decay
+
+    def __call__(self, lr, step):
+        return lr / (1.0 + step * self.decay)
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(step/step_size)) — SGD.Step."""
+
+    def __init__(self, step_size: int, gamma: float = 0.1):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def __call__(self, lr, step):
+        return lr * self.gamma ** jnp.floor(step / self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """lr * gamma^(#milestones passed) — SGD.MultiStep."""
+
+    def __init__(self, step_sizes: Sequence[int], gamma: float = 0.1):
+        self.step_sizes = jnp.asarray(step_sizes)
+        self.gamma = gamma
+
+    def __call__(self, lr, step):
+        passed = jnp.sum(step >= self.step_sizes)
+        return lr * self.gamma ** passed
+
+
+class Exponential(LearningRateSchedule):
+    """SGD.Exponential: lr * decay_rate^(step/decay_step), optionally staircase."""
+
+    def __init__(self, decay_step: int, decay_rate: float, stair_case: bool = False):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.stair_case = stair_case
+
+    def __call__(self, lr, step):
+        p = step / self.decay_step
+        if self.stair_case:
+            p = jnp.floor(p)
+        return lr * self.decay_rate ** p
+
+
+class NaturalExp(LearningRateSchedule):
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step = decay_step
+        self.gamma = gamma
+
+    def __call__(self, lr, step):
+        return lr * jnp.exp(-self.gamma * jnp.floor(step / self.decay_step))
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - step/max_iter)^power — SGD.Poly (the reference ResNet/
+    ImageNet schedule)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def __call__(self, lr, step):
+        frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
+        return lr * (1.0 - frac) ** self.power
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp by delta per step — SGD.Warmup (pair inside
+    SequentialSchedule like the reference's large-batch ImageNet recipe)."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def __call__(self, lr, step):
+        return lr + self.delta * step
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for ``iterations`` steps — SGD.
+    SequentialSchedule."""
+
+    def __init__(self):
+        self.schedules: List[Tuple[LearningRateSchedule, int]] = []
+
+    def add(self, schedule: LearningRateSchedule, iterations: int):
+        self.schedules.append((schedule, iterations))
+        return self
+
+    def __call__(self, lr, step):
+        out = lr
+        offset = 0
+        # resolved as nested where's — fine for a handful of phases
+        result = None
+        for schedule, iters in self.schedules:
+            local = jnp.clip(step - offset, 0, iters)
+            val = schedule(lr, local)
+            active = step >= offset
+            result = val if result is None else jnp.where(active, val, result)
+            offset += iters
+        return result if result is not None else out

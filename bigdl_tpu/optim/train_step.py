@@ -1,0 +1,250 @@
+"""The distributed train step — heart of the framework.
+
+Reference analog (unverified — mount empty): ``dllib/optim/DistriOptimizer.
+scala`` task body + ``optim/parameters/AllReduceParameter.scala``: weights are
+flattened into ONE contiguous 1-D storage, gradients are split into
+``partitionNum`` chunks pushed through Spark's BlockManager, each partition
+owner sums its slice, applies the OptimMethod **on the slice only** (optimizer
+state lives sharded — ZeRO-1, 2016 vintage), publishes the updated slice, and
+every task gathers all slices next iteration.
+
+TPU-native mapping (this file): the same algorithm as ONE ``shard_map``-ped
+XLA program over the mesh's "data" axis —
+
+    flat grads --psum_scatter--> grad slice       (BlockManager put+sum)
+    OptimMethod.update(slice)                     (partition-owner update)
+    --all_gather--> new flat params               (next-iteration getWeights)
+
+so the BlockManager/netty transport becomes ICI collectives and the two Spark
+stages per iteration become zero host round-trips.  FP16 gradient compression
+(``FP16CompressedTensor``) is unnecessary over ICI (bf16-grad option covers
+the DCN-bound case).  See PAPERS.md "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" for why this is the native XLA form.
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from bigdl_tpu.runtime.mesh import AXIS_DATA
+
+
+@dataclass
+class GradientClipping:
+    """Reference ``optim/parameters/ParameterProcessor.scala``:
+    ConstantClippingProcessor / L2NormClippingProcessor."""
+
+    constant_min: Optional[float] = None
+    constant_max: Optional[float] = None
+    l2_norm: Optional[float] = None
+
+
+def _clip_slice(g_slice, clip: Optional[GradientClipping], axis: str):
+    if clip is None:
+        return g_slice
+    if clip.constant_min is not None or clip.constant_max is not None:
+        g_slice = jnp.clip(g_slice, clip.constant_min, clip.constant_max)
+    if clip.l2_norm is not None:
+        # global norm over the full (sharded) gradient vector
+        sq = jax.lax.psum(jnp.sum(g_slice.astype(jnp.float32) ** 2), axis)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, clip.l2_norm / (norm + 1e-12))
+        g_slice = g_slice * scale
+    return g_slice
+
+
+class ShardedParameterStep:
+    """Builds the jitted ZeRO-1 train/eval steps for a model+criterion over a
+    mesh.  Owns the flat-parameter layout (the ``AllReduceParameter`` role)."""
+
+    def __init__(self, model, criterion, optim_method, mesh: Mesh,
+                 init_variables: Dict[str, Any],
+                 clip: Optional[GradientClipping] = None):
+        self.model = model
+        self.criterion = criterion
+        self.optim = optim_method
+        self.mesh = mesh
+        self.clip = clip
+        self.ndev = mesh.shape[AXIS_DATA]
+
+        flat, self.unravel = ravel_pytree(init_variables["params"])
+        self.n_real = flat.shape[0]
+        self.n_pad = -(-self.n_real // self.ndev) * self.ndev
+        self.shard_size = self.n_pad // self.ndev
+
+        self._rep = NamedSharding(mesh, P())
+        self._sharded_vec = NamedSharding(mesh, P(AXIS_DATA))
+        self._batch_sh = NamedSharding(mesh, P(AXIS_DATA))
+
+        # initial device state
+        self.flat_params = jax.device_put(
+            jnp.pad(flat, (0, self.n_pad - self.n_real)), self._rep)
+        self.model_state = jax.device_put(init_variables.get("state", {}),
+                                          self._rep)
+        if self.optim.elementwise:
+            opt_state = self.optim.init_state(jnp.zeros((self.n_pad,), flat.dtype))
+            self.opt_state = jax.device_put(opt_state, self._sharded_vec)
+        else:
+            self.opt_state = jax.device_put(
+                self.optim.init_state(init_variables["params"]), self._rep)
+
+        self._train = self._build_train()
+        self._eval_cache: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------------
+    def _build_train(self):
+        model, criterion, optim = self.model, self.criterion, self.optim
+        unravel, n_real = self.unravel, self.n_real
+        ndev, shard_size = self.ndev, self.shard_size
+        clip = self.clip
+        elementwise = optim.elementwise
+
+        def step_shard(flat_p, opt_state, mstate, step, rng, x, y):
+            params = unravel(flat_p[:n_real])
+            dev_rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
+
+            def loss_fn(p):
+                out, new_mstate = model.forward(
+                    p, mstate, x, training=True, rng=dev_rng)
+                return criterion.forward(out, y), new_mstate
+
+            (loss, new_mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            flat_g, _ = ravel_pytree(grads)
+            flat_g = jnp.pad(flat_g, (0, flat_p.shape[0] - n_real))
+
+            if elementwise:
+                # reduce-scatter (mean) -> sharded update -> all-gather:
+                # exactly AllReduceParameter's put/aggregate/send cycle.
+                g_slice = jax.lax.psum_scatter(
+                    flat_g, AXIS_DATA, scatter_dimension=0, tiled=True) / ndev
+                g_slice = _clip_slice(g_slice, clip, AXIS_DATA)
+                rank = jax.lax.axis_index(AXIS_DATA)
+                p_slice = jax.lax.dynamic_slice(
+                    flat_p, (rank * shard_size,), (shard_size,))
+                new_p_slice, new_opt = optim.update(
+                    step, g_slice, p_slice, opt_state)
+                new_flat = jax.lax.all_gather(
+                    new_p_slice, AXIS_DATA, tiled=True)
+            else:
+                # layerwise methods (LARS): plain psum allreduce + replicated
+                # update (matches the reference's treatment pre-slice-sharding)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, AXIS_DATA), grads)
+                if clip is not None and clip.l2_norm is not None:
+                    fg, _ = ravel_pytree(grads)
+                    norm = jnp.linalg.norm(fg)
+                    scale = jnp.minimum(1.0, clip.l2_norm / (norm + 1e-12))
+                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                new_params, new_opt = optim.update(step, grads, params, opt_state)
+                nf, _ = ravel_pytree(new_params)
+                new_flat = jnp.pad(nf, (0, flat_p.shape[0] - n_real))
+
+            loss = jax.lax.pmean(loss, AXIS_DATA)
+            new_mstate = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, AXIS_DATA)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                new_mstate)
+            return new_flat, new_opt, new_mstate, loss
+
+        opt_spec = (P(AXIS_DATA) if elementwise else P())
+        mapped = shard_map(
+            step_shard, mesh=self.mesh,
+            in_specs=(P(), opt_spec, P(), P(), P(), P(AXIS_DATA), P(AXIS_DATA)),
+            out_specs=(P(), opt_spec, P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def _build_eval(self, methods: Tuple):
+        model, unravel, n_real = self.model, self.unravel, self.n_real
+
+        def eval_shard(flat_p, mstate, x, y, w):
+            params = unravel(flat_p[:n_real])
+            out, _ = model.forward(params, mstate, x, training=False)
+            stats = []
+            for m in methods:
+                s, c = m.batch_stats(out, y, w)
+                stats.append((jax.lax.psum(s, AXIS_DATA),
+                              jax.lax.psum(c, AXIS_DATA)))
+            return tuple(stats)
+
+        mapped = shard_map(
+            eval_shard, mesh=self.mesh,
+            in_specs=(P(), P(), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA)),
+            out_specs=P(), check_vma=False)
+        return jax.jit(mapped)
+
+    # ------------------------------------------------------------------
+    def shard_batch(self, arr: np.ndarray):
+        """Host numpy (per-process shard) -> global device array on the data
+        axis."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, self._batch_sh)
+        return jax.make_array_from_process_local_data(self._batch_sh, arr)
+
+    def train_step(self, step: int, rng, x, y):
+        self.flat_params, self.opt_state, self.model_state, loss = self._train(
+            self.flat_params, self.opt_state, self.model_state,
+            jnp.asarray(step, jnp.int32), rng,
+            self.shard_batch(x), self.shard_batch(y))
+        return loss
+
+    def evaluate(self, methods, batches) -> list:
+        # cache key must be the method *instances* (two Loss() objects with
+        # different criteria are different programs); holding them in the
+        # cache keeps ids stable
+        key = tuple(id(m) for m in methods)
+        if key not in self._eval_cache:
+            self._eval_cache[key] = (tuple(methods),
+                                     self._build_eval(tuple(methods)))
+        _, fn = self._eval_cache[key]
+        totals = None
+        for mb in batches:
+            x = mb["input"]
+            w = mb.get("weight")
+            if w is None:
+                w = np.ones((x.shape[0],), np.float32)
+            stats = fn(self.flat_params, self.model_state,
+                       self.shard_batch(x),
+                       self.shard_batch(mb["target"]),
+                       self.shard_batch(w))
+            stats = [(float(s), float(c)) for s, c in stats]
+            if totals is None:
+                totals = stats
+            else:
+                totals = [(a + s, b + c) for (a, b), (s, c) in zip(totals, stats)]
+        return [m.fold(s, c) for m, (s, c) in zip(methods, totals or [])]
+
+    # ------------------------------------------------------------------
+    def get_variables(self) -> Dict[str, Any]:
+        flat = np.asarray(self.flat_params)[: self.n_real]
+        return {"params": self.unravel(jnp.asarray(flat)),
+                "state": jax.device_get(self.model_state)}
+
+    def predict_fn(self):
+        """Jitted inference callable over the mesh (batch data-sharded)."""
+        model, unravel, n_real = self.model, self.unravel, self.n_real
+
+        @jax.jit
+        def fwd(flat_p, mstate, x):
+            params = unravel(flat_p[:n_real])
+            out, _ = model.forward(params, mstate, x, training=False)
+            return out
+
+        def run(x):
+            return fwd(self.flat_params, self.model_state, self.shard_batch(x))
+
+        return run

@@ -1,0 +1,59 @@
+"""Triggers — when to stop / validate / checkpoint.
+
+Reference analog (unverified — mount empty): ``dllib/optim/Trigger.scala`` —
+``everyEpoch``, ``severalIteration``, ``maxEpoch``, ``maxIteration``,
+``maxScore``, ``minLoss``, ``and``/``or``.  Evaluated host-side on the driver
+state dict (epoch, iteration ["neval"], loss, score, epoch_finished).
+"""
+
+from typing import Callable, Dict
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger"):
+        self.fn = fn
+        self.desc = desc
+
+    def __call__(self, state: Dict) -> bool:
+        return bool(self.fn(state))
+
+    def __repr__(self):
+        return f"Trigger({self.desc})"
+
+    # -- factories (reference names, snake_case) ---------------------------
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        """Fires at each epoch boundary (reference everyEpoch)."""
+        return Trigger(lambda s: s.get("epoch_finished", False), "every_epoch")
+
+    @staticmethod
+    def several_iteration(n: int) -> "Trigger":
+        return Trigger(lambda s: s["iteration"] > 0 and s["iteration"] % n == 0,
+                       f"several_iteration({n})")
+
+    @staticmethod
+    def max_epoch(n: int) -> "Trigger":
+        """True once epoch count exceeds n (epochs are 1-based like the
+        reference)."""
+        return Trigger(lambda s: s["epoch"] > n, f"max_epoch({n})")
+
+    @staticmethod
+    def max_iteration(n: int) -> "Trigger":
+        return Trigger(lambda s: s["iteration"] >= n, f"max_iteration({n})")
+
+    @staticmethod
+    def min_loss(v: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss", float("inf")) < v, f"min_loss({v})")
+
+    @staticmethod
+    def max_score(v: float) -> "Trigger":
+        return Trigger(lambda s: s.get("score", float("-inf")) > v,
+                       f"max_score({v})")
+
+    @staticmethod
+    def and_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+
+    @staticmethod
+    def or_(*triggers: "Trigger") -> "Trigger":
+        return Trigger(lambda s: any(t(s) for t in triggers), "or")

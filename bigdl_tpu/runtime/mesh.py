@@ -52,9 +52,9 @@ class MeshSpec:
             data = n_devices // prod
         else:
             data = self.data
-            if data * prod != n_devices:
+            if data * prod > n_devices:
                 raise ValueError(
-                    f"mesh {data}x{prod} != device count {n_devices}"
+                    f"mesh {data}x{prod} exceeds device count {n_devices}"
                 )
         return {AXIS_DATA: data, **fixed}
 
@@ -76,6 +76,16 @@ def build_mesh(
     sizes = spec.resolve(len(devices))
     order = (AXIS_PIPE, AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
     shape = tuple(sizes[a] for a in order)
+    total = int(np.prod(shape))
+    if total < len(devices):
+        # a sub-mesh is allowed in single-process runs (tests, debugging) but
+        # would strand whole hosts' devices in a multi-process job while the
+        # input pipeline still shards by process_count
+        if jax.process_count() > 1:
+            raise ValueError(
+                f"mesh size {total} < device count {len(devices)} is not "
+                "supported in multi-process runs")
+        devices = devices[:total]
     try:
         from jax.experimental import mesh_utils
 
