@@ -110,15 +110,22 @@ class ArrayDataSet(DataSet):
             raise ValueError(
                 f"global batch {batch_size} not divisible by {process_count} hosts")
         per_host = batch_size // process_count
-        n_batches = (len(local) // per_host if drop_last
-                     else math.ceil(len(local) / per_host))
+        # the step count must be computed from GLOBAL sizes so every process
+        # dispatches the same number of collective-bearing steps (different
+        # local shard lengths would deadlock a multi-host job)
+        min_local = n // process_count
+        max_local = min_local + (1 if n % process_count else 0)
+        n_batches = (min_local // per_host if drop_last
+                     else math.ceil(max_local / per_host))
+        filler = local if len(local) else idx[:1]
         for b in range(n_batches):
             sel = local[b * per_host:(b + 1) * per_host]
             n_real_sel = len(sel)
-            if not drop_last and n_real_sel < per_host and n_real_sel > 0:
+            if n_real_sel < per_host:
                 # cyclic-pad to the static batch size; padded rows carry
                 # weight 0 so metrics stay exact per-sample
-                sel = np.resize(sel, per_host)
+                sel = np.concatenate(
+                    [sel, np.resize(filler, per_host - n_real_sel)])
             x = self.data[sel]
             if self.transform is not None:
                 x = np.stack([self.transform(s) for s in x])
@@ -134,9 +141,11 @@ class ArrayDataSet(DataSet):
     def steps_per_epoch(self, batch_size: int, process_count: int = 1,
                         drop_last: bool = True) -> int:
         per_host = batch_size // process_count
-        local_n = math.ceil(self.size() / process_count)
-        return (local_n // per_host if drop_last
-                else math.ceil(local_n / per_host))
+        n = self.size()
+        min_local = n // process_count
+        max_local = min_local + (1 if n % process_count else 0)
+        return (min_local // per_host if drop_last
+                else math.ceil(max_local / per_host))
 
 
 class SampleToMiniBatch:

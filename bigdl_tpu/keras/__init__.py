@@ -1,0 +1,53 @@
+"""Keras-style API — reference ``dllib/keras`` (keras-1 style layer names).
+
+Layers are the nn catalog re-exported under keras names; models are
+``Sequential`` and functional ``Model(inputs, outputs)`` with
+``compile/fit/evaluate/predict``.
+"""
+
+from bigdl_tpu.keras.engine import Input, Model, Node, Sequential
+
+# keras-1 layer names (reference keras/layers/*.scala) -> nn catalog
+from bigdl_tpu.nn import (
+    Dense, Dropout, Flatten, Embedding, LayerNorm,
+    LSTM, GRU, SimpleRNN, TimeDistributed,
+    MultiHeadAttention, TransformerLayer,
+)
+from bigdl_tpu.nn.layers import (
+    Conv2D as Convolution2D, Conv2D,
+    Conv1D as Convolution1D, Conv1D,
+    MaxPool2D as MaxPooling2D,
+    AvgPool2D as AveragePooling2D,
+    GlobalAvgPool2D as GlobalAveragePooling2D,
+    BatchNorm as BatchNormalization,
+    ZeroPadding2D, Reshape,
+)
+from bigdl_tpu.nn.layers import _act  # noqa: F401  (internal)
+from bigdl_tpu.nn import (
+    ReLU, Tanh, Sigmoid, SoftMax, LogSoftMax, GELU, ELU, LeakyReLU,
+)
+
+
+class Activation:
+    """keras Activation('relu') factory — returns the matching nn module."""
+
+    def __new__(cls, name: str):
+        from bigdl_tpu import nn as _nn
+
+        table = {
+            "relu": _nn.ReLU, "tanh": _nn.Tanh, "sigmoid": _nn.Sigmoid,
+            "softmax": _nn.SoftMax, "log_softmax": _nn.LogSoftMax,
+            "gelu": _nn.GELU, "elu": _nn.ELU, "linear": _nn.Identity,
+        }
+        return table[name.lower()]()
+
+
+__all__ = [
+    "Input", "Model", "Node", "Sequential", "Activation",
+    "Dense", "Dropout", "Flatten", "Embedding", "LayerNorm", "LSTM", "GRU",
+    "SimpleRNN", "TimeDistributed", "MultiHeadAttention", "TransformerLayer",
+    "Convolution2D", "Conv2D", "Convolution1D", "Conv1D", "MaxPooling2D",
+    "AveragePooling2D", "GlobalAveragePooling2D", "BatchNormalization",
+    "ZeroPadding2D", "Reshape", "ReLU", "Tanh", "Sigmoid", "SoftMax",
+    "LogSoftMax", "GELU", "ELU", "LeakyReLU",
+]

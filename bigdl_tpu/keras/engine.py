@@ -1,0 +1,212 @@
+"""Keras-style model engine: Sequential + functional Model(inputs, outputs).
+
+Reference analog (unverified — mount empty): ``dllib/keras/{Sequential,Model}.
+scala`` + ``nn/Graph.scala``/``StaticGraph.scala`` — keras-1-style API with
+shape inference, compiled onto the nn core; ``compile/fit/evaluate/predict``
+plumb into ``InternalDistriOptimizer``.
+
+Here a ``Model`` is itself an ``nn.Module`` (graph of nodes, topologically
+executed), so the whole keras layer sits directly on the L4 sharded optimizer.
+Symbolic graph building: calling any ``nn.Module`` on a ``Node`` returns a new
+``Node`` (see ``Module.__call__`` overload hook).
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from bigdl_tpu.nn.module import EMPTY, Module
+
+_node_counter = [0]
+
+
+class Node:
+    """Symbolic tensor in the layer graph."""
+
+    def __init__(self, layer: Optional[Module], parents: Sequence["Node"],
+                 shape: Optional[Tuple[int, ...]] = None):
+        _node_counter[0] += 1
+        self.id = _node_counter[0]
+        self.layer = layer
+        self.parents = list(parents)
+        self.shape = shape  # only set for Input nodes
+        lname = layer.name if layer is not None else "input"
+        self.name = f"{lname}_{self.id}"
+
+    def __repr__(self):
+        return f"Node({self.name})"
+
+
+def Input(shape: Tuple[int, ...], dtype=np.float32) -> Node:
+    """Symbolic input — reference ``keras/Input``. ``shape`` EXCLUDES the
+    batch dim (keras convention)."""
+    n = Node(None, [], shape=None if shape is None else tuple(shape))
+    n.dtype = dtype
+    return n
+
+
+def _topo_order(outputs: List[Node]) -> List[Node]:
+    order, seen = [], set()
+
+    def visit(n: Node):
+        if n.id in seen:
+            return
+        seen.add(n.id)
+        for p in n.parents:
+            visit(p)
+        order.append(n)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+class Model(Module):
+    """Functional graph model — reference ``keras/Model.scala`` (and the nn
+    ``Graph``)."""
+
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]], name=None):
+        super().__init__(name or "Model")
+        self.inputs = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.outputs = [outputs] if isinstance(outputs, Node) else list(outputs)
+        self.order = _topo_order(self.outputs)
+        self._compiled: Optional[Dict[str, Any]] = None
+
+    # ---- Module contract --------------------------------------------------
+    def init(self, rng, *sample_inputs):
+        values: Dict[int, Any] = {}
+        for node, x in zip(self.inputs, sample_inputs):
+            values[node.id] = np.asarray(x)
+        params, state = {}, {}
+        for i, node in enumerate(self.order):
+            if node.layer is None:
+                continue
+            xs = [values[p.id] for p in node.parents]
+            v = node.layer.init(jax.random.fold_in(rng, i), *xs)
+            if v["params"]:
+                params[node.name] = v["params"]
+            if v["state"]:
+                state[node.name] = v["state"]
+            y, _ = node.layer.apply(v, *xs, training=False)
+            values[node.id] = y
+        return {"params": params, "state": state}
+
+    def forward(self, params, state, *inputs, training=False, rng=None):
+        values: Dict[int, Any] = {}
+        for node, x in zip(self.inputs, inputs):
+            values[node.id] = x
+        new_state = dict(state)
+        for i, node in enumerate(self.order):
+            if node.layer is None:
+                continue
+            xs = [values[p.id] for p in node.parents]
+            y, st = node.layer.forward(
+                params.get(node.name, EMPTY), state.get(node.name, EMPTY),
+                *xs, training=training,
+                rng=None if rng is None else jax.random.fold_in(rng, i))
+            if st:
+                new_state[node.name] = st
+            values[node.id] = y
+        outs = [values[o.id] for o in self.outputs]
+        return outs[0] if len(outs) == 1 else tuple(outs), new_state
+
+    # ---- keras training API ----------------------------------------------
+    def compile(self, optimizer, loss, metrics: Sequence = ()):
+        """Reference ``keras Model.compile(optimizer, loss, metrics)``."""
+        from bigdl_tpu.keras.training import resolve_compile
+
+        self._compiled = resolve_compile(optimizer, loss, metrics)
+        return self
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, checkpoint_path: Optional[str] = None,
+            log_every: int = 10, **kw):
+        from bigdl_tpu.keras.training import fit_module
+
+        if self._compiled is None:
+            raise RuntimeError("call compile(...) before fit(...)")
+        self._trained = fit_module(
+            self, self._compiled, x, y, batch_size=batch_size,
+            nb_epoch=nb_epoch, validation_data=validation_data,
+            checkpoint_path=checkpoint_path, log_every=log_every, **kw)
+        return self._trained
+
+    def predict(self, x, batch_size: int = 0):
+        self._require_trained()
+        return self._trained.predict(np.asarray(x), batch_size=batch_size)
+
+    def evaluate(self, x, y=None, batch_size: int = 32):
+        from bigdl_tpu.data import ArrayDataSet
+
+        self._require_trained()
+        ds = ArrayDataSet(np.asarray(x), None if y is None else np.asarray(y))
+        from bigdl_tpu.optim import Loss
+
+        methods = (self._compiled or {}).get("metrics")
+        if not methods:
+            # default to the effective loss (compiled, else the criterion the
+            # trained engine was built with — the set_weights path)
+            loss = ((self._compiled or {}).get("loss")
+                    or self._trained._engine.criterion)
+            methods = [Loss(loss)]
+        return self._trained.evaluate(ds, methods, batch_size=batch_size)
+
+    def set_weights(self, variables):
+        """Install externally-trained variables (predict/evaluate without
+        fit)."""
+        from bigdl_tpu.keras.training import make_trained
+
+        self._trained = make_trained(self, variables, self._compiled)
+
+    def _require_trained(self):
+        if not hasattr(self, "_trained"):
+            raise RuntimeError("model has no weights yet: fit() or "
+                               "set_weights() first")
+
+    def get_weights(self):
+        self._require_trained()
+        return self._trained.variables
+
+    def summary(self, variables=None) -> str:
+        lines = [f"Model '{self.name}':"]
+        for node in self.order:
+            if node.layer is None:
+                lines.append(f"  Input {node.shape}")
+            else:
+                lines.append(f"  {node.name} <- "
+                             f"{[p.name for p in node.parents]}")
+        return "\n".join(lines)
+
+
+class Sequential(Model):
+    """Keras Sequential — reference ``keras/Sequential.scala``.  Built as a
+    degenerate graph so fit/predict/evaluate are shared with Model."""
+
+    def __init__(self, layers: Sequence[Module] = (), input_shape=None,
+                 name=None):
+        self._layers: List[Module] = []
+        self._input_shape = input_shape
+        self._head: Optional[Node] = None
+        Module.__init__(self, name or "Sequential")
+        self.inputs, self.outputs, self.order = [], [], []
+        self._compiled = None
+        for l in layers:
+            self.add(l)
+
+    def add(self, layer: Module) -> "Sequential":
+        self._layers.append(layer)
+        self._rebuild()
+        return self
+
+    def _rebuild(self):
+        if self._input_shape is not None:
+            inp = Input(self._input_shape)
+        else:
+            inp = Input(shape=None)
+        node = inp
+        for l in self._layers:
+            node = Node(l, [node])
+        self.inputs, self.outputs = [inp], [node]
+        self.order = _topo_order(self.outputs)

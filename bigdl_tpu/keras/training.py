@@ -1,0 +1,96 @@
+"""compile/fit plumbing: keras API -> L4 Optimizer.
+
+Reference analog (unverified — mount empty): ``keras/python/PythonZooKeras.
+zooFit`` -> ``InternalDistriOptimizer`` (SURVEY.md §4.2) — here it is a direct
+in-process call, no py4j boundary.
+"""
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.data import ArrayDataSet
+from bigdl_tpu.nn import criterion as crit_mod
+from bigdl_tpu.optim import (
+    Adam, Loss, MAE, Optimizer, SGD, Top1Accuracy, Top5Accuracy, Trigger,
+)
+from bigdl_tpu.optim.optimizer import TrainedModel
+from bigdl_tpu.optim.train_step import ShardedParameterStep
+from bigdl_tpu.runtime.engine import Engine
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGD(learning_rate=1e-2),
+    "adam": lambda: Adam(learning_rate=1e-3),
+}
+
+_LOSSES = {
+    "categorical_crossentropy": crit_mod.CrossEntropyCriterion,
+    "sparse_categorical_crossentropy": crit_mod.CrossEntropyCriterion,
+    "mse": crit_mod.MSECriterion,
+    "mean_squared_error": crit_mod.MSECriterion,
+    "mae": crit_mod.AbsCriterion,
+    "mean_absolute_error": crit_mod.AbsCriterion,
+    "binary_crossentropy": crit_mod.BCECriterion,
+    "nll": crit_mod.ClassNLLCriterion,
+}
+
+_METRICS = {
+    "accuracy": Top1Accuracy,
+    "acc": Top1Accuracy,
+    "top1": Top1Accuracy,
+    "top5": Top5Accuracy,
+    "mae": MAE,
+    "loss": Loss,
+}
+
+
+def resolve_compile(optimizer, loss, metrics: Sequence) -> Dict[str, Any]:
+    if isinstance(optimizer, str):
+        optimizer = _OPTIMIZERS[optimizer.lower()]()
+    if isinstance(loss, str):
+        loss = _LOSSES[loss.lower()]()
+    resolved = []
+    for m in metrics:
+        if isinstance(m, str):
+            if m.lower() == "loss":  # the compiled loss, not a default one
+                resolved.append(Loss(loss))
+            else:
+                resolved.append(_METRICS[m.lower()]())
+        else:
+            resolved.append(m)
+    return {"optimizer": optimizer, "loss": loss, "metrics": resolved}
+
+
+def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
+               nb_epoch=10, validation_data=None, checkpoint_path=None,
+               log_every=10, end_trigger=None) -> TrainedModel:
+    if isinstance(x, ArrayDataSet):
+        ds = x
+    else:
+        ds = ArrayDataSet(np.asarray(x), None if y is None else np.asarray(y))
+    opt = Optimizer(model, ds, compiled["loss"], batch_size=batch_size)
+    opt.set_optim_method(compiled["optimizer"])
+    opt.set_end_when(end_trigger or Trigger.max_epoch(nb_epoch))
+    opt.log_every = log_every
+    if validation_data is not None:
+        if isinstance(validation_data, ArrayDataSet):
+            vds = validation_data
+        else:
+            vx, vy = validation_data
+            vds = ArrayDataSet(np.asarray(vx), np.asarray(vy))
+        methods = compiled["metrics"] or [Loss(compiled["loss"])]
+        opt.set_validation(Trigger.every_epoch(), vds, methods,
+                           batch_size=batch_size)
+    if checkpoint_path:
+        opt.set_checkpoint(checkpoint_path, Trigger.every_epoch())
+    return opt.optimize()
+
+
+def make_trained(model, variables, compiled) -> TrainedModel:
+    """Build a TrainedModel from externally-provided variables (loading)."""
+    engine = Engine.get()
+    optim_method = (compiled or {}).get("optimizer") or SGD()
+    step = ShardedParameterStep(
+        model, (compiled or {}).get("loss") or crit_mod.MSECriterion(),
+        optim_method, engine.mesh, variables)
+    return TrainedModel(model, variables, step)

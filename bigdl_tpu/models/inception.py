@@ -1,0 +1,50 @@
+"""Inception-v1 (GoogLeNet) — reference ``dllib/models/inception/
+Inception_v1.scala`` (unverified — mount empty).  Inception modules built with
+the ``Concat`` container exactly like the reference (four parallel towers
+concatenated on channels); NHWC."""
+
+from bigdl_tpu import nn
+
+
+def _tower(*layers):
+    return nn.Sequential(list(layers))
+
+
+def inception_module(cin, c1, c3r, c3, c5r, c5, pool_proj):
+    """4-tower module: 1x1 / 3x3(reduced) / 5x5(reduced) / pool-proj."""
+    return nn.Concat([
+        _tower(nn.Conv2D(cin, c1, 1), nn.ReLU()),
+        _tower(nn.Conv2D(cin, c3r, 1), nn.ReLU(),
+               nn.Conv2D(c3r, c3, 3, padding="SAME"), nn.ReLU()),
+        _tower(nn.Conv2D(cin, c5r, 1), nn.ReLU(),
+               nn.Conv2D(c5r, c5, 5, padding="SAME"), nn.ReLU()),
+        _tower(nn.MaxPool2D(3, 1, padding=1),
+               nn.Conv2D(cin, pool_proj, 1), nn.ReLU()),
+    ], dim=-1)
+
+
+def inception_v1(classes: int = 1000, dropout: float = 0.4) -> nn.Sequential:
+    """Main tower (the reference also has two aux classifiers used only for
+    training-loss shaping; provided via ``inception_v1_aux``)."""
+    return nn.Sequential([
+        nn.Conv2D(3, 64, 7, stride=2, padding="SAME"), nn.ReLU(),
+        nn.MaxPool2D(3, 2, padding=1),
+        nn.Conv2D(64, 64, 1), nn.ReLU(),
+        nn.Conv2D(64, 192, 3, padding="SAME"), nn.ReLU(),
+        nn.MaxPool2D(3, 2, padding=1),
+        inception_module(192, 64, 96, 128, 16, 32, 32),    # 3a -> 256
+        inception_module(256, 128, 128, 192, 32, 96, 64),  # 3b -> 480
+        nn.MaxPool2D(3, 2, padding=1),
+        inception_module(480, 192, 96, 208, 16, 48, 64),   # 4a -> 512
+        inception_module(512, 160, 112, 224, 24, 64, 64),  # 4b
+        inception_module(512, 128, 128, 256, 24, 64, 64),  # 4c
+        inception_module(512, 112, 144, 288, 32, 64, 64),  # 4d -> 528
+        inception_module(528, 256, 160, 320, 32, 128, 128),  # 4e -> 832
+        nn.MaxPool2D(3, 2, padding=1),
+        inception_module(832, 256, 160, 320, 32, 128, 128),  # 5a
+        inception_module(832, 384, 192, 384, 48, 128, 128),  # 5b -> 1024
+        nn.GlobalAvgPool2D(),
+        nn.Dropout(dropout),
+        nn.Linear(1024, classes),
+        nn.LogSoftMax(),
+    ])

@@ -1,0 +1,18 @@
+"""LeNet-5 — reference ``dllib/models/lenet/LeNet5.scala`` (unverified —
+mount empty): conv6@5x5 -> tanh -> pool -> conv12@5x5 -> tanh -> pool ->
+fc100 -> tanh -> fc(classes) -> logsoftmax.  NHWC here."""
+
+from bigdl_tpu import nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    return nn.Sequential([
+        nn.Conv2D(1, 6, 5, padding="SAME"), nn.Tanh(),
+        nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 12, 5), nn.Tanh(),
+        nn.MaxPool2D(2, 2),
+        nn.Flatten(),
+        nn.Linear(12 * 5 * 5, 100), nn.Tanh(),
+        nn.Linear(100, class_num),
+        nn.LogSoftMax(),
+    ])

@@ -1,0 +1,129 @@
+"""ResNet — reference ``dllib/models/resnet/ResNet.scala`` (unverified —
+mount empty): v1 basic blocks for CIFAR-10 (depth 6n+2) and bottleneck
+ResNet-50 for ImageNet, MSRA init, BN-gamma-zero on the last block BN
+(reference ``optnet``/zero-init-residual trick).
+
+NHWC, bf16-friendly; identity shortcuts use stride-slicing + channel pad,
+projection shortcuts a 1x1 conv (shortcutType B for ImageNet like the
+reference default)."""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import EMPTY, Module
+
+
+def _conv_bn(cin, cout, k, stride=1, pad="SAME", act=True, gamma_zero=False):
+    layers = [nn.Conv2D(cin, cout, k, stride=stride, padding=pad,
+                        with_bias=False, weight_init=init_mod.msra),
+              _BN(cout, gamma_zero)]
+    if act:
+        layers.append(nn.ReLU())
+    return layers
+
+
+class _BN(nn.BatchNorm):
+    def __init__(self, c, gamma_zero=False):
+        super().__init__(c)
+        self.gamma_zero = gamma_zero
+
+    def build(self, rng, x):
+        params, state = super().build(rng, x)
+        if self.gamma_zero:
+            params["weight"] = jnp.zeros_like(params["weight"])
+        return params, state
+
+
+class BasicBlock(Module):
+    """3x3+3x3 residual block (CIFAR / resnet-18/34)."""
+
+    def __init__(self, cin, cout, stride=1, name=None):
+        super().__init__(name)
+        self.body = nn.Sequential(
+            _conv_bn(cin, cout, 3, stride) +
+            _conv_bn(cout, cout, 3, act=False, gamma_zero=True))
+        self.proj = (nn.Sequential(_conv_bn(cin, cout, 1, stride, act=False))
+                     if stride != 1 or cin != cout else None)
+        self.relu = nn.ReLU()
+
+    def init(self, rng, x):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        v = {"body": self.body.init(k1, x)}
+        if self.proj is not None:
+            v["proj"] = self.proj.init(k2, x)
+        return {"params": {k: vv["params"] for k, vv in v.items()},
+                "state": {k: vv["state"] for k, vv in v.items()}}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        y, st_b = self.body.forward(params["body"], state.get("body", EMPTY),
+                                    x, training=training, rng=rng)
+        if self.proj is not None:
+            sc, st_p = self.proj.forward(params["proj"],
+                                         state.get("proj", EMPTY), x,
+                                         training=training, rng=rng)
+        else:
+            sc, st_p = x, EMPTY
+        out = jnp.maximum(y + sc, 0.0)
+        new_state = {}
+        if st_b:
+            new_state["body"] = st_b
+        if st_p:
+            new_state["proj"] = st_p
+        return out, new_state
+
+
+class Bottleneck(Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (ResNet-50/101/152)."""
+
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1, name=None):
+        super().__init__(name)
+        cout = width * self.expansion
+        self.body = nn.Sequential(
+            _conv_bn(cin, width, 1) +
+            _conv_bn(width, width, 3, stride) +
+            _conv_bn(width, cout, 1, act=False, gamma_zero=True))
+        self.proj = (nn.Sequential(_conv_bn(cin, cout, 1, stride, act=False))
+                     if stride != 1 or cin != cout else None)
+
+    init = BasicBlock.init
+    forward = BasicBlock.forward
+
+
+def resnet_cifar(depth: int = 20, classes: int = 10) -> nn.Sequential:
+    """CIFAR-10 ResNet (depth = 6n+2) — reference TrainCIFAR10 path."""
+    assert (depth - 2) % 6 == 0, "depth must be 6n+2"
+    n = (depth - 2) // 6
+    layers = _conv_bn(3, 16, 3)
+    cin = 16
+    for stage, width in enumerate([16, 32, 64]):
+        for b in range(n):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            layers.append(BasicBlock(cin, width, stride))
+            cin = width
+    layers += [nn.GlobalAvgPool2D(), nn.Linear(64, classes), nn.LogSoftMax()]
+    return nn.Sequential(layers)
+
+
+def resnet50(classes: int = 1000, include_top: bool = True) -> nn.Sequential:
+    """ImageNet ResNet-50 — reference TrainImageNet path.  Input NHWC
+    224x224x3."""
+    layers = _conv_bn(3, 64, 7, stride=2)
+    layers.append(nn.MaxPool2D(3, 2, padding=1))
+    cin = 64
+    for stage, (width, blocks) in enumerate([(64, 3), (128, 4), (256, 6),
+                                             (512, 3)]):
+        for b in range(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            layers.append(Bottleneck(cin, width, stride))
+            cin = width * Bottleneck.expansion
+    layers.append(nn.GlobalAvgPool2D())
+    if include_top:
+        layers += [nn.Linear(2048, classes), nn.LogSoftMax()]
+    return nn.Sequential(layers)

@@ -1,0 +1,66 @@
+"""Sequence model zoo: char-RNN, Seq2Seq, autoencoder.
+
+Reference analog (unverified — mount empty): ``dllib/models/rnn/`` (PTB
+char/word LM: LookupTable -> Recurrent(LSTM) -> TimeDistributed(Linear) ->
+LogSoftMax) and the Seq2Seq Recurrent+RecurrentDecoder path named in
+BASELINE.json config 3; ``dllib/models/autoencoder/``."""
+
+from typing import Optional
+
+from bigdl_tpu import nn
+
+
+def char_rnn(vocab_size: int, embed_dim: int = 64, hidden: int = 128,
+             layers: int = 1) -> nn.Sequential:
+    """Character/word LM — logits per timestep."""
+    mods = [nn.Embedding(vocab_size, embed_dim)]
+    d = embed_dim
+    for _ in range(layers):
+        mods.append(nn.LSTM(d, hidden))
+        d = hidden
+    mods += [nn.TimeDistributed(nn.Linear(hidden, vocab_size)),
+             nn.LogSoftMax()]
+    return nn.Sequential(mods)
+
+
+class Seq2Seq(nn.Module):
+    """Encoder LSTM -> autoregressive decoder — the reference's
+    Recurrent + RecurrentDecoder composition."""
+
+    def __init__(self, input_dim: int, hidden: int, output_len: int,
+                 output_dim: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.encoder = nn.LSTM(input_dim, hidden, return_sequences=False)
+        self.decoder = nn.RecurrentDecoder(
+            nn.LSTM(hidden, hidden), seq_length=output_len)
+        self.head = nn.TimeDistributed(nn.Linear(hidden, output_dim or
+                                                 input_dim))
+
+    def init(self, rng, x):
+        import jax
+
+        k1, k2, k3 = jax.random.split(rng, 3)
+        ve = self.encoder.init(k1, x)
+        h, _ = self.encoder.apply(ve, x)
+        vd = self.decoder.init(k2, h)
+        y, _ = self.decoder.apply(vd, h)
+        vh = self.head.init(k3, y)
+        return {"params": {"enc": ve["params"], "dec": vd["params"],
+                           "head": vh["params"]}, "state": {}}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        h, _ = self.encoder.forward(params["enc"], {}, x, training=training,
+                                    rng=rng)
+        y, _ = self.decoder.forward(params["dec"], {}, h, training=training,
+                                    rng=rng)
+        out, _ = self.head.forward(params["head"], {}, y, training=training,
+                                   rng=rng)
+        return out, {}
+
+
+def autoencoder(input_dim: int = 784, hidden: int = 32) -> nn.Sequential:
+    """Reference ``models/autoencoder`` (MNIST AE)."""
+    return nn.Sequential([
+        nn.Linear(input_dim, hidden), nn.ReLU(),
+        nn.Linear(hidden, input_dim), nn.Sigmoid(),
+    ])

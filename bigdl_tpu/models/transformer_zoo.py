@@ -1,0 +1,158 @@
+"""Transformer / BERT zoo models.
+
+Reference analog (unverified — mount empty): ``dllib/nn/Transformer.scala``
+(encoder-decoder WMT config in BASELINE.json) and keras-side ``BERT.scala``
+(Analytics-Zoo lineage).  TPU-native: pre-LN blocks, bf16 matmuls, and the
+mesh-aware sharded variants in ``bigdl_tpu.parallel`` for tp/sp.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.attention import positional_encoding
+from bigdl_tpu.nn.module import EMPTY, Module
+
+
+class TransformerEncoder(Module):
+    """Token LM / classifier trunk: embed + sinusoidal pos + N blocks."""
+
+    def __init__(self, vocab_size: int, hidden: int = 256, layers: int = 4,
+                 heads: int = 4, max_len: int = 512, dropout: float = 0.1,
+                 causal: bool = False, num_classes: Optional[int] = None,
+                 name=None):
+        super().__init__(name)
+        self.embed = nn.Embedding(vocab_size, hidden)
+        self.blocks = [nn.TransformerLayer(hidden, heads, dropout=dropout,
+                                           causal=causal)
+                       for _ in range(layers)]
+        self.ln = nn.LayerNorm(hidden)
+        self.max_len = max_len
+        self.hidden = hidden
+        self.head = (nn.Linear(hidden, num_classes)
+                     if num_classes is not None else None)
+
+    def init(self, rng, tokens):
+        ks = jax.random.split(rng, len(self.blocks) + 3)
+        ve = self.embed.init(ks[0], tokens)
+        x, _ = self.embed.apply(ve, tokens)
+        x = x + positional_encoding(x.shape[1], x.shape[2])
+        params = {"embed": ve["params"]}
+        for i, blk in enumerate(self.blocks):
+            vb = blk.init(ks[i + 1], x)
+            params[f"block_{i}"] = vb["params"]
+            x, _ = blk.apply(vb, x)
+        vl = self.ln.init(ks[-2], x)
+        params["ln"] = vl["params"]
+        if self.head is not None:
+            vh = self.head.init(ks[-1], x[:, 0])
+            params["head"] = vh["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, tokens, training=False, rng=None,
+                mask=None):
+        x, _ = self.embed.forward(params["embed"], EMPTY, tokens)
+        x = x + positional_encoding(x.shape[1], x.shape[2]).astype(x.dtype)
+        for i, blk in enumerate(self.blocks):
+            x, _ = blk.forward(
+                params[f"block_{i}"], EMPTY, x, training=training,
+                rng=None if rng is None else jax.random.fold_in(rng, i),
+                mask=mask)
+        x, _ = self.ln.forward(params["ln"], EMPTY, x)
+        if self.head is not None:
+            cls, _ = self.head.forward(params["head"], EMPTY, x[:, 0])
+            return cls, EMPTY
+        return x, EMPTY
+
+
+class BERT(Module):
+    """BERT-style encoder: token+position+segment embeddings, post-embedding
+    LN+dropout, N transformer blocks, tanh pooler on [CLS] — reference
+    keras ``BERT.scala`` surface (``initializer_range`` init etc. simplified
+    to xavier)."""
+
+    def __init__(self, vocab_size: int, hidden: int = 256, layers: int = 4,
+                 heads: int = 4, max_position: int = 512, type_vocab: int = 2,
+                 dropout: float = 0.1, name=None):
+        super().__init__(name)
+        self.tok = nn.Embedding(vocab_size, hidden)
+        self.pos = nn.Embedding(max_position, hidden)
+        self.seg = nn.Embedding(type_vocab, hidden)
+        self.ln = nn.LayerNorm(hidden)
+        self.dropout = nn.Dropout(dropout)
+        self.blocks = [nn.TransformerLayer(hidden, heads, dropout=dropout)
+                       for _ in range(layers)]
+        self.pooler = nn.Linear(hidden, hidden)
+        self.hidden = hidden
+
+    def init(self, rng, tokens, segments=None):
+        if segments is None:
+            segments = jnp.zeros_like(tokens)
+        ks = jax.random.split(rng, len(self.blocks) + 5)
+        vt = self.tok.init(ks[0], tokens)
+        vp = self.pos.init(ks[1], tokens)
+        vs = self.seg.init(ks[2], segments)
+        x = (self.tok.apply(vt, tokens)[0]
+             + self.pos.apply(vp, jnp.arange(tokens.shape[1])[None])[0]
+             + self.seg.apply(vs, segments)[0])
+        vl = self.ln.init(ks[3], x)
+        x, _ = self.ln.apply(vl, x)
+        params = {"tok": vt["params"], "pos": vp["params"],
+                  "seg": vs["params"], "ln": vl["params"]}
+        for i, blk in enumerate(self.blocks):
+            vb = blk.init(ks[i + 4], x)
+            params[f"block_{i}"] = vb["params"]
+            x, _ = blk.apply(vb, x)
+        vpool = self.pooler.init(ks[-1], x[:, 0])
+        params["pooler"] = vpool["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, tokens, segments=None, training=False,
+                rng=None, mask=None):
+        if segments is None:
+            segments = jnp.zeros_like(tokens)
+        pos_ids = jnp.arange(tokens.shape[1])[None]
+        x = (self.tok.forward(params["tok"], EMPTY, tokens)[0]
+             + self.pos.forward(params["pos"], EMPTY, pos_ids)[0]
+             + self.seg.forward(params["seg"], EMPTY, segments)[0])
+        x, _ = self.ln.forward(params["ln"], EMPTY, x)
+        if rng is not None:
+            x, _ = self.dropout.forward(EMPTY, EMPTY, x, training=training,
+                                        rng=rng)
+        att_mask = None
+        if mask is not None:  # (b, L) 1=real token
+            att_mask = mask[:, None, None, :].astype(bool)
+        for i, blk in enumerate(self.blocks):
+            x, _ = blk.forward(
+                params[f"block_{i}"], EMPTY, x, training=training,
+                rng=None if rng is None else jax.random.fold_in(rng, i),
+                mask=att_mask)
+        pooled, _ = self.pooler.forward(params["pooler"], EMPTY, x[:, 0])
+        return (x, jnp.tanh(pooled)), EMPTY
+
+
+class BERTClassifier(Module):
+    """BERT + classification head (the Orca BERT fine-tune config)."""
+
+    def __init__(self, bert: BERT, num_classes: int, name=None):
+        super().__init__(name)
+        self.bert = bert
+        self.head = nn.Linear(bert.hidden, num_classes)
+
+    def init(self, rng, tokens, segments=None):
+        k1, k2 = jax.random.split(rng)
+        vb = self.bert.init(k1, tokens, segments)
+        (seq, pooled), _ = self.bert.apply(vb, tokens, segments)
+        vh = self.head.init(k2, pooled)
+        return {"params": {"bert": vb["params"], "head": vh["params"]},
+                "state": EMPTY}
+
+    def forward(self, params, state, tokens, segments=None, training=False,
+                rng=None, mask=None):
+        (seq, pooled), _ = self.bert.forward(
+            params["bert"], EMPTY, tokens, segments, training=training,
+            rng=rng, mask=mask)
+        logits, _ = self.head.forward(params["head"], EMPTY, pooled)
+        return logits, EMPTY

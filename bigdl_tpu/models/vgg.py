@@ -1,0 +1,44 @@
+"""VGG — reference ``dllib/models/vgg/`` (unverified — mount empty).  VGG-16
+(ImageNet) and the CIFAR VggForCifar10 variant with BN."""
+
+from bigdl_tpu import nn
+
+_CFG16 = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+def vgg16(classes: int = 1000, dropout: float = 0.5) -> nn.Sequential:
+    layers = []
+    cin = 3
+    for v in _CFG16:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers += [nn.Conv2D(cin, v, 3, padding="SAME"), nn.ReLU()]
+            cin = v
+    layers += [
+        nn.Flatten(),
+        nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(dropout),
+        nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
+        nn.Linear(4096, classes), nn.LogSoftMax(),
+    ]
+    return nn.Sequential(layers)
+
+
+def vgg_cifar10(classes: int = 10) -> nn.Sequential:
+    """VggForCifar10 — conv towers with BN, two fc512 heads."""
+    layers = []
+    cin = 3
+    for v in _CFG16:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers += [nn.Conv2D(cin, v, 3, padding="SAME"),
+                       nn.BatchNorm(v), nn.ReLU()]
+            cin = v
+    layers += [
+        nn.Flatten(),
+        nn.Linear(512, 512), nn.BatchNorm(512), nn.ReLU(), nn.Dropout(0.5),
+        nn.Linear(512, classes), nn.LogSoftMax(),
+    ]
+    return nn.Sequential(layers)

@@ -12,6 +12,13 @@ from bigdl_tpu.nn.layers import (
     SoftSign, HardSigmoid, SoftMax, LogSoftMax, LeakyReLU, ELU, HardTanh,
     PReLU,
 )
+from bigdl_tpu.nn.rnn import (
+    SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,
+)
+from bigdl_tpu.nn.attention import (
+    MultiHeadAttention, PositionwiseFFN, TransformerLayer,
+    dot_product_attention, positional_encoding,
+)
 from bigdl_tpu.nn.criterion import (
     Criterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,
     AbsCriterion, SmoothL1Criterion, BCECriterion, BCEWithLogitsCriterion,

@@ -1,0 +1,199 @@
+"""Attention & Transformer blocks.
+
+Reference analog (unverified — mount empty): ``dllib/nn/Attention.scala``,
+``dllib/nn/Transformer.scala`` and the keras-side ``TransformerLayer.scala`` /
+``BERT.scala`` (Analytics-Zoo lineage): full O(L²) single-device attention.
+
+TPU-native: attention computed in one fused einsum chain (bf16 in, f32
+accumulate), optionally routed through the blockwise-Pallas kernel
+(``bigdl_tpu.ops.attention``) for long sequences, and sequence-parallel ring
+attention (``bigdl_tpu.parallel.ring_attention``) when the mesh's "seq" axis
+is >1 — both capabilities the reference lacks (SURVEY.md §6.7).
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.layers import Dropout, LayerNorm, Linear
+from bigdl_tpu.nn.module import EMPTY, Module
+from bigdl_tpu.tensor.policy import cast_compute
+
+
+def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
+                          training=False):
+    """q,k,v: (b, heads, len, dim).  mask: broadcastable to (b, h, lq, lk),
+    True = attend."""
+    d = q.shape[-1]
+    qc, kc = cast_compute(q, k)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qc, kc,
+                        preferred_element_type=jnp.float32)
+    logits = logits / math.sqrt(d)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and training:
+        keep = 1.0 - dropout_p
+        weights = weights * jax.random.bernoulli(rng, keep, weights.shape) / keep
+    wc, vc = cast_compute(weights, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", wc, vc,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+class MultiHeadAttention(Module):
+    """Reference ``nn/Attention.scala`` (multi-head, with q/k/v/out
+    projections)."""
+
+    def __init__(self, hidden_size: int, num_heads: int,
+                 attn_dropout: float = 0.0, causal: bool = False,
+                 weight_init=init_mod.xavier, name=None):
+        super().__init__(name)
+        assert hidden_size % num_heads == 0
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.attn_dropout = attn_dropout
+        self.causal = causal
+        self.weight_init = weight_init
+
+    def build(self, rng, x, context=None):
+        h = self.hidden_size
+        d = x.shape[-1]
+        dc = d if context is None else context.shape[-1]
+        ks = jax.random.split(rng, 4)
+        return {
+            "wq": self.weight_init(ks[0], (d, h), d, h),
+            "wk": self.weight_init(ks[1], (dc, h), dc, h),
+            "wv": self.weight_init(ks[2], (dc, h), dc, h),
+            "wo": self.weight_init(ks[3], (h, d), h, d),
+            "bq": jnp.zeros((h,)), "bk": jnp.zeros((h,)),
+            "bv": jnp.zeros((h,)), "bo": jnp.zeros((d,)),
+        }, EMPTY
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.num_heads, self.head_dim).transpose(
+            0, 2, 1, 3)
+
+    def forward(self, params, state, x, context=None, training=False,
+                rng=None, mask=None):
+        ctx = x if context is None else context
+        xc = cast_compute(x)
+        cc = cast_compute(ctx)
+        q = (jnp.matmul(xc, cast_compute(params["wq"]),
+                        preferred_element_type=jnp.float32)
+             + params["bq"]).astype(x.dtype)
+        k = (jnp.matmul(cc, cast_compute(params["wk"]),
+                        preferred_element_type=jnp.float32)
+             + params["bk"]).astype(x.dtype)
+        v = (jnp.matmul(cc, cast_compute(params["wv"]),
+                        preferred_element_type=jnp.float32)
+             + params["bv"]).astype(x.dtype)
+        q, k, v = self._split(q), self._split(k), self._split(v)
+
+        attn_mask = mask
+        if self.causal:
+            lq, lk = q.shape[2], k.shape[2]
+            cmask = jnp.tril(jnp.ones((lq, lk), bool))
+            attn_mask = cmask if attn_mask is None else (attn_mask & cmask)
+
+        out = dot_product_attention(
+            q, k, v, mask=attn_mask, dropout_p=self.attn_dropout, rng=rng,
+            training=training)
+        b, h, t, dh = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+        y = (jnp.matmul(cast_compute(out), cast_compute(params["wo"]),
+                        preferred_element_type=jnp.float32)
+             + params["bo"]).astype(x.dtype)
+        return y, EMPTY
+
+
+class PositionwiseFFN(Module):
+    """The transformer FFN (two Linears + activation)."""
+
+    def __init__(self, hidden_size: int, ffn_size: int, activation="gelu",
+                 dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.l1 = Linear(hidden_size, ffn_size)
+        self.l2 = Linear(ffn_size, hidden_size)
+        self.act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
+        self.dropout = Dropout(dropout)
+
+    def init(self, rng, x):
+        k1, k2 = jax.random.split(rng)
+        v1 = self.l1.init(k1, x)
+        h, _ = self.l1.apply(v1, x)
+        v2 = self.l2.init(k2, h)
+        return {"params": {"l1": v1["params"], "l2": v2["params"]},
+                "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        h, _ = self.l1.forward(params["l1"], EMPTY, x)
+        h = self.act(h)
+        if rng is not None:
+            h, _ = self.dropout.forward(EMPTY, EMPTY, h, training=training,
+                                        rng=rng)
+        y, _ = self.l2.forward(params["l2"], EMPTY, h)
+        return y, EMPTY
+
+
+class TransformerLayer(Module):
+    """Pre-LN transformer encoder block — reference keras
+    ``TransformerLayer.scala`` (BERT-style block; pre-LN chosen for training
+    stability, documented divergence)."""
+
+    def __init__(self, hidden_size: int, num_heads: int, ffn_size: int = 0,
+                 dropout: float = 0.1, causal: bool = False, name=None):
+        super().__init__(name)
+        self.attn = MultiHeadAttention(hidden_size, num_heads,
+                                       attn_dropout=dropout, causal=causal)
+        self.ffn = PositionwiseFFN(hidden_size, ffn_size or 4 * hidden_size,
+                                   dropout=dropout)
+        self.ln1 = LayerNorm(hidden_size)
+        self.ln2 = LayerNorm(hidden_size)
+        self.dropout = Dropout(dropout)
+
+    def init(self, rng, x):
+        ks = jax.random.split(rng, 4)
+        va = self.attn.init(ks[0], x)
+        vl1 = self.ln1.init(ks[1], x)
+        vl2 = self.ln2.init(ks[2], x)
+        vf = self.ffn.init(ks[3], x)
+        return {"params": {"attn": va["params"], "ln1": vl1["params"],
+                           "ln2": vl2["params"], "ffn": vf["params"]},
+                "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None, mask=None):
+        r1, r2, r3, r4 = (jax.random.split(rng, 4) if rng is not None
+                          else (None,) * 4)
+        h, _ = self.ln1.forward(params["ln1"], EMPTY, x)
+        a, _ = self.attn.forward(params["attn"], EMPTY, h, training=training,
+                                 rng=r1, mask=mask)
+        if r2 is not None:
+            a, _ = self.dropout.forward(EMPTY, EMPTY, a, training=training,
+                                        rng=r2)
+        x = x + a
+        h, _ = self.ln2.forward(params["ln2"], EMPTY, x)
+        f, _ = self.ffn.forward(params["ffn"], EMPTY, h, training=training,
+                                rng=r3)
+        if r4 is not None:
+            f, _ = self.dropout.forward(EMPTY, EMPTY, f, training=training,
+                                        rng=r4)
+        return x + f, EMPTY
+
+
+def positional_encoding(length: int, dim: int) -> jnp.ndarray:
+    """Sinusoidal positions — reference ``Transformer.scala`` encoding.
+    Handles odd dims (sin gets ceil(dim/2) columns, cos the rest)."""
+    n_sin = (dim + 1) // 2
+    pos = jnp.arange(length)[:, None].astype(jnp.float32)
+    i = jnp.arange(n_sin)[None, :].astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, 2 * i / dim)
+    pe = jnp.zeros((length, dim))
+    pe = pe.at[:, 0::2].set(jnp.sin(angle))
+    pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : dim // 2]))
+    return pe

@@ -64,6 +64,16 @@ class Module:
             *inputs, training=training, rng=rng)
 
     def __call__(self, variables, *inputs, training: bool = False, rng=None):
+        # symbolic overload: layer(node) builds a keras graph Node
+        from bigdl_tpu.keras.engine import Node
+
+        if isinstance(variables, Node) or (
+                isinstance(variables, (list, tuple)) and variables
+                and all(isinstance(v, Node) for v in variables)):
+            parents = ([variables] if isinstance(variables, Node)
+                       else list(variables))
+            parents += [i for i in inputs if isinstance(i, Node)]
+            return Node(self, parents)
         y, _ = self.apply(variables, *inputs, training=training, rng=rng)
         return y
 

@@ -22,11 +22,12 @@ from bigdl_tpu.data.dataset import DataSet
 from bigdl_tpu.optim import checkpoint as ckpt
 from bigdl_tpu.optim.metrics import Metrics, SummaryWriter, Timer
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
-from bigdl_tpu.optim.train_step import GradientClipping, ShardedParameterStep
+from bigdl_tpu.optim.train_step import (
+    GradientClipping, ShardedParameterStep, host_fetch, put_sharded,
+)
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.runtime.engine import Engine
-from bigdl_tpu.runtime.mesh import AXIS_DATA
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.optim")
@@ -43,8 +44,9 @@ class TrainedModel:
 
     def predict(self, x: np.ndarray, batch_size: int = 0) -> np.ndarray:
         run = self._engine.predict_fn()
-        n_proc = jax.process_count()
-        ndev = self._engine.ndev
+        # multi-host predict runs per-process (no mesh sharding), so padding
+        # to the data-axis multiple is only needed single-process
+        ndev = self._engine.ndev if jax.process_count() == 1 else 1
         n = x.shape[0]
         if batch_size <= 0:
             # single full batch, padded to device multiple
@@ -177,31 +179,35 @@ class Optimizer:
                 self.batch_size, shuffle=True, seed=self.seed, epoch=epoch,
                 process_id=jax.process_index(),
                 process_count=jax.process_count())
-            for mb in batch_iter:
-                try:
+            try:
+                for mb in batch_iter:
                     loss = self._one_iteration(step_engine, state, mb)
-                except Exception as e:  # driver retry loop (§6.3)
-                    retries += 1
-                    if retries > max_retries or not self._ckpt_path:
-                        raise
-                    log.warning(
-                        "iteration failed (%s); retry %d/%d from checkpoint",
-                        e, retries, max_retries)
-                    time.sleep(engine.config.failure_retry_interval_s)
-                    self._try_resume(step_engine, state)
-                    continue
-                state["loss"] = loss  # device array; float() only when read
-                if self._should_log(state):
-                    self._log_progress(state, t_loop)
-                self._fire_triggers(step_engine, state)
-                if self.end_when(state):
-                    break
-            else:
-                # epoch boundary: fire epoch triggers while `epoch` still
-                # names the epoch that just finished, then advance
-                state["epoch_finished"] = True
-                self._fire_triggers(step_engine, state)
-                state["epoch"] += 1
+                    state["loss"] = loss  # device array; float() when read
+                    if self._should_log(state):
+                        self._log_progress(state, t_loop)
+                    self._fire_triggers(step_engine, state)
+                    if self.end_when(state):
+                        break
+                else:
+                    # epoch boundary: fire epoch triggers while `epoch` still
+                    # names the epoch that just finished, then advance
+                    state["epoch_finished"] = True
+                    self._fire_triggers(step_engine, state)
+                    state["epoch"] += 1
+            except Exception as e:  # driver retry loop (§6.3)
+                # A failed train_step may have consumed donated buffers, so
+                # recovery REQUIRES a checkpoint to restore from; the epoch
+                # restarts cleanly from the resumed driver state.
+                retries += 1
+                can_resume = (self._ckpt_path and
+                              ckpt.latest_checkpoint(self._ckpt_path))
+                if retries > max_retries or not can_resume:
+                    raise
+                log.warning(
+                    "iteration failed (%s); retry %d/%d from checkpoint",
+                    e, retries, max_retries)
+                time.sleep(engine.config.failure_retry_interval_s)
+                self._try_resume(step_engine, state)
 
         variables = step_engine.get_variables()
         return TrainedModel(self.model, variables, step_engine)
@@ -224,6 +230,7 @@ class Optimizer:
         loss = float(state["loss"])
         state["loss"] = loss
         dt = self.metrics.mean("step_dispatch")
+        self.metrics.reset()  # rolling window: throughput reflects recent steps
         lr = float(np.asarray(self.optim_method.get_learning_rate(it - 1)))
         throughput = self.batch_size / max(dt, 1e-9)
         log.info(
@@ -248,9 +255,9 @@ class Optimizer:
             state["loss"] = float(state["loss"])
             ckpt.save_checkpoint(
                 self._ckpt_path, state["iteration"],
-                flat_params=step_engine.flat_params,
-                opt_state=jax.device_get(step_engine.opt_state),
-                model_state=jax.device_get(step_engine.model_state),
+                flat_params=np.asarray(step_engine.flat_params),
+                opt_state=host_fetch(step_engine.opt_state),
+                model_state=host_fetch(step_engine.model_state),
                 driver_state=state)
 
     def _run_validation(self, step_engine, state):
@@ -273,14 +280,14 @@ class Optimizer:
             return
         flat, opt_state, model_state, driver = ckpt.load_checkpoint(
             latest,
-            opt_state_template=jax.device_get(step_engine.opt_state),
-            model_state_template=jax.device_get(step_engine.model_state))
-        step_engine.flat_params = jax.device_put(
+            opt_state_template=step_engine.opt_template,
+            model_state_template=step_engine.model_state_template)
+        step_engine.flat_params = put_sharded(
             jax.numpy.asarray(flat), step_engine._rep)
         opt_sh = (step_engine._sharded_vec if step_engine.optim.elementwise
                   else step_engine._rep)
-        step_engine.opt_state = jax.device_put(opt_state, opt_sh)
-        step_engine.model_state = jax.device_put(model_state, step_engine._rep)
+        step_engine.opt_state = put_sharded(opt_state, opt_sh)
+        step_engine.model_state = put_sharded(model_state, step_engine._rep)
         state.update(driver)
         state["epoch_finished"] = False
         log.info("resumed from %s (iteration %d, epoch %d)", latest,

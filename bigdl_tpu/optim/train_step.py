@@ -64,6 +64,32 @@ def _clip_slice(g_slice, clip: Optional[GradientClipping], axis: str):
     return g_slice
 
 
+def host_fetch(tree):
+    """Fetch a (possibly multi-host sharded) pytree to host numpy on every
+    process.  Single-process: plain device_get.  Multi-process: allgather the
+    non-addressable shards first (checkpoint-time only; not on the hot path)."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    return jax.device_get(multihost_utils.process_allgather(tree, tiled=True))
+
+
+def put_sharded(tree, sharding):
+    """Inverse of host_fetch: place full host arrays with ``sharding`` in a
+    way that works under multi-controller (each process contributes only its
+    addressable shards)."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def put_one(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx])
+
+    return jax.tree_util.tree_map(put_one, tree)
+
+
 class ShardedParameterStep:
     """Builds the jitted ZeRO-1 train/eval steps for a model+criterion over a
     mesh.  Owns the flat-parameter layout (the ``AllReduceParameter`` role)."""
@@ -96,8 +122,14 @@ class ShardedParameterStep:
             opt_state = self.optim.init_state(jnp.zeros((self.n_pad,), flat.dtype))
             self.opt_state = jax.device_put(opt_state, self._sharded_vec)
         else:
-            self.opt_state = jax.device_put(
-                self.optim.init_state(init_variables["params"]), self._rep)
+            opt_state = self.optim.init_state(init_variables["params"])
+            self.opt_state = jax.device_put(opt_state, self._rep)
+        # host-side structure templates for checkpoint load (safe to use even
+        # when device buffers were consumed by a failed donated step)
+        _z = lambda t: jax.tree_util.tree_map(
+            lambda x: np.zeros(jnp.shape(x), jnp.asarray(x).dtype), t)
+        self.opt_template = _z(opt_state)
+        self.model_state_template = _z(init_variables.get("state", {}))
 
         self._train = self._build_train()
         self._eval_cache: Dict[Any, Callable] = {}
@@ -244,7 +276,18 @@ class ShardedParameterStep:
             out, _ = model.forward(params, mstate, x, training=False)
             return out
 
-        def run(x):
-            return fwd(self.flat_params, self.model_state, self.shard_batch(x))
+        if jax.process_count() > 1:
+            # multi-host: predict locally per process (params are replicated,
+            # so each host can run inference on its own shard of requests
+            # without building a non-addressable global output)
+            host_params = np.asarray(self.flat_params)
+            host_state = host_fetch(self.model_state)
+
+            def run(x):
+                return fwd(jnp.asarray(host_params), host_state, jnp.asarray(x))
+        else:
+            def run(x):
+                return fwd(self.flat_params, self.model_state,
+                           self.shard_batch(x))
 
         return run

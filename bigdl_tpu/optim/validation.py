@@ -48,12 +48,21 @@ def _w(weight, batch: int):
     return jnp.ones((batch,), jnp.float32) if weight is None else weight
 
 
+def _class_target(output, target):
+    """Accept integer labels OR one-hot/soft targets (argmax them), matching
+    CrossEntropyCriterion's target handling."""
+    if (target.ndim == output.ndim and target.shape == output.shape
+            and jnp.issubdtype(target.dtype, jnp.floating)):
+        return jnp.argmax(target, axis=-1)
+    return target.astype(jnp.int32)
+
+
 class Top1Accuracy(ValidationMethod):
     name = "Top1Accuracy"
 
     def batch_stats(self, output, target, weight=None):
         pred = jnp.argmax(output, axis=-1)
-        tgt = target.astype(jnp.int32).reshape(pred.shape)
+        tgt = _class_target(output, target).reshape(pred.shape)
         hits = (pred == tgt).astype(jnp.float32).reshape(pred.shape[0], -1)
         w = _w(weight, pred.shape[0])
         return jnp.sum(hits * w[:, None]), jnp.sum(w) * hits.shape[1]
@@ -63,8 +72,9 @@ class Top5Accuracy(ValidationMethod):
     name = "Top5Accuracy"
 
     def batch_stats(self, output, target, weight=None):
-        _, top5 = jax.lax.top_k(output, 5)
-        tgt = target.astype(jnp.int32).reshape(output.shape[:-1])[..., None]
+        _, top5 = jax.lax.top_k(output, min(5, output.shape[-1]))
+        tgt = _class_target(output, target).reshape(
+            output.shape[:-1])[..., None]
         hits = jnp.any(top5 == tgt, axis=-1).astype(jnp.float32).reshape(
             output.shape[0], -1)
         w = _w(weight, output.shape[0])
