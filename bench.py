@@ -1,0 +1,77 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Metric: ResNet-50 ImageNet-shape training throughput (images/sec/chip) on the
+available accelerator — the north-star metric family from BASELINE.json
+("ResNet-50 images/sec/chip"). ``vs_baseline`` is reported against the
+BASELINE.json published numbers when present; the reference published no
+numbers (``published: {}``), so the ratio is against a fixed nominal target
+recorded here.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Nominal single-chip target for ResNet-50 train throughput. The reference
+# publishes no numbers (BASELINE.json "published": {}); papers report CPU-
+# cluster figures not comparable per-chip. We pin a TPU-class target so the
+# ratio is stable across rounds: v5e-chip-class ResNet-50 training ~ 1000
+# img/s/chip order of magnitude.
+BASELINE_IMG_PER_SEC_PER_CHIP = 1000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.resnet import resnet50
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    n_chips = len(devices)
+    mesh = build_mesh(MeshSpec(data=n_chips), devices=devices)
+
+    if on_tpu:
+        batch_per_chip, hw, steps = 128, 224, 20
+    else:  # CPU smoke fallback so bench.py always emits a line
+        batch_per_chip, hw, steps = 4, 64, 3
+
+    batch = batch_per_chip * n_chips
+    model = resnet50(classes=1000)
+    rng = jax.random.PRNGKey(0)
+    x = np.random.RandomState(0).rand(batch, hw, hw, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 1000, (batch,)).astype(np.int32)
+    variables = model.init(rng, jnp.asarray(x[:1]))
+
+    step = ShardedParameterStep(
+        model, CrossEntropyCriterion(),
+        SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4), mesh, variables)
+
+    # warmup / compile
+    step.train_step(0, rng, x, y)
+    jax.block_until_ready(step.flat_params)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss = step.train_step(i + 1, rng, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec_chip = batch * steps / dt / n_chips
+    print(json.dumps({
+        "metric": "resnet50_train_throughput"
+                  + ("" if on_tpu else "_cpu_smoke"),
+        "value": round(img_per_sec_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_PER_SEC_PER_CHIP,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

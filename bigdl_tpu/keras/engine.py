@@ -24,6 +24,8 @@ _node_counter = [0]
 class Node:
     """Symbolic tensor in the layer graph."""
 
+    _graph_node = True  # duck-type sentinel checked by nn.Module.__call__
+
     def __init__(self, layer: Optional[Module], parents: Sequence["Node"],
                  shape: Optional[Tuple[int, ...]] = None):
         _node_counter[0] += 1

@@ -47,7 +47,6 @@ class BasicBlock(Module):
             _conv_bn(cout, cout, 3, act=False, gamma_zero=True))
         self.proj = (nn.Sequential(_conv_bn(cin, cout, 1, stride, act=False))
                      if stride != 1 or cin != cout else None)
-        self.relu = nn.ReLU()
 
     def init(self, rng, x):
         import jax

@@ -53,6 +53,10 @@ class TransformerEncoder(Module):
 
     def forward(self, params, state, tokens, training=False, rng=None,
                 mask=None):
+        if tokens.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_len "
+                f"{self.max_len}")
         x, _ = self.embed.forward(params["embed"], EMPTY, tokens)
         x = x + positional_encoding(x.shape[1], x.shape[2]).astype(x.dtype)
         for i, blk in enumerate(self.blocks):

@@ -36,6 +36,10 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     weights = jax.nn.softmax(logits, axis=-1)
     if dropout_p > 0.0 and training:
+        if rng is None:
+            raise ValueError(
+                "attention dropout needs an rng: pass rng= to forward/apply "
+                "when training with dropout_p > 0")
         keep = 1.0 - dropout_p
         weights = weights * jax.random.bernoulli(rng, keep, weights.shape) / keep
     wc, vc = cast_compute(weights, v)

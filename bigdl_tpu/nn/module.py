@@ -64,15 +64,18 @@ class Module:
             *inputs, training=training, rng=rng)
 
     def __call__(self, variables, *inputs, training: bool = False, rng=None):
-        # symbolic overload: layer(node) builds a keras graph Node
-        from bigdl_tpu.keras.engine import Node
-
-        if isinstance(variables, Node) or (
+        # symbolic overload: layer(node) builds a keras graph Node.  Duck-typed
+        # on the sentinel set by keras.engine.Node so core nn never imports
+        # the keras package.
+        _is_node = lambda v: getattr(v, "_graph_node", False)
+        if _is_node(variables) or (
                 isinstance(variables, (list, tuple)) and variables
-                and all(isinstance(v, Node) for v in variables)):
-            parents = ([variables] if isinstance(variables, Node)
+                and all(_is_node(v) for v in variables)):
+            from bigdl_tpu.keras.engine import Node
+
+            parents = ([variables] if _is_node(variables)
                        else list(variables))
-            parents += [i for i in inputs if isinstance(i, Node)]
+            parents += [i for i in inputs if _is_node(i)]
             return Node(self, parents)
         y, _ = self.apply(variables, *inputs, training=training, rng=rng)
         return y

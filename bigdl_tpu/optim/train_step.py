@@ -267,14 +267,20 @@ class ShardedParameterStep:
                 "state": jax.device_get(self.model_state)}
 
     def predict_fn(self):
-        """Jitted inference callable over the mesh (batch data-sharded)."""
-        model, unravel, n_real = self.model, self.unravel, self.n_real
+        """Jitted inference callable over the mesh (batch data-sharded).
+        The jitted forward is cached on the engine so repeated predict()
+        calls don't recompile."""
+        fwd = getattr(self, "_predict_jit", None)
+        if fwd is None:
+            model, unravel, n_real = self.model, self.unravel, self.n_real
 
-        @jax.jit
-        def fwd(flat_p, mstate, x):
-            params = unravel(flat_p[:n_real])
-            out, _ = model.forward(params, mstate, x, training=False)
-            return out
+            @jax.jit
+            def fwd(flat_p, mstate, x):
+                params = unravel(flat_p[:n_real])
+                out, _ = model.forward(params, mstate, x, training=False)
+                return out
+
+            self._predict_jit = fwd
 
         if jax.process_count() > 1:
             # multi-host: predict locally per process (params are replicated,

@@ -72,7 +72,11 @@ class Top5Accuracy(ValidationMethod):
     name = "Top5Accuracy"
 
     def batch_stats(self, output, target, weight=None):
-        _, top5 = jax.lax.top_k(output, min(5, output.shape[-1]))
+        if output.shape[-1] <= 5:
+            raise ValueError(
+                f"Top5Accuracy is degenerate with {output.shape[-1]} classes "
+                "(always 1.0); use Top1Accuracy")
+        _, top5 = jax.lax.top_k(output, 5)
         tgt = _class_target(output, target).reshape(
             output.shape[:-1])[..., None]
         hits = jnp.any(top5 == tgt, axis=-1).astype(jnp.float32).reshape(

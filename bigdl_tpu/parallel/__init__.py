@@ -1,0 +1,27 @@
+"""Parallelism beyond data-parallel — capabilities the reference lacks.
+
+The reference (ram1991/BigDL — SURVEY.md §3.5, mount empty/unverified) is
+synchronous data-parallel only (``DistriOptimizer`` + BlockManager allreduce).
+This package adds the TPU-native axes on the same ``Mesh``:
+
+- ``ring_attention``: sequence/context parallelism — blockwise attention with
+  K/V blocks rotating around the "seq" axis via ``ppermute`` (ICI ring),
+  flash-style online-softmax accumulation, exact (not approximate).
+- ``tp``: tensor parallelism — column/row-parallel Linear pairs with one
+  ``psum`` per pair over the "model" axis (Megatron layout, expressed as
+  shard_map-friendly functions + GSPMD sharding rules).
+- ``sharded_module``: GSPMD partitioning helpers — logical-axis param
+  annotations lowered to ``NamedSharding`` on the mesh.
+"""
+
+from bigdl_tpu.parallel.ring_attention import ring_attention
+from bigdl_tpu.parallel.tp import (
+    column_parallel, row_parallel, tp_linear_pair,
+)
+
+__all__ = [
+    "ring_attention",
+    "column_parallel",
+    "row_parallel",
+    "tp_linear_pair",
+]

@@ -1,0 +1,80 @@
+"""Tensor parallelism over the "model" axis (Megatron column/row layout).
+
+New capability vs the reference (SURVEY.md §3.5: TP absent).  Two expression
+modes, both TPU-native:
+
+1. **shard_map functions** (`column_parallel`/`row_parallel`/`tp_linear_pair`)
+   — explicit: weights pre-sharded on the model axis, ONE ``psum`` per
+   column+row pair (the MLP block / attention block pattern), no other
+   communication.
+2. **GSPMD annotations** (`logical_sharding`, `annotate`) — declarative:
+   annotate param pytrees with logical axes, let XLA insert the collectives.
+"""
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.runtime.mesh import AXIS_MODEL
+
+
+def column_parallel(x, w, b=None, axis_name: str = AXIS_MODEL):
+    """y_local = x @ w_shard (+ b_shard): output features sharded, NO
+    communication (inputs replicated on the model axis)."""
+    y = jnp.einsum("...d,df->...f", x, w,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel(x_local, w, b=None, axis_name: str = AXIS_MODEL):
+    """y = psum_model(x_shard @ w_shard) (+ full b): input features sharded,
+    one allreduce producing the replicated output."""
+    y = jnp.einsum("...d,df->...f", x_local, w,
+                   preferred_element_type=jnp.float32)
+    y = jax.lax.psum(y, axis_name)
+    y = y.astype(x_local.dtype)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_linear_pair(x, w1, b1, w2, b2, act=jax.nn.gelu,
+                   axis_name: str = AXIS_MODEL):
+    """The canonical 2-layer TP block (MLP): column-parallel up-projection,
+    activation, row-parallel down-projection — exactly one psum."""
+    h = column_parallel(x, w1, b1, axis_name)
+    h = act(h)
+    return row_parallel(h, w2, b2, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD logical-axis annotation helpers
+# ---------------------------------------------------------------------------
+
+def logical_sharding(mesh: Mesh, *logical: Optional[str]) -> NamedSharding:
+    """NamedSharding from logical axis names (None = replicated dim)."""
+    return NamedSharding(mesh, P(*logical))
+
+
+def annotate(tree: Any, rules: Dict[str, Tuple[Optional[str], ...]],
+             mesh: Mesh) -> Any:
+    """``with_sharding_constraint`` a param pytree by path-suffix rules,
+    e.g. {"wq": ("model", None), "w2": (None, "model")}.  Unmatched leaves
+    are left unconstrained (XLA decides)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    leaves, treedef = flat
+
+    def constrain(path, leaf):
+        key = jax.tree_util.keystr(path).strip("[]'\"").split("'")[-1]
+        for suffix, spec in rules.items():
+            if key.endswith(suffix):
+                return jax.lax.with_sharding_constraint(
+                    leaf, NamedSharding(mesh, P(*spec)))
+        return leaf
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [constrain(p, l) for p, l in leaves])
