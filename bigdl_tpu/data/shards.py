@@ -1,0 +1,139 @@
+"""XShards — partitioned data collections (the Orca ``SparkXShards`` analog).
+
+Reference analog (unverified — mount empty): ``python/orca/src/bigdl/orca/
+data/shard.py`` — an RDD of python objects (pandas DataFrames / numpy dicts)
+with ``transform_shard``, ``repartition``, ``collect``, plus
+``orca.data.pandas.read_csv/read_parquet`` loaders.
+
+TPU-native: a shard list owned by the local process.  In a multi-controller
+job each process constructs the SAME global shard index and reads only its
+own slice (``owned()``), giving the per-host input sharding that replaces
+RDD partitioning; no driver, no serialization of data through a JVM.
+"""
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+
+
+def _split_obj(obj, n: int) -> List[Any]:
+    """Split a numpy array / dict of arrays / tuple / pandas DataFrame into n
+    roughly equal shards along axis 0."""
+    if isinstance(obj, dict):
+        parts = {k: _split_obj(v, n) for k, v in obj.items()}
+        return [{k: parts[k][i] for k in obj} for i in range(n)]
+    if isinstance(obj, (tuple, list)):
+        parts = [_split_obj(v, n) for v in obj]
+        return [type(obj)(p[i] for p in parts) for i in range(n)]
+    if hasattr(obj, "iloc"):  # pandas
+        idx = np.array_split(np.arange(len(obj)), n)
+        return [obj.iloc[i] for i in idx]
+    arr = np.asarray(obj)
+    return np.array_split(arr, n)
+
+
+def _concat_objs(objs: Sequence[Any]):
+    first = objs[0]
+    if isinstance(first, dict):
+        return {k: _concat_objs([o[k] for o in objs]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            _concat_objs([o[i] for o in objs]) for i in range(len(first)))
+    if hasattr(first, "iloc"):
+        import pandas as pd
+
+        return pd.concat(list(objs), axis=0)
+    return np.concatenate([np.asarray(o) for o in objs], axis=0)
+
+
+class XShards:
+    """A globally-indexed list of data shards; each process owns a slice."""
+
+    def __init__(self, shards: List[Any]):
+        self._shards = list(shards)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def partition(data: Any, num_shards: Optional[int] = None) -> "XShards":
+        """Split in-memory data (numpy / dict / tuple / DataFrame) into
+        shards — reference ``XShards.partition``."""
+        if num_shards is None:
+            num_shards = max(jax.process_count(),
+                             jax.local_device_count())
+        return XShards(_split_obj(data, num_shards))
+
+    # -- RDD-like ops -------------------------------------------------------
+    def transform_shard(self, fn: Callable, *args) -> "XShards":
+        return XShards([fn(s, *args) for s in self._shards])
+
+    def num_partitions(self) -> int:
+        return len(self._shards)
+
+    def repartition(self, n: int) -> "XShards":
+        return XShards(_split_obj(_concat_objs(self._shards), n))
+
+    def collect(self) -> List[Any]:
+        return list(self._shards)
+
+    def concat(self):
+        """Materialize the full (process-local) dataset."""
+        return _concat_objs(self._shards)
+
+    def owned(self) -> List[Any]:
+        """Shards owned by this process (multi-controller input sharding)."""
+        p, n = jax.process_index(), jax.process_count()
+        return self._shards[p::n]
+
+    def owned_concat(self):
+        return _concat_objs(self.owned())
+
+    def __len__(self):
+        return len(self._shards)
+
+    def __iter__(self):
+        return iter(self._shards)
+
+
+# ---------------------------------------------------------------------------
+# loaders — reference orca.data.pandas.read_csv / read_parquet
+# ---------------------------------------------------------------------------
+
+def _expand(path: Union[str, Sequence[str]]) -> List[str]:
+    if isinstance(path, (list, tuple)):
+        out: List[str] = []
+        for p in path:
+            out.extend(_expand(p))
+        return out
+    if os.path.isdir(path):
+        return sorted(
+            p for p in _glob.glob(os.path.join(path, "*"))
+            if os.path.isfile(p))
+    matches = sorted(_glob.glob(path))
+    return matches or [path]
+
+
+def read_csv(path, num_shards: Optional[int] = None, **kwargs) -> XShards:
+    """One shard per file (repartitioned if num_shards given)."""
+    import pandas as pd
+
+    shards = [pd.read_csv(f, **kwargs) for f in _expand(path)]
+    xs = XShards(shards)
+    return xs.repartition(num_shards) if num_shards else xs
+
+
+def read_parquet(path, num_shards: Optional[int] = None, **kwargs) -> XShards:
+    import pandas as pd
+
+    shards = [pd.read_parquet(f, **kwargs) for f in _expand(path)]
+    xs = XShards(shards)
+    return xs.repartition(num_shards) if num_shards else xs
+
+
+def read_npy(path, num_shards: Optional[int] = None) -> XShards:
+    shards = [np.load(f) for f in _expand(path)]
+    xs = XShards(shards)
+    return xs.repartition(num_shards) if num_shards else xs

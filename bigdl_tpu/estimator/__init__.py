@@ -1,0 +1,13 @@
+"""Estimator — the Orca-equivalent scaling API (SURVEY.md §7 step 7).
+
+Reference analog (unverified — mount empty): ``python/orca/src/bigdl/orca/``
+— ``init_orca_context`` / ``Estimator.from_torch(model_creator, ...)`` with
+pluggable backends over Spark/Ray.  TPU-native: the single backend is
+``jax_tpu`` — one controller process per TPU-VM host, rendezvous via
+``jax.distributed.initialize`` (replacing Spark barrier stages + gloo/NCCL),
+training through the ZeRO-1 sharded train step over the mesh.
+"""
+
+from bigdl_tpu.estimator.estimator import Estimator, init_context, stop_context
+
+__all__ = ["Estimator", "init_context", "stop_context"]

@@ -1,0 +1,225 @@
+"""Orca-style Estimator on the jax_tpu backend.
+
+Reference call stack being replaced (SURVEY.md §4.3, unverified):
+``Estimator.from_torch(backend="spark").fit`` → Spark barrier stage → one DDP
+rank per executor → gloo ring allreduce.  Here: creators are plain callables
+evaluated in-process (multi-controller — every TPU-VM host runs this same
+program), data shards map to the host's slice of the global batch, and
+gradient sync is the XLA collective inside the jitted train step.
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+
+from bigdl_tpu.data.dataset import DataSet
+from bigdl_tpu.data.shards import XShards
+from bigdl_tpu.optim.optim_method import OptimMethod
+from bigdl_tpu.optim.optimizer import Optimizer, TrainedModel
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.runtime.engine import Engine, EngineConfig, init_engine
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.estimator")
+
+
+def init_context(cluster_mode: str = "local",
+                 coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 **mesh_axes) -> Engine:
+    """``init_orca_context`` analog.
+
+    - ``cluster_mode="local"``: single process, all local devices.
+    - ``cluster_mode="multihost"``: one process per TPU-VM host;
+      pass coordinator_address/num_processes/process_id (or set
+      BIGDL_TPU_COORDINATOR/... env vars) — the
+      ``jax.distributed.initialize`` rendezvous replaces Spark's
+      barrier-stage + gloo bootstrap (reference stack §4.3).
+    """
+    cfg = EngineConfig.from_env()
+    if cluster_mode == "multihost":
+        if coordinator_address is not None:
+            cfg.coordinator_address = coordinator_address
+            cfg.num_processes = num_processes
+            cfg.process_id = process_id
+        if cfg.coordinator_address is None:
+            raise ValueError(
+                "multihost mode needs coordinator_address (or "
+                "BIGDL_TPU_COORDINATOR env)")
+    elif cluster_mode != "local":
+        raise ValueError(f"unknown cluster_mode {cluster_mode!r}; "
+                         "use 'local' or 'multihost'")
+    return init_engine(cfg, **mesh_axes)
+
+
+def stop_context() -> None:
+    Engine.reset()
+
+
+def _to_xy(data, batch_size, shuffle=True):
+    """Normalize fit/evaluate inputs to (x, y) numpy arrays.
+
+    Accepts: (x, y) tuple, dict {"x":, "y":}, XShards of either, a DataSet,
+    or a creator fn (config -> any of the above)."""
+    if isinstance(data, DataSet):
+        return data
+    if callable(data) and not isinstance(data, (tuple, dict, XShards)):
+        data = data()
+    if isinstance(data, XShards):
+        data = data.owned_concat() if jax.process_count() > 1 else data.concat()
+    if isinstance(data, dict):
+        data = (data["x"], data["y"])
+    if isinstance(data, (tuple, list)):
+        x, y = data
+        return DataSet.array(np.asarray(x), np.asarray(y))
+    raise TypeError(f"unsupported data type {type(data)}")
+
+
+class Estimator:
+    """``Estimator.from_module(...)`` — fit/evaluate/predict driver."""
+
+    def __init__(self, model_creator: Callable[[Dict], Any],
+                 optimizer_creator: Callable[[Dict], OptimMethod],
+                 loss_creator: Callable[[Dict], Any],
+                 config: Optional[Dict] = None,
+                 backend: str = "jax_tpu"):
+        if backend != "jax_tpu":
+            raise ValueError(
+                f"backend {backend!r} not supported; the TPU rebuild has one "
+                "native backend: 'jax_tpu' (reference backends bigdl/ray/"
+                "horovod/spark all reduce to sync data-parallel — §3.5)")
+        self.config = dict(config or {})
+        self.model = model_creator(self.config)
+        self.optim_method = optimizer_creator(self.config)
+        self.criterion = loss_creator(self.config)
+        self._trained: Optional[TrainedModel] = None
+        self._loaded_variables: Optional[Dict[str, Any]] = None
+        self._last_stats: Dict[str, Any] = {}
+
+    # -- constructors (reference: from_torch / from_keras) ------------------
+    @staticmethod
+    def from_module(model_creator, optimizer_creator, loss_creator,
+                    config=None, backend="jax_tpu") -> "Estimator":
+        return Estimator(model_creator, optimizer_creator, loss_creator,
+                         config, backend)
+
+    @staticmethod
+    def from_keras(model_creator, config=None, backend="jax_tpu") -> "Estimator":
+        """model_creator returns a COMPILED keras-style model
+        (``model.compile(optimizer, loss, metrics)`` already called)."""
+        cfg = dict(config or {})
+        model = model_creator(cfg)
+        compiled = getattr(model, "_compiled", None)
+        if compiled is None:
+            raise ValueError("from_keras: creator must return a compiled model")
+        est = Estimator.__new__(Estimator)
+        est.config = cfg
+        est.model = model
+        est.optim_method = compiled["optimizer"]
+        est.criterion = compiled["loss"]
+        est._trained = None
+        est._loaded_variables = None
+        est._last_stats = {}
+        return est
+
+    # -- training -----------------------------------------------------------
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            validation_data=None,
+            validation_methods: Sequence[ValidationMethod] = (),
+            checkpoint_path: Optional[str] = None,
+            checkpoint_trigger: Optional[Trigger] = None) -> Dict[str, Any]:
+        ds = _to_xy(data, batch_size)
+        opt = Optimizer(self.model, ds, self.criterion,
+                        batch_size=batch_size)
+        opt.set_optim_method(self.optim_method)
+        opt.set_end_when(Trigger.max_epoch(epochs))
+        if validation_data is not None:
+            vds = _to_xy(validation_data, batch_size)
+            methods = list(validation_methods) or None
+            if methods is None:
+                from bigdl_tpu.optim.validation import Loss
+
+                methods = [Loss(self.criterion)]
+            opt.set_validation(Trigger.every_epoch(), vds, methods)
+        if checkpoint_path is not None:
+            opt.set_checkpoint(checkpoint_path,
+                               checkpoint_trigger or Trigger.every_epoch())
+        t0 = time.time()
+        self._trained = opt.optimize()
+        self._last_stats = {
+            "train_time_s": time.time() - t0,
+            "epochs": epochs,
+            "num_samples": ds.size(),
+        }
+        return self._last_stats
+
+    # -- inference ----------------------------------------------------------
+    def _predict_array(self, x: np.ndarray, batch_size: int):
+        if self._trained is not None:
+            return self._trained.predict(x, batch_size)
+        # loaded-weights path: plain jitted forward, no train-step engine
+        if self._loaded_variables is None:
+            raise RuntimeError("call fit() or load() first")
+        fwd = self.__dict__.get("_loaded_fwd")
+        if fwd is None:
+            model = self.model
+
+            @jax.jit
+            def fwd(params, state, xb):
+                out, _ = model.forward(params, state, xb, training=False)
+                return out
+
+            self._loaded_fwd = fwd
+        v = self._loaded_variables
+        outs = []
+        step = batch_size if batch_size > 0 else len(x)
+        for i in range(0, len(x), step):
+            outs.append(np.asarray(fwd(v.get("params", {}),
+                                       v.get("state", {}),
+                                       np.asarray(x[i:i + step]))))
+        return np.concatenate(outs, 0)
+
+    def predict(self, data, batch_size: int = 0):
+        if isinstance(data, XShards):
+            return data.transform_shard(
+                lambda s: self._predict_array(
+                    np.asarray(s if not isinstance(s, dict) else s["x"]),
+                    batch_size))
+        return self._predict_array(np.asarray(data), batch_size)
+
+    def evaluate(self, data, methods: Sequence[ValidationMethod],
+                 batch_size: int = 32) -> Dict[str, float]:
+        self._require_fit()
+        ds = _to_xy(data, batch_size, shuffle=False)
+        res = self._trained.evaluate(ds, list(methods), batch_size)
+        return {r.name: r.result for r in res}
+
+    # -- model access (reference: get_model / save / load) ------------------
+    def get_model(self):
+        if self._trained is not None:
+            return self._trained.variables
+        if self._loaded_variables is not None:
+            return self._loaded_variables
+        raise RuntimeError("call fit() or load() first")
+
+    def save(self, path: str) -> None:
+        from bigdl_tpu.utils.serializer import save_model
+
+        save_model(path, self.model, self.get_model())
+
+    def load(self, path: str) -> None:
+        """Load weights saved by ``save`` — enables predict/evaluate without
+        a prior fit (reference: ``Estimator.load`` / ``Module.loadModule``)."""
+        from bigdl_tpu.utils.serializer import load_model
+
+        self._loaded_variables = load_model(path)
+        self._trained = None
+
+    def _require_fit(self):
+        if self._trained is None:
+            raise RuntimeError("call fit() first")

@@ -1,0 +1,25 @@
+"""Time-series forecasting — the Chronos-equivalent (SURVEY.md §7 step 8).
+
+Reference analog (unverified — mount empty): ``python/chronos/src/bigdl/
+chronos/`` — ``TSDataset`` preprocessing, forecasters (TCN / LSTM / Seq2Seq /
+NBeats / Autoformer) each a torch module + fit/predict/evaluate harness, and
+anomaly detectors.  TPU-native: models are ``bigdl_tpu.nn`` modules trained
+through the jitted ZeRO-1 train step; ``distributed=True`` routes through the
+Orca-equivalent Estimator exactly like the reference routes through Orca.
+"""
+
+from bigdl_tpu.forecast.tsdataset import TSDataset
+from bigdl_tpu.forecast.forecaster import (
+    LSTMForecaster, NBeatsForecaster, Seq2SeqForecaster, TCNForecaster,
+    AutoformerForecaster,
+)
+from bigdl_tpu.forecast.detector import (
+    AEDetector, DBScanDetector, ThresholdDetector,
+)
+
+__all__ = [
+    "TSDataset",
+    "TCNForecaster", "LSTMForecaster", "Seq2SeqForecaster",
+    "NBeatsForecaster", "AutoformerForecaster",
+    "ThresholdDetector", "AEDetector", "DBScanDetector",
+]

@@ -1,0 +1,195 @@
+"""Forecasters — fit/predict/evaluate harness over the forecast models.
+
+Reference analog (unverified — mount empty): ``chronos/forecaster/
+base_forecaster.py`` (``BasePytorchForecaster``): torch module + Nano trainer
+single-node, or Orca Estimator when ``distributed=True``.  TPU-native: the
+model is a ``bigdl_tpu.nn`` Module; both paths go through the same jitted
+ZeRO-1 train step — "distributed" here only widens the mesh, it never changes
+frameworks (the reference must switch between Lightning and Orca).
+"""
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.data.dataset import DataSet
+from bigdl_tpu.forecast.autoformer import Autoformer
+from bigdl_tpu.forecast.models import (
+    LSTMForecastNet, NBeats, Seq2SeqNet, TCN,
+)
+from bigdl_tpu.forecast.tsdataset import TSDataset
+from bigdl_tpu.nn.criterion import MSECriterion
+from bigdl_tpu.optim.optim_method import Adam
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import MAE, MSE
+
+
+def _as_xy(data, lookback, horizon) -> Tuple[np.ndarray, np.ndarray]:
+    if isinstance(data, TSDataset):
+        return data.to_numpy()
+    if isinstance(data, (tuple, list)):
+        return np.asarray(data[0], np.float32), np.asarray(data[1], np.float32)
+    raise TypeError(f"unsupported data {type(data)}")
+
+
+class BaseForecaster:
+    """fit/predict/evaluate lifecycle shared by every forecaster."""
+
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 optimizer: Optional[object] = None, lr: float = 1e-3,
+                 loss=None, seed: int = 0):
+        self.lookback = past_seq_len
+        self.horizon = future_seq_len
+        self.in_dim = input_feature_num
+        self.out_dim = output_feature_num
+        self.optim = optimizer or Adam(learning_rate=lr)
+        self.criterion = loss or MSECriterion()
+        self.seed = seed
+        self.model = self._build_model()
+        self._trained = None
+
+    def _build_model(self):
+        raise NotImplementedError
+
+    # -- lifecycle ----------------------------------------------------------
+    def fit(self, data, epochs: int = 10, batch_size: int = 32,
+            validation_data=None) -> "BaseForecaster":
+        x, y = _as_xy(data, self.lookback, self.horizon)
+        ds = DataSet.array(x, y)
+        opt = Optimizer(self.model, ds, self.criterion, batch_size=batch_size)
+        opt.set_optim_method(self.optim)
+        opt.set_end_when(Trigger.max_epoch(epochs))
+        if validation_data is not None:
+            vx, vy = _as_xy(validation_data, self.lookback, self.horizon)
+            opt.set_validation(Trigger.every_epoch(),
+                               DataSet.array(vx, vy), [MSE()])
+        self._trained = opt.optimize()
+        return self
+
+    def predict(self, data, batch_size: int = 0) -> np.ndarray:
+        self._check_fit()
+        if isinstance(data, TSDataset):
+            x, _ = data.to_numpy()
+        elif isinstance(data, (tuple, list)):
+            x = np.asarray(data[0], np.float32)
+        else:
+            x = np.asarray(data, np.float32)
+        return np.asarray(self._trained.predict(x, batch_size))
+
+    def evaluate(self, data, metrics: Sequence[str] = ("mse",),
+                 batch_size: int = 32) -> Dict[str, float]:
+        self._check_fit()
+        x, y = _as_xy(data, self.lookback, self.horizon)
+        table = {"mse": MSE, "mae": MAE}
+        methods = [table[m.lower()]() for m in metrics]
+        res = self._trained.evaluate(DataSet.array(x, y), methods, batch_size)
+        return {m: r.result for m, r in zip(metrics, res)}
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path: str) -> None:
+        self._check_fit()
+        from bigdl_tpu.utils.serializer import save_model
+
+        save_model(path, self.model, self._trained.variables)
+
+    def load(self, path: str) -> None:
+        """Restore weights into this forecaster (requires same hyperparams).
+        Builds the prediction engine by re-initializing then overwriting."""
+        import jax
+
+        from bigdl_tpu.utils.serializer import load_model
+
+        x0 = np.zeros((1, self.lookback, self.in_dim), np.float32)
+        template = self.model.init(jax.random.PRNGKey(self.seed), x0)
+        variables = load_model(path, self.model, template=template)
+        ds = DataSet.array(x0, np.zeros((1, self.horizon, self.out_dim),
+                                        np.float32))
+        opt = Optimizer(self.model, ds, self.criterion, batch_size=1)
+        opt.set_optim_method(self.optim)
+        opt.set_end_when(Trigger.max_iteration(0))
+        self._trained = opt.optimize()
+        self._trained.set_variables(variables)
+
+    def _check_fit(self):
+        if self._trained is None:
+            raise RuntimeError("call fit() (or load()) first")
+
+
+class TCNForecaster(BaseForecaster):
+    """Reference ``chronos/forecaster/tcn_forecaster.py``."""
+
+    def __init__(self, past_seq_len, future_seq_len, input_feature_num,
+                 output_feature_num, num_channels=(32, 32), kernel_size=3,
+                 dropout=0.1, **kw):
+        self.num_channels = tuple(num_channels)
+        self.kernel_size = kernel_size
+        self.dropout = dropout
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kw)
+
+    def _build_model(self):
+        return TCN(self.in_dim, self.out_dim, self.horizon,
+                   channels=self.num_channels, kernel_size=self.kernel_size,
+                   dropout=self.dropout)
+
+
+class LSTMForecaster(BaseForecaster):
+    def __init__(self, past_seq_len, future_seq_len, input_feature_num,
+                 output_feature_num, hidden_dim=64, layer_num=2,
+                 dropout=0.1, **kw):
+        self.hidden_dim, self.layer_num = hidden_dim, layer_num
+        self.dropout = dropout
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kw)
+
+    def _build_model(self):
+        return LSTMForecastNet(self.in_dim, self.out_dim, self.horizon,
+                               hidden=self.hidden_dim, layers=self.layer_num,
+                               dropout=self.dropout)
+
+
+class Seq2SeqForecaster(BaseForecaster):
+    def __init__(self, past_seq_len, future_seq_len, input_feature_num,
+                 output_feature_num, lstm_hidden_dim=64, **kw):
+        self.hidden_dim = lstm_hidden_dim
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kw)
+
+    def _build_model(self):
+        return Seq2SeqNet(self.in_dim, self.out_dim, self.horizon,
+                          hidden=self.hidden_dim)
+
+
+class NBeatsForecaster(BaseForecaster):
+    def __init__(self, past_seq_len, future_seq_len, input_feature_num,
+                 output_feature_num, stacks=2, blocks_per_stack=3,
+                 hidden_units=128, **kw):
+        self.stacks, self.bps = stacks, blocks_per_stack
+        self.units = hidden_units
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kw)
+
+    def _build_model(self):
+        return NBeats(self.in_dim, self.out_dim, self.lookback, self.horizon,
+                      stacks=self.stacks, blocks_per_stack=self.bps,
+                      units=self.units)
+
+
+class AutoformerForecaster(BaseForecaster):
+    def __init__(self, past_seq_len, future_seq_len, input_feature_num,
+                 output_feature_num, d_model=64, n_heads=4, e_layers=2,
+                 d_layers=1, d_ff=128, moving_avg=25, **kw):
+        self.d_model, self.n_heads = d_model, n_heads
+        self.e_layers, self.d_layers = e_layers, d_layers
+        self.d_ff, self.moving_avg = d_ff, moving_avg
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, **kw)
+
+    def _build_model(self):
+        return Autoformer(self.in_dim, self.out_dim, self.lookback,
+                          self.horizon, hidden=self.d_model,
+                          heads=self.n_heads, enc_layers=self.e_layers,
+                          dec_layers=self.d_layers, ff=self.d_ff,
+                          kernel=self.moving_avg)

@@ -1,0 +1,272 @@
+"""Forecasting model trunks — TCN / Seq2Seq / NBeats.
+
+Reference analogs (unverified — mount empty): ``chronos/model/tcn.py``
+(dilated causal conv residual blocks, weight-norm + chomp in torch),
+``chronos/model/Seq2Seq.py`` (LSTM encoder-decoder), ``chronos/model/
+nbeats.py`` (doubly-residual basis-expansion stacks).  TPU-native: causal
+padding instead of chomp, one ``lax.scan`` per RNN, everything a pure
+``bigdl_tpu.nn`` Module trained by ``jax.grad``.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import EMPTY, Module
+
+
+class TCNBlock(Module):
+    """Two dilated causal convs + residual (reference TemporalBlock)."""
+
+    def __init__(self, cin, cout, kernel_size, dilation, dropout=0.1,
+                 name=None):
+        super().__init__(name)
+        self.conv1 = nn.Conv1D(cin, cout, kernel_size, causal=True,
+                               dilation=dilation)
+        self.conv2 = nn.Conv1D(cout, cout, kernel_size, causal=True,
+                               dilation=dilation)
+        self.down = nn.Conv1D(cin, cout, 1) if cin != cout else None
+        self.dropout = dropout
+
+    def init(self, rng, x):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        v1 = self.conv1.init(k1, x)
+        h, _ = self.conv1.apply(v1, x)
+        v2 = self.conv2.init(k2, h)
+        params = {"conv1": v1["params"], "conv2": v2["params"]}
+        if self.down is not None:
+            params["down"] = self.down.init(k3, x)["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        def drop(h, key_i):
+            if not training or self.dropout <= 0.0 or rng is None:
+                return h
+            keep = 1.0 - self.dropout
+            k = jax.random.fold_in(rng, key_i)
+            return h * jax.random.bernoulli(k, keep, h.shape) / keep
+
+        h, _ = self.conv1.forward(params["conv1"], EMPTY, x)
+        h = drop(jax.nn.relu(h), 1)
+        h, _ = self.conv2.forward(params["conv2"], EMPTY, h)
+        h = drop(jax.nn.relu(h), 2)
+        res = x
+        if self.down is not None:
+            res, _ = self.down.forward(params["down"], EMPTY, x)
+        return jax.nn.relu(h + res), EMPTY
+
+
+class TCN(Module):
+    """Stacked TCN + linear head mapping lookback -> horizon.
+
+    Input (b, lookback, in_dim) -> output (b, horizon, out_dim)."""
+
+    def __init__(self, in_dim: int, out_dim: int, horizon: int,
+                 channels: Sequence[int] = (32, 32), kernel_size: int = 3,
+                 dropout: float = 0.1, name=None):
+        super().__init__(name)
+        self.blocks = []
+        cin = in_dim
+        for i, c in enumerate(channels):
+            self.blocks.append(TCNBlock(cin, c, kernel_size, 2 ** i, dropout))
+            cin = c
+        self.horizon = horizon
+        self.out_dim = out_dim
+        self.head = nn.Linear(cin, horizon * out_dim)
+
+    def init(self, rng, x):
+        ks = jax.random.split(rng, len(self.blocks) + 1)
+        params = {}
+        h = x
+        for i, blk in enumerate(self.blocks):
+            v = blk.init(ks[i], h)
+            params[f"block_{i}"] = v["params"]
+            h, _ = blk.apply(v, h)
+        vh = self.head.init(ks[-1], h[:, -1])
+        params["head"] = vh["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        h = x
+        for i, blk in enumerate(self.blocks):
+            h, _ = blk.forward(
+                params[f"block_{i}"], EMPTY, h, training=training,
+                rng=None if rng is None else jax.random.fold_in(rng, i))
+        y, _ = self.head.forward(params["head"], EMPTY, h[:, -1])
+        return y.reshape(x.shape[0], self.horizon, self.out_dim), EMPTY
+
+
+class LSTMForecastNet(Module):
+    """Stacked LSTM on the lookback window, dense head off the last hidden
+    state (reference ``chronos/model/VanillaLSTM``)."""
+
+    def __init__(self, in_dim: int, out_dim: int, horizon: int,
+                 hidden: int = 64, layers: int = 2, dropout: float = 0.1,
+                 name=None):
+        super().__init__(name)
+        self.cells = [nn.LSTM(in_dim if i == 0 else hidden, hidden,
+                              return_sequences=True)
+                      for i in range(layers)]
+        self.horizon, self.out_dim = horizon, out_dim
+        self.dropout = dropout
+        self.head = nn.Linear(hidden, horizon * out_dim)
+
+    def init(self, rng, x):
+        ks = jax.random.split(rng, len(self.cells) + 1)
+        params = {}
+        h = x
+        for i, c in enumerate(self.cells):
+            v = c.init(ks[i], h)
+            params[f"lstm_{i}"] = v["params"]
+            h, _ = c.apply(v, h)
+        vh = self.head.init(ks[-1], h[:, -1])
+        params["head"] = vh["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        h = x
+        for i, c in enumerate(self.cells):
+            h, _ = c.forward(params[f"lstm_{i}"], EMPTY, h, training=training)
+            if training and self.dropout > 0 and rng is not None \
+                    and i < len(self.cells) - 1:
+                keep = 1.0 - self.dropout
+                k = jax.random.fold_in(rng, i)
+                h = h * jax.random.bernoulli(k, keep, h.shape) / keep
+        y, _ = self.head.forward(params["head"], EMPTY, h[:, -1])
+        return y.reshape(x.shape[0], self.horizon, self.out_dim), EMPTY
+
+
+class Seq2SeqNet(Module):
+    """LSTM encoder -> autoregressive LSTM decoder (reference
+    ``chronos/model/Seq2Seq.py``): decoder consumes its previous prediction,
+    initialized from the encoder final state."""
+
+    def __init__(self, in_dim: int, out_dim: int, horizon: int,
+                 hidden: int = 64, name=None):
+        super().__init__(name)
+        self.encoder = nn.LSTM(in_dim, hidden, return_sequences=False)
+        self.dec_cell = nn.LSTM(out_dim, hidden, return_sequences=True)
+        self.head = nn.Linear(hidden, out_dim)
+        self.horizon, self.out_dim, self.hidden = horizon, out_dim, hidden
+
+    def init(self, rng, x):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        ve = self.encoder.init(k1, x)
+        y0 = jnp.zeros((x.shape[0], 1, self.out_dim), x.dtype)
+        vd = self.dec_cell.init(k2, y0)
+        h0 = jnp.zeros((x.shape[0], self.hidden), x.dtype)
+        vh = self.head.init(k3, h0)
+        return {"params": {"enc": ve["params"], "dec": vd["params"],
+                           "head": vh["params"]},
+                "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        from bigdl_tpu.tensor.policy import cast_compute
+
+        b = x.shape[0]
+        # encoder: full sequence, keep final (h, c)
+        enc = self.encoder
+        _, _ = 0, 0  # readability anchor
+        # run encoder manually to get final carry
+        xc, wi = cast_compute(x, params["enc"]["w_in"])
+        x_proj = (jnp.einsum("bti,ig->btg", xc, wi,
+                             preferred_element_type=jnp.float32)
+                  + params["enc"]["bias"]).astype(x.dtype)
+        carry = enc._init_carry(b, x.dtype)
+
+        def enc_step(c, xp):
+            new_c, _h = enc._step(params["enc"], c, xp)
+            return new_c, None
+
+        carry, _ = jax.lax.scan(enc_step, carry,
+                                jnp.swapaxes(x_proj, 0, 1))
+
+        # decoder: autoregressive scan for `horizon` steps
+        dec, head = self.dec_cell, self.head
+        y0 = jnp.zeros((b, self.out_dim), x.dtype)
+
+        def dec_step(loop, _):
+            dc, y_prev = loop
+            wi_d = cast_compute(params["dec"]["w_in"])
+            xp = (jnp.matmul(cast_compute(y_prev), wi_d,
+                             preferred_element_type=jnp.float32)
+                  + params["dec"]["bias"]).astype(x.dtype)
+            dc, h = dec._step(params["dec"], dc, xp)
+            y, _ = head.forward(params["head"], EMPTY, h)
+            return (dc, y.astype(x.dtype)), y
+
+        (_, _), ys = jax.lax.scan(dec_step, (carry, y0), None,
+                                  length=self.horizon)
+        return jnp.swapaxes(ys, 0, 1), EMPTY  # (b, horizon, out_dim)
+
+
+class NBeatsBlock(Module):
+    def __init__(self, lookback_flat: int, horizon_flat: int, units: int,
+                 layers: int = 4, name=None):
+        super().__init__(name)
+        dims = [lookback_flat] + [units] * layers
+        self.fcs = [nn.Linear(dims[i], dims[i + 1]) for i in range(layers)]
+        self.backcast = nn.Linear(units, lookback_flat)
+        self.forecast = nn.Linear(units, horizon_flat)
+
+    def init(self, rng, x):
+        ks = jax.random.split(rng, len(self.fcs) + 2)
+        params = {}
+        h = x
+        for i, fc in enumerate(self.fcs):
+            v = fc.init(ks[i], h)
+            params[f"fc_{i}"] = v["params"]
+            h, _ = fc.apply(v, h)
+        params["backcast"] = self.backcast.init(ks[-2], h)["params"]
+        params["forecast"] = self.forecast.init(ks[-1], h)["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        h = x
+        for i, fc in enumerate(self.fcs):
+            h, _ = fc.forward(params[f"fc_{i}"], EMPTY, h)
+            h = jax.nn.relu(h)
+        bc, _ = self.backcast.forward(params["backcast"], EMPTY, h)
+        fo, _ = self.forecast.forward(params["forecast"], EMPTY, h)
+        return (bc, fo), EMPTY
+
+
+class NBeats(Module):
+    """Doubly-residual generic N-Beats (reference
+    ``chronos/model/nbeats.py``): each block subtracts its backcast from the
+    residual input and adds its forecast to the running total."""
+
+    def __init__(self, in_dim: int, out_dim: int, lookback: int, horizon: int,
+                 stacks: int = 2, blocks_per_stack: int = 3, units: int = 128,
+                 name=None):
+        super().__init__(name)
+        if in_dim != out_dim:
+            raise ValueError("NBeats is univariate-per-channel: needs "
+                             "in_dim == out_dim (target-only input)")
+        self.lookback, self.horizon = lookback, horizon
+        self.out_dim = out_dim
+        n = stacks * blocks_per_stack
+        self.blocks = [NBeatsBlock(lookback * in_dim, horizon * out_dim,
+                                   units) for _ in range(n)]
+
+    def init(self, rng, x):
+        b = x.shape[0]
+        flat = x.reshape(b, -1)
+        ks = jax.random.split(rng, len(self.blocks))
+        params = {}
+        for i, blk in enumerate(self.blocks):
+            params[f"block_{i}"] = blk.init(ks[i], flat)["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        b = x.shape[0]
+        residual = x.reshape(b, -1)
+        total = jnp.zeros((b, self.horizon * self.out_dim), x.dtype)
+        for i, blk in enumerate(self.blocks):
+            (bc, fo), _ = blk.forward(params[f"block_{i}"], EMPTY, residual)
+            residual = residual - bc
+            total = total + fo
+        return total.reshape(b, self.horizon, self.out_dim), EMPTY

@@ -69,6 +69,24 @@ class TrainedModel:
             process_id=jax.process_index(), process_count=jax.process_count())
         return self._engine.evaluate(list(methods), batches)
 
+    def set_variables(self, variables: Dict[str, Any]) -> None:
+        """Overwrite the engine's weights/state with a loaded variables
+        pytree (``Module.loadModule`` analog)."""
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        eng = self._engine
+        flat, _ = ravel_pytree(variables["params"])
+        if flat.shape[0] != eng.n_real:
+            raise ValueError(
+                f"loaded params have {flat.shape[0]} elements, model has "
+                f"{eng.n_real}")
+        eng.flat_params = jax.device_put(
+            jnp.pad(flat, (0, eng.n_pad - eng.n_real)), eng._rep)
+        eng.model_state = jax.device_put(
+            variables.get("state", {}), eng._rep)
+        self.variables = variables
+
 
 class Optimizer:
     """Builder + driver.  Works on a 1-device mesh (the LocalOptimizer case)

@@ -1,0 +1,20 @@
+"""Serving — streaming/queued inference (SURVEY.md §7 step 9).
+
+Reference analog (unverified — mount empty): Cluster Serving
+(``scala/serving``): Redis queue in → Flink streaming job batches requests →
+``InferenceModel.doPredict`` → Redis out; plus the python
+``InputQueue``/``OutputQueue`` client and the Orca ``InferenceModel``
+(a blocking queue of model replicas for concurrent predict).
+
+TPU-native: one process drives the chip; dynamic request batching feeds ONE
+jitted forward (padded to bucketed batch sizes so XLA reuses a few compiled
+programs); the "model replica queue" concurrency trick is unnecessary —
+XLA serializes device execution — but the thread-safe façade remains.
+"""
+
+from bigdl_tpu.serving.inference_model import InferenceModel
+from bigdl_tpu.serving.server import ServingConfig, ServingServer
+from bigdl_tpu.serving.client import InputQueue, OutputQueue
+
+__all__ = ["InferenceModel", "ServingServer", "ServingConfig",
+           "InputQueue", "OutputQueue"]

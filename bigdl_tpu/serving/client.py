@@ -1,0 +1,35 @@
+"""Serving client — ``InputQueue``/``OutputQueue`` API.
+
+Reference analog (unverified — mount empty): ``python/serving/src/bigdl/
+serving/client.py`` — enqueue ndarrays into Redis, poll results.  Here the
+transport is the in-process ``ServingServer`` (the Redis/Flink cluster
+plumbing is out of scope for the TPU core; the client API surface and
+semantics — ids, enqueue/query, timeout — match).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.server import ServingServer
+
+
+class InputQueue:
+    def __init__(self, server: ServingServer):
+        self._server = server
+
+    def enqueue(self, uri: Optional[str] = None, **kwargs) -> str:
+        """``InputQueue.enqueue(uri, t=ndarray)`` — returns the request id."""
+        if len(kwargs) != 1:
+            raise ValueError("enqueue expects exactly one named tensor, "
+                             "e.g. enqueue('req-1', t=arr)")
+        (arr,) = kwargs.values()
+        return self._server.enqueue(np.asarray(arr), request_id=uri)
+
+
+class OutputQueue:
+    def __init__(self, server: ServingServer):
+        self._server = server
+
+    def query(self, uri: str, timeout: float = 30.0) -> np.ndarray:
+        return self._server.query(uri, timeout=timeout)

@@ -1,0 +1,68 @@
+"""InferenceModel — thread-safe multi-backend predict holder.
+
+Reference analog (unverified — mount empty): ``scala/orca/.../inference/
+InferenceModel.scala`` — holds N model replicas in a blocking queue so many
+Flink/HTTP threads can predict concurrently; backends BigDL/OpenVINO/TF/
+Torch.  TPU-native: ONE jitted program (XLA queues device work; replicas
+buy nothing on a single chip), a lock only around host-side staging, and
+batch-size bucketing so arbitrary request sizes hit a handful of compiled
+shapes.
+"""
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class InferenceModel:
+    """Wraps (model, variables) — or any callable — for concurrent serving."""
+
+    def __init__(self, model=None, variables: Optional[Dict] = None,
+                 predict_fn: Optional[Callable] = None,
+                 batch_buckets: Sequence[int] = (1, 4, 16, 64, 256)):
+        if predict_fn is None:
+            if model is None or variables is None:
+                raise ValueError("need (model, variables) or predict_fn")
+
+            def raw(params, state, x):
+                out, _ = model.forward(params, state, x, training=False)
+                return out
+
+            self._jit = jax.jit(raw)
+            self._params = variables.get("params", {})
+            self._state = variables.get("state", {})
+            self._custom = None
+        else:
+            self._custom = predict_fn
+        self.buckets = tuple(sorted(batch_buckets))
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def load(path: str, model) -> "InferenceModel":
+        """Load from the durable model format (``doLoadBigDL`` analog)."""
+        from bigdl_tpu.utils.serializer import load_model
+
+        return InferenceModel(model, load_model(path))
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x)
+        if self._custom is not None:
+            return np.asarray(self._custom(x))
+        n = x.shape[0]
+        b = _bucket(n, self.buckets)
+        if n < b:  # pad to the bucket so XLA reuses the compiled program
+            pad = np.repeat(x[-1:], b - n, axis=0)
+            x = np.concatenate([x, pad], axis=0)
+        with self._lock:
+            out = self._jit(self._params, self._state, x)
+        return np.asarray(out)[:n]

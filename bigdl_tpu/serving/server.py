@@ -1,0 +1,124 @@
+"""ServingServer — the Cluster-Serving streaming engine.
+
+Reference analog (unverified — mount empty): ``scala/serving/.../
+ClusterServing.scala`` + ``engine/FlinkRedisSource/Sink``: pop a batch of
+requests from a Redis list, dynamic-batch up to ``batch_size`` within a
+timeout, run ``InferenceModel.doPredict``, write each result back keyed by
+request id.
+
+TPU-native: the transport is an in-process (or file-backed) queue pair —
+Redis/Flink are cluster plumbing, not capability — while the batching loop,
+backpressure and at-least-once result delivery semantics match.  A
+dispatcher thread owns the chip; client threads only enqueue.
+"""
+
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.serving.inference_model import InferenceModel
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.serving")
+
+
+@dataclass
+class ServingConfig:
+    """Reference config.yaml surface: modelPath, batchSize, timeout."""
+
+    batch_size: int = 32
+    batch_timeout_s: float = 0.005
+    queue_capacity: int = 4096
+
+
+class ServingServer:
+    """queue -> dynamic batch -> jitted predict -> result table."""
+
+    def __init__(self, model: InferenceModel,
+                 config: Optional[ServingConfig] = None):
+        self.model = model
+        self.config = config or ServingConfig()
+        self._in: "queue.Queue[Tuple[str, np.ndarray]]" = queue.Queue(
+            self.config.queue_capacity)
+        self._results: Dict[str, np.ndarray] = {}
+        self._result_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"batches": 0, "requests": 0}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServingServer":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- client side --------------------------------------------------------
+    def enqueue(self, arr: np.ndarray, request_id: Optional[str] = None
+                ) -> str:
+        rid = request_id or uuid.uuid4().hex
+        self._in.put((rid, np.asarray(arr)))
+        return rid
+
+    def query(self, request_id: str, timeout: float = 30.0) -> np.ndarray:
+        deadline = time.time() + timeout
+        with self._result_cv:
+            while request_id not in self._results:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"result {request_id} not ready")
+                self._result_cv.wait(remaining)
+            res = self._results.pop(request_id)
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    # -- engine loop --------------------------------------------------------
+    def _run(self) -> None:
+        cfg = self.config
+        while not self._stop.is_set():
+            batch = []
+            try:
+                batch.append(self._in.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            t0 = time.time()
+            while (len(batch) < cfg.batch_size
+                   and time.time() - t0 < cfg.batch_timeout_s):
+                try:
+                    batch.append(self._in.get_nowait())
+                except queue.Empty:
+                    time.sleep(0.0005)
+            self._process(batch)
+
+    def _process(self, batch) -> None:
+        rids = [r for r, _ in batch]
+        sizes = [a.shape[0] if a.ndim > 1 else 1 for _, a in batch]
+        arrs = [a if a.ndim > 1 else a[None] for _, a in batch]
+        stacked = np.concatenate(arrs, axis=0)
+        try:
+            out = self.model.predict(stacked)
+        except Exception as e:  # deliver the failure to every waiter
+            log.error("predict failed: %s", e)
+            with self._result_cv:
+                for rid in rids:
+                    self._results[rid] = e  # type: ignore[assignment]
+                self._result_cv.notify_all()
+            return
+        ofs = 0
+        with self._result_cv:
+            for rid, n in zip(rids, sizes):
+                self._results[rid] = out[ofs:ofs + n]
+                ofs += n
+            self._result_cv.notify_all()
+        self.stats["batches"] += 1
+        self.stats["requests"] += len(batch)

@@ -1,0 +1,97 @@
+"""Durable model format — the ``ModuleSerializer``/protobuf analog.
+
+Reference (unverified — mount empty): ``dllib/utils/serializer/
+ModuleSerializer.scala`` + ``bigdl.proto`` — a versioned protobuf with
+per-layer converters and weights as tensor blobs (SURVEY.md §6.4).
+
+TPU-native format: a directory with
+- ``manifest.json``: format version, model class/repr, tree structure with
+  dtypes/shapes (the proto-schema role, human-readable)
+- ``weights.npz``: flat path->array map (the tensor-blob role; zero-copy
+  into jnp on load)
+
+Multi-host discipline: only process 0 writes; every process can read.
+"""
+
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+
+FORMAT_VERSION = 1
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return out
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = jax.tree_util.keystr(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing tensor {key}")
+        arr = flat[key]
+        want = tuple(np.shape(leaf))
+        if tuple(arr.shape) != want:
+            raise ValueError(
+                f"shape mismatch for {key}: saved {arr.shape}, model {want}")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_model(path: str, model, variables: Dict[str, Any],
+               overwrite: bool = True) -> None:
+    """``Module.saveModule(path, overWrite)`` analog."""
+    if os.path.exists(os.path.join(path, "manifest.json")) and not overwrite:
+        raise FileExistsError(f"{path} exists and overwrite=False")
+    if jax.process_index() != 0:
+        return
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(variables)
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "model_class": type(model).__name__ if model is not None else None,
+        "model_repr": repr(model) if model is not None else None,
+        "tensors": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                    for k, v in flat.items()},
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    np.savez(os.path.join(path, "weights.npz"),
+             **{k: v for k, v in flat.items()})
+
+
+def load_model(path: str, model=None,
+               template: Dict[str, Any] = None) -> Dict[str, Any]:
+    """Load variables saved by ``save_model``.  If ``template`` (a variables
+    pytree, e.g. from ``model.init``) is given, the result keeps its exact
+    structure and shapes are validated; otherwise a nested-dict pytree is
+    rebuilt from the flat paths."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["format_version"] > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format v{manifest['format_version']} is newer than "
+            f"this library (v{FORMAT_VERSION})")
+    with np.load(os.path.join(path, "weights.npz")) as z:
+        flat = {k: z[k] for k in z.files}
+    if template is not None:
+        return _unflatten_like(template, flat)
+    # rebuild nested dicts from keystr paths like "['params']['block_0']['w']"
+    root: Dict[str, Any] = {}
+    for key, arr in flat.items():
+        parts = [p.strip("[]'\"") for p in key.split("][")]
+        parts = [p.replace("['", "").replace("']", "") for p in parts]
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root

@@ -1,0 +1,92 @@
+"""Orca-equivalent Estimator + XShards + serializer round-trip tests
+(reference test analog: orca estimator tests run with cluster_mode="local" —
+SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.data.shards import XShards, read_csv
+from bigdl_tpu.estimator import Estimator, init_context
+from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+from bigdl_tpu.optim.optim_method import Adam
+from bigdl_tpu.optim.validation import Loss, Top1Accuracy
+
+
+def _toy(n=256, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, d).astype(np.float32)
+    y = (x.sum(1) > d / 2).astype(np.int32)
+    return x, y
+
+
+def _make_est():
+    return Estimator.from_module(
+        model_creator=lambda cfg: nn.Sequential(
+            [nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2)]),
+        optimizer_creator=lambda cfg: Adam(
+            learning_rate=cfg.get("lr", 1e-2)),
+        loss_creator=lambda cfg: CrossEntropyCriterion(),
+        config={"lr": 1e-2})
+
+
+def test_estimator_fit_evaluate_predict():
+    init_context("local")
+    x, y = _toy()
+    est = _make_est()
+    stats = est.fit((x, y), epochs=30, batch_size=64,
+                    validation_data=(x, y),
+                    validation_methods=[Top1Accuracy()])
+    assert stats["num_samples"] == 256
+    res = est.evaluate((x, y), [Top1Accuracy(), Loss(CrossEntropyCriterion())])
+    assert res["Top1Accuracy"] > 0.85
+    pred = est.predict(x[:10])
+    assert pred.shape == (10, 2)
+
+
+def test_estimator_xshards_and_save_load(tmp_path):
+    init_context("local")
+    x, y = _toy(seed=1)
+    shards = XShards.partition({"x": x, "y": y}, num_shards=4)
+    assert shards.num_partitions() == 4
+
+    est = _make_est()
+    est.fit(shards, epochs=8, batch_size=64)
+    ref_pred = est.predict(x[:16])
+
+    path = str(tmp_path / "model")
+    est.save(path)
+
+    est2 = _make_est()
+    est2.load(path)
+    pred2 = est2.predict(x[:16])
+    np.testing.assert_allclose(np.asarray(pred2), np.asarray(ref_pred),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_xshards_ops():
+    x = np.arange(100).reshape(50, 2).astype(np.float32)
+    s = XShards.partition(x, num_shards=5)
+    s2 = s.transform_shard(lambda a: a * 2)
+    assert np.allclose(s2.concat(), x * 2)
+    s3 = s.repartition(3)
+    assert s3.num_partitions() == 3
+    assert np.allclose(s3.concat(), x)
+
+
+def test_read_csv(tmp_path):
+    import pandas as pd
+
+    for i in range(3):
+        pd.DataFrame({"a": np.arange(10) + i, "b": np.arange(10)}).to_csv(
+            tmp_path / f"part{i}.csv", index=False)
+    xs = read_csv(str(tmp_path))
+    assert xs.num_partitions() == 3
+    df = xs.concat()
+    assert len(df) == 30
+
+
+def test_estimator_rejects_unknown_backend():
+    with pytest.raises(ValueError):
+        Estimator.from_module(lambda c: None, lambda c: None, lambda c: None,
+                              backend="ray")

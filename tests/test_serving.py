@@ -1,0 +1,78 @@
+"""Serving tests: dynamic batching, concurrent clients, bucketed predict
+(reference analog: cluster-serving integration tests — SURVEY.md §5)."""
+
+import threading
+
+import numpy as np
+import jax
+
+from bigdl_tpu import nn
+from bigdl_tpu.serving import (
+    InferenceModel, InputQueue, OutputQueue, ServingConfig, ServingServer,
+)
+
+
+def _model_and_vars():
+    model = nn.Sequential([nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2)])
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.float32))
+    return model, v
+
+
+def test_inference_model_bucketing():
+    model, v = _model_and_vars()
+    im = InferenceModel(model, v, batch_buckets=(4, 16))
+    for n in (1, 3, 4, 9, 33):
+        out = im.predict(np.random.rand(n, 4).astype(np.float32))
+        assert out.shape == (n, 2)
+
+
+def test_inference_model_matches_direct():
+    model, v = _model_and_vars()
+    im = InferenceModel(model, v)
+    x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    ref, _ = model.apply(v, x)
+    np.testing.assert_allclose(im.predict(x), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_serving_server_roundtrip():
+    model, v = _model_and_vars()
+    server = ServingServer(InferenceModel(model, v),
+                           ServingConfig(batch_size=8)).start()
+    try:
+        x = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+        rid = server.enqueue(x)
+        out = server.query(rid, timeout=30)
+        ref, _ = model.apply(v, x)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5, atol=1e-6)
+    finally:
+        server.stop()
+
+
+def test_serving_concurrent_clients():
+    model, v = _model_and_vars()
+    server = ServingServer(InferenceModel(model, v),
+                           ServingConfig(batch_size=16)).start()
+    inq, outq = InputQueue(server), OutputQueue(server)
+    errors = []
+
+    def client(i):
+        try:
+            x = np.random.RandomState(i).rand(2, 4).astype(np.float32)
+            rid = inq.enqueue(f"req-{i}", t=x)
+            out = outq.query(rid, timeout=30)
+            ref, _ = model.apply(v, x)
+            np.testing.assert_allclose(out, np.asarray(ref),
+                                       rtol=1e-5, atol=1e-6)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        [t.start() for t in threads]
+        [t.join(60) for t in threads]
+        assert not errors, errors
+        assert server.stats["requests"] == 12
+    finally:
+        server.stop()
