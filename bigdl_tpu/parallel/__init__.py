@@ -12,16 +12,33 @@ This package adds the TPU-native axes on the same ``Mesh``:
   shard_map-friendly functions + GSPMD sharding rules).
 - ``sharded_module``: GSPMD partitioning helpers — logical-axis param
   annotations lowered to ``NamedSharding`` on the mesh.
+- ``pp``: pipeline parallelism — GPipe schedule as ONE SPMD ``lax.scan``
+  over the "pipe" axis, activations rotating via ``ppermute``.
+- ``moe``: mixture-of-experts with expert parallelism — capacity-bounded
+  top-k dispatch, ONE ``all_to_all`` each way over the "expert" axis.
 """
 
 from bigdl_tpu.parallel.ring_attention import ring_attention
 from bigdl_tpu.parallel.tp import (
     column_parallel, row_parallel, tp_linear_pair,
 )
+from bigdl_tpu.parallel.pp import (
+    microbatch, pipeline_apply, spmd_pipeline, stack_stage_params,
+    unmicrobatch,
+)
+from bigdl_tpu.parallel.moe import MoE, moe_apply_ep, moe_apply_local
 
 __all__ = [
     "ring_attention",
     "column_parallel",
     "row_parallel",
     "tp_linear_pair",
+    "microbatch",
+    "pipeline_apply",
+    "spmd_pipeline",
+    "stack_stage_params",
+    "unmicrobatch",
+    "MoE",
+    "moe_apply_ep",
+    "moe_apply_local",
 ]

@@ -1,0 +1,187 @@
+"""Mixture-of-Experts with expert parallelism over the "expert" mesh axis.
+
+New capability vs the reference (SURVEY.md §3.5: expert parallelism absent).
+TPU-native design (GShard/Switch formulation): top-k gating builds a
+capacity-bounded dispatch tensor; tokens are routed to expert shards with ONE
+``jax.lax.all_to_all`` (the canonical EP collective over ICI), each shard runs
+its local experts as a single batched einsum (MXU-friendly — no scalar
+routing loops), and a second all_to_all brings expert outputs home where they
+are combined with the gating weights.  Everything is static-shaped
+(capacity-dropped tokens pass through unchanged via the residual), so the
+whole layer jits and differentiates cleanly.
+
+Two entry points:
+- ``moe_gate`` / ``moe_apply_local``: single-shard (all experts local) — used
+  on one device and inside tests as the golden reference.
+- ``moe_apply_ep``: expert-parallel functional form, call inside shard_map
+  with tokens sharded over data and experts sharded over the expert axis.
+- ``MoE``: nn.Module wrapper (local experts) for Sequential/keras use.
+"""
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module, EMPTY
+from bigdl_tpu.runtime.mesh import AXIS_EXPERT
+
+
+class GateOutput(NamedTuple):
+    combine: jnp.ndarray    # (T, E, C) — combine weights (0 where dropped)
+    dispatch: jnp.ndarray   # (T, E, C) bool — one-hot dispatch mask
+    aux_loss: jnp.ndarray   # scalar load-balancing loss (Switch-style)
+
+
+def moe_gate(logits: jnp.ndarray, capacity: int, k: int = 2) -> GateOutput:
+    """Top-k gating with capacity. logits: (T, E)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # load-balance aux loss uses the top-1 assignment fractions (Switch eq. 4)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac_tokens * frac_probs) * E
+
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), bool)
+    remaining = probs
+    # expert buffer fill level carries across the k rounds so a token's
+    # 2nd choice lands after all 1st choices took their slots in that round
+    fill = jnp.zeros((E,), jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)                    # (T,)
+        gate = jnp.take_along_axis(remaining, choice[:, None], -1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)        # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]       # slot index
+        pos_tok = jnp.sum(pos * onehot, axis=-1)                   # (T,)
+        keep = pos_tok < capacity
+        slot = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)  # (T, C)
+        d = (onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+             * keep[:, None, None].astype(jnp.float32))
+        dispatch = jnp.logical_or(dispatch, d > 0)
+        combine = combine + d * gate[:, None, None]
+        fill = fill + jnp.sum(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+    # renormalize combine weights over the selected experts (GShard style)
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), 0.0)
+    return GateOutput(combine, dispatch, aux)
+
+
+def _expert_ffn(w1, b1, w2, b2, x, act):
+    # x: (E, C, d); w1: (E, d, h)
+    h = act(jnp.einsum("ecd,edh->ech", x, w1,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+            + b1[:, None, :])
+    return (jnp.einsum("ech,ehd->ecd", h, w2,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+            + b2[:, None, :])
+
+
+def moe_apply_local(params, x, *, capacity_factor: float = 1.25, k: int = 2,
+                    act: Callable = jax.nn.gelu
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All experts local. x: (T, d). params: {wg, w1, b1, w2, b2} with
+    expert-major leaves (E, ...). Returns (y, aux_loss)."""
+    T, d = x.shape
+    E = params["w1"].shape[0]
+    capacity = max(1, int(np.ceil(T * capacity_factor * k / E)))
+    logits = x @ params["wg"]                                     # (T, E)
+    gate = moe_gate(logits, capacity, k)
+    xe = jnp.einsum("td,tec->ecd", x,
+                    gate.dispatch.astype(x.dtype))                # (E, C, d)
+    ye = _expert_ffn(params["w1"], params["b1"], params["w2"], params["b2"],
+                     xe, act)
+    y = jnp.einsum("ecd,tec->td", ye, gate.combine.astype(x.dtype))
+    return y, gate.aux_loss
+
+
+def moe_apply_ep(params, x, *, n_expert_shards: int,
+                 capacity_factor: float = 1.25, k: int = 2,
+                 act: Callable = jax.nn.gelu,
+                 axis_name: str = AXIS_EXPERT
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel MoE — call inside shard_map.
+
+    x: (T_local, d) — this shard's tokens.  params: expert-major leaves
+    sharded on the expert axis, so the local block is (E_local, ...).
+    Gating weights ``wg`` are (d, E_global) replicated.
+
+    Route: dispatch (T,E,C) → (E_global, C, d) → all_to_all → each shard
+    holds (E_local, S*C, d) → batched expert FFN → all_to_all back → combine.
+    """
+    T, d = x.shape
+    E_local = params["w1"].shape[0]
+    E = E_local * n_expert_shards
+    capacity = max(1, int(np.ceil(T * capacity_factor * k / E)))
+    logits = x @ params["wg"]                                     # (T, E)
+    gate = moe_gate(logits, capacity, k)
+    xe = jnp.einsum("td,tec->ecd", x,
+                    gate.dispatch.astype(x.dtype))                # (E, C, d)
+    if n_expert_shards > 1:
+        # (E, C, d) -> (S, E_local, C, d); all_to_all swaps the shard dim for
+        # the token-source dim: each shard receives its experts' tokens from
+        # every peer -> (S, E_local, C, d) with S = source shard
+        xe = xe.reshape(n_expert_shards, E_local, capacity, d)
+        xe = jax.lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+        # (S, E_local, C, d) -> (E_local, S*C, d)
+        xe = xe.transpose(1, 0, 2, 3).reshape(E_local,
+                                              n_expert_shards * capacity, d)
+    ye = _expert_ffn(params["w1"], params["b1"], params["w2"], params["b2"],
+                     xe, act)
+    if n_expert_shards > 1:
+        ye = ye.reshape(E_local, n_expert_shards, capacity, d)
+        ye = ye.transpose(1, 0, 2, 3)                 # (S, E_local, C, d)
+        ye = jax.lax.all_to_all(ye, axis_name, split_axis=0, concat_axis=0,
+                                tiled=False)
+        ye = ye.reshape(E, capacity, d)
+    y = jnp.einsum("ecd,tec->td", ye, gate.combine.astype(x.dtype))
+    return y, gate.aux_loss
+
+
+class MoE(Module):
+    """MoE feed-forward block (local experts) as an nn.Module.
+
+    Reference analog: none (SURVEY.md §3.5 — EP absent from BigDL); this is
+    new TPU-native capability.  Expert = 2-layer MLP.
+    """
+
+    def __init__(self, num_experts: int, hidden: int, k: int = 2,
+                 capacity_factor: float = 1.25, aux_weight: float = 1e-2,
+                 act: Callable = jax.nn.gelu, name: Optional[str] = None):
+        super().__init__(name)
+        self.num_experts = num_experts
+        self.hidden = hidden
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.aux_weight = aux_weight
+        self.act = act
+
+    def build(self, rng, x):
+        d = x.shape[-1]
+        E, H = self.num_experts, self.hidden
+        k1, k2, k3 = jax.random.split(rng, 3)
+        s1 = 1.0 / np.sqrt(d)
+        params = {
+            "wg": jax.random.uniform(k1, (d, E), jnp.float32, -s1, s1),
+            "w1": jax.random.uniform(k2, (E, d, H), jnp.float32, -s1, s1),
+            "b1": jnp.zeros((E, H), jnp.float32),
+            "w2": jax.random.uniform(k3, (E, H, d), jnp.float32,
+                                     -1.0 / np.sqrt(H), 1.0 / np.sqrt(H)),
+            "b2": jnp.zeros((E, d), jnp.float32),
+        }
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        shape = x.shape
+        flat = x.reshape(-1, shape[-1])
+        y, aux = moe_apply_local(params, flat,
+                                 capacity_factor=self.capacity_factor,
+                                 k=self.k, act=self.act)
+        # expose aux loss through state so criteria/training can pick it up
+        return y.reshape(shape), {"aux_loss": aux * self.aux_weight}

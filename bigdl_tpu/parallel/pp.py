@@ -1,0 +1,128 @@
+"""Pipeline parallelism over the "pipe" mesh axis (GPipe schedule, SPMD form).
+
+New capability vs the reference (SURVEY.md §3.5: pipeline parallelism absent —
+BigDL's DistriOptimizer is pure data-parallel).  TPU-native design: instead of
+a stage-per-process scheduler with explicit send/recv (the torch/NCCL idiom),
+the whole pipeline is ONE SPMD program over the mesh's "pipe" axis:
+
+- every stage's parameters are the same pytree structure, stacked on a leading
+  stage dimension and sharded ``P("pipe")`` — each device holds one stage;
+- activations rotate stage→stage+1 with ``jax.lax.ppermute`` (a neighbor
+  exchange that rides ICI);
+- the GPipe schedule (fill → steady state → drain) is a ``lax.scan`` over
+  ``num_microbatches + n_stages - 1`` ticks, so the program is traced once,
+  fully static, and reverse-differentiable (scan + ppermute both have
+  transposes — backward pipelining falls out of ``jax.grad`` for free).
+
+Composability: ``spmd_pipeline`` is written to run INSIDE an enclosing
+``shard_map`` so it composes with data/tensor/sequence/expert axes (the
+5-axis flagship step in ``optim/parallel_train_step.py``).  The standalone
+wrapper ``pipeline_apply`` builds its own shard_map for single-axis use.
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from bigdl_tpu.runtime.mesh import AXIS_PIPE
+
+
+def stack_stage_params(stage_params: Sequence[Any]):
+    """Stack per-stage param pytrees (identical structure) on a new leading
+    stage axis — the layout that shards ``P("pipe")`` on every leaf."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *stage_params)
+
+
+def spmd_pipeline(stage_fn: Callable, params, x, *, n_stages: int,
+                  num_microbatches: int, axis_name: str = AXIS_PIPE):
+    """GPipe forward over a pipe axis.  MUST be called inside shard_map.
+
+    stage_fn(params, mb, mb_index) -> mb: applies ONE stage to one microbatch.
+      ``params`` is this device's stage-param shard (leading stage dim of
+      size 1 kept — squeeze inside stage_fn or index [0]).
+    x: (num_microbatches, mb_size, ...) — microbatched input, replicated over
+      the pipe axis (every stage sees it; only stage 0 reads it).
+    Returns (num_microbatches, mb_size, ...) — the last stage's outputs,
+    replicated over the pipe axis via a final psum (all other stages
+    contribute zeros).
+    """
+    if num_microbatches < 1:
+        raise ValueError("num_microbatches must be >= 1")
+    stage = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    total = num_microbatches + n_stages - 1
+
+    mb0 = jnp.zeros(x.shape[1:], x.dtype)
+
+    def tick(carry, t):
+        state, out = carry
+        # stage 0 ingests microbatch t (clamped; ticks >= num_microbatches
+        # inject a duplicate whose output drains past the loop end)
+        inj = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, num_microbatches - 1), 0, keepdims=False)
+        state = jnp.where(stage == 0, inj, state)
+        y = stage_fn(params, state, t)
+        # last stage emits microbatch (t - n_stages + 1)
+        oidx = t - (n_stages - 1)
+        emit = jnp.logical_and(stage == n_stages - 1, oidx >= 0)
+        safe = jnp.clip(oidx, 0, num_microbatches - 1)
+        cur = jax.lax.dynamic_index_in_dim(out, safe, 0, keepdims=False)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, jnp.where(emit, y, cur), safe, 0)
+        # rotate activations to the next stage (last→0 edge is overwritten by
+        # the next injection)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, out), None
+
+    # uniform pipeline: every stage maps (mb_size, ...) -> same shape/dtype
+    out0 = jnp.zeros((num_microbatches,) + x.shape[1:], x.dtype)
+    (_, out), _ = jax.lax.scan(tick, (mb0, out0), jnp.arange(total))
+    # replicate the last stage's outputs to every stage (zeros elsewhere)
+    out = jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out))
+    return jax.lax.psum(out, axis_name)
+
+
+def microbatch(x, num_microbatches: int):
+    """(B, ...) -> (num_microbatches, B/num_microbatches, ...)."""
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches {num_microbatches}")
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x):
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params, x,
+                   num_microbatches: int, axis_name: str = AXIS_PIPE):
+    """Standalone pipelined forward: builds the shard_map over ``axis_name``.
+
+    stacked_params: leaves of shape (n_stages, ...) — see stack_stage_params.
+    x: full batch (B, ...); microbatched internally.
+    """
+    n_stages = mesh.shape[axis_name]
+
+    def fn(p, xmb):
+        out = spmd_pipeline(stage_fn, p, xmb, n_stages=n_stages,
+                            num_microbatches=num_microbatches,
+                            axis_name=axis_name)
+        return out
+
+    mapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
+                                         stacked_params), P()),
+        out_specs=P(), check_vma=False)
+    return unmicrobatch(mapped(stacked_params, microbatch(x, num_microbatches)))
