@@ -54,7 +54,7 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attn_dropout: float = 0.0, causal: bool = False,
-                 weight_init=init_mod.xavier, name=None):
+                 weight_init=init_mod.xavier, use_flash=None, name=None):
         super().__init__(name)
         assert hidden_size % num_heads == 0
         self.hidden_size = hidden_size
@@ -63,6 +63,9 @@ class MultiHeadAttention(Module):
         self.attn_dropout = attn_dropout
         self.causal = causal
         self.weight_init = weight_init
+        # None = auto: the fused Pallas kernel (bigdl_tpu.ops.flash_attention)
+        # when on TPU and the mask is none/causal with no attention dropout.
+        self.use_flash = use_flash
 
     def build(self, rng, x, context=None):
         h = self.hidden_size
@@ -99,15 +102,29 @@ class MultiHeadAttention(Module):
              + params["bv"]).astype(x.dtype)
         q, k, v = self._split(q), self._split(k), self._split(v)
 
-        attn_mask = mask
-        if self.causal:
-            lq, lk = q.shape[2], k.shape[2]
-            cmask = jnp.tril(jnp.ones((lq, lk), bool))
-            attn_mask = cmask if attn_mask is None else (attn_mask & cmask)
+        dropout_active = self.attn_dropout > 0.0 and training
+        flash_ok = mask is None and not dropout_active
+        if self.use_flash is None:
+            from bigdl_tpu.ops.common import on_tpu
 
-        out = dot_product_attention(
-            q, k, v, mask=attn_mask, dropout_p=self.attn_dropout, rng=rng,
-            training=training)
+            use_flash = flash_ok and on_tpu()
+        else:
+            use_flash = self.use_flash and flash_ok
+
+        if use_flash:
+            from bigdl_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=self.causal)
+        else:
+            attn_mask = mask
+            if self.causal:
+                lq, lk = q.shape[2], k.shape[2]
+                cmask = jnp.tril(jnp.ones((lq, lk), bool))
+                attn_mask = cmask if attn_mask is None else (attn_mask & cmask)
+
+            out = dot_product_attention(
+                q, k, v, mask=attn_mask, dropout_p=self.attn_dropout, rng=rng,
+                training=training)
         b, h, t, dh = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
         y = (jnp.matmul(cast_compute(out), cast_compute(params["wo"]),

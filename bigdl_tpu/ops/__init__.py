@@ -1,0 +1,31 @@
+"""Pallas (Mosaic) TPU kernels for the hot ops.
+
+Reference analog: the native C/C++ kernel layer of BigDL —
+``com.intel.analytics.bigdl.mkl.MKL`` (BLAS/VML JNI) and the
+``bigdl-core`` int8 quantization kernels (SURVEY.md §3.2).  On TPU the
+bulk of that role is played by XLA itself; this package holds the
+hand-written kernels for what XLA does not fuse well:
+
+- ``flash_attention`` — blockwise fused attention (online softmax, O(S)
+  memory), the MXU-friendly replacement for materialised O(S²) attention.
+- ``int8_matmul`` / ``quantize_int8`` — quantized inference gemm + abs-max
+  calibration (reference: ``nn/quantized`` + bigdl-core int8 kernels).
+- ``fused_layernorm`` — single-pass row-blocked LayerNorm.
+
+Every kernel has an ``interpret`` escape hatch so the full test suite runs
+on CPU (`interpret=True` under `--xla_force_host_platform_device_count`),
+mirroring the reference's MKL-vs-pure-JVM fallback split.
+"""
+
+from bigdl_tpu.ops.common import on_tpu, default_interpret
+from bigdl_tpu.ops.flash_attention import flash_attention
+from bigdl_tpu.ops.quantized import (abs_max_scales, dequantize_int8,
+                                     int8_matmul, quantize_int8,
+                                     quantized_linear)
+from bigdl_tpu.ops.fused import fused_layernorm
+
+__all__ = [
+    "on_tpu", "default_interpret", "flash_attention",
+    "abs_max_scales", "quantize_int8", "dequantize_int8", "int8_matmul",
+    "quantized_linear", "fused_layernorm",
+]

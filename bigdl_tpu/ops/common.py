@@ -1,0 +1,29 @@
+"""Shared helpers for the Pallas kernel layer."""
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def default_interpret(interpret=None) -> bool:
+    """Kernels compile with Mosaic on TPU, interpret everywhere else so the
+    same code path is exercised by the CPU-simulated-mesh test suite."""
+    if interpret is None:
+        return not on_tpu()
+    return bool(interpret)
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b

@@ -1,0 +1,194 @@
+"""Blockwise fused (flash) attention — Pallas TPU kernel.
+
+Reference analog: the reference has NO fused attention — its
+``nn/Attention.scala`` / Keras ``TransformerLayer`` materialise the full
+O(S²) score matrix on one device (SURVEY.md §6.7).  This kernel is the
+TPU-native upgrade: online-softmax blockwise attention that keeps exactly
+one (block_q × d) query tile and one (block_k × d) key/value tile in VMEM
+at a time, so peak on-chip memory is O(block·d) and the matmuls stay on
+the MXU.
+
+Forward is a Pallas kernel with grid (batch·heads, q-blocks, k-blocks);
+the k dimension is innermost and iterates sequentially on-core, carrying
+the online-softmax running (max, denom, accumulator) in VMEM scratch —
+the k/v BlockSpecs stream one tile per step from HBM.  Backward is a
+custom VJP using the saved logsumexp: the standard flash-attention
+backward recurrence evaluated with jnp einsums (XLA fuses it well; a
+fully blocked backward kernel is a later perf item — ring attention in
+``bigdl_tpu/parallel/ring_attention.py`` covers the long-context regime
+where O(S²) backward would not fit).
+
+Shapes: q, k, v are (batch, heads, seq, head_dim); output matches q.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bigdl_tpu.ops.common import default_interpret, round_up
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, kv_len):
+    # q_ref: (1, block_q, d); k_ref/v_ref: (1, block_k, d) — one tile each.
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip k-blocks strictly above this q-block's diagonal band
+    needed = jnp.bool_(True)
+    if causal:
+        needed = kj * block_k < (qi + 1) * block_q
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == num_kb - 1)
+    def _finish():
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    bq = min(block_q, round_up(sq, 8))
+    bk = min(block_k, round_up(skv, 8))
+    sq_p, skv_p = round_up(sq, bq), round_up(skv, bk)
+
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    qp = qp.reshape(b * h, sq_p, d)
+    kp = kp.reshape(b * h, skv_p, d)
+    vp = vp.reshape(b * h, skv_p, d)
+
+    grid = (b * h, sq_p // bq, skv_p // bk)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+        block_k=bk, kv_len=skv)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            # lse carries a trailing singleton lane dim: a 2-D (1, bq) block
+            # would put bq in the lane slot and 1 in the sublane slot, which
+            # TPU tiling rejects when batch·heads > 1.
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denom
+            pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+        interpret=default_interpret(interpret),
+    )(qp, kp, vp)
+
+    out = out.reshape(b, h, sq_p, d)[:, :, :sq]
+    lse = lse.reshape(b, h, sq_p)[:, :, :sq]
+    return out, lse  # lse: (b, h, sq)
+
+
+def _reference_bwd(q, k, v, out, lse, g, sm_scale, causal):
+    """Flash-attention backward recurrence with the saved logsumexp.
+
+    p = exp(q·kᵀ·scale − lse) is reconstructed tile-free by XLA fusion;
+    D = rowsum(g ⊙ out) gives dS = p ⊙ (g·vᵀ − D)."""
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
+    if causal:
+        sq, skv = s.shape[-2], s.shape[-1]
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _reference_bwd(q, k, v, out, lse, g, sm_scale, causal)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: Optional[bool] = None):
+    """Fused blockwise attention.  q, k, v: (batch, heads, seq, head_dim)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
+                  int(block_k), interpret)

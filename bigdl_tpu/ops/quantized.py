@@ -1,0 +1,102 @@
+"""Int8 quantized matmul + calibration — Pallas TPU kernels.
+
+Reference analog: BigDL's post-training int8 inference path —
+``nn/quantized/{Quantizer,Linear,SpatialConvolution}.scala`` backed by the
+``bigdl-core`` native int8 gemm with abs-max calibration (SURVEY.md
+§3.1/§3.2).  TPU-native redesign: symmetric per-channel weight
+quantization + dynamic per-row activation quantization feeding an
+int8×int8→int32 MXU matmul kernel, rescaled to float on the way out.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from bigdl_tpu.ops.common import default_interpret, round_up
+
+
+def abs_max_scales(x, axis) -> jnp.ndarray:
+    """Symmetric abs-max calibration: scale s.t. x/scale fits int8."""
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=False)
+    return jnp.maximum(amax, 1e-8) / 127.0
+
+
+def quantize_int8(w, axis: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-output-channel symmetric int8 quantization of a weight.
+
+    ``axis`` is the reduction axis (the one contracted in the matmul); for a
+    (in, out) Linear weight use axis=0 → per-out-channel scales (out,)."""
+    scales = abs_max_scales(w, axis=axis)
+    q = jnp.clip(jnp.round(w / jnp.expand_dims(scales, axis)), -127, 127)
+    return q.astype(jnp.int8), scales.astype(jnp.float32)
+
+
+def dequantize_int8(q, scales, axis: int = 0) -> jnp.ndarray:
+    return q.astype(jnp.float32) * jnp.expand_dims(scales, axis)
+
+
+def _int8_mm_kernel(x_ref, w_ref, o_ref):
+    # x: (bm, bk) int8, w: (bk, bn) int8 → o: (bm, bn) int32; the K grid
+    # dimension is innermost (sequential on-core), so the output block stays
+    # resident and accumulates across K tiles.
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    # precision pinned to DEFAULT: a global jax_default_matmul_precision of
+    # "highest" would stamp an fp32 contract precision onto this integer
+    # matmul, which Mosaic rejects ("Bad lhs type").
+    o_ref[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+        precision=jax.lax.Precision.DEFAULT)
+
+
+def int8_matmul(x_q, w_q, *, block_m: int = 256, block_n: int = 256,
+                block_k: int = 512, interpret: Optional[bool] = None):
+    """int8 (M,K) × int8 (K,N) → int32 (M,N) on the MXU, tiled on all
+    three dimensions (one (bm,bk) + (bk,bn) tile pair in VMEM per step)."""
+    m, k = x_q.shape
+    k2, n = w_q.shape
+    assert k == k2, (x_q.shape, w_q.shape)
+    bm = min(block_m, round_up(m, 32))
+    bn = min(block_n, round_up(n, 128))
+    bk = min(block_k, round_up(k, 128))
+    mp, np_, kp = round_up(m, bm), round_up(n, bn), round_up(k, bk)
+    xp = jnp.pad(x_q, ((0, mp - m), (0, kp - k)))
+    wp = jnp.pad(w_q, ((0, kp - k), (0, np_ - n)))
+
+    out = pl.pallas_call(
+        _int8_mm_kernel,
+        grid=(mp // bm, np_ // bn, kp // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.int32),
+        interpret=default_interpret(interpret),
+    )(xp, wp)
+    return out[:m, :n]
+
+
+def quantized_linear(x, w_q, w_scales, bias=None,
+                     interpret: Optional[bool] = None):
+    """Dense layer with a pre-quantized (in, out) int8 weight.
+
+    Activations are dynamically quantized per row (abs-max), the matmul runs
+    int8×int8→int32, and the result is rescaled: y = (x_q·w_q) · sx ⊗ sw."""
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    x2 = x.reshape(-1, k)
+    sx = abs_max_scales(x2, axis=1)  # (M,)
+    x_q = jnp.clip(jnp.round(x2 / sx[:, None]), -127, 127).astype(jnp.int8)
+    acc = int8_matmul(x_q, w_q, interpret=interpret)
+    y = acc.astype(jnp.float32) * sx[:, None] * w_scales[None, :]
+    if bias is not None:
+        y = y + bias
+    return y.reshape(*lead, w_q.shape[1]).astype(x.dtype)

@@ -49,7 +49,10 @@ def spmd_pipeline(stage_fn: Callable, params, x, *, n_stages: int,
 
     stage_fn(params, mb, mb_index) -> mb: applies ONE stage to one microbatch.
       ``params`` is this device's stage-param shard (leading stage dim of
-      size 1 kept — squeeze inside stage_fn or index [0]).
+      size 1 kept — squeeze inside stage_fn or index [0]).  ``mb_index`` is
+      the index of the microbatch this stage is processing right now
+      (tick − stage position; negative/overflow values occur only on
+      fill/drain ticks whose results are discarded).
     x: (num_microbatches, mb_size, ...) — microbatched input, replicated over
       the pipe axis (every stage sees it; only stage 0 reads it).
     Returns (num_microbatches, mb_size, ...) — the last stage's outputs,
@@ -71,7 +74,8 @@ def spmd_pipeline(stage_fn: Callable, params, x, *, n_stages: int,
         inj = jax.lax.dynamic_index_in_dim(
             x, jnp.clip(t, 0, num_microbatches - 1), 0, keepdims=False)
         state = jnp.where(stage == 0, inj, state)
-        y = stage_fn(params, state, t)
+        # at tick t, pipeline position s holds microbatch t - s
+        y = stage_fn(params, state, t - stage)
         # last stage emits microbatch (t - n_stages + 1)
         oidx = t - (n_stages - 1)
         emit = jnp.logical_and(stage == n_stages - 1, oidx >= 0)

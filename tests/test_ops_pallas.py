@@ -1,0 +1,207 @@
+"""Pallas kernel correctness — flash attention, int8 matmul, fused LN.
+
+Mirrors the reference's layer-correctness spec pattern (SURVEY.md §5:
+``nn/LinearSpec.scala``-style golden comparisons): every kernel is checked
+against a plain jnp/numpy oracle, on CPU in interpreter mode — the same
+code path Mosaic compiles on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn.attention import dot_product_attention
+from bigdl_tpu.ops import (flash_attention, fused_layernorm, int8_matmul,
+                           quantize_int8, quantized_linear)
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        rng = np.random.default_rng(0)
+        q = _rand(rng, 2, 3, 40, 16)
+        k = _rand(rng, 2, 3, 40, 16)
+        v = _rand(rng, 2, 3, 40, 16)
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                              interpret=True)
+        mask = jnp.tril(jnp.ones((40, 40), bool)) if causal else None
+        ref = dot_product_attention(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_unaligned_and_cross_lengths(self):
+        rng = np.random.default_rng(1)
+        q = _rand(rng, 1, 2, 37, 8)
+        k = _rand(rng, 1, 2, 53, 8)
+        v = _rand(rng, 1, 2, 53, 8)
+        out = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match(self, causal):
+        rng = np.random.default_rng(2)
+        q = _rand(rng, 1, 2, 24, 8)
+        k = _rand(rng, 1, 2, 24, 8)
+        v = _rand(rng, 1, 2, 24, 8)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=8, block_k=8,
+                interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            mask = jnp.tril(jnp.ones((24, 24), bool)) if causal else None
+            return jnp.sum(dot_product_attention(q, k, v, mask=mask) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_jit_compatible(self):
+        rng = np.random.default_rng(3)
+        q = _rand(rng, 1, 1, 16, 8)
+        f = jax.jit(lambda q: flash_attention(q, q, q, interpret=True))
+        out = f(q)
+        assert out.shape == q.shape
+
+
+class TestInt8Matmul:
+    def test_exact_int_arithmetic(self):
+        rng = np.random.default_rng(0)
+        x = rng.integers(-127, 128, (50, 70), dtype=np.int8)
+        w = rng.integers(-127, 128, (70, 30), dtype=np.int8)
+        out = int8_matmul(jnp.asarray(x), jnp.asarray(w), block_m=32,
+                          block_n=128, block_k=128, interpret=True)
+        ref = x.astype(np.int32) @ w.astype(np.int32)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_quantize_roundtrip(self):
+        rng = np.random.default_rng(1)
+        w = _rand(rng, 64, 32)
+        w_q, scales = quantize_int8(w, axis=0)
+        assert w_q.dtype == jnp.int8 and scales.shape == (32,)
+        deq = np.asarray(w_q, np.float32) * np.asarray(scales)[None, :]
+        np.testing.assert_allclose(deq, np.asarray(w), atol=float(
+            np.max(np.asarray(scales))) * 0.51)
+
+    def test_quantized_linear_close_to_f32(self):
+        rng = np.random.default_rng(2)
+        x = _rand(rng, 9, 64)
+        w = _rand(rng, 64, 48) * 0.1
+        b = _rand(rng, 48) * 0.01
+        w_q, scales = quantize_int8(w, axis=0)
+        y_q = quantized_linear(x, w_q, scales, b, interpret=True)
+        y = x @ w + b
+        err = np.abs(np.asarray(y_q) - np.asarray(y)).max()
+        scale = float(np.abs(np.asarray(y)).max())
+        assert err / scale < 0.05, (err, scale)
+
+
+class TestQuantizedModules:
+    def test_quantize_sequential(self):
+        from bigdl_tpu.nn.layers import Linear, ReLU
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.quantized import QuantizedLinear, quantize
+
+        rng = np.random.default_rng(3)
+        model = Sequential([Linear(32, 16), ReLU(), Linear(16, 4)])
+        x = _rand(rng, 5, 32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = model.apply(variables, x)
+
+        q_model, q_vars = quantize(model, variables)
+        assert isinstance(q_model.layers[0], QuantizedLinear)
+        assert isinstance(q_model.layers[2], QuantizedLinear)
+        y_q, _ = q_model.apply(q_vars, x)
+        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
+               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
+        assert rel < 0.1, rel
+        # original untouched
+        y_again, _ = model.apply(variables, x)
+        np.testing.assert_array_equal(np.asarray(y_again), np.asarray(y_ref))
+
+    def test_quantize_conv(self):
+        from bigdl_tpu.nn.layers import Conv2D
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.quantized import QuantizedConv2D, quantize
+
+        rng = np.random.default_rng(4)
+        model = Sequential([Conv2D(3, 8, 3, stride=1, padding="SAME")])
+        x = _rand(rng, 2, 8, 8, 3)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = model.apply(variables, x)
+        q_model, q_vars = quantize(model, variables)
+        assert isinstance(q_model.layers[0], QuantizedConv2D)
+        y_q, _ = q_model.apply(q_vars, x)
+        assert y_q.shape == y_ref.shape
+        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
+               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
+        assert rel < 0.1, rel
+
+
+class TestFusedLayerNorm:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(0)
+        x = _rand(rng, 7, 33)
+        g = _rand(rng, 33)
+        b = _rand(rng, 33)
+        out = fused_layernorm(x, g, b, interpret=True)
+        mean = np.asarray(x).mean(-1, keepdims=True)
+        var = np.asarray(x).var(-1, keepdims=True)
+        ref = (np.asarray(x) - mean) / np.sqrt(var + 1e-5)
+        ref = ref * np.asarray(g) + np.asarray(b)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_gradients_match(self):
+        rng = np.random.default_rng(2)
+        x = _rand(rng, 4, 16)
+        g = _rand(rng, 16)
+        b = _rand(rng, 16)
+
+        def loss_fused(x, g, b):
+            return jnp.sum(fused_layernorm(x, g, b, interpret=True) ** 2)
+
+        def loss_ref(x, g, b):
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            y = (x - mean) * jax.lax.rsqrt(var + 1e-5) * g + b
+            return jnp.sum(y ** 2)
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(x, g, b)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, g, b)
+        for a, bb in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_3d_input(self):
+        rng = np.random.default_rng(1)
+        x = _rand(rng, 2, 5, 16)
+        g = jnp.ones((16,))
+        b = jnp.zeros((16,))
+        out = fused_layernorm(x, g, b, interpret=True)
+        assert out.shape == x.shape
+
+
+class TestFlashInMHA:
+    def test_mha_flash_path(self):
+        from bigdl_tpu.nn.attention import MultiHeadAttention
+
+        rng = np.random.default_rng(5)
+        x = _rand(rng, 2, 20, 32)
+        mha = MultiHeadAttention(32, 4, causal=True, use_flash=False)
+        variables = mha.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = mha.apply(variables, x)
+        mha_flash = MultiHeadAttention(32, 4, causal=True, use_flash=True)
+        y_flash, _ = mha_flash.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_ref),
+                                   rtol=2e-3, atol=2e-3)
