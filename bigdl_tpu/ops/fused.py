@@ -73,11 +73,11 @@ def _ln_forward(x, gamma, beta, eps, block_rows, interpret):
 
 def _ln_vjp_fwd(x, gamma, beta, eps, block_rows, interpret):
     out = _ln_forward(x, gamma, beta, eps, block_rows, interpret)
-    return out, (x, gamma, beta.dtype)
+    return out, (x, gamma, beta)
 
 
 def _ln_vjp_bwd(eps, block_rows, interpret, res, g):
-    x, gamma, beta_dtype = res
+    x, gamma, beta = res
     xf = x.astype(jnp.float32)
     gf = g.astype(jnp.float32)
     d = x.shape[-1]
@@ -93,7 +93,7 @@ def _ln_vjp_bwd(eps, block_rows, interpret, res, g):
     # gradients match each primal's dtype (f32 master params keep f32 grads
     # even when activations are bf16)
     return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
-            dbeta.astype(beta_dtype))
+            dbeta.astype(beta.dtype))
 
 
 _fused_ln.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
