@@ -1,0 +1,20 @@
+"""AutoML — hyperparameter search.
+
+Reference analog (unverified — mount empty): ``python/orca/src/bigdl/orca/
+automl/`` (SURVEY.md §3.3): ``AutoEstimator.fit(data, search_space,
+n_sampling)`` running trials on Ray Tune with the ``hp`` search-space DSL.
+
+TPU-native redesign: trials run sequentially in-process — a TPU slice is
+gang-scheduled to ONE program, so concurrent trials would fight for the
+chips; sequential trials each get the whole mesh (and jit caching makes
+same-shape trials cheap).  The ``hp`` DSL and the Searcher/AutoEstimator
+surface mirror the reference so AutoTS code ports unchanged.
+"""
+
+from bigdl_tpu.automl import hp
+from bigdl_tpu.automl.auto_estimator import AutoEstimator
+from bigdl_tpu.automl.search import (GridSearcher, RandomSearcher, Searcher,
+                                     TrialResult)
+
+__all__ = ["hp", "AutoEstimator", "Searcher", "RandomSearcher",
+           "GridSearcher", "TrialResult"]

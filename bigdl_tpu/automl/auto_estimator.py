@@ -1,0 +1,77 @@
+"""AutoEstimator — reference ``orca/automl/auto_estimator.py``:
+``AutoEstimator.from_torch(model_creator, optimizer_creator, loss_creator)``
+then ``.fit(data, search_space=…, n_sampling=…)`` → ``get_best_model()``.
+
+TPU-native: creators take a concrete sampled ``config`` dict and the
+trials train through the Orca-equivalent ``Estimator`` on the local
+mesh (see ``bigdl_tpu/automl/__init__`` for why trials are sequential).
+"""
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.automl.search import RandomSearcher, Searcher, TrialResult
+from bigdl_tpu.estimator.estimator import Estimator
+
+
+class AutoEstimator:
+    def __init__(self, model_creator: Callable[[Dict], Any],
+                 optimizer_creator: Callable[[Dict], Any],
+                 loss_creator: Callable[[Dict], Any],
+                 metric: str = "loss", mode: str = "min"):
+        self.model_creator = model_creator
+        self.optimizer_creator = optimizer_creator
+        self.loss_creator = loss_creator
+        self.metric = metric
+        self.mode = mode
+        self.best_result: Optional[TrialResult] = None
+        self.best_estimator: Optional[Estimator] = None
+
+    from_module = staticmethod(lambda *a, **k: AutoEstimator(*a, **k))
+
+    def fit(self, data, validation_data=None, *, search_space: Dict[str, Any],
+            n_sampling: int = 8, epochs: int = 1, batch_size: Any = 32,
+            searcher: Optional[Searcher] = None) -> "AutoEstimator":
+        """data: (x, y) arrays or anything Estimator.fit accepts.  The
+        sampled config may carry 'batch_size'/'epochs' overrides."""
+        searcher = searcher or RandomSearcher(mode=self.mode)
+        val = validation_data if validation_data is not None else data
+
+        from bigdl_tpu.optim import validation as V
+
+        method_table = {"loss": lambda est: V.Loss(est.criterion),
+                        "mse": lambda est: V.MSE(),
+                        "mae": lambda est: V.MAE(),
+                        "top1accuracy": lambda est: V.Top1Accuracy(),
+                        "accuracy": lambda est: V.Top1Accuracy()}
+        make_method = method_table.get(self.metric.lower())
+        if make_method is None:
+            raise ValueError(f"unknown metric {self.metric!r}; "
+                             f"one of {sorted(method_table)}")
+
+        def trial(config):
+            est = Estimator.from_module(
+                self.model_creator, self.optimizer_creator,
+                self.loss_creator, config=config)
+            est.fit(data, epochs=int(config.get("epochs", epochs)),
+                    batch_size=int(config.get("batch_size", batch_size)))
+            stats = est.evaluate(val, [make_method(est)])
+            return float(list(stats.values())[0]), est
+
+        self.best_result = searcher.run(trial, search_space, n_sampling)
+        self.best_estimator = self.best_result.artifacts
+        self.searcher = searcher
+        return self
+
+    def get_best_model(self):
+        self._check()
+        return self.best_estimator.get_model()
+
+    def get_best_config(self) -> Dict[str, Any]:
+        self._check()
+        return self.best_result.config
+
+    def _check(self):
+        if self.best_result is None:
+            raise RuntimeError("call fit() first")

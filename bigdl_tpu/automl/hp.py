@@ -1,0 +1,119 @@
+"""Search-space DSL — reference ``orca/automl/hp.py`` (``hp.choice``,
+``hp.uniform``, ``hp.randint``, … thin wrappers over Ray Tune sample
+spaces; here self-contained samplers)."""
+
+import math
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+
+class Sampler:
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid(self) -> List[Any]:
+        """Discrete support for grid search (None = continuous)."""
+        return None
+
+
+class Choice(Sampler):
+    def __init__(self, options: Sequence[Any]):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return self.options[int(rng.integers(0, len(self.options)))]
+
+    def grid(self):
+        return list(self.options)
+
+
+class Uniform(Sampler):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = float(lower), float(upper)
+
+    def sample(self, rng):
+        return float(rng.uniform(self.lower, self.upper))
+
+
+class QUniform(Sampler):
+    def __init__(self, lower: float, upper: float, q: float):
+        self.lower, self.upper, self.q = float(lower), float(upper), float(q)
+
+    def sample(self, rng):
+        v = rng.uniform(self.lower, self.upper)
+        return float(np.round(v / self.q) * self.q)
+
+
+class LogUniform(Sampler):
+    def __init__(self, lower: float, upper: float):
+        self.lower, self.upper = math.log(lower), math.log(upper)
+
+    def sample(self, rng):
+        return float(math.exp(rng.uniform(self.lower, self.upper)))
+
+
+class RandInt(Sampler):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = int(lower), int(upper)
+
+    def sample(self, rng):
+        return int(rng.integers(self.lower, self.upper))
+
+
+def choice(options):
+    return Choice(options)
+
+
+def uniform(lower, upper):
+    return Uniform(lower, upper)
+
+
+def quniform(lower, upper, q):
+    return QUniform(lower, upper, q)
+
+
+def loguniform(lower, upper):
+    return LogUniform(lower, upper)
+
+
+def randint(lower, upper):
+    return RandInt(lower, upper)
+
+
+def sample_space(space: Dict[str, Any], rng: np.random.Generator
+                 ) -> Dict[str, Any]:
+    """Resolve a (possibly nested) search space into a concrete config."""
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, Sampler):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict):
+            out[k] = sample_space(v, rng)
+        else:
+            out[k] = v
+    return out
+
+
+def grid_points(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cartesian product of all discrete axes (continuous axes forbidden)."""
+    keys, axes = [], []
+    fixed = {}
+    for k, v in space.items():
+        if isinstance(v, Sampler):
+            g = v.grid()
+            if g is None:
+                raise ValueError(
+                    f"grid search needs discrete axes; '{k}' is continuous")
+            keys.append(k)
+            axes.append(g)
+        elif isinstance(v, dict):
+            sub = grid_points(v)
+            keys.append(k)
+            axes.append(sub)
+        else:
+            fixed[k] = v
+    points = [dict(fixed)]
+    for k, axis in zip(keys, axes):
+        points = [dict(p, **{k: a}) for p in points for a in axis]
+    return points

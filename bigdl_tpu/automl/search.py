@@ -1,0 +1,84 @@
+"""Trial searchers — reference ``orca/automl/search/`` (Ray-Tune-backed
+SearchEngine; here in-process sequential trials, see package docstring)."""
+
+import dataclasses
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.automl import hp as hp_mod
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    metric: float
+    artifacts: Any = None          # whatever the trial fn returned alongside
+    duration_s: float = 0.0
+    error: Optional[str] = None
+
+
+class Searcher:
+    """Drive trial_fn(config) -> (metric, artifacts) over a search space."""
+
+    def __init__(self, mode: str = "min"):
+        assert mode in ("min", "max")
+        self.mode = mode
+        self.results: List[TrialResult] = []
+
+    def _configs(self, space, n_sampling):
+        raise NotImplementedError
+
+    def run(self, trial_fn: Callable[[Dict], Any], space: Dict[str, Any],
+            n_sampling: int = 8) -> TrialResult:
+        sign = 1.0 if self.mode == "min" else -1.0
+        best = None
+        for i, config in enumerate(self._configs(space, n_sampling)):
+            t0 = time.perf_counter()
+            try:
+                out = trial_fn(config)
+                metric, artifacts = out if isinstance(out, tuple) else (out,
+                                                                        None)
+                res = TrialResult(config, float(metric), artifacts,
+                                  time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — a bad config must not kill the sweep
+                res = TrialResult(config, float("inf") * sign, None,
+                                  time.perf_counter() - t0,
+                                  error=traceback.format_exc())
+                log.warning("trial %d failed: %s", i, res.error.splitlines()[-1])
+            self.results.append(res)
+            if res.error is None and (
+                    best is None or sign * res.metric < sign * best.metric):
+                if best is not None:
+                    best.artifacts = None  # only the winner's model is kept
+                best = res
+            else:
+                res.artifacts = None
+            log.info("trial %d/%s: metric=%s config=%s", i + 1,
+                     n_sampling, res.metric, config)
+        if best is None:
+            raise RuntimeError("all trials failed; see results[*].error")
+        return best
+
+
+class RandomSearcher(Searcher):
+    def __init__(self, mode: str = "min", seed: int = 0):
+        super().__init__(mode)
+        self.rng = np.random.default_rng(seed)
+
+    def _configs(self, space, n_sampling):
+        for _ in range(n_sampling):
+            yield hp_mod.sample_space(space, self.rng)
+
+
+class GridSearcher(Searcher):
+    """Exhaustive over discrete axes; n_sampling caps the trial count."""
+
+    def _configs(self, space, n_sampling):
+        pts = hp_mod.grid_points(space)
+        return pts[:n_sampling] if n_sampling else pts

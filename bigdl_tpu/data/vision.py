@@ -1,0 +1,235 @@
+"""Vision pipeline: ImageFrame + augmentation DSL.
+
+Reference analog (unverified — mount empty): ``dllib/feature/transform/
+vision/image/{ImageFrame,ImageFeature,MatToTensor}.scala`` and
+``augmentation/{Resize,CenterCrop,RandomCrop,HFlip,ChannelNormalize}.scala``
+— an OpenCV-JNI-backed augmentation DSL over local or RDD image
+collections (SURVEY.md §3.1).
+
+TPU-native redesign: augmentations are host-CPU work (as in the
+reference); the hot loops run in the native C++ library
+(``bigdl_tpu.native``, threaded) with numpy fallbacks, and
+``ImageFrameToBatches`` fuses resize→crop→flip→normalize into ONE
+threaded pass per minibatch that writes straight into the contiguous
+NHWC float32 batch handed to the device.
+"""
+
+import math
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu import native
+from bigdl_tpu.data.dataset import MiniBatch
+from bigdl_tpu.data.transformer import Transformer
+
+
+class ImageFeature(dict):
+    """One image + metadata — reference ``ImageFeature.scala`` (a typed
+    hashmap with well-known keys)."""
+
+    KEY_IMAGE = "image"      # uint8 HWC
+    KEY_LABEL = "label"
+    KEY_URI = "uri"
+
+    def __init__(self, image=None, label=None, uri=None, **kw):
+        super().__init__(**kw)
+        if image is not None:
+            self[self.KEY_IMAGE] = np.asarray(image, np.uint8)
+        if label is not None:
+            self[self.KEY_LABEL] = label
+        if uri is not None:
+            self[self.KEY_URI] = uri
+
+    @property
+    def image(self) -> np.ndarray:
+        return self[self.KEY_IMAGE]
+
+    @image.setter
+    def image(self, v):
+        self[self.KEY_IMAGE] = v
+
+    @property
+    def label(self):
+        return self.get(self.KEY_LABEL)
+
+
+class ImageFrame:
+    """Local collection of ImageFeatures — reference ``ImageFrame.scala``
+    (``LocalImageFrame``; the distributed twin is an XShards of frames —
+    see ``bigdl_tpu/data/shards.py``)."""
+
+    def __init__(self, features: Sequence[ImageFeature]):
+        self.features: List[ImageFeature] = list(features)
+
+    @staticmethod
+    def from_arrays(images, labels=None) -> "ImageFrame":
+        labels = labels if labels is not None else [None] * len(images)
+        return ImageFrame([ImageFeature(im, lb)
+                           for im, lb in zip(images, labels)])
+
+    def transform(self, transformer: Transformer) -> "ImageFrame":
+        return ImageFrame(list(transformer(iter(self.features))))
+
+    def __len__(self):
+        return len(self.features)
+
+    def __iter__(self):
+        return iter(self.features)
+
+
+class _PerImage(Transformer):
+    def apply(self, it: Iterator) -> Iterator:
+        return (self.transform_one(f) for f in it)
+
+    def transform_one(self, f: ImageFeature) -> ImageFeature:
+        raise NotImplementedError
+
+
+class Resize(_PerImage):
+    """Bilinear resize — reference ``augmentation/Resize.scala``."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform_one(self, f):
+        f.image = native.resize_bilinear(f.image, self.height, self.width)
+        return f
+
+
+class ResizeShortSide(_PerImage):
+    """Resize so the short side equals ``size`` (aspect preserved) —
+    the reference ImageNet eval transform (``Resize(256) then crop``)."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def transform_one(self, f):
+        h, w, _ = f.image.shape
+        s = self.size / min(h, w)
+        f.image = native.resize_bilinear(
+            f.image, max(self.size, int(round(h * s))),
+            max(self.size, int(round(w * s))))
+        return f
+
+
+class CenterCrop(_PerImage):
+    """Reference ``augmentation/CenterCrop.scala``."""
+
+    def __init__(self, height: int, width: int):
+        self.height, self.width = height, width
+
+    def transform_one(self, f):
+        h, w, _ = f.image.shape
+        oy = max(0, (h - self.height) // 2)
+        ox = max(0, (w - self.width) // 2)
+        f.image = native.crop(f.image, oy, ox, self.height, self.width)
+        return f
+
+
+class RandomCrop(_PerImage):
+    """Reference ``augmentation/RandomCrop.scala``."""
+
+    def __init__(self, height: int, width: int, seed: Optional[int] = None):
+        self.height, self.width = height, width
+        self.rng = np.random.default_rng(seed)
+
+    def transform_one(self, f):
+        h, w, _ = f.image.shape
+        oy = int(self.rng.integers(0, max(1, h - self.height + 1)))
+        ox = int(self.rng.integers(0, max(1, w - self.width + 1)))
+        f.image = native.crop(f.image, oy, ox, self.height, self.width)
+        return f
+
+
+class HFlip(_PerImage):
+    """Random horizontal flip — reference ``augmentation/HFlip.scala``
+    (there unconditional; probability matches ``RandomTransformer(HFlip, p)``
+    usage)."""
+
+    def __init__(self, p: float = 0.5, seed: Optional[int] = None):
+        self.p = p
+        self.rng = np.random.default_rng(seed)
+
+    def transform_one(self, f):
+        if self.rng.random() < self.p:
+            f.image = native.hflip(f.image)
+        return f
+
+
+class ChannelNormalize(_PerImage):
+    """uint8 → float32 (x/255 − mean)/std — reference
+    ``augmentation/ChannelNormalize.scala`` (note: the reference operates on
+    0-255 floats; here the conventional 0-1 scale, stated explicitly)."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def transform_one(self, f):
+        f.image = native.normalize(f.image, self.mean, self.std)
+        return f
+
+
+class MatToTensor(_PerImage):
+    """Terminal stage: ensure float32 NHWC array — reference
+    ``MatToTensor.scala`` (OpenCV Mat → Tensor; here a dtype/shape check)."""
+
+    def transform_one(self, f):
+        f.image = np.asarray(f.image, np.float32)
+        return f
+
+
+class ImageFrameToBatches:
+    """Fused batch producer: one threaded native pass per minibatch doing
+    resize→crop→flip→normalize into a contiguous (n, H, W, C) float32 batch.
+
+    Reference analog: the transformer chain + ``SampleToMiniBatch`` copy,
+    executed by the per-core ThreadPool (SURVEY.md §4.1 task body)."""
+
+    def __init__(self, out_hw: Tuple[int, int], mean, std,
+                 resize_hw: Optional[Tuple[int, int]] = None,
+                 random_crop: bool = False, random_flip: bool = False,
+                 seed: Optional[int] = None,
+                 num_threads: Optional[int] = None):
+        self.out_hw = out_hw
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.resize_hw = resize_hw
+        self.random_crop = random_crop
+        self.random_flip = random_flip
+        self.rng = np.random.default_rng(seed)
+        self.pipeline = native.BatchPipeline(num_threads)
+
+    def __call__(self, frame: ImageFrame, batch_size: int,
+                 shuffle: bool = False, drop_last: bool = True
+                 ) -> Iterator[MiniBatch]:
+        n = len(frame)
+        order = np.arange(n)
+        if shuffle:
+            self.rng.shuffle(order)
+        stop = n - batch_size + 1 if drop_last else n
+        for s in range(0, max(stop, 0), batch_size):
+            idx = order[s:s + batch_size]
+            feats = [frame.features[i] for i in idx]
+            images = [f.image for f in feats]
+            oh, ow = self.out_hw
+            crops, flips = [], None
+            for im in images:
+                h, w = ((self.resize_hw or im.shape[:2]))
+                if self.random_crop:
+                    crops.append((
+                        int(self.rng.integers(0, max(1, h - oh + 1))),
+                        int(self.rng.integers(0, max(1, w - ow + 1)))))
+                else:
+                    crops.append((max(0, (h - oh) // 2),
+                                  max(0, (w - ow) // 2)))
+            if self.random_flip:
+                flips = self.rng.random(len(images)) < 0.5
+            batch = self.pipeline.process_batch(
+                images, self.out_hw, self.mean, self.std,
+                resize_hw=self.resize_hw, crops=crops, flips=flips)
+            labels = [f.label for f in feats]
+            target = (np.asarray(labels)
+                      if all(l is not None for l in labels) else None)
+            yield MiniBatch(input=batch, target=target)

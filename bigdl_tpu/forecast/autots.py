@@ -1,0 +1,167 @@
+"""AutoTS — automatic time-series model selection + HPO.
+
+Reference analog (unverified — mount empty): ``python/chronos/src/bigdl/
+chronos/autots/{autotsestimator,tspipeline}.py`` (SURVEY.md §3.3):
+``AutoTSEstimator.fit(tsdata)`` searches lookback + model hyperparams via
+orca.automl and returns a ``TSPipeline`` bundling preprocessing state with
+the best trained forecaster.
+
+TPU-native: searches with ``bigdl_tpu.automl`` (sequential in-process
+trials — see that package's docstring), forecasters from
+``bigdl_tpu.forecast.forecaster``.
+"""
+
+import os
+import pickle
+from typing import Any, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.automl import hp as hp_mod
+from bigdl_tpu.automl.search import RandomSearcher
+from bigdl_tpu.forecast import forecaster as F
+from bigdl_tpu.forecast.tsdataset import TSDataset
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+_MODEL_TABLE = {
+    "tcn": F.TCNForecaster,
+    "lstm": F.LSTMForecaster,
+    "seq2seq": F.Seq2SeqForecaster,
+    "nbeats": F.NBeatsForecaster,
+    "autoformer": F.AutoformerForecaster,
+}
+
+# hyperparameter names each forecaster constructor accepts (beyond the
+# base past/future/in/out/lr set)
+_MODEL_KWARGS = {
+    "tcn": {"num_channels", "kernel_size", "dropout"},
+    "lstm": {"hidden_dim", "layer_num", "dropout"},
+    "seq2seq": {"lstm_hidden_dim"},
+    "nbeats": {"stacks", "blocks_per_stack", "hidden_units"},
+    "autoformer": {"d_model", "n_heads", "e_layers", "d_layers", "d_ff",
+                   "moving_avg"},
+}
+
+
+class TSPipeline:
+    """Preprocessing state + trained forecaster — reference
+    ``chronos/autots/tspipeline.py``."""
+
+    def __init__(self, forecaster: F.BaseForecaster, lookback: int,
+                 horizon: int, scaler=None, best_config: Optional[Dict] = None):
+        self.forecaster = forecaster
+        self.lookback = lookback
+        self.horizon = horizon
+        self.scaler = scaler
+        self.best_config = best_config or {}
+
+    def _rolled(self, data):
+        if isinstance(data, TSDataset):
+            if self.scaler is not None and data.scaler is None:
+                data = data.scale(self.scaler, fit=False)
+            return data.roll(self.lookback, self.horizon).to_numpy()
+        return data
+
+    def fit(self, data, epochs: int = 5, batch_size: int = 32) -> "TSPipeline":
+        """Incremental fit on new data (reference: TSPipeline.fit)."""
+        x, y = self._rolled(data)
+        self.forecaster.fit((x, y), epochs=epochs, batch_size=batch_size)
+        return self
+
+    def predict(self, data, batch_size: int = 0) -> np.ndarray:
+        if isinstance(data, TSDataset):
+            x, _ = self._rolled(data)
+        else:
+            x = np.asarray(data, np.float32)
+        return self.forecaster.predict(x, batch_size)
+
+    def evaluate(self, data, metrics: Sequence[str] = ("mse",),
+                 batch_size: int = 32) -> Dict[str, float]:
+        x, y = self._rolled(data)
+        return self.forecaster.evaluate((x, y), metrics, batch_size)
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.forecaster.save(os.path.join(path, "forecaster"))
+        with open(os.path.join(path, "pipeline.pkl"), "wb") as f:
+            pickle.dump({
+                "lookback": self.lookback, "horizon": self.horizon,
+                "scaler": self.scaler, "best_config": self.best_config,
+                "forecaster_cls": type(self.forecaster).__name__,
+                "forecaster_args": self.forecaster._init_args,
+            }, f)
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        with open(os.path.join(path, "pipeline.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        cls = getattr(F, meta["forecaster_cls"])
+        fc = cls(**meta["forecaster_args"])
+        fc.load(os.path.join(path, "forecaster"))
+        return TSPipeline(fc, meta["lookback"], meta["horizon"],
+                          meta["scaler"], meta["best_config"])
+
+
+class AutoTSEstimator:
+    """Reference ``chronos/autots/autotsestimator.py``:
+    ``AutoTSEstimator(model="tcn", search_space=…).fit(tsdata)`` →
+    TSPipeline."""
+
+    def __init__(self, model: str = "tcn",
+                 search_space: Optional[Dict[str, Any]] = None,
+                 past_seq_len: Union[int, hp_mod.Sampler] = 24,
+                 future_seq_len: int = 1,
+                 metric: str = "mse", mode: str = "min", seed: int = 0):
+        if model not in _MODEL_TABLE:
+            raise ValueError(f"model {model!r}; one of {sorted(_MODEL_TABLE)}")
+        self.model = model
+        self.search_space = dict(search_space or {})
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.metric = metric
+        self.mode = mode
+        self.seed = seed
+        self.best_result = None
+
+    def fit(self, data: TSDataset, validation_data: Optional[TSDataset] = None,
+            epochs: int = 3, batch_size: int = 32, n_sampling: int = 4
+            ) -> TSPipeline:
+        space = dict(self.search_space)
+        space["past_seq_len"] = self.past_seq_len
+        searcher = RandomSearcher(mode=self.mode, seed=self.seed)
+        cls = _MODEL_TABLE[self.model]
+        allowed = _MODEL_KWARGS[self.model]
+        n_feat = len(data.feature_cols) + len(data.target_cols)
+        n_target = len(data.target_cols)
+        val = validation_data or data
+
+        def trial(config):
+            lookback = int(config["past_seq_len"])
+            kwargs = {k: v for k, v in config.items() if k in allowed}
+            args = dict(past_seq_len=lookback,
+                        future_seq_len=self.future_seq_len,
+                        input_feature_num=n_feat,
+                        output_feature_num=n_target,
+                        lr=float(config.get("lr", 1e-3)), **kwargs)
+            fc = cls(**args)
+            x, y = data.roll(lookback, self.future_seq_len).to_numpy()
+            fc.fit((x, y), epochs=int(config.get("epochs", epochs)),
+                   batch_size=int(config.get("batch_size", batch_size)))
+            vx, vy = val.roll(lookback, self.future_seq_len).to_numpy()
+            res = fc.evaluate((vx, vy), metrics=[self.metric])
+            return float(res[self.metric]), fc
+
+        self.best_result = searcher.run(trial, space, n_sampling)
+        best_fc = self.best_result.artifacts
+        log.info("AutoTS best %s=%.6f config=%s", self.metric,
+                 self.best_result.metric, self.best_result.config)
+        return TSPipeline(best_fc, best_fc.lookback, self.future_seq_len,
+                          scaler=data.scaler,
+                          best_config=self.best_result.config)
+
+    def get_best_config(self) -> Dict[str, Any]:
+        if self.best_result is None:
+            raise RuntimeError("call fit() first")
+        return self.best_result.config

@@ -36,6 +36,29 @@ def _as_xy(data, lookback, horizon) -> Tuple[np.ndarray, np.ndarray]:
 class BaseForecaster:
     """fit/predict/evaluate lifecycle shared by every forecaster."""
 
+    def __init_subclass__(cls, **kw):
+        # Record every concrete forecaster's constructor arguments as
+        # self._init_args so save()/TSPipeline.save() can rebuild the exact
+        # model on load without each subclass having to remember to do it.
+        super().__init_subclass__(**kw)
+        import functools
+        import inspect
+
+        orig = cls.__init__
+
+        @functools.wraps(orig)
+        def wrapped(self, *args, **kwargs):
+            if not hasattr(self, "_init_args"):
+                ba = inspect.signature(orig).bind(self, *args, **kwargs)
+                ba.apply_defaults()
+                d = dict(ba.arguments)
+                d.pop("self", None)
+                d.update(d.pop("kw", None) or {})
+                self._init_args = d
+            orig(self, *args, **kwargs)
+
+        cls.__init__ = wrapped
+
     def __init__(self, past_seq_len: int, future_seq_len: int,
                  input_feature_num: int, output_feature_num: int,
                  optimizer: Optional[object] = None, lr: float = 1e-3,

@@ -1,0 +1,15 @@
+"""Friesian-equivalent: recsys feature engineering.
+
+Reference analog (unverified — mount empty): ``python/friesian/src/bigdl/
+friesian/feature/table.py`` (SURVEY.md §3.3) — ``FeatureTable`` over a
+Spark DataFrame with categorical encoding, cross features, negative
+sampling, and history-sequence building for two-tower/DIEN-style models.
+
+TPU-native redesign: pandas-backed (one table = one host's shard; the
+distributed twin is an XShards of tables), producing dense numpy arrays
+ready for ``Embedding``-based models on the mesh.
+"""
+
+from bigdl_tpu.friesian.table import FeatureTable, StringIndex
+
+__all__ = ["FeatureTable", "StringIndex"]

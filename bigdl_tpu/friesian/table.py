@@ -1,0 +1,181 @@
+"""FeatureTable — reference ``friesian/feature/table.py`` (one large
+class of Spark-DF feature ops).  Pandas-backed; see package docstring."""
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+
+class StringIndex:
+    """Category → contiguous id mapping — reference ``StringIndex`` (a
+    (value, id) DataFrame per column).  Id 0 is reserved for unseen/OOV
+    (the reference starts ids at 1 for the same reason)."""
+
+    def __init__(self, mapping: Dict, col_name: str):
+        self.mapping = mapping
+        self.col_name = col_name
+
+    @property
+    def size(self) -> int:
+        """Vocabulary size including the OOV slot."""
+        return len(self.mapping) + 1
+
+    def encode(self, values) -> np.ndarray:
+        return np.asarray([self.mapping.get(v, 0) for v in values], np.int64)
+
+    def to_frame(self) -> pd.DataFrame:
+        return pd.DataFrame({self.col_name: list(self.mapping),
+                             "id": list(self.mapping.values())})
+
+
+class FeatureTable:
+    def __init__(self, df: pd.DataFrame):
+        self.df = df
+
+    @staticmethod
+    def from_pandas(df: pd.DataFrame) -> "FeatureTable":
+        return FeatureTable(df.copy())
+
+    # -- basic relational ops (reference mirrors Spark DF) ------------------
+    def select(self, *cols: str) -> "FeatureTable":
+        return FeatureTable(self.df[list(cols)].copy())
+
+    def filter(self, mask) -> "FeatureTable":
+        return FeatureTable(self.df[mask(self.df)
+                                    if callable(mask) else mask].copy())
+
+    def rename(self, columns: Dict[str, str]) -> "FeatureTable":
+        return FeatureTable(self.df.rename(columns=columns))
+
+    def drop(self, *cols: str) -> "FeatureTable":
+        return FeatureTable(self.df.drop(columns=list(cols)))
+
+    def join(self, other: "FeatureTable", on: Union[str, List[str]],
+             how: str = "inner") -> "FeatureTable":
+        return FeatureTable(self.df.merge(other.df, on=on, how=how))
+
+    def __len__(self):
+        return len(self.df)
+
+    # -- missing values ------------------------------------------------------
+    def fillna(self, value, columns: Optional[Sequence[str]] = None
+               ) -> "FeatureTable":
+        df = self.df.copy()
+        cols = list(columns) if columns else df.columns
+        df[cols] = df[cols].fillna(value)
+        return FeatureTable(df)
+
+    # -- categorical encoding -----------------------------------------------
+    def gen_string_idx(self, columns: Union[str, Sequence[str]],
+                       freq_limit: int = 0
+                       ) -> Union[StringIndex, List[StringIndex]]:
+        """Build category→id maps (most frequent first, ids start at 1) —
+        reference ``gen_string_idx`` (with ``freq_limit`` pruning)."""
+        single = isinstance(columns, str)
+        cols = [columns] if single else list(columns)
+        out = []
+        for c in cols:
+            vc = self.df[c].value_counts()
+            if freq_limit:
+                vc = vc[vc >= freq_limit]
+            mapping = {v: i + 1 for i, v in enumerate(vc.index)}
+            out.append(StringIndex(mapping, c))
+        return out[0] if single else out
+
+    def encode_string(self, columns: Union[str, Sequence[str]],
+                      indices: Union[StringIndex, Sequence[StringIndex]]
+                      ) -> "FeatureTable":
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        idxs = [indices] if isinstance(indices, StringIndex) else list(indices)
+        df = self.df.copy()
+        for c, ix in zip(cols, idxs):
+            df[c] = ix.encode(df[c].to_numpy())
+        return FeatureTable(df)
+
+    def category_encode(self, columns: Union[str, Sequence[str]],
+                        freq_limit: int = 0):
+        """gen_string_idx + encode_string in one step (reference name)."""
+        idx = self.gen_string_idx(columns, freq_limit)
+        return self.encode_string(columns, idx), idx
+
+    # -- numeric features -----------------------------------------------------
+    def min_max_scale(self, columns: Union[str, Sequence[str]]
+                      ) -> Tuple["FeatureTable", Dict[str, Tuple[float, float]]]:
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        df = self.df.copy()
+        stats = {}
+        for c in cols:
+            lo, hi = float(df[c].min()), float(df[c].max())
+            stats[c] = (lo, hi)
+            df[c] = (df[c] - lo) / (hi - lo) if hi > lo else 0.0
+        return FeatureTable(df), stats
+
+    def cross_columns(self, cross_cols: Sequence[Sequence[str]],
+                      bucket_sizes: Sequence[int]) -> "FeatureTable":
+        """Hashed cross features — reference ``cross_columns``."""
+        df = self.df.copy()
+        for cols, size in zip(cross_cols, bucket_sizes):
+            name = "_".join(cols)
+            joined = df[list(cols)].astype(str).agg("_".join, axis=1)
+            df[name] = (pd.util.hash_array(joined.to_numpy())
+                        % np.uint64(size)).astype(np.int64)
+        return FeatureTable(df)
+
+    # -- sequence features ----------------------------------------------------
+    def add_hist_seq(self, user_col: str, cols: Sequence[str],
+                     sort_col: str, min_len: int = 1, max_len: int = 10
+                     ) -> "FeatureTable":
+        """Per-user trailing history of ``cols`` (padded left with 0) —
+        reference ``add_hist_seq`` for DIEN/two-tower."""
+        df = self.df.sort_values([user_col, sort_col]).copy()
+        for c in cols:
+            hists = []
+            for _, g in df.groupby(user_col, sort=False):
+                v = g[c].to_numpy()
+                for i in range(len(v)):
+                    h = v[max(0, i - max_len):i]
+                    hists.append(h if len(h) >= min_len else None)
+            df[f"{c}_hist_seq"] = hists
+        df = df[df[[f"{c}_hist_seq" for c in cols]].notna().all(axis=1)]
+        for c in cols:
+            col = f"{c}_hist_seq"
+            df[col] = df[col].map(
+                lambda h: np.pad(np.asarray(h, np.int64),
+                                 (max_len - len(h), 0)))
+        return FeatureTable(df)
+
+    def add_negative_samples(self, item_size: int, item_col: str = "item",
+                             label_col: str = "label", neg_num: int = 1,
+                             seed: int = 0) -> "FeatureTable":
+        """Append neg_num random-item negatives per positive row —
+        reference ``add_negative_samples`` (items are 1-indexed ids)."""
+        rng = np.random.default_rng(seed)
+        pos = self.df.copy()
+        pos[label_col] = 1
+        negs = []
+        for _ in range(neg_num):
+            n = pos.copy()
+            rand = rng.integers(1, item_size + 1, len(n))
+            # re-draw collisions with the positive item
+            clash = rand == pos[item_col].to_numpy()
+            while clash.any():
+                rand[clash] = rng.integers(1, item_size + 1, int(clash.sum()))
+                clash = rand == pos[item_col].to_numpy()
+            n[item_col] = rand
+            n[label_col] = 0
+            negs.append(n)
+        return FeatureTable(pd.concat([pos] + negs, ignore_index=True))
+
+    # -- export ---------------------------------------------------------------
+    def to_numpy(self, columns: Sequence[str]) -> List[np.ndarray]:
+        out = []
+        for c in columns:
+            v = self.df[c].to_numpy()
+            if len(v) and isinstance(v[0], np.ndarray):
+                v = np.stack(v)
+            out.append(v)
+        return out
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self.df.copy()

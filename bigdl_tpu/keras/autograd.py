@@ -1,0 +1,93 @@
+"""Keras autograd — custom layers and losses from ops.
+
+Reference analog (unverified — mount empty): ``dllib/keras/autograd/
+{Variable,AutoGrad,CustomLoss}.scala`` (SURVEY.md §3.1): a mini symbolic
+op set over ``Variable`` nodes so users can define layers/losses without
+writing a Scala ``backward`` — the reference needs this machinery because
+its nn core has NO autodiff.
+
+TPU-native: JAX *is* the autograd, so this module is thin sugar:
+- the op set (``add/mul/square/exp/clip/mean/…``) builds keras graph
+  ``Node``s via ``Lambda`` modules, usable directly in ``Model(in, out)``;
+- ``CustomLoss`` wraps any jnp function ``(y_true, y_pred) -> scalar`` as
+  a ``Criterion`` for ``compile(loss=…)``; the gradient comes from
+  ``jax.grad`` over the whole train step.
+"""
+
+import jax.numpy as jnp
+
+from bigdl_tpu.keras.engine import Node
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.nn.module import Lambda
+
+
+def _wrap(fn, name):
+    """Lift a jnp function over Nodes/constants into a graph Node (or apply
+    eagerly when called with arrays)."""
+
+    def op(*args, **kw):
+        if any(isinstance(a, Node) for a in args):
+            nodes = [a for a in args if isinstance(a, Node)]
+            consts = [(i, a) for i, a in enumerate(args)
+                      if not isinstance(a, Node)]
+
+            def run(*xs):
+                full = list(xs)
+                for i, c in consts:
+                    full.insert(i, c)
+                return fn(*full, **kw)
+
+            return Lambda(run, name=name)(nodes if len(nodes) > 1
+                                          else nodes[0])
+        return fn(*args, **kw)
+
+    op.__name__ = name
+    return op
+
+
+# -- reference AutoGrad op set ------------------------------------------------
+add = _wrap(lambda a, b: a + b, "add")
+sub = _wrap(lambda a, b: a - b, "sub")
+mul = _wrap(lambda a, b: a * b, "mul")
+div = _wrap(lambda a, b: a / b, "div")
+neg = _wrap(lambda a: -a, "neg")
+abs = _wrap(jnp.abs, "abs")  # noqa: A001 — reference name
+square = _wrap(jnp.square, "square")
+sqrt = _wrap(jnp.sqrt, "sqrt")
+exp = _wrap(jnp.exp, "exp")
+log = _wrap(jnp.log, "log")
+pow = _wrap(jnp.power, "pow")  # noqa: A001 — reference name
+maximum = _wrap(jnp.maximum, "maximum")
+minimum = _wrap(jnp.minimum, "minimum")
+clip = _wrap(jnp.clip, "clip")
+sum = _wrap(jnp.sum, "sum")  # noqa: A001 — reference name
+mean = _wrap(jnp.mean, "mean")
+softsign = _wrap(lambda a: a / (1 + jnp.abs(a)), "softsign")
+softplus = _wrap(lambda a: jnp.logaddexp(a, 0.0), "softplus")
+dot = _wrap(lambda a, b: jnp.matmul(a, b), "dot")
+stack = _wrap(lambda *xs, axis=0: jnp.stack(xs, axis=axis), "stack")
+concatenate = _wrap(lambda *xs, axis=-1: jnp.concatenate(xs, axis=axis),
+                    "concatenate")
+expand_dims = _wrap(jnp.expand_dims, "expand_dims")
+squeeze = _wrap(jnp.squeeze, "squeeze")
+
+
+class CustomLoss(Criterion):
+    """Wrap ``fn(y_true, y_pred) -> scalar`` as a criterion — reference
+    ``CustomLoss.scala`` (there it builds a Variable graph; here the
+    function IS differentiable already)."""
+
+    def __init__(self, loss_fn, name: str = "custom_loss"):
+        self.loss_fn = loss_fn
+        self.name = name
+
+    def forward(self, input, target):
+        return self.loss_fn(target, input)
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred - y_true))

@@ -1,0 +1,19 @@
+"""Nano-equivalent: single-device inference acceleration.
+
+Reference analog (unverified — mount empty): ``python/nano/src/bigdl/
+nano/`` (SURVEY.md §2 L12): ``InferenceOptimizer.quantize/trace`` compiles
+a trained model onto faster runtimes (ONNXRuntime / OpenVINO / INC int8)
+and ``.optimize()`` benchmarks every variant and picks the winner;
+``nano.pytorch.Trainer`` accelerates single-node training.
+
+TPU-native redesign: the "runtimes" are XLA execution modes of the SAME
+model — fp32 jit, bf16-compute jit, int8 Pallas-kernel quantization
+(``bigdl_tpu.nn.quantized``) — so ``trace``/``quantize``/``optimize``
+keep the reference surface without foreign-runtime exports.  (Training
+acceleration is native to the core stack: the Optimizer already jits,
+shards, and runs bf16 — a separate Trainer wrapper would be vestigial.)
+"""
+
+from bigdl_tpu.nano.inference import InferenceOptimizer, TracedModel
+
+__all__ = ["InferenceOptimizer", "TracedModel"]

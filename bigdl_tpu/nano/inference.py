@@ -1,0 +1,149 @@
+"""InferenceOptimizer — reference ``nano/pytorch/InferenceOptimizer``
+(trace/quantize/optimize/get_best_model).  See package docstring."""
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class TracedModel:
+    """An AOT-compiled forward with fixed input shape — the analog of a
+    traced/exported inference artifact."""
+
+    def __init__(self, fn: Callable, variables: Dict, sample: np.ndarray,
+                 precision: str):
+        self.precision = precision
+        self._params = variables.get("params", {})
+        self._state = variables.get("state", {})
+        self._compiled = (
+            jax.jit(fn)
+            .lower(self._params, self._state, jnp.asarray(sample))
+            .compile())
+        self._shape = tuple(sample.shape)
+
+    def __call__(self, x) -> np.ndarray:
+        x = jnp.asarray(x)
+        if tuple(x.shape) != self._shape:
+            raise ValueError(
+                f"traced for input shape {self._shape}, got {tuple(x.shape)}"
+                " — re-trace for new shapes (AOT artifacts are shape-fixed)")
+        return self._compiled(self._params, self._state, x)
+
+
+def _forward_fn(model, cast=None):
+    def fn(params, state, x):
+        if cast is not None:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(cast)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+            x = x.astype(cast) if jnp.issubdtype(x.dtype,
+                                                 jnp.floating) else x
+        out, _ = model.forward(params, state, x, training=False)
+        return out
+
+    return fn
+
+
+class InferenceOptimizer:
+    """trace / quantize / optimize-and-pick — reference
+    ``InferenceOptimizer`` surface."""
+
+    # variants benchmarked by optimize(); name -> builder
+    @staticmethod
+    def trace(model, variables, sample, precision: str = "fp32"
+              ) -> TracedModel:
+        """AOT-compile the forward.  precision: fp32 | bf16."""
+        cast = {"fp32": None, "bf16": jnp.bfloat16}[precision]
+        return TracedModel(_forward_fn(model, cast), variables,
+                           np.asarray(sample), precision)
+
+    @staticmethod
+    def quantize(model, variables, sample=None, precision: str = "int8",
+                 calib_data=None) -> TracedModel:
+        """Post-training quantization.  precision: int8 | bf16.
+        (calib_data accepted for reference parity; abs-max calibration is
+        weight-driven so it is unused.)"""
+        if sample is None:
+            raise ValueError("quantize needs a sample input for tracing")
+        if precision == "bf16":
+            return InferenceOptimizer.trace(model, variables, sample, "bf16")
+        if precision != "int8":
+            raise ValueError(f"precision {precision!r}: int8 or bf16")
+        from bigdl_tpu.nn.quantized import quantize as quantize_module
+
+        q_model, q_vars = quantize_module(model, variables)
+        return TracedModel(_forward_fn(q_model), q_vars, np.asarray(sample),
+                           "int8")
+
+    @staticmethod
+    def optimize(model, variables, sample, *,
+                 methods: Tuple[str, ...] = ("fp32", "bf16", "int8"),
+                 repeats: int = 10,
+                 accuracy_fn: Optional[Callable] = None,
+                 accuracy_budget: float = 0.02) -> "OptimizedResult":
+        """Benchmark every variant on ``sample`` and rank by latency —
+        reference ``InferenceOptimizer.optimize`` + ``get_best_model``.
+
+        accuracy_fn(outputs) -> float score (higher better); variants whose
+        score drops more than ``accuracy_budget`` below fp32 are rejected."""
+        sample = np.asarray(sample)
+        results: Dict[str, Dict[str, Any]] = {}
+        baseline_score = None
+        for name in methods:
+            try:
+                if name in ("fp32", "bf16"):
+                    tm = InferenceOptimizer.trace(model, variables, sample,
+                                                  name)
+                else:
+                    tm = InferenceOptimizer.quantize(model, variables, sample,
+                                                     name)
+                out = jax.block_until_ready(tm(sample))  # warmup
+                t0 = time.perf_counter()
+                for _ in range(repeats):
+                    out = tm(sample)
+                jax.block_until_ready(out)
+                lat = (time.perf_counter() - t0) / repeats
+                score = (float(accuracy_fn(np.asarray(out)))
+                         if accuracy_fn else None)
+                if name == "fp32":
+                    baseline_score = score
+                results[name] = {"model": tm, "latency_s": lat,
+                                 "score": score, "status": "ok"}
+            except Exception as e:  # noqa: BLE001 — a variant failing to build is a result
+                results[name] = {"model": None, "latency_s": float("inf"),
+                                 "score": None, "status": f"failed: {e}"}
+        if baseline_score is not None:
+            for name, r in results.items():
+                if (r["status"] == "ok" and r["score"] is not None
+                        and r["score"] < baseline_score - accuracy_budget):
+                    r["status"] = "accuracy_drop"
+        return OptimizedResult(results)
+
+
+class OptimizedResult:
+    def __init__(self, results: Dict[str, Dict[str, Any]]):
+        self.results = results
+
+    def get_best_model(self) -> Tuple[TracedModel, str]:
+        ok = {k: v for k, v in self.results.items() if v["status"] == "ok"}
+        if not ok:
+            raise RuntimeError(f"no variant succeeded: "
+                               f"{ {k: v['status'] for k, v in self.results.items()} }")
+        name = min(ok, key=lambda k: ok[k]["latency_s"])
+        return ok[name]["model"], name
+
+    def summary(self) -> str:
+        lines = [f"{'method':8} {'latency(ms)':>12} {'score':>8} status"]
+        for k, v in self.results.items():
+            lat = ("inf" if v["latency_s"] == float("inf")
+                   else f"{v['latency_s'] * 1e3:.3f}")
+            sc = "-" if v["score"] is None else f"{v['score']:.4f}"
+            lines.append(f"{k:8} {lat:>12} {sc:>8} {v['status']}")
+        return "\n".join(lines)

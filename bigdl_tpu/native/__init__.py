@@ -1,0 +1,18 @@
+"""ctypes bindings for the native host-side IO/vision library.
+
+Reference analog: the JNI façade classes (``com.intel.analytics.bigdl.
+opencv.OpenCV``, ``mkl.MKL`` — SURVEY.md §3.2) that expose BigDL-core's
+``.so`` to the JVM.  Here: a C ABI (``native/bigdl_tpu_io.cpp``) compiled
+on first use with the system g++ and loaded via ctypes; every entry point
+has a pure-numpy fallback so the package works where no toolchain exists
+(mirroring the reference's pure-JVM fallback when MKL is absent).
+
+Public surface: ``available()``, ``resize_bilinear``, ``normalize``,
+``hflip``, ``crop``, ``BatchPipeline`` (threaded transform→assemble).
+"""
+
+from bigdl_tpu.native.lib import (BatchPipeline, available, crop, hflip,
+                                  normalize, resize_bilinear)
+
+__all__ = ["available", "resize_bilinear", "normalize", "hflip", "crop",
+           "BatchPipeline"]

@@ -1,0 +1,263 @@
+"""Build-on-first-use loader + ctypes wrappers + numpy fallbacks."""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "bigdl_tpu_io.cpp")
+_CACHE_DIR = os.environ.get(
+    "BIGDL_TPU_NATIVE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "bigdl_tpu"))
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_SRC):
+        return None
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so = os.path.join(_CACHE_DIR, "libbigdl_tpu_io.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(_SRC)):
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               "-march=native", "-o", so + ".tmp", _SRC, "-lpthread"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(so + ".tmp", so)
+        except (subprocess.SubprocessError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    # signatures
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.btio_resize_bilinear_u8.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int,
+        ctypes.c_int]
+    lib.btio_crop_u8.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, u8p, ctypes.c_int, ctypes.c_int]
+    lib.btio_hflip_u8.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
+    lib.btio_normalize_f32.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p, f32p, f32p]
+    lib.btio_pipeline_create.argtypes = [ctypes.c_int]
+    lib.btio_pipeline_create.restype = ctypes.c_void_p
+    lib.btio_pipeline_destroy.argtypes = [ctypes.c_void_p]
+    lib.btio_process_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(u8p), i32p, i32p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p, f32p, f32p]
+    lib.btio_gather_rows_f32.argtypes = [
+        ctypes.c_void_p, f32p, i64p, ctypes.c_int, ctypes.c_int64, f32p]
+    lib.btio_version.restype = ctypes.c_int
+    if lib.btio_version() != 1:
+        return None
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _build_and_load()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def _u8p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+# ---------------------------------------------------------------------------
+# Single-image ops (uint8 HWC)
+# ---------------------------------------------------------------------------
+
+def resize_bilinear(img: np.ndarray, oh: int, ow: int) -> np.ndarray:
+    img = np.ascontiguousarray(img, np.uint8)
+    h, w, c = img.shape
+    if (h, w) == (oh, ow):
+        return img
+    lib = _get()
+    if lib is not None:
+        out = np.empty((oh, ow, c), np.uint8)
+        lib.btio_resize_bilinear_u8(_u8p(img), h, w, c, _u8p(out), oh, ow)
+        return out
+    # numpy fallback (same align-corners-style sampling as the C path)
+    ys = (np.linspace(0, h - 1, oh) if oh > 1 else np.zeros(1))
+    xs = (np.linspace(0, w - 1, ow) if ow > 1 else np.zeros(1))
+    y0 = ys.astype(np.int64)
+    x0 = xs.astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    f = img.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    return np.rint(top * (1 - wy) + bot * wy).astype(np.uint8)
+
+
+def _check_crop(h, w, oy, ox, ch, cw):
+    if oy < 0 or ox < 0 or oy + ch > h or ox + cw > w:
+        raise ValueError(
+            f"crop ({ch}x{cw} at {oy},{ox}) out of bounds for {h}x{w} image"
+            " — resize up first (the C path would read out of bounds)")
+
+
+def crop(img: np.ndarray, oy: int, ox: int, ch: int, cw: int) -> np.ndarray:
+    img = np.ascontiguousarray(img, np.uint8)
+    h, w, c = img.shape
+    _check_crop(h, w, oy, ox, ch, cw)
+    lib = _get()
+    if lib is not None:
+        out = np.empty((ch, cw, c), np.uint8)
+        lib.btio_crop_u8(_u8p(img), h, w, c, oy, ox, _u8p(out), ch, cw)
+        return out
+    return img[oy:oy + ch, ox:ox + cw].copy()
+
+
+def hflip(img: np.ndarray) -> np.ndarray:
+    img = np.ascontiguousarray(img, np.uint8).copy()
+    lib = _get()
+    if lib is not None:
+        h, w, c = img.shape
+        lib.btio_hflip_u8(_u8p(img), h, w, c)
+        return img
+    return img[:, ::-1].copy()
+
+
+def normalize(img: np.ndarray, mean, std) -> np.ndarray:
+    """uint8 HWC -> float32 HWC, (x/255 - mean) / std per channel."""
+    img = np.ascontiguousarray(img, np.uint8)
+    h, w, c = img.shape
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = _get()
+    if lib is not None:
+        out = np.empty((h, w, c), np.float32)
+        lib.btio_normalize_f32(_u8p(img), h, w, c, _f32p(mean), _f32p(std),
+                               _f32p(out))
+        return out
+    return ((img.astype(np.float32) / 255.0 - mean) / std).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Threaded batch pipeline
+# ---------------------------------------------------------------------------
+
+class BatchPipeline:
+    """Threaded per-image transform → contiguous NHWC f32 batch assembly.
+
+    Reference analog: per-executor ``ThreadPool.invokeAndWait`` over
+    transformer chains inside ``SampleToMiniBatch`` (SURVEY.md §4.1)."""
+
+    def __init__(self, num_threads: Optional[int] = None):
+        self.num_threads = num_threads or max(1, (os.cpu_count() or 2) - 1)
+        lib = _get()
+        self._lib = lib
+        self._pipe = (lib.btio_pipeline_create(self.num_threads)
+                      if lib is not None else None)
+
+    def close(self):
+        if self._pipe is not None:
+            self._lib.btio_pipeline_destroy(self._pipe)
+            self._pipe = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def process_batch(self, images, out_hw, mean, std, resize_hw=None,
+                      crops=None, flips=None) -> np.ndarray:
+        """images: list of uint8 HWC arrays (same channel count).
+        out_hw: (oh, ow) final size.  resize_hw: per-image or single (rh, rw)
+        intermediate resize (None = no resize).  crops: per-image (cy, cx)
+        offsets (None = 0,0).  flips: per-image bool (None = no flip).
+        Returns (n, oh, ow, c) float32, normalized."""
+        n = len(images)
+        oh, ow = out_hw
+        c = images[0].shape[2]
+        mean = np.ascontiguousarray(mean, np.float32)
+        std = np.ascontiguousarray(std, np.float32)
+        images = [np.ascontiguousarray(im, np.uint8) for im in images]
+
+        if self._pipe is not None:
+            out = np.empty((n, oh, ow, c), np.float32)
+            srcs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+                *[_u8p(im) for im in images])
+            dims = np.empty((n, 2), np.int32)
+            geom = np.zeros((n, 5), np.int32)
+            for i, im in enumerate(images):
+                dims[i] = im.shape[:2]
+                eh, ew = im.shape[:2]  # size entering the crop stage
+                if resize_hw is not None:
+                    rh, rw = (resize_hw[i]
+                              if not np.isscalar(resize_hw[0]) else resize_hw)
+                    geom[i, 0], geom[i, 1] = rh, rw
+                    eh, ew = rh, rw
+                cy, cx = crops[i] if crops is not None else (0, 0)
+                _check_crop(eh, ew, cy, cx, oh, ow)
+                if crops is not None:
+                    geom[i, 2], geom[i, 3] = crops[i]
+                if flips is not None:
+                    geom[i, 4] = int(bool(flips[i]))
+            self._lib.btio_process_batch(
+                self._pipe, n, srcs,
+                dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                geom.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                c, oh, ow, _f32p(mean), _f32p(std), _f32p(out))
+            return out
+
+        # fallback: sequential numpy
+        out = np.empty((n, oh, ow, c), np.float32)
+        for i, im in enumerate(images):
+            cur = im
+            if resize_hw is not None:
+                rh, rw = (resize_hw[i]
+                          if not np.isscalar(resize_hw[0]) else resize_hw)
+                cur = resize_bilinear(cur, rh, rw)
+            cy, cx = crops[i] if crops is not None else (0, 0)
+            _check_crop(cur.shape[0], cur.shape[1], cy, cx, oh, ow)
+            if cur.shape[:2] != (oh, ow) or (cy, cx) != (0, 0):
+                cur = cur[cy:cy + oh, cx:cx + ow]
+            if flips is not None and flips[i]:
+                cur = cur[:, ::-1]
+            out[i] = (cur.astype(np.float32) / 255.0 - mean) / std
+        return out
+
+    def gather_rows(self, src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        """Parallel src[idx] for a 2-D-viewable float32 array (batch
+        assembly from a sample pool)."""
+        src = np.ascontiguousarray(src, np.float32)
+        idx = np.ascontiguousarray(idx, np.int64)
+        if self._pipe is None:
+            return src[idx].copy()
+        row = int(np.prod(src.shape[1:]))
+        out = np.empty((len(idx),) + src.shape[1:], np.float32)
+        self._lib.btio_gather_rows_f32(
+            self._pipe, _f32p(src),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx), row, _f32p(out))
+        return out

@@ -1,0 +1,18 @@
+"""NNFrames — ML-pipeline Estimator/Transformer stages over DataFrames.
+
+Reference analog (unverified — mount empty): ``dllib/nnframes/
+{NNEstimator,NNModel,NNClassifier,NNImageReader}.scala`` (SURVEY.md §2
+L7): Spark-ML ``Estimator``/``Transformer`` stages that assemble
+feature/label columns into Sample RDDs, train with the internal
+DistriOptimizer, and append a prediction column.
+
+TPU-native redesign: the DataFrame surface is pandas (the in-process
+analog of a Spark DF partition; the distributed twin is an XShards of
+frames via ``bigdl_tpu.data.shards``), and training runs the
+``optim.Optimizer`` sharded train step over the local mesh.
+"""
+
+from bigdl_tpu.nnframes.nn_classifier import (NNClassifier, NNClassifierModel,
+                                              NNEstimator, NNModel)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]

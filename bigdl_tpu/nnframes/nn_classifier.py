@@ -1,0 +1,170 @@
+"""NNEstimator / NNModel / NNClassifier — reference
+``dllib/nnframes/NNEstimator.scala`` ff.  See package docstring."""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.data.dataset import DataSet
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.trigger import Trigger
+
+
+def _col_matrix(df, cols: Union[str, Sequence[str]]) -> np.ndarray:
+    """Column(s) → (n, …) float32 array; cells may be scalars or vectors."""
+    if isinstance(cols, str):
+        cols = [cols]
+    parts = []
+    for c in cols:
+        v = df[c].to_numpy()
+        if len(v) and isinstance(v[0], (list, tuple, np.ndarray)):
+            v = np.stack([np.asarray(e, np.float32) for e in v])
+        else:
+            v = v.astype(np.float32)[:, None]
+        parts.append(v.reshape(len(v), -1))
+    out = np.concatenate(parts, axis=1)
+    return out
+
+
+class NNEstimator:
+    """Fit a module on feature/label columns of a DataFrame —
+    reference ``NNEstimator.scala`` (a Spark-ML Estimator).
+
+    ``fit(df)`` returns an ``NNModel`` transformer."""
+
+    def __init__(self, model, criterion,
+                 features_col: Union[str, Sequence[str]] = "features",
+                 label_col: Union[str, Sequence[str]] = "label",
+                 feature_preprocessing: Optional[Callable] = None,
+                 label_preprocessing: Optional[Callable] = None):
+        self.model = model
+        self.criterion = criterion
+        self.features_col = features_col
+        self.label_col = label_col
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        # builder-style knobs (reference: setMaxEpoch/setBatchSize/…)
+        self._max_epoch = 1
+        self._batch_size = 32
+        self._optim_method = None
+        self._end_trigger = None
+        self._validation = None
+        self._checkpoint = None
+
+    # -- Spark-ML-style builder setters -------------------------------------
+    def set_max_epoch(self, n: int) -> "NNEstimator":
+        self._max_epoch = n
+        return self
+
+    def set_batch_size(self, n: int) -> "NNEstimator":
+        self._batch_size = n
+        return self
+
+    def set_optim_method(self, method) -> "NNEstimator":
+        self._optim_method = method
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "NNEstimator":
+        self._end_trigger = trigger
+        return self
+
+    def set_validation(self, trigger, df, methods,
+                       batch_size: int = 0) -> "NNEstimator":
+        self._validation = (trigger, df, methods,
+                            batch_size or self._batch_size)
+        return self
+
+    def set_checkpoint(self, path: str, trigger=None) -> "NNEstimator":
+        self._checkpoint = (path, trigger or Trigger.every_epoch())
+        return self
+
+    def _xy(self, df):
+        x = _col_matrix(df, self.features_col)
+        if self.feature_preprocessing is not None:
+            x = np.asarray(self.feature_preprocessing(x), np.float32)
+        y = _col_matrix(df, self.label_col)
+        if self.label_preprocessing is not None:
+            y = np.asarray(self.label_preprocessing(y))
+        return x, y
+
+    def fit(self, df) -> "NNModel":
+        x, y = self._xy(df)
+        ds = DataSet.array(x, self._label_cast(y))
+        opt = Optimizer(self.model, ds, self.criterion,
+                        batch_size=self._batch_size)
+        if self._optim_method is not None:
+            opt.set_optim_method(self._optim_method)
+        opt.set_end_when(self._end_trigger
+                         or Trigger.max_epoch(self._max_epoch))
+        if self._validation is not None:
+            trig, vdf, methods, vbs = self._validation
+            vx, vy = self._xy(vdf)
+            opt.set_validation(trig, DataSet.array(vx, self._label_cast(vy)),
+                               list(methods))
+        if self._checkpoint is not None:
+            opt.set_checkpoint(*self._checkpoint)
+        trained = opt.optimize()
+        return self._make_model(trained)
+
+    def _label_cast(self, y):
+        # regression keeps (n, d) labels matching the module output shape
+        return y.astype(np.float32)
+
+    def _make_model(self, trained) -> "NNModel":
+        return NNModel(self.model, trained, self.features_col,
+                       self.feature_preprocessing)
+
+
+class NNModel:
+    """Transformer appending a ``prediction`` column — reference
+    ``NNModel.scala``."""
+
+    prediction_col = "prediction"
+
+    def __init__(self, model, trained, features_col,
+                 feature_preprocessing=None):
+        self.model = model
+        self.trained = trained
+        self.features_col = features_col
+        self.feature_preprocessing = feature_preprocessing
+
+    def _features(self, df):
+        x = _col_matrix(df, self.features_col)
+        if self.feature_preprocessing is not None:
+            x = np.asarray(self.feature_preprocessing(x), np.float32)
+        return x
+
+    def _raw_predict(self, df, batch_size: int = 0) -> np.ndarray:
+        return np.asarray(self.trained.predict(self._features(df),
+                                               batch_size))
+
+    def transform(self, df, batch_size: int = 0):
+        out = df.copy()
+        pred = self._raw_predict(df, batch_size)
+        pred = pred.reshape(len(pred), -1)
+        # single-output models get a flat numeric column (the common
+        # regression case); multi-output keeps per-row vectors
+        out[self.prediction_col] = (pred[:, 0].astype(np.float32)
+                                    if pred.shape[1] == 1 else list(pred))
+        return out
+
+
+class NNClassifier(NNEstimator):
+    """Classification specialisation — reference ``NNClassifier.scala``:
+    labels are class indices, prediction is the argmax class."""
+
+    def _label_cast(self, y):
+        # class-index labels are flat (n,) ints
+        return y.reshape(len(y), -1)[:, 0].astype(np.int32)
+
+    def _make_model(self, trained) -> "NNClassifierModel":
+        return NNClassifierModel(self.model, trained, self.features_col,
+                                 self.feature_preprocessing)
+
+
+class NNClassifierModel(NNModel):
+    def transform(self, df, batch_size: int = 0):
+        out = df.copy()
+        logits = self._raw_predict(df, batch_size)
+        out[self.prediction_col] = np.argmax(logits, axis=-1).astype(np.int64)
+        return out

@@ -1,0 +1,263 @@
+// bigdl_tpu native host-side IO/vision kernels.
+//
+// Reference analog: BigDL's native layer — the OpenCV JNI vision pipeline
+// (com.intel.analytics.bigdl.opencv, feature/transform/vision) and the
+// per-executor ThreadPool that assembles MiniBatches (SURVEY.md §3.2, L0).
+// On TPU the device math belongs to XLA/Pallas; what stays native is the
+// HOST hot path: image decode-side transforms (resize/crop/flip/normalize)
+// and multi-threaded minibatch assembly that must keep up with the chips'
+// input bandwidth.  Exposed as a plain C ABI consumed via ctypes
+// (no pybind11 in the image).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -o libbigdl_tpu_io.so bigdl_tpu_io.cpp -lpthread
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Single-image ops (uint8 HWC in, uint8/float32 HWC out)
+// ---------------------------------------------------------------------------
+
+// Bilinear resize, uint8 HWC -> uint8 HWC.
+void btio_resize_bilinear_u8(const uint8_t* src, int sh, int sw, int c,
+                             uint8_t* dst, int dh, int dw) {
+  const float ry = dh > 1 ? (float)(sh - 1) / (dh - 1) : 0.f;
+  const float rx = dw > 1 ? (float)(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    const float fy = y * ry;
+    const int y0 = (int)fy;
+    const int y1 = std::min(y0 + 1, sh - 1);
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      const float fx = x * rx;
+      const int x0 = (int)fx;
+      const int x1 = std::min(x0 + 1, sw - 1);
+      const float wx = fx - x0;
+      const uint8_t* p00 = src + (y0 * sw + x0) * c;
+      const uint8_t* p01 = src + (y0 * sw + x1) * c;
+      const uint8_t* p10 = src + (y1 * sw + x0) * c;
+      const uint8_t* p11 = src + (y1 * sw + x1) * c;
+      uint8_t* q = dst + (y * dw + x) * c;
+      for (int k = 0; k < c; ++k) {
+        const float top = p00[k] + (p01[k] - p00[k]) * wx;
+        const float bot = p10[k] + (p11[k] - p10[k]) * wx;
+        q[k] = (uint8_t)std::lround(top + (bot - top) * wy);
+      }
+    }
+  }
+}
+
+// Crop a (ch x cw) window at (oy, ox), uint8 HWC.
+void btio_crop_u8(const uint8_t* src, int sh, int sw, int c, int oy, int ox,
+                  uint8_t* dst, int ch_, int cw) {
+  (void)sh;
+  for (int y = 0; y < ch_; ++y) {
+    std::memcpy(dst + y * cw * c, src + ((oy + y) * sw + ox) * c,
+                (size_t)cw * c);
+  }
+}
+
+// Horizontal flip in place, uint8 HWC.
+void btio_hflip_u8(uint8_t* img, int h, int w, int c) {
+  std::vector<uint8_t> tmp(c);
+  for (int y = 0; y < h; ++y) {
+    uint8_t* row = img + (size_t)y * w * c;
+    for (int x = 0; x < w / 2; ++x) {
+      uint8_t* a = row + (size_t)x * c;
+      uint8_t* b = row + (size_t)(w - 1 - x) * c;
+      std::memcpy(tmp.data(), a, c);
+      std::memcpy(a, b, c);
+      std::memcpy(b, tmp.data(), c);
+    }
+  }
+}
+
+// uint8 HWC -> float32 HWC with per-channel (x/255 - mean) / std.
+void btio_normalize_f32(const uint8_t* src, int h, int w, int c,
+                        const float* mean, const float* stdv, float* dst) {
+  std::vector<float> scale(c), shift(c);
+  for (int k = 0; k < c; ++k) {
+    const float inv = 1.f / stdv[k];
+    scale[k] = inv / 255.f;
+    shift[k] = -mean[k] * inv;
+  }
+  const size_t n = (size_t)h * w;
+  for (size_t i = 0; i < n; ++i) {
+    const uint8_t* p = src + i * c;
+    float* q = dst + i * c;
+    for (int k = 0; k < c; ++k) q[k] = p[k] * scale[k] + shift[k];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Threaded batch pipeline: N worker threads run resize+crop+flip+normalize
+// per image straight into its slot of a contiguous NHWC float32 batch.
+// (Reference analog: Engine.ThreadPool invokeAndWait over per-core
+// transformer chains in SampleToMiniBatch.)
+// ---------------------------------------------------------------------------
+
+struct Pipeline {
+  std::vector<std::thread> workers;
+  std::queue<std::function<void()>> jobs;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::condition_variable done_cv;
+  int outstanding = 0;
+  bool stop = false;
+
+  explicit Pipeline(int n) {
+    for (int i = 0; i < n; ++i) {
+      workers.emplace_back([this] {
+        for (;;) {
+          std::function<void()> job;
+          {
+            std::unique_lock<std::mutex> lk(mu);
+            cv.wait(lk, [this] { return stop || !jobs.empty(); });
+            if (stop && jobs.empty()) return;
+            job = std::move(jobs.front());
+            jobs.pop();
+          }
+          job();
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            if (--outstanding == 0) done_cv.notify_all();
+          }
+        }
+      });
+    }
+  }
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    for (auto& t : workers) t.join();
+  }
+  void submit(std::function<void()> f) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      jobs.push(std::move(f));
+      ++outstanding;
+    }
+    cv.notify_one();
+  }
+  void wait() {
+    std::unique_lock<std::mutex> lk(mu);
+    done_cv.wait(lk, [this] { return outstanding == 0; });
+  }
+};
+
+void* btio_pipeline_create(int num_threads) {
+  return new Pipeline(std::max(1, num_threads));
+}
+
+void btio_pipeline_destroy(void* p) { delete (Pipeline*)p; }
+
+// One image job: src uint8 HWC (sh, sw, c) -> batch slot i of a float32
+// NHWC batch (n, oh, ow, c):  resize to (rh, rw) -> crop (oh, ow) at
+// (cy, cx) -> optional hflip -> normalize.
+struct ImageJob {
+  const uint8_t* src;
+  int sh, sw, c;
+  int rh, rw;       // resize target (0 = skip resize)
+  int cy, cx;       // crop offset
+  int flip;         // 0/1
+  const float* mean;
+  const float* stdv;
+  float* dst;       // slot pointer (oh*ow*c floats)
+  int oh, ow;
+};
+
+static void run_image_job(const ImageJob j) {
+  std::vector<uint8_t> buf1, buf2;
+  const uint8_t* cur = j.src;
+  int h = j.sh, w = j.sw;
+  if (j.rh > 0 && (j.rh != h || j.rw != w)) {
+    buf1.resize((size_t)j.rh * j.rw * j.c);
+    btio_resize_bilinear_u8(cur, h, w, j.c, buf1.data(), j.rh, j.rw);
+    cur = buf1.data();
+    h = j.rh;
+    w = j.rw;
+  }
+  if (j.oh != h || j.ow != w || j.cy != 0 || j.cx != 0) {
+    buf2.resize((size_t)j.oh * j.ow * j.c);
+    btio_crop_u8(cur, h, w, j.c, j.cy, j.cx, buf2.data(), j.oh, j.ow);
+    cur = buf2.data();
+    h = j.oh;
+    w = j.ow;
+  }
+  std::vector<uint8_t> flipped;
+  if (j.flip) {
+    flipped.assign(cur, cur + (size_t)h * w * j.c);
+    btio_hflip_u8(flipped.data(), h, w, j.c);
+    cur = flipped.data();
+  }
+  btio_normalize_f32(cur, h, w, j.c, j.mean, j.stdv, j.dst);
+}
+
+// Submit a whole batch of image jobs described by parallel arrays, then wait.
+// srcs: n pointers; dims: n*(sh,sw); geom: n*(rh,rw,cy,cx,flip);
+// dst: contiguous (n, oh, ow, c) float32.
+void btio_process_batch(void* pipe, int n, const uint8_t** srcs,
+                        const int* dims, const int* geom, int c, int oh,
+                        int ow, const float* mean, const float* stdv,
+                        float* dst) {
+  Pipeline* p = (Pipeline*)pipe;
+  const size_t slot = (size_t)oh * ow * c;
+  for (int i = 0; i < n; ++i) {
+    ImageJob j;
+    j.src = srcs[i];
+    j.sh = dims[2 * i];
+    j.sw = dims[2 * i + 1];
+    j.c = c;
+    j.rh = geom[5 * i];
+    j.rw = geom[5 * i + 1];
+    j.cy = geom[5 * i + 2];
+    j.cx = geom[5 * i + 3];
+    j.flip = geom[5 * i + 4];
+    j.mean = mean;
+    j.stdv = stdv;
+    j.dst = dst + slot * i;
+    j.oh = oh;
+    j.ow = ow;
+    p->submit([j] { run_image_job(j); });
+  }
+  p->wait();
+}
+
+// ---------------------------------------------------------------------------
+// Gather-assemble: copy rows[idx] of a (num, row_elems) float32 array into a
+// contiguous batch — the SampleToMiniBatch copy, parallelized.
+// ---------------------------------------------------------------------------
+void btio_gather_rows_f32(void* pipe, const float* src, const int64_t* idx,
+                          int n, int64_t row_elems, float* dst) {
+  Pipeline* p = (Pipeline*)pipe;
+  const int chunk = std::max(1, n / (int)(((Pipeline*)pipe)->workers.size() * 4));
+  for (int s = 0; s < n; s += chunk) {
+    const int e = std::min(n, s + chunk);
+    p->submit([=] {
+      for (int i = s; i < e; ++i) {
+        std::memcpy(dst + (size_t)i * row_elems,
+                    src + (size_t)idx[i] * row_elems,
+                    sizeof(float) * row_elems);
+      }
+    });
+  }
+  p->wait();
+}
+
+int btio_version() { return 1; }
+
+}  // extern "C"

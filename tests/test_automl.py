@@ -1,0 +1,166 @@
+"""AutoML (hp DSL, searchers, AutoEstimator) and Chronos-equivalent AutoTS.
+
+Mirrors the reference test style (SURVEY.md §5): tiny synthetic data,
+local execution, assert the search ran and the best model beats chance.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bigdl_tpu.automl import AutoEstimator, GridSearcher, RandomSearcher, hp
+from bigdl_tpu.automl.hp import grid_points, sample_space
+
+
+class TestHp:
+    def test_samplers(self):
+        rng = np.random.default_rng(0)
+        space = {
+            "lr": hp.loguniform(1e-4, 1e-1),
+            "units": hp.choice([16, 32]),
+            "depth": hp.randint(1, 4),
+            "frac": hp.uniform(0.0, 1.0),
+            "q": hp.quniform(0.0, 1.0, 0.25),
+            "fixed": 7,
+            "nested": {"k": hp.choice(["a", "b"])},
+        }
+        cfg = sample_space(space, rng)
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert cfg["units"] in (16, 32)
+        assert 1 <= cfg["depth"] < 4
+        assert cfg["q"] in (0.0, 0.25, 0.5, 0.75, 1.0)
+        assert cfg["fixed"] == 7
+        assert cfg["nested"]["k"] in ("a", "b")
+
+    def test_grid_points(self):
+        pts = grid_points({"a": hp.choice([1, 2]), "b": hp.choice([3, 4]),
+                           "c": "x"})
+        assert len(pts) == 4
+        assert all(p["c"] == "x" for p in pts)
+        with pytest.raises(ValueError):
+            grid_points({"a": hp.uniform(0, 1)})
+
+
+class TestSearchers:
+    def test_random_min(self):
+        s = RandomSearcher(mode="min", seed=0)
+        best = s.run(lambda c: (c["x"] - 3) ** 2,
+                     {"x": hp.uniform(0, 10)}, n_sampling=25)
+        assert abs(best.config["x"] - 3) < 2.0
+        assert len(s.results) == 25
+
+    def test_grid_max(self):
+        s = GridSearcher(mode="max")
+        best = s.run(lambda c: c["x"] * c["y"],
+                     {"x": hp.choice([1, 2, 3]), "y": hp.choice([5, 7])},
+                     n_sampling=0)
+        assert best.config == {"x": 3, "y": 7}
+
+    def test_failed_trials_skipped(self):
+        def trial(c):
+            if c["x"] == 1:
+                raise RuntimeError("boom")
+            return c["x"]
+
+        s = GridSearcher(mode="min")
+        best = s.run(trial, {"x": hp.choice([1, 2, 3])}, n_sampling=0)
+        assert best.config["x"] == 2
+        assert s.results[0].error is not None
+
+
+class TestAutoEstimator:
+    def test_fit_linear_regression(self):
+        from bigdl_tpu.nn.criterion import MSECriterion
+        from bigdl_tpu.nn.layers import Linear
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.optim.optim_method import Adam
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 8).astype(np.float32)
+        w = rng.randn(8, 1).astype(np.float32)
+        y = x @ w
+
+        auto = AutoEstimator(
+            model_creator=lambda cfg: Sequential(
+                [Linear(8, cfg["units"]), Linear(cfg["units"], 1)]),
+            optimizer_creator=lambda cfg: Adam(learning_rate=cfg["lr"]),
+            loss_creator=lambda cfg: MSECriterion(),
+            metric="loss", mode="min")
+        auto.fit((x, y), search_space={
+            "units": hp.choice([4, 8]),
+            "lr": hp.choice([1e-2, 3e-2]),
+        }, n_sampling=3, epochs=12, batch_size=32)
+        assert auto.best_result.metric < 0.5
+        assert auto.get_best_config()["units"] in (4, 8)
+        assert auto.get_best_model() is not None
+
+
+def _series(n=300):
+    t = np.arange(n)
+    return pd.DataFrame({
+        "dt": pd.date_range("2025-01-01", periods=n, freq="h"),
+        "value": (np.sin(2 * np.pi * t / 24)
+                  + 0.05 * np.random.RandomState(0).randn(n)),
+    })
+
+
+class TestAutoTS:
+    def test_autots_pipeline(self, tmp_path):
+        from bigdl_tpu.forecast.autots import AutoTSEstimator, TSPipeline
+        from bigdl_tpu.forecast.tsdataset import TSDataset
+
+        tsdata = TSDataset.from_pandas(_series(), dt_col="dt",
+                                       target_col="value").scale()
+        auto = AutoTSEstimator(
+            model="lstm",
+            search_space={"hidden_dim": hp.choice([16, 32]),
+                          "lr": hp.choice([1e-2])},
+            past_seq_len=hp.choice([12, 24]), future_seq_len=4, seed=0)
+        pipeline = auto.fit(tsdata, epochs=2, n_sampling=2)
+        assert auto.get_best_config()["past_seq_len"] in (12, 24)
+
+        pred = pipeline.predict(tsdata)
+        assert pred.shape[1:] == (4, 1)
+        ev = pipeline.evaluate(tsdata, metrics=["mse", "mae"])
+        assert set(ev) == {"mse", "mae"}
+        assert np.isfinite(ev["mse"])
+
+        # save/load round trip
+        p = str(tmp_path / "tsppl")
+        pipeline.save(p)
+        loaded = TSPipeline.load(p)
+        pred2 = loaded.predict(tsdata)
+        np.testing.assert_allclose(pred2, pred, rtol=1e-4, atol=1e-5)
+        # a LOADED pipeline can be re-saved (its forecaster re-records
+        # constructor args) and a manually built pipeline can be saved too
+        loaded.save(str(tmp_path / "tsppl2"))
+        re = TSPipeline.load(str(tmp_path / "tsppl2"))
+        np.testing.assert_allclose(re.predict(tsdata), pred, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_manual_pipeline_save(self, tmp_path):
+        from bigdl_tpu.forecast.autots import TSPipeline
+        from bigdl_tpu.forecast.forecaster import LSTMForecaster
+        from bigdl_tpu.forecast.tsdataset import TSDataset
+
+        tsdata = TSDataset.from_pandas(_series(200), dt_col="dt",
+                                       target_col="value")
+        fc = LSTMForecaster(past_seq_len=12, future_seq_len=2,
+                            input_feature_num=1, output_feature_num=1,
+                            hidden_dim=8)
+        x, y = tsdata.roll(12, 2).to_numpy()
+        fc.fit((x, y), epochs=1)
+        ppl = TSPipeline(fc, 12, 2)
+        p = str(tmp_path / "manual")
+        ppl.save(p)
+        loaded = TSPipeline.load(p)
+        assert loaded.forecaster.hidden_dim == 8
+        np.testing.assert_allclose(loaded.predict(x), ppl.predict(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_searcher_drops_loser_artifacts(self):
+        s = RandomSearcher(mode="min", seed=0)
+        s.run(lambda c: (c["x"], object()), {"x": hp.choice([3, 1, 2])},
+              n_sampling=6)
+        keep = [r for r in s.results if r.artifacts is not None]
+        assert len(keep) == 1 and keep[0].metric == 1

@@ -1,0 +1,112 @@
+"""Nano-equivalent InferenceOptimizer + keras autograd."""
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.keras import autograd as A
+from bigdl_tpu.nano import InferenceOptimizer
+
+
+def _model_and_vars(seed=0):
+    from bigdl_tpu.nn.layers import Linear, ReLU
+    from bigdl_tpu.nn.module import Sequential
+
+    model = Sequential([Linear(16, 32), ReLU(), Linear(32, 4)])
+    x = np.random.RandomState(seed).randn(8, 16).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    return model, variables, x
+
+
+class TestInferenceOptimizer:
+    def test_trace_fp32(self):
+        model, variables, x = _model_and_vars()
+        tm = InferenceOptimizer.trace(model, variables, x)
+        out = np.asarray(tm(x))
+        ref, _ = model.apply(variables, x)
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_trace_shape_fixed(self):
+        model, variables, x = _model_and_vars()
+        tm = InferenceOptimizer.trace(model, variables, x)
+        with pytest.raises(ValueError, match="re-trace"):
+            tm(x[:4])
+
+    def test_quantize_int8(self):
+        model, variables, x = _model_and_vars()
+        tm = InferenceOptimizer.quantize(model, variables, x,
+                                         precision="int8")
+        out = np.asarray(tm(x))
+        ref, _ = model.apply(variables, x)
+        rel = np.abs(out - np.asarray(ref)).max() / (
+            np.abs(np.asarray(ref)).max() + 1e-8)
+        assert rel < 0.1, rel
+
+    def test_optimize_picks_best(self):
+        model, variables, x = _model_and_vars()
+        res = InferenceOptimizer.optimize(
+            model, variables, x, methods=("fp32", "bf16", "int8"),
+            repeats=3)
+        best, name = res.get_best_model()
+        assert name in ("fp32", "bf16", "int8")
+        assert np.asarray(best(x)).shape == (8, 4)
+        assert "latency" in res.summary()
+
+    def test_accuracy_gate(self):
+        model, variables, x = _model_and_vars()
+        ref, _ = model.apply(variables, x)
+        ref = np.asarray(ref)
+
+        # scorer: negative max-deviation from fp32 output; bf16/int8 deviate
+        def score(out):
+            return -float(np.abs(out - ref).max())
+
+        res = InferenceOptimizer.optimize(
+            model, variables, x, methods=("fp32", "int8"), repeats=2,
+            accuracy_fn=score, accuracy_budget=1e-9)
+        assert res.results["fp32"]["status"] == "ok"
+        assert res.results["int8"]["status"] == "accuracy_drop"
+
+
+class TestAutograd:
+    def test_ops_eager(self):
+        x = np.array([1.0, -2.0, 3.0], np.float32)
+        np.testing.assert_allclose(A.square(x), x ** 2)
+        np.testing.assert_allclose(A.abs(x), np.abs(x))
+        np.testing.assert_allclose(np.asarray(A.clip(x, -1, 1)),
+                                   np.clip(x, -1, 1))
+
+    def test_custom_layer_graph(self):
+        from bigdl_tpu.keras.engine import Input, Model
+        from bigdl_tpu.nn.layers import Linear
+
+        inp = Input((8,))
+        h = Linear(8, 4)(inp)
+        out = A.mul(A.softsign(h), 2.0)
+        model = Model(inp, out)
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y, _ = model.apply(variables, x)
+        assert y.shape == (3, 4)
+        assert np.abs(np.asarray(y)).max() <= 2.0
+
+    def test_custom_loss_trains(self):
+        from bigdl_tpu.keras.engine import Input, Model
+        from bigdl_tpu.nn.layers import Linear
+        from bigdl_tpu.optim.optim_method import Adam
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        y = x @ rng.randn(4, 1).astype(np.float32)
+
+        inp = Input((4,))
+        model = Model(inp, Linear(4, 1)(inp))
+        loss = A.CustomLoss(
+            lambda yt, yp: A.mean(A.square(yp - yt))
+            + 0.01 * A.mean(A.abs(yp)))
+        model.compile(Adam(learning_rate=1e-1), loss)
+        model.fit(x, y, batch_size=32, nb_epoch=50)
+        pred = model.predict(x)
+        mse = float(np.mean((np.asarray(pred) - y) ** 2))
+        assert mse < 0.1, mse

@@ -1,0 +1,126 @@
+"""Native C++ IO library + vision pipeline.
+
+The C path and the numpy fallback are both exercised and compared —
+the reference's MKL-vs-pure-JVM duality (SURVEY.md §3.1 tensor row).
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import native
+from bigdl_tpu.data.vision import (CenterCrop, ChannelNormalize, HFlip,
+                                   ImageFrame, ImageFrameToBatches,
+                                   RandomCrop, Resize, ResizeShortSide)
+
+
+def _img(rng, h=32, w=48, c=3):
+    return rng.integers(0, 256, (h, w, c), dtype=np.uint8)
+
+
+class TestNativeLib:
+    def test_builds(self):
+        assert native.available(), "native lib should build with g++"
+
+    def test_resize_matches_fallback(self):
+        rng = np.random.default_rng(0)
+        img = _img(rng)
+        out = native.resize_bilinear(img, 16, 24)
+        assert out.shape == (16, 24, 3) and out.dtype == np.uint8
+        from bigdl_tpu.native import lib as L
+        real = L._lib
+        try:
+            L._lib = None
+            ref = native.resize_bilinear(img, 16, 24)
+        finally:
+            L._lib = real
+        # identical sampling; allow ±1 for rounding differences
+        assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
+
+    def test_crop_flip_normalize(self):
+        rng = np.random.default_rng(1)
+        img = _img(rng)
+        c = native.crop(img, 2, 3, 10, 12)
+        np.testing.assert_array_equal(c, img[2:12, 3:15])
+        f = native.hflip(img)
+        np.testing.assert_array_equal(f, img[:, ::-1])
+        mean = [0.5, 0.4, 0.3]
+        std = [0.2, 0.25, 0.3]
+        n = native.normalize(img, mean, std)
+        ref = (img.astype(np.float32) / 255.0 - np.float32(mean)) / \
+            np.float32(std)
+        np.testing.assert_allclose(n, ref, rtol=1e-5, atol=1e-5)
+
+    def test_batch_pipeline(self):
+        rng = np.random.default_rng(2)
+        images = [_img(rng, 40, 50) for _ in range(7)]
+        pipe = native.BatchPipeline(2)
+        mean, std = [0.5] * 3, [0.25] * 3
+        out = pipe.process_batch(images, (24, 24), mean, std,
+                                 resize_hw=(32, 32),
+                                 crops=[(4, 4)] * 7,
+                                 flips=[True, False] * 3 + [True])
+        assert out.shape == (7, 24, 24, 3) and out.dtype == np.float32
+        # reference computation for image 1 (no flip)
+        r = native.resize_bilinear(images[1], 32, 32)[4:28, 4:28]
+        ref = (r.astype(np.float32) / 255.0 - 0.5) / 0.25
+        np.testing.assert_allclose(out[1], ref, atol=1e-5)
+        # image 0 flipped
+        r0 = native.resize_bilinear(images[0], 32, 32)[4:28, 4:28][:, ::-1]
+        ref0 = (r0.astype(np.float32) / 255.0 - 0.5) / 0.25
+        np.testing.assert_allclose(out[0], ref0, atol=1e-5)
+        pipe.close()
+
+    def test_crop_out_of_bounds_rejected(self):
+        import pytest
+
+        rng = np.random.default_rng(7)
+        img = _img(rng, 20, 20)
+        with pytest.raises(ValueError, match="out of bounds"):
+            native.crop(img, 0, 0, 32, 32)
+        pipe = native.BatchPipeline(1)
+        with pytest.raises(ValueError, match="out of bounds"):
+            pipe.process_batch([img], (32, 32), [0.5] * 3, [0.25] * 3)
+        pipe.close()
+
+    def test_gather_rows(self):
+        rng = np.random.default_rng(3)
+        src = rng.standard_normal((20, 6, 4)).astype(np.float32)
+        idx = np.array([3, 0, 19, 7], np.int64)
+        pipe = native.BatchPipeline(2)
+        out = pipe.gather_rows(src, idx)
+        np.testing.assert_array_equal(out, src[idx])
+        pipe.close()
+
+
+class TestVisionPipeline:
+    def test_transform_chain(self):
+        rng = np.random.default_rng(4)
+        frame = ImageFrame.from_arrays([_img(rng, 50, 60) for _ in range(4)],
+                                       labels=[0, 1, 2, 3])
+        chain = (ResizeShortSide(36) >> CenterCrop(32, 32)
+                 >> ChannelNormalize([0.5] * 3, [0.25] * 3))
+        out = frame.transform(chain)
+        assert len(out) == 4
+        for f in out:
+            assert f.image.shape == (32, 32, 3)
+            assert f.image.dtype == np.float32
+
+    def test_augmentations(self):
+        rng = np.random.default_rng(5)
+        frame = ImageFrame.from_arrays([_img(rng, 40, 40)])
+        out = frame.transform(Resize(20, 20) >> RandomCrop(16, 16, seed=0)
+                              >> HFlip(p=1.0))
+        assert out.features[0].image.shape == (16, 16, 3)
+
+    def test_batches(self):
+        rng = np.random.default_rng(6)
+        frame = ImageFrame.from_arrays(
+            [_img(rng, 40, 40) for _ in range(10)], labels=list(range(10)))
+        to_batches = ImageFrameToBatches(
+            (24, 24), [0.5] * 3, [0.25] * 3, resize_hw=(32, 32),
+            random_crop=True, random_flip=True, seed=0)
+        batches = list(to_batches(frame, batch_size=4, shuffle=True))
+        assert len(batches) == 2  # drop_last
+        for b in batches:
+            assert b["input"].shape == (4, 24, 24, 3)
+            assert b["target"].shape == (4,)

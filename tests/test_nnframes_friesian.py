@@ -1,0 +1,139 @@
+"""NNFrames (DataFrame Estimator/Transformer) + Friesian FeatureTable."""
+
+import numpy as np
+import pandas as pd
+
+from bigdl_tpu.friesian import FeatureTable
+from bigdl_tpu.nnframes import NNClassifier, NNEstimator
+
+
+def _clf_df(n=160, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 6).astype(np.float32)
+    w = rng.randn(6, 3).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    return pd.DataFrame({"features": list(x), "label": y})
+
+
+class TestNNFrames:
+    def test_classifier_fit_transform(self):
+        from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+        from bigdl_tpu.nn.layers import Linear, ReLU
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.optim.optim_method import Adam
+
+        df = _clf_df()
+        est = (NNClassifier(
+            Sequential([Linear(6, 32), ReLU(), Linear(32, 3)]),
+            CrossEntropyCriterion())
+            .set_max_epoch(15).set_batch_size(32)
+            .set_optim_method(Adam(learning_rate=1e-2)))
+        model = est.fit(df)
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        acc = (out["prediction"].to_numpy() == df["label"].to_numpy()).mean()
+        assert acc > 0.9, acc
+
+    def test_regression_estimator(self):
+        from bigdl_tpu.nn.criterion import MSECriterion
+        from bigdl_tpu.nn.layers import Linear
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.optim.optim_method import Adam
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(128, 4).astype(np.float32)
+        y = (x @ rng.randn(4, 1).astype(np.float32))[:, 0]
+        df = pd.DataFrame({"features": list(x), "label": y})
+        est = (NNEstimator(Sequential([Linear(4, 1)]), MSECriterion())
+               .set_max_epoch(40).set_batch_size(32)
+               .set_optim_method(Adam(learning_rate=3e-2)))
+        model = est.fit(df)
+        out = model.transform(df)
+        pred = out["prediction"].to_numpy()  # flat numeric column
+        assert pred.dtype == np.float32
+        mse = float(np.mean((pred - y) ** 2))
+        assert mse < 0.1, mse
+
+    def test_multi_column_features(self):
+        from bigdl_tpu.nn.criterion import MSECriterion
+        from bigdl_tpu.nn.layers import Linear
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.optim.optim_method import SGD
+
+        vals = np.arange(8, dtype=np.float32)
+        df = pd.DataFrame({"a": vals, "b": vals / 2.0,
+                           "label": vals})
+        est = (NNEstimator(Sequential([Linear(2, 1)]), MSECriterion(),
+                           features_col=["a", "b"])
+               .set_max_epoch(1).set_batch_size(8)
+               .set_optim_method(SGD(learning_rate=1e-2)))
+        model = est.fit(df)
+        out = model.transform(df)
+        assert len(out) == 8
+
+
+class TestFeatureTable:
+    def test_string_index_roundtrip(self):
+        df = pd.DataFrame({"cat": ["a", "b", "a", "c", "a", "b"],
+                           "v": range(6)})
+        tbl = FeatureTable.from_pandas(df)
+        idx = tbl.gen_string_idx("cat")
+        assert idx.mapping["a"] == 1  # most frequent first
+        assert idx.size == 4          # 3 cats + OOV
+        enc = tbl.encode_string("cat", idx)
+        assert enc.df["cat"].tolist() == [1, 2, 1, 3, 1, 2]
+        assert idx.encode(["zzz"])[0] == 0  # OOV
+
+    def test_freq_limit_and_category_encode(self):
+        df = pd.DataFrame({"cat": ["a"] * 5 + ["b"] * 2 + ["c"]})
+        tbl = FeatureTable.from_pandas(df)
+        idx = tbl.gen_string_idx("cat", freq_limit=2)
+        assert "c" not in idx.mapping
+        enc, idx2 = tbl.category_encode("cat")
+        assert enc.df["cat"].nunique() == 3
+
+    def test_cross_and_scale(self):
+        df = pd.DataFrame({"u": [1, 2, 1], "i": [10, 10, 20],
+                           "price": [1.0, 3.0, 5.0]})
+        tbl = FeatureTable.from_pandas(df)
+        crossed = tbl.cross_columns([["u", "i"]], [100])
+        assert "u_i" in crossed.df.columns
+        assert crossed.df["u_i"].between(0, 99).all()
+        # same inputs hash equal, different differ (overwhelmingly)
+        assert crossed.df["u_i"][0] != crossed.df["u_i"][1]
+        scaled, stats = tbl.min_max_scale("price")
+        assert scaled.df["price"].min() == 0.0
+        assert scaled.df["price"].max() == 1.0
+        assert stats["price"] == (1.0, 5.0)
+
+    def test_hist_seq(self):
+        df = pd.DataFrame({
+            "user": [1, 1, 1, 2, 2],
+            "item": [11, 12, 13, 21, 22],
+            "ts": [1, 2, 3, 1, 2],
+        })
+        tbl = FeatureTable.from_pandas(df).add_hist_seq(
+            "user", ["item"], "ts", min_len=1, max_len=3)
+        # first event per user has no history -> dropped
+        assert len(tbl) == 3
+        seqs = {tuple(s) for s in tbl.df["item_hist_seq"]}
+        assert (0, 0, 11) in seqs and (0, 11, 12) in seqs
+
+    def test_negative_samples(self):
+        df = pd.DataFrame({"user": [1, 2], "item": [3, 4]})
+        tbl = FeatureTable.from_pandas(df).add_negative_samples(
+            item_size=50, item_col="item", neg_num=2, seed=0)
+        assert len(tbl) == 6
+        assert (tbl.df["label"] == 1).sum() == 2
+        negs = tbl.df[tbl.df["label"] == 0]
+        pos_items = df["item"].tolist() * 2
+        assert all(n != p for n, p in zip(negs["item"], pos_items))
+
+    def test_join_select_fillna(self):
+        a = FeatureTable.from_pandas(
+            pd.DataFrame({"k": [1, 2], "x": [1.0, np.nan]}))
+        b = FeatureTable.from_pandas(pd.DataFrame({"k": [1, 2],
+                                                   "y": [5, 6]}))
+        j = a.fillna(0.0, ["x"]).join(b, on="k")
+        assert j.df["x"].tolist() == [1.0, 0.0]
+        assert set(j.select("k", "y").df.columns) == {"k", "y"}
