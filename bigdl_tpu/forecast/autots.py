@@ -64,6 +64,15 @@ class TSPipeline:
             return data.roll(self.lookback, self.horizon).to_numpy()
         return data
 
+    def _unscale_y(self, y: np.ndarray) -> np.ndarray:
+        if self.scaler is None:
+            return y
+        n_t = y.shape[-1]
+        mean = np.asarray(self.scaler.mean_ if hasattr(self.scaler, "mean_")
+                          else self.scaler.min_)[0, :n_t]
+        scale = np.asarray(self.scaler.scale_)[0, :n_t]
+        return y * scale + mean
+
     def fit(self, data, epochs: int = 5, batch_size: int = 32) -> "TSPipeline":
         """Incremental fit on new data (reference: TSPipeline.fit)."""
         x, y = self._rolled(data)
@@ -71,10 +80,19 @@ class TSPipeline:
         return self
 
     def predict(self, data, batch_size: int = 0) -> np.ndarray:
+        """Forecast.  TSDataset input: scaling is handled internally
+        (scale → model → inverse-transform, the reference TSPipeline
+        behavior) and windows are rolled with horizon=0 so the LAST
+        window — the true forecast beyond the series end — is included.
+        Raw ndarray input: treated as already-preprocessed model-space
+        windows; predictions come back in model space unchanged."""
         if isinstance(data, TSDataset):
-            x, _ = self._rolled(data)
-        else:
-            x = np.asarray(data, np.float32)
+            if self.scaler is not None and data.scaler is None:
+                data = data.scale(self.scaler, fit=False)
+            x, _ = data.roll(self.lookback, 0).to_numpy()
+            return self._unscale_y(
+                np.asarray(self.forecaster.predict(x, batch_size)))
+        x = np.asarray(data, np.float32)
         return self.forecaster.predict(x, batch_size)
 
     def evaluate(self, data, metrics: Sequence[str] = ("mse",),

@@ -46,14 +46,19 @@ class BaseForecaster:
 
         orig = cls.__init__
 
+        sig = inspect.signature(orig)
+        var_kw = [p.name for p in sig.parameters.values()
+                  if p.kind is inspect.Parameter.VAR_KEYWORD]
+
         @functools.wraps(orig)
         def wrapped(self, *args, **kwargs):
             if not hasattr(self, "_init_args"):
-                ba = inspect.signature(orig).bind(self, *args, **kwargs)
+                ba = sig.bind(self, *args, **kwargs)
                 ba.apply_defaults()
                 d = dict(ba.arguments)
                 d.pop("self", None)
-                d.update(d.pop("kw", None) or {})
+                for name in var_kw:  # flatten **kwargs whatever its name
+                    d.update(d.pop(name, None) or {})
                 self._init_args = d
             orig(self, *args, **kwargs)
 

@@ -137,13 +137,16 @@ def crop(img: np.ndarray, oy: int, ox: int, ch: int, cw: int) -> np.ndarray:
 
 
 def hflip(img: np.ndarray) -> np.ndarray:
-    img = np.ascontiguousarray(img, np.uint8).copy()
+    src = np.asarray(img)
+    out = np.ascontiguousarray(src, np.uint8)
+    if out is src:  # ascontiguousarray didn't copy — keep input unmutated
+        out = out.copy()
     lib = _get()
     if lib is not None:
-        h, w, c = img.shape
-        lib.btio_hflip_u8(_u8p(img), h, w, c)
-        return img
-    return img[:, ::-1].copy()
+        h, w, c = out.shape
+        lib.btio_hflip_u8(_u8p(out), h, w, c)
+        return out
+    return out[:, ::-1].copy()
 
 
 def normalize(img: np.ndarray, mean, std) -> np.ndarray:

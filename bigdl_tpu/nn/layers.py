@@ -78,6 +78,16 @@ def _pair(v) -> Tuple[int, int]:
     return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
 
 
+def _conv_accum(xc):
+    """f32-accumulation kwargs for convs.  With bf16 inputs we must NOT pass
+    preferred_element_type: jax's conv transpose rule then builds a mixed
+    bf16/f32 conv and fails under grad — and the TPU MXU accumulates conv
+    partials in f32 internally regardless, so only the output rounds to
+    bf16 (re-widened before the bias add)."""
+    return ({"preferred_element_type": jnp.float32}
+            if xc.dtype == jnp.float32 else {})
+
+
 def _conv_padding(pad: PadLike, kh: int, kw: int):
     if isinstance(pad, str):
         return pad.upper()  # "SAME" / "VALID"
@@ -129,10 +139,10 @@ class Conv2D(Module):
             rhs_dilation=self.dilation,
             feature_group_count=self.groups,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
+            **_conv_accum(xc),
         )
         if self.with_bias:
-            y = y + params["bias"]
+            y = y.astype(jnp.float32) + params["bias"]
         return y.astype(x.dtype), EMPTY
 
 
@@ -185,10 +195,10 @@ class Conv1D(Module):
             xc, wc, window_strides=(self.stride,), padding=pad,
             rhs_dilation=(self.dilation,), feature_group_count=self.groups,
             dimension_numbers=("NWC", "WIO", "NWC"),
-            preferred_element_type=jnp.float32,
+            **_conv_accum(xc),
         )
         if self.with_bias:
-            y = y + params["bias"]
+            y = y.astype(jnp.float32) + params["bias"]
         return y.astype(x.dtype), EMPTY
 
 

@@ -50,20 +50,51 @@ class Timer:
 
 
 class SummaryWriter:
-    """jsonl scalar summary — the TrainSummary/ValidationSummary analog
-    (reference writes TensorBoard event protobufs; jsonl is the primary
-    format here, TB export is additive later)."""
+    """Scalar summary — the TrainSummary/ValidationSummary analog.  Writes
+    BOTH jsonl (greppable primary format) and TensorBoard event protobufs
+    (``utils/tbwriter.py``) so curves open in stock TensorBoard exactly as
+    the reference's ``TrainSummary`` files do (SURVEY.md §6.1)."""
 
-    def __init__(self, log_dir: str, name: str):
+    def __init__(self, log_dir: str, name: str, tensorboard: bool = True):
         os.makedirs(log_dir, exist_ok=True)
         self.path = os.path.join(log_dir, f"{name}.jsonl")
         self._f = open(self.path, "a")
+        self._tb = None
+        if tensorboard:
+            from bigdl_tpu.utils.tbwriter import TensorBoardWriter
+
+            self._tb = TensorBoardWriter(os.path.join(log_dir, name))
 
     def add_scalar(self, tag: str, value: float, step: int):
         self._f.write(json.dumps(
             {"step": step, "tag": tag, "value": float(value),
              "wall": time.time()}) + "\n")
         self._f.flush()
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+
+    def read_scalar(self, tag: str):
+        """(step, value) pairs for one tag — reference
+        ``TrainSummary.readScalar``."""
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["tag"] == tag:
+                    out.append((rec["step"], rec["value"]))
+        return out
 
     def close(self):
         self._f.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def TrainSummary(log_dir: str, app_name: str) -> SummaryWriter:
+    """Reference ``utils/visualization/TrainSummary.scala`` constructor."""
+    return SummaryWriter(os.path.join(log_dir, app_name), "train")
+
+
+def ValidationSummary(log_dir: str, app_name: str) -> SummaryWriter:
+    """Reference ``utils/visualization/ValidationSummary.scala``."""
+    return SummaryWriter(os.path.join(log_dir, app_name), "validation")

@@ -115,6 +115,8 @@ class Optimizer:
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
+        self._preempt_signals: tuple = ()
+        self._preempted = False
 
     # ---- builder API (reference names, snake_case) -----------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -160,6 +162,16 @@ class Optimizer:
         self._val_summary = SummaryWriter(log_dir, "validation")
         return self
 
+    def set_preemption_checkpoint(self, *signals) -> "Optimizer":
+        """Save a checkpoint and stop cleanly when the process receives a
+        preemption signal (default SIGTERM — what TPU-VM maintenance events
+        deliver).  SURVEY.md §6.3 TPU mapping of the reference's
+        checkpoint-restart stance; requires ``set_checkpoint``."""
+        import signal as _signal
+
+        self._preempt_signals = signals or (_signal.SIGTERM,)
+        return self
+
     # ---- the driver loop --------------------------------------------------
     def optimize(self) -> TrainedModel:
         engine = Engine.get()
@@ -182,15 +194,45 @@ class Optimizer:
             "epoch": 1, "iteration": 0, "epoch_finished": False,
             "loss": float("nan"), "score": float("-inf"),
         }
-        retries = 0
-        max_retries = engine.config.failure_retry_times
 
         # resume if a checkpoint exists
         if self._ckpt_path:
             self._try_resume(step_engine, state)
 
+        # preemption-aware save: flag-based — the handler must not touch jax
+        # from signal context, so the loop checkpoints at the next iteration
+        old_handlers = []
+        self._preempted = False
+        if self._preempt_signals:
+            import signal as _signal
+
+            if not self._ckpt_path:
+                raise ValueError(
+                    "set_preemption_checkpoint requires set_checkpoint")
+
+            def _on_preempt(signum, frame):
+                self._preempted = True
+
+            for s in self._preempt_signals:
+                old_handlers.append((s, _signal.signal(s, _on_preempt)))
+
+        try:
+            return self._optimize_loop(step_engine, state)
+        finally:
+            if old_handlers:
+                import signal as _signal
+
+                for s, h in old_handlers:
+                    _signal.signal(s, h)
+
+    def _optimize_loop(self, step_engine, state) -> TrainedModel:
+        engine = Engine.get()
+        retries = 0
+        max_retries = engine.config.failure_retry_times
         t_loop = time.perf_counter()
         while not self.end_when(state):
+            if self._preempted:
+                break
             state["epoch_finished"] = False
             epoch = state["epoch"]
             batch_iter = self.dataset.batches(
@@ -204,6 +246,12 @@ class Optimizer:
                     if self._should_log(state):
                         self._log_progress(state, t_loop)
                     self._fire_triggers(step_engine, state)
+                    if self._preempted:
+                        log.warning(
+                            "preemption signal received: checkpointing at "
+                            "iteration %d and stopping", state["iteration"])
+                        self._save_checkpoint(step_engine, state)
+                        break
                     if self.end_when(state):
                         break
                 else:
@@ -270,13 +318,16 @@ class Optimizer:
         if (self._ckpt_trigger and self._ckpt_trigger(state)
                 and self._ckpt_path and self._last_ckpt_iter != it):
             self._last_ckpt_iter = it
-            state["loss"] = float(state["loss"])
-            ckpt.save_checkpoint(
-                self._ckpt_path, state["iteration"],
-                flat_params=np.asarray(step_engine.flat_params),
-                opt_state=host_fetch(step_engine.opt_state),
-                model_state=host_fetch(step_engine.model_state),
-                driver_state=state)
+            self._save_checkpoint(step_engine, state)
+
+    def _save_checkpoint(self, step_engine, state):
+        state["loss"] = float(state["loss"])
+        ckpt.save_checkpoint(
+            self._ckpt_path, state["iteration"],
+            flat_params=np.asarray(step_engine.flat_params),
+            opt_state=host_fetch(step_engine.opt_state),
+            model_state=host_fetch(step_engine.model_state),
+            driver_state=state)
 
     def _run_validation(self, step_engine, state):
         batches = self._val_dataset.batches(

@@ -138,6 +138,30 @@ class TestAutoTS:
         np.testing.assert_allclose(re.predict(tsdata), pred, rtol=1e-4,
                                    atol=1e-5)
 
+    def test_predict_includes_final_window_and_unscales(self):
+        from bigdl_tpu.forecast.autots import TSPipeline
+        from bigdl_tpu.forecast.forecaster import LSTMForecaster
+        from bigdl_tpu.forecast.tsdataset import TSDataset
+
+        df = _series(260)
+        # train on a scaled dataset, keep values in original units offset
+        df["value"] = df["value"] * 50 + 500
+        tsdata = TSDataset.from_pandas(df, dt_col="dt",
+                                       target_col="value").scale()
+        fc = LSTMForecaster(past_seq_len=24, future_seq_len=4,
+                            input_feature_num=1, output_feature_num=1,
+                            hidden_dim=16)
+        x, y = tsdata.roll(24, 4).to_numpy()
+        fc.fit((x, y), epochs=3)
+        ppl = TSPipeline(fc, 24, 4, scaler=tsdata.scaler)
+
+        fresh = TSDataset.from_pandas(df, dt_col="dt", target_col="value")
+        pred = ppl.predict(fresh)
+        # horizon=0 roll => one window per trailing position incl. the LAST
+        assert pred.shape == (260 - 24 + 1, 4, 1)
+        # outputs are inverse-transformed to original units (~500-ish scale)
+        assert 300 < float(np.mean(pred)) < 700, float(np.mean(pred))
+
     def test_manual_pipeline_save(self, tmp_path):
         from bigdl_tpu.forecast.autots import TSPipeline
         from bigdl_tpu.forecast.forecaster import LSTMForecaster
