@@ -57,21 +57,23 @@ class TSPipeline:
         self.scaler = scaler
         self.best_config = best_config or {}
 
+    def _scaled_copy(self, data: TSDataset) -> TSDataset:
+        """Model-space view of a TSDataset WITHOUT mutating the caller's
+        object (TSDataset ops are in-place by design)."""
+        if self.scaler is not None and data.scaler is None:
+            return data.copy().scale(self.scaler, fit=False)
+        return data
+
     def _rolled(self, data):
         if isinstance(data, TSDataset):
-            if self.scaler is not None and data.scaler is None:
-                data = data.scale(self.scaler, fit=False)
-            return data.roll(self.lookback, self.horizon).to_numpy()
+            return self._scaled_copy(data).roll(
+                self.lookback, self.horizon).to_numpy()
         return data
 
     def _unscale_y(self, y: np.ndarray) -> np.ndarray:
-        if self.scaler is None:
-            return y
-        n_t = y.shape[-1]
-        mean = np.asarray(self.scaler.mean_ if hasattr(self.scaler, "mean_")
-                          else self.scaler.min_)[0, :n_t]
-        scale = np.asarray(self.scaler.scale_)[0, :n_t]
-        return y * scale + mean
+        from bigdl_tpu.forecast.tsdataset import unscale_array
+
+        return unscale_array(self.scaler, y, y.shape[-1])
 
     def fit(self, data, epochs: int = 5, batch_size: int = 32) -> "TSPipeline":
         """Incremental fit on new data (reference: TSPipeline.fit)."""
@@ -87,9 +89,7 @@ class TSPipeline:
         Raw ndarray input: treated as already-preprocessed model-space
         windows; predictions come back in model space unchanged."""
         if isinstance(data, TSDataset):
-            if self.scaler is not None and data.scaler is None:
-                data = data.scale(self.scaler, fit=False)
-            x, _ = data.roll(self.lookback, 0).to_numpy()
+            x, _ = self._scaled_copy(data).roll(self.lookback, 0).to_numpy()
             return self._unscale_y(
                 np.asarray(self.forecaster.predict(x, batch_size)))
         x = np.asarray(data, np.float32)
@@ -97,7 +97,23 @@ class TSPipeline:
 
     def evaluate(self, data, metrics: Sequence[str] = ("mse",),
                  batch_size: int = 32) -> Dict[str, float]:
+        """Metrics in ORIGINAL units for TSDataset input (matching what
+        predict returns); raw model-space arrays are scored as given."""
         x, y = self._rolled(data)
+        if isinstance(data, TSDataset) and self.scaler is not None:
+            pred = self._unscale_y(
+                np.asarray(self.forecaster.predict(x, batch_size)))
+            y = self._unscale_y(np.asarray(y))
+            out = {}
+            for m in metrics:
+                err = pred - y
+                if m.lower() == "mse":
+                    out[m] = float(np.mean(err ** 2))
+                elif m.lower() == "mae":
+                    out[m] = float(np.mean(np.abs(err)))
+                else:
+                    raise ValueError(f"unknown metric {m!r}")
+            return out
         return self.forecaster.evaluate((x, y), metrics, batch_size)
 
     def save(self, path: str) -> None:

@@ -46,6 +46,18 @@ class MinMaxScaler:
         return arr * self.scale_ + self.min_
 
 
+def unscale_array(scaler, arr: np.ndarray, n_targets: int) -> np.ndarray:
+    """Inverse-transform the target slice of a prediction array using a
+    fitted scaler's stats (shared by TSDataset.unscale_numpy and
+    TSPipeline — the only places that know scaler stat layout)."""
+    if scaler is None:
+        return arr
+    shift = np.asarray(scaler.mean_ if hasattr(scaler, "mean_")
+                       else scaler.min_)[0, :n_targets]
+    scale = np.asarray(scaler.scale_)[0, :n_targets]
+    return arr * scale + shift
+
+
 class TSDataset:
     """Chained preprocessing over a per-id long-format DataFrame."""
 
@@ -68,6 +80,14 @@ class TSDataset:
                     id_col: Optional[str] = None,
                     extra_feature_col=None) -> "TSDataset":
         return TSDataset(df, dt_col, target_col, id_col, extra_feature_col)
+
+    def copy(self) -> "TSDataset":
+        """Independent copy (own DataFrame) — chained mutating steps on the
+        copy leave the original untouched."""
+        out = TSDataset(self.df, self.dt_col, self.target_cols, self.id_col,
+                        self.feature_cols)
+        out.scaler = self.scaler
+        return out
 
     # -- per-id apply -------------------------------------------------------
     def _groups(self):
@@ -152,14 +172,7 @@ class TSDataset:
 
     def unscale_numpy(self, arr: np.ndarray) -> np.ndarray:
         """Unscale a rolled prediction array (N, horizon, n_targets)."""
-        if self.scaler is None:
-            return arr
-        n_t = len(self.target_cols)
-        mean = np.asarray(self.scaler.mean_
-                          if hasattr(self.scaler, "mean_")
-                          else self.scaler.min_)[0, :n_t]
-        scale = np.asarray(self.scaler.scale_)[0, :n_t]
-        return arr * scale + mean
+        return unscale_array(self.scaler, arr, len(self.target_cols))
 
     # -- windowing ----------------------------------------------------------
     def roll(self, lookback: int, horizon: int,

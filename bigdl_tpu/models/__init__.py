@@ -2,7 +2,8 @@ from bigdl_tpu.models.lenet import LeNet5
 from bigdl_tpu.models.resnet import resnet_cifar, resnet50, BasicBlock, Bottleneck
 from bigdl_tpu.models.inception import inception_v1, inception_module
 from bigdl_tpu.models.vgg import vgg16, vgg_cifar10
-from bigdl_tpu.models.rnn_zoo import char_rnn, Seq2Seq, autoencoder
+from bigdl_tpu.models.rnn_zoo import char_rnn, Seq2Seq
+from bigdl_tpu.models.autoencoder import Encoder, autoencoder
 from bigdl_tpu.models.transformer_zoo import (
     TransformerEncoder, BERT, BERTClassifier,
 )
@@ -10,5 +11,6 @@ from bigdl_tpu.models.transformer_zoo import (
 __all__ = [
     "LeNet5", "resnet_cifar", "resnet50", "BasicBlock", "Bottleneck",
     "inception_v1", "inception_module", "vgg16", "vgg_cifar10", "char_rnn",
-    "Seq2Seq", "autoencoder", "TransformerEncoder", "BERT", "BERTClassifier",
+    "Seq2Seq", "autoencoder", "Encoder", "TransformerEncoder", "BERT",
+    "BERTClassifier",
 ]

@@ -1,0 +1,59 @@
+"""Autoencoder zoo model.
+
+Reference analog (unverified — mount empty): ``dllib/models/autoencoder/``
+(SURVEY.md §3.1 model-zoo row) — the MNIST fully-connected autoencoder
+example (784 → hidden → 784 with sigmoid output trained against the
+input).
+
+TPU note: widths are kept at MXU-friendly multiples of 128 by default.
+"""
+
+from typing import Sequence, Union
+
+from bigdl_tpu.nn.layers import Flatten, Linear, ReLU, Sigmoid
+from bigdl_tpu.nn.module import Module, Sequential
+
+
+def autoencoder(input_dim: int = 784,
+                hidden: Union[int, Sequence[int]] = (128, 32),
+                final_activation: str = "sigmoid") -> Sequential:
+    """Symmetric MLP autoencoder — reference ``models/autoencoder/
+    Autoencoder.scala`` shape (encoder mirrored into decoder)."""
+    if isinstance(hidden, int):
+        hidden = (hidden,)
+    layers = [Flatten()]
+    dims = [input_dim] + list(hidden)
+    for i in range(1, len(dims)):
+        layers += [Linear(dims[i - 1], dims[i]), ReLU()]
+    rev = list(reversed(dims))
+    for i in range(1, len(rev)):
+        layers += [Linear(rev[i - 1], rev[i])]
+        if i < len(rev) - 1:
+            layers.append(ReLU())
+    if final_activation == "sigmoid":
+        layers.append(Sigmoid())
+    return Sequential(layers)
+
+
+class Encoder(Module):
+    """Encoder half of a trained autoencoder: reuse the trained params to
+    embed inputs (the common downstream use)."""
+
+    def __init__(self, auto: Sequential, n_hidden_layers: int, name=None):
+        super().__init__(name)
+        # Flatten + (Linear, ReLU) * n_hidden_layers; trunk indices (and so
+        # param keys "i_name") line up with the autoencoder's own
+        self.trunk = Sequential(auto.layers[: 1 + 2 * n_hidden_layers])
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return self.trunk.forward(params, state, x, training=training,
+                                  rng=rng)
+
+    def encoder_variables(self, auto_variables):
+        """Slice the autoencoder's variables down to the encoder trunk."""
+        keep = {self.trunk._key(i) for i in range(len(self.trunk.layers))}
+        params = {k: v for k, v in auto_variables.get("params", {}).items()
+                  if k in keep}
+        st = {k: v for k, v in auto_variables.get("state", {}).items()
+              if k in keep}
+        return {"params": params, "state": st}

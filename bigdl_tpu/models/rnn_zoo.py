@@ -1,4 +1,4 @@
-"""Sequence model zoo: char-RNN, Seq2Seq, autoencoder.
+"""Sequence model zoo: char-RNN, Seq2Seq.
 
 Reference analog (unverified — mount empty): ``dllib/models/rnn/`` (PTB
 char/word LM: LookupTable -> Recurrent(LSTM) -> TimeDistributed(Linear) ->
@@ -58,9 +58,3 @@ class Seq2Seq(nn.Module):
         return out, {}
 
 
-def autoencoder(input_dim: int = 784, hidden: int = 32) -> nn.Sequential:
-    """Reference ``models/autoencoder`` (MNIST AE)."""
-    return nn.Sequential([
-        nn.Linear(input_dim, hidden), nn.ReLU(),
-        nn.Linear(hidden, input_dim), nn.Sigmoid(),
-    ])

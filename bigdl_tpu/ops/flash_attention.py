@@ -12,11 +12,11 @@ Forward is a Pallas kernel with grid (batch·heads, q-blocks, k-blocks);
 the k dimension is innermost and iterates sequentially on-core, carrying
 the online-softmax running (max, denom, accumulator) in VMEM scratch —
 the k/v BlockSpecs stream one tile per step from HBM.  Backward is a
-custom VJP using the saved logsumexp: the standard flash-attention
-backward recurrence evaluated with jnp einsums (XLA fuses it well; a
-fully blocked backward kernel is a later perf item — ring attention in
-``bigdl_tpu/parallel/ring_attention.py`` covers the long-context regime
-where O(S²) backward would not fit).
+custom VJP: the standard flash-attention backward recurrence evaluated
+blockwise with a ``lax.scan`` over k/v tiles using the saved logsumexp,
+so the O(S²) score matrix is never materialised in either direction
+(single-chip long context; cross-chip sequence parallelism lives in
+``bigdl_tpu/parallel/ring_attention.py``).
 
 Shapes: q, k, v are (batch, heads, seq, head_dim); output matches q.
 """
@@ -138,28 +138,50 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return out, lse  # lse: (b, h, sq)
 
 
-def _reference_bwd(q, k, v, out, lse, g, sm_scale, causal):
-    """Flash-attention backward recurrence with the saved logsumexp.
-
-    p = exp(q·kᵀ·scale − lse) is reconstructed tile-free by XLA fusion;
-    D = rowsum(g ⊙ out) gives dS = p ⊙ (g·vᵀ − D)."""
+def _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal, block_k=128):
+    """Memory-efficient flash-attention backward: a ``lax.scan`` over k/v
+    blocks reconstructs one (sq × block_k) score tile at a time from the
+    saved logsumexp — peak memory O(S·block) instead of the O(S²) full
+    score matrix.  Recurrence: p = exp(q·kᵀ·scale − lse);
+    D = rowsum(g ⊙ out); dS = p ⊙ (g·vᵀ − D)·scale."""
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     gf = g.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
-    if causal:
-        sq, skv = s.shape[-2], s.shape[-1]
-        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
-        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
-    ds = p * (dp - delta[..., None]) * sm_scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    b, h, sq, d = qf.shape
+    skv = kf.shape[2]
+    bk = min(block_k, round_up(skv, 8))
+    skv_p = round_up(skv, bk)
+    kp = jnp.pad(kf, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    vp = jnp.pad(vf, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    # (nblocks, b, h, bk, d) scan layout
+    kb = kp.reshape(b, h, skv_p // bk, bk, d).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(b, h, skv_p // bk, bk, d).transpose(2, 0, 1, 3, 4)
+
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (b,h,sq)
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 0)
+    k_off = jax.lax.broadcasted_iota(jnp.int32, (sq, bk), 1)
+
+    def step(dq_acc, inp):
+        j, k_j, v_j = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_j) * sm_scale
+        k_pos = j * bk + k_off
+        mask = k_pos < skv
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_j)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_j)
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq_acc, (dk_j, dv_j)
+
+    nb = skv_p // bk
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        step, jnp.zeros_like(qf), (jnp.arange(nb), kb, vb))
+    dk = dk_b.transpose(1, 2, 0, 3, 4).reshape(b, h, skv_p, d)[:, :, :skv]
+    dv = dv_b.transpose(1, 2, 0, 3, 4).reshape(b, h, skv_p, d)[:, :, :skv]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -178,7 +200,8 @@ def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    return _reference_bwd(q, k, v, out, lse, g, sm_scale, causal)
+    return _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal,
+                          block_k=block_k)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

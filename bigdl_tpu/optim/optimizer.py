@@ -117,6 +117,7 @@ class Optimizer:
         self._last_ckpt_iter = -1
         self._preempt_signals: tuple = ()
         self._preempted = False
+        self._profiler = None
 
     # ---- builder API (reference names, snake_case) -----------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -160,6 +161,16 @@ class Optimizer:
 
     def set_val_summary(self, log_dir: str) -> "Optimizer":
         self._val_summary = SummaryWriter(log_dir, "validation")
+        return self
+
+    def set_profile(self, log_dir: str, start_iter: int = 10,
+                    num_iters: int = 5) -> "Optimizer":
+        """Capture a jax.profiler trace over a warm window of iterations —
+        SURVEY.md §6.1 TPU mapping of the reference's per-iteration Metrics
+        dump."""
+        from bigdl_tpu.utils.profiling import IterationProfiler
+
+        self._profiler = IterationProfiler(log_dir, start_iter, num_iters)
         return self
 
     def set_preemption_checkpoint(self, *signals) -> "Optimizer":
@@ -219,6 +230,8 @@ class Optimizer:
         try:
             return self._optimize_loop(step_engine, state)
         finally:
+            if self._profiler is not None:
+                self._profiler.close()
             if old_handlers:
                 import signal as _signal
 
@@ -232,6 +245,9 @@ class Optimizer:
         t_loop = time.perf_counter()
         while not self.end_when(state):
             if self._preempted:
+                # signal landed during epoch-boundary work (validation,
+                # triggers) — still honour the save-before-stop contract
+                self._save_checkpoint_once(step_engine, state)
                 break
             state["epoch_finished"] = False
             epoch = state["epoch"]
@@ -250,7 +266,7 @@ class Optimizer:
                         log.warning(
                             "preemption signal received: checkpointing at "
                             "iteration %d and stopping", state["iteration"])
-                        self._save_checkpoint(step_engine, state)
+                        self._save_checkpoint_once(step_engine, state)
                         break
                     if self.end_when(state):
                         break
@@ -281,6 +297,8 @@ class Optimizer:
     # ------------------------------------------------------------------
     def _one_iteration(self, step_engine, state, mb):
         it = state["iteration"]
+        if self._profiler is not None:
+            self._profiler.step(it)
         step_rng = jax.random.fold_in(jax.random.PRNGKey(self.seed + 1), it)
         with Timer(self.metrics, "step_dispatch"):
             loss = step_engine.train_step(
@@ -318,6 +336,13 @@ class Optimizer:
         if (self._ckpt_trigger and self._ckpt_trigger(state)
                 and self._ckpt_path and self._last_ckpt_iter != it):
             self._last_ckpt_iter = it
+            self._save_checkpoint(step_engine, state)
+
+    def _save_checkpoint_once(self, step_engine, state):
+        """Checkpoint unless this iteration was already checkpointed (the
+        trigger may have fired just before a preemption break)."""
+        if self._last_ckpt_iter != state["iteration"]:
+            self._last_ckpt_iter = state["iteration"]
             self._save_checkpoint(step_engine, state)
 
     def _save_checkpoint(self, step_engine, state):

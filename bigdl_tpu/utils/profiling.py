@@ -1,0 +1,75 @@
+"""Profiling — TensorBoard trace capture around training iterations.
+
+Reference analog (unverified — mount empty): ``dllib/optim/Metrics.scala``'s
+per-iteration timing breakdown + mkldnn perf-dump flags (SURVEY.md §6.1).
+TPU mapping per the survey: ``jax.profiler`` traces (XLA op-level timeline,
+viewable in TensorBoard's trace viewer / xprof) replace the hand-rolled
+counters for device-side visibility; the host-side ``Metrics`` timers stay
+for the input-pipeline/dispatch split.
+"""
+
+import contextlib
+from typing import Optional
+
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace for the enclosed block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", log_dir)
+
+
+class IterationProfiler:
+    """Trace a window of training iterations — the pattern the reference's
+    per-iteration Metrics dump serves: profile steps [start, stop) once the
+    pipeline is warm (never step 0: that would capture compile, not
+    steady state)."""
+
+    def __init__(self, log_dir: str, start_iter: int = 10,
+                 num_iters: int = 5):
+        self.log_dir = log_dir
+        self.start_iter = max(1, start_iter)
+        self.stop_iter = self.start_iter + num_iters
+        self._active = False
+        self.done = False
+
+    def step(self, iteration: int) -> None:
+        """Call once per training iteration (before the step dispatch)."""
+        import jax
+
+        if self.done:
+            return
+        if not self._active and iteration >= self.start_iter:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop_iter:
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+            log.info("profiler trace (iters %d-%d) written to %s",
+                     self.start_iter, self.stop_iter - 1, self.log_dir)
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+
+
+def annotate(name: str):
+    """Named region for the trace viewer (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

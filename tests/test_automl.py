@@ -156,11 +156,18 @@ class TestAutoTS:
         ppl = TSPipeline(fc, 24, 4, scaler=tsdata.scaler)
 
         fresh = TSDataset.from_pandas(df, dt_col="dt", target_col="value")
+        before = fresh.df["value"].to_numpy().copy()
         pred = ppl.predict(fresh)
         # horizon=0 roll => one window per trailing position incl. the LAST
         assert pred.shape == (260 - 24 + 1, 4, 1)
         # outputs are inverse-transformed to original units (~500-ish scale)
         assert 300 < float(np.mean(pred)) < 700, float(np.mean(pred))
+        # the caller's dataset is NOT mutated by internal scaling
+        np.testing.assert_array_equal(fresh.df["value"].to_numpy(), before)
+        assert fresh.scaler is None
+        # evaluate reports metrics in the same original units as predict
+        ev = ppl.evaluate(fresh, metrics=["mse", "mae"])
+        assert ev["mae"] < 200, ev  # original-unit scale, not z-scores
 
     def test_manual_pipeline_save(self, tmp_path):
         from bigdl_tpu.forecast.autots import TSPipeline
