@@ -101,18 +101,19 @@ class TSPipeline:
         predict returns); raw model-space arrays are scored as given."""
         x, y = self._rolled(data)
         if isinstance(data, TSDataset) and self.scaler is not None:
+            # score in original units with the same ValidationMethod
+            # implementations forecaster.evaluate uses
+            from bigdl_tpu.optim.validation import MAE, MSE
+
+            table = {"mse": MSE, "mae": MAE}
             pred = self._unscale_y(
                 np.asarray(self.forecaster.predict(x, batch_size)))
             y = self._unscale_y(np.asarray(y))
             out = {}
             for m in metrics:
-                err = pred - y
-                if m.lower() == "mse":
-                    out[m] = float(np.mean(err ** 2))
-                elif m.lower() == "mae":
-                    out[m] = float(np.mean(np.abs(err)))
-                else:
-                    raise ValueError(f"unknown metric {m!r}")
+                method = table[m.lower()]()
+                s, c = method.batch_stats(pred, y, np.ones((len(y),)))
+                out[m] = method.fold(float(s), float(c)).result
             return out
         return self.forecaster.evaluate((x, y), metrics, batch_size)
 

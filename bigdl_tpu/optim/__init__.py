@@ -1,6 +1,6 @@
 from bigdl_tpu.optim.optim_method import (
-    OptimMethod, SGD, Adam, ParallelAdam, AdamWeightDecay, Adagrad, RMSprop,
-    Ftrl, LarsSGD,
+    OptimMethod, SGD, Adam, ParallelAdam, AdamWeightDecay, Adagrad, Adadelta,
+    Adamax, RMSprop, Ftrl, LarsSGD,
 )
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Step, MultiStep, Exponential, NaturalExp,

@@ -208,6 +208,60 @@ class RMSprop(OptimMethod):
         return new_params, {"rms": rms}
 
 
+class Adadelta(OptimMethod):
+    """Reference ``optim/Adadelta.scala`` (accumulated-delta scaling; no
+    global learning rate in the classic formulation — ``learning_rate``
+    multiplies the final step as in the reference)."""
+
+    def __init__(self, learning_rate: float = 1.0, decay_rate: float = 0.9,
+                 epsilon: float = 1e-10):
+        self.lr = learning_rate
+        self.rho = decay_rate
+        self.eps = epsilon
+
+    def init_state(self, params):
+        return {"accum": _tmap(jnp.zeros_like, params),
+                "delta": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        rho, eps = self.rho, self.eps
+        accum = _tmap(lambda a, g: rho * a + (1 - rho) * g * g,
+                      state["accum"], grads)
+        upd = _tmap(
+            lambda g, d, a: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+            grads, state["delta"], accum)
+        delta = _tmap(lambda d, u: rho * d + (1 - rho) * u * u,
+                      state["delta"], upd)
+        new_params = _tmap(lambda p, u: p - self.lr * u, params, upd)
+        return new_params, {"accum": accum, "delta": delta}
+
+
+class Adamax(OptimMethod):
+    """Reference ``optim/Adamax.scala`` (Adam with an infinity-norm second
+    moment)."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        self.lr = learning_rate
+        self.b1 = beta1
+        self.b2 = beta2
+        self.eps = epsilon
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def update(self, step, grads, params, state):
+        t = step + 1
+        m = _tmap(lambda m, g: self.b1 * m + (1 - self.b1) * g,
+                  state["m"], grads)
+        u = _tmap(lambda u, g: jnp.maximum(self.b2 * u, jnp.abs(g) + self.eps),
+                  state["u"], grads)
+        lr_t = self.lr / (1.0 - self.b1 ** t)
+        new_params = _tmap(lambda p, m, u: p - lr_t * m / u, params, m, u)
+        return new_params, {"m": m, "u": u}
+
+
 class Ftrl(OptimMethod):
     """Reference ``optim/Ftrl.scala`` (recsys sparse-ish method)."""
 

@@ -15,6 +15,7 @@ XLA serializes device execution — but the thread-safe façade remains.
 from bigdl_tpu.serving.inference_model import InferenceModel
 from bigdl_tpu.serving.server import ServingConfig, ServingServer
 from bigdl_tpu.serving.client import InputQueue, OutputQueue
+from bigdl_tpu.serving.http_frontend import HttpClient, HttpFrontend
 
 __all__ = ["InferenceModel", "ServingServer", "ServingConfig",
-           "InputQueue", "OutputQueue"]
+           "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient"]

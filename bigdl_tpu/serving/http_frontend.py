@@ -1,0 +1,118 @@
+"""HTTP frontend for synchronous serving.
+
+Reference analog (unverified — mount empty): the Cluster Serving HTTP
+frontend (``scala/serving/.../http/``, akka/netty — SURVEY.md §3.4 row
+"Cluster Serving engine"): a sync REST endpoint in front of the
+streaming engine.
+
+TPU-native: a stdlib ``ThreadingHTTPServer`` over the in-process
+``ServingServer`` queue — requests POST JSON, the dispatcher thread
+dynamic-batches them onto the chip exactly as queue clients do.
+
+    POST /predict   {"instances": [[...], ...]}  -> {"predictions": [...]}
+    GET  /health    -> {"status": "ok", "batches": N, "requests": M}
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib import request as _urlreq
+
+import numpy as np
+
+from bigdl_tpu.serving.server import ServingServer
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.serving.http")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "bigdl-tpu-serving/1"
+
+    def log_message(self, fmt, *args):  # route to our logger, not stderr
+        log.debug(fmt, *args)
+
+    def _json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path != "/health":
+            return self._json(404, {"error": f"unknown path {self.path}"})
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        self._json(200, {"status": "ok", **srv.stats})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            return self._json(404, {"error": f"unknown path {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            instances = np.asarray(payload["instances"], np.float32)
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            # TypeError covers valid-JSON non-object bodies ([1,2,3], 42)
+            return self._json(400, {"error": f"bad request: {e}"})
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            rid = srv.enqueue(instances)
+            result = srv.query(rid, timeout=self.server.predict_timeout)
+        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+            return self._json(500, {"error": str(e)})
+        self._json(200, {"predictions": np.asarray(result).tolist()})
+
+
+class HttpFrontend:
+    """Serve a ServingServer over HTTP (threaded stdlib server)."""
+
+    def __init__(self, serving: ServingServer, host: str = "127.0.0.1",
+                 port: int = 0, predict_timeout: float = 30.0):
+        self.serving = serving
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.serving = serving  # type: ignore[attr-defined]
+        self._httpd.predict_timeout = predict_timeout  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "HttpFrontend":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("HTTP frontend listening on %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class HttpClient:
+    """Tiny client for the frontend (reference python http client analog)."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def predict(self, instances) -> np.ndarray:
+        body = json.dumps(
+            {"instances": np.asarray(instances).tolist()}).encode()
+        req = _urlreq.Request(self.url + "/predict", data=body,
+                              headers={"Content-Type": "application/json"})
+        with _urlreq.urlopen(req, timeout=self.timeout) as resp:
+            out = json.loads(resp.read())
+        return np.asarray(out["predictions"], np.float32)
+
+    def health(self) -> dict:
+        with _urlreq.urlopen(self.url + "/health",
+                             timeout=self.timeout) as resp:
+            return json.loads(resp.read())

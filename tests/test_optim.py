@@ -29,6 +29,8 @@ def quad_problem():
     optim.Adagrad(learning_rate=0.5),
     optim.RMSprop(learning_rate=0.05),
     optim.Ftrl(learning_rate=0.5),
+    optim.Adadelta(learning_rate=1.0, decay_rate=0.9, epsilon=1e-2),
+    optim.Adamax(learning_rate=0.1),
 ])
 def test_methods_converge_on_quadratic(method):
     params, target, grads = quad_problem()

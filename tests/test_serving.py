@@ -76,3 +76,46 @@ def test_serving_concurrent_clients():
         assert server.stats["requests"] == 12
     finally:
         server.stop()
+
+
+def test_http_frontend_roundtrip():
+    from bigdl_tpu.serving import HttpClient, HttpFrontend
+
+    model, v = _model_and_vars()
+    server = ServingServer(InferenceModel(model, v),
+                           ServingConfig(batch_size=8)).start()
+    frontend = HttpFrontend(server).start()
+    try:
+        client = HttpClient(frontend.url)
+        x = np.random.RandomState(2).rand(3, 4).astype(np.float32)
+        pred = client.predict(x)
+        ref, _ = model.apply(v, x)
+        np.testing.assert_allclose(pred, np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+        h = client.health()
+        assert h["status"] == "ok" and h["requests"] >= 1
+    finally:
+        frontend.stop()
+        server.stop()
+
+
+def test_http_frontend_bad_request():
+    from urllib import request as urlreq
+    from urllib.error import HTTPError
+
+    from bigdl_tpu.serving import HttpFrontend
+
+    model, v = _model_and_vars()
+    server = ServingServer(InferenceModel(model, v)).start()
+    frontend = HttpFrontend(server).start()
+    try:
+        req = urlreq.Request(frontend.url + "/predict", data=b"not json",
+                             headers={"Content-Type": "application/json"})
+        try:
+            urlreq.urlopen(req, timeout=10)
+            assert False, "expected HTTP 400"
+        except HTTPError as e:
+            assert e.code == 400
+    finally:
+        frontend.stop()
+        server.stop()
