@@ -12,6 +12,23 @@ from bigdl_tpu.nn.layers import (
     SoftSign, HardSigmoid, SoftMax, LogSoftMax, LeakyReLU, ELU, HardTanh,
     PReLU,
 )
+from bigdl_tpu.nn.layers_extra import (
+    Conv3D, VolumetricConvolution, Conv2DTranspose, SpatialFullConvolution,
+    Deconvolution2D, DepthwiseConv2D, SeparableConv2D,
+    SpatialSeparableConvolution, LocallyConnected2D, MaxPool1D, AvgPool1D,
+    TemporalMaxPooling, MaxPool3D, AvgPool3D, VolumetricMaxPooling,
+    VolumetricAveragePooling, GlobalMaxPool2D, GlobalMaxPool1D,
+    GlobalAvgPool1D, UpSampling2D, ResizeBilinear, UpSampling1D, UpSampling3D,
+    Cropping2D, Cropping1D, ZeroPadding1D, ZeroPadding3D, Padding, Power,
+    Square, Sqrt, Log, Exp, Abs, Negative, Clamp, AddConstant, MulConstant,
+    Threshold, SoftMin, LogSigmoid, ThresholdedReLU, Sum, Mean, Max, Min,
+    CMul, CAdd, Mul, Add, Scale, CSubTable, CDivTable, CMaxTable, CMinTable,
+    CAveTable, MM, MV, DotProduct, CosineDistance, PairwiseDistance,
+    NarrowTable, FlattenTable, Select, Narrow, Masking, RepeatVector, Permute,
+    Normalize, LRN, SpatialCrossMapLRN, SpatialDropout2D, SpatialDropout1D,
+    GaussianNoise, GaussianDropout, Highway, Maxout, Bilinear, Cosine,
+    Euclidean, SReLU,
+)
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,
 )

@@ -1,0 +1,1125 @@
+"""Extended layer catalog — second batch toward the reference's ~300 layers.
+
+Reference analog (unverified — mount empty): ``dllib/nn/*.scala`` one file per
+layer (VolumetricConvolution, SpatialFullConvolution, SpatialCrossMapLRN,
+Power/Square/Sqrt/Log/Exp/AddConstant/MulConstant, Sum/Mean/Max/Min, CMul/CAdd/
+Mul/Add/Scale, C{Sub,Div,Max,Min}Table, MM/MV/DotProduct/CosineDistance/
+PairwiseDistance, Select/Narrow, Normalize, Maxout, Bilinear, Cosine,
+Euclidean, Threshold, ...) plus keras-side layers (Highway, Masking,
+GaussianNoise/GaussianDropout, SpatialDropout, RepeatVector, Permute,
+Cropping, UpSampling, SeparableConvolution2D, LocallyConnected, SReLU,
+ThresholdedReLU).
+
+All spatial layers are NHWC / NDHWC (TPU-first); kernels HWIO / DHWIO.
+"""
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.layers import PadLike, _conv_accum, _conv_padding, _pair
+from bigdl_tpu.nn.module import EMPTY, Module, _table
+from bigdl_tpu.tensor.policy import cast_compute
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+
+# ---------------------------------------------------------------------------
+# Convolution family
+# ---------------------------------------------------------------------------
+
+
+class Conv3D(Module):
+    """3-D convolution (NDHWC) — reference ``nn/VolumetricConvolution.scala``."""
+
+    def __init__(self, in_channels: Optional[int], out_channels: int,
+                 kernel_size, stride=1, padding: Union[str, int] = 0,
+                 dilation=1, with_bias: bool = True,
+                 weight_init=init_mod.msra, bias_init=init_mod.zeros, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride)
+        self.padding = padding
+        self.dilation = _triple(dilation)
+        self.with_bias = with_bias
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        kd, kh, kw = self.kernel_size
+        fan_in = cin * kd * kh * kw
+        fan_out = self.out_channels * kd * kh * kw
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": self.weight_init(
+            k1, (kd, kh, kw, cin, self.out_channels), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k2, (self.out_channels,), fan_in,
+                                            fan_out)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            p = _triple(self.padding)
+            pad = [(pi, pi) for pi in p]
+        xc, wc = cast_compute(x, params["weight"])
+        y = jax.lax.conv_general_dilated(
+            xc, wc, window_strides=self.stride, padding=pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"), **_conv_accum(xc))
+        if self.with_bias:
+            y = y.astype(jnp.float32) + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+VolumetricConvolution = Conv3D
+
+
+class Conv2DTranspose(Module):
+    """Transposed 2-D conv — reference ``nn/SpatialFullConvolution.scala``."""
+
+    def __init__(self, in_channels: Optional[int], out_channels: int,
+                 kernel_size, stride=1, padding: Union[str, int] = 0,
+                 with_bias: bool = True, weight_init=init_mod.msra,
+                 bias_init=init_mod.zeros, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = padding
+        self.with_bias = with_bias
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        kh, kw = self.kernel_size
+        fan_in = cin * kh * kw
+        fan_out = self.out_channels * kh * kw
+        k1, k2 = jax.random.split(rng)
+        # stored in forward-conv orientation (kh, kw, out, in) because
+        # conv_transpose(transpose_kernel=True) flips spatial dims and swaps
+        # the feature dims itself (matches torch ConvTranspose2d semantics)
+        params = {"weight": self.weight_init(
+            k1, (kh, kw, self.out_channels, cin), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k2, (self.out_channels,), fan_in,
+                                            fan_out)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            ph, pw = _pair(self.padding)
+            # match torch ConvTranspose2d semantics: output trimmed by padding
+            kh, kw = self.kernel_size
+            pad = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        xc, wc = cast_compute(x, params["weight"])
+        y = jax.lax.conv_transpose(
+            xc, wc, strides=self.stride, padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True, **_conv_accum(xc))
+        if self.with_bias:
+            y = y.astype(jnp.float32) + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+SpatialFullConvolution = Conv2DTranspose
+Deconvolution2D = Conv2DTranspose
+
+
+class DepthwiseConv2D(Module):
+    """Depthwise conv (channel multiplier) — the depthwise stage of reference
+    ``nn/SpatialSeparableConvolution.scala``."""
+
+    def __init__(self, in_channels: Optional[int] = None,
+                 kernel_size=3, stride=1, padding: PadLike = 0,
+                 depth_multiplier: int = 1, with_bias: bool = True,
+                 weight_init=init_mod.msra, bias_init=init_mod.zeros, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = padding
+        self.depth_multiplier = depth_multiplier
+        self.with_bias = with_bias
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        kh, kw = self.kernel_size
+        cout = cin * self.depth_multiplier
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": self.weight_init(
+            k1, (kh, kw, 1, cout), kh * kw, kh * kw * self.depth_multiplier)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k2, (cout,), kh * kw, cout)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        xc, wc = cast_compute(x, params["weight"])
+        y = jax.lax.conv_general_dilated(
+            xc, wc, window_strides=self.stride,
+            padding=_conv_padding(self.padding, kh, kw),
+            feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), **_conv_accum(xc))
+        if self.with_bias:
+            y = y.astype(jnp.float32) + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+class SeparableConv2D(Module):
+    """Depthwise-separable conv — reference
+    ``nn/SpatialSeparableConvolution.scala`` / keras ``SeparableConvolution2D``."""
+
+    def __init__(self, in_channels: Optional[int], out_channels: int,
+                 kernel_size=3, stride=1, padding: PadLike = 0,
+                 depth_multiplier: int = 1, with_bias: bool = True, name=None):
+        super().__init__(name)
+        self.depthwise = DepthwiseConv2D(
+            in_channels, kernel_size, stride, padding, depth_multiplier,
+            with_bias=False)
+        from bigdl_tpu.nn.layers import Conv2D
+
+        self.pointwise = Conv2D(None, out_channels, 1, with_bias=with_bias)
+
+    def build(self, rng, x):
+        k1, k2 = jax.random.split(rng)
+        pd, _ = self.depthwise.build(k1, x)
+        y, _ = self.depthwise.forward(pd, EMPTY, x)
+        pp, _ = self.pointwise.build(k2, y)
+        return {"depthwise": pd, "pointwise": pp}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        y, _ = self.depthwise.forward(params["depthwise"], EMPTY, x)
+        y, _ = self.pointwise.forward(params["pointwise"], EMPTY, y)
+        return y, EMPTY
+
+
+SpatialSeparableConvolution = SeparableConv2D
+
+
+class LocallyConnected2D(Module):
+    """Unshared-weight conv — reference ``nn/LocallyConnected2D.scala``.
+
+    Implemented as patch extraction + per-position einsum (maps to one big
+    batched matmul on the MXU instead of the reference's per-position gemm
+    loop)."""
+
+    def __init__(self, in_channels: Optional[int], out_channels: int,
+                 kernel_size, stride=1, with_bias: bool = True, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.with_bias = with_bias
+
+    def _out_hw(self, x):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        oh = (x.shape[1] - kh) // sh + 1
+        ow = (x.shape[2] - kw) // sw + 1
+        return oh, ow
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        kh, kw = self.kernel_size
+        oh, ow = self._out_hw(x)
+        fan_in = cin * kh * kw
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": init_mod.xavier(
+            k1, (oh, ow, kh * kw * cin, self.out_channels), fan_in,
+            self.out_channels)}
+        if self.with_bias:
+            params["bias"] = init_mod.zeros(
+                k2, (oh, ow, self.out_channels), fan_in, self.out_channels)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        kh, kw = self.kernel_size
+        cin = x.shape[-1]
+        # patches: (N, OH, OW, C*KH*KW) with channel-major ordering
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), self.stride, "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # conv_general_dilated_patches yields features ordered (C, KH, KW);
+        # reorder to (KH, KW, C) to match the weight layout
+        n, oh, ow, _ = patches.shape
+        patches = patches.reshape(n, oh, ow, cin, kh * kw)
+        patches = jnp.swapaxes(patches, -1, -2).reshape(n, oh, ow, -1)
+        y = jnp.einsum("nhwp,hwpo->nhwo", patches, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Pooling (1-D / 3-D / global)
+# ---------------------------------------------------------------------------
+
+
+class _Pool1D(Module):
+    def __init__(self, kernel_size: int, stride: Optional[int] = None,
+                 padding: int = 0, name=None):
+        super().__init__(name)
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+
+    def _run(self, x, init, op):
+        pad = [(0, 0), (self.padding, self.padding), (0, 0)]
+        return jax.lax.reduce_window(
+            x, init, op, (1, self.kernel_size, 1), (1, self.stride, 1), pad)
+
+
+class MaxPool1D(_Pool1D):
+    def forward(self, params, state, x, training=False, rng=None):
+        return self._run(x, -jnp.inf, jax.lax.max), EMPTY
+
+
+class AvgPool1D(_Pool1D):
+    def forward(self, params, state, x, training=False, rng=None):
+        return self._run(x, 0.0, jax.lax.add) / self.kernel_size, EMPTY
+
+
+TemporalMaxPooling = MaxPool1D
+
+
+class _Pool3D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(name)
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride if stride is not None else kernel_size)
+        self.padding = _triple(padding)
+
+    def _run(self, x, init, op):
+        kd, kh, kw = self.kernel_size
+        sd, sh, sw = self.stride
+        pd, ph, pw = self.padding
+        pad = [(0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0)]
+        return jax.lax.reduce_window(
+            x, init, op, (1, kd, kh, kw, 1), (1, sd, sh, sw, 1), pad)
+
+
+class MaxPool3D(_Pool3D):
+    """Reference ``nn/VolumetricMaxPooling.scala`` (NDHWC)."""
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return self._run(x, -jnp.inf, jax.lax.max), EMPTY
+
+
+class AvgPool3D(_Pool3D):
+    def forward(self, params, state, x, training=False, rng=None):
+        kd, kh, kw = self.kernel_size
+        return self._run(x, 0.0, jax.lax.add) / (kd * kh * kw), EMPTY
+
+
+VolumetricMaxPooling = MaxPool3D
+VolumetricAveragePooling = AvgPool3D
+
+
+class GlobalMaxPool2D(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2)), EMPTY
+
+
+class GlobalMaxPool1D(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.max(x, axis=1), EMPTY
+
+
+class GlobalAvgPool1D(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.mean(x, axis=1), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Upsampling / cropping / padding
+# ---------------------------------------------------------------------------
+
+
+class UpSampling2D(Module):
+    """Reference ``nn/UpSampling2D.scala`` (nearest) and
+    ``nn/ResizeBilinear.scala`` (``mode="bilinear"``), NHWC."""
+
+    def __init__(self, size=2, mode: str = "nearest", name=None):
+        super().__init__(name)
+        self.size = _pair(size)
+        self.mode = mode
+
+    def forward(self, params, state, x, training=False, rng=None):
+        n, h, w, c = x.shape
+        sh, sw = self.size
+        if self.mode == "nearest":
+            y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        else:
+            y = jax.image.resize(x, (n, h * sh, w * sw, c), method="bilinear")
+        return y, EMPTY
+
+
+class ResizeBilinear(UpSampling2D):
+    """Reference ``nn/ResizeBilinear.scala`` — bilinear by definition."""
+
+    def __init__(self, size=2, name=None):
+        super().__init__(size, mode="bilinear", name=name)
+
+
+class UpSampling1D(Module):
+    def __init__(self, size: int = 2, name=None):
+        super().__init__(name)
+        self.size = size
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.repeat(x, self.size, axis=1), EMPTY
+
+
+class UpSampling3D(Module):
+    def __init__(self, size=2, name=None):
+        super().__init__(name)
+        self.size = _triple(size)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        sd, sh, sw = self.size
+        y = jnp.repeat(x, sd, axis=1)
+        y = jnp.repeat(y, sh, axis=2)
+        return jnp.repeat(y, sw, axis=3), EMPTY
+
+
+class Cropping2D(Module):
+    """Keras ``Cropping2D`` analog (NHWC)."""
+
+    def __init__(self, cropping=((0, 0), (0, 0)), name=None):
+        super().__init__(name)
+        if isinstance(cropping, int):
+            cropping = ((cropping, cropping), (cropping, cropping))
+        self.cropping = cropping
+
+    def forward(self, params, state, x, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b or None, l:w - r or None, :], EMPTY
+
+
+class Cropping1D(Module):
+    def __init__(self, cropping=(0, 0), name=None):
+        super().__init__(name)
+        self.cropping = _pair(cropping)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b or None, :], EMPTY
+
+
+class ZeroPadding1D(Module):
+    def __init__(self, padding=1, name=None):
+        super().__init__(name)
+        self.padding = _pair(padding)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), EMPTY
+
+
+class ZeroPadding3D(Module):
+    def __init__(self, padding=1, name=None):
+        super().__init__(name)
+        self.padding = _triple(padding)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        pd, ph, pw = self.padding
+        return jnp.pad(
+            x, ((0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0))), EMPTY
+
+
+class Padding(Module):
+    """Constant-pad one dim — reference ``nn/Padding.scala`` (0-indexed dim
+    here; negative pad = pad at the front, matching the reference)."""
+
+    def __init__(self, dim: int, pad: int, value: float = 0.0, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.pad = pad
+        self.value = value
+
+    def forward(self, params, state, x, training=False, rng=None):
+        cfg = [(0, 0)] * x.ndim
+        cfg[self.dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, cfg, constant_values=self.value), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Elementwise math layers — reference nn/{Power,Square,Sqrt,Log,Exp,Abs,
+# Clamp,Negative,AddConstant,MulConstant,Threshold}.scala
+# ---------------------------------------------------------------------------
+
+
+class Power(Module):
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name=None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.power(self.scale * x + self.shift, self.power), EMPTY
+
+
+class Square(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return x * x, EMPTY
+
+
+class Sqrt(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.sqrt(x), EMPTY
+
+
+class Log(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.log(x), EMPTY
+
+
+class Exp(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.exp(x), EMPTY
+
+
+class Abs(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.abs(x), EMPTY
+
+
+class Negative(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return -x, EMPTY
+
+
+class Clamp(Module):
+    def __init__(self, min_value: float, max_value: float, name=None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value), EMPTY
+
+
+class AddConstant(Module):
+    def __init__(self, constant: float, name=None):
+        super().__init__(name)
+        self.constant = constant
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x + self.constant, EMPTY
+
+
+class MulConstant(Module):
+    def __init__(self, constant: float, name=None):
+        super().__init__(name)
+        self.constant = constant
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x * self.constant, EMPTY
+
+
+class Threshold(Module):
+    """x if x > th else value — reference ``nn/Threshold.scala``."""
+
+    def __init__(self, threshold: float = 1e-6, value: float = 0.0, name=None):
+        super().__init__(name)
+        self.threshold, self.value = threshold, value
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.where(x > self.threshold, x, self.value), EMPTY
+
+
+class SoftMin(Module):
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jax.nn.softmax(-x, axis=self.axis), EMPTY
+
+
+class LogSigmoid(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jax.nn.log_sigmoid(x), EMPTY
+
+
+class ThresholdedReLU(Module):
+    """Keras ``ThresholdedReLU``: x if x > theta else 0."""
+
+    def __init__(self, theta: float = 1.0, name=None):
+        super().__init__(name)
+        self.theta = theta
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.where(x > self.theta, x, 0.0), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Reductions — reference nn/{Sum,Mean,Max,Min}.scala (0-indexed dims here)
+# ---------------------------------------------------------------------------
+
+
+class Sum(Module):
+    def __init__(self, dim: int = 1, keepdims: bool = False, name=None):
+        super().__init__(name)
+        self.dim, self.keepdims = dim, keepdims
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.sum(x, axis=self.dim, keepdims=self.keepdims), EMPTY
+
+
+class Mean(Module):
+    def __init__(self, dim: int = 1, keepdims: bool = False, name=None):
+        super().__init__(name)
+        self.dim, self.keepdims = dim, keepdims
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.mean(x, axis=self.dim, keepdims=self.keepdims), EMPTY
+
+
+class Max(Module):
+    def __init__(self, dim: int = 1, keepdims: bool = False, name=None):
+        super().__init__(name)
+        self.dim, self.keepdims = dim, keepdims
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.max(x, axis=self.dim, keepdims=self.keepdims), EMPTY
+
+
+class Min(Module):
+    def __init__(self, dim: int = 1, keepdims: bool = False, name=None):
+        super().__init__(name)
+        self.dim, self.keepdims = dim, keepdims
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.min(x, axis=self.dim, keepdims=self.keepdims), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Learnable pointwise — reference nn/{CMul,CAdd,Mul,Add,Scale}.scala
+# ---------------------------------------------------------------------------
+
+
+class CMul(Module):
+    """Learnable componentwise multiply with broadcastable shape."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, x):
+        return {"weight": jnp.ones(self.size)}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x * params["weight"], EMPTY
+
+
+class CAdd(Module):
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, x):
+        return {"bias": jnp.zeros(self.size)}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x + params["bias"], EMPTY
+
+
+class Mul(Module):
+    """Single learnable scalar multiplier — reference ``nn/Mul.scala``."""
+
+    def build(self, rng, x):
+        return {"weight": jnp.ones(())}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x * params["weight"], EMPTY
+
+
+class Add(Module):
+    """Learnable bias vector over last dim — reference ``nn/Add.scala``."""
+
+    def __init__(self, size: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.size = size
+
+    def build(self, rng, x):
+        return {"bias": jnp.zeros((self.size or x.shape[-1],))}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x + params["bias"], EMPTY
+
+
+class Scale(Module):
+    """CMul then CAdd — reference ``nn/Scale.scala``."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def build(self, rng, x):
+        return {"weight": jnp.ones(self.size),
+                "bias": jnp.zeros(self.size)}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x * params["weight"] + params["bias"], EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Table (multi-input) ops — reference nn/C{Sub,Div,Max,Min}Table, MM, MV,
+# DotProduct, CosineDistance, PairwiseDistance, NarrowTable
+# ---------------------------------------------------------------------------
+
+
+class CSubTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        return a - b, EMPTY
+
+
+class CDivTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        return a / b, EMPTY
+
+
+class CMaxTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out, EMPTY
+
+
+class CMinTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.minimum(out, x)
+        return out, EMPTY
+
+
+class CAveTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        return sum(xs) / len(xs), EMPTY
+
+
+class MM(Module):
+    """Batched matmul of a two-tensor table — reference ``nn/MM.scala``."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False, name=None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), EMPTY
+
+
+class MV(Module):
+    """Batched matrix-vector product — reference ``nn/MV.scala``."""
+
+    def __init__(self, trans: bool = False, name=None):
+        super().__init__(name)
+        self.trans = trans
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        m, v = _table(xs)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), EMPTY
+
+
+class DotProduct(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        return jnp.sum(a * b, axis=-1), EMPTY
+
+
+class CosineDistance(Module):
+    """Cosine similarity of a two-tensor table — reference
+    ``nn/CosineDistance.scala`` (outputs similarity, as the reference does)."""
+
+    def __init__(self, eps: float = 1e-8, name=None):
+        super().__init__(name)
+        self.eps = eps
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+        return num / jnp.maximum(den, self.eps), EMPTY
+
+
+class PairwiseDistance(Module):
+    def __init__(self, p: int = 2, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        return jnp.linalg.norm(a - b, ord=self.p, axis=-1), EMPTY
+
+
+class NarrowTable(Module):
+    def __init__(self, offset: int, length: int = 1, name=None):
+        super().__init__(name)
+        self.offset, self.length = offset, length
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        sub = _table(xs)[self.offset:self.offset + self.length]
+        return sub[0] if self.length == 1 else tuple(sub), EMPTY
+
+
+class FlattenTable(Module):
+    def forward(self, params, state, *xs, training=False, rng=None):
+        flat = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for u in t:
+                    rec(u)
+            else:
+                flat.append(t)
+
+        rec(_table(xs))
+        return tuple(flat), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Indexing / slicing — reference nn/{Select,Narrow}.scala, keras Masking
+# ---------------------------------------------------------------------------
+
+
+class Select(Module):
+    """Select one index along a dim (squeezing it) — reference
+    ``nn/Select.scala`` (0-indexed here; negative supported)."""
+
+    def __init__(self, dim: int, index: int, name=None):
+        super().__init__(name)
+        self.dim, self.index = dim, index
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.take(x, self.index, axis=self.dim), EMPTY
+
+
+class Narrow(Module):
+    """Slice [offset, offset+length) along dim — reference ``nn/Narrow.scala``."""
+
+    def __init__(self, dim: int, offset: int, length: int, name=None):
+        super().__init__(name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jax.lax.slice_in_dim(
+            x, self.offset, self.offset + self.length, axis=self.dim), EMPTY
+
+
+class Masking(Module):
+    """Zero timesteps equal to mask_value — keras ``Masking`` analog (static
+    shape: emits zeros rather than dropping steps, XLA-friendly)."""
+
+    def __init__(self, mask_value: float = 0.0, name=None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def forward(self, params, state, x, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0), EMPTY
+
+
+class RepeatVector(Module):
+    """(N, F) → (N, n, F) — keras ``RepeatVector``."""
+
+    def __init__(self, n: int, name=None):
+        super().__init__(name)
+        self.n = n
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), EMPTY
+
+
+class Permute(Module):
+    """Permute non-batch dims (keras semantics, 0-indexed over non-batch)."""
+
+    def __init__(self, dims: Sequence[int], name=None):
+        super().__init__(name)
+        self.dims = tuple(dims)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        perm = (0,) + tuple(d + 1 for d in self.dims)
+        return jnp.transpose(x, perm), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Normalization extras — Normalize (Lp), LRN, SpatialDropout, noise
+# ---------------------------------------------------------------------------
+
+
+class Normalize(Module):
+    """Lp-normalize over last dim — reference ``nn/Normalize.scala``."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10, name=None):
+        super().__init__(name)
+        self.p, self.eps = p, eps
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1,
+                           keepdims=True) ** (1.0 / self.p)
+        return x / jnp.maximum(norm, self.eps), EMPTY
+
+
+class LRN(Module):
+    """Local response normalization across channels — reference
+    ``nn/SpatialCrossMapLRN.scala`` (NHWC; reference defaults size=5,
+    alpha=1.0, beta=0.75, k=1.0)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name=None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, params, state, x, training=False, rng=None):
+        half = self.size // 2
+        sq = x * x
+        window = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, (1, 1, 1, self.size), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)])
+        den = (self.k + self.alpha / self.size * window) ** self.beta
+        return x / den, EMPTY
+
+
+SpatialCrossMapLRN = LRN
+
+
+class SpatialDropout2D(Module):
+    """Drop whole channels — keras/reference ``SpatialDropout2D`` (NHWC)."""
+
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, EMPTY
+        if rng is None:
+            raise ValueError("SpatialDropout2D in training mode requires rng")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(
+            rng, keep, (x.shape[0], 1, 1, x.shape[-1]))
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), EMPTY
+
+
+class SpatialDropout1D(Module):
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, EMPTY
+        if rng is None:
+            raise ValueError("SpatialDropout1D in training mode requires rng")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, x.shape[-1]))
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), EMPTY
+
+
+class GaussianNoise(Module):
+    """Additive zero-mean gaussian noise (train only) — keras analog."""
+
+    def __init__(self, stddev: float, name=None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if not training:
+            return x, EMPTY
+        if rng is None:
+            raise ValueError("GaussianNoise in training mode requires rng")
+        return x + self.stddev * jax.random.normal(rng, x.shape,
+                                                   x.dtype), EMPTY
+
+
+class GaussianDropout(Module):
+    """Multiplicative gaussian noise N(1, p/(1-p)) — keras analog."""
+
+    def __init__(self, p: float, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, EMPTY
+        if rng is None:
+            raise ValueError("GaussianDropout in training mode requires rng")
+        stddev = (self.p / (1.0 - self.p)) ** 0.5
+        return x * (1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Parametrized misc — Highway, Maxout, Bilinear, Cosine, Euclidean, SReLU
+# ---------------------------------------------------------------------------
+
+
+class Highway(Module):
+    """Highway layer y = t*h(x) + (1-t)*x — keras/reference ``Highway``."""
+
+    def __init__(self, activation=jnp.tanh, name=None):
+        super().__init__(name)
+        self.activation = activation
+
+    def build(self, rng, x):
+        d = x.shape[-1]
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w_h": init_mod.xavier(k1, (d, d), d, d),
+            "b_h": jnp.zeros((d,)),
+            "w_t": init_mod.xavier(k2, (d, d), d, d),
+            # negative gate bias so the layer starts as identity
+            "b_t": jnp.full((d,), -2.0),
+        }, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        h = self.activation(x @ params["w_h"] + params["b_h"])
+        t = jax.nn.sigmoid(x @ params["w_t"] + params["b_t"])
+        return t * h + (1.0 - t) * x, EMPTY
+
+
+class Maxout(Module):
+    """Linear to out*pool units then max over each pool — reference
+    ``nn/Maxout.scala``."""
+
+    def __init__(self, in_features: Optional[int], out_features: int,
+                 pool_size: int = 2, name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.pool_size = pool_size
+
+    def build(self, rng, x):
+        fan_in = self.in_features or x.shape[-1]
+        total = self.out_features * self.pool_size
+        k1, _ = jax.random.split(rng)
+        return {"weight": init_mod.xavier(k1, (fan_in, total), fan_in, total),
+                "bias": jnp.zeros((total,))}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        y = x @ params["weight"] + params["bias"]
+        y = y.reshape(y.shape[:-1] + (self.out_features, self.pool_size))
+        return jnp.max(y, axis=-1), EMPTY
+
+
+class Bilinear(Module):
+    """y_k = x1ᵀ W_k x2 + b_k over a two-tensor table — reference
+    ``nn/Bilinear.scala``.  One einsum → one MXU contraction."""
+
+    def __init__(self, in1: int, in2: int, out: int, with_bias: bool = True,
+                 name=None):
+        super().__init__(name)
+        self.in1, self.in2, self.out = in1, in2, out
+        self.with_bias = with_bias
+
+    def build(self, rng, *xs):
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": init_mod.xavier(
+            k1, (self.out, self.in1, self.in2), self.in1 * self.in2, self.out)}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.out,))
+        return params, EMPTY
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        a, b = _table(xs)
+        y = jnp.einsum("bi,kij,bj->bk", a, params["weight"], b)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, EMPTY
+
+
+class Cosine(Module):
+    """Cosine similarity of input to each weight row — reference
+    ``nn/Cosine.scala``."""
+
+    def __init__(self, in_features: Optional[int], out_features: int,
+                 eps: float = 1e-12, name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.eps = eps
+
+    def build(self, rng, x):
+        fan_in = self.in_features or x.shape[-1]
+        w = init_mod.xavier(rng, (self.out_features, fan_in), fan_in,
+                            self.out_features)
+        return {"weight": w}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        w = params["weight"]
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True),
+                             self.eps)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True),
+                             self.eps)
+        return xn @ wn.T, EMPTY
+
+
+class Euclidean(Module):
+    """L2 distance of input to each weight center — reference
+    ``nn/Euclidean.scala``."""
+
+    def __init__(self, in_features: Optional[int], out_features: int,
+                 name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+
+    def build(self, rng, x):
+        fan_in = self.in_features or x.shape[-1]
+        w = init_mod.xavier(rng, (self.out_features, fan_in), fan_in,
+                            self.out_features)
+        return {"weight": w}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        diff = x[..., None, :] - params["weight"]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-12), EMPTY
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learnable per-channel params — keras ``SReLU``."""
+
+    def build(self, rng, x):
+        c = x.shape[-1]
+        return {"t_left": jnp.zeros((c,)), "a_left": jnp.full((c,), 0.2),
+                "t_right": jnp.ones((c,)), "a_right": jnp.ones((c,))}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(x < tl, tl + al * (x - tl), x)
+        y = jnp.where(x > tr, tr + ar * (x - tr), y)
+        return y, EMPTY
