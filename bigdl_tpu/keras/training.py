@@ -11,6 +11,9 @@ import numpy as np
 
 from bigdl_tpu.data import ArrayDataSet
 from bigdl_tpu.nn import criterion as crit_mod
+from bigdl_tpu.nn import criterion_extra as _ce
+from bigdl_tpu.optim import optim_method as _om
+from bigdl_tpu.optim import validation as _vm
 from bigdl_tpu.optim import (
     Adam, Loss, MAE, Optimizer, SGD, Top1Accuracy, Top5Accuracy, Trigger,
 )
@@ -21,6 +24,10 @@ from bigdl_tpu.runtime.engine import Engine
 _OPTIMIZERS = {
     "sgd": lambda: SGD(learning_rate=1e-2),
     "adam": lambda: Adam(learning_rate=1e-3),
+    "rmsprop": lambda: _om.RMSprop(learning_rate=1e-3),
+    "adagrad": lambda: _om.Adagrad(learning_rate=1e-2),
+    "adadelta": lambda: _om.Adadelta(),
+    "adamax": lambda: _om.Adamax(learning_rate=2e-3),
 }
 
 _LOSSES = {
@@ -32,6 +39,21 @@ _LOSSES = {
     "mean_absolute_error": crit_mod.AbsCriterion,
     "binary_crossentropy": crit_mod.BCECriterion,
     "nll": crit_mod.ClassNLLCriterion,
+    "kld": _ce.KullbackLeiblerDivergenceCriterion,
+    "kullback_leibler_divergence": _ce.KullbackLeiblerDivergenceCriterion,
+    "mape": _ce.MeanAbsolutePercentageCriterion,
+    "mean_absolute_percentage_error": _ce.MeanAbsolutePercentageCriterion,
+    "msle": _ce.MeanSquaredLogarithmicCriterion,
+    "mean_squared_logarithmic_error": _ce.MeanSquaredLogarithmicCriterion,
+    # keras hinge accepts 0/1 labels; MarginCriterion wants ±1 — convert
+    "hinge": lambda: _ce.TransformerCriterion(
+        _ce.MarginCriterion(),
+        target_transform=lambda t: 2.0 * (t > 0) - 1.0),
+    "squared_hinge": lambda: _ce.TransformerCriterion(
+        _ce.MarginCriterion(squared=True),
+        target_transform=lambda t: 2.0 * (t > 0) - 1.0),
+    "poisson": _ce.PoissonCriterion,
+    "cosine_proximity": _ce.CosineProximityCriterion,
 }
 
 _METRICS = {
@@ -41,6 +63,9 @@ _METRICS = {
     "top5": Top5Accuracy,
     "mae": MAE,
     "loss": Loss,
+    "auc": _vm.AUC,
+    "hitratio": _vm.HitRatio,
+    "ndcg": _vm.NDCG,
 }
 
 

@@ -42,3 +42,13 @@ from bigdl_tpu.nn.criterion import (
     KLDivCriterion, CosineEmbeddingCriterion, MarginRankingCriterion,
     ParallelCriterion, TimeDistributedCriterion,
 )
+from bigdl_tpu.nn.criterion_extra import (
+    MultiCriterion, MultiLabelSoftMarginCriterion, MultiMarginCriterion,
+    HingeEmbeddingCriterion, L1HingeEmbeddingCriterion, MarginCriterion,
+    SoftMarginCriterion, DiceCoefficientCriterion, PoissonCriterion,
+    DistKLDivCriterion, KullbackLeiblerDivergenceCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    CategoricalCrossEntropy, CosineDistanceCriterion,
+    CosineProximityCriterion, RankHingeCriterion, GaussianCriterion,
+    KLDCriterion, L1Cost, TransformerCriterion,
+)

@@ -21,7 +21,8 @@ class Criterion:
     def forward(self, input, target):
         raise NotImplementedError
 
-    def __call__(self, input, target):
+    def __call__(self, input, target=None):
+        # target=None supported for target-free criterions (L1Cost, KLD, ...)
         return self.forward(input, target)
 
 

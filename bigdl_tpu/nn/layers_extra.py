@@ -199,8 +199,10 @@ class SeparableConv2D(Module):
     def build(self, rng, x):
         k1, k2 = jax.random.split(rng)
         pd, _ = self.depthwise.build(k1, x)
-        y, _ = self.depthwise.forward(pd, EMPTY, x)
-        pp, _ = self.pointwise.build(k2, y)
+        # shape-only trace — no device FLOPs spent at init
+        y = jax.eval_shape(
+            lambda xx: self.depthwise.forward(pd, EMPTY, xx)[0], x)
+        pp, _ = self.pointwise.build(k2, jnp.zeros(y.shape, y.dtype))
         return {"depthwise": pd, "pointwise": pp}, EMPTY
 
     def forward(self, params, state, x, training=False, rng=None):
@@ -406,6 +408,10 @@ class Cropping2D(Module):
         super().__init__(name)
         if isinstance(cropping, int):
             cropping = ((cropping, cropping), (cropping, cropping))
+        elif all(isinstance(c, int) for c in cropping):
+            # keras (crop_h, crop_w) symmetric form
+            ch, cw = cropping
+            cropping = ((ch, ch), (cw, cw))
         self.cropping = cropping
 
     def forward(self, params, state, x, training=False, rng=None):

@@ -1,6 +1,6 @@
 from bigdl_tpu.optim.optim_method import (
     OptimMethod, SGD, Adam, ParallelAdam, AdamWeightDecay, Adagrad, Adadelta,
-    Adamax, RMSprop, Ftrl, LarsSGD,
+    Adamax, RMSprop, Ftrl, LarsSGD, LBFGS,
 )
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Step, MultiStep, Exponential, NaturalExp,
@@ -9,7 +9,7 @@ from bigdl_tpu.optim.schedules import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss, MAE,
-    MSE,
+    MSE, HitRatio, NDCG, AUC,
 )
 from bigdl_tpu.optim.optimizer import (
     Optimizer, DistriOptimizer, LocalOptimizer, TrainedModel,

@@ -342,3 +342,93 @@ class LarsSGD(OptimMethod):
         new_p = treedef.unflatten([l[0] for l in leaves])
         vel = treedef.unflatten([l[1] for l in leaves])
         return new_p, {"velocity": vel}
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS — reference ``optim/LBFGS.scala``.
+
+    Pure-functional two-loop recursion with a fixed-size (s, y) history kept
+    in the optimizer state as stacked arrays, so one ``update`` per gradient
+    (no inner line search — fixed ``learning_rate`` step; the reference's
+    line-search variant needs multiple evals per step, which doesn't fit a
+    one-grad-per-iteration jitted train loop.  Documented divergence).
+
+    Needs whole-vector dot products, so it requires the replicated (non-ZeRO)
+    path: ``elementwise = False``."""
+
+    elementwise = False
+
+    def __init__(self, learning_rate: float = 1.0, history_size: int = 10,
+                 eps: float = 1e-10):
+        self.lr = learning_rate
+        self.m = history_size
+        self.eps = eps
+
+    def _dot(self, a, b):
+        leaves_a = jax.tree_util.tree_leaves(a)
+        leaves_b = jax.tree_util.tree_leaves(b)
+        return sum(jnp.vdot(x, y) for x, y in zip(leaves_a, leaves_b))
+
+    def init_state(self, params):
+        def hist(p):
+            return jnp.zeros((self.m,) + p.shape, p.dtype)
+
+        return {
+            "s": _tmap(hist, params), "y": _tmap(hist, params),
+            "rho": jnp.zeros((self.m,)),
+            "prev_params": _tmap(jnp.zeros_like, params),
+            "prev_grads": _tmap(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, step, grads, params, state):
+        count = state["count"]
+
+        def roll_in(h, new):
+            return jnp.concatenate([h[1:], new[None]], axis=0)
+
+        s_new = _tmap(lambda p, q: p - q, params, state["prev_params"])
+        y_new = _tmap(lambda g, h: g - h, grads, state["prev_grads"])
+        ys = self._dot(y_new, s_new)
+        valid = (count > 0) & (ys > self.eps)
+
+        s_hist = _tmap(
+            lambda h, n: jnp.where(valid, roll_in(h, n), h), state["s"], s_new)
+        y_hist = _tmap(
+            lambda h, n: jnp.where(valid, roll_in(h, n), h), state["y"], y_new)
+        rho = jnp.where(
+            valid,
+            jnp.concatenate([state["rho"][1:],
+                             (1.0 / jnp.maximum(ys, self.eps))[None]]),
+            state["rho"])
+
+        # two-loop recursion; rho==0 entries are no-ops so masking is implicit
+        q = grads
+        alphas = []
+        for i in range(self.m - 1, -1, -1):
+            s_i = _tmap(lambda h: h[i], s_hist)
+            y_i = _tmap(lambda h: h[i], y_hist)
+            a_i = rho[i] * self._dot(s_i, q)
+            q = _tmap(lambda qq, yy: qq - a_i * yy, q, y_i)
+            alphas.append((i, a_i))
+        # initial Hessian scale gamma = s·y / y·y of the newest valid pair
+        y_last = _tmap(lambda h: h[-1], y_hist)
+        s_last = _tmap(lambda h: h[-1], s_hist)
+        yy = self._dot(y_last, y_last)
+        gamma = jnp.where(yy > self.eps,
+                          self._dot(s_last, y_last) / jnp.maximum(yy, self.eps),
+                          1.0)
+        q = _tmap(lambda qq: gamma * qq, q)
+        for i, a_i in reversed(alphas):
+            s_i = _tmap(lambda h: h[i], s_hist)
+            y_i = _tmap(lambda h: h[i], y_hist)
+            b_i = rho[i] * self._dot(y_i, q)
+            q = _tmap(lambda qq, ss: qq + (a_i - b_i) * ss, q, s_i)
+
+        new_params = _tmap(lambda p, d: p - self.lr * d, params, q)
+        new_state = {
+            "s": s_hist, "y": y_hist, "rho": rho,
+            "prev_params": params, "prev_grads": grads,
+            "count": count + 1,
+        }
+        return new_params, new_state

@@ -124,3 +124,77 @@ class MSE(ValidationMethod):
                        axis=-1)
         w = _w(weight, output.shape[0])
         return jnp.sum(per * w), jnp.sum(w)
+
+
+def _rank_of_positive(output, target):
+    """Rank of the positive candidate with half-credit ties (matches AUC's
+    tie handling — a constant-score model ranks mid-pack, not first)."""
+    tgt = target.astype(jnp.int32).reshape(output.shape[0])
+    pos = jnp.take_along_axis(output, tgt[:, None], axis=-1)
+    greater = jnp.sum((output > pos).astype(jnp.float32), axis=-1)
+    ties = jnp.sum((output == pos).astype(jnp.float32), axis=-1) - 1.0
+    return greater + 0.5 * ties
+
+
+class HitRatio(ValidationMethod):
+    """HR@k over candidate scores — reference ``optim/ValidationMethod.scala``
+    ``HitRatio(k, negNum)`` (recsys eval: did the positive item rank in the
+    top-k among its negatives).
+
+    Here ``output`` is (N, n_candidates) scores and ``target`` the index of
+    the positive candidate per row (0-based)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+        self.name = f"HitRatio@{k}"
+
+    def batch_stats(self, output, target, weight=None):
+        rank = _rank_of_positive(output, target)
+        hits = (rank < self.k).astype(jnp.float32)
+        w = _w(weight, output.shape[0])
+        return jnp.sum(hits * w), jnp.sum(w)
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k with a single positive per row — reference ``NDCG`` validation
+    method.  Same (scores, positive-index) convention as :class:`HitRatio`;
+    with one relevant item the ideal DCG is 1, so NDCG = 1/log2(rank+2) when
+    the positive ranks inside the top-k, else 0."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+        self.name = f"NDCG@{k}"
+
+    def batch_stats(self, output, target, weight=None):
+        rank = _rank_of_positive(output, target)
+        gain = jnp.where(rank < self.k, 1.0 / jnp.log2(rank + 2.0), 0.0)
+        w = _w(weight, output.shape[0])
+        return jnp.sum(gain * w), jnp.sum(w)
+
+
+class AUC(ValidationMethod):
+    """Batchwise ROC-AUC (Mann-Whitney U) for binary targets.
+
+    The reference's ``AUC`` accumulates a global threshold curve; a (sum,
+    count) fold can't express that exactly, so this computes the exact AUC
+    *per batch* and averages weighted by the number of pos-neg pairs —
+    identical to the global AUC when batches are iid samples, and exact
+    whenever validation runs in a single batch."""
+
+    name = "AUC"
+
+    def batch_stats(self, output, target, weight=None):
+        score = output.reshape(output.shape[0], -1)
+        score = score[:, -1]  # prob of positive class (or the sole column)
+        t = target.reshape(-1).astype(jnp.float32)
+        w = _w(weight, output.shape[0])
+        pos = (t > 0.5).astype(jnp.float32) * w
+        neg = (t <= 0.5).astype(jnp.float32) * w
+        # pairwise wins + half-ties; O(batch²) but validation batches are small
+        s_i = score[:, None]
+        s_j = score[None, :]
+        wins = (s_i > s_j).astype(jnp.float32) + 0.5 * (s_i == s_j)
+        pair_w = pos[:, None] * neg[None, :]
+        u = jnp.sum(wins * pair_w)
+        n_pairs = jnp.sum(pair_w)
+        return u, n_pairs
