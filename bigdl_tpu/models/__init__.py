@@ -7,10 +7,11 @@ from bigdl_tpu.models.autoencoder import Encoder, autoencoder
 from bigdl_tpu.models.transformer_zoo import (
     TransformerEncoder, BERT, BERTClassifier,
 )
+from bigdl_tpu.models.recsys import NeuralCF, WideAndDeep
 
 __all__ = [
     "LeNet5", "resnet_cifar", "resnet50", "BasicBlock", "Bottleneck",
     "inception_v1", "inception_module", "vgg16", "vgg_cifar10", "char_rnn",
     "Seq2Seq", "autoencoder", "Encoder", "TransformerEncoder", "BERT",
-    "BERTClassifier",
+    "BERTClassifier", "NeuralCF", "WideAndDeep",
 ]

@@ -1,0 +1,137 @@
+"""Recsys model zoo: NCF (NeuMF) and Wide&Deep.
+
+Reference analog (unverified — mount empty): the BigDL model zoo's
+``NeuralCF`` (python ``models/recommendation/neuralcf.py``, the SoCC'19 BigDL
+paper's headline NCF workload) and ``WideAndDeep``
+(``models/recommendation/wide_n_deep.py``), both Keras-style models in the
+reference.
+
+TPU-native: embeddings are plain gathers; the GMF ⊙ and MLP towers fuse into
+the surrounding matmuls under XLA.  The wide half of Wide&Deep consumes a
+:class:`SparseTensor` through :class:`SparseLinear` (gather + segment-sum)."""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import EMPTY, Module
+from bigdl_tpu.nn.sparse_layers import SparseLinear
+
+
+class NeuralCF(Module):
+    """NeuMF: GMF (elementwise product of user/item embeddings) + MLP tower,
+    concatenated into a prediction head.
+
+    Inputs: ``(user_ids, item_ids)`` int arrays of shape (N,).
+    Output: (N, 1) score in (0,1) when ``include_sigmoid`` (rating/CTR) or raw
+    logits otherwise (for CrossEntropy ranking losses use ``class_num``)."""
+
+    def __init__(self, user_count: int, item_count: int,
+                 embed_dim: int = 16, mlp_dims: Sequence[int] = (64, 32, 16),
+                 class_num: int = 1, include_sigmoid: bool = True, name=None):
+        super().__init__(name)
+        self.user_count = user_count
+        self.item_count = item_count
+        self.embed_dim = embed_dim
+        self.mlp_dims = tuple(mlp_dims)
+        self.class_num = class_num
+        self.include_sigmoid = include_sigmoid and class_num == 1
+
+        self.user_embed_gmf = nn.Embedding(user_count, embed_dim)
+        self.item_embed_gmf = nn.Embedding(item_count, embed_dim)
+        self.user_embed_mlp = nn.Embedding(user_count, embed_dim)
+        self.item_embed_mlp = nn.Embedding(item_count, embed_dim)
+        mlp = []
+        for d in self.mlp_dims:
+            mlp += [nn.Linear(None, d), nn.ReLU()]
+        self.mlp = nn.Sequential(mlp)
+        self.head = nn.Linear(None, class_num)
+
+    def build(self, rng, users, items):
+        ks = jax.random.split(rng, 6)
+        p = {
+            "ue_gmf": self.user_embed_gmf.build(ks[0], users)[0],
+            "ie_gmf": self.item_embed_gmf.build(ks[1], items)[0],
+            "ue_mlp": self.user_embed_mlp.build(ks[2], users)[0],
+            "ie_mlp": self.item_embed_mlp.build(ks[3], items)[0],
+        }
+        u_mlp = p["ue_mlp"]["weight"][users.astype(jnp.int32)]
+        i_mlp = p["ie_mlp"]["weight"][items.astype(jnp.int32)]
+        mlp_in = jnp.concatenate([u_mlp, i_mlp], -1)
+        v_mlp = self.mlp.init(ks[4], mlp_in)
+        p["mlp"] = v_mlp["params"]
+        mlp_out, _ = self.mlp.apply(v_mlp, mlp_in)
+        gmf = u_mlp[..., :self.embed_dim] * i_mlp[..., :self.embed_dim]
+        head_in = jnp.concatenate([gmf, mlp_out], -1)
+        p["head"] = self.head.build(ks[5], head_in)[0]
+        return p, EMPTY
+
+    def forward(self, params, state, users, items, training=False, rng=None):
+        u = users.astype(jnp.int32)
+        i = items.astype(jnp.int32)
+        gmf = (params["ue_gmf"]["weight"][u]
+               * params["ie_gmf"]["weight"][i])
+        mlp_in = jnp.concatenate([params["ue_mlp"]["weight"][u],
+                                  params["ie_mlp"]["weight"][i]], -1)
+        mlp_out, _ = self.mlp.forward(params["mlp"], EMPTY, mlp_in,
+                                      training=training, rng=rng)
+        y, _ = self.head.forward(params["head"], EMPTY,
+                                 jnp.concatenate([gmf, mlp_out], -1))
+        if self.include_sigmoid:
+            y = jax.nn.sigmoid(y)
+        return y, EMPTY
+
+
+class WideAndDeep(Module):
+    """Wide (sparse cross features through SparseLinear) & Deep (categorical
+    embeddings + dense features through an MLP), summed into logits.
+
+    Inputs: ``(wide_sparse, deep_cat, deep_dense)`` where ``wide_sparse`` is a
+    SparseTensor (N, wide_dim), ``deep_cat`` int (N, n_cat_fields) of
+    categorical ids, ``deep_dense`` float (N, dense_dim)."""
+
+    def __init__(self, wide_dim: int, cat_cardinalities: Sequence[int],
+                 dense_dim: int, embed_dim: int = 8,
+                 hidden: Sequence[int] = (64, 32), class_num: int = 1,
+                 include_sigmoid: bool = True, name=None):
+        super().__init__(name)
+        self.wide = SparseLinear(wide_dim, class_num)
+        self.cat_cardinalities = tuple(cat_cardinalities)
+        self.embeds = [nn.Embedding(c, embed_dim)
+                       for c in self.cat_cardinalities]
+        self.dense_dim = dense_dim
+        deep = []
+        for h in hidden:
+            deep += [nn.Linear(None, h), nn.ReLU()]
+        deep.append(nn.Linear(None, class_num))
+        self.deep = nn.Sequential(deep)
+        self.include_sigmoid = include_sigmoid and class_num == 1
+
+    def build(self, rng, wide_sp, deep_cat, deep_dense):
+        ks = jax.random.split(rng, 3 + len(self.embeds))
+        p = {"wide": self.wide.build(ks[0], wide_sp)[0]}
+        emb_ps = []
+        parts = []
+        for f, emb in enumerate(self.embeds):
+            ep = emb.build(ks[1 + f], deep_cat[:, f])[0]
+            emb_ps.append(ep)
+            parts.append(ep["weight"][deep_cat[:, f].astype(jnp.int32)])
+        p["embeds"] = emb_ps
+        deep_in = jnp.concatenate(parts + [deep_dense], -1)
+        p["deep"] = self.deep.init(ks[-1], deep_in)["params"]
+        return p, EMPTY
+
+    def forward(self, params, state, wide_sp, deep_cat, deep_dense,
+                training=False, rng=None):
+        wide_y, _ = self.wide.forward(params["wide"], EMPTY, wide_sp)
+        parts = [ep["weight"][deep_cat[:, f].astype(jnp.int32)]
+                 for f, ep in enumerate(params["embeds"])]
+        deep_in = jnp.concatenate(parts + [deep_dense], -1)
+        deep_y, _ = self.deep.forward(params["deep"], EMPTY, deep_in,
+                                      training=training, rng=rng)
+        y = wide_y + deep_y
+        if self.include_sigmoid:
+            y = jax.nn.sigmoid(y)
+        return y, EMPTY

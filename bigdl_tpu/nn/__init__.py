@@ -29,6 +29,7 @@ from bigdl_tpu.nn.layers_extra import (
     GaussianNoise, GaussianDropout, Highway, Maxout, Bilinear, Cosine,
     Euclidean, SReLU,
 )
+from bigdl_tpu.nn.sparse_layers import SparseLinear, SparseJoinTable
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,
 )

@@ -26,6 +26,10 @@ class MultiCriterion(Criterion):
                  weights: Optional[Sequence[float]] = None):
         self.criterions = list(criterions)
         self.weights = list(weights) if weights else [1.0] * len(self.criterions)
+        if len(self.weights) != len(self.criterions):
+            raise ValueError(
+                f"{len(self.criterions)} criterions but "
+                f"{len(self.weights)} weights")
 
     def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
         self.criterions.append(criterion)

@@ -1,0 +1,54 @@
+"""Sparse input layers — reference ``nn/SparseLinear.scala`` /
+``nn/SparseJoinTable.scala`` (the wide half of wide-and-deep recsys models).
+
+Input is a :class:`bigdl_tpu.tensor.sparse.SparseTensor`; the contraction
+lowers to gather + segment-sum (see sparse.py docstring for why that is the
+TPU-idiomatic shape)."""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import EMPTY, Module, _table
+from bigdl_tpu.tensor.sparse import SparseTensor, sparse_join
+
+
+class SparseLinear(Module):
+    """Dense layer over sparse input: ``y = sp @ W + b``."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 with_bias: bool = True, weight_init=init_mod.xavier,
+                 name=None):
+        super().__init__(name)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.with_bias = with_bias
+        self.weight_init = weight_init
+
+    def build(self, rng, x):
+        k1, _ = jax.random.split(rng)
+        params = {"weight": self.weight_init(
+            k1, (self.in_features, self.out_features), self.in_features,
+            self.out_features)}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.out_features,))
+        return params, EMPTY
+
+    def forward(self, params, state, x: SparseTensor, training=False, rng=None):
+        y = x.matmul(params["weight"])
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, EMPTY
+
+
+class SparseJoinTable(Module):
+    """Concat sparse tensors along the feature axis."""
+
+    def __init__(self, total_cols: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.total_cols = total_cols
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        return sparse_join(list(_table(xs)), self.total_cols), EMPTY

@@ -185,7 +185,13 @@ class AUC(ValidationMethod):
 
     def batch_stats(self, output, target, weight=None):
         score = output.reshape(output.shape[0], -1)
-        score = score[:, -1]  # prob of positive class (or the sole column)
+        if score.shape[1] == 2:
+            # 2-class output: rank by the positive-vs-negative margin, which
+            # is monotonic in p1 for both logits and probabilities (the raw
+            # last column is NOT monotonic for logits)
+            score = score[:, 1] - score[:, 0]
+        else:
+            score = score[:, -1]  # prob/logit of positive class (sole column)
         t = target.reshape(-1).astype(jnp.float32)
         w = _w(weight, output.shape[0])
         pos = (t > 0.5).astype(jnp.float32) * w

@@ -1,4 +1,6 @@
 from bigdl_tpu.tensor.tensor import Tensor
 from bigdl_tpu.tensor.numeric import TensorNumeric, get_default_dtype, set_default_dtype
+from bigdl_tpu.tensor.sparse import SparseTensor, sparse_join
 
-__all__ = ["Tensor", "TensorNumeric", "get_default_dtype", "set_default_dtype"]
+__all__ = ["Tensor", "TensorNumeric", "get_default_dtype",
+           "set_default_dtype", "SparseTensor", "sparse_join"]

@@ -1,0 +1,132 @@
+"""Sparse tensor (COO) — TPU-native re-design of the reference SparseTensor.
+
+Reference analog (unverified — mount empty): ``dllib/tensor/SparseTensor.
+scala`` — CSR-ish 2-D sparse tensor used by ``nn/SparseLinear`` and
+``nn/SparseJoinTable`` for wide (recsys) models.
+
+TPU-first constraints drive the design:
+
+- **Static nnz.** XLA wants static shapes, so a ``SparseTensor`` carries a
+  fixed-capacity ``(nnz,)`` values array + ``(nnz, 2)`` indices array; unused
+  slots are padded with ``value 0`` at row 0 (a zero value contributes
+  nothing to any contraction, so padding is mathematically inert).
+- **Contractions become gather + segment-sum**, the idiomatic TPU lowering
+  for embedding-style sparse work: ``y[r] += v * W[c]`` is
+  ``segment_sum(values[:, None] * W[cols], rows)`` — one dense gather feeding
+  one dense scatter-add, both HBM-bandwidth-bound and jit-compatible (no
+  dynamic shapes, no host loops like the reference's per-element JVM walk).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseTensor:
+    """2-D COO sparse matrix (rows = batch) with fixed nnz capacity."""
+
+    def __init__(self, indices, values, shape: Tuple[int, int]):
+        self.indices = jnp.asarray(indices, jnp.int32)   # (nnz, 2) [row, col]
+        self.values = jnp.asarray(values)                # (nnz,)
+        self.shape = tuple(shape)
+        if self.indices.ndim != 2 or self.indices.shape[-1] != 2:
+            raise ValueError(f"indices must be (nnz, 2), got {self.indices.shape}")
+        if self.values.shape[0] != self.indices.shape[0]:
+            raise ValueError("values/indices nnz mismatch")
+
+    @property
+    def nnz(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    # ---- constructors -----------------------------------------------------
+    @staticmethod
+    def from_dense(dense, nnz: Optional[int] = None) -> "SparseTensor":
+        """Host-side conversion (data-pipeline use, not for inside jit)."""
+        d = np.asarray(dense)
+        rows, cols = np.nonzero(d)
+        vals = d[rows, cols]
+        cap = nnz if nnz is not None else len(vals)
+        if len(vals) > cap:
+            raise ValueError(f"dense has {len(vals)} nonzeros > capacity {cap}")
+        pad = cap - len(vals)
+        idx = np.concatenate(
+            [np.stack([rows, cols], -1),
+             np.zeros((pad, 2), np.int64)]).astype(np.int32)
+        v = np.concatenate([vals, np.zeros((pad,), d.dtype)])
+        return SparseTensor(idx, v, d.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.indices[:, 0], self.indices[:, 1]].add(self.values)
+
+    # ---- ops ---------------------------------------------------------------
+    def matmul(self, dense):
+        """(N, D)·(D, O) → (N, O) via gather + segment-sum."""
+        rows = self.indices[:, 0]
+        cols = self.indices[:, 1]
+        gathered = dense[cols] * self.values[:, None]          # (nnz, O)
+        return jax.ops.segment_sum(gathered, rows,
+                                   num_segments=self.shape[0])
+
+    def __matmul__(self, dense):
+        return self.matmul(dense)
+
+    def row_sum(self):
+        return jax.ops.segment_sum(self.values, self.indices[:, 0],
+                                   num_segments=self.shape[0])
+
+    def scale(self, s) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * s, self.shape)
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_join(tensors, total_cols: Optional[int] = None) -> SparseTensor:
+    """Concatenate sparse tensors along the feature (col) axis — reference
+    ``nn/SparseJoinTable.scala``."""
+    n = tensors[0].shape[0]
+    for t in tensors:
+        if t.shape[0] != n:
+            raise ValueError("row-count mismatch in sparse_join")
+    offset = 0
+    idx_parts, val_parts = [], []
+    for t in tensors:
+        shifted = t.indices.at[:, 1].add(offset)
+        # keep padding slots inert: col offset on a zero-value slot is fine
+        idx_parts.append(shifted)
+        val_parts.append(t.values)
+        offset += t.shape[1]
+    cols = total_cols if total_cols is not None else offset
+    if cols < offset:
+        raise ValueError(
+            f"total_cols={cols} < combined column width {offset}")
+    return SparseTensor(jnp.concatenate(idx_parts),
+                        jnp.concatenate(val_parts), (n, cols))
+
+
+# register as a pytree so SparseTensor can cross jit boundaries
+def _flatten(t: SparseTensor):
+    return (t.indices, t.values), t.shape
+
+
+def _unflatten(shape, children):
+    # trusted fast path: transforms may unflatten with non-array leaves
+    # (ShapeDtypeStruct under eval_shape, tracers under jit) — skip the
+    # validating constructor entirely
+    idx, vals = children
+    t = object.__new__(SparseTensor)
+    t.indices = idx
+    t.values = vals
+    t.shape = tuple(shape)
+    return t
+
+
+jax.tree_util.register_pytree_node(SparseTensor, _flatten, _unflatten)

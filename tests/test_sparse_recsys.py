@@ -1,0 +1,190 @@
+"""Sparse tensor + SparseLinear + NCF / Wide&Deep zoo tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import NeuralCF, WideAndDeep
+from bigdl_tpu.nn.criterion import BCECriterion
+from bigdl_tpu.optim.validation import HitRatio, NDCG
+from bigdl_tpu.tensor.sparse import SparseTensor, sparse_join
+
+RS = np.random.RandomState(0)
+RNG = jax.random.PRNGKey(0)
+
+
+def _random_sparse(n, d, density=0.2, nnz=None):
+    dense = RS.rand(n, d) * (RS.rand(n, d) < density)
+    return SparseTensor.from_dense(dense.astype(np.float32), nnz=nnz), dense
+
+
+def test_sparse_roundtrip_and_padding():
+    sp, dense = _random_sparse(5, 8, nnz=32)
+    assert sp.nnz == 32  # padded capacity
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), dense, rtol=1e-6)
+
+
+def test_sparse_matmul_matches_dense():
+    sp, dense = _random_sparse(6, 10, nnz=40)
+    w = RS.rand(10, 3).astype(np.float32)
+    got = np.asarray(sp.matmul(jnp.asarray(w)))
+    np.testing.assert_allclose(got, dense @ w, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sp.row_sum()), dense.sum(-1),
+                               rtol=1e-5)
+
+
+def test_sparse_is_pytree_and_jits():
+    sp, dense = _random_sparse(4, 6, nnz=16)
+    w = jnp.asarray(RS.rand(6, 2).astype(np.float32))
+
+    @jax.jit
+    def f(s, w):
+        return s.matmul(w)
+
+    np.testing.assert_allclose(np.asarray(f(sp, w)), dense @ np.asarray(w),
+                               atol=1e-5)
+
+
+def test_sparse_join():
+    a, da = _random_sparse(3, 4, nnz=8)
+    b, db = _random_sparse(3, 5, nnz=8)
+    j = sparse_join([a, b])
+    assert j.shape == (3, 9)
+    np.testing.assert_allclose(np.asarray(j.to_dense()),
+                               np.concatenate([da, db], -1), rtol=1e-6)
+
+
+def test_sparse_linear_grad_flows():
+    sp, dense = _random_sparse(8, 12, nnz=48)
+    layer = nn.SparseLinear(12, 4)
+    v = layer.init(RNG, sp)
+    y, _ = layer.apply(v, sp)
+    np.testing.assert_allclose(
+        np.asarray(y), dense @ np.asarray(v["params"]["weight"])
+        + np.asarray(v["params"]["bias"]), atol=1e-5)
+
+    def loss(params):
+        out, _ = layer.forward(params, {}, sp)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(v["params"])
+    assert np.all(np.isfinite(np.asarray(g["weight"])))
+    # only touched columns get weight gradient
+    touched = set(np.asarray(sp.indices[:, 1])[np.asarray(sp.values) != 0])
+    gw = np.asarray(g["weight"])
+    for c in range(12):
+        if c not in touched:
+            np.testing.assert_allclose(gw[c], 0.0)
+
+
+def test_ncf_trains_and_ranks():
+    users = 30
+    items = 40
+    n = 512
+    u = RS.randint(0, users, n).astype(np.int32)
+    i = RS.randint(0, items, n).astype(np.int32)
+    # learnable rule: positive iff (u + i) even
+    y = (((u + i) % 2) == 0).astype(np.float32)[:, None]
+
+    model = NeuralCF(users, items, embed_dim=8, mlp_dims=(16, 8))
+    v = model.init(RNG, jnp.asarray(u), jnp.asarray(i))
+    crit = BCECriterion()
+
+    params = v["params"]
+    lr = 0.15
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            out, _ = model.forward(p, {}, jnp.asarray(u), jnp.asarray(i))
+            return crit(out, jnp.asarray(y))
+
+        l, g = jax.value_and_grad(loss)(params)
+        return jax.tree_util.tree_map(lambda p, gg: p - lr * gg, params, g), l
+
+    first = None
+    for _ in range(400):
+        params, l = step(params)
+        first = first if first is not None else float(l)
+    assert float(l) < first * 0.7, (first, float(l))
+
+    out, _ = model.forward(params, {}, jnp.asarray(u), jnp.asarray(i))
+    acc = float((np.asarray(out)[:, 0] > 0.5).astype(np.float32).__eq__(
+        y[:, 0]).mean())
+    assert acc > 0.7, acc
+
+
+def test_ncf_hit_ratio_eval():
+    """Scores for 1 positive + 19 negatives per row → HR@k pipeline shape."""
+    scores = jnp.asarray(RS.rand(16, 20).astype(np.float32))
+    pos = jnp.zeros((16,), jnp.int32)
+    s, c = HitRatio(k=20).batch_stats(scores, pos)
+    np.testing.assert_allclose(float(s) / float(c), 1.0)  # k=all → always hit
+    s, c = NDCG(k=20).batch_stats(scores, pos)
+    assert 0.0 < float(s) / float(c) <= 1.0
+
+
+def test_wide_and_deep_trains():
+    n, wide_dim, dense_dim = 256, 24, 5
+    cats = [7, 11]
+    wide_rows = RS.randint(0, n, n * 3)
+    wide_cols = RS.randint(0, wide_dim, n * 3)
+    wide_dense = np.zeros((n, wide_dim), np.float32)
+    wide_dense[wide_rows, wide_cols] = 1.0
+    sp = SparseTensor.from_dense(wide_dense, nnz=n * 3 + 8)
+    cat = np.stack([RS.randint(0, c, n) for c in cats], -1).astype(np.int32)
+    dense = RS.rand(n, dense_dim).astype(np.float32)
+    # label depends on both a wide column and a dense feature
+    y = ((wide_dense[:, 0] + (dense[:, 0] > 0.5)) >= 1).astype(
+        np.float32)[:, None]
+
+    model = WideAndDeep(wide_dim, cats, dense_dim, embed_dim=4,
+                        hidden=(16, 8))
+    v = model.init(RNG, sp, jnp.asarray(cat), jnp.asarray(dense))
+    crit = BCECriterion()
+    params = v["params"]
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            out, _ = model.forward(p, {}, sp, jnp.asarray(cat),
+                                   jnp.asarray(dense))
+            return crit(out, jnp.asarray(y))
+
+        l, g = jax.value_and_grad(loss)(params)
+        return jax.tree_util.tree_map(
+            lambda pp, gg: pp - 0.1 * gg, params, g), l
+
+    first = None
+    for _ in range(400):
+        params, l = step(params)
+        first = first if first is not None else float(l)
+    assert float(l) < first * 0.6, (first, float(l))
+
+    out, _ = model.forward(params, {}, sp, jnp.asarray(cat),
+                           jnp.asarray(dense))
+    acc = float(((np.asarray(out)[:, 0] > 0.5) == y[:, 0]).mean())
+    assert acc > 0.8, acc
+
+
+def test_sparse_eval_shape_and_join_validation():
+    import pytest
+
+    sp, _ = _random_sparse(4, 6, nnz=16)
+    out = jax.eval_shape(lambda s: s.scale(2.0), sp)
+    assert out.shape == (4, 6)
+    with pytest.raises(ValueError):
+        sparse_join([sp, sp], total_cols=6)  # < combined 12
+    with pytest.raises(ValueError):
+        nn.MultiCriterion([BCECriterion(), BCECriterion()], weights=[1.0])
+
+
+def test_auc_two_class_logits():
+    from bigdl_tpu.optim.validation import AUC
+
+    # logits where the raw last column ranks WRONG but p1 ranks right
+    logits = jnp.asarray([[5.0, 4.0], [-5.0, 0.0]])
+    t = jnp.asarray([1, 0])  # row1 is actually more-positive (p1=0.99)
+    s, c = AUC().batch_stats(logits, t)
+    np.testing.assert_allclose(float(s) / float(c), 0.0)  # true AUC
