@@ -12,35 +12,46 @@ import jax.numpy as jnp
 
 from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.module import EMPTY, Module, _table
+from bigdl_tpu.tensor.policy import cast_compute
 from bigdl_tpu.tensor.sparse import SparseTensor, sparse_join
 
 
 class SparseLinear(Module):
-    """Dense layer over sparse input: ``y = sp @ W + b``."""
+    """Dense layer over sparse input: ``y = sp @ W + b``.  Mirrors
+    ``nn.Linear``'s contract: lazy ``in_features``, ``bias_init`` hook, and
+    the global compute-dtype policy (bf16 gather/segment-sum with the output
+    cast back, matching sibling dense layers)."""
 
-    def __init__(self, in_features: int, out_features: int,
-                 with_bias: bool = True, weight_init=init_mod.xavier,
+    def __init__(self, in_features: Optional[int] = None,
+                 out_features: int = 0, with_bias: bool = True,
+                 weight_init=init_mod.xavier, bias_init=init_mod.zeros,
                  name=None):
         super().__init__(name)
+        if out_features == 0 and in_features is not None:
+            in_features, out_features = None, in_features
         self.in_features = in_features
         self.out_features = out_features
         self.with_bias = with_bias
         self.weight_init = weight_init
+        self.bias_init = bias_init
 
     def build(self, rng, x):
-        k1, _ = jax.random.split(rng)
+        fan_in = self.in_features or x.shape[1]
+        k1, k2 = jax.random.split(rng)
         params = {"weight": self.weight_init(
-            k1, (self.in_features, self.out_features), self.in_features,
-            self.out_features)}
+            k1, (fan_in, self.out_features), fan_in, self.out_features)}
         if self.with_bias:
-            params["bias"] = jnp.zeros((self.out_features,))
+            params["bias"] = self.bias_init(k2, (self.out_features,), fan_in,
+                                            self.out_features)
         return params, EMPTY
 
     def forward(self, params, state, x: SparseTensor, training=False, rng=None):
-        y = x.matmul(params["weight"])
+        vc, wc = cast_compute(x.values, params["weight"])
+        y = SparseTensor(x.indices, vc, x.shape).matmul(wc)
+        y = y.astype(jnp.float32)
         if self.with_bias:
             y = y + params["bias"]
-        return y, EMPTY
+        return y.astype(x.values.dtype), EMPTY
 
 
 class SparseJoinTable(Module):

@@ -1,0 +1,6 @@
+from bigdl_tpu.ppml.fl import FLServer, FLClient, FedAvg
+from bigdl_tpu.ppml.psi import PSIServer, psi_intersect, salted_hashes
+from bigdl_tpu.ppml.vfl import VFLNNTrainer
+
+__all__ = ["FLServer", "FLClient", "FedAvg", "PSIServer", "psi_intersect",
+           "salted_hashes", "VFLNNTrainer"]

@@ -1,0 +1,231 @@
+"""Horizontal federated learning — the PPML FLServer/FLClient analog.
+
+Reference analog (unverified — mount empty): ``scala/ppml/.../FLServer.scala``
+/ ``FLClient.scala`` — a gRPC server aggregating client updates (FedAvg for
+NN), clients train locally and sync per round.
+
+TPU-native re-design: the transport is plain HTTP on the trusted cluster
+network (the reference's gRPC role; SGX enclaves are hardware-specific and
+out of scope — SURVEY.md §3.2).  Model updates travel as npz-serialized
+pytrees.  Aggregation is weighted FedAvg; the server releases a round's
+global model only after all ``world_size`` clients have submitted, mirroring
+the reference's synchronous round barrier."""
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib import request as urlrequest
+
+import numpy as np
+
+import jax
+
+
+def _flatten(tree) -> Dict[str, np.ndarray]:
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return out
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    # FedAvg aggregates in f64/f32; restore each leaf's own dtype (bf16
+    # params must come back bf16)
+    leaves = [flat[jax.tree_util.keystr(p)].astype(
+        np.asarray(leaf).dtype) for p, leaf in paths]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _flat_to_npz_bytes(flat: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{k.replace("/", "⁄"): v for k, v in flat.items()})
+    return buf.getvalue()
+
+
+def _tree_to_npz_bytes(tree) -> bytes:
+    return _flat_to_npz_bytes(_flatten(tree))
+
+
+def _npz_bytes_to_flat(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        return {k.replace("⁄", "/"): z[k] for k in z.files}
+
+
+class FedAvg:
+    """Weighted-average aggregator: sum(w_i · update_i) / sum(w_i)."""
+
+    def __init__(self):
+        self._acc: Optional[Dict[str, np.ndarray]] = None
+        self._weight = 0.0
+
+    def add(self, flat: Dict[str, np.ndarray], weight: float) -> None:
+        if self._acc is None:
+            self._acc = {k: v.astype(np.float64) * weight
+                         for k, v in flat.items()}
+        else:
+            for k, v in flat.items():
+                self._acc[k] = self._acc[k] + v.astype(np.float64) * weight
+        self._weight += weight
+
+    def result(self) -> Dict[str, np.ndarray]:
+        if self._acc is None:
+            raise RuntimeError("no updates to aggregate")
+        return {k: (v / self._weight).astype(np.float32)
+                for k, v in self._acc.items()}
+
+
+class _FLState:
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.lock = threading.Condition()
+        self.round = 0
+        self.agg = FedAvg()
+        self.submitted: set = set()
+        self.global_flat: Optional[Dict[str, np.ndarray]] = None
+        self.psi_sets: Dict[str, list] = {}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _FLState  # injected by FLServer
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+
+    def do_GET(self):
+        st = self.state
+        if self.path.startswith("/model"):
+            # /model?round=R — block (long-poll) until round R aggregated
+            want = int(self.path.split("round=")[1])
+            with st.lock:
+                ok = st.lock.wait_for(
+                    lambda: st.round >= want and st.global_flat is not None,
+                    timeout=60.0)
+                if not ok:
+                    self._send(408, b"round not complete")
+                    return
+                body = _flat_to_npz_bytes(st.global_flat)
+            self._send(200, body)
+        elif self.path == "/status":
+            with st.lock:
+                body = json.dumps({
+                    "round": st.round,
+                    "submitted": len(st.submitted),
+                    "world_size": st.world_size}).encode()
+            self._send(200, body, "application/json")
+        else:
+            self._send(404, b"")
+
+    def do_POST(self):
+        st = self.state
+        if self.path.startswith("/update"):
+            # /update?client=ID&weight=W&round=R
+            q = dict(p.split("=") for p in self.path.split("?")[1].split("&"))
+            flat = _npz_bytes_to_flat(self._read_body())
+            with st.lock:
+                if int(q["round"]) != st.round:
+                    self._send(409, f"server at round {st.round}".encode())
+                    return
+                if q["client"] in st.submitted:
+                    self._send(409, b"duplicate submission")
+                    return
+                st.submitted.add(q["client"])
+                st.agg.add(flat, float(q.get("weight", 1.0)))
+                if len(st.submitted) == st.world_size:
+                    st.global_flat = st.agg.result()
+                    st.round += 1
+                    st.agg = FedAvg()
+                    st.submitted = set()
+                    st.lock.notify_all()
+            self._send(200, b"ok")
+        elif self.path.startswith("/psi"):
+            from bigdl_tpu.ppml.psi import handle_psi_post
+
+            handle_psi_post(self, st)
+        else:
+            self._send(404, b"")
+
+
+class FLServer:
+    """Synchronous-round FedAvg server.  ``with FLServer(world_size=2) as s:``"""
+
+    def __init__(self, world_size: int, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.state = _FLState(world_size)
+        handler = type("BoundHandler", (_Handler,), {"state": self.state})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def target(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "FLServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class FLClient:
+    """One federated party: local train steps + round sync."""
+
+    def __init__(self, target: str, client_id: str):
+        self.target = target
+        self.client_id = client_id
+        self.round = 0
+
+    def upload(self, variables: Any, weight: float = 1.0) -> None:
+        body = _tree_to_npz_bytes(variables)
+        url = (f"{self.target}/update?client={self.client_id}"
+               f"&weight={weight}&round={self.round}")
+        req = urlrequest.Request(url, data=body, method="POST")
+        with urlrequest.urlopen(req, timeout=70) as r:
+            if r.status != 200:
+                raise RuntimeError(f"upload failed: {r.status}")
+
+    def download(self, template: Any) -> Any:
+        """Blocks until the current round's aggregate is ready, then returns
+        the global model shaped like ``template``."""
+        want = self.round + 1
+        url = f"{self.target}/model?round={want}"
+        with urlrequest.urlopen(url, timeout=70) as r:
+            if r.status != 200:
+                raise RuntimeError(f"download failed: {r.status}")
+            flat = _npz_bytes_to_flat(r.read())
+        self.round = want
+        return _unflatten_like(template, flat)
+
+    def sync(self, variables: Any, weight: float = 1.0) -> Any:
+        """upload + download — one federated round."""
+        self.upload(variables, weight)
+        return self.download(variables)
+
+    def status(self) -> Dict[str, Any]:
+        with urlrequest.urlopen(f"{self.target}/status", timeout=10) as r:
+            return json.loads(r.read())

@@ -1,0 +1,103 @@
+"""Private set intersection — the PPML PSI analog.
+
+Reference analog (unverified — mount empty): ``scala/ppml/.../psi/`` — the
+FL server offers a PSI service: parties upload salted hashes of their record
+ids; the server returns the intersection so vertically-partitioned parties
+can align rows without revealing non-shared ids.
+
+Protocol here (salted-hash PSI, the reference's scheme class): the server
+issues one random salt per session; each party uploads
+``sha256(salt || id)`` digests; the server intersects digests and returns
+the matching digests to each party, which maps them back to its own ids
+locally.  Ids never leave a party in the clear; non-intersecting ids are
+only ever seen as salted hashes."""
+
+import hashlib
+import json
+import secrets
+from typing import Dict, List, Sequence
+from urllib import request as urlrequest
+
+
+def salted_hashes(ids: Sequence[str], salt: str) -> List[str]:
+    return [hashlib.sha256((salt + str(i)).encode()).hexdigest()
+            for i in ids]
+
+
+def psi_intersect(ids_a: Sequence[str], ids_b: Sequence[str],
+                  salt: str = None) -> List[str]:
+    """In-process PSI (both sides local — test/reference path): returns the
+    ids of party A that are shared with party B."""
+    salt = salt or secrets.token_hex(16)
+    ha = salted_hashes(ids_a, salt)
+    hb = set(salted_hashes(ids_b, salt))
+    return [i for i, h in zip(ids_a, ha) if h in hb]
+
+
+# ---- HTTP service half (mounted on the FLServer) ---------------------------
+
+_SALTS: Dict[int, str] = {}
+
+
+def handle_psi_post(handler, state) -> None:
+    """POST /psi/salt → {"salt": ...} (same salt for the session);
+    POST /psi/upload?client=ID body={"hashes": [...]} → stores;
+    POST /psi/intersect → {"hashes": [...]} intersection of all uploads."""
+    if handler.path.startswith("/psi/salt"):
+        with state.lock:
+            key = id(state)
+            if key not in _SALTS:
+                _SALTS[key] = secrets.token_hex(16)
+            body = json.dumps({"salt": _SALTS[key]}).encode()
+        handler._send(200, body, "application/json")
+    elif handler.path.startswith("/psi/upload"):
+        q = dict(p.split("=") for p in handler.path.split("?")[1].split("&"))
+        payload = json.loads(handler._read_body())
+        with state.lock:
+            state.psi_sets[q["client"]] = payload["hashes"]
+        handler._send(200, b"ok")
+    elif handler.path.startswith("/psi/intersect"):
+        with state.lock:
+            sets = [set(v) for v in state.psi_sets.values()]
+            inter = set.intersection(*sets) if sets else set()
+            body = json.dumps({"hashes": sorted(inter)}).encode()
+        handler._send(200, body, "application/json")
+    else:
+        handler._send(404, b"")
+
+
+class PSIServer:
+    """Client-side helper speaking the /psi endpoints of an FLServer."""
+
+    def __init__(self, target: str, client_id: str):
+        self.target = target
+        self.client_id = client_id
+        self._salt = None
+
+    def get_salt(self) -> str:
+        if self._salt is None:
+            req = urlrequest.Request(f"{self.target}/psi/salt", data=b"",
+                                     method="POST")
+            with urlrequest.urlopen(req, timeout=10) as r:
+                self._salt = json.loads(r.read())["salt"]
+        return self._salt
+
+    def upload_set(self, ids: Sequence[str]) -> None:
+        salt = self.get_salt()
+        body = json.dumps(
+            {"hashes": salted_hashes(ids, salt)}).encode()
+        req = urlrequest.Request(
+            f"{self.target}/psi/upload?client={self.client_id}", data=body,
+            method="POST")
+        with urlrequest.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+
+    def download_intersection(self, ids: Sequence[str]) -> List[str]:
+        """Returns this party's ids that are in the global intersection."""
+        salt = self.get_salt()
+        req = urlrequest.Request(f"{self.target}/psi/intersect", data=b"",
+                                 method="POST")
+        with urlrequest.urlopen(req, timeout=10) as r:
+            inter = set(json.loads(r.read())["hashes"])
+        return [i for i, h in zip(ids, salted_hashes(ids, salt))
+                if h in inter]

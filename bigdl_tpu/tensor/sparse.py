@@ -68,6 +68,11 @@ class SparseTensor:
     # ---- ops ---------------------------------------------------------------
     def matmul(self, dense):
         """(N, D)·(D, O) → (N, O) via gather + segment-sum."""
+        if dense.shape[0] != self.shape[1]:
+            # without this, XLA gather clamps OOB cols → silent garbage
+            raise ValueError(
+                f"matmul shape mismatch: sparse (N, {self.shape[1]}) @ "
+                f"dense {tuple(dense.shape)}")
         rows = self.indices[:, 0]
         cols = self.indices[:, 1]
         gathered = dense[cols] * self.values[:, None]          # (nnz, O)
