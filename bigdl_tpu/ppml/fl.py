@@ -14,29 +14,15 @@ the reference's synchronous round barrier."""
 import io
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib import request as urlrequest
 
 import numpy as np
 
-import jax
-
-
-def _flatten(tree) -> Dict[str, np.ndarray]:
-    out = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        out[jax.tree_util.keystr(path)] = np.asarray(leaf)
-    return out
-
-
-def _unflatten_like(template, flat: Dict[str, np.ndarray]):
-    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
-    # FedAvg aggregates in f64/f32; restore each leaf's own dtype (bf16
-    # params must come back bf16)
-    leaves = [flat[jax.tree_util.keystr(p)].astype(
-        np.asarray(leaf).dtype) for p, leaf in paths]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+# single pytree<->flat implementation shared with the checkpoint format
+from bigdl_tpu.utils.serializer import _flatten, _unflatten_like
 
 
 def _flat_to_npz_bytes(flat: Dict[str, np.ndarray]) -> bytes:
@@ -86,6 +72,7 @@ class _FLState:
         self.submitted: set = set()
         self.global_flat: Optional[Dict[str, np.ndarray]] = None
         self.psi_sets: Dict[str, list] = {}
+        self.psi_salt: Optional[str] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -117,6 +104,11 @@ class _Handler(BaseHTTPRequestHandler):
                     timeout=60.0)
                 if not ok:
                     self._send(408, b"round not complete")
+                    return
+                if st.round != want:
+                    # never serve round R+k weights labeled as round R
+                    self._send(409, f"server at round {st.round}, "
+                               f"wanted {want}".encode())
                     return
                 body = _flat_to_npz_bytes(st.global_flat)
             self._send(200, body)
@@ -192,6 +184,20 @@ class FLServer:
         self.stop()
 
 
+def _http(url: str, data: bytes = None, method: str = "GET",
+          timeout: float = 70.0):
+    """(status, body) — urllib raises HTTPError on non-2xx; normalize it so
+    callers can branch on status codes."""
+    from urllib.error import HTTPError
+
+    req = urlrequest.Request(url, data=data, method=method)
+    try:
+        with urlrequest.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except HTTPError as e:
+        return e.code, e.read()
+
+
 class FLClient:
     """One federated party: local train steps + round sync."""
 
@@ -204,22 +210,31 @@ class FLClient:
         body = _tree_to_npz_bytes(variables)
         url = (f"{self.target}/update?client={self.client_id}"
                f"&weight={weight}&round={self.round}")
-        req = urlrequest.Request(url, data=body, method="POST")
-        with urlrequest.urlopen(req, timeout=70) as r:
-            if r.status != 200:
-                raise RuntimeError(f"upload failed: {r.status}")
+        code, resp = _http(url, data=body, method="POST")
+        if code != 200:
+            raise RuntimeError(
+                f"upload for round {self.round} failed ({code}): "
+                f"{resp[:200].decode(errors='replace')}")
 
-    def download(self, template: Any) -> Any:
+    def download(self, template: Any, max_wait: float = 300.0) -> Any:
         """Blocks until the current round's aggregate is ready, then returns
-        the global model shaped like ``template``."""
+        the global model shaped like ``template``.  Retries long-poll
+        timeouts (408) until ``max_wait``; a 409 means this client fell a
+        whole round behind and must re-join (fatal here)."""
         want = self.round + 1
         url = f"{self.target}/model?round={want}"
-        with urlrequest.urlopen(url, timeout=70) as r:
-            if r.status != 200:
-                raise RuntimeError(f"download failed: {r.status}")
-            flat = _npz_bytes_to_flat(r.read())
+        deadline = time.monotonic() + max_wait
+        while True:
+            code, body = _http(url)
+            if code == 200:
+                break
+            if code == 408 and time.monotonic() < deadline:
+                continue  # peers still training — keep long-polling
+            raise RuntimeError(
+                f"download of round {want} failed ({code}): "
+                f"{body[:200].decode(errors='replace')}")
         self.round = want
-        return _unflatten_like(template, flat)
+        return _unflatten_like(template, _npz_bytes_to_flat(body))
 
     def sync(self, variables: Any, weight: float = 1.0) -> Any:
         """upload + download — one federated round."""

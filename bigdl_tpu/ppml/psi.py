@@ -36,19 +36,19 @@ def psi_intersect(ids_a: Sequence[str], ids_b: Sequence[str],
 
 # ---- HTTP service half (mounted on the FLServer) ---------------------------
 
-_SALTS: Dict[int, str] = {}
-
 
 def handle_psi_post(handler, state) -> None:
-    """POST /psi/salt → {"salt": ...} (same salt for the session);
+    """POST /psi/salt → {"salt": ...} (one fresh salt per server session,
+    stored on the server state);
     POST /psi/upload?client=ID body={"hashes": [...]} → stores;
-    POST /psi/intersect → {"hashes": [...]} intersection of all uploads."""
+    POST /psi/intersect → intersection once ALL world_size parties have
+    uploaded, else 409 (the same participation barrier /update enforces —
+    intersecting early would silently return a too-large set)."""
     if handler.path.startswith("/psi/salt"):
         with state.lock:
-            key = id(state)
-            if key not in _SALTS:
-                _SALTS[key] = secrets.token_hex(16)
-            body = json.dumps({"salt": _SALTS[key]}).encode()
+            if state.psi_salt is None:
+                state.psi_salt = secrets.token_hex(16)
+            body = json.dumps({"salt": state.psi_salt}).encode()
         handler._send(200, body, "application/json")
     elif handler.path.startswith("/psi/upload"):
         q = dict(p.split("=") for p in handler.path.split("?")[1].split("&"))
@@ -58,6 +58,11 @@ def handle_psi_post(handler, state) -> None:
         handler._send(200, b"ok")
     elif handler.path.startswith("/psi/intersect"):
         with state.lock:
+            if len(state.psi_sets) < state.world_size:
+                handler._send(
+                    409, (f"only {len(state.psi_sets)}/{state.world_size} "
+                          "parties uploaded").encode())
+                return
             sets = [set(v) for v in state.psi_sets.values()]
             inter = set.intersection(*sets) if sets else set()
             body = json.dumps({"hashes": sorted(inter)}).encode()
@@ -92,12 +97,27 @@ class PSIServer:
         with urlrequest.urlopen(req, timeout=10) as r:
             assert r.status == 200
 
-    def download_intersection(self, ids: Sequence[str]) -> List[str]:
-        """Returns this party's ids that are in the global intersection."""
+    def download_intersection(self, ids: Sequence[str],
+                              max_wait: float = 60.0) -> List[str]:
+        """Returns this party's ids that are in the global intersection.
+        Polls until all parties have uploaded (409 until then)."""
+        import time
+
+        from bigdl_tpu.ppml.fl import _http
+
         salt = self.get_salt()
-        req = urlrequest.Request(f"{self.target}/psi/intersect", data=b"",
-                                 method="POST")
-        with urlrequest.urlopen(req, timeout=10) as r:
-            inter = set(json.loads(r.read())["hashes"])
+        deadline = time.monotonic() + max_wait
+        while True:
+            code, body = _http(f"{self.target}/psi/intersect", data=b"",
+                               method="POST", timeout=10)
+            if code == 200:
+                inter = set(json.loads(body)["hashes"])
+                break
+            if code == 409 and time.monotonic() < deadline:
+                time.sleep(0.05)
+                continue
+            raise RuntimeError(
+                f"PSI intersect failed ({code}): "
+                f"{body[:200].decode(errors='replace')}")
         return [i for i, h in zip(ids, salted_hashes(ids, salt))
                 if h in inter]

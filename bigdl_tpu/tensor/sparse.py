@@ -76,6 +76,10 @@ class SparseTensor:
         rows = self.indices[:, 0]
         cols = self.indices[:, 1]
         gathered = dense[cols] * self.values[:, None]          # (nnz, O)
+        if gathered.dtype in (jnp.bfloat16, jnp.float16):
+            # accumulate in f32 like the dense layers' preferred_element_type
+            # — bf16 segment-sum over wide rows loses digits
+            gathered = gathered.astype(jnp.float32)
         return jax.ops.segment_sum(gathered, rows,
                                    num_segments=self.shape[0])
 

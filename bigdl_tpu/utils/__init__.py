@@ -1,4 +1,5 @@
 from bigdl_tpu.utils.log import get_logger
 from bigdl_tpu.utils.table import T, Table
+from bigdl_tpu.utils.interop import from_torch, to_torch
 
-__all__ = ["get_logger", "T", "Table"]
+__all__ = ["get_logger", "T", "Table", "from_torch", "to_torch"]

@@ -1,0 +1,199 @@
+"""Model interop: import/export weights from/to torch modules.
+
+Reference analog (unverified — mount empty): the reference ships model
+*import-export* beyond its own format — ``utils/caffe/CaffeLoader.scala``,
+``utils/tf/TensorflowLoader.scala`` (SURVEY.md §3.1) — so reference users can
+bring externally-trained weights.  Caffe/TF1 graphs are legacy; the living
+ecosystem interchange today is torch modules, so the TPU-native equivalent
+imports/exports torch ``state_dict`` weights.
+
+Mapping is **structural**: the ordered list of parameterized torch leaf
+modules must match the ordered list of parameterized bigdl_tpu leaf modules
+(containers are walked in order).  Layout conversions applied per type:
+
+==================  =======================  ==========================
+torch               bigdl_tpu                transform
+------------------  -----------------------  --------------------------
+Linear (out,in)     Linear (in,out)          transpose
+Conv2d OIHW         Conv2D HWIO              permute(2,3,1,0)
+ConvTranspose2d     Conv2DTranspose          permute(2,3,1,0)  (I,O,H,W →
+  (in,out,kh,kw)      (kh,kw,out,in)          H,W,O,I)
+Conv1d OIW          Conv1D WIO               permute(2,1,0)
+BatchNorm*d         BatchNorm                weight/bias + running stats
+Embedding           Embedding                copy
+LayerNorm           LayerNorm                copy
+PReLU               PReLU                    copy (per-channel)
+==================  =======================  ==========================
+
+NCHW→NHWC is a *model-structure* concern (our models are NHWC); the caller
+feeds NHWC inputs and this module only converts the kernels.
+"""
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Module
+
+
+def _our_leaves(module: Module, variables: Dict[str, Any]
+                ) -> List[Tuple[Module, Dict, Dict]]:
+    """Ordered (module, params, state) triples for parameterized leaves."""
+    out = []
+    params = variables.get("params", {})
+    state = variables.get("state", {})
+    if isinstance(module, Container):
+        for i, child in enumerate(module.layers):
+            k = module._key(i)
+            out += _our_leaves(child, {"params": params.get(k, {}),
+                                       "state": state.get(k, {})})
+    elif params or state:
+        out.append((module, params, state))
+    return out
+
+
+def _torch_leaves(tmodule) -> List[Any]:
+    """Ordered torch leaf modules that own parameters or buffers directly."""
+    out = []
+    for m in tmodule.modules():
+        has_own = any(True for _ in m.parameters(recurse=False)) or any(
+            True for _ in m.buffers(recurse=False))
+        if has_own:
+            out.append(m)
+    return out
+
+
+def _convert(tm, our: Module, params: Dict, state: Dict
+             ) -> Tuple[Dict, Dict]:
+    """Produce new (params, state) for ``our`` from torch module ``tm``."""
+    import torch
+
+    def np_(t):
+        return t.detach().cpu().numpy()
+
+    tname = type(tm).__name__
+    new_p = dict(params)
+    new_s = dict(state)
+    if tname == "Linear":
+        new_p["weight"] = jnp.asarray(np_(tm.weight).T)
+        if tm.bias is not None and "bias" in params:
+            new_p["bias"] = jnp.asarray(np_(tm.bias))
+    elif tname == "Conv2d":
+        new_p["weight"] = jnp.asarray(np_(tm.weight).transpose(2, 3, 1, 0))
+        if tm.bias is not None and "bias" in params:
+            new_p["bias"] = jnp.asarray(np_(tm.bias))
+    elif tname == "ConvTranspose2d":
+        # torch (in, out, kh, kw) → ours (kh, kw, out, in)
+        new_p["weight"] = jnp.asarray(np_(tm.weight).transpose(2, 3, 1, 0))
+        if tm.bias is not None and "bias" in params:
+            new_p["bias"] = jnp.asarray(np_(tm.bias))
+    elif tname == "Conv1d":
+        new_p["weight"] = jnp.asarray(np_(tm.weight).transpose(2, 1, 0))
+        if tm.bias is not None and "bias" in params:
+            new_p["bias"] = jnp.asarray(np_(tm.bias))
+    elif tname in ("BatchNorm1d", "BatchNorm2d", "BatchNorm3d"):
+        if "weight" in params:
+            new_p["weight"] = jnp.asarray(np_(tm.weight))
+            new_p["bias"] = jnp.asarray(np_(tm.bias))
+        new_s["running_mean"] = jnp.asarray(np_(tm.running_mean))
+        new_s["running_var"] = jnp.asarray(np_(tm.running_var))
+    elif tname == "Embedding":
+        new_p["weight"] = jnp.asarray(np_(tm.weight))
+    elif tname == "LayerNorm":
+        new_p["weight"] = jnp.asarray(np_(tm.weight))
+        new_p["bias"] = jnp.asarray(np_(tm.bias))
+    elif tname == "PReLU":
+        new_p["alpha"] = jnp.asarray(np_(tm.weight))
+    else:
+        raise NotImplementedError(
+            f"no torch→bigdl_tpu conversion for {tname} → "
+            f"{type(our).__name__}")
+    # shape sanity vs the existing init
+    for k, v in new_p.items():
+        if k in params and tuple(np.shape(params[k])) != tuple(v.shape):
+            raise ValueError(
+                f"{type(our).__name__}.{k}: torch shape {tuple(v.shape)} != "
+                f"model shape {tuple(np.shape(params[k]))}")
+    return new_p, new_s
+
+
+def from_torch(tmodule, model: Module, variables: Dict[str, Any]
+               ) -> Dict[str, Any]:
+    """Copy weights from a torch module into a structurally-matching
+    bigdl_tpu ``variables`` tree (returns a NEW tree; input untouched)."""
+    ours = _our_leaves(model, variables)
+    theirs = _torch_leaves(tmodule)
+    if len(ours) != len(theirs):
+        raise ValueError(
+            f"structure mismatch: bigdl_tpu model has {len(ours)} "
+            f"parameterized leaves, torch module has {len(theirs)}: "
+            f"{[type(m).__name__ for m, _, _ in ours]} vs "
+            f"{[type(m).__name__ for m in theirs]}")
+
+    converted = [_convert(tm, om, p, s)
+                 for tm, (om, p, s) in zip(theirs, ours)]
+
+    # rebuild the nested variables dict by walking the same paths again
+    idx = [0]
+
+    def rebuild(module, params, state):
+        if isinstance(module, Container):
+            np_, ns_ = dict(params), dict(state)
+            for i, child in enumerate(module.layers):
+                k = module._key(i)
+                cp, cs = rebuild(child, params.get(k, {}), state.get(k, {}))
+                if cp:
+                    np_[k] = cp
+                if cs:
+                    ns_[k] = cs
+            return np_, ns_
+        if params or state:
+            p, s = converted[idx[0]]
+            idx[0] += 1
+            return p, s
+        return params, state
+
+    p, s = rebuild(model, variables.get("params", {}),
+                   variables.get("state", {}))
+    return {"params": p, "state": s}
+
+
+def to_torch(model: Module, variables: Dict[str, Any], tmodule):
+    """Reverse direction: write bigdl_tpu weights into a torch module."""
+    import torch
+
+    ours = _our_leaves(model, variables)
+    theirs = _torch_leaves(tmodule)
+    if len(ours) != len(theirs):
+        raise ValueError("structure mismatch between models")
+    with torch.no_grad():
+        for tm, (om, p, s) in zip(theirs, ours):
+            tname = type(tm).__name__
+            if tname == "Linear":
+                tm.weight.copy_(torch.tensor(np.asarray(p["weight"]).T))
+                if tm.bias is not None and "bias" in p:
+                    tm.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+            elif tname == "Conv2d":
+                tm.weight.copy_(torch.tensor(
+                    np.asarray(p["weight"]).transpose(3, 2, 0, 1)))
+                if tm.bias is not None and "bias" in p:
+                    tm.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+            elif tname in ("BatchNorm1d", "BatchNorm2d", "BatchNorm3d"):
+                if "weight" in p:
+                    tm.weight.copy_(torch.tensor(np.asarray(p["weight"])))
+                    tm.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+                tm.running_mean.copy_(
+                    torch.tensor(np.asarray(s["running_mean"])))
+                tm.running_var.copy_(
+                    torch.tensor(np.asarray(s["running_var"])))
+            elif tname == "Embedding":
+                tm.weight.copy_(torch.tensor(np.asarray(p["weight"])))
+            elif tname == "LayerNorm":
+                tm.weight.copy_(torch.tensor(np.asarray(p["weight"])))
+                tm.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+            else:
+                raise NotImplementedError(
+                    f"no bigdl_tpu→torch conversion for {tname}")
+    return tmodule

@@ -43,7 +43,9 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
         if tuple(arr.shape) != want:
             raise ValueError(
                 f"shape mismatch for {key}: saved {arr.shape}, model {want}")
-        leaves.append(arr)
+        # restore the template leaf's dtype (e.g. bf16 params aggregated /
+        # stored as f32 must come back bf16)
+        leaves.append(arr.astype(np.asarray(leaf).dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
