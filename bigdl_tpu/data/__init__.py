@@ -2,8 +2,23 @@ from bigdl_tpu.data.dataset import (
     DataSet, ArrayDataSet, Sample, MiniBatch, SampleToMiniBatch,
 )
 from bigdl_tpu.data.transformer import Transformer, Identity as IdentityTransformer
+from bigdl_tpu.data.augmentation import (
+    Brightness, Contrast, Saturation, Hue, ColorJitter, ChannelOrder,
+    Grayscale, Expand, Filler, FixedCrop, AspectScale, RandomAspectScale,
+    PixelNormalizer, RandomTransformer,
+)
+from bigdl_tpu.data.segmentation import (
+    rle_encode, rle_decode, rle_area, polygons_to_mask, mask_to_bbox,
+    annotation_to_mask,
+)
 
 __all__ = [
     "DataSet", "ArrayDataSet", "Sample", "MiniBatch", "SampleToMiniBatch",
     "Transformer", "IdentityTransformer",
+    "Brightness", "Contrast", "Saturation", "Hue", "ColorJitter",
+    "ChannelOrder", "Grayscale", "Expand", "Filler", "FixedCrop",
+    "AspectScale", "RandomAspectScale", "PixelNormalizer",
+    "RandomTransformer",
+    "rle_encode", "rle_decode", "rle_area", "polygons_to_mask",
+    "mask_to_bbox", "annotation_to_mask",
 ]

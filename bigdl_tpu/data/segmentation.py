@@ -1,0 +1,80 @@
+"""Segmentation dataset utilities: COCO-style RLE + polygon masks.
+
+Reference analog (unverified — mount empty): ``dllib/feature/dataset/
+segmentation/{COCODataset,MaskUtils}.scala`` — COCO annotation parsing with
+RLE encode/decode and polygon→mask rasterization feeding the MaskRCNN path
+(SURVEY.md §3.1 dataset row).
+
+Host-CPU numpy (+PIL for polygon fill); masks land on device as dense
+uint8/float arrays."""
+
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+
+
+def rle_encode(mask: np.ndarray) -> Dict:
+    """Binary (H, W) mask → COCO *uncompressed* RLE dict
+    ``{"counts": [...], "size": [H, W]}`` (column-major order, starting with
+    the count of zeros, matching pycocotools' convention)."""
+    m = np.asarray(mask, np.uint8)
+    flat = m.flatten(order="F")
+    # run lengths, first run is zeros (possibly length 0)
+    change = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+    runs = np.diff(np.concatenate([[0], change, [flat.size]]))
+    counts = list(map(int, runs))
+    if flat.size and flat[0] == 1:
+        counts = [0] + counts
+    return {"counts": counts, "size": [int(m.shape[0]), int(m.shape[1])]}
+
+
+def rle_decode(rle: Dict) -> np.ndarray:
+    """COCO uncompressed RLE dict → binary (H, W) uint8 mask."""
+    h, w = rle["size"]
+    counts = rle["counts"]
+    flat = np.zeros(h * w, np.uint8)
+    pos = 0
+    val = 0
+    for c in counts:
+        if val:
+            flat[pos:pos + c] = 1
+        pos += c
+        val ^= 1
+    return flat.reshape((w, h)).T  # column-major
+
+
+def rle_area(rle: Dict) -> int:
+    return int(sum(rle["counts"][1::2]))
+
+
+def polygons_to_mask(polygons: Sequence[Sequence[float]], height: int,
+                     width: int) -> np.ndarray:
+    """COCO polygon list ([x0,y0,x1,y1,...] per ring) → (H, W) uint8 mask."""
+    from PIL import Image, ImageDraw
+
+    img = Image.new("L", (width, height), 0)
+    draw = ImageDraw.Draw(img)
+    for poly in polygons:
+        pts = [(float(poly[i]), float(poly[i + 1]))
+               for i in range(0, len(poly), 2)]
+        if len(pts) >= 3:
+            draw.polygon(pts, outline=1, fill=1)
+    return np.asarray(img, np.uint8)
+
+
+def mask_to_bbox(mask: np.ndarray) -> List[float]:
+    """Tight [x, y, w, h] bbox of a binary mask (COCO bbox convention)."""
+    ys, xs = np.nonzero(np.asarray(mask))
+    if len(ys) == 0:
+        return [0.0, 0.0, 0.0, 0.0]
+    x0, x1 = float(xs.min()), float(xs.max())
+    y0, y1 = float(ys.min()), float(ys.max())
+    return [x0, y0, x1 - x0 + 1.0, y1 - y0 + 1.0]
+
+
+def annotation_to_mask(ann: Dict, height: int, width: int) -> np.ndarray:
+    """COCO annotation dict (``segmentation`` = polygons or RLE) → mask."""
+    seg: Union[Dict, List] = ann["segmentation"]
+    if isinstance(seg, dict):
+        return rle_decode(seg)
+    return polygons_to_mask(seg, height, width)

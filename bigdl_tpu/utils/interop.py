@@ -76,25 +76,34 @@ def _convert(tm, our: Module, params: Dict, state: Dict
     tname = type(tm).__name__
     new_p = dict(params)
     new_s = dict(state)
+
+    def set_bias():
+        if tm.bias is not None and "bias" not in params:
+            raise ValueError(
+                f"torch {tname} has a bias but {type(our).__name__} was "
+                "built with with_bias=False — silent drop refused")
+        if tm.bias is not None:
+            new_p["bias"] = jnp.asarray(np_(tm.bias))
+
     if tname == "Linear":
         new_p["weight"] = jnp.asarray(np_(tm.weight).T)
-        if tm.bias is not None and "bias" in params:
-            new_p["bias"] = jnp.asarray(np_(tm.bias))
+        set_bias()
     elif tname == "Conv2d":
         new_p["weight"] = jnp.asarray(np_(tm.weight).transpose(2, 3, 1, 0))
-        if tm.bias is not None and "bias" in params:
-            new_p["bias"] = jnp.asarray(np_(tm.bias))
+        set_bias()
     elif tname == "ConvTranspose2d":
         # torch (in, out, kh, kw) → ours (kh, kw, out, in)
         new_p["weight"] = jnp.asarray(np_(tm.weight).transpose(2, 3, 1, 0))
-        if tm.bias is not None and "bias" in params:
-            new_p["bias"] = jnp.asarray(np_(tm.bias))
+        set_bias()
     elif tname == "Conv1d":
         new_p["weight"] = jnp.asarray(np_(tm.weight).transpose(2, 1, 0))
-        if tm.bias is not None and "bias" in params:
-            new_p["bias"] = jnp.asarray(np_(tm.bias))
+        set_bias()
     elif tname in ("BatchNorm1d", "BatchNorm2d", "BatchNorm3d"):
-        if "weight" in params:
+        if tm.weight is not None and "weight" not in params:
+            raise ValueError(
+                f"torch {tname} is affine but {type(our).__name__} was "
+                "built with affine=False — silent drop refused")
+        if tm.weight is not None:
             new_p["weight"] = jnp.asarray(np_(tm.weight))
             new_p["bias"] = jnp.asarray(np_(tm.bias))
         new_s["running_mean"] = jnp.asarray(np_(tm.running_mean))
@@ -110,12 +119,16 @@ def _convert(tm, our: Module, params: Dict, state: Dict
         raise NotImplementedError(
             f"no torch→bigdl_tpu conversion for {tname} → "
             f"{type(our).__name__}")
-    # shape sanity vs the existing init
-    for k, v in new_p.items():
-        if k in params and tuple(np.shape(params[k])) != tuple(v.shape):
-            raise ValueError(
-                f"{type(our).__name__}.{k}: torch shape {tuple(v.shape)} != "
-                f"model shape {tuple(np.shape(params[k]))}")
+    # shape sanity + template-dtype restore, params AND state
+    for tree, tmpl in ((new_p, params), (new_s, state)):
+        for k, v in tree.items():
+            if k in tmpl:
+                want = tuple(np.shape(tmpl[k]))
+                if want != tuple(v.shape):
+                    raise ValueError(
+                        f"{type(our).__name__}.{k}: torch shape "
+                        f"{tuple(v.shape)} != model shape {want}")
+                tree[k] = v.astype(np.asarray(tmpl[k]).dtype)
     return new_p, new_s
 
 
@@ -188,6 +201,19 @@ def to_torch(model: Module, variables: Dict[str, Any], tmodule):
                     torch.tensor(np.asarray(s["running_mean"])))
                 tm.running_var.copy_(
                     torch.tensor(np.asarray(s["running_var"])))
+            elif tname == "ConvTranspose2d":
+                # ours (kh, kw, out, in) → torch (in, out, kh, kw)
+                tm.weight.copy_(torch.tensor(
+                    np.asarray(p["weight"]).transpose(3, 2, 0, 1)))
+                if tm.bias is not None and "bias" in p:
+                    tm.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+            elif tname == "Conv1d":
+                tm.weight.copy_(torch.tensor(
+                    np.asarray(p["weight"]).transpose(2, 1, 0)))
+                if tm.bias is not None and "bias" in p:
+                    tm.bias.copy_(torch.tensor(np.asarray(p["bias"])))
+            elif tname == "PReLU":
+                tm.weight.copy_(torch.tensor(np.asarray(p["alpha"])))
             elif tname == "Embedding":
                 tm.weight.copy_(torch.tensor(np.asarray(p["weight"])))
             elif tname == "LayerNorm":

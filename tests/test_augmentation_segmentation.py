@@ -1,0 +1,124 @@
+"""Augmentation extensions + segmentation mask utilities."""
+
+import numpy as np
+
+from bigdl_tpu.data import (AspectScale, Brightness, ChannelOrder,
+                            ColorJitter, Contrast, Expand, Filler, FixedCrop,
+                            Grayscale, Hue, PixelNormalizer,
+                            RandomTransformer, Saturation, annotation_to_mask,
+                            mask_to_bbox, polygons_to_mask, rle_area,
+                            rle_decode, rle_encode)
+from bigdl_tpu.data.vision import ImageFeature, ImageFrame
+
+RS = np.random.RandomState(0)
+
+
+def _img(h=16, w=20):
+    return ImageFeature(RS.randint(0, 255, (h, w, 3), dtype=np.uint8).astype(
+        np.uint8), label=1)
+
+
+def _run(t, f):
+    return next(iter(t(iter([f]))))
+
+
+def test_color_ops_preserve_shape_dtype():
+    for t in [Brightness(seed=0), Contrast(seed=0), Saturation(seed=0),
+              Hue(seed=0), Grayscale(), ChannelOrder(), ColorJitter(seed=0)]:
+        f = _run(t, _img())
+        assert f.image.shape == (16, 20, 3)
+        assert f.image.dtype == np.uint8, type(t).__name__
+
+
+def test_brightness_shifts_mean():
+    f0 = _img()
+    before = f0.image.astype(np.float32).mean()
+    f = _run(Brightness(50, 50, seed=0), f0)
+    assert f.image.astype(np.float32).mean() > before + 20
+
+
+def test_hue_identity_when_zero():
+    f0 = _img()
+    ref = f0.image.copy()
+    f = _run(Hue(0.0, seed=0), f0)
+    np.testing.assert_allclose(f.image.astype(int), ref.astype(int), atol=2)
+
+
+def test_grayscale_channels_equal():
+    f = _run(Grayscale(), _img())
+    assert np.array_equal(f.image[..., 0], f.image[..., 1])
+
+
+def test_expand_filler_fixedcrop_aspect():
+    f = _run(Expand(max_ratio=2.0, seed=1), _img())
+    assert f.image.shape[0] >= 16 and f.image.shape[1] >= 20
+
+    f = _run(Filler(0.0, 0.0, 0.5, 0.5, value=7), _img())
+    assert np.all(f.image[:8, :10] == 7)
+    assert not np.all(f.image[8:, 10:] == 7)
+
+    f = _run(FixedCrop(0.25, 0.25, 0.75, 0.75), _img())
+    assert f.image.shape == (8, 10, 3)
+
+    f = _run(AspectScale(32, max_size=100), _img())
+    assert min(f.image.shape[:2]) == 32
+
+
+def test_random_transformer_probability():
+    always = RandomTransformer(ChannelOrder(), 1.0, seed=0)
+    never = RandomTransformer(ChannelOrder(), 0.0, seed=0)
+    f0 = _img()
+    ref = f0.image.copy()
+    f = _run(always, ImageFeature(ref.copy()))
+    assert np.array_equal(f.image, ref[..., ::-1])
+    f = _run(never, ImageFeature(ref.copy()))
+    assert np.array_equal(f.image, ref)
+
+
+def test_pixel_normalizer():
+    f0 = _img()
+    mean = np.full((16, 20, 3), 10.0, np.float32)
+    f = _run(PixelNormalizer(mean), f0)
+    assert f.image.dtype == np.float32
+
+
+def test_pipeline_chains_on_imageframe():
+    frame = ImageFrame([_img() for _ in range(4)])
+    out = frame.transform(ColorJitter(seed=0))
+    assert len(out) == 4
+
+
+# ---- segmentation ---------------------------------------------------------
+
+def test_rle_roundtrip():
+    mask = (RS.rand(13, 17) > 0.6).astype(np.uint8)
+    rle = rle_encode(mask)
+    np.testing.assert_array_equal(rle_decode(rle), mask)
+    assert rle_area(rle) == int(mask.sum())
+
+
+def test_rle_edge_cases():
+    zeros = np.zeros((4, 5), np.uint8)
+    np.testing.assert_array_equal(rle_decode(rle_encode(zeros)), zeros)
+    ones = np.ones((4, 5), np.uint8)
+    np.testing.assert_array_equal(rle_decode(rle_encode(ones)), ones)
+
+
+def test_polygon_rasterization_and_bbox():
+    # square from (2,3) to (8,9)
+    poly = [2, 3, 8, 3, 8, 9, 2, 9]
+    mask = polygons_to_mask([poly], 12, 12)
+    assert mask[5, 5] == 1
+    assert mask[0, 0] == 0
+    x, y, w, h = mask_to_bbox(mask)
+    assert (x, y) == (2.0, 3.0)
+    assert w >= 6 and h >= 6
+
+    ann_poly = {"segmentation": [poly]}
+    np.testing.assert_array_equal(annotation_to_mask(ann_poly, 12, 12), mask)
+    ann_rle = {"segmentation": rle_encode(mask)}
+    np.testing.assert_array_equal(annotation_to_mask(ann_rle, 12, 12), mask)
+
+
+def test_mask_to_bbox_empty():
+    assert mask_to_bbox(np.zeros((5, 5))) == [0.0, 0.0, 0.0, 0.0]

@@ -123,3 +123,26 @@ def test_conv_transpose_import():
     with torch.no_grad():
         ty = tm(torch.tensor(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
     np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=2e-4)
+
+
+def test_bias_mismatch_refused():
+    tm = torch.nn.Sequential(torch.nn.Linear(4, 2, bias=True))
+    model = nn.Sequential([nn.Linear(4, 2, with_bias=False)])
+    v = model.init(RNG, jnp.ones((1, 4)))
+    with pytest.raises(ValueError, match="with_bias=False"):
+        from_torch(tm, model, v)
+
+
+def test_conv_transpose_export_roundtrip():
+    model = nn.Sequential([nn.Conv2DTranspose(3, 5, 3, stride=2, padding=1),
+                           nn.PReLU()])
+    x = RS.rand(2, 6, 6, 3).astype(np.float32)
+    v = model.init(RNG, jnp.asarray(x))
+    tm = torch.nn.Sequential(
+        torch.nn.ConvTranspose2d(3, 5, 3, stride=2, padding=1),
+        torch.nn.PReLU(5)).eval()
+    to_torch(model, v, tm)
+    with torch.no_grad():
+        ty = tm(torch.tensor(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+    y, _ = model.apply(v, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=2e-4)
