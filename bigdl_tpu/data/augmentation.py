@@ -118,14 +118,17 @@ class ColorJitter(Transformer):
     def __init__(self, brightness: float = 32.0, contrast: float = 0.5,
                  saturation: float = 0.5, hue: float = 0.0,
                  seed: Optional[int] = None):
+        # independent per-stage streams — one shared seed would correlate
+        # the brightness/contrast/saturation draws
+        spawn = np.random.SeedSequence(seed).spawn(5)
         self.stages = [
-            Brightness(-brightness, brightness, seed),
-            Contrast(1 - contrast, 1 + contrast, seed),
-            Saturation(1 - saturation, 1 + saturation, seed),
+            Brightness(-brightness, brightness, spawn[0]),
+            Contrast(1 - contrast, 1 + contrast, spawn[1]),
+            Saturation(1 - saturation, 1 + saturation, spawn[2]),
         ]
         if hue > 0:
-            self.stages.append(Hue(hue, seed))
-        self.rng = np.random.default_rng(seed)
+            self.stages.append(Hue(hue, spawn[3]))
+        self.rng = np.random.default_rng(spawn[4])
 
     def apply(self, it):
         for f in it:

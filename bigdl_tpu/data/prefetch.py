@@ -1,0 +1,33 @@
+"""Prefetch-to-device iterator.
+
+Reference analog: the reference pipelines input via Spark's block prefetch +
+per-executor transformer threads ahead of the compute task (SURVEY.md §4.1);
+on TPU the equivalent is overlapping host→device transfer with the running
+step.  ``jax.device_put`` is asynchronous — it returns immediately while DMA
+proceeds — so a ``size``-deep queue of already-dispatched device batches
+gives transfer/compute overlap without threads: while step k executes, batch
+k+1 (and k+2 …) are in flight over PCIe."""
+
+import collections
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+D = TypeVar("D")
+
+
+def prefetch_to_device(batches: Iterable[T], put: Callable[[T], D],
+                       size: int = 2) -> Iterator[D]:
+    """Yield ``put(batch)`` results with a ``size``-deep dispatch lookahead.
+
+    ``put`` must be non-blocking (e.g. ``ShardedParameterStep.shard_batch``,
+    a ``jax.device_put`` under the hood).  ``size=2`` double-buffers; larger
+    values only help when host batch *production* is bursty."""
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    queue = collections.deque()
+    for b in batches:
+        queue.append(put(b))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -28,10 +28,40 @@ def rle_encode(mask: np.ndarray) -> Dict:
     return {"counts": counts, "size": [int(m.shape[0]), int(m.shape[1])]}
 
 
+def _coco_string_to_counts(s: Union[str, bytes]) -> List[int]:
+    """Decode COCO *compressed* RLE counts (pycocotools ``rleFrString``):
+    5-bit varint chunks (char = chunk+48, bit 0x20 = continuation, 0x10 in
+    the last chunk = sign extension), delta-coded against counts[i-2]."""
+    if isinstance(s, bytes):
+        s = s.decode("ascii")
+    counts: List[int] = []
+    p = 0
+    while p < len(s):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            c = ord(s[p]) - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            p += 1
+            k += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * k)
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(x)
+    return counts
+
+
 def rle_decode(rle: Dict) -> np.ndarray:
-    """COCO uncompressed RLE dict → binary (H, W) uint8 mask."""
+    """COCO RLE dict → binary (H, W) uint8 mask.  Accepts both uncompressed
+    (list ``counts``) and compressed (string ``counts``, the iscrowd=1 form
+    in real COCO JSON) encodings."""
     h, w = rle["size"]
     counts = rle["counts"]
+    if isinstance(counts, (str, bytes)):
+        counts = _coco_string_to_counts(counts)
     flat = np.zeros(h * w, np.uint8)
     pos = 0
     val = 0
@@ -44,7 +74,10 @@ def rle_decode(rle: Dict) -> np.ndarray:
 
 
 def rle_area(rle: Dict) -> int:
-    return int(sum(rle["counts"][1::2]))
+    counts = rle["counts"]
+    if isinstance(counts, (str, bytes)):
+        counts = _coco_string_to_counts(counts)
+    return int(sum(counts[1::2]))
 
 
 def polygons_to_mask(polygons: Sequence[Sequence[float]], height: int,

@@ -53,3 +53,17 @@ from bigdl_tpu.nn.criterion_extra import (
     CosineProximityCriterion, RankHingeCriterion, GaussianCriterion,
     KLDCriterion, L1Cost, TransformerCriterion,
 )
+
+
+def __getattr__(name):
+    # reference ``nn/Graph.scala`` — the node-graph container lives in the
+    # keras engine (one implementation); lazy import avoids a cycle
+    if name == "Graph":
+        from bigdl_tpu.keras.engine import Model as Graph
+
+        return Graph
+    if name == "Input":
+        from bigdl_tpu.keras.engine import Input
+
+        return Input
+    raise AttributeError(f"module 'bigdl_tpu.nn' has no attribute {name!r}")

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from bigdl_tpu.data.dataset import DataSet
+from bigdl_tpu.data.prefetch import prefetch_to_device
 from bigdl_tpu.optim import checkpoint as ckpt
 from bigdl_tpu.optim.metrics import Metrics, SummaryWriter, Timer
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
@@ -112,6 +113,7 @@ class Optimizer:
         self._train_summary: Optional[SummaryWriter] = None
         self._val_summary: Optional[SummaryWriter] = None
         self.log_every = 1
+        self.prefetch = 2  # device-transfer lookahead depth (1 = no overlap)
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
@@ -255,6 +257,12 @@ class Optimizer:
                 self.batch_size, shuffle=True, seed=self.seed, epoch=epoch,
                 process_id=jax.process_index(),
                 process_count=jax.process_count())
+            # double-buffer host→device DMA behind the running step
+            batch_iter = prefetch_to_device(
+                batch_iter,
+                lambda mb: (step_engine.shard_batch(np.asarray(mb["input"])),
+                            step_engine.shard_batch(np.asarray(mb["target"]))),
+                size=self.prefetch)
             try:
                 for mb in batch_iter:
                     loss = self._one_iteration(step_engine, state, mb)
@@ -300,9 +308,9 @@ class Optimizer:
         if self._profiler is not None:
             self._profiler.step(it)
         step_rng = jax.random.fold_in(jax.random.PRNGKey(self.seed + 1), it)
+        x_dev, y_dev = mb
         with Timer(self.metrics, "step_dispatch"):
-            loss = step_engine.train_step(
-                it, step_rng, np.asarray(mb["input"]), np.asarray(mb["target"]))
+            loss = step_engine.train_step_device(it, step_rng, x_dev, y_dev)
         state["iteration"] = it + 1
         return loss
 

@@ -228,10 +228,15 @@ class ShardedParameterStep:
         return jax.make_array_from_process_local_data(self._batch_sh, arr)
 
     def train_step(self, step: int, rng, x, y):
+        return self.train_step_device(
+            step, rng, self.shard_batch(x), self.shard_batch(y))
+
+    def train_step_device(self, step: int, rng, x_dev, y_dev):
+        """Variant taking already-sharded device arrays (the prefetch path —
+        see ``bigdl_tpu.data.prefetch``)."""
         self.flat_params, self.opt_state, self.model_state, loss = self._train(
             self.flat_params, self.opt_state, self.model_state,
-            jnp.asarray(step, jnp.int32), rng,
-            self.shard_batch(x), self.shard_batch(y))
+            jnp.asarray(step, jnp.int32), rng, x_dev, y_dev)
         return loss
 
     def evaluate(self, methods, batches) -> list:

@@ -128,12 +128,16 @@ class MSE(ValidationMethod):
 
 def _rank_of_positive(output, target):
     """Rank of the positive candidate with half-credit ties (matches AUC's
-    tie handling — a constant-score model ranks mid-pack, not first)."""
+    tie handling — a constant-score model ranks mid-pack, not first).  A NaN
+    positive score ranks LAST: every NaN comparison is false, which would
+    otherwise make a diverged model look perfect."""
     tgt = target.astype(jnp.int32).reshape(output.shape[0])
     pos = jnp.take_along_axis(output, tgt[:, None], axis=-1)
     greater = jnp.sum((output > pos).astype(jnp.float32), axis=-1)
     ties = jnp.sum((output == pos).astype(jnp.float32), axis=-1) - 1.0
-    return greater + 0.5 * ties
+    rank = greater + 0.5 * ties
+    bad = jnp.isnan(pos[:, 0]) | jnp.any(jnp.isnan(output), axis=-1)
+    return jnp.where(bad, jnp.asarray(output.shape[-1], rank.dtype), rank)
 
 
 class HitRatio(ValidationMethod):

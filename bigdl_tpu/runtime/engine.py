@@ -62,6 +62,17 @@ class Engine:
 
     def __init__(self, config: EngineConfig):
         self.config = config
+        # BIGDL_TPU_PLATFORM=cpu forces the host platform even where a TPU
+        # plugin ignores the JAX_PLATFORMS env var (combine with
+        # XLA_FLAGS=--xla_force_host_platform_device_count=N for a simulated
+        # mesh — the reference's local[N] analog, SURVEY.md §5)
+        plat = os.environ.get("BIGDL_TPU_PLATFORM")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except RuntimeError:
+                log.warning("backend already initialized; "
+                            "BIGDL_TPU_PLATFORM=%s ignored", plat)
         if config.coordinator_address is not None and not Engine._distributed_initialized:
             jax.distributed.initialize(
                 coordinator_address=config.coordinator_address,
@@ -111,3 +122,16 @@ def init_engine(config: Optional[EngineConfig] = None, **mesh_axes) -> Engine:
         config.mesh = dataclasses.replace(config.mesh, **mesh_axes)
     Engine._instance = Engine(config)
     return Engine._instance
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> None:
+    """Turn on JAX's persistent compilation cache (an optimization, never a
+    failure — errors are swallowed).  Big-model XLA compiles take minutes on
+    tunneled chips; the cache makes re-runs near-instant."""
+    if cache_dir is None:
+        cache_dir = os.path.join(os.getcwd(), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover — older jax without the options
+        pass

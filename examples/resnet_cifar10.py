@@ -1,0 +1,67 @@
+"""ResNet-20/CIFAR-10 training — reference ``models/resnet/TrainCIFAR10.scala``
+(unverified — mount empty): SGD with warmup+multistep schedule, L2 weight
+decay, per-epoch validation.
+
+    python examples/resnet_cifar10.py [--epochs 10] [--batch 256]
+
+Synthetic CIFAR-shaped data keeps the example runnable offline; swap
+``synthetic_cifar`` for a real loader to train for real.
+"""
+
+import argparse
+
+import numpy as np
+
+from bigdl_tpu.data.dataset import ArrayDataSet
+from bigdl_tpu.models import resnet_cifar
+from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Trigger)
+from bigdl_tpu.optim.schedules import MultiStep, Warmup, SequentialSchedule
+from bigdl_tpu.runtime.engine import init_engine
+
+
+def synthetic_cifar(n=4096, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 32, 32, 3).astype(np.float32) * 0.3
+    y = rs.randint(0, 10, n).astype(np.int32)
+    for i, k in enumerate(y):
+        x[i, :, :, k % 3] += 0.1 * (k + 1) / 10.0
+        x[i, (k * 3) % 28:(k * 3) % 28 + 4, :, :] += 0.4
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=20)
+    args = ap.parse_args()
+
+    init_engine()
+    x, y = synthetic_cifar()
+    n_val = len(x) // 8
+    train = ArrayDataSet(x[n_val:], y[n_val:])
+    val = ArrayDataSet(x[:n_val], y[:n_val])
+
+    steps_per_epoch = (len(x) - n_val) // args.batch
+    # linear warmup for one epoch, then step decay at 50%/75% of training
+    schedule = (SequentialSchedule()
+                .add(Warmup(0.1 / max(steps_per_epoch, 1)), steps_per_epoch)
+                .add(MultiStep([steps_per_epoch * (args.epochs // 2),
+                                steps_per_epoch * (3 * args.epochs // 4)],
+                               0.1), 10 ** 9))
+    model = resnet_cifar(depth=args.depth, classes=10)
+    opt = (Optimizer(model, train, CrossEntropyCriterion(),
+                     batch_size=args.batch)
+           .set_optim_method(SGD(learning_rate=0.1, momentum=0.9,
+                                 weight_decay=5e-4, nesterov=True,
+                                 learning_rate_schedule=schedule))
+           .set_end_when(Trigger.max_epoch(args.epochs))
+           .set_validation(Trigger.every_epoch(), val, [Top1Accuracy()]))
+    trained = opt.optimize()
+    print("final:", trained.evaluate(val, [Top1Accuracy()],
+                                     batch_size=args.batch))
+
+
+if __name__ == "__main__":
+    main()

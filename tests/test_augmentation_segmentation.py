@@ -122,3 +122,39 @@ def test_polygon_rasterization_and_bbox():
 
 def test_mask_to_bbox_empty():
     assert mask_to_bbox(np.zeros((5, 5))) == [0.0, 0.0, 0.0, 0.0]
+
+
+def test_coco_compressed_rle_decode():
+    from bigdl_tpu.data.segmentation import _coco_string_to_counts
+
+    # round-trip through the COCO varint coder: encode counts with the
+    # inverse algorithm, decode, compare
+    def counts_to_string(counts):
+        s = []
+        for i, x in enumerate(counts):
+            if i > 2:
+                x -= counts[i - 2]
+            more = True
+            while more:
+                c = x & 0x1F
+                x >>= 5
+                more = not ((x == 0 and not (c & 0x10))
+                            or (x == -1 and (c & 0x10)))
+                if more:
+                    c |= 0x20
+                s.append(chr(c + 48))
+        return "".join(s)
+
+    mask = (RS.rand(9, 11) > 0.55).astype(np.uint8)
+    rle = rle_encode(mask)
+    compressed = {"counts": counts_to_string(rle["counts"]),
+                  "size": rle["size"]}
+    assert _coco_string_to_counts(compressed["counts"]) == rle["counts"]
+    np.testing.assert_array_equal(rle_decode(compressed), mask)
+    assert rle_area(compressed) == int(mask.sum())
+
+
+def test_colorjitter_stages_independent():
+    cj = ColorJitter(seed=7)
+    b, c = cj.stages[0].rng, cj.stages[1].rng
+    assert not np.allclose(b.random(8), c.random(8))

@@ -248,3 +248,11 @@ def test_lbfgs_trains_model():
         params, st = opt.update(i, g, params, st)
     final = float(crit(model.forward(params, {}, x)[0], y))
     assert final < 1e-4, final
+
+
+def test_hitratio_nan_scores_rank_last():
+    scores = jnp.asarray([[np.nan, 1.0, 2.0], [5.0, 1.0, np.nan]])
+    tgt = jnp.zeros((2,), jnp.int32)
+    s, c = HitRatio(k=3).batch_stats(scores, tgt)
+    # NaN anywhere in the row disqualifies it — diverged models score 0
+    np.testing.assert_allclose(float(s), 0.0)

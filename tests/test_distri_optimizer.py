@@ -150,3 +150,28 @@ class TestDistriOptimizer:
         trained = opt.optimize()
         res = trained.evaluate(ds, [optim.Top1Accuracy()])
         assert res[0].result > 0.5
+
+
+def test_prefetch_to_device_order_and_depth():
+    from bigdl_tpu.data.prefetch import prefetch_to_device
+
+    dispatched = []
+
+    def put(b):
+        dispatched.append(b)
+        return b * 10
+
+    out = []
+    gen = prefetch_to_device(iter(range(5)), put, size=2)
+    first = next(gen)
+    # depth-2: two dispatches before the first yield
+    assert dispatched == [0, 1]
+    assert first == 0
+    out = [first] + list(gen)
+    assert out == [0, 10, 20, 30, 40]
+    assert dispatched == [0, 1, 2, 3, 4]
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        list(prefetch_to_device(iter([1]), put, size=0))
