@@ -1,0 +1,79 @@
+"""Two-process distributed training demo on CPU — the multi-controller
+bootstrap path (one process per TPU-VM host in production; two local CPU
+processes here, exactly the reference's ``local-cluster`` Spark test mode).
+
+Reference analog: SURVEY.md §4.3 — Orca's barrier-stage rendezvous →
+``torch.distributed.init_process_group``; here the rendezvous is
+``jax.distributed.initialize`` driven by the BIGDL_TPU_* env contract that
+``Engine`` reads, and gradient sync is the ZeRO-1 sharded step's XLA
+collectives running CROSS-PROCESS.
+
+    python examples/multihost_cpu_demo.py          # parent: spawns 2 workers
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+PORT = int(os.environ.get("DEMO_PORT", "12357"))
+
+
+def worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.criterion import MSECriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.runtime.engine import init_engine
+
+    init_engine()  # reads BIGDL_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID
+    pid = jax.process_index()
+    print(f"[worker {pid}] sees {jax.device_count()} global devices, "
+          f"{jax.local_device_count()} local", flush=True)
+
+    # identical data on every process (the DataSet shards by process_id)
+    rs = np.random.RandomState(0)
+    w_true = np.asarray([[2.0], [-1.0], [0.5], [3.0]], np.float32)
+    x = rs.rand(256, 4).astype(np.float32)
+    y = x @ w_true
+
+    model = nn.Linear(4, 1)
+    opt = (Optimizer(model, ArrayDataSet(x, y), MSECriterion(),
+                     batch_size=64)
+           .set_optim_method(SGD(learning_rate=0.3))
+           .set_end_when(Trigger.max_epoch(30)))
+    trained = opt.optimize()
+
+    w = np.asarray(trained.variables["params"]["weight"])
+    err = float(np.abs(w - w_true).max())
+    print(f"[worker {pid}] weight err {err:.5f}", flush=True)
+    assert err < 0.05, err
+    print(f"[worker {pid}] OK", flush=True)
+
+
+def main():
+    if os.environ.get("BIGDL_TPU_COORDINATOR"):
+        worker()
+        return
+    nproc = 2
+    procs = []
+    for r in range(nproc):
+        env = dict(os.environ,
+                   BIGDL_TPU_COORDINATOR=f"127.0.0.1:{PORT}",
+                   BIGDL_TPU_NUM_PROCESSES=str(nproc),
+                   BIGDL_TPU_PROCESS_ID=str(r),
+                   JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen([sys.executable, __file__], env=env))
+    codes = [p.wait(timeout=600) for p in procs]
+    if any(codes):
+        raise SystemExit(f"worker exit codes: {codes}")
+    print("multihost demo: both workers converged")
+
+
+if __name__ == "__main__":
+    main()
