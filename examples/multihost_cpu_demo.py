@@ -17,7 +17,12 @@ import sys
 
 import numpy as np
 
-PORT = int(os.environ.get("DEMO_PORT", "12357"))
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def worker():
@@ -61,15 +66,26 @@ def main():
         worker()
         return
     nproc = 2
+    port = int(os.environ.get("DEMO_PORT", "0")) or _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pythonpath = os.pathsep.join(
+        p for p in [repo_root, os.environ.get("PYTHONPATH")] if p)
     procs = []
-    for r in range(nproc):
-        env = dict(os.environ,
-                   BIGDL_TPU_COORDINATOR=f"127.0.0.1:{PORT}",
-                   BIGDL_TPU_NUM_PROCESSES=str(nproc),
-                   BIGDL_TPU_PROCESS_ID=str(r),
-                   JAX_PLATFORMS="cpu")
-        procs.append(subprocess.Popen([sys.executable, __file__], env=env))
-    codes = [p.wait(timeout=600) for p in procs]
+    try:
+        for r in range(nproc):
+            env = dict(os.environ,
+                       BIGDL_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       BIGDL_TPU_NUM_PROCESSES=str(nproc),
+                       BIGDL_TPU_PROCESS_ID=str(r),
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=pythonpath)
+            env.pop("XLA_FLAGS", None)  # one device per process
+            procs.append(subprocess.Popen([sys.executable, __file__], env=env))
+        codes = [p.wait(timeout=600) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     if any(codes):
         raise SystemExit(f"worker exit codes: {codes}")
     print("multihost demo: both workers converged")

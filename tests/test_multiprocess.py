@@ -5,11 +5,16 @@ This is the step beyond the in-process 8-device simulation (conftest): the
 reference's ``local-cluster`` Spark mode analog (SURVEY.md §5)."""
 
 import os
+import socket
 import subprocess
 import sys
 import textwrap
 
-PORT = 12431
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 WORKER = textwrap.dedent("""
     import os
@@ -45,18 +50,34 @@ def test_two_process_distributed_training(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     procs = []
-    for r in range(2):
-        env = dict(os.environ,
-                   BIGDL_TPU_COORDINATOR=f"127.0.0.1:{PORT}",
-                   BIGDL_TPU_NUM_PROCESSES="2",
-                   BIGDL_TPU_PROCESS_ID=str(r),
-                   JAX_PLATFORMS="cpu")
-        env.pop("XLA_FLAGS", None)  # one device per process
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=420)[0] for p in procs]
-    codes = [p.returncode for p in procs]
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pythonpath = os.pathsep.join(
+        p for p in [repo_root, os.environ.get("PYTHONPATH")] if p)
+    try:
+        for r in range(2):
+            env = dict(os.environ,
+                       BIGDL_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       BIGDL_TPU_NUM_PROCESSES="2",
+                       BIGDL_TPU_PROCESS_ID=str(r),
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=pythonpath)
+            env.pop("XLA_FLAGS", None)  # one device per process
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=420)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+        codes = [p.returncode for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     assert codes == [0, 0], f"exit {codes}\n--- rank0:\n{outs[0]}\n--- rank1:\n{outs[1]}"
     # both ranks converged to the same weights (collectives kept them synced)
     errs = sorted(line for o in outs for line in o.splitlines()
