@@ -29,6 +29,15 @@ from bigdl_tpu.nn.layers_extra import (
     GaussianNoise, GaussianDropout, Highway, Maxout, Bilinear, Cosine,
     Euclidean, SReLU,
 )
+from bigdl_tpu.nn.layers_more import (
+    SplitTable, Pack, Replicate, Reverse, MixtureTable, MapTable, Bottle,
+    InferReshape, GradientReversal, L1Penalty, HardShrink, SoftShrink,
+    TanhShrink, Mish, RReLU, GaussianSampler, Conv3DTranspose,
+    VolumetricFullConvolution, LocallyConnected1D, GlobalMaxPool3D,
+    GlobalAvgPool3D, ConvLSTM2D, ConvLSTMPeephole,
+    SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
+    SpatialContrastiveNormalization,
+)
 from bigdl_tpu.nn.sparse_layers import SparseLinear, SparseJoinTable
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,

@@ -1,0 +1,578 @@
+"""Third layer tranche — table/structure ops, penalty/reversal layers,
+shrink activations, samplers, 3-D transposed conv, ConvLSTM, local
+normalization.
+
+Reference analog (unverified — mount empty): ``dllib/nn/*.scala`` one file per
+layer (SplitTable, Replicate, Reverse, Pack, MixtureTable, MapTable, Bottle,
+GradientReversal, L1Penalty, GaussianSampler, InferReshape, HardShrink,
+SoftShrink, RReLU, VolumetricFullConvolution, ConvLSTMPeephole,
+SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
+SpatialContrastiveNormalization).
+
+All spatial layers are NHWC / NDHWC (TPU-first); time-major recurrences use
+``lax.scan`` over a batch-first (N, T, ...) input.
+"""
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.layers import _conv_accum, _pair
+from bigdl_tpu.nn.layers_extra import _triple
+from bigdl_tpu.nn.module import EMPTY, Container, Module, _fold, _table
+from bigdl_tpu.tensor.policy import cast_compute
+
+
+# ---------------------------------------------------------------------------
+# Table / structure ops
+# ---------------------------------------------------------------------------
+
+
+class SplitTable(Module):
+    """Split a tensor along ``dim`` into a tuple of tensors — reference
+    ``nn/SplitTable.scala`` (there 1-indexed; here 0-indexed, negative ok)."""
+
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, params, state, x, training=False, rng=None):
+        n = x.shape[self.dim]
+        parts = jnp.split(x, n, axis=self.dim)
+        return tuple(jnp.squeeze(p, axis=self.dim) for p in parts), EMPTY
+
+
+class Pack(Module):
+    """Stack a table of same-shaped tensors along a new ``dim`` — reference
+    ``nn/Pack.scala`` (inverse of SplitTable)."""
+
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        return jnp.stack(list(_table(xs)), axis=self.dim), EMPTY
+
+
+class Replicate(Module):
+    """Replicate the input ``n_features`` times along a new ``dim`` —
+    reference ``nn/Replicate.scala``."""
+
+    def __init__(self, n_features: int, dim: int = 0, name=None):
+        super().__init__(name)
+        self.n_features = n_features
+        self.dim = dim
+
+    def forward(self, params, state, x, training=False, rng=None):
+        y = jnp.expand_dims(x, self.dim)
+        reps = [1] * y.ndim
+        reps[self.dim] = self.n_features
+        return jnp.tile(y, reps), EMPTY
+
+
+class Reverse(Module):
+    """Reverse along ``dim`` — reference ``nn/Reverse.scala``."""
+
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.flip(x, axis=self.dim), EMPTY
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts combine: input is (gater, experts) where gater is
+    (N, E) weights and experts a table of E tensors (N, ...) or one stacked
+    (N, E, ...) tensor — reference ``nn/MixtureTable.scala``."""
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        gater = xs[0]
+        experts = xs[1] if len(xs) == 2 else xs[1:]
+        if isinstance(experts, (tuple, list)):
+            stacked = jnp.stack(list(experts), axis=1)  # (N, E, ...)
+        else:
+            stacked = experts
+        g = gater.reshape(gater.shape + (1,) * (stacked.ndim - 2))
+        return jnp.sum(g * stacked, axis=1), EMPTY
+
+
+class MapTable(Container):
+    """Apply ONE shared module to every element of the input table —
+    reference ``nn/MapTable.scala`` (clones share parameters there; here the
+    same params pytree is literally reused)."""
+
+    def __init__(self, module: Module, name=None):
+        super().__init__([module], name)
+
+    def init(self, rng, *inputs):
+        xs = _table(inputs)
+        v = self.layers[0].init(rng, xs[0])
+        k = self._key(0)
+        return {"params": {k: v["params"]} if v["params"] else {},
+                "state": {k: v["state"]} if v["state"] else {}}
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        xs = _table(xs)
+        k = self._key(0)
+        p = params.get(k, EMPTY)
+        st = state.get(k, EMPTY)
+        ys, new_st = [], st
+        for i, x in enumerate(xs):
+            y, upd = self.layers[0].forward(
+                p, new_st, x, training=training, rng=_fold(rng, i))
+            if upd:
+                new_st = upd  # thread state through elements (running stats)
+            ys.append(y)
+        out_state = {k: new_st} if new_st else EMPTY
+        return tuple(ys), out_state
+
+
+class Bottle(Container):
+    """Collapse the first ``n_input_dims`` dims to one batch dim, apply the
+    inner module, restore — reference ``nn/Bottle.scala``."""
+
+    def __init__(self, module: Module, n_input_dims: int = 2, name=None):
+        super().__init__([module], name)
+        self.n_input_dims = n_input_dims
+
+    def init(self, rng, x):
+        lead = x.shape[: self.n_input_dims]
+        flat = x.reshape((int(np.prod(lead)),) + x.shape[self.n_input_dims:])
+        v = self.layers[0].init(rng, flat)
+        k = self._key(0)
+        return {"params": {k: v["params"]} if v["params"] else {},
+                "state": {k: v["state"]} if v["state"] else {}}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        lead = x.shape[: self.n_input_dims]
+        flat = x.reshape((-1,) + x.shape[self.n_input_dims:])
+        k = self._key(0)
+        y, st = self.layers[0].forward(
+            params.get(k, EMPTY), state.get(k, EMPTY), flat,
+            training=training, rng=rng)
+        y = y.reshape(lead + y.shape[1:])
+        return y, ({k: st} if st else EMPTY)
+
+
+class InferReshape(Module):
+    """Reshape with -1 (inferred) and 0 (copy input dim) entries — reference
+    ``nn/InferReshape.scala``."""
+
+    def __init__(self, shape, batch_mode: bool = False, name=None):
+        super().__init__(name)
+        self.shape = tuple(shape)
+        self.batch_mode = batch_mode
+
+    def forward(self, params, state, x, training=False, rng=None):
+        lead = (x.shape[0],) if self.batch_mode else ()
+        src = x.shape[1:] if self.batch_mode else x.shape
+        out = [src[i] if s == 0 else s for i, s in enumerate(self.shape)]
+        return x.reshape(lead + tuple(out)), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Gradient-shaping layers
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def _grad_reverse(x, lam):
+    return x
+
+
+def _grad_reverse_fwd(x, lam):
+    return x, lam
+
+
+def _grad_reverse_bwd(lam, g):
+    return (-lam * g, None)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
+class GradientReversal(Module):
+    """Identity forward, gradient scaled by ``-lambda`` backward (domain-
+    adversarial training) — reference ``nn/GradientReversal.scala``."""
+
+    def __init__(self, lam: float = 1.0, name=None):
+        super().__init__(name)
+        self.lam = float(lam)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return _grad_reverse(x, self.lam), EMPTY
+
+
+@jax.custom_vjp
+def _l1_penalty(x, weight):
+    return x
+
+
+def _l1_penalty_fwd(x, weight):
+    return x, (jnp.sign(x), weight)
+
+
+def _l1_penalty_bwd(res, g):
+    sign, weight = res
+    return (g + weight * sign, None)
+
+
+_l1_penalty.defvjp(_l1_penalty_fwd, _l1_penalty_bwd)
+
+
+class L1Penalty(Module):
+    """Identity forward; adds ``l1weight * sign(x)`` to the gradient during
+    training (sparsity penalty on activations) — reference
+    ``nn/L1Penalty.scala`` (which adds the penalty into gradInput)."""
+
+    def __init__(self, l1weight: float, size_average: bool = False, name=None):
+        super().__init__(name)
+        self.l1weight = float(l1weight)
+        self.size_average = size_average
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if not training:
+            return x, EMPTY
+        w = self.l1weight / (x.size if self.size_average else 1)
+        return _l1_penalty(x, w), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Shrink / randomized activations
+# ---------------------------------------------------------------------------
+
+
+class HardShrink(Module):
+    """x if |x| > lambda else 0 — reference ``nn/HardShrink.scala``."""
+
+    def __init__(self, lam: float = 0.5, name=None):
+        super().__init__(name)
+        self.lam = float(lam)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.lam, x, 0.0), EMPTY
+
+
+class SoftShrink(Module):
+    """sign(x) * max(|x| - lambda, 0) — reference ``nn/SoftShrink.scala``."""
+
+    def __init__(self, lam: float = 0.5, name=None):
+        super().__init__(name)
+        self.lam = float(lam)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.lam, 0.0), EMPTY
+
+
+class TanhShrink(Module):
+    """x - tanh(x) — reference ``nn/TanhShrink.scala``."""
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x - jnp.tanh(x), EMPTY
+
+
+class Mish(Module):
+    """x * tanh(softplus(x)) (modern addition; not in the reference)."""
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x * jnp.tanh(jax.nn.softplus(x)), EMPTY
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: negative slope ~ U[lower, upper] per element in
+    training, fixed mean slope in eval — reference ``nn/RReLU.scala``."""
+
+    def __init__(self, lower: float = 1 / 8, upper: float = 1 / 3, name=None):
+        super().__init__(name)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if training:
+            if rng is None:
+                raise ValueError("RReLU(training=True) needs rng")
+            slope = jax.random.uniform(
+                rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2
+        return jnp.where(x >= 0, x, slope * x), EMPTY
+
+
+class GaussianSampler(Module):
+    """VAE reparameterization: input (mean, log_var) table, output
+    ``mean + exp(0.5*log_var) * eps`` — reference ``nn/GaussianSampler.scala``."""
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        mean, log_var = _table(xs)
+        if rng is None:
+            raise ValueError("GaussianSampler needs rng")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps, EMPTY
+
+
+# ---------------------------------------------------------------------------
+# Conv family additions
+# ---------------------------------------------------------------------------
+
+
+class Conv3DTranspose(Module):
+    """Transposed 3-D conv (NDHWC) — reference
+    ``nn/VolumetricFullConvolution.scala``."""
+
+    def __init__(self, in_channels: Optional[int], out_channels: int,
+                 kernel_size, stride=1, padding: Union[str, int] = 0,
+                 with_bias: bool = True, weight_init=init_mod.msra,
+                 bias_init=init_mod.zeros, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride)
+        self.padding = padding
+        self.with_bias = with_bias
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        kd, kh, kw = self.kernel_size
+        fan_in = cin * kd * kh * kw
+        fan_out = self.out_channels * kd * kh * kw
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": self.weight_init(
+            k1, (kd, kh, kw, self.out_channels, cin), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(k2, (self.out_channels,), fan_in,
+                                            fan_out)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            p = _triple(self.padding)
+            k = self.kernel_size
+            pad = [(k[i] - 1 - p[i], k[i] - 1 - p[i]) for i in range(3)]
+        xc, wc = cast_compute(x, params["weight"])
+        y = jax.lax.conv_transpose(
+            xc, wc, strides=self.stride, padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            transpose_kernel=True, **_conv_accum(xc))
+        if self.with_bias:
+            y = y.astype(jnp.float32) + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+VolumetricFullConvolution = Conv3DTranspose
+
+
+class LocallyConnected1D(Module):
+    """Conv1D with untied (per-position) weights — keras-side
+    ``LocallyConnected1D`` in the reference."""
+
+    def __init__(self, in_channels: Optional[int], out_channels: int,
+                 kernel_size: int, stride: int = 1, with_bias: bool = True,
+                 weight_init=init_mod.xavier,
+                 bias_init=init_mod.zeros, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = int(kernel_size)
+        self.stride = int(stride)
+        self.with_bias = with_bias
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+
+    def _out_len(self, length: int) -> int:
+        return (length - self.kernel_size) // self.stride + 1
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        out_len = self._out_len(x.shape[1])
+        fan_in = cin * self.kernel_size
+        fan_out = self.out_channels * self.kernel_size
+        k1, k2 = jax.random.split(rng)
+        params = {"weight": self.weight_init(
+            k1, (out_len, self.kernel_size, cin, self.out_channels),
+            fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init(
+                k2, (out_len, self.out_channels), fan_in, fan_out)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        out_len = self._out_len(x.shape[1])
+        idx = (jnp.arange(out_len)[:, None] * self.stride
+               + jnp.arange(self.kernel_size)[None, :])
+        windows = x[:, idx, :]  # (N, out_len, k, cin)
+        wc, xc = cast_compute(params["weight"], windows)
+        y = jnp.einsum("nlkc,lkco->nlo", xc, wc,
+                       preferred_element_type=jnp.float32)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+class GlobalMaxPool3D(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.max(x, axis=(1, 2, 3)), EMPTY
+
+
+class GlobalAvgPool3D(Module):
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2, 3)), EMPTY
+
+
+class ConvLSTM2D(Module):
+    """Convolutional LSTM over (N, T, H, W, C) with optional peephole
+    connections — reference ``nn/ConvLSTMPeephole.scala``.  The time
+    recurrence is a ``lax.scan`` (single compiled step, TPU-friendly);
+    gates are one fused convolution producing 4*hidden channels."""
+
+    def __init__(self, in_channels: Optional[int], hidden_channels: int,
+                 kernel_size, peephole: bool = True,
+                 return_sequences: bool = True,
+                 weight_init=init_mod.xavier,
+                 bias_init=init_mod.zeros, name=None):
+        super().__init__(name)
+        self.in_channels = in_channels
+        self.hidden = hidden_channels
+        self.kernel_size = _pair(kernel_size)
+        self.peephole = peephole
+        self.return_sequences = return_sequences
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+
+    def build(self, rng, x):
+        cin = self.in_channels or x.shape[-1]
+        kh, kw = self.kernel_size
+        h = self.hidden
+        fan_in = (cin + h) * kh * kw
+        fan_out = 4 * h * kh * kw
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = {
+            "weight": self.weight_init(
+                k1, (kh, kw, cin + h, 4 * h), fan_in, fan_out),
+            "bias": self.bias_init(k2, (4 * h,), fan_in, fan_out),
+        }
+        if self.peephole:
+            params["peep"] = self.weight_init(k3, (3, h), h, h)
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        n, t, hh, ww, _ = x.shape
+        h = self.hidden
+        w = params["weight"]
+        b = params["bias"]
+        peep = params.get("peep")
+
+        def step(carry, xt):
+            hprev, cprev = carry
+            inp = jnp.concatenate([xt, hprev], axis=-1)
+            ic, wc = cast_compute(inp, w)
+            gates = jax.lax.conv_general_dilated(
+                ic, wc, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                **_conv_accum(ic)).astype(jnp.float32) + b
+            gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+            if peep is not None:
+                gi = gi + peep[0] * cprev
+                gf = gf + peep[1] * cprev
+            i = jax.nn.sigmoid(gi)
+            f = jax.nn.sigmoid(gf)
+            c = f * cprev + i * jnp.tanh(gc)
+            if peep is not None:
+                go = go + peep[2] * c
+            o = jax.nn.sigmoid(go)
+            hnew = o * jnp.tanh(c)
+            return (hnew, c), hnew
+
+        h0 = jnp.zeros((n, hh, ww, h), jnp.float32)
+        (_, _), ys = jax.lax.scan(step, (h0, h0), jnp.moveaxis(x, 1, 0))
+        ys = jnp.moveaxis(ys, 0, 1).astype(x.dtype)  # (N, T, H, W, hidden)
+        return (ys if self.return_sequences else ys[:, -1]), EMPTY
+
+
+ConvLSTMPeephole = ConvLSTM2D
+
+
+# ---------------------------------------------------------------------------
+# Local normalization (classic torch-lineage layers)
+# ---------------------------------------------------------------------------
+
+
+def _gauss_kernel(size: Tuple[int, int]) -> np.ndarray:
+    kh, kw = size
+    yy = np.arange(kh) - (kh - 1) / 2
+    xx = np.arange(kw) - (kw - 1) / 2
+    sig_y = max(kh / 4.0, 1e-3)
+    sig_x = max(kw / 4.0, 1e-3)
+    k = np.exp(-(yy[:, None] ** 2) / (2 * sig_y ** 2)
+               - (xx[None, :] ** 2) / (2 * sig_x ** 2))
+    return (k / k.sum()).astype(np.float32)
+
+
+def _local_mean(x, kernel):
+    """Per-location weighted mean across the window AND channels, with edge
+    correction (divide by the local kernel mass, as the reference does via its
+    coefficient map)."""
+    kh, kw = kernel.shape
+    k4 = jnp.asarray(kernel)[:, :, None, None]
+    mean_c = jnp.mean(x, axis=-1, keepdims=True).astype(jnp.float32)
+    num = jax.lax.conv_general_dilated(
+        mean_c, k4, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    ones = jnp.ones_like(mean_c)
+    den = jax.lax.conv_general_dilated(
+        ones, k4, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return num / den
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract the gaussian-weighted local mean (across space and channels)
+    — reference ``nn/SpatialSubtractiveNormalization.scala``."""
+
+    def __init__(self, kernel_size=9, name=None):
+        super().__init__(name)
+        self.kernel = _gauss_kernel(_pair(kernel_size))
+
+    def forward(self, params, state, x, training=False, rng=None):
+        mean = _local_mean(x, self.kernel)
+        return (x - mean).astype(x.dtype), EMPTY
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by the local standard deviation, thresholded below by its
+    spatial mean — reference ``nn/SpatialDivisiveNormalization.scala``."""
+
+    def __init__(self, kernel_size=9, threshold: float = 1e-4, name=None):
+        super().__init__(name)
+        self.kernel = _gauss_kernel(_pair(kernel_size))
+        self.threshold = threshold
+
+    def forward(self, params, state, x, training=False, rng=None):
+        var = _local_mean(x.astype(jnp.float32) ** 2, self.kernel)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        mean_std = jnp.mean(std, axis=(1, 2), keepdims=True)
+        den = jnp.maximum(jnp.maximum(std, mean_std), self.threshold)
+        return (x / den).astype(x.dtype), EMPTY
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization — reference
+    ``nn/SpatialContrastiveNormalization.scala``."""
+
+    def __init__(self, kernel_size=9, threshold: float = 1e-4, name=None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(kernel_size)
+        self.div = SpatialDivisiveNormalization(kernel_size, threshold)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        y, _ = self.sub.forward(params, state, x, training=training)
+        return self.div.forward(params, state, y, training=training)

@@ -1,0 +1,280 @@
+"""Third layer tranche: table/structure ops, gradient-shaping layers, shrink
+activations, ConvLSTM, transposed 3-D conv, local normalization.
+
+Mirrors the reference's per-layer Spec + Torch-parity pattern (SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+RNG = jax.random.PRNGKey(0)
+
+
+def _run(layer, *xs, training=False, rng=None):
+    v = layer.init(RNG, *xs)
+    y, _ = layer.apply(v, *xs, training=training, rng=rng)
+    return v, y
+
+
+# ---------------------------------------------------------------------------
+# table / structure ops
+# ---------------------------------------------------------------------------
+
+
+def test_split_pack_roundtrip():
+    x = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+    _, parts = _run(nn.SplitTable(dim=1), x)
+    assert len(parts) == 4 and parts[0].shape == (3, 5)
+    _, packed = _run(nn.Pack(dim=1), parts)
+    np.testing.assert_allclose(packed, x, rtol=1e-6)
+
+
+def test_replicate_reverse():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _, y = _run(nn.Replicate(4, dim=1), x)
+    assert y.shape == (2, 4, 3)
+    np.testing.assert_allclose(y[:, 0], x)
+    _, r = _run(nn.Reverse(dim=1), x)
+    np.testing.assert_allclose(r, x[:, ::-1])
+
+
+def test_mixture_table_matches_manual():
+    rs = np.random.RandomState(1)
+    g = jax.nn.softmax(jnp.asarray(rs.rand(2, 3), jnp.float32), axis=-1)
+    experts = tuple(jnp.asarray(rs.rand(2, 5), jnp.float32) for _ in range(3))
+    _, y = _run(nn.MixtureTable(), g, *experts)
+    want = sum(np.asarray(g)[:, i:i + 1] * np.asarray(experts[i])
+               for i in range(3))
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5)
+    # stacked-tensor expert form
+    _, y2 = _run(nn.MixtureTable(), g, jnp.stack(experts, axis=1))
+    np.testing.assert_allclose(np.asarray(y2), want, rtol=1e-5)
+
+
+def test_map_table_shares_params():
+    x1 = np.random.RandomState(2).rand(4, 6).astype(np.float32)
+    x2 = np.random.RandomState(3).rand(4, 6).astype(np.float32)
+    m = nn.MapTable(nn.Linear(6, 2))
+    v = m.init(RNG, x1, x2)
+    (y1, y2), _ = m.apply(v, x1, x2)
+    # same params applied to each element
+    inner = nn.Linear(6, 2)
+    k = m._key(0)
+    y1_direct, _ = inner.forward(v["params"][k], {}, x1)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y1_direct),
+                               rtol=1e-5)
+    assert y2.shape == (4, 2)
+
+
+def test_bottle_equals_flat_apply():
+    x = np.random.RandomState(4).rand(2, 3, 6).astype(np.float32)
+    m = nn.Bottle(nn.Linear(6, 4), n_input_dims=2)
+    v = m.init(RNG, x)
+    y, _ = m.apply(v, x)
+    assert y.shape == (2, 3, 4)
+    k = m._key(0)
+    flat, _ = nn.Linear(6, 4).forward(v["params"][k], {}, x.reshape(6, 6))
+    np.testing.assert_allclose(np.asarray(y).reshape(6, 4),
+                               np.asarray(flat), rtol=1e-5)
+
+
+def test_infer_reshape():
+    x = np.zeros((2, 3, 4), np.float32)
+    _, y = _run(nn.InferReshape((0, -1)), x)
+    assert y.shape == (2, 12)
+    _, y2 = _run(nn.InferReshape((-1,), batch_mode=True), x)
+    assert y2.shape == (2, 12)
+
+
+# ---------------------------------------------------------------------------
+# gradient-shaping
+# ---------------------------------------------------------------------------
+
+
+def test_gradient_reversal():
+    layer = nn.GradientReversal(lam=0.7)
+    v = layer.init(RNG, np.zeros((3,), np.float32))
+
+    def f(x):
+        y, _ = layer.apply(v, x)
+        return jnp.sum(y ** 2)
+
+    x = jnp.asarray([1.0, -2.0, 3.0])
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g), -0.7 * 2 * np.asarray(x),
+                               rtol=1e-6)
+
+
+def test_l1_penalty_grad():
+    layer = nn.L1Penalty(l1weight=0.1)
+    v = layer.init(RNG, np.zeros((3,), np.float32))
+
+    def f(x):
+        y, _ = layer.apply(v, x, training=True)
+        return jnp.sum(y)
+
+    x = jnp.asarray([1.0, -2.0, 3.0])
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g),
+                               1.0 + 0.1 * np.sign(np.asarray(x)), rtol=1e-6)
+    # eval mode: pure identity
+    y, _ = layer.apply(v, x, training=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# shrink activations — torch parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ours,theirs", [
+    (nn.HardShrink(0.5), "hardshrink"),
+    (nn.SoftShrink(0.5), "softshrink"),
+    (nn.TanhShrink(), "tanhshrink"),
+    (nn.Mish(), "mish"),
+])
+def test_shrink_torch_parity(ours, theirs):
+    torch = pytest.importorskip("torch")
+    x = np.linspace(-2, 2, 41).astype(np.float32)
+    _, y = _run(ours, x)
+    want = getattr(torch.nn.functional, theirs)(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5, atol=1e-6)
+
+
+def test_rrelu_train_eval():
+    x = np.linspace(-3, 1, 64).astype(np.float32)
+    layer = nn.RReLU()
+    v = layer.init(RNG, x)
+    y_eval, _ = layer.apply(v, x, training=False)
+    mid = (1 / 8 + 1 / 3) / 2
+    np.testing.assert_allclose(
+        np.asarray(y_eval), np.where(x >= 0, x, mid * x), rtol=1e-5)
+    y_tr, _ = layer.apply(v, x, training=True, rng=jax.random.PRNGKey(7))
+    neg = x < 0
+    slopes = np.asarray(y_tr)[neg] / x[neg]
+    assert slopes.min() >= 1 / 8 - 1e-5 and slopes.max() <= 1 / 3 + 1e-5
+    with pytest.raises(ValueError):
+        layer.apply(v, x, training=True)
+
+
+def test_gaussian_sampler_stats():
+    mean = np.full((2000,), 3.0, np.float32)
+    log_var = np.full((2000,), np.log(0.25), np.float32)
+    layer = nn.GaussianSampler()
+    v = layer.init(RNG, mean, log_var)
+    y, _ = layer.apply(v, mean, log_var, rng=jax.random.PRNGKey(5))
+    y = np.asarray(y)
+    assert abs(y.mean() - 3.0) < 0.05
+    assert abs(y.std() - 0.5) < 0.05
+
+
+# ---------------------------------------------------------------------------
+# conv family
+# ---------------------------------------------------------------------------
+
+
+def test_conv3d_transpose_torch_parity():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(6)
+    x = rs.rand(2, 3, 4, 5, 2).astype(np.float32)  # NDHWC
+    layer = nn.Conv3DTranspose(2, 4, kernel_size=3, stride=2, padding=1)
+    v = layer.init(RNG, x)
+    y, _ = layer.apply(v, x)
+
+    tconv = torch.nn.ConvTranspose3d(2, 4, 3, stride=2, padding=1, bias=True)
+    # ours: (kd,kh,kw,out,in) -> torch: (in,out,kd,kh,kw)
+    w = np.asarray(v["params"]["weight"]).transpose(4, 3, 0, 1, 2)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.tensor(w))
+        tconv.bias.copy_(torch.tensor(np.asarray(v["params"]["bias"])))
+    want = tconv(torch.tensor(x.transpose(0, 4, 1, 2, 3))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(y).transpose(0, 4, 1, 2, 3), want,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_locally_connected_1d_matches_loop():
+    rs = np.random.RandomState(7)
+    x = rs.rand(2, 8, 3).astype(np.float32)
+    layer = nn.LocallyConnected1D(3, 5, kernel_size=3, stride=2)
+    v = layer.init(RNG, x)
+    y, _ = layer.apply(v, x)
+    w = np.asarray(v["params"]["weight"])
+    b = np.asarray(v["params"]["bias"])
+    out_len = (8 - 3) // 2 + 1
+    assert y.shape == (2, out_len, 5)
+    for l in range(out_len):
+        win = x[:, l * 2:l * 2 + 3, :]
+        want = np.einsum("nkc,kco->no", win, w[l]) + b[l]
+        np.testing.assert_allclose(np.asarray(y[:, l]), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_global_pool_3d():
+    x = np.random.RandomState(8).rand(2, 3, 4, 5, 6).astype(np.float32)
+    _, ya = _run(nn.GlobalAvgPool3D(), x)
+    _, ym = _run(nn.GlobalMaxPool3D(), x)
+    np.testing.assert_allclose(np.asarray(ya), x.mean(axis=(1, 2, 3)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ym), x.max(axis=(1, 2, 3)),
+                               rtol=1e-5)
+
+
+def test_conv_lstm_shapes_and_state():
+    rs = np.random.RandomState(9)
+    x = rs.rand(2, 4, 6, 6, 3).astype(np.float32)
+    layer = nn.ConvLSTM2D(3, 5, kernel_size=3)
+    v = layer.init(RNG, x)
+    y, _ = layer.apply(v, x)
+    assert y.shape == (2, 4, 6, 6, 5)
+    last = nn.ConvLSTM2D(3, 5, kernel_size=3, return_sequences=False)
+    v2 = last.init(RNG, x)
+    y2, _ = last.apply(v2, x)
+    assert y2.shape == (2, 6, 6, 5)
+    # outputs bounded by tanh*sigmoid
+    assert np.abs(np.asarray(y)).max() <= 1.0 + 1e-5
+    # gradient flows through the scan
+    def loss(p):
+        out, _ = layer.forward(p, {}, jnp.asarray(x))
+        return jnp.sum(out ** 2)
+    g = jax.grad(loss)(v["params"])
+    assert float(jnp.linalg.norm(g["weight"])) > 0
+
+
+def test_conv_lstm_no_peephole():
+    x = np.random.RandomState(10).rand(1, 2, 4, 4, 2).astype(np.float32)
+    layer = nn.ConvLSTM2D(2, 3, kernel_size=3, peephole=False)
+    v = layer.init(RNG, x)
+    assert "peep" not in v["params"]
+    y, _ = layer.apply(v, x)
+    assert y.shape == (1, 2, 4, 4, 3)
+
+
+# ---------------------------------------------------------------------------
+# local normalization
+# ---------------------------------------------------------------------------
+
+
+def test_subtractive_normalization_zero_mean_on_constant():
+    x = np.full((1, 8, 8, 3), 5.0, np.float32)
+    _, y = _run(nn.SpatialSubtractiveNormalization(5), x)
+    # constant input: local mean == value everywhere (edge-corrected)
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-5)
+
+
+def test_divisive_normalization_scale_invariance():
+    rs = np.random.RandomState(11)
+    x = rs.rand(1, 10, 10, 2).astype(np.float32)
+    _, y1 = _run(nn.SpatialDivisiveNormalization(5), x)
+    _, y2 = _run(nn.SpatialDivisiveNormalization(5), 10 * x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_contrastive_normalization_runs():
+    x = np.random.RandomState(12).rand(2, 9, 9, 3).astype(np.float32)
+    _, y = _run(nn.SpatialContrastiveNormalization(5), x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
