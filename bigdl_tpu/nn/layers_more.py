@@ -133,24 +133,36 @@ class MapTable(Container):
 
 
 class Bottle(Container):
-    """Collapse the first ``n_input_dims`` dims to one batch dim, apply the
-    inner module, restore — reference ``nn/Bottle.scala``."""
+    """Apply an inner module that accepts rank-``n_input_dims`` input to a
+    higher-rank input by collapsing the extra leading dims into the batch dim
+    — reference ``nn/Bottle.scala`` (torch semantics: input rank R collapses
+    its first R - n_input_dims + 1 dims, e.g. (4,5,10) with a rank-2 Linear
+    becomes (20,10))."""
 
     def __init__(self, module: Module, n_input_dims: int = 2, name=None):
         super().__init__([module], name)
         self.n_input_dims = n_input_dims
 
+    def _n_lead(self, x) -> int:
+        n = x.ndim - self.n_input_dims + 1
+        if n < 1:
+            raise ValueError(
+                f"Bottle: input rank {x.ndim} < n_input_dims "
+                f"{self.n_input_dims}")
+        return n
+
     def init(self, rng, x):
-        lead = x.shape[: self.n_input_dims]
-        flat = x.reshape((int(np.prod(lead)),) + x.shape[self.n_input_dims:])
+        n = self._n_lead(x)
+        flat = x.reshape((-1,) + x.shape[n:])
         v = self.layers[0].init(rng, flat)
         k = self._key(0)
         return {"params": {k: v["params"]} if v["params"] else {},
                 "state": {k: v["state"]} if v["state"] else {}}
 
     def forward(self, params, state, x, training=False, rng=None):
-        lead = x.shape[: self.n_input_dims]
-        flat = x.reshape((-1,) + x.shape[self.n_input_dims:])
+        n = self._n_lead(x)
+        lead = x.shape[:n]
+        flat = x.reshape((-1,) + x.shape[n:])
         k = self._key(0)
         y, st = self.layers[0].forward(
             params.get(k, EMPTY), state.get(k, EMPTY), flat,
@@ -570,9 +582,13 @@ class SpatialContrastiveNormalization(Module):
 
     def __init__(self, kernel_size=9, threshold: float = 1e-4, name=None):
         super().__init__(name)
-        self.sub = SpatialSubtractiveNormalization(kernel_size)
-        self.div = SpatialDivisiveNormalization(kernel_size, threshold)
+        self.kernel = _gauss_kernel(_pair(kernel_size))
+        self.threshold = threshold
 
     def forward(self, params, state, x, training=False, rng=None):
-        y, _ = self.sub.forward(params, state, x, training=training)
-        return self.div.forward(params, state, y, training=training)
+        y = (x - _local_mean(x, self.kernel)).astype(x.dtype)
+        var = _local_mean(y.astype(jnp.float32) ** 2, self.kernel)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        mean_std = jnp.mean(std, axis=(1, 2), keepdims=True)
+        den = jnp.maximum(jnp.maximum(std, mean_std), self.threshold)
+        return (y / den).astype(x.dtype), EMPTY

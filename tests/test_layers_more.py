@@ -81,6 +81,21 @@ def test_bottle_equals_flat_apply():
                                np.asarray(flat), rtol=1e-5)
 
 
+def test_bottle_higher_rank_inner():
+    # rank-4 inner module (Conv2D) on a 5-D (N,T,H,W,C) input: torch Bottle
+    # semantics collapse (N,T) into the batch dim
+    x = np.random.RandomState(5).rand(2, 3, 6, 6, 2).astype(np.float32)
+    m = nn.Bottle(nn.Conv2D(2, 4, 3, padding="SAME"), n_input_dims=4)
+    v = m.init(RNG, x)
+    y, _ = m.apply(v, x)
+    assert y.shape == (2, 3, 6, 6, 4)
+    k = m._key(0)
+    flat, _ = nn.Conv2D(2, 4, 3, padding="SAME").forward(
+        v["params"][k], {}, x.reshape(6, 6, 6, 2))
+    np.testing.assert_allclose(np.asarray(y).reshape(6, 6, 6, 4),
+                               np.asarray(flat), rtol=1e-5)
+
+
 def test_infer_reshape():
     x = np.zeros((2, 3, 4), np.float32)
     _, y = _run(nn.InferReshape((0, -1)), x)
