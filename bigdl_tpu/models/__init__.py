@@ -8,10 +8,12 @@ from bigdl_tpu.models.transformer_zoo import (
     TransformerEncoder, BERT, BERTClassifier,
 )
 from bigdl_tpu.models.recsys import NeuralCF, WideAndDeep
+from bigdl_tpu.models.maskrcnn import MaskRCNN, maskrcnn_resnet50
 
 __all__ = [
     "LeNet5", "resnet_cifar", "resnet50", "BasicBlock", "Bottleneck",
     "inception_v1", "inception_module", "vgg16", "vgg_cifar10", "char_rnn",
     "Seq2Seq", "autoencoder", "Encoder", "TransformerEncoder", "BERT",
-    "BERTClassifier", "NeuralCF", "WideAndDeep",
+    "BERTClassifier", "NeuralCF", "WideAndDeep", "MaskRCNN",
+    "maskrcnn_resnet50",
 ]

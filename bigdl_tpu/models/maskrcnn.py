@@ -1,0 +1,423 @@
+"""MaskRCNN family — ResNet-FPN backbone, RPN, box head, mask head.
+
+Reference analog (unverified — mount empty): ``dllib/models/maskrcnn/
+MaskRCNN.scala`` + supporting layers (RegionProposal, Pooler, BoxHead,
+MaskHead in the upstream 2.x layout).  The reference runs dynamic-length
+JVM loops per image; this build is **fully static-shape** so the whole
+detector compiles to one XLA program: fixed proposal count (top-K + padded
+NMS), all-levels RoIAlign with per-box level select, fixed ``max_detections``
+outputs with a validity mask.
+
+Layout: images NHWC; boxes (y1, x1, y2, x2) in image coordinates.
+
+Inference:
+
+    model = maskrcnn_resnet50(num_classes=81)
+    variables = model.init(rng, images)         # images (1, H, W, 3)
+    det, _ = model.apply(variables, images)
+    det["boxes"/"scores"/"classes"/"masks"/"valid"]
+
+Training uses the functional losses (``rpn_loss``, ``detection_loss``) over
+head outputs — see tests/test_maskrcnn.py.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.module import EMPTY, Module
+from bigdl_tpu.models.resnet import Bottleneck, _conv_bn
+from bigdl_tpu.ops import detection as D
+
+
+# ---------------------------------------------------------------------------
+# backbone with multi-scale taps
+# ---------------------------------------------------------------------------
+
+
+class ResNetC2345(Module):
+    """ResNet-50 trunk returning (C2, C3, C4, C5) feature maps
+    (strides 4/8/16/32)."""
+
+    def __init__(self, depth_blocks=(3, 4, 6, 3), name=None):
+        super().__init__(name)
+        self.stem = nn.Sequential(_conv_bn(3, 64, 7, stride=2)
+                                  + [nn.MaxPool2D(3, 2, padding=1)])
+        self.stages = []
+        cin = 64
+        for stage, (width, blocks) in enumerate(
+                zip([64, 128, 256, 512], depth_blocks)):
+            mods = []
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                mods.append(Bottleneck(cin, width, stride))
+                cin = width * Bottleneck.expansion
+            self.stages.append(nn.Sequential(mods))
+
+    def init(self, rng, x):
+        ks = jax.random.split(rng, 5)
+        v = {"stem": self.stem.init(ks[0], x)}
+        y, _ = self.stem.apply(v["stem"], x)
+        for i, st in enumerate(self.stages):
+            v[f"c{i + 2}"] = st.init(ks[i + 1], y)
+            y, _ = st.apply(v[f"c{i + 2}"], y)
+        return {"params": {k: vv["params"] for k, vv in v.items()},
+                "state": {k: vv["state"] for k, vv in v.items()}}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        new_state = {}
+        y, st = self.stem.forward(params["stem"], state["stem"], x,
+                                  training=training)
+        new_state["stem"] = st or state["stem"]
+        outs = []
+        for i, stg in enumerate(self.stages):
+            k = f"c{i + 2}"
+            y, st = stg.forward(params[k], state[k], y, training=training)
+            new_state[k] = st or state[k]
+            outs.append(y)
+        return tuple(outs), new_state
+
+
+class FPN(Module):
+    """Feature Pyramid Network: 1x1 laterals + top-down nearest upsample +
+    3x3 smoothing, producing P2..P5 at ``channels`` each."""
+
+    def __init__(self, in_channels: Sequence[int] = (256, 512, 1024, 2048),
+                 channels: int = 256, name=None):
+        super().__init__(name)
+        self.channels = channels
+        self.lat = [nn.Conv2D(c, channels, 1) for c in in_channels]
+        self.out = [nn.Conv2D(channels, channels, 3, padding="SAME")
+                    for _ in in_channels]
+
+    def init(self, rng, feats):
+        ks = jax.random.split(rng, 2 * len(self.lat))
+        params = {}
+        for i, (l, o, f) in enumerate(zip(self.lat, self.out, feats)):
+            params[f"lat{i}"] = l.init(ks[2 * i], f)["params"]
+            params[f"out{i}"] = o.init(
+                ks[2 * i + 1], jnp.zeros(f.shape[:-1] + (self.channels,),
+                                         f.dtype))["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, feats, training=False, rng=None):
+        lats = [l.forward(params[f"lat{i}"], EMPTY, f)[0]
+                for i, (l, f) in enumerate(zip(self.lat, feats))]
+        # top-down pathway
+        ps = [None] * len(lats)
+        ps[-1] = lats[-1]
+        for i in range(len(lats) - 2, -1, -1):
+            up = jnp.repeat(jnp.repeat(ps[i + 1], 2, axis=1), 2, axis=2)
+            up = up[:, : lats[i].shape[1], : lats[i].shape[2], :]
+            ps[i] = lats[i] + up
+        outs = tuple(
+            o.forward(params[f"out{i}"], EMPTY, p)[0]
+            for i, (o, p) in enumerate(zip(self.out, ps)))
+        return outs, EMPTY
+
+
+# ---------------------------------------------------------------------------
+# heads
+# ---------------------------------------------------------------------------
+
+
+class RPNHead(Module):
+    """Shared conv + per-anchor objectness / box deltas, applied to every
+    pyramid level."""
+
+    def __init__(self, channels: int = 256, num_anchors: int = 3, name=None):
+        super().__init__(name)
+        self.conv = nn.Conv2D(channels, channels, 3, padding="SAME")
+        self.cls = nn.Conv2D(channels, num_anchors, 1,
+                             weight_init=init_mod.random_normal(0.0, 0.01))
+        self.reg = nn.Conv2D(channels, num_anchors * 4, 1,
+                             weight_init=init_mod.random_normal(0.0, 0.01))
+
+    def init(self, rng, feats):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        f = feats[0]
+        return {"params": {
+            "conv": self.conv.init(k1, f)["params"],
+            "cls": self.cls.init(k2, f)["params"],
+            "reg": self.reg.init(k3, f)["params"],
+        }, "state": EMPTY}
+
+    def forward(self, params, state, feats, training=False, rng=None):
+        logits, deltas = [], []
+        for f in feats:
+            h = jax.nn.relu(self.conv.forward(params["conv"], EMPTY, f)[0])
+            lg = self.cls.forward(params["cls"], EMPTY, h)[0]
+            dl = self.reg.forward(params["reg"], EMPTY, h)[0]
+            n = f.shape[0]
+            logits.append(lg.reshape(n, -1))
+            deltas.append(dl.reshape(n, -1, 4))
+        return (jnp.concatenate(logits, axis=1),
+                jnp.concatenate(deltas, axis=1)), EMPTY
+
+
+class BoxHead(Module):
+    """RoI features (P, 7, 7, C) -> 2xFC -> class logits + per-class box
+    deltas."""
+
+    def __init__(self, num_classes: int, channels: int = 256,
+                 fc_dim: int = 1024, pool: int = 7, name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.fc1 = nn.Linear(pool * pool * channels, fc_dim)
+        self.fc2 = nn.Linear(fc_dim, fc_dim)
+        self.cls = nn.Linear(fc_dim, num_classes,
+                             weight_init=init_mod.random_normal(0.0, 0.01))
+        self.reg = nn.Linear(fc_dim, num_classes * 4,
+                             weight_init=init_mod.random_normal(0.0, 0.001))
+
+    def init(self, rng, rois):
+        ks = jax.random.split(rng, 4)
+        flat = rois.reshape(rois.shape[0], -1)
+        v1 = self.fc1.init(ks[0], flat)
+        h = jnp.zeros((rois.shape[0], self.fc1.out_features))
+        return {"params": {
+            "fc1": v1["params"],
+            "fc2": self.fc2.init(ks[1], h)["params"],
+            "cls": self.cls.init(ks[2], h)["params"],
+            "reg": self.reg.init(ks[3], h)["params"],
+        }, "state": EMPTY}
+
+    def forward(self, params, state, rois, training=False, rng=None):
+        h = rois.reshape(rois.shape[0], -1)
+        h = jax.nn.relu(self.fc1.forward(params["fc1"], EMPTY, h)[0])
+        h = jax.nn.relu(self.fc2.forward(params["fc2"], EMPTY, h)[0])
+        logits = self.cls.forward(params["cls"], EMPTY, h)[0]
+        deltas = self.reg.forward(params["reg"], EMPTY, h)[0]
+        return (logits, deltas.reshape(-1, self.num_classes, 4)), EMPTY
+
+
+class MaskHead(Module):
+    """RoI features (P, 14, 14, C) -> 4x conv -> deconv x2 -> per-class
+    28x28 mask logits."""
+
+    def __init__(self, num_classes: int, channels: int = 256, name=None):
+        super().__init__(name)
+        self.convs = [nn.Conv2D(channels, channels, 3, padding="SAME")
+                      for _ in range(4)]
+        self.deconv = nn.Conv2DTranspose(channels, channels, 2, stride=2,
+                                         padding="SAME")
+        self.out = nn.Conv2D(channels, num_classes, 1,
+                             weight_init=init_mod.random_normal(0.0, 0.01))
+
+    def init(self, rng, rois):
+        ks = jax.random.split(rng, 6)
+        params = {}
+        h = rois
+        for i, c in enumerate(self.convs):
+            params[f"conv{i}"] = c.init(ks[i], h)["params"]
+        params["deconv"] = self.deconv.init(ks[4], h)["params"]
+        h2 = jnp.zeros((h.shape[0], h.shape[1] * 2, h.shape[2] * 2,
+                        h.shape[3]))
+        params["out"] = self.out.init(ks[5], h2)["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, rois, training=False, rng=None):
+        h = rois
+        for i, c in enumerate(self.convs):
+            h = jax.nn.relu(c.forward(params[f"conv{i}"], EMPTY, h)[0])
+        h = jax.nn.relu(self.deconv.forward(params["deconv"], EMPTY, h)[0])
+        return self.out.forward(params["out"], EMPTY, h)[0], EMPTY
+
+
+# ---------------------------------------------------------------------------
+# full model
+# ---------------------------------------------------------------------------
+
+
+class MaskRCNN(Module):
+    """Two-stage detector with mask branch, end-to-end static shapes.
+
+    Single-image batch (B=1) inference path; the training losses below work
+    on the head outputs directly (the reference trains per-image too)."""
+
+    STRIDES = (4, 8, 16, 32)
+    SIZES = (32.0, 64.0, 128.0, 256.0)
+
+    def __init__(self, num_classes: int, image_size: Sequence[int] = (512, 512),
+                 pre_nms_topk: int = 512, num_proposals: int = 128,
+                 max_detections: int = 32, with_mask: bool = True,
+                 score_threshold: float = 0.05, nms_iou: float = 0.5,
+                 name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.image_size = tuple(image_size)
+        self.pre_nms_topk = pre_nms_topk
+        self.num_proposals = num_proposals
+        self.max_detections = max_detections
+        self.with_mask = with_mask
+        self.score_threshold = score_threshold
+        self.nms_iou = nms_iou
+
+        self.backbone = ResNetC2345()
+        self.fpn = FPN()
+        self.rpn = RPNHead()
+        self.box_head = BoxHead(num_classes)
+        self.mask_head = MaskHead(num_classes) if with_mask else None
+
+        h, w = self.image_size
+        feat_sizes = [(h // s, w // s) for s in self.STRIDES]
+        self.anchors = D.generate_anchors(feat_sizes, self.STRIDES,
+                                          self.SIZES)
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng, x):
+        ks = jax.random.split(rng, 5)
+        v = {"backbone": self.backbone.init(ks[0], x)}
+        feats, _ = self.backbone.apply(v["backbone"], x)
+        v["fpn"] = self.fpn.init(ks[1], feats)
+        ps, _ = self.fpn.apply(v["fpn"], feats)
+        v["rpn"] = self.rpn.init(ks[2], ps)
+        c = ps[0].shape[-1]
+        v["box_head"] = self.box_head.init(
+            ks[3], jnp.zeros((self.num_proposals, 7, 7, c)))
+        if self.mask_head is not None:
+            v["mask_head"] = self.mask_head.init(
+                ks[4], jnp.zeros((self.max_detections, 14, 14, c)))
+        return {"params": {k: vv["params"] for k, vv in v.items()},
+                "state": {k: vv.get("state") or {} for k, vv in v.items()}}
+
+    # -- pieces (used by both inference and the training losses) -----------
+    def features(self, params, state, x, training=False):
+        feats, bb_state = self.backbone.forward(
+            params["backbone"], state["backbone"], x, training=training)
+        ps, _ = self.fpn.forward(params["fpn"], EMPTY, feats)
+        return ps, bb_state
+
+    def rpn_outputs(self, params, ps):
+        (logits, deltas), _ = self.rpn.forward(params["rpn"], EMPTY, ps)
+        return logits[0], deltas[0]  # B=1
+
+    def proposals(self, logits, deltas):
+        """Top-K anchors by objectness -> decode -> clip -> NMS -> fixed
+        ``num_proposals`` boxes (padded; validity via scores)."""
+        h, w = self.image_size
+        k = min(self.pre_nms_topk, logits.shape[0])
+        top_scores, top_idx = jax.lax.top_k(logits, k)
+        top_boxes = D.decode_boxes(deltas[top_idx],
+                                   jnp.asarray(self.anchors)[top_idx])
+        top_boxes = D.clip_boxes(top_boxes, h, w)
+        keep, valid = D.nms_padded(top_boxes, top_scores, 0.7,
+                                   self.num_proposals)
+        boxes = top_boxes[keep] * valid[:, None]
+        return jax.lax.stop_gradient(boxes), valid
+
+    def detections(self, params, ps, prop_boxes, prop_valid):
+        rois = D.multilevel_roi_align(
+            [p[0] for p in ps], prop_boxes, 7, self.STRIDES)
+        (cls_logits, box_deltas), _ = self.box_head.forward(
+            params["box_head"], EMPTY, rois)
+        probs = jax.nn.softmax(cls_logits, axis=-1)
+        # best non-background class per proposal (class 0 = background)
+        fg = probs[:, 1:]
+        best_cls = jnp.argmax(fg, axis=-1) + 1
+        best_score = jnp.max(fg, axis=-1) * prop_valid
+        pick = jnp.take_along_axis(
+            box_deltas, best_cls[:, None, None].repeat(4, -1),
+            axis=1)[:, 0]
+        boxes = D.decode_boxes(pick, prop_boxes, weights=(10., 10., 5., 5.))
+        boxes = D.clip_boxes(boxes, *self.image_size)
+        score_ok = best_score > self.score_threshold
+        keep, valid = D.class_aware_nms(
+            boxes, jnp.where(score_ok, best_score, -jnp.inf), best_cls,
+            self.nms_iou, self.max_detections)
+        det_boxes = boxes[keep]
+        det_scores = jnp.where(valid, best_score[keep], 0.0)
+        det_classes = jnp.where(valid, best_cls[keep], 0)
+        return det_boxes, det_scores, det_classes, valid
+
+    # -- inference forward --------------------------------------------------
+    def forward(self, params, state, x, training=False, rng=None):
+        ps, bb_state = self.features(params, state, x, training=training)
+        logits, deltas = self.rpn_outputs(params, ps)
+        prop_boxes, prop_valid = self.proposals(logits, deltas)
+        det_boxes, det_scores, det_classes, valid = self.detections(
+            params, ps, prop_boxes, prop_valid.astype(logits.dtype))
+        out = {"boxes": det_boxes, "scores": det_scores,
+               "classes": det_classes, "valid": valid}
+        if self.mask_head is not None:
+            rois = D.multilevel_roi_align(
+                [p[0] for p in ps], det_boxes, 14, self.STRIDES)
+            mask_logits, _ = self.mask_head.forward(
+                params["mask_head"], EMPTY, rois)  # (D, 28, 28, K)
+            sel = det_classes[:, None, None, None]
+            masks = jnp.take_along_axis(
+                mask_logits, sel.repeat(28, 1).repeat(28, 2), axis=-1)[..., 0]
+            out["masks"] = jax.nn.sigmoid(masks)
+        new_state = dict(state)
+        new_state["backbone"] = bb_state
+        return out, new_state
+
+
+# ---------------------------------------------------------------------------
+# training losses (functional)
+# ---------------------------------------------------------------------------
+
+
+def rpn_loss(logits, deltas, anchors, gt_boxes, gt_valid,
+             pos_iou: float = 0.7, neg_iou: float = 0.3):
+    """RPN objectness (BCE) + box regression (smooth-L1 on positives).
+
+    gt_boxes (G, 4) padded, gt_valid (G,) bool."""
+    n_anchors = logits.shape[0]
+    iou = D.box_iou(jnp.asarray(anchors), gt_boxes)
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1)
+    pos = best_iou >= pos_iou
+    # anchors that are the argmax for some VALID gt are positive too
+    # (out-of-bounds scatter indices are dropped, masking invalid columns)
+    col_best = jnp.where(gt_valid, jnp.argmax(iou, axis=0), n_anchors)
+    is_best = jnp.zeros_like(pos).at[col_best].set(True, mode="drop")
+    pos = pos | (is_best & (best_iou > 1e-3))
+    neg = (best_iou < neg_iou) & ~pos
+
+    labels = pos.astype(logits.dtype)
+    weights = (pos | neg).astype(logits.dtype)
+    cls = jnp.sum(weights * (jax.nn.softplus(logits) - labels * logits))
+    cls = cls / jnp.maximum(jnp.sum(weights), 1.0)
+
+    target = D.encode_boxes(gt_boxes[best_gt], jnp.asarray(anchors))
+    diff = jnp.abs(deltas - target)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).sum(-1)
+    reg = jnp.sum(pos * sl1) / jnp.maximum(jnp.sum(pos), 1.0)
+    return cls + reg
+
+
+def detection_loss(cls_logits, box_deltas, prop_boxes, prop_valid,
+                   gt_boxes, gt_classes, gt_valid, fg_iou: float = 0.5):
+    """Box-head loss: softmax CE over classes (bg=0) + smooth-L1 on the
+    matched class's deltas for foreground proposals."""
+    iou = D.box_iou(prop_boxes, gt_boxes)
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1)
+    fg = (best_iou >= fg_iou) & (prop_valid > 0)
+    labels = jnp.where(fg, gt_classes[best_gt], 0)
+
+    logp = jax.nn.log_softmax(cls_logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    ce = jnp.sum(ce * prop_valid) / jnp.maximum(jnp.sum(prop_valid), 1.0)
+
+    target = D.encode_boxes(gt_boxes[best_gt], prop_boxes,
+                            weights=(10., 10., 5., 5.))
+    pick = jnp.take_along_axis(
+        box_deltas, labels[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    diff = jnp.abs(pick - target)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).sum(-1)
+    reg = jnp.sum(fg * sl1) / jnp.maximum(jnp.sum(fg), 1.0)
+    return ce + reg
+
+
+def maskrcnn_resnet50(num_classes: int = 81, image_size=(512, 512),
+                      **kw) -> MaskRCNN:
+    """COCO-shaped MaskRCNN — reference model-zoo entry point."""
+    return MaskRCNN(num_classes, image_size=image_size, **kw)

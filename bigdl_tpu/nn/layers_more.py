@@ -582,13 +582,11 @@ class SpatialContrastiveNormalization(Module):
 
     def __init__(self, kernel_size=9, threshold: float = 1e-4, name=None):
         super().__init__(name)
-        self.kernel = _gauss_kernel(_pair(kernel_size))
-        self.threshold = threshold
+        # both children are parameterless/stateless — composed with explicit
+        # EMPTY variables (visible assumption, no param plumbing needed)
+        self._sub = SpatialSubtractiveNormalization(kernel_size)
+        self._div = SpatialDivisiveNormalization(kernel_size, threshold)
 
     def forward(self, params, state, x, training=False, rng=None):
-        y = (x - _local_mean(x, self.kernel)).astype(x.dtype)
-        var = _local_mean(y.astype(jnp.float32) ** 2, self.kernel)
-        std = jnp.sqrt(jnp.maximum(var, 0.0))
-        mean_std = jnp.mean(std, axis=(1, 2), keepdims=True)
-        den = jnp.maximum(jnp.maximum(std, mean_std), self.threshold)
-        return (y / den).astype(x.dtype), EMPTY
+        y, _ = self._sub.forward(EMPTY, EMPTY, x, training=training)
+        return self._div.forward(EMPTY, EMPTY, y, training=training)

@@ -1,0 +1,259 @@
+"""Detection ops — TPU-first building blocks for the MaskRCNN family.
+
+Reference analog (unverified — mount empty): ``dllib/models/maskrcnn/`` and
+the vision heads under ``dllib/nn`` (Anchor, BboxUtil, Nms, Pooler/RoiAlign,
+RegionProposal in the upstream 2.x layout).  The reference implements these
+with dynamic-length JVM loops; here every op is **static-shape** so the whole
+detector jits onto the MXU: NMS is a fixed-iteration ``fori_loop`` returning
+padded indices + validity mask, RoIAlign samples a fixed grid per box, and
+"select top-k then pad" replaces data-dependent filtering.
+
+Boxes are ``(y1, x1, y2, x2)`` in image coordinates throughout (row-major,
+NHWC-friendly).
+"""
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# anchors
+# ---------------------------------------------------------------------------
+
+
+def generate_anchors(feat_sizes: Sequence[Tuple[int, int]],
+                     strides: Sequence[int],
+                     sizes: Sequence[float],
+                     ratios: Sequence[float] = (0.5, 1.0, 2.0)) -> np.ndarray:
+    """Multi-level anchor grid.  ``feat_sizes[i]`` is the (H, W) of pyramid
+    level i with stride ``strides[i]`` and base anchor area ``sizes[i]**2``;
+    each cell gets ``len(ratios)`` anchors.  Returns (sum_i H_i*W_i*R, 4)
+    float32 (y1, x1, y2, x2) — a host-side constant baked into the jitted
+    program (anchors depend only on static shapes)."""
+    out = []
+    for (fh, fw), stride, size in zip(feat_sizes, strides, sizes):
+        ys = (np.arange(fh) + 0.5) * stride
+        xs = (np.arange(fw) + 0.5) * stride
+        cy, cx = np.meshgrid(ys, xs, indexing="ij")
+        boxes = []
+        for r in ratios:
+            h = size * np.sqrt(r)
+            w = size / np.sqrt(r)
+            boxes.append(np.stack([cy - h / 2, cx - w / 2,
+                                   cy + h / 2, cx + w / 2], axis=-1))
+        # (fh, fw, R, 4) -> (fh*fw*R, 4)
+        lv = np.stack(boxes, axis=2).reshape(-1, 4)
+        out.append(lv)
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# box utilities
+# ---------------------------------------------------------------------------
+
+
+def box_area(boxes):
+    return ((boxes[..., 2] - boxes[..., 0]).clip(0)
+            * (boxes[..., 3] - boxes[..., 1]).clip(0))
+
+
+def box_iou(a, b):
+    """IoU matrix: a (Na,4), b (Nb,4) -> (Na,Nb)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = (rb - lt).clip(0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+BBOX_XFORM_CLIP = float(np.log(1000.0 / 16))
+
+
+def encode_boxes(boxes, anchors, weights=(1.0, 1.0, 1.0, 1.0)):
+    """Faster-RCNN deltas (ty, tx, th, tw) of ``boxes`` w.r.t. ``anchors``."""
+    ah = anchors[..., 2] - anchors[..., 0]
+    aw = anchors[..., 3] - anchors[..., 1]
+    acy = anchors[..., 0] + 0.5 * ah
+    acx = anchors[..., 1] + 0.5 * aw
+    bh = boxes[..., 2] - boxes[..., 0]
+    bw = boxes[..., 3] - boxes[..., 1]
+    bcy = boxes[..., 0] + 0.5 * bh
+    bcx = boxes[..., 1] + 0.5 * bw
+    wy, wx, wh, ww = weights
+    return jnp.stack([
+        wy * (bcy - acy) / jnp.maximum(ah, 1e-6),
+        wx * (bcx - acx) / jnp.maximum(aw, 1e-6),
+        wh * jnp.log(jnp.maximum(bh, 1e-6) / jnp.maximum(ah, 1e-6)),
+        ww * jnp.log(jnp.maximum(bw, 1e-6) / jnp.maximum(aw, 1e-6)),
+    ], axis=-1)
+
+
+def decode_boxes(deltas, anchors, weights=(1.0, 1.0, 1.0, 1.0)):
+    """Inverse of :func:`encode_boxes` with the standard exp clip."""
+    ah = anchors[..., 2] - anchors[..., 0]
+    aw = anchors[..., 3] - anchors[..., 1]
+    acy = anchors[..., 0] + 0.5 * ah
+    acx = anchors[..., 1] + 0.5 * aw
+    wy, wx, wh, ww = weights
+    ty = deltas[..., 0] / wy
+    tx = deltas[..., 1] / wx
+    th = jnp.minimum(deltas[..., 2] / wh, BBOX_XFORM_CLIP)
+    tw = jnp.minimum(deltas[..., 3] / ww, BBOX_XFORM_CLIP)
+    cy = ty * ah + acy
+    cx = tx * aw + acx
+    h = jnp.exp(th) * ah
+    w = jnp.exp(tw) * aw
+    return jnp.stack([cy - 0.5 * h, cx - 0.5 * w,
+                      cy + 0.5 * h, cx + 0.5 * w], axis=-1)
+
+
+def clip_boxes(boxes, height, width):
+    y1 = boxes[..., 0].clip(0, height)
+    x1 = boxes[..., 1].clip(0, width)
+    y2 = boxes[..., 2].clip(0, height)
+    x2 = boxes[..., 3].clip(0, width)
+    return jnp.stack([y1, x1, y2, x2], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# NMS — static shape
+# ---------------------------------------------------------------------------
+
+
+def nms_padded(boxes, scores, iou_threshold: float, max_out: int):
+    """Greedy NMS with static output size.
+
+    Returns ``(indices (max_out,), valid (max_out,) bool)``: indices into
+    ``boxes`` of the kept boxes in descending score order, padded with 0
+    where invalid.  Implemented as ``max_out`` fixed iterations of
+    select-best-then-suppress — O(max_out * N), fully jittable."""
+    n = boxes.shape[0]
+    iou = box_iou(boxes, boxes)
+
+    def body(i, carry):
+        alive, out_idx, out_valid = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        out_idx = out_idx.at[i].set(jnp.where(ok, best, 0))
+        out_valid = out_valid.at[i].set(ok)
+        suppress = iou[best] > iou_threshold
+        alive = alive & ~suppress & ~(jnp.arange(n) == best)
+        alive = alive & ok  # once exhausted, stay exhausted
+        return alive, out_idx, out_valid
+
+    alive0 = jnp.ones((n,), bool)
+    idx0 = jnp.zeros((max_out,), jnp.int32)
+    val0 = jnp.zeros((max_out,), bool)
+    _, idx, valid = jax.lax.fori_loop(0, max_out, body, (alive0, idx0, val0))
+    return idx, valid
+
+
+def class_aware_nms(boxes, scores, classes, iou_threshold: float,
+                    max_out: int, coord_span: float = 1e4):
+    """Per-class NMS in one call: shift each class's boxes to a disjoint
+    coordinate island so cross-class pairs never overlap (the standard
+    batched-NMS trick), then run :func:`nms_padded`."""
+    offset = classes.astype(boxes.dtype)[:, None] * coord_span
+    return nms_padded(boxes + offset, scores, iou_threshold, max_out)
+
+
+# ---------------------------------------------------------------------------
+# RoIAlign — static grid bilinear sampling
+# ---------------------------------------------------------------------------
+
+
+def _bilinear(feat, y, x):
+    """Sample feat (H, W, C) at fractional (y, x) grids of shape (S, S)."""
+    h, w, _ = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    y0i = y0.astype(jnp.int32).clip(0, h - 1)
+    x0i = x0.astype(jnp.int32).clip(0, w - 1)
+    y1i = (y0i + 1).clip(0, h - 1)
+    x1i = (x0i + 1).clip(0, w - 1)
+    # out-of-bounds samples contribute 0 (torchvision roi_align semantics)
+    oob = (y < -1) | (y > h) | (x < -1) | (x > w)
+    v00 = feat[y0i, x0i]
+    v01 = feat[y0i, x1i]
+    v10 = feat[y1i, x0i]
+    v11 = feat[y1i, x1i]
+    wy1 = wy1[..., None]
+    wx1 = wx1[..., None]
+    val = (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+           + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+    return jnp.where(oob[..., None], 0.0, val)
+
+
+def roi_align(feat, boxes, output_size: int, spatial_scale: float,
+              sampling_ratio: int = 2):
+    """RoIAlign on one feature map.
+
+    feat (H, W, C); boxes (N, 4) in IMAGE coordinates -> (N, S, S, C).
+    Each output cell averages ``sampling_ratio**2`` bilinear samples; the
+    half-pixel center shift matches torchvision ``roi_align(aligned=True)``
+    (the Detectron2 convention)."""
+    s = output_size
+    sr = sampling_ratio
+
+    def one(box):
+        y1, x1, y2, x2 = box * spatial_scale
+        bh = jnp.maximum(y2 - y1, 1e-6)
+        bw = jnp.maximum(x2 - x1, 1e-6)
+        cell_h = bh / s
+        cell_w = bw / s
+        # sample points: for output cell (i,j), sr x sr points
+        iy = jnp.arange(s * sr) + 0.5
+        ix = jnp.arange(s * sr) + 0.5
+        ys = y1 + iy * (cell_h / sr)
+        xs = x1 + ix * (cell_w / sr)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        vals = _bilinear(feat, yy - 0.5, xx - 0.5)  # center convention
+        # average-pool sr x sr sample blocks -> (s, s, C)
+        c = vals.shape[-1]
+        vals = vals.reshape(s, sr, s, sr, c)
+        return vals.mean(axis=(1, 3))
+
+    return jax.vmap(one)(boxes)
+
+
+def multilevel_roi_align(feats: List, boxes, output_size: int,
+                         strides: Sequence[int], canonical_level: int = 2,
+                         canonical_size: float = 224.0,
+                         sampling_ratio: int = 2):
+    """FPN-aware RoIAlign: each box is assigned a pyramid level by the FPN
+    heuristic ``k = k0 + log2(sqrt(area)/224)``; TPU-friendly form computes
+    the align on EVERY level (static shapes) and selects per box — the
+    standard TPU detection trade (compute for shape stability)."""
+    area = box_area(boxes)
+    k = jnp.floor(canonical_level
+                  + jnp.log2(jnp.sqrt(jnp.maximum(area, 1e-6))
+                             / canonical_size + 1e-9))
+    k = k.clip(0, len(feats) - 1).astype(jnp.int32)
+    pooled = jnp.stack([
+        roi_align(f, boxes, output_size, 1.0 / st, sampling_ratio)
+        for f, st in zip(feats, strides)], axis=0)  # (L, N, S, S, C)
+    return pooled[k, jnp.arange(boxes.shape[0])]
+
+
+def paste_mask(mask, box, height: int, width: int):
+    """Resize a (M, M) mask into its box within an (height, width) canvas —
+    the inference-time inverse of the mask head's 28x28 crop."""
+    m = mask.shape[0]
+    y1, x1, y2, x2 = box
+    bh = jnp.maximum(y2 - y1, 1.0)
+    bw = jnp.maximum(x2 - x1, 1.0)
+    yy = (jnp.arange(height) + 0.5 - y1) / bh * m - 0.5
+    xx = (jnp.arange(width) + 0.5 - x1) / bw * m - 0.5
+    gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
+    val = _bilinear(mask[..., None], gy, gx)[..., 0]
+    inside = ((jnp.arange(height)[:, None] >= jnp.floor(y1))
+              & (jnp.arange(height)[:, None] < jnp.ceil(y2))
+              & (jnp.arange(width)[None, :] >= jnp.floor(x1))
+              & (jnp.arange(width)[None, :] < jnp.ceil(x2)))
+    return jnp.where(inside, val, 0.0)

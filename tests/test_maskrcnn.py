@@ -1,0 +1,266 @@
+"""Detection ops numerics (vs numpy references) + MaskRCNN end-to-end
+forward/compile + functional losses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.ops import detection as D
+
+RS = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+
+def _np_iou(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            yy1, xx1 = max(x[0], y[0]), max(x[1], y[1])
+            yy2, xx2 = min(x[2], y[2]), min(x[3], y[3])
+            inter = max(yy2 - yy1, 0) * max(xx2 - xx1, 0)
+            ua = ((x[2] - x[0]) * (x[3] - x[1])
+                  + (y[2] - y[0]) * (y[3] - y[1]) - inter)
+            out[i, j] = inter / max(ua, 1e-9)
+    return out
+
+
+def test_box_iou_matches_numpy():
+    a = np.abs(RS.rand(5, 4)).astype(np.float32) * 50
+    a[:, 2:] = a[:, :2] + np.abs(RS.rand(5, 2)).astype(np.float32) * 30 + 1
+    b = np.abs(RS.rand(7, 4)).astype(np.float32) * 50
+    b[:, 2:] = b[:, :2] + np.abs(RS.rand(7, 2)).astype(np.float32) * 30 + 1
+    np.testing.assert_allclose(np.asarray(D.box_iou(jnp.asarray(a),
+                                                    jnp.asarray(b))),
+                               _np_iou(a, b), rtol=1e-4, atol=1e-5)
+
+
+def test_encode_decode_roundtrip():
+    anchors = np.array([[0, 0, 10, 10], [5, 5, 25, 35]], np.float32)
+    boxes = np.array([[1, 2, 12, 9], [4, 8, 30, 30]], np.float32)
+    deltas = D.encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors))
+    back = D.decode_boxes(deltas, jnp.asarray(anchors))
+    np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-4, atol=1e-3)
+
+
+def _np_greedy_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    alive = np.ones(len(boxes), bool)
+    iou = _np_iou(boxes, boxes)
+    for _ in range(len(boxes)):
+        cand = [i for i in order if alive[i]]
+        if not cand:
+            break
+        best = cand[0]
+        keep.append(best)
+        alive &= iou[best] <= thr
+        alive[best] = False
+    return keep
+
+
+def test_nms_matches_numpy_greedy():
+    n = 20
+    boxes = RS.rand(n, 4).astype(np.float32) * 40
+    boxes[:, 2:] = boxes[:, :2] + RS.rand(n, 2).astype(np.float32) * 20 + 2
+    scores = RS.rand(n).astype(np.float32)
+    idx, valid = D.nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                              0.5, 10)
+    got = [int(i) for i, v in zip(np.asarray(idx), np.asarray(valid)) if v]
+    want = _np_greedy_nms(boxes, scores, 0.5)[:10]
+    assert got == want
+
+
+def test_class_aware_nms_keeps_cross_class_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    classes = np.array([1, 2], np.int32)
+    _, valid = D.class_aware_nms(jnp.asarray(boxes), jnp.asarray(scores),
+                                 jnp.asarray(classes), 0.5, 2)
+    assert np.asarray(valid).sum() == 2  # same-box different-class both kept
+    _, valid_same = D.nms_padded(jnp.asarray(boxes), jnp.asarray(scores),
+                                 0.5, 2)
+    assert np.asarray(valid_same).sum() == 1
+
+
+def _np_roi_align(feat, box, out_size, scale, sr):
+    """Literal re-implementation of torchvision roi_align for one box."""
+    y1, x1, y2, x2 = box * scale
+    bh, bw = max(y2 - y1, 1e-6), max(x2 - x1, 1e-6)
+    ch, cw = bh / out_size, bw / out_size
+    h, w, c = feat.shape
+    out = np.zeros((out_size, out_size, c), np.float32)
+    for i in range(out_size):
+        for j in range(out_size):
+            acc = np.zeros(c, np.float32)
+            for si in range(sr):
+                for sj in range(sr):
+                    y = y1 + (i * sr + si + 0.5) * (ch / sr) - 0.5
+                    x = x1 + (j * sr + sj + 0.5) * (cw / sr) - 0.5
+                    if y < -1 or y > h or x < -1 or x > w:
+                        continue
+                    y0, x0 = int(np.floor(y)), int(np.floor(x))
+                    wy, wx = y - y0, x - x0
+                    def at(yy, xx):
+                        return feat[min(max(yy, 0), h - 1),
+                                    min(max(xx, 0), w - 1)]
+                    acc += ((1 - wy) * (1 - wx) * at(y0, x0)
+                            + (1 - wy) * wx * at(y0, x0 + 1)
+                            + wy * (1 - wx) * at(y0 + 1, x0)
+                            + wy * wx * at(y0 + 1, x0 + 1))
+            out[i, j] = acc / (sr * sr)
+    return out
+
+
+def test_roi_align_matches_reference():
+    feat = RS.rand(16, 16, 3).astype(np.float32)
+    boxes = np.array([[2, 2, 12, 12], [0, 0, 31, 31], [5.5, 3.2, 9.9, 14.1]],
+                     np.float32)
+    got = np.asarray(D.roi_align(jnp.asarray(feat), jnp.asarray(boxes),
+                                 4, 0.5, 2))
+    for k in range(len(boxes)):
+        want = _np_roi_align(feat, boxes[k], 4, 0.5, 2)
+        np.testing.assert_allclose(got[k], want, rtol=1e-4, atol=1e-5)
+
+
+def test_multilevel_roi_align_level_assignment():
+    feats = [jnp.asarray(RS.rand(32 // (2 ** i), 32 // (2 ** i), 2)
+                         .astype(np.float32)) for i in range(4)]
+    strides = (4, 8, 16, 32)
+    small = np.array([[0, 0, 20, 20]], np.float32)     # -> low level
+    large = np.array([[0, 0, 500, 500]], np.float32)   # -> top level
+    out_s = D.multilevel_roi_align(feats, jnp.asarray(small), 2, strides)
+    out_l = D.multilevel_roi_align(feats, jnp.asarray(large), 2, strides)
+    # small box equals level-0 align; large equals level-3 align
+    np.testing.assert_allclose(
+        np.asarray(out_s[0]),
+        np.asarray(D.roi_align(feats[0], jnp.asarray(small), 2, 1 / 4)[0]),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out_l[0]),
+        np.asarray(D.roi_align(feats[3], jnp.asarray(large), 2, 1 / 32)[0]),
+        rtol=1e-5)
+
+
+def test_generate_anchors_counts_and_geometry():
+    anchors = D.generate_anchors([(4, 4), (2, 2)], [8, 16], [32, 64])
+    assert anchors.shape == (4 * 4 * 3 + 2 * 2 * 3, 4)
+    # ratio=1 anchor at first cell of level 0: centered at (4,4), size 32
+    a = anchors[1]
+    np.testing.assert_allclose(a, [4 - 16, 4 - 16, 4 + 16, 4 + 16], atol=1e-4)
+
+
+def test_paste_mask_inside_box():
+    mask = jnp.ones((4, 4), jnp.float32)
+    out = np.asarray(D.paste_mask(mask, jnp.asarray([2., 3., 8., 9.]),
+                                  12, 12))
+    assert out.shape == (12, 12)
+    assert out[5, 5] > 0.9      # inside box
+    assert out[0, 0] == 0.0     # outside box
+    assert out[11, 11] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from bigdl_tpu.models.maskrcnn import MaskRCNN
+
+    model = MaskRCNN(num_classes=5, image_size=(64, 64), pre_nms_topk=64,
+                     num_proposals=16, max_detections=8)
+    x = jnp.asarray(RS.rand(1, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    return model, variables, x
+
+
+def test_maskrcnn_forward_shapes(tiny_model):
+    model, variables, x = tiny_model
+    out, _ = model.apply(variables, x)
+    assert out["boxes"].shape == (8, 4)
+    assert out["scores"].shape == (8,)
+    assert out["classes"].shape == (8,)
+    assert out["valid"].shape == (8,)
+    assert out["masks"].shape == (8, 28, 28)
+    b = np.asarray(out["boxes"])
+    assert (b >= 0).all() and (b <= 64).all()
+    s = np.asarray(out["masks"])
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_maskrcnn_jits(tiny_model):
+    model, variables, x = tiny_model
+
+    @jax.jit
+    def infer(p, s, xx):
+        out, _ = model.forward(p, s, xx)
+        return out
+
+    out = infer(variables["params"], variables["state"], x)
+    assert np.isfinite(np.asarray(out["scores"])).all()
+
+
+def test_rpn_loss_decreases_for_better_logits(tiny_model):
+    from bigdl_tpu.models import maskrcnn as M
+
+    model, variables, x = tiny_model
+    anchors = model.anchors
+    gt = jnp.asarray([[10., 10., 40., 40.]])
+    gt_valid = jnp.asarray([True])
+    iou = np.asarray(D.box_iou(jnp.asarray(anchors), gt))[:, 0]
+    good_logits = jnp.asarray((iou > 0.5).astype(np.float32) * 8 - 4)
+    bad_logits = -good_logits
+    deltas = D.encode_boxes(gt[0], jnp.asarray(anchors))
+    l_good = M.rpn_loss(good_logits, deltas, anchors, gt, gt_valid)
+    l_bad = M.rpn_loss(bad_logits, deltas, anchors, gt, gt_valid)
+    assert float(l_good) < float(l_bad)
+    assert np.isfinite(float(l_good))
+
+
+def test_rpn_loss_ignores_padded_gt(tiny_model):
+    """Padded (invalid) gt columns must not mark anchor 0 positive via the
+    best-anchor-per-gt rule."""
+    from bigdl_tpu.models import maskrcnn as M
+
+    model, _, _ = tiny_model
+    anchors = model.anchors
+    gt = jnp.asarray([[10., 10., 40., 40.], [0., 0., 0., 0.]])
+    valid_both = jnp.asarray([True, False])
+    valid_one = jnp.asarray([True])
+    a = anchors.shape[0]
+    logits = jnp.zeros((a,))
+    deltas = jnp.zeros((a, 4))
+    l_padded = M.rpn_loss(logits, deltas, anchors, gt, valid_both)
+    l_clean = M.rpn_loss(logits, deltas, anchors, gt[:1], valid_one)
+    np.testing.assert_allclose(float(l_padded), float(l_clean), rtol=1e-6)
+
+
+def test_detection_loss_gradients_flow(tiny_model):
+    from bigdl_tpu.models import maskrcnn as M
+
+    model, variables, x = tiny_model
+    ps, _ = model.features(variables["params"], variables["state"], x)
+    logits, deltas = model.rpn_outputs(variables["params"], ps)
+    prop, prop_valid = model.proposals(logits, deltas)
+    gt = jnp.asarray([[8., 8., 30., 30.]])
+    gt_cls = jnp.asarray([2])
+    gt_valid = jnp.asarray([True])
+
+    def loss(p):
+        rois = D.multilevel_roi_align([pp[0] for pp in ps], prop, 7,
+                                      model.STRIDES)
+        (cl, bd), _ = model.box_head.forward(p["box_head"], {}, rois)
+        return M.detection_loss(cl, bd, prop,
+                                prop_valid.astype(jnp.float32),
+                                gt, gt_cls, gt_valid)
+
+    g = jax.grad(loss)(variables["params"])
+    gn = float(jnp.sqrt(sum(jnp.sum(a ** 2) for a in
+                            jax.tree_util.tree_leaves(g["box_head"]))))
+    assert np.isfinite(gn) and gn > 0
