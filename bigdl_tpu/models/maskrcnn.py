@@ -250,6 +250,13 @@ class MaskRCNN(Module):
         super().__init__(name)
         self.num_classes = num_classes
         self.image_size = tuple(image_size)
+        if any(s % self.STRIDES[-1] for s in self.image_size):
+            # anchor grids use exact H//stride; SAME-padded convs round up,
+            # so non-multiple sizes would silently misalign anchors with RPN
+            # outputs
+            raise ValueError(
+                f"image_size {self.image_size} must be a multiple of "
+                f"{self.STRIDES[-1]} (pad the input)")
         self.pre_nms_topk = pre_nms_topk
         self.num_proposals = num_proposals
         self.max_detections = max_detections

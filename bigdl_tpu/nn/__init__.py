@@ -42,6 +42,7 @@ from bigdl_tpu.nn.sparse_layers import SparseLinear, SparseJoinTable
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,
 )
+from bigdl_tpu.nn.decode import beam_search, greedy_decode, DecodeResult
 from bigdl_tpu.nn.attention import (
     MultiHeadAttention, PositionwiseFFN, TransformerLayer,
     dot_product_attention, positional_encoding,

@@ -1,0 +1,126 @@
+"""Sequence decoding — beam search and greedy decode.
+
+Reference analog (unverified — mount empty): ``dllib/nn/SequenceBeamSearch.
+scala`` (the transformer beam-search layer, GNMT-style length penalty).
+
+TPU-first design: the whole decode is ONE ``lax.scan`` over ``max_len``
+steps with static (batch, beam, vocab) shapes — no dynamic loops, no
+data-dependent shapes; beam reordering is ``take_along_axis`` gathers, so the
+program compiles once and runs entirely on-device.  The caller provides a
+jittable ``step_fn(last_tokens, state) -> (log_probs, new_state)`` where
+``last_tokens`` is (batch*beam,) int32 and every ``state`` leaf has leading
+dim batch*beam (the decoder cell carry / KV cache).
+"""
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e9
+
+
+class DecodeResult(NamedTuple):
+    tokens: jnp.ndarray      # (batch, beam, max_len+1) incl. leading BOS
+    scores: jnp.ndarray      # (batch, beam) length-normalized log prob
+    log_probs: jnp.ndarray   # (batch, beam) raw summed log prob
+    lengths: jnp.ndarray     # (batch, beam) tokens up to and incl. EOS
+
+
+def _length_penalty(lengths, alpha: float):
+    """GNMT: ((5 + len) / 6) ** alpha."""
+    return ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** alpha
+
+
+def beam_search(step_fn: Callable[[jnp.ndarray, Any], Tuple[jnp.ndarray, Any]],
+                init_state: Any, batch_size: int, vocab_size: int,
+                bos_id: int, eos_id: int, beam_size: int = 4,
+                max_len: int = 32, length_penalty: float = 0.6,
+                ) -> DecodeResult:
+    """Batched beam search with static shapes.
+
+    ``init_state`` leaves must have leading dim ``batch_size`` — they are
+    tiled to ``batch*beam`` internally.  Returns beams sorted by normalized
+    score (best first)."""
+    B, K, V = batch_size, beam_size, vocab_size
+
+    def tile(a):
+        return jnp.repeat(a, K, axis=0)  # (B, ...) -> (B*K, ...) beam-major
+
+    state0 = jax.tree_util.tree_map(tile, init_state)
+    tokens0 = jnp.full((B, K, max_len + 1), bos_id, jnp.int32)
+    # only beam 0 is live initially (identical beams would collapse top-k)
+    logp0 = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (K - 1), jnp.float32),
+                     (B, 1))
+    fin0 = jnp.zeros((B, K), bool)
+
+    eos_row = jnp.full((V,), NEG_INF, jnp.float32).at[eos_id].set(0.0)
+
+    def body(carry, t):
+        tokens, logp, finished, state = carry
+        last = tokens[:, :, t].reshape(B * K)
+        lp, new_state = step_fn(last, state)
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        lp = lp.reshape(B, K, V)
+        # finished beams only extend with EOS at no cost (score frozen)
+        lp = jnp.where(finished[:, :, None], eos_row, lp)
+        cand = logp[:, :, None] + lp                   # (B, K, V)
+        top_lp, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        beam_idx = top_idx // V                        # (B, K)
+        tok = (top_idx % V).astype(jnp.int32)
+
+        tokens = jnp.take_along_axis(tokens, beam_idx[:, :, None], axis=1)
+        tokens = tokens.at[:, :, t + 1].set(tok)
+        finished = (jnp.take_along_axis(finished, beam_idx, axis=1)
+                    | (tok == eos_id))
+        flat_idx = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        state = jax.tree_util.tree_map(lambda a: a[flat_idx], new_state)
+        return (tokens, top_lp, finished, state), None
+
+    (tokens, logp, finished, _), _ = jax.lax.scan(
+        body, (tokens0, logp0, fin0, state0), jnp.arange(max_len))
+
+    # length = position of first EOS (inclusive), else max_len
+    is_eos = tokens[:, :, 1:] == eos_id
+    any_eos = jnp.any(is_eos, axis=-1)
+    first_eos = jnp.argmax(is_eos, axis=-1) + 1
+    lengths = jnp.where(any_eos, first_eos, max_len)
+
+    scores = logp / _length_penalty(lengths, length_penalty)
+    order = jnp.argsort(-scores, axis=1)
+    return DecodeResult(
+        tokens=jnp.take_along_axis(tokens, order[:, :, None], axis=1),
+        scores=jnp.take_along_axis(scores, order, axis=1),
+        log_probs=jnp.take_along_axis(logp, order, axis=1),
+        lengths=jnp.take_along_axis(lengths, order, axis=1),
+    )
+
+
+def greedy_decode(step_fn, init_state: Any, batch_size: int,
+                  bos_id: int, eos_id: int, max_len: int = 32):
+    """Argmax decode — ``beam_search`` with beam 1 but cheaper (no gathers).
+    Returns (tokens (B, max_len+1), log_probs (B,), lengths (B,))."""
+    B = batch_size
+    tokens0 = jnp.full((B, max_len + 1), bos_id, jnp.int32)
+    logp0 = jnp.zeros((B,), jnp.float32)
+    fin0 = jnp.zeros((B,), bool)
+
+    def body(carry, t):
+        tokens, logp, finished, state = carry
+        lp, state = step_fn(tokens[:, t], state)
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        tok = jnp.argmax(lp, axis=-1).astype(jnp.int32)
+        tok = jnp.where(finished, eos_id, tok)
+        step_lp = jnp.where(finished, 0.0,
+                            jnp.take_along_axis(lp, tok[:, None],
+                                                axis=1)[:, 0])
+        tokens = tokens.at[:, t + 1].set(tok)
+        return (tokens, logp + step_lp, finished | (tok == eos_id),
+                state), None
+
+    (tokens, logp, _, _), _ = jax.lax.scan(
+        body, (tokens0, logp0, fin0, init_state), jnp.arange(max_len))
+    is_eos = tokens[:, 1:] == eos_id
+    any_eos = jnp.any(is_eos, axis=-1)
+    lengths = jnp.where(any_eos, jnp.argmax(is_eos, axis=-1) + 1, max_len)
+    return tokens, logp, lengths

@@ -96,6 +96,19 @@ class _RNNBase(Module):
     def _final_output(self, carry):
         return carry
 
+    def step(self, params, carry, x_t):
+        """ONE decode step outside the scan: x_t (b, d) -> (new_carry,
+        h (b, hidden)).  Pairs with ``nn.decode.beam_search``/``greedy_decode``
+        step_fns (carry leaves keep leading dim b = batch*beam)."""
+        xc, wi = cast_compute(x_t, params["w_in"])
+        x_proj = (jnp.matmul(xc, wi, preferred_element_type=jnp.float32)
+                  + params["bias"]).astype(x_t.dtype)
+        return self._step(params, carry, x_proj)
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        """Public initial decode carry (zeros)."""
+        return self._init_carry(batch, dtype)
+
 
 class SimpleRNN(_RNNBase):
     """tanh RNN — reference ``nn/RnnCell.scala``."""

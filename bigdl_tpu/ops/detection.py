@@ -167,8 +167,14 @@ def class_aware_nms(boxes, scores, classes, iou_threshold: float,
 
 
 def _bilinear(feat, y, x):
-    """Sample feat (H, W, C) at fractional (y, x) grids of shape (S, S)."""
+    """Sample feat (H, W, C) at fractional (y, x) grids of shape (S, S).
+    Coordinates in (-1, 0) are clamped to 0 before the weights are computed
+    (torchvision ``roi_align`` border semantics); samples fully outside
+    [-1, H]x[-1, W] contribute 0."""
     h, w, _ = feat.shape
+    oob = (y < -1) | (y > h) | (x < -1) | (x > w)
+    y = y.clip(0, None)
+    x = x.clip(0, None)
     y0 = jnp.floor(y)
     x0 = jnp.floor(x)
     wy1 = y - y0
@@ -177,8 +183,6 @@ def _bilinear(feat, y, x):
     x0i = x0.astype(jnp.int32).clip(0, w - 1)
     y1i = (y0i + 1).clip(0, h - 1)
     x1i = (x0i + 1).clip(0, w - 1)
-    # out-of-bounds samples contribute 0 (torchvision roi_align semantics)
-    oob = (y < -1) | (y > h) | (x < -1) | (x > w)
     v00 = feat[y0i, x0i]
     v01 = feat[y0i, x1i]
     v10 = feat[y1i, x0i]

@@ -103,6 +103,7 @@ def _np_roi_align(feat, box, out_size, scale, sr):
                     x = x1 + (j * sr + sj + 0.5) * (cw / sr) - 0.5
                     if y < -1 or y > h or x < -1 or x > w:
                         continue
+                    y, x = max(y, 0.0), max(x, 0.0)  # torchvision clamp
                     y0, x0 = int(np.floor(y)), int(np.floor(x))
                     wy, wx = y - y0, x - x0
                     def at(yy, xx):
@@ -118,7 +119,8 @@ def _np_roi_align(feat, box, out_size, scale, sr):
 
 def test_roi_align_matches_reference():
     feat = RS.rand(16, 16, 3).astype(np.float32)
-    boxes = np.array([[2, 2, 12, 12], [0, 0, 31, 31], [5.5, 3.2, 9.9, 14.1]],
+    boxes = np.array([[2, 2, 12, 12], [0, 0, 31, 31], [5.5, 3.2, 9.9, 14.1],
+                      [0, 0, 4, 4]],  # border box: exercises the (-1,0) clamp
                      np.float32)
     got = np.asarray(D.roi_align(jnp.asarray(feat), jnp.asarray(boxes),
                                  4, 0.5, 2))
@@ -178,6 +180,13 @@ def tiny_model():
     x = jnp.asarray(RS.rand(1, 64, 64, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), x)
     return model, variables, x
+
+
+def test_maskrcnn_rejects_misaligned_image_size():
+    from bigdl_tpu.models.maskrcnn import MaskRCNN
+
+    with pytest.raises(ValueError):
+        MaskRCNN(num_classes=3, image_size=(100, 100))
 
 
 def test_maskrcnn_forward_shapes(tiny_model):
