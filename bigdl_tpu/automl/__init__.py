@@ -14,7 +14,9 @@ surface mirror the reference so AutoTS code ports unchanged.
 from bigdl_tpu.automl import hp
 from bigdl_tpu.automl.auto_estimator import AutoEstimator
 from bigdl_tpu.automl.search import (GridSearcher, RandomSearcher, Searcher,
+                                     SuccessiveHalvingSearcher, TPESearcher,
                                      TrialResult)
 
 __all__ = ["hp", "AutoEstimator", "Searcher", "RandomSearcher",
-           "GridSearcher", "TrialResult"]
+           "GridSearcher", "SuccessiveHalvingSearcher", "TPESearcher",
+           "TrialResult"]

@@ -34,24 +34,30 @@ class Searcher:
     def _configs(self, space, n_sampling):
         raise NotImplementedError
 
+    def _run_one(self, trial_fn, config, sign) -> TrialResult:
+        """Execute one trial: time it, unpack (metric, artifacts), convert
+        failures into an inf-metric result (a bad config must not kill the
+        sweep).  Appends to self.results."""
+        t0 = time.perf_counter()
+        try:
+            out = trial_fn(config)
+            metric, artifacts = out if isinstance(out, tuple) else (out, None)
+            res = TrialResult(config, float(metric), artifacts,
+                              time.perf_counter() - t0)
+        except Exception:  # noqa: BLE001
+            res = TrialResult(config, float("inf") * sign, None,
+                              time.perf_counter() - t0,
+                              error=traceback.format_exc())
+            log.warning("trial failed: %s", res.error.splitlines()[-1])
+        self.results.append(res)
+        return res
+
     def run(self, trial_fn: Callable[[Dict], Any], space: Dict[str, Any],
             n_sampling: int = 8) -> TrialResult:
         sign = 1.0 if self.mode == "min" else -1.0
         best = None
         for i, config in enumerate(self._configs(space, n_sampling)):
-            t0 = time.perf_counter()
-            try:
-                out = trial_fn(config)
-                metric, artifacts = out if isinstance(out, tuple) else (out,
-                                                                        None)
-                res = TrialResult(config, float(metric), artifacts,
-                                  time.perf_counter() - t0)
-            except Exception:  # noqa: BLE001 — a bad config must not kill the sweep
-                res = TrialResult(config, float("inf") * sign, None,
-                                  time.perf_counter() - t0,
-                                  error=traceback.format_exc())
-                log.warning("trial %d failed: %s", i, res.error.splitlines()[-1])
-            self.results.append(res)
+            res = self._run_one(trial_fn, config, sign)
             if res.error is None and (
                     best is None or sign * res.metric < sign * best.metric):
                 if best is not None:
@@ -82,3 +88,199 @@ class GridSearcher(Searcher):
     def _configs(self, space, n_sampling):
         pts = hp_mod.grid_points(space)
         return pts[:n_sampling] if n_sampling else pts
+
+
+class SuccessiveHalvingSearcher(Searcher):
+    """Successive halving (ASHA-style, synchronous rungs) — the reference's
+    AutoML uses Ray Tune schedulers of this family.
+
+    The trial budget (e.g. epochs) is injected into the config under
+    ``budget_key``; ``trial_fn`` must honor it.  ``n_sampling`` configs start
+    at ``min_budget``; each rung keeps the top ``1/eta`` and multiplies the
+    budget by ``eta`` until ``max_budget``."""
+
+    def __init__(self, mode: str = "min", seed: int = 0, eta: int = 3,
+                 min_budget: int = 1, max_budget: int = 9,
+                 budget_key: str = "epochs"):
+        super().__init__(mode)
+        self.rng = np.random.default_rng(seed)
+        self.eta = int(eta)
+        self.min_budget = int(min_budget)
+        self.max_budget = int(max_budget)
+        self.budget_key = budget_key
+
+    def run(self, trial_fn, space, n_sampling: int = 9) -> TrialResult:
+        sign = 1.0 if self.mode == "min" else -1.0
+        configs = [hp_mod.sample_space(space, self.rng)
+                   for _ in range(n_sampling)]
+        budget = self.min_budget
+        survivors = configs
+        best = None  # best of the HIGHEST rung reached — metrics at
+        rung = 0     # different budgets are not comparable
+        while True:
+            scored = []
+            for config in survivors:
+                cfg = dict(config, **{self.budget_key: budget})
+                res = self._run_one(trial_fn, cfg, sign)
+                scored.append((res, config))
+            scored.sort(key=lambda rc: sign * rc[0].metric)
+            for res, _ in scored[1:]:
+                res.artifacts = None
+            if scored[0][0].error is None:
+                if best is not None:
+                    best.artifacts = None
+                best = scored[0][0]  # this rung's winner supersedes
+            log.info("rung %d (budget=%d): best=%s", rung, budget,
+                     scored[0][0].metric)
+            if budget >= self.max_budget:
+                break
+            keep = max(1, len(scored) // self.eta)
+            survivors = [c for _, c in scored[:keep]]
+            budget = min(budget * self.eta, self.max_budget)
+            rung += 1
+        if best is None:
+            raise RuntimeError("all trials failed; see results[*].error")
+        return best
+
+
+class TPESearcher(Searcher):
+    """Tree-structured-Parzen-style sampler (the reference AutoML's hyperopt
+    backend, simplified): after a random warmup, candidates are drawn around
+    the good quantile of past trials and ranked by a Parzen density ratio
+    l(x)/g(x); categorical axes use frequency-weighted draws."""
+
+    def __init__(self, mode: str = "min", seed: int = 0, gamma: float = 0.25,
+                 n_candidates: int = 24, n_warmup: int = 5):
+        super().__init__(mode)
+        self.rng = np.random.default_rng(seed)
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.n_warmup = n_warmup
+
+    # -- Parzen helpers (flat numeric/categorical spaces) -------------------
+    def _split(self):
+        sign = 1.0 if self.mode == "min" else -1.0
+        done = [r for r in self.results if r.error is None]
+        done.sort(key=lambda r: sign * r.metric)
+        n_good = max(1, int(np.ceil(self.gamma * len(done))))
+        return done[:n_good], done[n_good:]
+
+    def _density(self, xs: List[float], x: float, scale: float) -> float:
+        if not xs:
+            return 1e-12
+        xs = np.asarray(xs, np.float64)
+        return float(np.mean(np.exp(-0.5 * ((x - xs) / scale) ** 2))
+                     / (scale * np.sqrt(2 * np.pi)) + 1e-12)
+
+    @staticmethod
+    def _get_path(config, path):
+        node = config
+        for p in path:
+            if not isinstance(node, dict) or p not in node:
+                return None
+            node = node[p]
+        return node
+
+    def _propose(self, space):
+        good, bad = self._split()
+
+        # precompute candidate-independent per-axis histories (flattened over
+        # nested sub-spaces)
+        axes = []  # (path, sampler)
+
+        def walk(sp, path):
+            for k, v in sp.items():
+                if isinstance(v, dict):
+                    walk(v, path + (k,))
+                elif isinstance(v, hp_mod.Sampler):
+                    axes.append((path + (k,), v))
+
+        walk(space, ())
+
+        # each numeric axis works in its NATURAL space: log for LogUniform
+        # (perturbing/scoring log-scale params linearly pins proposals to the
+        # clip boundaries), identity otherwise
+        def to_t(v, x):
+            return float(np.log(x)) if isinstance(v, hp_mod.LogUniform) \
+                else float(x)
+
+        def from_t(v, t):
+            if isinstance(v, hp_mod.LogUniform):
+                return float(np.exp(np.clip(t, v.lower, v.upper)))
+            if isinstance(v, (hp_mod.Uniform, hp_mod.QUniform)):
+                return float(np.clip(t, v.lower, v.upper))
+            if isinstance(v, hp_mod.RandInt):
+                return int(np.clip(round(t), v.lower, v.upper - 1))
+            return t
+
+        def axis_width(v):
+            if isinstance(v, (hp_mod.LogUniform, hp_mod.Uniform,
+                              hp_mod.QUniform, hp_mod.RandInt)):
+                return float(v.upper - v.lower)  # LogUniform bounds are logs
+            return 1.0
+
+        hist = {}
+        for path, v in axes:
+            gx = [self._get_path(r.config, path) for r in good]
+            bx = [self._get_path(r.config, path) for r in bad]
+            gx = [x for x in gx if x is not None]
+            bx = [x for x in bx if x is not None]
+            if isinstance(v, hp_mod.Choice):
+                hist[path] = (gx, bx, None)
+            else:
+                gt = [to_t(v, x) for x in gx]
+                bt = [to_t(v, x) for x in bx]
+                vals = gt + bt
+                scale = ((max(vals) - min(vals)) * 0.25 + 1e-9) if vals \
+                    else axis_width(v) * 0.25
+                hist[path] = (gt, bt, scale)
+
+        def sample_axis(path, v):
+            gt, _, _ = hist[path]
+            if isinstance(v, hp_mod.Choice):
+                opts = v.options
+                counts = np.ones(len(opts))
+                for x in gt:
+                    if x in opts:
+                        counts[opts.index(x)] += 1
+                return opts[int(self.rng.choice(
+                    len(opts), p=counts / counts.sum()))]
+            if gt and self.rng.random() < 0.8:
+                mu = gt[int(self.rng.integers(len(gt)))]
+                t = self.rng.normal(mu, 0.1 * axis_width(v) + 1e-12)
+                return from_t(v, t)
+            return v.sample(self.rng)
+
+        def build(sp, path):
+            cfg = {}
+            for k, v in sp.items():
+                if isinstance(v, dict):
+                    cfg[k] = build(v, path + (k,))
+                elif isinstance(v, hp_mod.Sampler):
+                    cfg[k] = sample_axis(path + (k,), v)
+                else:
+                    cfg[k] = v
+            return cfg
+
+        cands = [build(space, ()) for _ in range(self.n_candidates)]
+
+        def score(cfg):
+            s = 0.0
+            for path, v in axes:
+                if isinstance(v, hp_mod.Choice):
+                    continue
+                gt, bt, scale = hist[path]
+                x = to_t(v, self._get_path(cfg, path))
+                s += np.log(self._density(gt, x, scale))
+                s -= np.log(self._density(bt, x, scale))
+            return s
+
+        return max(cands, key=score)
+
+    def _configs(self, space, n_sampling):
+        for i in range(n_sampling):
+            if i < self.n_warmup or len(
+                    [r for r in self.results if r.error is None]) < 2:
+                yield hp_mod.sample_space(space, self.rng)
+            else:
+                yield self._propose(space)
