@@ -42,8 +42,17 @@ class Searcher:
         try:
             out = trial_fn(config)
             metric, artifacts = out if isinstance(out, tuple) else (out, None)
-            res = TrialResult(config, float(metric), artifacts,
-                              time.perf_counter() - t0)
+            metric = float(metric)
+            if not np.isfinite(metric):
+                # a diverged trial (NaN/inf loss) must never win a sort —
+                # NaN compares False against everything and would float to
+                # the top of a sorted() ranking
+                res = TrialResult(config, float("inf") * sign, None,
+                                  time.perf_counter() - t0,
+                                  error=f"non-finite metric: {metric}")
+            else:
+                res = TrialResult(config, metric, artifacts,
+                                  time.perf_counter() - t0)
         except Exception:  # noqa: BLE001
             res = TrialResult(config, float("inf") * sign, None,
                               time.perf_counter() - t0,
@@ -207,7 +216,10 @@ class TPESearcher(Searcher):
         def from_t(v, t):
             if isinstance(v, hp_mod.LogUniform):
                 return float(np.exp(np.clip(t, v.lower, v.upper)))
-            if isinstance(v, (hp_mod.Uniform, hp_mod.QUniform)):
+            if isinstance(v, hp_mod.QUniform):
+                return float(np.clip(np.round(t / v.q) * v.q,
+                                     v.lower, v.upper))
+            if isinstance(v, hp_mod.Uniform):
                 return float(np.clip(t, v.lower, v.upper))
             if isinstance(v, hp_mod.RandInt):
                 return int(np.clip(round(t), v.lower, v.upper - 1))

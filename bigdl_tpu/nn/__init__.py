@@ -38,6 +38,8 @@ from bigdl_tpu.nn.layers_more import (
     SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
     SpatialContrastiveNormalization,
 )
+from bigdl_tpu.nn import ops_layers as ops_layers  # noqa: F401
+from bigdl_tpu.nn.ops_layers import *  # noqa: F401,F403 — TF-op tranche (nn/ops)
 from bigdl_tpu.nn.sparse_layers import SparseLinear, SparseJoinTable
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,

@@ -307,3 +307,38 @@ class TestAdvancedSearchers:
         best = s.run(trial, {"x": hp.uniform(0, 1)}, n_sampling=2)
         assert best.config["epochs"] == 9  # lone survivor still promoted
         assert 9 in budgets
+
+
+class TestSearcherRobustness:
+    def test_nan_metric_never_wins(self):
+        # a diverged trial (NaN loss) must be treated as failed, not sorted
+        # to the top (NaN comparisons are all-False under sorted())
+        from bigdl_tpu.automl import (SuccessiveHalvingSearcher, TPESearcher,
+                                      hp)
+
+        def trial(cfg):
+            return float("nan") if cfg["lr"] > 0.5 else cfg["lr"]
+
+        for seed in range(4):
+            s = SuccessiveHalvingSearcher(mode="min", seed=seed,
+                                          min_budget=1, max_budget=3)
+            best = s.run(trial, {"lr": hp.uniform(0, 1)}, n_sampling=6)
+            assert np.isfinite(best.metric)
+
+        s = TPESearcher(mode="min", seed=1, n_warmup=3)
+        best = s.run(trial, {"lr": hp.uniform(0, 1)}, n_sampling=10)
+        assert np.isfinite(best.metric)
+        # NaN trials are recorded as errors, excluded from the Parzen split
+        assert all(r.error is not None or np.isfinite(r.metric)
+                   for r in s.results)
+
+    def test_tpe_quniform_stays_on_grid(self):
+        from bigdl_tpu.automl import TPESearcher, hp
+
+        def trial(cfg):
+            assert cfg["bs"] % 16 == 0, cfg["bs"]  # the q contract
+            return abs(cfg["bs"] - 64)
+
+        s = TPESearcher(mode="min", seed=0, n_warmup=3)
+        best = s.run(trial, {"bs": hp.quniform(16, 128, 16)}, n_sampling=12)
+        assert best.error is None and best.config["bs"] % 16 == 0
