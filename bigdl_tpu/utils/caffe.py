@@ -1,0 +1,703 @@
+"""Caffe model import/export — the ``utils/caffe`` analog.
+
+Reference analog (unverified — mount empty):
+``utils/caffe/CaffeLoader.scala`` converts a Caffe ``NetParameter``
+(binary ``.caffemodel``) into a BigDL graph + weights;
+``utils/caffe/CaffePersister.scala`` writes one back.  Same role here,
+with the wire format read/written via ``utils/proto`` (no caffe/protobuf
+dependency), producing a keras-engine functional ``Model``.
+
+Layout note: Caffe is NCHW, this framework is NHWC.  On import conv/BN
+weights are transposed to HWIO, channel-wise ``Concat axis=1`` becomes
+``JoinTable(3)``, and an ``InnerProduct`` consuming a 4-D blob gets a
+``Transpose(0,3,1,2)+Flatten`` prefix so numerics match Caffe's NCHW
+flatten exactly.  Imported models therefore take NHWC inputs like every
+other model in the framework.
+
+Import:  ``model, variables = load_caffe(path_or_bytes)``
+Export:  ``blob = save_caffe(model, variables, sample, path=...)``
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+from bigdl_tpu.utils.proto import Msg
+
+
+class UnsupportedCaffeLayer(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# caffe.proto subset codec
+# ---------------------------------------------------------------------------
+# Field numbers from BVLC caffe.proto:
+#   NetParameter: name=1, input=3, input_dim=4, layer=100 (LayerParameter)
+#   LayerParameter: name=1, type=2, bottom=3, top=4, blobs=7,
+#     concat_param=104, convolution_param=106, dropout_param=108,
+#     eltwise_param=110, inner_product_param=117, lrn_param=118,
+#     pooling_param=121, batch_norm_param=139, scale_param=142,
+#     input_param=143
+#   BlobProto: data=5 (packed float), shape=7 (BlobShape: dim=1)
+
+
+def _decode_blob(data: bytes) -> np.ndarray:
+    f = proto.parse(data)
+    vals = np.asarray(proto.repeated_f32(f, 5), np.float32)
+    shape_raw = proto.get_bytes(f, 7)
+    if shape_raw:
+        dims = proto.repeated_ints(proto.parse(shape_raw), 1)
+    else:  # legacy num/channels/height/width fields 1-4
+        dims = [proto.get_int(f, i, 1) for i in (1, 2, 3, 4)]
+        while len(dims) > 1 and dims[0] == 1:
+            dims = dims[1:]
+    return vals.reshape(tuple(dims))
+
+
+def _encode_blob(arr: np.ndarray) -> Msg:
+    arr = np.asarray(arr, np.float32)
+    shape = Msg()
+    for d in arr.shape:
+        shape.varint(1, int(d))
+    # packed float wire format == little-endian IEEE754 concatenation
+    return Msg().msg(7, shape).blob(
+        5, np.ascontiguousarray(arr, np.float32).tobytes())
+
+
+class CaffeLayer:
+    def __init__(self, name: str, type_: str, bottoms: List[str],
+                 tops: List[str], blobs: List[np.ndarray],
+                 params: Dict[str, Dict]):
+        self.name, self.type = name, type_
+        self.bottoms, self.tops, self.blobs = bottoms, tops, blobs
+        self.params = params  # param-message name -> parsed fields
+
+    def __repr__(self):
+        return f"CaffeLayer({self.type}:{self.name})"
+
+
+_PARAM_FIELDS = {
+    104: "concat", 106: "convolution", 108: "dropout", 110: "eltwise",
+    117: "inner_product", 118: "lrn", 121: "pooling", 139: "batch_norm",
+    142: "scale", 143: "input", 125: "softmax", 133: "reshape",
+}
+
+
+def parse_caffe_net(data: bytes) -> Tuple[str, List[CaffeLayer]]:
+    f = proto.parse(data)
+    net_name = proto.get_str(f, 1)
+    layers = []
+    for raw in proto.repeated(f, 100):
+        lf = proto.parse(raw)
+        params = {}
+        for num, pname in _PARAM_FIELDS.items():
+            b = proto.get_bytes(lf, num)
+            if b:
+                params[pname] = proto.parse(b)
+        layers.append(CaffeLayer(
+            proto.get_str(lf, 1), proto.get_str(lf, 2),
+            [b.decode() for b in proto.repeated(lf, 3)],
+            [b.decode() for b in proto.repeated(lf, 4)],
+            [_decode_blob(b) for b in proto.repeated(lf, 7)],
+            params))
+    return net_name, layers
+
+
+# ---------------------------------------------------------------------------
+# import
+# ---------------------------------------------------------------------------
+
+
+def _conv_geom(p, field_pair, repeated_field, default):
+    """Caffe allows kernel_size (repeated) or kernel_h/kernel_w; same for
+    stride/pad."""
+    h_field, w_field = field_pair
+    h = proto.get_int(p, h_field, 0)
+    w = proto.get_int(p, w_field, 0)
+    if h or w:
+        return (h or default, w or default)
+    rep = proto.repeated_ints(p, repeated_field)
+    if not rep:
+        return (default, default)
+    if len(rep) == 1:
+        return (rep[0], rep[0])
+    return (rep[0], rep[1])
+
+
+def load_caffe(source, input_shapes: Optional[Dict[str, Sequence[int]]] = None):
+    """Import a Caffe NetParameter (deploy-style, with Input layer or
+    ``input_shapes`` giving NHWC shapes).  Returns ``(model, variables)``."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.keras.engine import Input, Model, Node
+
+    if isinstance(source, str):
+        with open(source, "rb") as fh:
+            source = fh.read()
+    _, layers = parse_caffe_net(source)
+
+    sym: Dict[str, Node] = {}
+    shape: Dict[str, Tuple[int, ...]] = {}  # NHWC shapes incl. batch
+    inputs: List[Node] = []
+    imported: List[Tuple[Any, Dict, Dict]] = []
+    pending_bn: Dict[str, Tuple[Any, Dict, Dict]] = {}  # top -> BN awaiting Scale
+
+    def add_layer(layer, p, s, parents, top, out_shape):
+        node = layer(parents[0] if len(parents) == 1 else parents)
+        imported.append((layer, p, s))
+        sym[top] = node
+        shape[top] = out_shape
+
+    for lay in layers:
+        t = lay.type
+        if t in ("Input", "Data", "DummyData"):
+            for ti, top in enumerate(lay.tops):
+                dims = None
+                if "input" in lay.params:
+                    shapes_raw = proto.repeated(lay.params["input"], 1)
+                    if ti < len(shapes_raw):
+                        dims = proto.repeated_ints(
+                            proto.parse(shapes_raw[ti]), 1)
+                if input_shapes and top in input_shapes:
+                    nhwc = tuple(input_shapes[top])
+                elif dims and len(dims) == 4:
+                    n, c, h, w = dims
+                    nhwc = (n, h, w, c)
+                elif dims:
+                    nhwc = tuple(dims)
+                else:
+                    raise UnsupportedCaffeLayer(
+                        f"Input '{top}' has no shape; pass input_shapes (NHWC)")
+                node = Input(nhwc[1:])
+                sym[top] = node
+                shape[top] = nhwc
+                inputs.append(node)
+            continue
+
+        bottom = lay.bottoms[0] if lay.bottoms else None
+        top = lay.tops[0] if lay.tops else lay.name
+        x = sym.get(bottom)
+        if x is None:
+            raise UnsupportedCaffeLayer(
+                f"{t} '{lay.name}': unknown bottom '{bottom}'")
+        in_shape = shape[bottom]
+
+        if t == "Convolution":
+            p = lay.params.get("convolution", {})
+            cout = proto.get_int(p, 1)
+            bias_term = proto.get_bool(p, 2, True)
+            kh, kw = _conv_geom(p, (11, 12), 4, 1)
+            sh, sw = _conv_geom(p, (13, 14), 6, 1)
+            ph, pw = _conv_geom(p, (9, 10), 3, 0)
+            group = proto.get_int(p, 5, 1)
+            dil = proto.repeated_ints(p, 18)
+            d = dil[0] if dil else 1
+            w = lay.blobs[0]  # (cout, cin/g, kh, kw)
+            w = np.transpose(w, (2, 3, 1, 0))  # HWIO
+            layer = nn.Conv2D(in_shape[3], cout, (kh, kw), stride=(sh, sw),
+                              padding=(ph, pw), dilation=d, groups=group,
+                              with_bias=bias_term, name=_pyname(lay.name))
+            params = {"weight": w}
+            if bias_term:
+                params["bias"] = lay.blobs[1]
+            oh = (in_shape[1] + 2 * ph - ((kh - 1) * d + 1)) // sh + 1
+            ow = (in_shape[2] + 2 * pw - ((kw - 1) * d + 1)) // sw + 1
+            add_layer(layer, params, {}, [x], top, (in_shape[0], oh, ow, cout))
+        elif t == "InnerProduct":
+            p = lay.params.get("inner_product", {})
+            cout = proto.get_int(p, 1)
+            bias_term = proto.get_bool(p, 2, True)
+            w = lay.blobs[0]  # (cout, cin) — cin over NCHW-flattened input
+            parents = [x]
+            if len(in_shape) == 4:
+                tr = nn.Transpose((0, 3, 1, 2), name=_pyname(lay.name) + "_nchw")
+                fl = nn.Flatten(name=_pyname(lay.name) + "_flat")
+                node = tr(parents[0])
+                imported.append((tr, {}, {}))
+                node = fl(node)
+                imported.append((fl, {}, {}))
+                parents = [node]
+            layer = nn.Linear(w.shape[1], cout, with_bias=bias_term,
+                              name=_pyname(lay.name))
+            params = {"weight": w.T}
+            if bias_term:
+                params["bias"] = lay.blobs[1]
+            add_layer(layer, params, {}, parents, top, (in_shape[0], cout))
+        elif t == "Pooling":
+            p = lay.params.get("pooling", {})
+            pool = proto.get_int(p, 1, 0)  # 0=MAX 1=AVE
+            if proto.get_bool(p, 12, False):  # global_pooling
+                layer = (nn.GlobalMaxPool2D(name=_pyname(lay.name)) if pool == 0
+                         else nn.GlobalAvgPool2D(name=_pyname(lay.name)))
+                add_layer(layer, {}, {}, [x], top,
+                          (in_shape[0], in_shape[3]))
+                continue
+            kh, kw = _conv_geom(p, (5, 6), 2, 1)
+            sh, sw = _conv_geom(p, (7, 8), 3, 1)
+            ph, pw = _conv_geom(p, (9, 10), 4, 0)
+            cls = nn.MaxPool2D if pool == 0 else nn.AvgPool2D
+            # caffe pooling rounds output size UP (ceil mode)
+            layer = cls((kh, kw), stride=(sh, sw), padding=(ph, pw),
+                        ceil_mode=True, name=_pyname(lay.name))
+            oh = -(-(in_shape[1] + 2 * ph - kh) // sh) + 1
+            ow = -(-(in_shape[2] + 2 * pw - kw) // sw) + 1
+            add_layer(layer, {}, {}, [x], top,
+                      (in_shape[0], oh, ow, in_shape[3]))
+        elif t == "ReLU":
+            add_layer(nn.ReLU(name=_pyname(lay.name)), {}, {}, [x], top,
+                      in_shape)
+        elif t == "Sigmoid":
+            add_layer(nn.Sigmoid(name=_pyname(lay.name)), {}, {}, [x], top,
+                      in_shape)
+        elif t == "TanH":
+            add_layer(nn.Tanh(name=_pyname(lay.name)), {}, {}, [x], top,
+                      in_shape)
+        elif t in ("Softmax", "SoftmaxWithLoss"):
+            add_layer(nn.SoftMax(name=_pyname(lay.name)), {}, {}, [x], top,
+                      in_shape)
+        elif t == "Dropout":
+            p = lay.params.get("dropout", {})
+            ratio = proto.get_f32(p, 1, 0.5)
+            add_layer(nn.Dropout(ratio, name=_pyname(lay.name)), {}, {}, [x],
+                      top, in_shape)
+        elif t == "LRN":
+            p = lay.params.get("lrn", {})
+            size = proto.get_int(p, 1, 5)
+            alpha = proto.get_f32(p, 2, 1.0)
+            beta = proto.get_f32(p, 3, 0.75)
+            k = proto.get_f32(p, 5, 1.0)
+            add_layer(nn.LRN(size, alpha, beta, k, name=_pyname(lay.name)),
+                      {}, {}, [x], top, in_shape)
+        elif t == "BatchNorm":
+            p = lay.params.get("batch_norm", {})
+            eps = proto.get_f32(p, 3, 1e-5)
+            mean, var = lay.blobs[0], lay.blobs[1]
+            sf = float(lay.blobs[2].reshape(-1)[0]) if len(lay.blobs) > 2 else 1.0
+            sf = 1.0 / sf if sf != 0 else 1.0
+            bn = nn.BatchNorm(mean.shape[0], eps=eps, affine=True,
+                              name=_pyname(lay.name))
+            params = {"weight": np.ones_like(mean), "bias": np.zeros_like(mean)}
+            state = {"running_mean": mean * sf, "running_var": var * sf}
+            # a DIRECTLY-following Scale layer folds its gamma/beta into this
+            # dict; the fold checks sym[top] is still this BN's node so any
+            # intervening layer (even in-place) invalidates it
+            add_layer(bn, params, state, [x], top, in_shape)
+            pending_bn[top] = (sym[top], params, state)
+        elif t == "Scale":
+            prev = pending_bn.pop(bottom, None)
+            if prev is not None and sym.get(bottom) is not prev[0]:
+                prev = None  # another layer ran in between; don't fold
+            p = lay.params.get("scale", {})
+            bias_term = proto.get_bool(p, 4, False)
+            gamma = lay.blobs[0]
+            beta = lay.blobs[1] if bias_term and len(lay.blobs) > 1 else \
+                np.zeros_like(gamma)
+            if prev is not None:
+                _, bn_params, _ = prev
+                bn_params["weight"] = gamma
+                bn_params["bias"] = beta
+                sym[top] = sym[bottom]
+                shape[top] = in_shape
+            else:
+                layer = nn.CMul(gamma.shape, name=_pyname(lay.name))
+                add_layer(layer, {"weight": gamma}, {}, [x], top, in_shape)
+                if bias_term:
+                    bl = nn.CAdd(beta.shape, name=_pyname(lay.name) + "_b")
+                    add_layer(bl, {"bias": beta}, {}, [sym[top]], top, in_shape)
+        elif t == "Eltwise":
+            p = lay.params.get("eltwise", {})
+            op = proto.get_int(p, 1, 1)  # default SUM
+            coeff = proto.repeated_f32(p, 2)
+            parents = [sym[b] for b in lay.bottoms]
+            if coeff and op == 1 and list(coeff) == [1.0, -1.0]:
+                cls = nn.CSubTable
+            elif coeff and any(c != 1.0 for c in coeff):
+                raise UnsupportedCaffeLayer(
+                    f"Eltwise '{lay.name}': coeff {coeff} not supported")
+            else:
+                cls = {0: nn.CMulTable, 1: nn.CAddTable, 2: nn.CMaxTable}[op]
+            add_layer(cls(name=_pyname(lay.name)), {}, {}, parents, top,
+                      in_shape)
+        elif t == "Concat":
+            p = lay.params.get("concat", {})
+            axis = proto.get_int(p, 2, 1)
+            if len(in_shape) == 4:
+                dim = {0: 0, 1: 3, 2: 1, 3: 2}[axis]  # NCHW -> NHWC
+            else:
+                dim = axis
+            parents = [sym[b] for b in lay.bottoms]
+            out = list(in_shape)
+            out[dim] = sum(shape[b][dim] for b in lay.bottoms)
+            add_layer(nn.JoinTable(dim, name=_pyname(lay.name)), {}, {},
+                      parents, top, tuple(out))
+        elif t == "Reshape":
+            p = lay.params.get("reshape", {})
+            dims = proto.repeated_ints(proto.parse(proto.get_bytes(p, 1)), 1) \
+                if proto.get_bytes(p, 1) else []
+            if len(in_shape) == 4 and dims[:1] in ([0], [-1]) and \
+                    list(dims[1:]) == [-1]:
+                # NCHW flatten == our Flatten behind a transpose
+                tr = nn.Transpose((0, 3, 1, 2), name=_pyname(lay.name) + "_n")
+                add_layer(tr, {}, {}, [x], top + "__pre", in_shape)
+                fl = nn.Flatten(name=_pyname(lay.name))
+                add_layer(fl, {}, {}, [sym[top + "__pre"]], top,
+                          (in_shape[0], int(np.prod(in_shape[1:]))))
+            elif len(in_shape) != 4 and dims and dims[0] in (0, -1):
+                tgt = [int(d) for d in dims[1:]]
+                add_layer(nn.Reshape(tgt, batch_mode=True,
+                                     name=_pyname(lay.name)), {}, {}, [x],
+                          top, (in_shape[0],) + tuple(
+                              np.abs(tgt) if -1 not in tgt else
+                              [int(np.prod(in_shape[1:]))]))
+            else:
+                raise UnsupportedCaffeLayer(
+                    f"Reshape '{lay.name}' dims {dims} on rank-"
+                    f"{len(in_shape)} blob")
+        elif t == "Flatten":
+            add_layer(nn.Transpose((0, 3, 1, 2), name=_pyname(lay.name) + "_n")
+                      if len(in_shape) == 4 else nn.Identity(), {}, {}, [x],
+                      top + "__pre", in_shape)
+            fl = nn.Flatten(name=_pyname(lay.name))
+            add_layer(fl, {}, {}, [sym[top + "__pre"]], top,
+                      (in_shape[0], int(np.prod(in_shape[1:]))))
+        else:
+            raise UnsupportedCaffeLayer(
+                f"unsupported Caffe layer type '{t}' ('{lay.name}')")
+
+    if not inputs:
+        raise UnsupportedCaffeLayer("net has no Input layer")
+    consumed = set()
+    for lay in layers:
+        for b in lay.bottoms:
+            if not (lay.tops and lay.tops[0] == b):  # in-place doesn't consume
+                consumed.add(b)
+    out_nodes, seen = [], set()
+    for top_name, nd in sym.items():
+        if top_name.endswith("__pre"):
+            continue
+        if top_name not in consumed and nd not in inputs and nd.id not in seen:
+            seen.add(nd.id)
+            out_nodes.append(nd)
+    from bigdl_tpu.keras.engine import Model
+    model = Model(inputs, out_nodes, name="CaffeImported")
+
+    params: Dict[str, Dict] = {}
+    state: Dict[str, Dict] = {}
+    by_layer = {id(l): (p, s) for l, p, s in imported}
+    for node in model.order:
+        if node.layer is not None and id(node.layer) in by_layer:
+            p, s = by_layer[id(node.layer)]
+            if p:
+                params[node.name] = {k: np.asarray(v, np.float32)
+                                     for k, v in p.items()}
+            if s:
+                state[node.name] = {k: np.asarray(v, np.float32)
+                                    for k, v in s.items()}
+    return model, {"params": params, "state": state}
+
+
+def _pyname(nm: str) -> str:
+    return nm.replace("/", "_").replace(":", "_")
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+
+def save_caffe(model, variables: Dict[str, Any], sample=None,
+               path: Optional[str] = None) -> bytes:
+    """Export a Sequential or functional Model as a binary Caffe
+    NetParameter (deploy-style: Input layer + weights in blobs).
+
+    The exported net is NCHW per Caffe convention; conv weights are
+    transposed from HWIO, Linear weights reordered when they follow a
+    spatial blob (requires ``sample`` for shape tracking, like the TF
+    exporter).
+    """
+    from bigdl_tpu.keras.engine import Model as KModel
+    from bigdl_tpu.nn.module import Sequential
+
+    net = Msg().string(1, getattr(model, "name", "net"))
+    uid = [0]
+
+    def fresh(base):
+        uid[0] += 1
+        return f"{base}_{uid[0]}"
+
+    def emit(name: str, type_: str, bottoms: List[str], top: str,
+             blobs: Sequence[np.ndarray] = (), **param_msgs: Msg):
+        m = Msg().string(1, name).string(2, type_)
+        for b in bottoms:
+            m.string(3, b)
+        m.string(4, top)
+        for blob in blobs:
+            m.msg(7, _encode_blob(blob))
+        field_of = {v: k for k, v in _PARAM_FIELDS.items()}
+        for pname, pmsg in param_msgs.items():
+            m.msg(field_of[pname], pmsg)
+        net.msg(100, m)
+        return top
+
+    params = variables.get("params", {})
+    state = variables.get("state", {})
+    ctx: Dict[str, Any] = {"flat": {}}  # flatten-top -> pre-flatten (H, W, C)
+
+    if isinstance(model, Sequential):
+        if sample is None:
+            raise UnsupportedCaffeLayer("save_caffe needs `sample`")
+        x = np.asarray(sample)
+        nchw = ((x.shape[0], x.shape[3], x.shape[1], x.shape[2])
+                if x.ndim == 4 else x.shape)
+        ip = Msg()
+        bs = Msg()
+        for d in nchw:
+            bs.varint(1, int(d))
+        ip.msg(1, bs)
+        emit("data", "Input", [], "data", input=ip)
+        cur, val = "data", x
+        for i, layer in enumerate(model.layers):
+            k = model._key(i)
+            p, s = params.get(k, {}), state.get(k, {})
+            cur = _emit_caffe_layer(emit, fresh, layer, p, s, [cur],
+                                    [np.shape(val)], ctx)
+            val2, _ = layer.apply({"params": p, "state": s}, val,
+                                  training=False)
+            val = np.asarray(val2)
+    elif isinstance(model, KModel):
+        if sample is None:
+            raise UnsupportedCaffeLayer("save_caffe needs `sample`")
+        samples = sample if isinstance(sample, (list, tuple)) else [sample]
+        name_of: Dict[int, str] = {}
+        val_of: Dict[int, np.ndarray] = {}
+        for i, inp in enumerate(model.inputs):
+            x = np.asarray(samples[i])
+            nchw = ((x.shape[0], x.shape[3], x.shape[1], x.shape[2])
+                    if x.ndim == 4 else x.shape)
+            ip = Msg()
+            bs = Msg()
+            for d in nchw:
+                bs.varint(1, int(d))
+            ip.msg(1, bs)
+            top = f"data_{i}"
+            emit(top, "Input", [], top, input=ip)
+            name_of[inp.id] = top
+            val_of[inp.id] = x
+        for node in model.order:
+            if node.layer is None:
+                continue
+            ins = [name_of[p.id] for p in node.parents]
+            shapes = [np.shape(val_of[p.id]) for p in node.parents]
+            p = params.get(node.name, {})
+            s = state.get(node.name, {})
+            name_of[node.id] = _emit_caffe_layer(emit, fresh, node.layer, p, s,
+                                                 ins, shapes, ctx)
+            xs = [val_of[pn.id] for pn in node.parents]
+            y, _ = node.layer.apply({"params": p, "state": s}, *xs,
+                                    training=False)
+            val_of[node.id] = np.asarray(y)
+    else:
+        raise UnsupportedCaffeLayer(f"cannot export {type(model).__name__}")
+
+    data = net.bytes()
+    if path:
+        with open(path, "wb") as fh:
+            fh.write(data)
+    return data
+
+
+def _emit_caffe_layer(emit, fresh, layer, params, state, ins: List[str],
+                      in_shapes: List[Tuple], ctx: Dict) -> str:
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import Sequential
+
+    t = type(layer).__name__
+    x = ins[0] if ins else None
+
+    if isinstance(layer, Sequential):
+        cur = x
+        shapes = in_shapes
+        for i, sub in enumerate(layer.layers):
+            k = layer._key(i)
+            cur = _emit_caffe_layer(emit, fresh, sub, params.get(k, {}),
+                                    state.get(k, {}), [cur], shapes, ctx)
+            shapes = None
+        return cur
+
+    if isinstance(layer, nn.Conv2D) and t in ("Conv2D", "SpatialConvolution"):
+        w = np.asarray(params["weight"])  # HWIO
+        w_nchw = np.transpose(w, (3, 2, 0, 1))
+        pad = layer.padding
+        if isinstance(pad, str):
+            if pad.upper() != "SAME":
+                raise UnsupportedCaffeLayer(f"padding '{pad}'")
+            kh, kw = layer.kernel_size
+            ph, pw = (kh - 1) // 2, (kw - 1) // 2  # odd-kernel SAME
+        else:
+            ph, pw = (pad, pad) if isinstance(pad, int) else tuple(pad)
+        p = (Msg().varint(1, layer.out_channels)
+             .varint(2, 1 if layer.with_bias else 0)
+             .varint(11, layer.kernel_size[0]).varint(12, layer.kernel_size[1])
+             .varint(13, layer.stride[0]).varint(14, layer.stride[1])
+             .varint(9, ph).varint(10, pw).varint(5, layer.groups))
+        if layer.dilation != (1, 1):
+            p.varint(18, layer.dilation[0])
+        blobs = [w_nchw] + ([np.asarray(params["bias"])] if layer.with_bias
+                            else [])
+        return emit(fresh("conv"), "Convolution", [x], fresh("conv_top"),
+                    blobs, convolution=p)
+
+    if isinstance(layer, nn.Linear):
+        w = np.asarray(params["weight"])  # (in, out), NHWC-flat rows
+        if in_shapes and len(in_shapes[0]) == 4:
+            raise UnsupportedCaffeLayer(
+                "export Linear on 4-D blob: insert Flatten first")
+        if x in ctx["flat"]:
+            # caffe enumerates flattened features NCHW; reorder the NHWC-flat
+            # weight rows to match (position k of the caffe weight = NHWC row
+            # nchw_from_nhwc[k])
+            h, wd, c = ctx["flat"][x]
+            nchw_from_nhwc = np.transpose(
+                np.arange(h * wd * c).reshape(h, wd, c), (2, 0, 1)).reshape(-1)
+            w = w[nchw_from_nhwc, :]
+        p = Msg().varint(1, w.shape[1]).varint(2, 1 if layer.with_bias else 0)
+        blobs = [w.T] + ([np.asarray(params["bias"])] if layer.with_bias
+                         else [])
+        return emit(fresh("fc"), "InnerProduct", [x], fresh("fc_top"), blobs,
+                    inner_product=p)
+
+    if isinstance(layer, nn.BatchNorm):
+        mean = np.asarray(state["running_mean"])
+        var = np.asarray(state["running_var"])
+        bn_p = Msg().f32(3, layer.eps)
+        top = emit(fresh("bn"), "BatchNorm", [x], fresh("bn_top"),
+                   [mean, var, np.asarray([1.0], np.float32)],
+                   batch_norm=bn_p)
+        if layer.affine:
+            sc_p = Msg().boolean(4, True)
+            top = emit(fresh("scale"), "Scale", [top], fresh("scale_top"),
+                       [np.asarray(params["weight"]),
+                        np.asarray(params["bias"])], scale=sc_p)
+        return top
+
+    if isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D)):
+        if not layer.ceil_mode:
+            # caffe always ceil-rounds the output size; a floor-mode pool is
+            # only representable when floor == ceil (window tiles exactly)
+            ok = False
+            if in_shapes and len(in_shapes[0]) == 4:
+                pad = layer.padding
+                ph, pw = ((0, 0) if isinstance(pad, str)
+                          else ((pad, pad) if isinstance(pad, int)
+                                else tuple(pad)))
+                ok = ((in_shapes[0][1] + 2 * ph - layer.kernel_size[0])
+                      % layer.stride[0] == 0 and
+                      (in_shapes[0][2] + 2 * pw - layer.kernel_size[1])
+                      % layer.stride[1] == 0)
+            if not ok:
+                raise UnsupportedCaffeLayer(
+                    "floor-mode pooling does not tile the input exactly; "
+                    "caffe Pooling is ceil-mode only")
+        pad = layer.padding
+        ph, pw = ((0, 0) if isinstance(pad, str)
+                  else ((pad, pad) if isinstance(pad, int) else tuple(pad)))
+        if isinstance(pad, str) and pad.upper() != "VALID":
+            raise UnsupportedCaffeLayer("SAME pooling export")
+        p = (Msg().varint(1, 0 if isinstance(layer, nn.MaxPool2D) else 1)
+             .varint(5, layer.kernel_size[0]).varint(6, layer.kernel_size[1])
+             .varint(7, layer.stride[0]).varint(8, layer.stride[1])
+             .varint(9, ph).varint(10, pw))
+        return emit(fresh("pool"), "Pooling", [x], fresh("pool_top"),
+                    pooling=p)
+
+    if isinstance(layer, (nn.GlobalAvgPool2D, nn.GlobalMaxPool2D)):
+        p = (Msg().varint(1, 1 if isinstance(layer, nn.GlobalAvgPool2D) else 0)
+             .boolean(12, True))
+        return emit(fresh("gpool"), "Pooling", [x], fresh("gpool_top"),
+                    pooling=p)
+
+    if isinstance(layer, nn.LRN):
+        p = (Msg().varint(1, layer.size).f32(2, layer.alpha)
+             .f32(3, layer.beta).f32(5, layer.k))
+        return emit(fresh("lrn"), "LRN", [x], fresh("lrn_top"), lrn=p)
+
+    if isinstance(layer, nn.Dropout):
+        p = Msg().f32(1, getattr(layer, "p", 0.5))
+        return emit(fresh("drop"), "Dropout", [x], fresh("drop_top"),
+                    dropout=p)
+
+    if isinstance(layer, nn.CAdd):
+        bias = np.asarray(params["bias"]).reshape(-1)
+        return emit(fresh("bias"), "Scale", [x], fresh("bias_top"),
+                    [np.ones_like(bias), bias], scale=Msg().boolean(4, True))
+
+    if isinstance(layer, nn.CMul):
+        w = np.asarray(params["weight"]).reshape(-1)
+        return emit(fresh("scale"), "Scale", [x], fresh("scale_top"), [w],
+                    scale=Msg().boolean(4, False))
+
+    if isinstance(layer, nn.CAddTable):
+        p = Msg().varint(1, 1)
+        return emit(fresh("elt"), "Eltwise", list(ins), fresh("elt_top"),
+                    eltwise=p)
+
+    if isinstance(layer, nn.CMulTable):
+        p = Msg().varint(1, 0)
+        return emit(fresh("elt"), "Eltwise", list(ins), fresh("elt_top"),
+                    eltwise=p)
+
+    if isinstance(layer, nn.CMaxTable):
+        p = Msg().varint(1, 2)
+        return emit(fresh("elt"), "Eltwise", list(ins), fresh("elt_top"),
+                    eltwise=p)
+
+    if isinstance(layer, nn.JoinTable):
+        dim = layer.dim
+        rank = len(in_shapes[0]) if in_shapes else 2
+        if rank == 4:
+            axis = {3: 1, 1: 2, 2: 3, -1: 1}.get(dim)
+        else:
+            axis = 1 if dim in (1, -1) else dim
+        if axis is None:
+            raise UnsupportedCaffeLayer(f"JoinTable dim {dim}")
+        p = Msg().varint(2, axis)
+        return emit(fresh("concat"), "Concat", list(ins), fresh("concat_top"),
+                    concat=p)
+
+    if isinstance(layer, nn.Flatten) or (
+            isinstance(layer, nn.Reshape) and layer.batch_mode
+            and len(layer.shape) == 1 and in_shapes
+            and len(in_shapes[0]) == 4):
+        # Caffe's Flatten is over NCHW; the importer re-inserts the NHWC
+        # transpose, and the geometry recorded here lets a following
+        # InnerProduct reorder its weight rows to match.  A batch-mode
+        # Reshape to one dim over a 4-D blob IS a flatten (the form the TF
+        # round-trip produces).
+        top = emit(fresh("flat"), "Flatten", [x], fresh("flat_top"))
+        if in_shapes and len(in_shapes[0]) == 4:
+            ctx["flat"][top] = tuple(in_shapes[0][1:4])
+        return top
+
+    if isinstance(layer, nn.Reshape):
+        if in_shapes and len(in_shapes[0]) == 4:
+            raise UnsupportedCaffeLayer(
+                "general Reshape on 4-D blob (NCHW/NHWC ambiguous)")
+        bs = Msg().varint(1, 0)  # dim 0 = keep batch
+        for d in layer.shape:
+            bs.varint(1, int(d))
+        return emit(fresh("reshape"), "Reshape", [x], fresh("reshape_top"),
+                    reshape=Msg().msg(1, bs))
+
+    if t in ("ReLU",):
+        return emit(fresh("relu"), "ReLU", [x], fresh("relu_top"))
+    if t == "Sigmoid":
+        return emit(fresh("sig"), "Sigmoid", [x], fresh("sig_top"))
+    if t == "Tanh":
+        return emit(fresh("tanh"), "TanH", [x], fresh("tanh_top"))
+    if t == "SoftMax":
+        return emit(fresh("prob"), "Softmax", [x], fresh("prob_top"))
+    if t == "Identity":
+        return x
+
+    raise UnsupportedCaffeLayer(f"cannot export layer {t}")

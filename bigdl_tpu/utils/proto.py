@@ -1,0 +1,225 @@
+"""Minimal protobuf wire-format codec (zero-dependency).
+
+Reference analog (unverified — mount empty): the reference links the real
+protobuf runtime for its model formats (``utils/tf/TensorflowLoader.scala``
+reads TF ``GraphDef``; ``utils/caffe/CaffeLoader.scala`` reads Caffe
+``NetParameter``; ``utils/serializer`` writes ``bigdl.proto``).  Here we
+implement just the wire format — varint, fixed32/64, length-delimited —
+so the TF/Caffe interop modules can parse and emit those protobufs without
+a protobuf (or tensorflow/caffe) dependency in the image.
+
+A parsed message is ``{field_number: [(wire_type, raw)]}`` where ``raw`` is
+an ``int`` for varints, ``bytes`` for length-delimited fields, and 4/8-byte
+``bytes`` for fixed32/64.  Interpretation (string vs sub-message vs packed
+array) is the caller's job, exactly as in the wire spec.
+"""
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+WIRE_VARINT = 0
+WIRE_I64 = 1
+WIRE_LEN = 2
+WIRE_I32 = 5
+
+
+# ---------------------------------------------------------------------------
+# decoding
+# ---------------------------------------------------------------------------
+
+
+def read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    n = len(data)
+    while True:
+        if i >= n:
+            raise ValueError("truncated varint")
+        b = data[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def parse(data: bytes) -> Dict[int, List[Tuple[int, Any]]]:
+    """Parse one message into {field: [(wire_type, raw), ...]} in order."""
+    fields: Dict[int, List[Tuple[int, Any]]] = {}
+    i, n = 0, len(data)
+    while i < n:
+        tag, i = read_varint(data, i)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == WIRE_VARINT:
+            v, i = read_varint(data, i)
+        elif wire == WIRE_LEN:
+            ln, i = read_varint(data, i)
+            v = data[i:i + ln]
+            if len(v) != ln:
+                raise ValueError("truncated length-delimited field")
+            i += ln
+        elif wire == WIRE_I32:
+            v = data[i:i + 4]
+            if len(v) != 4:
+                raise ValueError("truncated fixed32 field")
+            i += 4
+        elif wire == WIRE_I64:
+            v = data[i:i + 8]
+            if len(v) != 8:
+                raise ValueError("truncated fixed64 field")
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, v))
+    return fields
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def get_int(fields, num: int, default: int = 0) -> int:
+    vals = fields.get(num)
+    return _signed64(vals[-1][1]) if vals else default
+
+
+def get_bool(fields, num: int, default: bool = False) -> bool:
+    return bool(get_int(fields, num, int(default)))
+
+
+def get_bytes(fields, num: int, default: bytes = b"") -> bytes:
+    vals = fields.get(num)
+    return vals[-1][1] if vals else default
+
+
+def get_str(fields, num: int, default: str = "") -> str:
+    return get_bytes(fields, num, default.encode()).decode("utf-8")
+
+
+def get_f32(fields, num: int, default: float = 0.0) -> float:
+    vals = fields.get(num)
+    if not vals:
+        return default
+    wire, raw = vals[-1]
+    if wire == WIRE_I32:
+        return struct.unpack("<f", raw)[0]
+    raise ValueError("field is not fixed32")
+
+
+def get_f64(fields, num: int, default: float = 0.0) -> float:
+    vals = fields.get(num)
+    if not vals:
+        return default
+    return struct.unpack("<d", vals[-1][1])[0]
+
+
+def repeated(fields, num: int) -> List[Any]:
+    """Raw values of a repeated field (caller interprets)."""
+    return [raw for _, raw in fields.get(num, [])]
+
+
+def repeated_ints(fields, num: int) -> List[int]:
+    """Repeated varint field, accepting both packed and unpacked encoding."""
+    out: List[int] = []
+    for wire, raw in fields.get(num, []):
+        if wire == WIRE_VARINT:
+            out.append(_signed64(raw))
+        elif wire == WIRE_LEN:  # packed
+            i = 0
+            while i < len(raw):
+                v, i = read_varint(raw, i)
+                out.append(_signed64(v))
+        else:
+            raise ValueError("not a varint field")
+    return out
+
+
+def repeated_f32(fields, num: int) -> List[float]:
+    out: List[float] = []
+    for wire, raw in fields.get(num, []):
+        if wire == WIRE_I32:
+            out.append(struct.unpack("<f", raw)[0])
+        elif wire == WIRE_LEN:  # packed
+            out.extend(struct.unpack(f"<{len(raw) // 4}f", raw))
+    return out
+
+
+def repeated_f64(fields, num: int) -> List[float]:
+    out: List[float] = []
+    for wire, raw in fields.get(num, []):
+        if wire == WIRE_I64:
+            out.append(struct.unpack("<d", raw)[0])
+        elif wire == WIRE_LEN:
+            out.extend(struct.unpack(f"<{len(raw) // 8}d", raw))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# encoding
+# ---------------------------------------------------------------------------
+
+
+def _varint_bytes(v: int) -> bytes:
+    if v < 0:
+        v += 1 << 64  # two's-complement 64-bit, per spec
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Msg:
+    """Append-only protobuf message builder."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def _tag(self, field: int, wire: int):
+        self.buf += _varint_bytes((field << 3) | wire)
+
+    def varint(self, field: int, v: int) -> "Msg":
+        self._tag(field, WIRE_VARINT)
+        self.buf += _varint_bytes(int(v))
+        return self
+
+    def boolean(self, field: int, v: bool) -> "Msg":
+        return self.varint(field, 1 if v else 0)
+
+    def f32(self, field: int, v: float) -> "Msg":
+        self._tag(field, WIRE_I32)
+        self.buf += struct.pack("<f", float(v))
+        return self
+
+    def f64(self, field: int, v: float) -> "Msg":
+        self._tag(field, WIRE_I64)
+        self.buf += struct.pack("<d", float(v))
+        return self
+
+    def blob(self, field: int, data: bytes) -> "Msg":
+        self._tag(field, WIRE_LEN)
+        self.buf += _varint_bytes(len(data))
+        self.buf += bytes(data)
+        return self
+
+    def string(self, field: int, s: str) -> "Msg":
+        return self.blob(field, s.encode("utf-8"))
+
+    def msg(self, field: int, sub: "Msg") -> "Msg":
+        return self.blob(field, bytes(sub.buf))
+
+    def packed_ints(self, field: int, vals) -> "Msg":
+        body = b"".join(_varint_bytes(int(v)) for v in vals)
+        return self.blob(field, body)
+
+    def packed_f32(self, field: int, vals) -> "Msg":
+        return self.blob(field, struct.pack(f"<{len(vals)}f", *map(float, vals)))
+
+    def bytes(self) -> bytes:
+        return bytes(self.buf)

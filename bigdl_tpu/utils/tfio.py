@@ -1,0 +1,845 @@
+"""TensorFlow GraphDef import/export — the ``nn/tf`` + ``utils/tf`` analog.
+
+Reference analog (unverified — mount empty):
+``utils/tf/TensorflowLoader.scala`` pattern-matches frozen-TF ``GraphDef``
+subgraphs (MatMul+BiasAdd → Linear, Conv2D+BiasAdd → SpatialConvolution, …)
+into BigDL modules; ``utils/tf/TensorflowSaver.scala`` emits a BigDL graph
+back out as a ``GraphDef``; the ~100 small wrappers in ``nn/ops/*.scala``
+cover the remaining TF ops (those live here in ``nn/ops_layers.py``).
+
+TPU-native re-design: no tensorflow (or protobuf) dependency — the wire
+format is read/written directly via ``utils/proto``; imported graphs become
+a keras-engine functional :class:`~bigdl_tpu.keras.engine.Model` whose
+layers are catalog ``nn`` modules, so an imported model drops straight onto
+the sharded ``pjit`` training/inference path like any native model.
+
+Import:  ``model, variables = load_tf_graph(path_or_bytes)``
+Export:  ``graph_bytes = save_tf_graph(model, variables, sample, path=...)``
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+from bigdl_tpu.utils.proto import Msg
+
+# TF DataType enum (tensorflow/core/framework/types.proto)
+DT_FLOAT, DT_DOUBLE, DT_INT32, DT_UINT8 = 1, 2, 3, 4
+DT_INT16, DT_INT8, DT_STRING, DT_INT64, DT_BOOL = 5, 6, 7, 9, 10
+DT_BFLOAT16, DT_HALF = 14, 19
+
+_NP_OF_DT = {
+    DT_FLOAT: np.float32, DT_DOUBLE: np.float64, DT_INT32: np.int32,
+    DT_UINT8: np.uint8, DT_INT16: np.int16, DT_INT8: np.int8,
+    DT_INT64: np.int64, DT_BOOL: np.bool_, DT_HALF: np.float16,
+}
+_DT_OF_NP = {np.dtype(v): k for k, v in _NP_OF_DT.items()}
+
+
+class UnsupportedTFOp(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# TensorProto / TensorShapeProto / AttrValue codec
+# ---------------------------------------------------------------------------
+
+
+def _decode_shape(data: bytes) -> Optional[Tuple[int, ...]]:
+    f = proto.parse(data)
+    if proto.get_bool(f, 3):  # unknown_rank
+        return None
+    dims = []
+    for raw in proto.repeated(f, 2):
+        dims.append(proto.get_int(proto.parse(raw), 1))
+    return tuple(dims)
+
+
+def _encode_shape(shape: Sequence[int]) -> Msg:
+    m = Msg()
+    for d in shape:
+        m.msg(2, Msg().varint(1, int(d)))
+    return m
+
+
+def decode_tensor(data: bytes) -> np.ndarray:
+    """TensorProto → numpy (tensorflow/core/framework/tensor.proto)."""
+    f = proto.parse(data)
+    dtype = proto.get_int(f, 1, DT_FLOAT)
+    shape = _decode_shape(proto.get_bytes(f, 2)) or ()
+    np_dtype = _NP_OF_DT.get(dtype)
+    if np_dtype is None:
+        raise UnsupportedTFOp(f"tensor dtype {dtype} not supported")
+    content = proto.get_bytes(f, 4)
+    if content:
+        arr = np.frombuffer(content, dtype=np_dtype)
+    else:
+        if dtype == DT_FLOAT:
+            vals = proto.repeated_f32(f, 5)
+        elif dtype == DT_DOUBLE:
+            vals = proto.repeated_f64(f, 6)
+        elif dtype in (DT_INT32, DT_UINT8, DT_INT16, DT_INT8):
+            vals = proto.repeated_ints(f, 7)
+        elif dtype == DT_INT64:
+            vals = proto.repeated_ints(f, 10)
+        elif dtype == DT_BOOL:
+            vals = proto.repeated_ints(f, 11)
+        else:
+            raise UnsupportedTFOp(f"tensor value field for dtype {dtype}")
+        arr = np.asarray(vals, dtype=np_dtype)
+        n = int(np.prod(shape)) if shape else max(len(vals), 1)
+        if arr.size == 1 and n > 1:  # proto scalar-splat convention
+            arr = np.full((n,), arr.reshape(-1)[0], dtype=np_dtype)
+    return arr.reshape(shape)
+
+
+def encode_tensor(arr: np.ndarray) -> Msg:
+    arr = np.asarray(arr, order="C")  # NOT ascontiguousarray: keeps 0-d shape
+    dt = _DT_OF_NP.get(arr.dtype)
+    if dt is None:
+        raise UnsupportedTFOp(f"cannot export dtype {arr.dtype}")
+    m = Msg().varint(1, dt).msg(2, _encode_shape(arr.shape))
+    return m.blob(4, arr.tobytes())
+
+
+class Attr:
+    """Decoded AttrValue (tensorflow/core/framework/attr_value.proto)."""
+
+    def __init__(self, data: bytes):
+        self.f = proto.parse(data)
+
+    @property
+    def s(self) -> bytes:
+        return proto.get_bytes(self.f, 2)
+
+    @property
+    def i(self) -> int:
+        return proto.get_int(self.f, 3)
+
+    @property
+    def fval(self) -> float:
+        return proto.get_f32(self.f, 4)
+
+    @property
+    def b(self) -> bool:
+        return proto.get_bool(self.f, 5)
+
+    @property
+    def type(self) -> int:
+        return proto.get_int(self.f, 6)
+
+    @property
+    def shape(self) -> Optional[Tuple[int, ...]]:
+        return _decode_shape(proto.get_bytes(self.f, 7))
+
+    @property
+    def tensor(self) -> np.ndarray:
+        return decode_tensor(proto.get_bytes(self.f, 8))
+
+    @property
+    def ints(self) -> List[int]:
+        lst = proto.get_bytes(self.f, 1)
+        return proto.repeated_ints(proto.parse(lst), 3) if lst else []
+
+
+def _attr_i(v: int) -> Msg:
+    return Msg().varint(3, v)
+
+
+def _attr_f(v: float) -> Msg:
+    return Msg().f32(4, v)
+
+
+def _attr_b(v: bool) -> Msg:
+    return Msg().boolean(5, v)
+
+
+def _attr_s(v: bytes) -> Msg:
+    return Msg().blob(2, v)
+
+
+def _attr_type(dt: int) -> Msg:
+    return Msg().varint(6, dt)
+
+
+def _attr_shape(shape: Sequence[int]) -> Msg:
+    return Msg().msg(7, _encode_shape(shape))
+
+
+def _attr_tensor(arr: np.ndarray) -> Msg:
+    return Msg().msg(8, encode_tensor(arr))
+
+
+def _attr_int_list(vals: Sequence[int]) -> Msg:
+    return Msg().msg(1, Msg().packed_ints(3, vals))
+
+
+class TFNode:
+    def __init__(self, name: str, op: str, inputs: List[str],
+                 attrs: Dict[str, Attr]):
+        self.name, self.op, self.inputs, self.attrs = name, op, inputs, attrs
+
+    def __repr__(self):
+        return f"TFNode({self.op}:{self.name})"
+
+
+def parse_graphdef(data: bytes) -> List[TFNode]:
+    nodes = []
+    for raw in proto.repeated(proto.parse(data), 1):
+        f = proto.parse(raw)
+        name = proto.get_str(f, 1)
+        op = proto.get_str(f, 2)
+        inputs = [b.decode("utf-8") for b in proto.repeated(f, 3)]
+        attrs: Dict[str, Attr] = {}
+        for entry in proto.repeated(f, 5):
+            ef = proto.parse(entry)
+            attrs[proto.get_str(ef, 1)] = Attr(proto.get_bytes(ef, 2))
+        nodes.append(TFNode(name, op, inputs, attrs))
+    return nodes
+
+
+class GraphDefBuilder:
+    """Emit a GraphDef; used by the exporter and by tests to fabricate
+    "foreign" TF graphs."""
+
+    def __init__(self):
+        self.g = Msg()
+        self._names: set = set()
+
+    def node(self, name: str, op: str, inputs: Sequence[str] = (),
+             **attrs: Msg) -> str:
+        if name in self._names:
+            raise ValueError(f"duplicate node name {name}")
+        self._names.add(name)
+        n = Msg().string(1, name).string(2, op)
+        for i in inputs:
+            n.string(3, i)
+        for k, v in attrs.items():
+            n.msg(5, Msg().string(1, k).msg(2, v))
+        self.g.msg(1, n)
+        return name
+
+    def const(self, name: str, arr: np.ndarray) -> str:
+        arr = np.asarray(arr)
+        return self.node(name, "Const", dtype=_attr_type(_DT_OF_NP[arr.dtype]),
+                         value=_attr_tensor(arr))
+
+    def bytes(self) -> bytes:
+        return self.g.bytes()
+
+
+# ---------------------------------------------------------------------------
+# Import: GraphDef → keras Model + variables
+# ---------------------------------------------------------------------------
+
+
+def _canon(inp: str) -> Optional[str]:
+    """Canonical producer name of an input ref; None for control deps."""
+    if inp.startswith("^"):
+        return None
+    return inp.split(":")[0]
+
+
+def _pyname(tf_name: str) -> str:
+    return tf_name.replace("/", "_").replace(":", "_")
+
+
+def _toposort(nodes: List["TFNode"], by_name: Dict[str, "TFNode"]):
+    """Iterative DFS (frozen graphs can chain 1000s of nodes deep)."""
+    order: List[TFNode] = []
+    mark: Dict[str, int] = {}  # 1 = on stack, 2 = done
+
+    for root in nodes:
+        if mark.get(root.name) == 2:
+            continue
+        stack: List[Tuple[TFNode, int]] = [(root, 0)]
+        while stack:
+            n, idx = stack.pop()
+            if idx == 0:
+                if mark.get(n.name) == 2:
+                    continue
+                if mark.get(n.name) == 1:
+                    raise UnsupportedTFOp(f"cycle at node '{n.name}'")
+                mark[n.name] = 1
+            deps = [by_name[c] for c in
+                    (_canon(i) for i in n.inputs) if c and c in by_name]
+            while idx < len(deps) and mark.get(deps[idx].name) == 2:
+                idx += 1
+            if idx < len(deps):
+                dep = deps[idx]
+                if mark.get(dep.name) == 1:
+                    raise UnsupportedTFOp(f"cycle at node '{dep.name}'")
+                stack.append((n, idx + 1))
+                stack.append((dep, 0))
+            else:
+                mark[n.name] = 2
+                order.append(n)
+    return order
+
+
+def _act_import_table():
+    from bigdl_tpu import nn
+    return {
+        "Relu": nn.ReLU, "Relu6": nn.ReLU6, "Elu": nn.ELU,
+        "Sigmoid": nn.Sigmoid, "Tanh": nn.Tanh, "Softmax": nn.SoftMax,
+        "LogSoftmax": nn.LogSoftMax, "Softplus": nn.SoftPlus,
+        "Softsign": nn.SoftSign, "Rsqrt": nn.Rsqrt, "Sqrt": nn.Sqrt,
+        "Square": nn.Square, "Exp": nn.Exp, "Log": nn.Log, "Abs": nn.Abs,
+        "Neg": nn.Negative, "Floor": nn.Floor, "Ceil": nn.Ceil,
+        "Sign": nn.Sign, "Sin": nn.Sin, "Cos": nn.Cos,
+    }
+
+
+def load_tf_graph(source, input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                  outputs: Optional[Sequence[str]] = None):
+    """Import a frozen-inference GraphDef.
+
+    ``source``: bytes or a path to a ``.pb`` file.  ``input_shapes`` maps
+    placeholder name → full shape (batch dim included) when the graph doesn't
+    carry one.  Returns ``(model, variables)`` ready for
+    ``model.apply(variables, x)``.
+    """
+    from bigdl_tpu import nn
+    from bigdl_tpu.keras.engine import Input, Model, Node
+
+    if isinstance(source, str):
+        with open(source, "rb") as fh:
+            source = fh.read()
+    nodes = parse_graphdef(source)
+    by_name = {n.name: n for n in nodes}
+    acts = _act_import_table()
+
+    consumers: Dict[str, List[TFNode]] = {}
+    for n in nodes:
+        for i in n.inputs:
+            c = _canon(i)
+            if c is not None:
+                consumers.setdefault(c, []).append(n)
+
+    consts: Dict[str, np.ndarray] = {}
+    sym: Dict[str, Node] = {}
+    inputs: List[Node] = []
+    imported: List[Tuple[Any, Dict, Dict]] = []  # (layer, params, state)
+    folded: set = set()  # names of bias nodes folded into a producing layer
+
+    def const_of(name: Optional[str]) -> Optional[np.ndarray]:
+        n = by_name.get(name) if name else None
+        while n is not None and n.op in ("Identity", "StopGradient"):
+            nxt = _canon(n.inputs[0])
+            n = by_name.get(nxt) if nxt else None
+        if n is None:
+            return None
+        if n.name not in consts and n.op == "Const":
+            # decode on demand: bias-fold peeks at consts the topo walk has
+            # not reached yet
+            consts[n.name] = n.attrs["value"].tensor
+        return consts.get(n.name)
+
+    def add_layer(layer, params: Dict, state: Dict, parents: List[Node],
+                  out_name: str):
+        node = layer(parents[0] if len(parents) == 1 else parents)
+        imported.append((layer, params, state))
+        sym[out_name] = node
+
+    def bias_fold_target(n: TFNode) -> Optional[Tuple[TFNode, np.ndarray]]:
+        """If n's sole consumer is BiasAdd/Add(x, const-1d), return it."""
+        cs = consumers.get(n.name, [])
+        if len(cs) != 1 or cs[0].op not in ("BiasAdd", "Add", "AddV2"):
+            return None
+        ba = cs[0]
+        ins = [_canon(i) for i in ba.inputs if _canon(i)]
+        other = [i for i in ins if i != n.name]
+        if len(other) != 1:
+            return None
+        b = const_of(other[0])
+        if b is None or b.ndim != 1:
+            return None
+        return ba, b
+
+    def sym_in(n: TFNode, idx: int = 0) -> Node:
+        name = _canon(n.inputs[idx])
+        if name not in sym:
+            raise UnsupportedTFOp(
+                f"{n.op} '{n.name}': input '{name}' is not a tensor value")
+        return sym[name]
+
+    for n in _toposort(nodes, by_name):
+        op = n.op
+        if op == "NoOp" or n.name in folded:
+            continue
+        if op == "Const":
+            consts[n.name] = n.attrs["value"].tensor
+        elif op in ("Placeholder", "PlaceholderV2"):
+            shape = None
+            if input_shapes and n.name in input_shapes:
+                shape = tuple(input_shapes[n.name])[1:]
+            elif "shape" in n.attrs:
+                s = n.attrs["shape"].shape
+                if s:
+                    shape = tuple(s[1:])
+            if shape is None:
+                raise UnsupportedTFOp(
+                    f"Placeholder '{n.name}' has no shape; pass input_shapes")
+            node = Input(shape)
+            sym[n.name] = node
+            inputs.append(node)
+        elif op in ("Identity", "StopGradient", "CheckNumerics"):
+            src = _canon(n.inputs[0])
+            if src in sym:
+                sym[n.name] = sym[src]
+            else:
+                c = const_of(src)
+                if c is not None:
+                    consts[n.name] = c
+        elif op == "MatMul":
+            w = const_of(_canon(n.inputs[1]))
+            if w is None:
+                raise UnsupportedTFOp(f"MatMul '{n.name}': non-const weights")
+            if "transpose_a" in n.attrs and n.attrs["transpose_a"].b:
+                raise UnsupportedTFOp("MatMul transpose_a")
+            if "transpose_b" in n.attrs and n.attrs["transpose_b"].b:
+                w = w.T
+            fold = bias_fold_target(n)
+            layer = nn.Linear(w.shape[0], w.shape[1],
+                              with_bias=fold is not None, name=_pyname(n.name))
+            params = {"weight": w}
+            out = n.name
+            if fold is not None:
+                ba, bias = fold
+                params["bias"] = bias
+                folded.add(ba.name)
+                out = ba.name
+            add_layer(layer, params, {}, [sym_in(n)], out)
+        elif op == "Conv2D":
+            w = const_of(_canon(n.inputs[1]))
+            if w is None:
+                raise UnsupportedTFOp(f"Conv2D '{n.name}': non-const weights")
+            if "data_format" in n.attrs and n.attrs["data_format"].s not in (
+                    b"", b"NHWC"):
+                raise UnsupportedTFOp("Conv2D: only NHWC data_format")
+            strides = n.attrs["strides"].ints if "strides" in n.attrs else [1] * 4
+            pad = n.attrs["padding"].s.decode() if "padding" in n.attrs else "VALID"
+            dil = n.attrs["dilations"].ints if "dilations" in n.attrs else [1] * 4
+            fold = bias_fold_target(n)
+            kh, kw, cin, cout = w.shape
+            layer = nn.Conv2D(cin, cout, (kh, kw), stride=tuple(strides[1:3]),
+                              padding=pad, dilation=tuple(dil[1:3]),
+                              with_bias=fold is not None, name=_pyname(n.name))
+            params = {"weight": w}
+            out = n.name
+            if fold is not None:
+                ba, bias = fold
+                params["bias"] = bias
+                folded.add(ba.name)
+                out = ba.name
+            add_layer(layer, params, {}, [sym_in(n)], out)
+        elif op == "BiasAdd":
+            b = const_of(_canon(n.inputs[1]))
+            if b is None:
+                raise UnsupportedTFOp(f"BiasAdd '{n.name}': non-const bias")
+            layer = nn.CAdd(b.shape, name=_pyname(n.name))
+            add_layer(layer, {"bias": b}, {}, [sym_in(n)], n.name)
+        elif op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            scale = const_of(_canon(n.inputs[1]))
+            offset = const_of(_canon(n.inputs[2]))
+            mean = const_of(_canon(n.inputs[3]))
+            var = const_of(_canon(n.inputs[4]))
+            if any(v is None for v in (scale, offset, mean, var)):
+                raise UnsupportedTFOp(f"{op} '{n.name}': non-const stats")
+            eps = n.attrs["epsilon"].fval if "epsilon" in n.attrs else 1e-3
+            layer = nn.BatchNorm(scale.shape[0], eps=eps, name=_pyname(n.name))
+            add_layer(layer, {"weight": scale, "bias": offset},
+                      {"running_mean": mean, "running_var": var},
+                      [sym_in(n)], n.name)
+        elif op in ("Add", "AddV2", "Sub", "Mul", "Maximum", "Minimum",
+                    "RealDiv"):
+            a, b = _canon(n.inputs[0]), _canon(n.inputs[1])
+            table = {"Add": nn.CAddTable, "AddV2": nn.CAddTable,
+                     "Sub": nn.CSubTable, "Mul": nn.CMulTable,
+                     "Maximum": nn.CMaxTable, "Minimum": nn.CMinTable,
+                     "RealDiv": nn.CDivTable}
+            if a in sym and b in sym:
+                add_layer(table[op](name=_pyname(n.name)), {}, {},
+                          [sym[a], sym[b]], n.name)
+                continue
+            x_name, c_name = (a, b) if a in sym else (b, a)
+            if x_name not in sym:
+                raise UnsupportedTFOp(f"{op} '{n.name}': no tensor input")
+            c = const_of(c_name)
+            if c is None:
+                raise UnsupportedTFOp(f"{op} '{n.name}': non-const operand")
+            if c.ndim == 0:
+                if op in ("Add", "AddV2"):
+                    layer = nn.AddConstant(float(c), name=_pyname(n.name))
+                elif op == "Sub" and x_name == a:
+                    layer = nn.AddConstant(-float(c), name=_pyname(n.name))
+                elif op == "Mul":
+                    layer = nn.MulConstant(float(c), name=_pyname(n.name))
+                elif op == "RealDiv" and x_name == a:
+                    layer = nn.MulConstant(1.0 / float(c), name=_pyname(n.name))
+                else:
+                    raise UnsupportedTFOp(f"{op}(const, x) not supported")
+                add_layer(layer, {}, {}, [sym[x_name]], n.name)
+            elif op in ("Add", "AddV2"):
+                layer = nn.CAdd(c.shape, name=_pyname(n.name))
+                add_layer(layer, {"bias": c}, {}, [sym[x_name]], n.name)
+            elif op == "Mul":
+                layer = nn.CMul(c.shape, name=_pyname(n.name))
+                add_layer(layer, {"weight": c}, {}, [sym[x_name]], n.name)
+            else:
+                raise UnsupportedTFOp(f"{op} with non-scalar const")
+        elif op == "LeakyRelu":
+            alpha = n.attrs["alpha"].fval if "alpha" in n.attrs else 0.2
+            add_layer(nn.LeakyReLU(alpha, name=_pyname(n.name)), {}, {},
+                      [sym_in(n)], n.name)
+        elif op in acts:
+            add_layer(acts[op](name=_pyname(n.name)), {}, {}, [sym_in(n)],
+                      n.name)
+        elif op in ("MaxPool", "AvgPool"):
+            ks = n.attrs["ksize"].ints
+            st = n.attrs["strides"].ints
+            pad = n.attrs["padding"].s.decode()
+            cls = nn.MaxPool2D if op == "MaxPool" else nn.AvgPool2D
+            layer = cls(tuple(ks[1:3]), stride=tuple(st[1:3]), padding=pad,
+                        name=_pyname(n.name))
+            add_layer(layer, {}, {}, [sym_in(n)], n.name)
+        elif op == "Reshape":
+            shape = const_of(_canon(n.inputs[1]))
+            if shape is None:
+                raise UnsupportedTFOp(f"Reshape '{n.name}': non-const shape")
+            shape = [int(d) for d in shape]
+            if shape and shape[0] == -1:
+                layer = nn.Reshape(shape[1:], batch_mode=True,
+                                   name=_pyname(n.name))
+            else:
+                layer = nn.Reshape(shape, batch_mode=False,
+                                   name=_pyname(n.name))
+            add_layer(layer, {}, {}, [sym_in(n)], n.name)
+        elif op == "Squeeze":
+            dims = n.attrs["squeeze_dims"].ints if "squeeze_dims" in n.attrs \
+                else None
+            layer = nn.Squeeze(tuple(dims) if dims else None,
+                               name=_pyname(n.name))
+            add_layer(layer, {}, {}, [sym_in(n)], n.name)
+        elif op == "Mean":
+            idx = const_of(_canon(n.inputs[1]))
+            if idx is None:
+                raise UnsupportedTFOp(f"Mean '{n.name}': non-const indices")
+            keep = n.attrs["keep_dims"].b if "keep_dims" in n.attrs else False
+            layer = nn.Mean(tuple(int(i) for i in np.atleast_1d(idx)),
+                            keepdims=keep, name=_pyname(n.name))
+            add_layer(layer, {}, {}, [sym_in(n)], n.name)
+        elif op == "Pad":
+            pads = const_of(_canon(n.inputs[1]))
+            if pads is None:
+                raise UnsupportedTFOp(f"Pad '{n.name}': non-const paddings")
+            layer = nn.PadOp([[int(a) for a in row] for row in pads],
+                             name=_pyname(n.name))
+            add_layer(layer, {}, {}, [sym_in(n)], n.name)
+        elif op in ("ConcatV2", "Concat"):
+            if op == "ConcatV2":
+                axis = const_of(_canon(n.inputs[-1]))
+                data = n.inputs[:-1]
+            else:
+                axis = const_of(_canon(n.inputs[0]))
+                data = n.inputs[1:]
+            if axis is None:
+                raise UnsupportedTFOp(f"{op} '{n.name}': non-const axis")
+            parents = [sym[_canon(i)] for i in data]
+            add_layer(nn.JoinTable(int(axis), name=_pyname(n.name)), {}, {},
+                      parents, n.name)
+        else:
+            raise UnsupportedTFOp(
+                f"unsupported TF op '{op}' (node '{n.name}')")
+
+    if not inputs:
+        raise UnsupportedTFOp("graph has no Placeholder inputs")
+    if outputs:
+        out_nodes = [sym[o] for o in outputs]
+    else:
+        out_nodes, seen = [], set()
+        for n in nodes:
+            nd = sym.get(n.name)
+            if (nd is not None and not consumers.get(n.name)
+                    and nd not in inputs and nd.id not in seen):
+                seen.add(nd.id)
+                out_nodes.append(nd)
+    model = Model(inputs, out_nodes, name="TFImported")
+
+    params: Dict[str, Dict] = {}
+    state: Dict[str, Dict] = {}
+    by_layer = {id(l): (p, s) for l, p, s in imported}
+    for node in model.order:
+        if node.layer is not None and id(node.layer) in by_layer:
+            p, s = by_layer[id(node.layer)]
+            if p:
+                params[node.name] = {k: np.asarray(v) for k, v in p.items()}
+            if s:
+                state[node.name] = {k: np.asarray(v) for k, v in s.items()}
+    return model, {"params": params, "state": state}
+
+
+# ---------------------------------------------------------------------------
+# Export: model → GraphDef
+# ---------------------------------------------------------------------------
+
+
+def save_tf_graph(model, variables: Dict[str, Any],
+                  sample=None, path: Optional[str] = None,
+                  input_names: Optional[Sequence[str]] = None) -> bytes:
+    """Export a Sequential or functional Model as a frozen GraphDef.
+
+    ``sample`` (a sample input array, or list of arrays for multi-input
+    models) drives shape inference — needed to emit Placeholder shapes and
+    to resolve ``Flatten`` into a concrete TF ``Reshape``.  Covers the layer
+    set the reference's ``TensorflowSaver`` handles: Linear, Conv2D (SAME /
+    int padding), BatchNorm (inference form), pooling, activations,
+    Reshape/Flatten/Squeeze, Dropout (→ Identity), CAddTable, JoinTable,
+    GlobalAvgPool2D, ZeroPadding2D, CAdd/CMul, Pad.
+    """
+    from bigdl_tpu.keras.engine import Model as KModel
+    from bigdl_tpu.nn.module import Sequential
+
+    b = GraphDefBuilder()
+    uid = [0]
+
+    def fresh(base: str) -> str:
+        uid[0] += 1
+        return f"{base}_{uid[0]}"
+
+    params = variables.get("params", {})
+    state = variables.get("state", {})
+
+    if isinstance(model, KModel):
+        samples = None
+        if sample is not None:
+            samples = sample if isinstance(sample, (list, tuple)) else [sample]
+        name_of: Dict[int, str] = {}
+        val_of: Dict[int, Any] = {}
+        for i, inp in enumerate(model.inputs):
+            nm = (input_names[i] if input_names and i < len(input_names)
+                  else f"input_{i}")
+            if samples is not None:
+                shape = (-1,) + tuple(np.shape(samples[i])[1:])
+                val_of[inp.id] = np.asarray(samples[i])
+            elif inp.shape is not None:
+                shape = (-1,) + tuple(inp.shape)
+            else:
+                shape = (-1,)
+            b.node(nm, "Placeholder", dtype=_attr_type(DT_FLOAT),
+                   shape=_attr_shape(shape))
+            name_of[inp.id] = nm
+        for node in model.order:
+            if node.layer is None:
+                continue
+            ins = [name_of[p.id] for p in node.parents]
+            in_shapes = [np.shape(val_of[p.id]) for p in node.parents] \
+                if samples is not None else None
+            p = params.get(node.name, {})
+            s = state.get(node.name, {})
+            out = _emit_layer(b, fresh, node.layer, p, s, ins, in_shapes)
+            name_of[node.id] = out
+            if samples is not None:
+                xs = [val_of[pn.id] for pn in node.parents]
+                y, _ = node.layer.apply({"params": p, "state": s}, *xs,
+                                        training=False)
+                val_of[node.id] = np.asarray(y)
+    elif isinstance(model, Sequential):
+        shape = ((-1,) + tuple(np.shape(sample)[1:])) if sample is not None \
+            else (-1,)
+        b.node("input_0", "Placeholder", dtype=_attr_type(DT_FLOAT),
+               shape=_attr_shape(shape))
+        cur, val = "input_0", (np.asarray(sample) if sample is not None
+                               else None)
+        for i, layer in enumerate(model.layers):
+            k = model._key(i)
+            p, s = params.get(k, {}), state.get(k, {})
+            in_shapes = [np.shape(val)] if val is not None else None
+            cur = _emit_layer(b, fresh, layer, p, s, [cur], in_shapes)
+            if val is not None:
+                val, _ = layer.apply({"params": p, "state": s}, val,
+                                     training=False)
+                val = np.asarray(val)
+    else:
+        raise UnsupportedTFOp(f"cannot export {type(model).__name__}")
+
+    data = b.bytes()
+    if path:
+        with open(path, "wb") as fh:
+            fh.write(data)
+    return data
+
+
+def _np(v) -> np.ndarray:
+    return np.asarray(v)
+
+
+def _emit_layer(b: GraphDefBuilder, fresh, layer, params: Dict, state: Dict,
+                ins: List[str], in_shapes: Optional[List[Tuple]] = None) -> str:
+    """Emit GraphDef node(s) for one catalog layer; returns output node name."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import Sequential
+
+    t = type(layer).__name__
+    x = ins[0] if ins else None
+
+    if isinstance(layer, Sequential):
+        cur = x
+        shapes = in_shapes
+        for i, sub in enumerate(layer.layers):
+            k = layer._key(i)
+            cur = _emit_layer(b, fresh, sub, params.get(k, {}),
+                              state.get(k, {}), [cur], shapes)
+            shapes = None  # inner shape tracking only at the top level
+        return cur
+
+    if isinstance(layer, nn.Linear):
+        w = b.const(fresh("weight"), _np(params["weight"]).astype(np.float32))
+        out = b.node(fresh("MatMul"), "MatMul", [x, w],
+                     transpose_a=_attr_b(False), transpose_b=_attr_b(False))
+        if layer.with_bias:
+            bias = b.const(fresh("bias"), _np(params["bias"]).astype(np.float32))
+            out = b.node(fresh("BiasAdd"), "BiasAdd", [out, bias])
+        return out
+
+    if isinstance(layer, nn.Conv2D) and t in ("Conv2D", "SpatialConvolution"):
+        if layer.groups != 1:
+            raise UnsupportedTFOp("grouped Conv2D export")
+        w = b.const(fresh("kernel"), _np(params["weight"]).astype(np.float32))
+        pad = layer.padding
+        src = x
+        if isinstance(pad, str):
+            tf_pad = pad.upper()
+        else:
+            ph, pw = (pad, pad) if isinstance(pad, int) else tuple(pad)
+            if (ph, pw) == (-1, -1):
+                tf_pad = "SAME"
+            elif (ph, pw) == (0, 0):
+                tf_pad = "VALID"
+            else:
+                pads = b.const(fresh("pads"), np.asarray(
+                    [[0, 0], [ph, ph], [pw, pw], [0, 0]], np.int32))
+                src = b.node(fresh("Pad"), "Pad", [x, pads])
+                tf_pad = "VALID"
+        sh, sw = layer.stride
+        dh, dw = layer.dilation
+        out = b.node(fresh("Conv2D"), "Conv2D", [src, w],
+                     strides=_attr_int_list([1, sh, sw, 1]),
+                     dilations=_attr_int_list([1, dh, dw, 1]),
+                     padding=_attr_s(tf_pad.encode()),
+                     data_format=_attr_s(b"NHWC"))
+        if layer.with_bias:
+            bias = b.const(fresh("bias"), _np(params["bias"]).astype(np.float32))
+            out = b.node(fresh("BiasAdd"), "BiasAdd", [out, bias])
+        return out
+
+    if isinstance(layer, nn.BatchNorm):
+        c = _np(state["running_mean"]).shape[0]
+        scale = _np(params["weight"]) if layer.affine else np.ones(c, np.float32)
+        offset = _np(params["bias"]) if layer.affine else np.zeros(c, np.float32)
+        sc = b.const(fresh("gamma"), scale.astype(np.float32))
+        of = b.const(fresh("beta"), offset.astype(np.float32))
+        mu = b.const(fresh("mean"), _np(state["running_mean"]).astype(np.float32))
+        var = b.const(fresh("variance"),
+                      _np(state["running_var"]).astype(np.float32))
+        return b.node(fresh("FusedBatchNormV3"), "FusedBatchNormV3",
+                      [x, sc, of, mu, var], epsilon=_attr_f(layer.eps),
+                      is_training=_attr_b(False))
+
+    if isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D)):
+        op = "MaxPool" if isinstance(layer, nn.MaxPool2D) else "AvgPool"
+        pad = layer.padding
+        if isinstance(pad, str):
+            tf_pad = pad.upper()
+        else:
+            ph, pw = (pad, pad) if isinstance(pad, int) else tuple(pad)
+            if (ph, pw) != (0, 0):
+                raise UnsupportedTFOp(f"int-padded {op} export")
+            tf_pad = "VALID"
+        kh, kw = layer.kernel_size
+        sh, sw = layer.stride
+        return b.node(fresh(op), op, [x],
+                      ksize=_attr_int_list([1, kh, kw, 1]),
+                      strides=_attr_int_list([1, sh, sw, 1]),
+                      padding=_attr_s(tf_pad.encode()))
+
+    if isinstance(layer, nn.GlobalAvgPool2D):
+        idx = b.const(fresh("axes"), np.asarray([1, 2], np.int32))
+        return b.node(fresh("Mean"), "Mean", [x, idx], keep_dims=_attr_b(False))
+
+    if isinstance(layer, nn.Flatten):
+        if not in_shapes:
+            raise UnsupportedTFOp(
+                "Flatten export needs `sample` for shape inference")
+        flat = int(np.prod(in_shapes[0][1:]))
+        shape = b.const(fresh("shape"), np.asarray([-1, flat], np.int32))
+        return b.node(fresh("Reshape"), "Reshape", [x, shape])
+
+    if isinstance(layer, nn.Reshape):
+        if layer.batch_mode:
+            tgt = [-1] + [int(d) for d in layer.shape]
+        else:
+            tgt = [int(d) for d in layer.shape]
+        shape = b.const(fresh("shape"), np.asarray(tgt, np.int32))
+        return b.node(fresh("Reshape"), "Reshape", [x, shape])
+
+    if isinstance(layer, nn.Squeeze):
+        dims = layer.dim
+        attrs = {}
+        if dims is not None:
+            attrs["squeeze_dims"] = _attr_int_list(
+                [int(d) for d in np.atleast_1d(dims)])
+        return b.node(fresh("Squeeze"), "Squeeze", [x], **attrs)
+
+    if isinstance(layer, (nn.Dropout, nn.Identity)):
+        return b.node(fresh("Identity"), "Identity", [x])
+
+    if isinstance(layer, nn.CAdd):
+        bias = b.const(fresh("bias"), _np(params["bias"]).astype(np.float32))
+        return b.node(fresh("AddV2"), "AddV2", [x, bias])
+
+    if isinstance(layer, nn.CMul):
+        w = b.const(fresh("weight"), _np(params["weight"]).astype(np.float32))
+        return b.node(fresh("Mul"), "Mul", [x, w])
+
+    if isinstance(layer, nn.CAddTable):
+        out = ins[0]
+        for other in ins[1:]:
+            out = b.node(fresh("AddV2"), "AddV2", [out, other])
+        return out
+
+    if isinstance(layer, nn.JoinTable):
+        axis = b.const(fresh("axis"), np.asarray(layer.dim, np.int32))
+        return b.node(fresh("ConcatV2"), "ConcatV2", list(ins) + [axis],
+                      N=_attr_i(len(ins)))
+
+    if isinstance(layer, nn.ZeroPadding2D):
+        ph, pw = layer.padding
+        pads = b.const(fresh("pads"), np.asarray(
+            [[0, 0], [ph, ph], [pw, pw], [0, 0]], np.int32))
+        return b.node(fresh("Pad"), "Pad", [x, pads])
+
+    if isinstance(layer, nn.PadOp):
+        pads = b.const(fresh("pads"), np.asarray(layer.paddings, np.int32))
+        return b.node(fresh("Pad"), "Pad", [x, pads])
+
+    if isinstance(layer, nn.LeakyReLU):
+        return b.node(fresh("LeakyRelu"), "LeakyRelu", [x],
+                      alpha=_attr_f(layer.negval))
+
+    act = _ACT_EXPORT.get(t)
+    if act is not None:
+        return b.node(fresh(act), act, [x])
+
+    raise UnsupportedTFOp(f"cannot export layer {t}")
+
+
+_ACT_EXPORT = {
+    "ReLU": "Relu", "ReLU6": "Relu6", "ELU": "Elu", "Sigmoid": "Sigmoid",
+    "Tanh": "Tanh", "SoftMax": "Softmax", "LogSoftMax": "LogSoftmax",
+    "SoftPlus": "Softplus", "SoftSign": "Softsign", "Exp": "Exp",
+    "Log": "Log", "Sqrt": "Sqrt", "Square": "Square", "Abs": "Abs",
+    "Negative": "Neg", "Floor": "Floor", "Ceil": "Ceil", "Sign": "Sign",
+    "Sin": "Sin", "Cos": "Cos",
+}

@@ -1,0 +1,263 @@
+"""Caffe import/export tests — reference `utils/caffe` CaffeLoader/Persister
+specs.  Foreign nets are fabricated with the wire codec; round-trips check
+export→import numerics across the NHWC↔NCHW boundary.
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.keras.engine import Input, Model
+from bigdl_tpu.nn.module import Sequential
+from bigdl_tpu.utils.caffe import (
+    Msg, UnsupportedCaffeLayer, _encode_blob, _decode_blob, load_caffe,
+    parse_caffe_net, save_caffe,
+)
+
+
+def test_blob_roundtrip():
+    arr = np.random.RandomState(0).randn(4, 3, 2).astype(np.float32)
+    out = _decode_blob(bytes(_encode_blob(arr).buf))
+    np.testing.assert_array_equal(out, arr)
+
+
+def _layer_msg(name, type_, bottoms, tops, blobs=(), **params):
+    from bigdl_tpu.utils.caffe import _PARAM_FIELDS
+    m = Msg().string(1, name).string(2, type_)
+    for b in bottoms:
+        m.string(3, b)
+    for t in tops:
+        m.string(4, t)
+    for blob in blobs:
+        m.msg(7, _encode_blob(blob))
+    field_of = {v: k for k, v in _PARAM_FIELDS.items()}
+    for pname, pmsg in params.items():
+        m.msg(field_of[pname], pmsg)
+    return m
+
+
+def _input_layer(name, nchw):
+    bs = Msg()
+    for d in nchw:
+        bs.varint(1, int(d))
+    return _layer_msg(name, "Input", [], [name], input=Msg().msg(1, bs))
+
+
+def test_import_foreign_lenet_style_net():
+    """Conv→ReLU(in-place)→Pool→IP→Softmax fabricated as caffe would freeze
+    it, verified against a hand NCHW computation."""
+    rng = np.random.RandomState(1)
+    wconv = rng.randn(4, 1, 3, 3).astype(np.float32)  # (cout, cin, kh, kw)
+    bconv = rng.randn(4).astype(np.float32)
+    wip = rng.randn(2, 4 * 3 * 3).astype(np.float32)  # NCHW-flat columns
+    bip = rng.randn(2).astype(np.float32)
+
+    net = Msg().string(1, "lenet-ish")
+    net.msg(100, _input_layer("data", (1, 1, 8, 8)))
+    conv_p = (Msg().varint(1, 4).varint(2, 1).varint(4, 3).varint(6, 2))
+    net.msg(100, _layer_msg("conv1", "Convolution", ["data"], ["conv1"],
+                            [wconv, bconv], convolution=conv_p))
+    net.msg(100, _layer_msg("relu1", "ReLU", ["conv1"], ["conv1"]))  # in-place
+    pool_p = Msg().varint(1, 0).varint(2, 1)  # MAX 1x1 (identity pool)
+    net.msg(100, _layer_msg("pool1", "Pooling", ["conv1"], ["pool1"],
+                            pooling=pool_p))
+    ip_p = Msg().varint(1, 2).varint(2, 1)
+    net.msg(100, _layer_msg("ip1", "InnerProduct", ["pool1"], ["ip1"],
+                            [wip, bip], inner_product=ip_p))
+    net.msg(100, _layer_msg("prob", "Softmax", ["ip1"], ["prob"]))
+
+    model, variables = load_caffe(net.bytes())
+
+    x_nhwc = rng.randn(1, 8, 8, 1).astype(np.float32)
+    y, _ = model.apply(variables, x_nhwc)
+
+    # hand NCHW reference
+    from scipy_free_conv import conv2d_nchw  # noqa — defined below
+    x = np.transpose(x_nhwc, (0, 3, 1, 2))
+    h = conv2d_nchw(x, wconv, stride=2) + bconv[None, :, None, None]
+    h = np.maximum(h, 0)
+    flat = h.reshape(1, -1)  # NCHW flatten
+    logits = flat @ wip.T + bip
+    e = np.exp(logits - logits.max())
+    expect = e / e.sum()
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+
+# tiny dependency-free NCHW conv used by the test above
+import sys
+import types
+
+_m = types.ModuleType("scipy_free_conv")
+
+
+def _conv2d_nchw(x, w, stride=1):
+    n, cin, hh, ww = x.shape
+    cout, _, kh, kw = w.shape
+    oh = (hh - kh) // stride + 1
+    ow = (ww - kw) // stride + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+_m.conv2d_nchw = _conv2d_nchw
+sys.modules["scipy_free_conv"] = _m
+
+
+def test_import_bn_scale_fold_and_eltwise():
+    rng = np.random.RandomState(2)
+    mean = rng.randn(3).astype(np.float32)
+    var = (1 + rng.rand(3)).astype(np.float32)
+    gamma = rng.randn(3).astype(np.float32)
+    beta = rng.randn(3).astype(np.float32)
+
+    net = Msg().string(1, "bn-net")
+    net.msg(100, _input_layer("data", (2, 3, 4, 4)))
+    net.msg(100, _layer_msg("bn", "BatchNorm", ["data"], ["bn"],
+                            [mean, var, np.asarray([1.0], np.float32)],
+                            batch_norm=Msg().f32(3, 1e-5)))
+    net.msg(100, _layer_msg("scale", "Scale", ["bn"], ["bn"],
+                            [gamma, beta], scale=Msg().boolean(4, True)))
+    net.msg(100, _layer_msg("sum", "Eltwise", ["bn", "data"], ["sum"],
+                            eltwise=Msg().varint(1, 1)))
+
+    model, variables = load_caffe(net.bytes())
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    norm = (x - mean) / np.sqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(np.asarray(y), norm + x, rtol=1e-4, atol=1e-5)
+
+    # Scale folded into BN affine: exactly one parametered layer
+    bns = [n.layer for n in model.order
+           if n.layer is not None and isinstance(n.layer, nn.BatchNorm)]
+    assert len(bns) == 1
+
+
+def test_import_concat_channel_axis():
+    rng = np.random.RandomState(3)
+    net = Msg().string(1, "concat-net")
+    net.msg(100, _input_layer("a", (1, 2, 3, 3)))
+    net.msg(100, _input_layer("b", (1, 5, 3, 3)))
+    net.msg(100, _layer_msg("cat", "Concat", ["a", "b"], ["cat"],
+                            concat=Msg().varint(2, 1)))
+    model, variables = load_caffe(net.bytes())
+    xa = rng.randn(1, 3, 3, 2).astype(np.float32)
+    xb = rng.randn(1, 3, 3, 5).astype(np.float32)
+    y, _ = model.apply(variables, xa, xb)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.concatenate([xa, xb], axis=3))
+
+
+def test_roundtrip_sequential_cnn():
+    import jax
+
+    model = Sequential([
+        nn.Conv2D(2, 4, 3, padding=(1, 1)),
+        nn.BatchNorm(4),
+        nn.ReLU(),
+        nn.MaxPool2D(2, ceil_mode=True),
+        nn.Flatten(),
+        nn.Linear(4 * 5 * 5, 7),
+        nn.SoftMax(),
+    ])
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 10, 10, 2).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    k = [k for k in variables["state"] if "BatchNorm" in k][0]
+    variables["state"][k]["running_mean"] = rng.randn(4).astype(np.float32) * .1
+    variables["state"][k]["running_var"] = (
+        1.0 + 0.1 * rng.rand(4)).astype(np.float32)
+
+    data = save_caffe(model, variables, sample=x)
+    model2, vars2 = load_caffe(data)
+
+    y1, _ = model.apply(variables, x)
+    y2, _ = model2.apply(vars2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roundtrip_functional_residual():
+    import jax
+
+    inp = Input((6, 6, 3))
+    a = nn.Conv2D(3, 3, 3, padding=(1, 1))(inp)
+    a = nn.ReLU()(a)
+    s = nn.CAddTable()([a, inp])
+    out = nn.JoinTable(3)([s, a])
+    model = Model(inp, out)
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(1), x)
+
+    data = save_caffe(model, variables, sample=x)
+    model2, vars2 = load_caffe(data)
+    y1, _ = model.apply(variables, x)
+    y2, _ = model2.apply(vars2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_layer_raises():
+    net = Msg().string(1, "bad")
+    net.msg(100, _input_layer("data", (1, 3, 4, 4)))
+    net.msg(100, _layer_msg("crazy", "SPP", ["data"], ["crazy"]))
+    with pytest.raises(UnsupportedCaffeLayer, match="SPP"):
+        load_caffe(net.bytes())
+
+
+def test_parse_caffe_net_structure():
+    net = Msg().string(1, "mynet")
+    net.msg(100, _input_layer("data", (1, 1, 2, 2)))
+    net.msg(100, _layer_msg("r", "ReLU", ["data"], ["r"]))
+    name, layers = parse_caffe_net(net.bytes())
+    assert name == "mynet"
+    assert [l.type for l in layers] == ["Input", "ReLU"]
+    assert layers[1].bottoms == ["data"]
+
+
+def test_bn_scale_not_folded_across_inplace_relu():
+    """BN -> in-place ReLU -> Scale: gamma/beta must apply AFTER the relu."""
+    rng = np.random.RandomState(6)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    gamma = rng.randn(3).astype(np.float32)
+    beta = rng.randn(3).astype(np.float32)
+
+    net = Msg().string(1, "bn-relu-scale")
+    net.msg(100, _input_layer("data", (2, 3, 4, 4)))
+    net.msg(100, _layer_msg("bn", "BatchNorm", ["data"], ["a"],
+                            [mean, var, np.asarray([1.0], np.float32)],
+                            batch_norm=Msg().f32(3, 1e-5)))
+    net.msg(100, _layer_msg("relu", "ReLU", ["a"], ["a"]))  # in-place
+    net.msg(100, _layer_msg("sc", "Scale", ["a"], ["out"],
+                            [gamma, beta], scale=Msg().boolean(4, True)))
+    model, variables = load_caffe(net.bytes())
+    x = rng.randn(2, 4, 4, 3).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    expect = np.maximum((x - mean) / np.sqrt(var + 1e-5), 0) * gamma + beta
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_floor_pool_export_guard():
+    import jax
+
+    # 2x2/2 pool on 10x10 tiles exactly -> exportable even in floor mode
+    ok_model = Sequential([nn.MaxPool2D(2)])
+    x = np.random.RandomState(7).randn(1, 10, 10, 2).astype(np.float32)
+    v = ok_model.init(jax.random.PRNGKey(0), x)
+    m2, v2 = load_caffe(save_caffe(ok_model, v, sample=x))
+    y1, _ = ok_model.apply(v, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+    # 2x2/2 on 5x5 floor-pools to 2x2 but caffe would ceil to 3x3 -> refuse
+    bad = Sequential([nn.MaxPool2D(2)])
+    xb = np.random.RandomState(8).randn(1, 5, 5, 2).astype(np.float32)
+    vb = bad.init(jax.random.PRNGKey(0), xb)
+    with pytest.raises(UnsupportedCaffeLayer, match="ceil"):
+        save_caffe(bad, vb, sample=xb)

@@ -1,0 +1,291 @@
+"""TF GraphDef import/export tests — reference `utils/tf` loader/saver specs.
+
+Foreign-graph import is exercised against GraphDefs fabricated with the wire
+codec (no tensorflow in the image); round-trips check export→import numerics.
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.keras.engine import Input, Model
+from bigdl_tpu.nn.module import Sequential
+from bigdl_tpu.utils import proto
+from bigdl_tpu.utils import tfio
+from bigdl_tpu.utils.tfio import (
+    DT_FLOAT, GraphDefBuilder, UnsupportedTFOp, decode_tensor, encode_tensor,
+    load_tf_graph, parse_graphdef, save_tf_graph, _attr_b, _attr_s,
+    _attr_int_list, _attr_shape, _attr_type,
+)
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+def test_proto_varint_roundtrip():
+    m = proto.Msg().varint(1, 0).varint(1, 127).varint(1, 300).varint(1, -5)
+    vals = proto.repeated_ints(proto.parse(m.bytes()), 1)
+    assert vals == [0, 127, 300, -5]
+
+
+def test_proto_packed_and_fixed():
+    m = (proto.Msg().packed_ints(2, [1, 128, 16384])
+         .f32(3, 2.5).string(4, "hello"))
+    f = proto.parse(m.bytes())
+    assert proto.repeated_ints(f, 2) == [1, 128, 16384]
+    assert proto.get_f32(f, 3) == 2.5
+    assert proto.get_str(f, 4) == "hello"
+
+
+def test_proto_packed_f32():
+    m = proto.Msg().packed_f32(1, [1.0, -2.0, 0.5])
+    assert proto.repeated_f32(proto.parse(m.bytes()), 1) == [1.0, -2.0, 0.5]
+
+
+@pytest.mark.parametrize("arr", [
+    np.random.RandomState(0).randn(3, 4).astype(np.float32),
+    np.arange(6, dtype=np.int32).reshape(2, 3),
+    np.asarray(3.5, np.float32),
+    np.asarray([True, False]),
+    np.arange(4, dtype=np.int64),
+])
+def test_tensorproto_roundtrip(arr):
+    out = decode_tensor(bytes(encode_tensor(arr).buf))
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_tensorproto_scalar_splat():
+    # TF encodes constant-filled tensors as a single value + shape
+    m = (proto.Msg().varint(1, DT_FLOAT)
+         .msg(2, tfio._encode_shape((2, 2)))
+         .packed_f32(5, [7.0]))
+    out = decode_tensor(m.bytes())
+    np.testing.assert_array_equal(out, np.full((2, 2), 7.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# foreign-graph import
+# ---------------------------------------------------------------------------
+
+
+def _mlp_graphdef(w1, b1, w2):
+    g = GraphDefBuilder()
+    g.node("x", "Placeholder", dtype=_attr_type(DT_FLOAT),
+           shape=_attr_shape((-1, w1.shape[0])))
+    g.const("dense/w", w1)
+    g.const("dense/b", b1)
+    g.node("dense/MatMul", "MatMul", ["x", "dense/w"],
+           transpose_b=_attr_b(False))
+    g.node("dense/BiasAdd", "BiasAdd", ["dense/MatMul", "dense/b"])
+    g.node("relu", "Relu", ["dense/BiasAdd"])
+    g.const("out/w", w2)
+    g.node("out/MatMul", "MatMul", ["relu", "out/w"])
+    g.node("probs", "Softmax", ["out/MatMul"])
+    return g.bytes()
+
+
+def test_import_mlp_matches_numpy():
+    rng = np.random.RandomState(1)
+    w1 = rng.randn(4, 8).astype(np.float32)
+    b1 = rng.randn(8).astype(np.float32)
+    w2 = rng.randn(8, 3).astype(np.float32)
+    model, variables = load_tf_graph(_mlp_graphdef(w1, b1, w2))
+
+    # MatMul+BiasAdd folded into a single Linear with bias
+    layers = [n.layer for n in model.order if n.layer is not None]
+    linears = [l for l in layers if isinstance(l, nn.Linear)]
+    assert len(linears) == 2
+    assert linears[0].with_bias and not linears[1].with_bias
+
+    x = rng.randn(5, 4).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    h = np.maximum(x @ w1 + b1, 0.0)
+    logits = h @ w2
+    expect = np.exp(logits - logits.max(-1, keepdims=True))
+    expect /= expect.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(y), expect, atol=1e-5)
+
+
+def test_import_transpose_b_and_scalar_math():
+    rng = np.random.RandomState(2)
+    w = rng.randn(6, 4).astype(np.float32)  # stored transposed
+    g = GraphDefBuilder()
+    g.node("x", "Placeholder", dtype=_attr_type(DT_FLOAT),
+           shape=_attr_shape((-1, 4)))
+    g.const("w", w)
+    g.node("mm", "MatMul", ["x", "w"], transpose_b=_attr_b(True))
+    g.const("two", np.asarray(2.0, np.float32))
+    g.node("scaled", "Mul", ["mm", "two"])
+    g.const("one", np.asarray(1.0, np.float32))
+    g.node("shifted", "Sub", ["scaled", "one"])
+    model, variables = load_tf_graph(g.bytes())
+    x = rng.randn(3, 4).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), (x @ w.T) * 2.0 - 1.0,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_import_identity_chain_and_residual_add():
+    rng = np.random.RandomState(3)
+    w = rng.randn(4, 4).astype(np.float32)
+    g = GraphDefBuilder()
+    g.node("x", "Placeholder", dtype=_attr_type(DT_FLOAT),
+           shape=_attr_shape((-1, 4)))
+    g.const("w/raw", w)
+    g.node("w", "Identity", ["w/raw"])  # frozen graphs wrap vars in Identity
+    g.node("mm", "MatMul", ["x", "w"])
+    g.node("res", "AddV2", ["mm", "x"])  # residual: both inputs are tensors
+    model, variables = load_tf_graph(g.bytes())
+    x = rng.randn(2, 4).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), x @ w + x, rtol=1e-5, atol=1e-5)
+
+
+def test_import_unsupported_op_raises():
+    g = GraphDefBuilder()
+    g.node("x", "Placeholder", dtype=_attr_type(DT_FLOAT),
+           shape=_attr_shape((-1, 4)))
+    g.node("weird", "SomeCustomOp", ["x"])
+    with pytest.raises(UnsupportedTFOp, match="SomeCustomOp"):
+        load_tf_graph(g.bytes())
+
+
+def test_import_conv_pool_mean_graph():
+    rng = np.random.RandomState(4)
+    w = rng.randn(3, 3, 3, 8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    g = GraphDefBuilder()
+    g.node("img", "Placeholder", dtype=_attr_type(DT_FLOAT),
+           shape=_attr_shape((-1, 16, 16, 3)))
+    g.const("k", w)
+    g.const("kb", b)
+    g.node("conv", "Conv2D", ["img", "k"],
+           strides=_attr_int_list([1, 1, 1, 1]), padding=_attr_s(b"SAME"))
+    g.node("conv/bias", "BiasAdd", ["conv", "kb"])
+    g.node("act", "Relu6", ["conv/bias"])
+    g.node("pool", "MaxPool", ["act"], ksize=_attr_int_list([1, 2, 2, 1]),
+           strides=_attr_int_list([1, 2, 2, 1]), padding=_attr_s(b"VALID"))
+    g.const("axes", np.asarray([1, 2], np.int32))
+    g.node("gap", "Mean", ["pool", "axes"])
+    model, variables = load_tf_graph(g.bytes())
+    x = rng.randn(2, 16, 16, 3).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    assert np.asarray(y).shape == (2, 8)
+    # conv bias got folded
+    convs = [n.layer for n in model.order
+             if n.layer is not None and isinstance(n.layer, nn.Conv2D)]
+    assert len(convs) == 1 and convs[0].with_bias
+
+
+# ---------------------------------------------------------------------------
+# export → import round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_sequential_cnn(tmp_path):
+    import jax
+
+    model = Sequential([
+        nn.Conv2D(3, 8, 3, padding="SAME"),
+        nn.BatchNorm(8),
+        nn.ReLU(),
+        nn.MaxPool2D(2),
+        nn.Flatten(),
+        nn.Linear(8 * 8 * 8, 10),
+        nn.SoftMax(),
+    ])
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 16, 16, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    # non-trivial BN stats so the export path is actually checked
+    k = [k for k in variables["state"] if "BatchNorm" in k][0]
+    variables["state"][k]["running_mean"] = rng.randn(8).astype(np.float32) * .1
+    variables["state"][k]["running_var"] = (
+        1.0 + 0.1 * rng.rand(8)).astype(np.float32)
+
+    path = str(tmp_path / "model.pb")
+    save_tf_graph(model, variables, sample=x, path=path)
+    model2, vars2 = load_tf_graph(path)
+
+    y1, _ = model.apply(variables, x)
+    y2, _ = model2.apply(vars2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roundtrip_functional_two_branch():
+    import jax
+
+    inp = Input((12, 12, 2))
+    a = nn.Conv2D(2, 4, 3, padding="SAME")(inp)
+    a = nn.ReLU()(a)
+    b = nn.Conv2D(2, 4, 1, padding="SAME")(inp)
+    merged = nn.CAddTable()([a, b])
+    out = nn.JoinTable(3)([merged, b])
+    model = Model(inp, out)
+
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 12, 12, 2).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(1), x)
+
+    data = save_tf_graph(model, variables, sample=x)
+    model2, vars2 = load_tf_graph(data)
+
+    y1, _ = model.apply(variables, x)
+    y2, _ = model2.apply(vars2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_roundtrip_activations_and_pad():
+    import jax
+
+    model = Sequential([
+        nn.ZeroPadding2D(1),
+        nn.AvgPool2D(2, padding=0),
+        nn.Flatten(),
+        nn.Linear(2 * 9 * 9, 6),
+        nn.Tanh(),
+        nn.Dropout(0.5),
+        nn.LeakyReLU(0.1),
+        nn.LogSoftMax(),
+    ])
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 16, 16, 2).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(2), x)
+    data = save_tf_graph(model, variables, sample=x)
+    model2, vars2 = load_tf_graph(data)
+    y1, _ = model.apply(variables, x)
+    y2, _ = model2.apply(vars2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_parse_graphdef_structure():
+    g = GraphDefBuilder()
+    g.node("x", "Placeholder", dtype=_attr_type(DT_FLOAT))
+    g.const("c", np.ones((2,), np.float32))
+    g.node("y", "Relu", ["x"])
+    nodes = parse_graphdef(g.bytes())
+    assert [n.op for n in nodes] == ["Placeholder", "Const", "Relu"]
+    assert nodes[2].inputs == ["x"]
+    np.testing.assert_array_equal(nodes[1].attrs["value"].tensor,
+                                  np.ones((2,), np.float32))
+
+
+def test_import_deep_chain_no_recursion_limit():
+    """Frozen graphs routinely chain 1000+ nodes; toposort must not recurse."""
+    g = GraphDefBuilder()
+    g.node("x", "Placeholder", dtype=_attr_type(DT_FLOAT),
+           shape=_attr_shape((-1, 4)))
+    prev = "x"
+    for i in range(1500):
+        prev = g.node(f"id_{i}", "Identity", [prev])
+    g.node("out", "Relu", [prev])
+    model, variables = load_tf_graph(g.bytes())
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    y, _ = model.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), np.maximum(x, 0))
