@@ -1,0 +1,308 @@
+"""IR graph + execution-engine retargeting — ``utils/intermediate`` analog.
+
+Reference analog (unverified — mount empty):
+``utils/intermediate/{IRGraph,IRToBlas,IRToDnn}.scala`` — a built graph is
+lifted to an engine-neutral IR and re-emitted for either the ``mklblas``
+engine or the ``mkldnn`` engine, where ``nn/mkldnn/Fusion.scala`` applies
+inference rewrites (conv+bn fold, conv+relu fusion) before lowering to
+oneDNN primitives.
+
+TPU-native re-design: the two engines become
+
+- ``"xla"``   — plain catalog modules; XLA's own fuser does the elementwise
+  stitching (the mklblas analog, and the identity rebuild).
+- ``"fused"`` — inference-oriented rewrites before compilation (the mkldnn
+  ``Phase.INFERENCE`` analog):
+    * ``Conv2D → BatchNorm``  folded into the conv weights/bias
+      (``Fusion.scala`` fuseConvBn)
+    * ``Linear → BatchNorm``  folded likewise
+    * ``LayerNorm``           re-emitted as the single-pass Pallas kernel
+      (``ops.fused.fused_layernorm``)
+    * ``Dropout`` / ``Identity`` dropped (no-ops in inference)
+
+Usage::
+
+    ir = IRGraph.from_model(model, variables)      # Sequential or keras Model
+    fast, fast_vars = ir.to_model(engine="fused")  # inference-ready twin
+    same, same_vars = ir.to_model(engine="xla")    # identity rebuild
+
+The returned pair is a keras-engine functional ``Model`` + variables; the
+original model is untouched (functional discipline, like ``nn.quantized``).
+"""
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import EMPTY, Module, Sequential
+
+
+class PallasLayerNorm(Module):
+    """LayerNorm twin backed by the single-pass Pallas kernel (params are
+    interchangeable with ``nn.LayerNorm``)."""
+
+    def __init__(self, num_features: Optional[int] = None, eps: float = 1e-6,
+                 name=None):
+        super().__init__(name)
+        self.num_features = num_features
+        self.eps = eps
+
+    def build(self, rng, x):
+        c = self.num_features or x.shape[-1]
+        return {"weight": jnp.ones((c,)), "bias": jnp.zeros((c,))}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        from bigdl_tpu.ops.fused import fused_layernorm
+
+        shape = x.shape
+        x2 = x.reshape((-1, shape[-1]))
+        y = fused_layernorm(x2, params["weight"], params["bias"],
+                            eps=self.eps)
+        return y.reshape(shape), EMPTY
+
+
+class IRNode:
+    """One op in the engine-neutral graph."""
+
+    __slots__ = ("layer", "params", "state", "parents", "is_input", "uid")
+    _counter = [0]
+
+    def __init__(self, layer=None, params=None, state=None, parents=(),
+                 is_input=False):
+        IRNode._counter[0] += 1
+        self.uid = IRNode._counter[0]
+        self.layer = layer
+        self.params = dict(params or {})
+        self.state = dict(state or {})
+        self.parents: List[IRNode] = list(parents)
+        self.is_input = is_input
+
+    def __repr__(self):
+        t = "Input" if self.is_input else type(self.layer).__name__
+        return f"IRNode({t}#{self.uid})"
+
+
+class IRGraph:
+    """Engine-neutral graph of IRNodes (reference ``IRGraph.scala``)."""
+
+    def __init__(self, inputs: List[IRNode], outputs: List[IRNode],
+                 order: List[IRNode]):
+        self.inputs = inputs
+        self.outputs = outputs
+        self.order = order  # topological, inputs included
+
+    # ------------------------------------------------------------------ build
+    @staticmethod
+    def from_model(model, variables: Dict[str, Any]) -> "IRGraph":
+        from bigdl_tpu.keras.engine import Model as KModel
+
+        params = variables.get("params", EMPTY) or {}
+        state = variables.get("state", EMPTY) or {}
+        if isinstance(model, KModel):
+            by_id: Dict[int, IRNode] = {}
+            order: List[IRNode] = []
+            inputs: List[IRNode] = []
+            for node in model.order:
+                if node.layer is None:
+                    ir = IRNode(is_input=True)
+                    inputs.append(ir)
+                else:
+                    ir = IRNode(node.layer, params.get(node.name, {}),
+                                state.get(node.name, {}),
+                                [by_id[p.id] for p in node.parents])
+                by_id[node.id] = ir
+                order.append(ir)
+            outputs = [by_id[o.id] for o in model.outputs]
+            return IRGraph(inputs, outputs, order)
+        if isinstance(model, Sequential):
+            inp = IRNode(is_input=True)
+            order = [inp]
+            cur = inp
+            cur = IRGraph._chain_sequential(model, params, state, cur, order)
+            return IRGraph([inp], [cur], order)
+        raise TypeError(f"cannot lift {type(model).__name__} to IR")
+
+    @staticmethod
+    def _chain_sequential(seq: Sequential, params, state, cur, order):
+        for i, child in enumerate(seq.layers):
+            k = seq._key(i)
+            cp = params.get(k, EMPTY) if params else EMPTY
+            cs = state.get(k, EMPTY) if state else EMPTY
+            if isinstance(child, Sequential):
+                cur = IRGraph._chain_sequential(child, cp, cs, cur, order)
+            else:
+                node = IRNode(child, cp, cs, [cur])
+                order.append(node)
+                cur = node
+        return cur
+
+    # ------------------------------------------------------------ retargeting
+    def to_model(self, engine: str = "xla"):
+        """Emit a (keras Model, variables) pair for the given engine."""
+        if engine not in ("xla", "fused"):
+            raise ValueError(f"unknown engine {engine!r}: 'xla' or 'fused'")
+        nodes = list(self.order)
+        outputs = list(self.outputs)
+        if engine == "fused":
+            nodes, outputs = _fuse_pass(nodes, outputs)
+        return _emit(self.inputs, nodes, outputs)
+
+
+# ---------------------------------------------------------------------------
+# fusion pass (reference nn/mkldnn/Fusion.scala, inference phase)
+# ---------------------------------------------------------------------------
+
+
+def _consumer_counts(nodes: List[IRNode]) -> Dict[int, int]:
+    c: Dict[int, int] = {}
+    for n in nodes:
+        for p in n.parents:
+            c[p.uid] = c.get(p.uid, 0) + 1
+    return c
+
+
+def _fuse_pass(nodes: List[IRNode], outputs: List[IRNode]):
+    from bigdl_tpu.nn import layers as L
+    from bigdl_tpu.nn.module import Identity
+
+    nodes = list(nodes)
+    outputs = list(outputs)
+    out_ids = {o.uid for o in outputs}
+
+    # 1. drop inference no-ops (Dropout, Identity) by rewiring consumers
+    drop = {}
+    for n in nodes:
+        if n.layer is not None and isinstance(n.layer,
+                                              (L.Dropout, Identity)) \
+                and len(n.parents) == 1:
+            drop[n.uid] = n.parents[0]
+    if drop:
+        def resolve(p: IRNode) -> IRNode:
+            while p.uid in drop:
+                p = drop[p.uid]
+            return p
+        for n in nodes:
+            n.parents = [resolve(p) for p in n.parents]
+        outputs = [resolve(o) for o in outputs]
+        out_ids = {o.uid for o in outputs}
+        nodes = [n for n in nodes if n.uid not in drop]
+
+    # 2. fold BatchNorm into a preceding single-consumer Conv2D/Linear
+    counts = _consumer_counts(nodes)
+    folded: Dict[int, IRNode] = {}
+    for n in nodes:
+        if n.uid in folded:
+            continue
+        lay = n.layer
+        if lay is None or not isinstance(lay, L.BatchNorm):
+            continue
+        if len(n.parents) != 1:
+            continue
+        prod = n.parents[0]
+        if prod.uid in folded or prod.layer is None:
+            continue
+        if not isinstance(prod.layer, (L.Conv2D, L.Linear)):
+            continue
+        if type(prod.layer) not in (L.Conv2D, L.Linear):
+            continue  # exact types only: subclasses may scale differently
+        if counts.get(prod.uid, 0) != 1 or prod.uid in out_ids:
+            continue
+        if not n.state:
+            continue
+        mean = np.asarray(n.state["running_mean"], np.float64)
+        var = np.asarray(n.state["running_var"], np.float64)
+        eps = lay.eps
+        if lay.affine:
+            gamma = np.asarray(n.params["weight"], np.float64)
+            beta = np.asarray(n.params["bias"], np.float64)
+        else:
+            gamma = np.ones_like(mean)
+            beta = np.zeros_like(mean)
+        scale = gamma / np.sqrt(var + eps)  # per-out-channel
+
+        new = copy.copy(prod)
+        new.params = dict(prod.params)
+        w = np.asarray(prod.params["weight"], np.float64)
+        # Conv2D weight (kh,kw,cin,cout), Linear weight (in,out): the out
+        # channel is the LAST axis for both
+        new.params["weight"] = (w * scale).astype(np.float32)
+        old_bias = (np.asarray(prod.params["bias"], np.float64)
+                    if prod.layer.with_bias else 0.0)
+        new_bias = ((old_bias - mean) * scale + beta).astype(np.float32)
+        if not prod.layer.with_bias:
+            new.layer = copy.copy(prod.layer)
+            new.layer.with_bias = True
+        new.params["bias"] = new_bias
+        folded[prod.uid] = new
+        folded[n.uid] = new  # BN node itself resolves to the fused conv
+
+    if folded:
+        def resolve2(p: IRNode) -> IRNode:
+            seen = set()
+            while p.uid in folded and p.uid not in seen:
+                seen.add(p.uid)
+                p = folded[p.uid]
+            return p
+        new_nodes = []
+        emitted = set()
+        for n in nodes:
+            r = resolve2(n)
+            if r.uid in emitted:
+                continue
+            if n.uid in folded and folded[n.uid] is not r:
+                continue
+            r.parents = [resolve2(p) for p in r.parents]
+            new_nodes.append(r)
+            emitted.add(r.uid)
+        # BN nodes resolve to their fused producer; drop originals
+        nodes = [n for n in new_nodes
+                 if not (n.uid in folded and folded[n.uid] is not n)]
+        outputs = [resolve2(o) for o in outputs]
+
+    # 3. LayerNorm -> Pallas kernel twin
+    for n in nodes:
+        if n.layer is not None and type(n.layer).__name__ == "LayerNorm":
+            ln = n.layer
+            n.layer = PallasLayerNorm(ln.num_features, eps=ln.eps,
+                                      name=ln.name)
+
+    return nodes, outputs
+
+
+# ---------------------------------------------------------------------------
+# emission
+# ---------------------------------------------------------------------------
+
+
+def _emit(ir_inputs: List[IRNode], nodes: List[IRNode],
+          ir_outputs: List[IRNode]):
+    from bigdl_tpu.keras.engine import Input, Model
+
+    sym: Dict[int, Any] = {}
+    k_inputs = []
+    for ir in ir_inputs:
+        node = Input(None)
+        sym[ir.uid] = node
+        k_inputs.append(node)
+
+    params: Dict[str, Dict] = {}
+    state: Dict[str, Dict] = {}
+    for ir in nodes:
+        if ir.is_input:
+            continue
+        # fresh layer copy so the emitted model shares nothing mutable
+        layer = copy.copy(ir.layer)
+        parents = [sym[p.uid] for p in ir.parents]
+        node = layer(parents[0] if len(parents) == 1 else parents)
+        sym[ir.uid] = node
+        if ir.params:
+            params[node.name] = {k: jnp.asarray(v)
+                                 for k, v in ir.params.items()}
+        if ir.state:
+            state[node.name] = {k: jnp.asarray(v)
+                                for k, v in ir.state.items()}
+    outputs = [sym[o.uid] for o in ir_outputs]
+    model = Model(k_inputs, outputs, name="IRModel")
+    return model, {"params": params, "state": state}

@@ -1,0 +1,182 @@
+"""IR retargeting tests — reference `utils/intermediate` IRGraph/IRToDnn
+specs + `nn/mkldnn/Fusion.scala` conv+bn folding."""
+
+import jax
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.keras.engine import Input, Model
+from bigdl_tpu.nn.module import Sequential
+from bigdl_tpu.utils.intermediate import IRGraph, PallasLayerNorm
+
+
+def _bn_with_stats(variables, rng, c):
+    k = [k for k in variables["state"] if "BatchNorm" in k][0]
+    variables["state"][k]["running_mean"] = rng.randn(c).astype(np.float32) * .2
+    variables["state"][k]["running_var"] = (
+        1.0 + 0.3 * rng.rand(c)).astype(np.float32)
+    return variables
+
+
+def test_xla_engine_identity_rebuild():
+    model = Sequential([
+        nn.Conv2D(2, 4, 3, padding="SAME"),
+        nn.BatchNorm(4),
+        nn.ReLU(),
+        nn.Flatten(),
+        nn.Linear(4 * 6 * 6, 5),
+    ])
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 6, 2).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 4)
+
+    ir = IRGraph.from_model(model, variables)
+    m2, v2 = ir.to_model("xla")
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_engine_folds_conv_bn_and_drops_dropout():
+    model = Sequential([
+        nn.Conv2D(2, 4, 3, padding="SAME"),
+        nn.BatchNorm(4),
+        nn.ReLU(),
+        nn.Dropout(0.5),
+        nn.Flatten(),
+        nn.Linear(4 * 6 * 6, 5),
+    ])
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 6, 6, 2).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 4)
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("fused")
+    layers = [n.layer for n in m2.order if n.layer is not None]
+    assert not any(isinstance(l, nn.BatchNorm) for l in layers)
+    assert not any(isinstance(l, nn.Dropout) for l in layers)
+
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_conv_without_bias_gains_folded_bias():
+    model = Sequential([
+        nn.Conv2D(3, 6, 3, padding="SAME", with_bias=False),
+        nn.BatchNorm(6),
+    ])
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 5, 5, 3).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 6)
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("fused")
+    convs = [n for n in m2.order
+             if n.layer is not None and isinstance(n.layer, nn.Conv2D)]
+    assert len(convs) == 1 and convs[0].layer.with_bias
+    assert "bias" in v2["params"][convs[0].name]
+
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_linear_bn_fold():
+    model = Sequential([
+        nn.Linear(8, 6),
+        nn.BatchNorm(6),
+        nn.Tanh(),
+    ])
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 8).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 6)
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("fused")
+    assert not any(isinstance(n.layer, nn.BatchNorm)
+                   for n in m2.order if n.layer is not None)
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bn_not_folded_when_conv_has_two_consumers():
+    inp = Input((5, 5, 3))
+    conv = nn.Conv2D(3, 3, 3, padding="SAME")(inp)
+    bn = nn.BatchNorm(3)(conv)
+    out = nn.CAddTable()([bn, conv])  # conv feeds both bn and the skip
+    model = Model(inp, out)
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 5, 5, 3).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 3)
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("fused")
+    layers = [n.layer for n in m2.order if n.layer is not None]
+    assert any(isinstance(l, nn.BatchNorm) for l in layers)  # kept
+
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_residual_graph_matches():
+    inp = Input((6, 6, 4))
+    a = nn.Conv2D(4, 4, 3, padding="SAME", with_bias=False)(inp)
+    b = nn.BatchNorm(4)(a)
+    r = nn.ReLU()(b)
+    s = nn.CAddTable()([r, inp])
+    model = Model(inp, s)
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 6, 6, 4).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 4)
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("fused")
+    layers = [n.layer for n in m2.order if n.layer is not None]
+    assert not any(isinstance(l, nn.BatchNorm) for l in layers)
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_retargets_to_pallas_twin():
+    model = Sequential([
+        nn.Linear(16, 16),
+        nn.LayerNorm(16),
+        nn.GELU(),
+    ])
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 16).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    # non-trivial gamma/beta
+    k = [k for k in variables["params"] if "LayerNorm" in k][0]
+    variables["params"][k]["weight"] = (
+        1 + 0.1 * rng.randn(16)).astype(np.float32)
+    variables["params"][k]["bias"] = rng.randn(16).astype(np.float32) * .1
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("fused")
+    assert any(isinstance(n.layer, PallasLayerNorm)
+               for n in m2.order if n.layer is not None)
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ir_from_functional_multi_output():
+    inp = Input((4,))
+    h = nn.Linear(4, 8)(inp)
+    o1 = nn.ReLU()(h)
+    o2 = nn.Tanh()(h)
+    model = Model(inp, [o1, o2])
+    x = np.random.RandomState(7).randn(3, 4).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    m2, v2 = IRGraph.from_model(model, variables).to_model("xla")
+    (a1, a2), _ = model.apply(variables, x)
+    (b1, b2), _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(b1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(a2), np.asarray(b2), rtol=1e-5)
