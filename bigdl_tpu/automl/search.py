@@ -217,8 +217,10 @@ class TPESearcher(Searcher):
             if isinstance(v, hp_mod.LogUniform):
                 return float(np.exp(np.clip(t, v.lower, v.upper)))
             if isinstance(v, hp_mod.QUniform):
-                return float(np.clip(np.round(t / v.q) * v.q,
-                                     v.lower, v.upper))
+                # clamp into the sampling interval BEFORE rounding so the
+                # result stays on the q-grid exactly like QUniform.sample
+                return float(np.round(np.clip(t, v.lower, v.upper) / v.q)
+                             * v.q)
             if isinstance(v, hp_mod.Uniform):
                 return float(np.clip(t, v.lower, v.upper))
             if isinstance(v, hp_mod.RandInt):

@@ -70,7 +70,7 @@ def test_special_fns():
 BINARY_CASES = [
     (nn.Maximum, np.maximum),
     (nn.Minimum, np.minimum),
-    (nn.Mod, np.mod),
+    (nn.Mod, np.fmod),  # TF raw-op Mod: truncated (C) semantics
     (nn.FloorDiv, np.floor_divide),
     (nn.Atan2, np.arctan2),
     (nn.SquaredDifference, lambda a, b: (a - b) ** 2),
