@@ -41,8 +41,14 @@ from bigdl_tpu.nn.layers_more import (
 from bigdl_tpu.nn import ops_layers as ops_layers  # noqa: F401
 from bigdl_tpu.nn.ops_layers import *  # noqa: F401,F403 — TF-op tranche (nn/ops)
 from bigdl_tpu.nn.sparse_layers import SparseLinear, SparseJoinTable
+from bigdl_tpu.nn.layers_misc import (
+    LookupTableSparse, SpatialWithinChannelLRN, NormalizeScale, Echo,
+    RoiPooling, SpatialShareConvolution, SpatialDilatedConvolution,
+    CTCCriterion, ClassSimplexCriterion, WeightedMSECriterion,
+)
 from bigdl_tpu.nn.rnn import (
-    SimpleRNN, LSTM, GRU, BiRecurrent, TimeDistributed, RecurrentDecoder,
+    SimpleRNN, LSTM, LSTMPeephole, GRU, BiRecurrent, TimeDistributed,
+    RecurrentDecoder,
 )
 from bigdl_tpu.nn.decode import beam_search, greedy_decode, DecodeResult
 from bigdl_tpu.nn.attention import (

@@ -1,0 +1,261 @@
+"""Catalog tranche: remaining notable reference layers and criterions.
+
+Reference analogs (unverified — mount empty): ``dllib/nn/{LookupTableSparse,
+SpatialWithinChannelLRN,NormalizeScale,Echo,RoiPooling,SpatialShareConvolution,
+SpatialDilatedConvolution}.scala`` and ``dllib/nn/{CTCCriterion,
+ClassSimplexCriterion,WeightedMSECriterion}.scala``.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn import init as init_mod
+from bigdl_tpu.nn.criterion import Criterion, _reduce
+from bigdl_tpu.nn.layers import Conv2D
+from bigdl_tpu.nn.module import EMPTY, Module
+
+__all__ = [
+    "LookupTableSparse", "SpatialWithinChannelLRN", "NormalizeScale", "Echo",
+    "RoiPooling", "SpatialShareConvolution", "SpatialDilatedConvolution",
+    "CTCCriterion", "ClassSimplexCriterion", "WeightedMSECriterion",
+]
+
+
+# SpatialShareConvolution exists in the reference purely to share im2col
+# buffers between clones; SpatialDilatedConvolution is Conv2D's dilation
+# parameter.  Both lower to the same XLA convolution here.
+SpatialShareConvolution = Conv2D
+SpatialDilatedConvolution = Conv2D
+
+
+class LookupTableSparse(Module):
+    """Embedding lookup over a 2-D COO ``SparseTensor`` of ids with a
+    combiner — reference ``nn/LookupTableSparse.scala`` (combiner
+    sum | mean | sqrtn, TF ``embedding_lookup_sparse`` semantics).
+    Optional second SparseTensor carries per-id weights."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 combiner: str = "sum", pad_id: int = -1,
+                 weight_init=init_mod.random_normal(0.0, 1.0), name=None):
+        super().__init__(name)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner {combiner!r}: sum | mean | sqrtn")
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.combiner = combiner
+        # entries whose id == pad_id are ignored: capacity-padded id tensors
+        # must pad with pad_id, NOT 0 (0 is a legitimate 0-based id here —
+        # SparseTensor.from_dense's zero-padding is only inert when values
+        # are multipliers, which ids are not)
+        self.pad_id = pad_id
+        self.weight_init = weight_init
+
+    def build(self, rng, ids, weights=None):
+        shape = (self.num_embeddings, self.embedding_dim)
+        return {"weight": self.weight_init(
+            rng, shape, self.num_embeddings, self.embedding_dim)}, EMPTY
+
+    def forward(self, params, state, ids, weights=None, training=False,
+                rng=None):
+        table = params["weight"]
+        rows = ids.indices[:, 0]
+        vals = ids.values.astype(jnp.int32)
+        valid = (vals != self.pad_id)
+        emb = jnp.take(table, jnp.maximum(vals, 0), axis=0)  # (nnz, D)
+        w = weights.values.astype(emb.dtype)[:, None] if weights is not None \
+            else jnp.ones((emb.shape[0], 1), emb.dtype)
+        w = w * valid[:, None].astype(emb.dtype)
+        n_rows = ids.shape[0]
+        summed = jax.ops.segment_sum(emb * w, rows, num_segments=n_rows)
+        if self.combiner == "sum":
+            return summed, EMPTY
+        counts = jax.ops.segment_sum(
+            w[:, 0] if weights is not None
+            else valid.astype(emb.dtype),
+            rows, num_segments=n_rows)
+        if self.combiner == "mean":
+            return summed / jnp.maximum(counts, 1e-12)[:, None], EMPTY
+        sq = jax.ops.segment_sum(w[:, 0] ** 2, rows, num_segments=n_rows)
+        return summed / jnp.sqrt(jnp.maximum(sq, 1e-12))[:, None], EMPTY
+
+
+class SpatialWithinChannelLRN(Module):
+    """Within-channel local response normalization — reference
+    ``nn/SpatialWithinChannelLRN.scala`` (caffe WITHIN_CHANNEL):
+    ``y = x / (1 + alpha/size^2 * spatial_window_sum(x^2))^beta`` (NHWC)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, name=None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def forward(self, params, state, x, training=False, rng=None):
+        half = self.size // 2
+        pads = [(0, 0), (half, self.size - 1 - half),
+                (half, self.size - 1 - half), (0, 0)]
+        window = jax.lax.reduce_window(
+            x * x, 0.0, jax.lax.add, (1, self.size, self.size, 1),
+            (1, 1, 1, 1), pads)
+        den = (1.0 + self.alpha / (self.size ** 2) * window) ** self.beta
+        return x / den, EMPTY
+
+
+class NormalizeScale(Module):
+    """L2-normalize across channels then multiply by a learnable per-channel
+    scale — reference ``nn/NormalizeScale.scala`` (the SSD conv4_3 trick)."""
+
+    def __init__(self, num_features: Optional[int] = None,
+                 scale: float = 1.0, eps: float = 1e-10, name=None):
+        super().__init__(name)
+        self.num_features = num_features
+        self.scale = scale
+        self.eps = eps
+
+    def build(self, rng, x):
+        c = self.num_features or x.shape[-1]
+        return {"weight": jnp.full((c,), float(self.scale))}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + self.eps)
+        return x / norm * params["weight"], EMPTY
+
+
+class Echo(Module):
+    """Identity that prints its input shape (and optionally values) when the
+    compiled program runs — reference ``nn/Echo.scala`` debug layer, via
+    ``jax.debug.print`` so it works under jit."""
+
+    def __init__(self, message: str = "", print_values: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.message = message
+        self.print_values = print_values
+
+    def forward(self, params, state, x, training=False, rng=None):
+        tag = self.message or self.name
+        if self.print_values:
+            jax.debug.print("{m} shape={s} x={x}", m=tag, s=str(x.shape), x=x)
+        else:
+            jax.debug.print("{m} shape={s}", m=tag, s=str(x.shape))
+        return x, EMPTY
+
+
+class RoiPooling(Module):
+    """RoI max pooling — reference ``nn/RoiPooling.scala`` (Fast-RCNN).
+    Input: feature map (H, W, C) + boxes (N, 4) ``[x1, y1, x2, y2]`` in
+    image coordinates; output (N, S, S, C).  Each bin max-pools a grid of
+    ``sampling_ratio``² bilinear samples (static shapes; the quantized-bin
+    loops of the reference are replaced by a dense sampling grid, which is
+    the TPU-friendly form and matches RoIAlign-style sampling)."""
+
+    def __init__(self, output_size: int, spatial_scale: float = 1.0,
+                 sampling_ratio: int = 2, name=None):
+        super().__init__(name)
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+
+    def forward(self, params, state, feat, boxes, training=False, rng=None):
+        s = self.output_size
+        r = self.sampling_ratio
+        feat = jnp.asarray(feat)
+        h, w, c = feat.shape
+        boxes = jnp.asarray(boxes) * self.spatial_scale
+
+        def one_box(box):
+            x1, y1, x2, y2 = box
+            bw = jnp.maximum(x2 - x1, 1.0)
+            bh = jnp.maximum(y2 - y1, 1.0)
+            # r*s sample centers per axis
+            gy = y1 + (jnp.arange(s * r) + 0.5) * bh / (s * r)
+            gx = x1 + (jnp.arange(s * r) + 0.5) * bw / (s * r)
+            yy = jnp.clip(gy, 0.0, h - 1.0)
+            xx = jnp.clip(gx, 0.0, w - 1.0)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            wy = (yy - y0)[:, None, None]
+            wx = (xx - x0)[None, :, None]
+            f00 = feat[y0][:, x0]
+            f01 = feat[y0][:, x1i]
+            f10 = feat[y1i][:, x0]
+            f11 = feat[y1i][:, x1i]
+            samp = (f00 * (1 - wy) * (1 - wx) + f01 * (1 - wy) * wx
+                    + f10 * wy * (1 - wx) + f11 * wy * wx)  # (sr, sr, C)
+            # max over each r x r sampling block
+            samp = samp.reshape(s, r, s, r, c)
+            return jnp.max(samp, axis=(1, 3))
+
+        return jax.vmap(one_box)(boxes), EMPTY
+
+
+# ---------------------------------------------------------------------------
+# criterions
+# ---------------------------------------------------------------------------
+
+
+class CTCCriterion(Criterion):
+    """Connectionist temporal classification loss — reference
+    ``nn/CTCCriterion.scala`` (warp-CTC backed there; optax forward-backward
+    here).
+
+    ``forward(logits, target)`` with logits (B, T, C) UNnormalized and
+    ``target = (labels, input_lengths, label_lengths)``; labels (B, S)
+    0-padded, blank id = ``blank`` (default 0, so real labels start at 1
+    when blank is 0)."""
+
+    def __init__(self, blank: int = 0, size_average: bool = True):
+        self.blank = blank
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        import optax
+
+        labels, input_lengths, label_lengths = target
+        b, t, _ = input.shape
+        s = labels.shape[1]
+        logit_pad = (jnp.arange(t)[None, :]
+                     >= jnp.asarray(input_lengths)[:, None]).astype(jnp.float32)
+        label_pad = (jnp.arange(s)[None, :]
+                     >= jnp.asarray(label_lengths)[:, None]).astype(jnp.float32)
+        per_example = optax.ctc_loss(input, logit_pad,
+                                     jnp.asarray(labels).astype(jnp.int32),
+                                     label_pad, blank_id=self.blank)
+        return _reduce(per_example, self.size_average)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE regression onto regular-simplex class embeddings — reference
+    ``nn/ClassSimplexCriterion.scala``.  The n class vertices are unit
+    vectors in R^n with pairwise inner product -1/(n-1)
+    (rows of sqrt(n/(n-1)) * (I - J/n))."""
+
+    def __init__(self, n_classes: int, size_average: bool = True):
+        if n_classes < 2:
+            raise ValueError("need >= 2 classes")
+        self.n_classes = n_classes
+        self.size_average = size_average
+        n = n_classes
+        m = np.sqrt(n / (n - 1.0)) * (np.eye(n) - np.ones((n, n)) / n)
+        self.simplex = jnp.asarray(m, jnp.float32)
+
+    def forward(self, input, target):
+        tgt = self.simplex[target.astype(jnp.int32)]
+        return _reduce(jnp.mean((input - tgt) ** 2, axis=-1),
+                       self.size_average)
+
+
+class WeightedMSECriterion(Criterion):
+    """Per-element weighted MSE — reference ``nn/WeightedMSECriterion.scala``
+    (``target`` is ``(y, weights)``)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        y, w = target
+        return _reduce(w * (input - y) ** 2, self.size_average)

@@ -157,6 +157,34 @@ class LSTM(_RNNBase):
         return carry[0]
 
 
+class LSTMPeephole(LSTM):
+    """LSTM with peephole connections — reference ``nn/LSTMPeephole.scala``:
+    input/forget gates see the previous cell state and the output gate sees
+    the new one, through learnable diagonal (per-unit) peephole weights."""
+
+    def build(self, rng, x):
+        params, state = super().build(rng, x)
+        h = self.hidden_size
+        params["peep"] = jnp.zeros((3, h))  # rows: i, f, o
+        return params, state
+
+    def _step(self, params, carry, x_proj):
+        h_prev, c_prev = carry
+        wr = cast_compute(params["w_rec"])
+        gates = x_proj + jnp.matmul(
+            cast_compute(h_prev), wr,
+            preferred_element_type=jnp.float32).astype(h_prev.dtype)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        peep = params["peep"].astype(c_prev.dtype)  # keep the scan carry dtype
+        i = jax.nn.sigmoid(i + peep[0] * c_prev)
+        f = jax.nn.sigmoid(f + peep[1] * c_prev)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(o + peep[2] * c)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+
 class GRU(_RNNBase):
     """GRU — reference ``dllib/nn/GRU.scala`` (gate order r,z,n)."""
 

@@ -1,0 +1,269 @@
+"""Tranche-4 layer/criterion tests — golden-oracle parity vs torch where a
+torch twin exists (the reference's Torch7-parity spec pattern, SURVEY.md §5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.tensor.sparse import SparseTensor
+
+
+def test_lookup_table_sparse_combiners():
+    rng = np.random.RandomState(0)
+    table = rng.randn(10, 4).astype(np.float32)
+    # batch of 3 rows: row0 has ids [1, 2], row1 has [5], row2 has [7, 7, 3]
+    indices = np.array([[0, 0], [0, 1], [1, 0], [2, 0], [2, 1], [2, 2]])
+    ids = np.array([1, 2, 5, 7, 7, 3], np.float32)
+    sp = SparseTensor(indices, ids, (3, 3))
+
+    for combiner in ("sum", "mean", "sqrtn"):
+        layer = nn.LookupTableSparse(10, 4, combiner=combiner)
+        variables = layer.init(jax.random.PRNGKey(0), sp)
+        variables["params"]["weight"] = jnp.asarray(table)
+        y, _ = layer.apply(variables, sp)
+        rows = [table[[1, 2]], table[[5]], table[[7, 7, 3]]]
+        if combiner == "sum":
+            expect = np.stack([r.sum(0) for r in rows])
+        elif combiner == "mean":
+            expect = np.stack([r.mean(0) for r in rows])
+        else:
+            expect = np.stack([r.sum(0) / np.sqrt(len(r)) for r in rows])
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_lookup_table_sparse_weighted():
+    rng = np.random.RandomState(1)
+    table = rng.randn(6, 3).astype(np.float32)
+    indices = np.array([[0, 0], [0, 1], [1, 0]])
+    sp = SparseTensor(indices, np.array([2, 4, 1], np.float32), (2, 2))
+    wts = SparseTensor(indices, np.array([0.5, 2.0, 3.0], np.float32), (2, 2))
+    layer = nn.LookupTableSparse(6, 3, combiner="sum")
+    variables = layer.init(jax.random.PRNGKey(0), sp)
+    variables["params"]["weight"] = jnp.asarray(table)
+    y, _ = layer.apply(variables, sp, wts)
+    expect = np.stack([0.5 * table[2] + 2.0 * table[4], 3.0 * table[1]])
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_within_channel_lrn_matches_caffe_formula():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)
+    size, alpha, beta = 3, 2.0, 0.75
+    layer = nn.SpatialWithinChannelLRN(size, alpha, beta)
+    y, _ = layer.apply({"params": {}, "state": {}}, x)
+
+    pad = size // 2
+    xp = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    expect = np.empty_like(x)
+    for i in range(6):
+        for j in range(6):
+            win = xp[:, i:i + size, j:j + size, :]
+            ssum = (win ** 2).sum(axis=(1, 2))
+            expect[:, i, j, :] = x[:, i, j, :] / (
+                1 + alpha / size ** 2 * ssum) ** beta
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_normalize_scale():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 4, 8).astype(np.float32)
+    layer = nn.NormalizeScale(8, scale=20.0)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    y, _ = layer.apply(variables, x)
+    expect = x / np.sqrt((x ** 2).sum(-1, keepdims=True) + 1e-10) * 20.0
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pooling_shapes_and_max_semantics():
+    rng = np.random.RandomState(4)
+    feat = rng.rand(16, 16, 5).astype(np.float32)
+    boxes = np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32)
+    layer = nn.RoiPooling(output_size=4, spatial_scale=1.0)
+    y, _ = layer.apply({"params": {}, "state": {}}, feat, boxes)
+    y = np.asarray(y)
+    assert y.shape == (2, 4, 4, 5)
+    # pooled values are bounded by the box-region max
+    region = feat[0:9, 0:9]
+    assert (y[0] <= region.max(axis=(0, 1)) + 1e-5).all()
+    assert y.max() <= feat.max() + 1e-5
+
+
+def test_lstm_peephole_runs_and_uses_peepholes():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 7, 4).astype(np.float32)
+    layer = nn.LSTMPeephole(4, 6)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    assert variables["params"]["peep"].shape == (3, 6)
+    y0, _ = layer.apply(variables, x)
+    assert np.asarray(y0).shape == (2, 7, 6)
+    # non-zero peepholes change the output (they're actually wired in)
+    variables["params"]["peep"] = variables["params"]["peep"] + 0.5
+    y1, _ = layer.apply(variables, x)
+    assert not np.allclose(np.asarray(y0), np.asarray(y1))
+
+
+def test_ctc_criterion_torch_parity():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(6)
+    B, T, C, S = 3, 12, 7, 5
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = rng.randint(1, C, size=(B, S)).astype(np.int32)
+    input_lengths = np.array([12, 10, 8])
+    label_lengths = np.array([5, 3, 2])
+    labels_padded = labels.copy()
+    for b, L in enumerate(label_lengths):
+        labels_padded[b, L:] = 0
+
+    crit = nn.CTCCriterion(blank=0, size_average=False)
+    loss = crit(jnp.asarray(logits),
+                (labels_padded, input_lengths, label_lengths))
+
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1).transpose(0, 1)
+    tloss = torch.nn.CTCLoss(blank=0, reduction="sum")(
+        lp, torch.tensor(labels_padded.astype(np.int64)),
+        torch.tensor(input_lengths), torch.tensor(label_lengths))
+    np.testing.assert_allclose(float(loss), float(tloss), rtol=1e-4)
+
+
+def test_ctc_criterion_differentiable():
+    rng = np.random.RandomState(7)
+    logits = jnp.asarray(rng.randn(2, 6, 5).astype(np.float32))
+    labels = np.array([[1, 2, 0], [3, 0, 0]], np.int32)
+    crit = nn.CTCCriterion()
+
+    g = jax.grad(lambda lg: crit(lg, (labels, np.array([6, 5]),
+                                      np.array([2, 1]))))(logits)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_class_simplex_criterion_geometry():
+    crit = nn.ClassSimplexCriterion(4)
+    m = np.asarray(crit.simplex)
+    np.testing.assert_allclose((m ** 2).sum(-1), np.ones(4), atol=1e-6)
+    gram = m @ m.T
+    off = gram[~np.eye(4, dtype=bool)]
+    np.testing.assert_allclose(off, -1 / 3, atol=1e-6)
+
+    # loss is zero exactly at the class vertex
+    x = jnp.asarray(m[[2, 0]])
+    assert float(crit(x, jnp.asarray([2, 0]))) < 1e-10
+    assert float(crit(x, jnp.asarray([1, 3]))) > 0.1
+
+
+def test_weighted_mse_torch_parity():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(8)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(4, 5).astype(np.float32)
+    w = rng.rand(4, 5).astype(np.float32)
+    crit = nn.WeightedMSECriterion()
+    ours = float(crit(jnp.asarray(x), (jnp.asarray(y), jnp.asarray(w))))
+    ref = float((torch.tensor(w) * (torch.tensor(x) - torch.tensor(y)) ** 2)
+                .mean())
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_echo_is_identity(capsys):
+    x = np.ones((2, 3), np.float32)
+    layer = nn.Echo("probe")
+    y, _ = layer.apply({"params": {}, "state": {}}, x)
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_dilated_share_conv_aliases():
+    assert nn.SpatialDilatedConvolution is nn.Conv2D
+    assert nn.SpatialShareConvolution is nn.Conv2D
+
+
+def test_nn_image_reader_and_imageframe_read(tmp_path):
+    from PIL import Image
+
+    from bigdl_tpu.nnframes import NNImageReader
+
+    rng = np.random.RandomState(9)
+    for i in range(3):
+        arr = rng.randint(0, 255, size=(10 + i, 12, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.png")
+
+    df = NNImageReader.read_images(str(tmp_path / "*.png"), resize=8)
+    assert len(df) == 3
+    assert all(im.shape == (8, 8, 3) for im in df["image"])
+    assert df["origin"][0].endswith("img_0.png")
+    assert list(df["n_channels"]) == [3, 3, 3]
+
+
+def test_prediction_service_concurrent_and_error_contract():
+    import threading
+
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim import PredictionService
+
+    model = Sequential([nn.Linear(4, 2)])
+    x = np.random.RandomState(10).randn(8, 4).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    svc = PredictionService(model, variables, n_replicas=2)
+
+    expect, _ = model.apply(variables, x)
+    results = [None] * 8
+    def worker(i):
+        results[i] = svc.predict(x)
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in results:
+        np.testing.assert_allclose(r, np.asarray(expect), rtol=1e-5,
+                                   atol=1e-6)
+
+    out, err = svc.try_predict(np.ones((2, 999), np.float32))  # bad shape
+    assert out is None and err is not None
+
+
+def test_lstm_peephole_bf16_carry():
+    x = jnp.asarray(np.random.RandomState(11).randn(2, 5, 4),
+                    jnp.bfloat16)
+    layer = nn.LSTMPeephole(4, 3)
+    variables = layer.init(jax.random.PRNGKey(0), np.zeros((2, 5, 4),
+                                                           np.float32))
+    y, _ = layer.apply(variables, x)
+    assert np.asarray(y).shape == (2, 5, 3)
+
+
+def test_echo_message_with_braces():
+    layer = nn.Echo("gate {0}")
+    y, _ = layer.apply({"params": {}, "state": {}},
+                       np.ones((2, 2), np.float32))
+    np.testing.assert_array_equal(np.asarray(y), np.ones((2, 2)))
+
+
+def test_imageframe_read_label_mismatch_raises(tmp_path):
+    from PIL import Image
+
+    from bigdl_tpu.data.vision import ImageFrame
+
+    for i in range(2):
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+            tmp_path / f"a_{i}.png")
+    with pytest.raises(ValueError, match="labels for"):
+        ImageFrame.read(str(tmp_path / "*.png"), labels=[0])
+
+
+def test_lookup_table_sparse_pad_id_ignored():
+    table = np.arange(12, dtype=np.float32).reshape(4, 3)
+    # row0: ids [1, pad]; row1: ids [0] (id 0 is REAL in 0-based indexing)
+    indices = np.array([[0, 0], [0, 1], [1, 0]])
+    sp = SparseTensor(indices, np.array([1, -1, 0], np.float32), (2, 2))
+    for combiner, expect in (
+            ("sum", np.stack([table[1], table[0]])),
+            ("mean", np.stack([table[1], table[0]]))):
+        layer = nn.LookupTableSparse(4, 3, combiner=combiner)
+        variables = layer.init(jax.random.PRNGKey(0), sp)
+        variables["params"]["weight"] = jnp.asarray(table)
+        y, _ = layer.apply(variables, sp)
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-6)
