@@ -14,6 +14,7 @@ threaded pass per minibatch that writes straight into the contiguous
 NHWC float32 batch handed to the device.
 """
 
+import os
 import math
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -67,6 +68,34 @@ class ImageFrame:
         labels = labels if labels is not None else [None] * len(images)
         return ImageFrame([ImageFeature(im, lb)
                            for im, lb in zip(images, labels)])
+
+    @staticmethod
+    def read(paths, labels=None) -> "ImageFrame":
+        """Decode image files (host CPU, PIL) — reference
+        ``ImageFrame.read``/``NNImageReader`` ingestion.  ``paths`` is a
+        list of file paths, a directory, or a glob pattern; images come out
+        HWC uint8 RGB."""
+        from PIL import Image as _PILImage
+
+        from bigdl_tpu.data.shards import _expand
+
+        if isinstance(paths, str):
+            pattern = paths
+            paths = [p for p in _expand(pattern) if os.path.isfile(p)]
+            if not paths:
+                raise ValueError(f"no images matched {pattern!r}")
+        if labels is not None and len(labels) != len(paths):
+            raise ValueError(
+                f"{len(labels)} labels for {len(paths)} resolved images")
+        imgs = []
+        for p in paths:
+            with _PILImage.open(p) as im:
+                imgs.append(np.asarray(im.convert("RGB"), np.uint8))
+        frame = ImageFrame.from_arrays(
+            imgs, labels if labels is not None else [None] * len(imgs))
+        for f, p in zip(frame.features, paths):
+            f[ImageFeature.KEY_URI] = p
+        return frame
 
     def transform(self, transformer: Transformer) -> "ImageFrame":
         return ImageFrame(list(transformer(iter(self.features))))

@@ -13,6 +13,8 @@ frames via ``bigdl_tpu.data.shards``), and training runs the
 """
 
 from bigdl_tpu.nnframes.nn_classifier import (NNClassifier, NNClassifierModel,
-                                              NNEstimator, NNModel)
+                                              NNEstimator, NNImageReader,
+                                              NNModel)
 
-__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

@@ -168,3 +168,31 @@ class NNClassifierModel(NNModel):
         logits = self._raw_predict(df, batch_size)
         out[self.prediction_col] = np.argmax(logits, axis=-1).astype(np.int64)
         return out
+
+
+class NNImageReader:
+    """Read images into a DataFrame — reference ``NNImageReader.scala``
+    (``readImages(path, sc)`` returning a DataFrame with an image-struct
+    column).  Pandas twin: one row per file, with the decoded HWC uint8
+    array in ``image_col`` plus origin/height/width/n_channels columns, so
+    the frame drops straight into ``NNEstimator``/``NNModel`` via
+    ``features_col=image_col``."""
+
+    @staticmethod
+    def read_images(paths, image_col: str = "image", resize=None):
+        import pandas as pd
+
+        from bigdl_tpu.data.vision import ImageFrame, Resize
+
+        frame = ImageFrame.read(paths)
+        if resize is not None:
+            h, w = (resize, resize) if isinstance(resize, int) else resize
+            frame = frame.transform(Resize(h, w))
+        rows = {
+            image_col: [f.image for f in frame],
+            "origin": [f.get("uri") for f in frame],
+            "height": [f.image.shape[0] for f in frame],
+            "width": [f.image.shape[1] for f in frame],
+            "n_channels": [f.image.shape[2] for f in frame],
+        }
+        return pd.DataFrame(rows)

@@ -15,3 +15,4 @@ from bigdl_tpu.optim.optimizer import (
     Optimizer, DistriOptimizer, LocalOptimizer, TrainedModel,
 )
 from bigdl_tpu.optim.train_step import GradientClipping, ShardedParameterStep
+from bigdl_tpu.optim.prediction_service import PredictionService  # noqa: E402,F401

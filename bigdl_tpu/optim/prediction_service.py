@@ -1,0 +1,43 @@
+"""Thread-safe concurrent prediction — reference ``optim/PredictionService``.
+
+Reference analog (unverified — mount empty): ``optim/PredictionService.scala``
+holds ``numThreads`` cloned model instances in a blocking queue; each
+``predict`` call takes one, runs forward, and returns it, so concurrent
+callers never share mutable layer state.
+
+TPU-native re-design: the compiled program is pure, so there is nothing to
+clone — one jitted forward is safe under any concurrency.  What survives is
+the *capacity discipline*: a semaphore of ``n_replicas`` permits bounds
+in-flight predicts (on-device queueing stays shallow, latency stays
+predictable), and per-call errors are caught and returned like the
+reference's ``Result`` wrapper instead of tearing down the service.
+"""
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.inference_model import InferenceModel
+
+
+class PredictionService:
+    def __init__(self, model=None, variables: Optional[Dict[str, Any]] = None,
+                 n_replicas: int = 2, predict_fn=None):
+        self._im = InferenceModel(model, variables, predict_fn=predict_fn)
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self._sem = threading.Semaphore(n_replicas)
+
+    def predict(self, x) -> np.ndarray:
+        """Blocking predict; safe from any number of threads."""
+        with self._sem:
+            return self._im.predict(np.asarray(x))
+
+    def try_predict(self, x):
+        """Reference ``PredictionService.predict`` error contract: returns
+        (result, None) or (None, exception) instead of raising."""
+        try:
+            return self.predict(x), None
+        except Exception as e:  # noqa: BLE001 — service must stay up
+            return None, e

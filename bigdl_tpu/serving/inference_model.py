@@ -4,12 +4,12 @@ Reference analog (unverified — mount empty): ``scala/orca/.../inference/
 InferenceModel.scala`` — holds N model replicas in a blocking queue so many
 Flink/HTTP threads can predict concurrently; backends BigDL/OpenVINO/TF/
 Torch.  TPU-native: ONE jitted program (XLA queues device work; replicas
-buy nothing on a single chip), a lock only around host-side staging, and
-batch-size bucketing so arbitrary request sizes hit a handful of compiled
-shapes.
+buy nothing on a single chip — the pure compiled forward is thread-safe by
+construction), and batch-size bucketing so arbitrary request sizes hit a
+handful of compiled shapes.  Concurrency capacity lives in
+``optim.PredictionService``.
 """
 
-import threading
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -45,7 +45,11 @@ class InferenceModel:
         else:
             self._custom = predict_fn
         self.buckets = tuple(sorted(batch_buckets))
-        self._lock = threading.Lock()
+        # no lock: the jitted forward is pure and JAX dispatch is
+        # thread-safe, so concurrent predicts are safe by construction
+        # (the reference needs its replica queue only because its layers
+        # carry mutable output/gradInput state).  Concurrency CAPACITY is
+        # the caller's concern — see optim.PredictionService.
 
     @staticmethod
     def load(path: str, model) -> "InferenceModel":
@@ -63,6 +67,5 @@ class InferenceModel:
         if n < b:  # pad to the bucket so XLA reuses the compiled program
             pad = np.repeat(x[-1:], b - n, axis=0)
             x = np.concatenate([x, pad], axis=0)
-        with self._lock:
-            out = self._jit(self._params, self._state, x)
+        out = self._jit(self._params, self._state, x)
         return np.asarray(out)[:n]
