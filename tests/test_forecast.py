@@ -126,3 +126,64 @@ def test_dbscan_detector():
     y = np.concatenate([rs.randn(300) * 0.05, [9.0, -9.0]])
     idx = DBScanDetector(eps=0.3, min_samples=4).anomaly_indexes(y)
     assert set([300, 301]) <= set(idx.tolist())
+
+
+def test_xshards_tsdataset_matches_local_and_shares_scaler():
+    import pandas as pd
+
+    from bigdl_tpu.data.shards import XShards
+    from bigdl_tpu.forecast import TSDataset, XShardsTSDataset
+
+    rng = np.random.RandomState(0)
+    def mk(id_, n, scale):
+        return pd.DataFrame({
+            "t": pd.date_range("2024-01-01", periods=n, freq="h"),
+            "v": rng.randn(n).astype(np.float32) * scale + scale,
+            "id": id_,
+        })
+
+    df_a, df_b = mk("a", 60, 1.0), mk("b", 60, 10.0)
+    shards = XShards([df_a, df_b])
+
+    dist = (XShardsTSDataset.from_xshards(shards, "t", "v", id_col="id")
+            .impute().scale().roll(12, 3))
+    xd, yd = dist.to_numpy()
+
+    local = (TSDataset.from_pandas(pd.concat([df_a, df_b]), "t", "v",
+                                   id_col="id")
+             .impute().scale().roll(12, 3))
+    xl, yl = local.to_numpy()
+
+    assert xd.shape == xl.shape and yd.shape == yl.shape
+    # same global scaler stats -> identical windows (row order may differ
+    # per shard, but both group by id so ordering matches here)
+    np.testing.assert_allclose(xd, xl, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(dist.scaler.mean_), np.asarray(local.scaler.mean_),
+        rtol=1e-6)
+
+    sh = dist.to_xshards()
+    assert sh.num_partitions() == 2
+    parts = sh.collect()
+    assert sum(p[0].shape[0] for p in parts) == xd.shape[0]
+
+
+def test_xshards_tsdataset_short_shard_skipped():
+    import pandas as pd
+
+    from bigdl_tpu.data.shards import XShards
+    from bigdl_tpu.forecast import XShardsTSDataset
+
+    rng = np.random.RandomState(1)
+    def mk(id_, n):
+        return pd.DataFrame({
+            "t": pd.date_range("2024-01-01", periods=n, freq="h"),
+            "v": rng.randn(n).astype(np.float32), "id": id_})
+
+    dist = (XShardsTSDataset.from_xshards(
+        XShards([mk("a", 60), mk("b", 5)]), "t", "v", id_col="id")
+        .roll(12, 3))
+    x, y = dist.to_numpy()  # only shard a contributes, no raise
+    assert x.shape[0] == 60 - 12 - 3 + 1
+    assert dist.num_partitions() == 2
+    assert dist.to_xshards().num_partitions() == 1
