@@ -73,6 +73,10 @@ class SummaryWriter:
         if self._tb is not None:
             self._tb.add_scalar(tag, value, step)
 
+    def add_histogram(self, tag: str, values, step: int):
+        if self._tb is not None:
+            self._tb.add_histogram(tag, values, step)
+
     def read_scalar(self, tag: str):
         """(step, value) pairs for one tag — reference
         ``TrainSummary.readScalar``."""

@@ -120,6 +120,8 @@ class Optimizer:
         self._preempt_signals: tuple = ()
         self._preempted = False
         self._profiler = None
+        self._summary_triggers: Dict[str, Trigger] = {}
+        self._last_hist_iter = -1
 
     # ---- builder API (reference names, snake_case) -----------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -159,6 +161,17 @@ class Optimizer:
 
     def set_train_summary(self, log_dir: str) -> "Optimizer":
         self._train_summary = SummaryWriter(log_dir, "train")
+        return self
+
+    def set_summary_trigger(self, tag: str, trigger: Trigger) -> "Optimizer":
+        """Opt-in heavy summary streams — reference
+        ``TrainSummary.setSummaryTrigger``.  Supported tag: ``"Parameters"``
+        (per-parameter histograms; costs a device→host fetch per firing,
+        which is why it is trigger-gated like the reference)."""
+        if tag != "Parameters":
+            raise ValueError(f"unknown summary tag {tag!r} "
+                             "(supported: 'Parameters')")
+        self._summary_triggers[tag] = trigger
         return self
 
     def set_val_summary(self, log_dir: str) -> "Optimizer":
@@ -345,6 +358,16 @@ class Optimizer:
                 and self._ckpt_path and self._last_ckpt_iter != it):
             self._last_ckpt_iter = it
             self._save_checkpoint(step_engine, state)
+        hist_trigger = self._summary_triggers.get("Parameters")
+        if (hist_trigger and self._train_summary and hist_trigger(state)
+                and self._last_hist_iter != it):
+            self._last_hist_iter = it
+            variables = step_engine.get_variables()
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    variables["params"])[0]:
+                tag = "Parameters/" + "/".join(
+                    str(getattr(k, "key", k)) for k in path)
+                self._train_summary.add_histogram(tag, np.asarray(leaf), it)
 
     def _save_checkpoint_once(self, step_engine, state):
         """Checkpoint unless this iteration was already checkpointed (the

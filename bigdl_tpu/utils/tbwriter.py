@@ -107,6 +107,31 @@ def _scalar_summary(tag: str, value: float) -> bytes:
     return _pb_bytes(1, val)
 
 
+def _packed_doubles(field: int, vals) -> bytes:
+    body = struct.pack(f"<{len(vals)}d", *map(float, vals))
+    return _key(field, 2) + _varint(len(body)) + body
+
+
+def _histogram_summary(tag: str, values, bins: int = 30) -> bytes:
+    """Summary.Value with a HistogramProto (tensorflow/core/framework/
+    summary.proto: min=1, max=2, num=3, sum=4, sum_squares=5,
+    bucket_limit=6, bucket=7) — the parameter-histogram stream the
+    reference's TrainSummary emits when 'Parameters' is enabled."""
+    import numpy as np
+
+    v = np.asarray(values, np.float64).reshape(-1)
+    v = v[np.isfinite(v)]  # diverged params must not kill the monitoring
+    if v.size == 0:
+        v = np.zeros((1,))
+    counts, edges = np.histogram(v, bins=bins)
+    histo = (_pb_double(1, float(v.min())) + _pb_double(2, float(v.max()))
+             + _pb_double(3, float(v.size)) + _pb_double(4, float(v.sum()))
+             + _pb_double(5, float((v * v).sum()))
+             + _packed_doubles(6, edges[1:]) + _packed_doubles(7, counts))
+    val = _pb_str(1, tag) + _pb_bytes(5, histo)
+    return _pb_bytes(1, val)
+
+
 class TensorBoardWriter:
     """Write ``events.out.tfevents.*`` scalar streams stock TensorBoard can
     read.  API mirrors the reference FileWriter surface used by
@@ -130,6 +155,10 @@ class TensorBoardWriter:
     def add_scalar(self, tag: str, value: float, step: int):
         self._record(_event(time.time(), step=step,
                             summary=_scalar_summary(tag, float(value))))
+
+    def add_histogram(self, tag: str, values, step: int, bins: int = 30):
+        self._record(_event(time.time(), step=step,
+                            summary=_histogram_summary(tag, values, bins)))
 
     def close(self):
         self._f.close()
