@@ -129,6 +129,8 @@ class Model(Module):
 
         if self._compiled is None:
             raise RuntimeError("call compile(...) before fit(...)")
+        if "epochs" in kw:  # accept the keras-2 spelling alongside nb_epoch
+            nb_epoch = kw.pop("epochs")
         self._trained = fit_module(
             self, self._compiled, x, y, batch_size=batch_size,
             nb_epoch=nb_epoch, validation_data=validation_data,

@@ -58,6 +58,24 @@ class InferenceModel:
 
         return InferenceModel(model, load_model(path))
 
+    @staticmethod
+    def load_tf(path: str, **kwargs) -> "InferenceModel":
+        """Serve a frozen TF GraphDef (``doLoadTF``/TFNet analog — no
+        libtensorflow: the graph becomes catalog modules via utils.tfio)."""
+        from bigdl_tpu.utils.tfio import load_tf_graph
+
+        model, variables = load_tf_graph(path, **kwargs)
+        return InferenceModel(model, variables)
+
+    @staticmethod
+    def load_caffe(path: str, **kwargs) -> "InferenceModel":
+        """Serve a Caffe NetParameter (``doLoadCaffe`` analog); NHWC inputs
+        per the utils.caffe import conversion."""
+        from bigdl_tpu.utils.caffe import load_caffe
+
+        model, variables = load_caffe(path, **kwargs)
+        return InferenceModel(model, variables)
+
     def predict(self, x: np.ndarray) -> np.ndarray:
         x = np.asarray(x)
         if self._custom is not None:

@@ -147,3 +147,15 @@ class TestZooTraining:
         trained = opt.optimize()
         res = trained.evaluate(ArrayDataSet(x, y), [optim.Top1Accuracy()])
         assert res[0].result > 0.95, res
+
+
+def test_fit_accepts_epochs_alias():
+    from bigdl_tpu import keras as K
+
+    inp = K.Input((6,))
+    model = K.Model(inp, K.Dense(2)(inp))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    x = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 2, 32)
+    model.fit(x, y, batch_size=16, epochs=1, log_every=100)
+    assert model.predict(x[:3]).shape == (3, 2)

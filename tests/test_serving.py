@@ -119,3 +119,28 @@ def test_http_frontend_bad_request():
     finally:
         frontend.stop()
         server.stop()
+
+
+def test_inference_model_tf_and_caffe_backends(tmp_path):
+    import jax
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.serving.inference_model import InferenceModel
+    from bigdl_tpu.utils.caffe import save_caffe
+    from bigdl_tpu.utils.tfio import save_tf_graph
+
+    model = Sequential([nn.Linear(4, 3), nn.SoftMax()])
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    expect, _ = model.apply(variables, x)
+
+    tf_path = str(tmp_path / "m.pb")
+    save_tf_graph(model, variables, sample=x, path=tf_path)
+    got = InferenceModel.load_tf(tf_path).predict(x)
+    np.testing.assert_allclose(got, np.asarray(expect), rtol=1e-4, atol=1e-5)
+
+    cf_path = str(tmp_path / "m.caffemodel")
+    save_caffe(model, variables, sample=x, path=cf_path)
+    got2 = InferenceModel.load_caffe(cf_path).predict(x)
+    np.testing.assert_allclose(got2, np.asarray(expect), rtol=1e-4, atol=1e-5)
