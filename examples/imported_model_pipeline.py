@@ -1,0 +1,63 @@
+"""Interop pipeline: train -> export TF GraphDef -> re-import -> IR-fuse ->
+int8-quantize -> serve over the dynamic-batching engine.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/imported_model_pipeline.py
+"""
+
+import os
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+import numpy as np
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.data.dataset import ArrayDataSet
+from bigdl_tpu.nano.inference import InferenceOptimizer
+from bigdl_tpu.nn.module import Sequential
+from bigdl_tpu.serving import InferenceModel, InputQueue, OutputQueue, ServingServer
+from bigdl_tpu.utils.intermediate import IRGraph
+from bigdl_tpu.utils.tfio import load_tf_graph, save_tf_graph
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 16, 16, 3).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+
+    model = Sequential([
+        nn.Conv2D(3, 8, 3, padding="SAME"), nn.BatchNorm(8), nn.ReLU(),
+        nn.MaxPool2D(2), nn.Flatten(), nn.Linear(8 * 8 * 8, 2),
+    ])
+    opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                          nn.CrossEntropyCriterion(), batch_size=64)
+    opt.set_optim_method(optim.Adam(learning_rate=1e-2))
+    opt.set_end_when(optim.Trigger.max_epoch(3))
+    trained = opt.optimize()
+
+    # 1. export the trained model as a frozen TF GraphDef and re-import it
+    pb = os.path.join(tempfile.mkdtemp(), "model.pb")
+    save_tf_graph(model, trained.variables, sample=x[:4], path=pb)
+    imported, ivars = load_tf_graph(pb)
+    print("re-imported graph:", os.path.getsize(pb), "bytes,",
+          sum(1 for n in imported.order if n.layer is not None), "layers")
+
+    # 2. IR-retarget to the fused inference engine (BN folded into convs)
+    fused, fvars = IRGraph.from_model(imported, ivars).to_model("fused")
+
+    # 3. benchmark fp32 vs bf16 vs int8 variants, pick the best
+    res = InferenceOptimizer.optimize(fused, fvars, x[:64],
+                                      methods=("fp32", "bf16", "int8"))
+    print(res.summary())
+
+    # 4. serve the fused model with dynamic batching
+    server = ServingServer(InferenceModel(fused, fvars)).start()
+    rid = InputQueue(server).enqueue("req-1", t=x[:8])
+    out = OutputQueue(server).query(rid)
+    print("served prediction:", np.argmax(out, -1))
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()
