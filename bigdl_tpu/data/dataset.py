@@ -82,15 +82,27 @@ class ArrayDataSet(DataSet):
                  transform: Optional[Callable[[np.ndarray], np.ndarray]] = None):
         if isinstance(data, (tuple, list)) and labels is None and len(data) == 2:
             data, labels = data
-        self.data = np.asarray(data)
+        # multi-input models: data is a tuple/list of per-input arrays
+        # (labels must be given, else the 2-tuple means (x, y) above)
+        self.multi = isinstance(data, (tuple, list))
+        if self.multi:
+            self.data = tuple(np.asarray(a) for a in data)
+            n = len(self.data[0])
+            if any(len(a) != n for a in self.data):
+                raise ValueError("multi-input arrays differ in length: "
+                                 + str([len(a) for a in self.data]))
+            if transform is not None:
+                raise ValueError("transform not supported for multi-input data")
+        else:
+            self.data = np.asarray(data)
         self.labels = None if labels is None else np.asarray(labels)
-        if self.labels is not None and len(self.labels) != len(self.data):
+        if self.labels is not None and len(self.labels) != self.size():
             raise ValueError(
-                f"data/labels length mismatch: {len(self.data)} vs {len(self.labels)}")
+                f"data/labels length mismatch: {self.size()} vs {len(self.labels)}")
         self.transform = transform
 
     def size(self) -> int:
-        return len(self.data)
+        return len(self.data[0]) if self.multi else len(self.data)
 
     def transformed(self, fn) -> "ArrayDataSet":
         prev = self.transform
@@ -99,7 +111,7 @@ class ArrayDataSet(DataSet):
 
     def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
                 drop_last=True, process_id=0, process_count=1):
-        n = len(self.data)
+        n = self.size()
         idx = np.arange(n)
         if shuffle:
             # same global permutation on every host (shared seed), then shard
@@ -126,7 +138,8 @@ class ArrayDataSet(DataSet):
                 # weight 0 so metrics stay exact per-sample
                 sel = np.concatenate(
                     [sel, np.resize(filler, per_host - n_real_sel)])
-            x = self.data[sel]
+            x = (tuple(a[sel] for a in self.data) if self.multi
+                 else self.data[sel])
             if self.transform is not None:
                 x = np.stack([self.transform(s) for s in x])
             mb = MiniBatch(input=x)

@@ -10,22 +10,55 @@ from bigdl_tpu.keras.engine import Input, Model, Node, Sequential
 # keras-1 layer names (reference keras/layers/*.scala) -> nn catalog
 from bigdl_tpu.nn import (
     Dense, Dropout, Flatten, Embedding, LayerNorm,
-    LSTM, GRU, SimpleRNN, TimeDistributed,
+    LSTM, GRU, SimpleRNN, TimeDistributed, ConvLSTM2D,
     MultiHeadAttention, TransformerLayer,
+    Masking, RepeatVector, Permute, Highway,
+    GaussianNoise, GaussianDropout,
+    SpatialDropout1D, SpatialDropout2D,
+    Cropping1D, Cropping2D, Cropping3D,
+    ZeroPadding1D, ZeroPadding3D,
+    UpSampling1D, UpSampling2D, UpSampling3D,
+    LocallyConnected1D, LocallyConnected2D,
 )
 from bigdl_tpu.nn.layers import (
     Conv2D as Convolution2D, Conv2D,
     Conv1D as Convolution1D, Conv1D,
-    MaxPool2D as MaxPooling2D,
-    AvgPool2D as AveragePooling2D,
+    MaxPool2D as MaxPooling2D, MaxPool2D,
+    AvgPool2D as AveragePooling2D, AvgPool2D,
     GlobalAvgPool2D as GlobalAveragePooling2D,
     BatchNorm as BatchNormalization,
     ZeroPadding2D, Reshape,
 )
+from bigdl_tpu.nn.layers_extra import (
+    Conv3D as Convolution3D,
+    Conv2DTranspose as Deconvolution2D,
+    SeparableConv2D as SeparableConvolution2D,
+    MaxPool1D as MaxPooling1D,
+    AvgPool1D as AveragePooling1D,
+    MaxPool3D as MaxPooling3D,
+    AvgPool3D as AveragePooling3D,
+    GlobalMaxPool1D as GlobalMaxPooling1D,
+    GlobalMaxPool2D as GlobalMaxPooling2D,
+    GlobalAvgPool1D as GlobalAveragePooling1D,
+)
+from bigdl_tpu.nn.layers_more import (
+    GlobalMaxPool3D as GlobalMaxPooling3D,
+    GlobalAvgPool3D as GlobalAveragePooling3D,
+)
+from bigdl_tpu.keras.layers import (
+    AtrousConvolution1D, AtrousConvolution2D, Bidirectional, MaxoutDense,
+    Merge,
+)
+from bigdl_tpu.nn.layers_misc import (
+    SpatialWithinChannelLRN as WithinChannelLRN2D,
+)
 from bigdl_tpu.nn.layers import _act  # noqa: F401  (internal)
 from bigdl_tpu.nn import (
-    ReLU, Tanh, Sigmoid, SoftMax, LogSoftMax, GELU, ELU, LeakyReLU,
+    ReLU, Tanh, Sigmoid, SoftMax, LogSoftMax, GELU, ELU, LeakyReLU, PReLU,
+    SReLU, ThresholdedReLU, HardSigmoid, SoftPlus, SoftSign,
 )
+
+InputLayer = Input
 
 
 class Activation:
@@ -35,19 +68,43 @@ class Activation:
         from bigdl_tpu import nn as _nn
 
         table = {
-            "relu": _nn.ReLU, "tanh": _nn.Tanh, "sigmoid": _nn.Sigmoid,
+            "relu": _nn.ReLU, "relu6": _nn.ReLU6, "tanh": _nn.Tanh,
+            "sigmoid": _nn.Sigmoid, "hard_sigmoid": _nn.HardSigmoid,
             "softmax": _nn.SoftMax, "log_softmax": _nn.LogSoftMax,
-            "gelu": _nn.GELU, "elu": _nn.ELU, "linear": _nn.Identity,
+            "softplus": _nn.SoftPlus, "softsign": _nn.SoftSign,
+            "gelu": _nn.GELU, "elu": _nn.ELU, "silu": _nn.SiLU,
+            "swish": _nn.Swish, "mish": _nn.Mish, "linear": _nn.Identity,
         }
-        return table[name.lower()]()
+        try:
+            return table[name.lower()]()
+        except KeyError:
+            raise ValueError(
+                f"unknown activation {name!r}; one of {sorted(table)}"
+            ) from None
 
 
 __all__ = [
-    "Input", "Model", "Node", "Sequential", "Activation",
+    "Input", "InputLayer", "Model", "Node", "Sequential", "Activation",
     "Dense", "Dropout", "Flatten", "Embedding", "LayerNorm", "LSTM", "GRU",
-    "SimpleRNN", "TimeDistributed", "MultiHeadAttention", "TransformerLayer",
-    "Convolution2D", "Conv2D", "Convolution1D", "Conv1D", "MaxPooling2D",
-    "AveragePooling2D", "GlobalAveragePooling2D", "BatchNormalization",
-    "ZeroPadding2D", "Reshape", "ReLU", "Tanh", "Sigmoid", "SoftMax",
-    "LogSoftMax", "GELU", "ELU", "LeakyReLU",
+    "SimpleRNN", "TimeDistributed", "ConvLSTM2D", "Bidirectional",
+    "MultiHeadAttention", "TransformerLayer",
+    "Convolution2D", "Conv2D", "Convolution1D", "Conv1D", "Convolution3D",
+    "AtrousConvolution1D", "AtrousConvolution2D", "Deconvolution2D",
+    "SeparableConvolution2D",
+    "MaxPooling1D", "MaxPooling2D", "MaxPooling3D", "MaxPool2D",
+    "AveragePooling1D", "AveragePooling2D", "AveragePooling3D", "AvgPool2D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+    "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalAveragePooling3D",
+    "BatchNormalization", "WithinChannelLRN2D",
+    "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
+    "Cropping1D", "Cropping2D", "Cropping3D",
+    "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "LocallyConnected1D", "LocallyConnected2D",
+    "Masking", "RepeatVector", "Permute", "Highway", "Merge", "MaxoutDense",
+    "GaussianNoise", "GaussianDropout", "SpatialDropout1D",
+    "SpatialDropout2D", "Reshape",
+    "ReLU", "Tanh", "Sigmoid", "SoftMax", "LogSoftMax", "GELU", "ELU",
+    "LeakyReLU", "PReLU", "SReLU", "ThresholdedReLU", "HardSigmoid",
+    "SoftPlus", "SoftSign",
 ]

@@ -139,13 +139,21 @@ class Model(Module):
 
     def predict(self, x, batch_size: int = 0):
         self._require_trained()
-        return self._trained.predict(np.asarray(x), batch_size=batch_size)
+        if isinstance(x, (list, tuple)):
+            x = tuple(np.asarray(a) for a in x)
+        else:
+            x = np.asarray(x)
+        return self._trained.predict(x, batch_size=batch_size)
 
     def evaluate(self, x, y=None, batch_size: int = 32):
         from bigdl_tpu.data import ArrayDataSet
 
         self._require_trained()
-        ds = ArrayDataSet(np.asarray(x), None if y is None else np.asarray(y))
+        if isinstance(x, (list, tuple)) and y is not None:
+            ds = ArrayDataSet(tuple(np.asarray(a) for a in x), np.asarray(y))
+        else:
+            ds = ArrayDataSet(np.asarray(x),
+                              None if y is None else np.asarray(y))
         from bigdl_tpu.optim import Loss
 
         methods = (self._compiled or {}).get("metrics")

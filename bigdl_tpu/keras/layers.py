@@ -1,0 +1,78 @@
+"""Keras-1 layer names that need adaptation beyond a re-export.
+
+Reference analog (unverified — mount empty): ``dllib/keras/layers/*.scala``
+(``Merge``, ``Bidirectional``, ``MaxoutDense``, ``AtrousConvolution``) — the
+keras-1 API surface of the reference, bound here onto the nn catalog.
+"""
+
+from typing import Optional, Sequence
+
+
+from bigdl_tpu.nn import layers_extra as LX
+from bigdl_tpu.nn.layers import Conv1D, Conv2D
+from bigdl_tpu.nn.module import EMPTY, Module
+from bigdl_tpu.nn.rnn import BiRecurrent, _RNNBase
+
+
+class Merge(Module):
+    """keras-1 ``Merge([...], mode=...)`` over a table input — modes
+    sum | mul | ave | max | concat | dot | cosine.  Each mode delegates to
+    the catalog table op with the same semantics (CAddTable, CMulTable,
+    CAveTable, CMaxTable, JoinTable, DotProduct, CosineDistance), so Merge
+    never drifts from the nn layers."""
+
+    MODES = ("sum", "mul", "ave", "max", "concat", "dot", "cosine")
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1, name=None):
+        super().__init__(name)
+        if mode not in self.MODES:
+            raise ValueError(f"mode {mode!r}: one of {self.MODES}")
+        from bigdl_tpu.nn.module import CAddTable, CMulTable, JoinTable
+
+        self.mode = mode
+        self.concat_axis = concat_axis
+        self._op = {
+            "sum": CAddTable, "mul": CMulTable, "ave": LX.CAveTable,
+            "max": LX.CMaxTable, "dot": LX.DotProduct,
+            "cosine": LX.CosineDistance,
+            "concat": lambda: JoinTable(concat_axis),
+        }[mode]()
+
+    def forward(self, params, state, *xs, training=False, rng=None):
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        y, _ = self._op.forward(EMPTY, EMPTY, *xs, training=training, rng=rng)
+        if self.mode in ("dot", "cosine"):
+            y = y[..., None]  # keras Merge keeps a trailing feature axis
+        return y, EMPTY
+
+
+def Bidirectional(layer: _RNNBase, merge_mode: str = "concat",
+                  name: Optional[str] = None) -> BiRecurrent:
+    """keras ``Bidirectional(LSTM(...))`` — wraps the nn ``BiRecurrent``."""
+    return BiRecurrent(layer, merge=merge_mode, name=name)
+
+
+def MaxoutDense(in_features: Optional[int], out_features: int,
+                nb_feature: int = 4, name=None) -> LX.Maxout:
+    """keras-1 ``MaxoutDense`` — the nn ``Maxout`` with keras arg names."""
+    return LX.Maxout(in_features, out_features, pool_size=nb_feature,
+                     name=name)
+
+
+def AtrousConvolution2D(in_channels, out_channels, kernel_size,
+                        atrous_rate=1, stride=1, padding="VALID",
+                        with_bias=True, name=None) -> Conv2D:
+    """keras-1 ``AtrousConvolution2D`` == dilated Conv2D."""
+    return Conv2D(in_channels, out_channels, kernel_size, stride=stride,
+                  padding=padding, dilation=atrous_rate, with_bias=with_bias,
+                  name=name)
+
+
+def AtrousConvolution1D(in_channels, out_channels, kernel_size,
+                        atrous_rate=1, stride=1, padding="VALID",
+                        with_bias=True, name=None) -> Conv1D:
+    """keras-1 ``AtrousConvolution1D`` == dilated Conv1D."""
+    return Conv1D(in_channels, out_channels, kernel_size, stride=stride,
+                  padding=padding, dilation=atrous_rate, with_bias=with_bias,
+                  name=name)

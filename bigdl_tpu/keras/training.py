@@ -91,6 +91,9 @@ def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
                log_every=10, end_trigger=None) -> TrainedModel:
     if isinstance(x, ArrayDataSet):
         ds = x
+    elif isinstance(x, (list, tuple)) and y is not None:
+        # multi-input functional model: list of per-input arrays
+        ds = ArrayDataSet(tuple(np.asarray(a) for a in x), np.asarray(y))
     else:
         ds = ArrayDataSet(np.asarray(x), None if y is None else np.asarray(y))
     opt = Optimizer(model, ds, compiled["loss"], batch_size=batch_size)
@@ -102,7 +105,11 @@ def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
             vds = validation_data
         else:
             vx, vy = validation_data
-            vds = ArrayDataSet(np.asarray(vx), np.asarray(vy))
+            if isinstance(vx, (list, tuple)):
+                vds = ArrayDataSet(tuple(np.asarray(a) for a in vx),
+                                   np.asarray(vy))
+            else:
+                vds = ArrayDataSet(np.asarray(vx), np.asarray(vy))
         methods = compiled["metrics"] or [Loss(compiled["loss"])]
         opt.set_validation(Trigger.every_epoch(), vds, methods,
                            batch_size=batch_size)

@@ -19,7 +19,7 @@ from bigdl_tpu.nn.layers_extra import (
     TemporalMaxPooling, MaxPool3D, AvgPool3D, VolumetricMaxPooling,
     VolumetricAveragePooling, GlobalMaxPool2D, GlobalMaxPool1D,
     GlobalAvgPool1D, UpSampling2D, ResizeBilinear, UpSampling1D, UpSampling3D,
-    Cropping2D, Cropping1D, ZeroPadding1D, ZeroPadding3D, Padding, Power,
+    Cropping2D, Cropping1D, Cropping3D, ZeroPadding1D, ZeroPadding3D, Padding, Power,
     Square, Sqrt, Log, Exp, Abs, Negative, Clamp, AddConstant, MulConstant,
     Threshold, SoftMin, LogSigmoid, ThresholdedReLU, Sum, Mean, Max, Min,
     CMul, CAdd, Mul, Add, Scale, CSubTable, CDivTable, CMaxTable, CMinTable,
@@ -45,6 +45,7 @@ from bigdl_tpu.nn.layers_misc import (
     LookupTableSparse, SpatialWithinChannelLRN, NormalizeScale, Echo,
     RoiPooling, SpatialShareConvolution, SpatialDilatedConvolution,
     CTCCriterion, ClassSimplexCriterion, WeightedMSECriterion,
+    Index, BifurcateSplitTable, NegativeEntropyPenalty,
 )
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, LSTMPeephole, GRU, BiRecurrent, TimeDistributed,

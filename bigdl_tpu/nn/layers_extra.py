@@ -430,6 +430,24 @@ class Cropping1D(Module):
         return x[:, a:x.shape[1] - b or None, :], EMPTY
 
 
+class Cropping3D(Module):
+    """Keras ``Cropping3D`` analog (NDHWC)."""
+
+    def __init__(self, cropping=((0, 0), (0, 0), (0, 0)), name=None):
+        super().__init__(name)
+        if isinstance(cropping, int):
+            cropping = ((cropping,) * 2,) * 3
+        elif all(isinstance(c, int) for c in cropping):
+            cropping = tuple((c, c) for c in cropping)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        (a0, b0), (a1, b1), (a2, b2) = self.cropping
+        d, h, w = x.shape[1], x.shape[2], x.shape[3]
+        return x[:, a0:d - b0 or None, a1:h - b1 or None,
+                 a2:w - b2 or None, :], EMPTY
+
+
 class ZeroPadding1D(Module):
     def __init__(self, padding=1, name=None):
         super().__init__(name)

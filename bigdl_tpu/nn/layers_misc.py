@@ -21,6 +21,7 @@ __all__ = [
     "LookupTableSparse", "SpatialWithinChannelLRN", "NormalizeScale", "Echo",
     "RoiPooling", "SpatialShareConvolution", "SpatialDilatedConvolution",
     "CTCCriterion", "ClassSimplexCriterion", "WeightedMSECriterion",
+    "Index", "BifurcateSplitTable", "NegativeEntropyPenalty",
 ]
 
 
@@ -259,3 +260,49 @@ class WeightedMSECriterion(Criterion):
     def forward(self, input, target):
         y, w = target
         return _reduce(w * (input - y) ** 2, self.size_average)
+
+
+class Index(Module):
+    """Select rows along a dimension by an index tensor — reference
+    ``nn/Index.scala`` (table input ``(x, indices)``; indices 0-based here,
+    matching the framework-wide divergence from Torch's 1-based)."""
+
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, params, state, x, indices=None, training=False,
+                rng=None):
+        if indices is None:  # table-as-tuple form
+            x, indices = x
+        return jnp.take(jnp.asarray(x), jnp.asarray(indices).astype(jnp.int32),
+                        axis=self.dim), EMPTY
+
+
+class BifurcateSplitTable(Module):
+    """Split a tensor into two halves along ``dim`` — reference
+    ``nn/BifurcateSplitTable.scala`` (output is a 2-table)."""
+
+    def __init__(self, dim: int = -1, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def forward(self, params, state, x, training=False, rng=None):
+        n = x.shape[self.dim]
+        half = n // 2
+        a = jax.lax.slice_in_dim(x, 0, half, axis=self.dim)
+        b = jax.lax.slice_in_dim(x, half, n, axis=self.dim)
+        return (a, b), EMPTY
+
+
+class NegativeEntropyPenalty(Criterion):
+    """Entropy regularizer over probabilities — reference
+    ``nn/NegativeEntropyPenalty.scala``: ``beta * sum(p * log p)``
+    (target-free; add via MultiCriterion or a custom loss)."""
+
+    def __init__(self, beta: float = 0.01):
+        self.beta = beta
+
+    def forward(self, input, target=None):
+        p = jnp.clip(input, 1e-12, 1.0)
+        return self.beta * jnp.sum(p * jnp.log(p))

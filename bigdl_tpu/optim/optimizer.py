@@ -43,24 +43,30 @@ class TrainedModel:
         self.variables = variables
         self._engine = step_engine
 
-    def predict(self, x: np.ndarray, batch_size: int = 0) -> np.ndarray:
+    def predict(self, x, batch_size: int = 0) -> np.ndarray:
         run = self._engine.predict_fn()
+        multi = isinstance(x, (list, tuple))
+        if multi:
+            x = tuple(np.asarray(a) for a in x)
         # multi-host predict runs per-process (no mesh sharding), so padding
         # to the data-axis multiple is only needed single-process
         ndev = self._engine.ndev if jax.process_count() == 1 else 1
-        n = x.shape[0]
+        n = (x[0] if multi else x).shape[0]
+
+        def pad_to(arrs, k):
+            def one(a):
+                p = (-a.shape[0]) % k
+                return np.concatenate([a, np.repeat(a[-1:], p, 0)]) if p else a
+            return tuple(one(a) for a in arrs) if multi else one(arrs)
+
         if batch_size <= 0:
-            # single full batch, padded to device multiple
-            pad = (-n) % ndev
-            xp = np.concatenate([x, np.repeat(x[-1:], pad, 0)]) if pad else x
-            return np.asarray(run(xp))[:n]
+            return np.asarray(run(pad_to(x, ndev)))[:n]
         outs = []
         for i in range(0, n, batch_size):
-            xb = x[i:i + batch_size]
-            pad = (-xb.shape[0]) % ndev
-            if pad:
-                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, 0)])
-            outs.append(np.asarray(run(xb))[:min(batch_size, n - i)])
+            xb = (tuple(a[i:i + batch_size] for a in x) if multi
+                  else x[i:i + batch_size])
+            outs.append(np.asarray(run(pad_to(xb, ndev)))
+                        [:min(batch_size, n - i)])
         return np.concatenate(outs)
 
     def evaluate(self, dataset: DataSet, methods: Sequence[ValidationMethod],
@@ -207,7 +213,10 @@ class Optimizer:
         # init params from one sample batch
         sample = next(iter(self.dataset.batches(
             self.batch_size, shuffle=False, process_count=jax.process_count())))
-        init_vars = self.model.init(rng, np.asarray(sample["input"][:1]))
+        sx = sample["input"]
+        init_args = (tuple(np.asarray(a[:1]) for a in sx)
+                     if isinstance(sx, tuple) else (np.asarray(sx[:1]),))
+        init_vars = self.model.init(rng, *init_args)
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip)
@@ -273,7 +282,7 @@ class Optimizer:
             # double-buffer host→device DMA behind the running step
             batch_iter = prefetch_to_device(
                 batch_iter,
-                lambda mb: (step_engine.shard_batch(np.asarray(mb["input"])),
+                lambda mb: (step_engine.shard_batch(mb["input"]),
                             step_engine.shard_batch(np.asarray(mb["target"]))),
                 size=self.prefetch)
             try:

@@ -146,9 +146,11 @@ class ShardedParameterStep:
             params = unravel(flat_p[:n_real])
             dev_rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
 
+            xs = x if isinstance(x, tuple) else (x,)
+
             def loss_fn(p):
                 out, new_mstate = model.forward(
-                    p, mstate, x, training=True, rng=dev_rng)
+                    p, mstate, *xs, training=True, rng=dev_rng)
                 return criterion.forward(out, y), new_mstate
 
             (loss, new_mstate), grads = jax.value_and_grad(
@@ -205,7 +207,8 @@ class ShardedParameterStep:
 
         def eval_shard(flat_p, mstate, x, y, w):
             params = unravel(flat_p[:n_real])
-            out, _ = model.forward(params, mstate, x, training=False)
+            xs = x if isinstance(x, tuple) else (x,)
+            out, _ = model.forward(params, mstate, *xs, training=False)
             stats = []
             for m in methods:
                 s, c = m.batch_stats(out, y, w)
@@ -220,12 +223,15 @@ class ShardedParameterStep:
         return jax.jit(mapped)
 
     # ------------------------------------------------------------------
-    def shard_batch(self, arr: np.ndarray):
+    def shard_batch(self, arr):
         """Host numpy (per-process shard) -> global device array on the data
-        axis."""
+        axis.  Accepts a pytree (tuple of arrays for multi-input models)."""
         if jax.process_count() == 1:
-            return jax.device_put(arr, self._batch_sh)
-        return jax.make_array_from_process_local_data(self._batch_sh, arr)
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, self._batch_sh), arr)
+        return jax.tree_util.tree_map(
+            lambda a: jax.make_array_from_process_local_data(
+                self._batch_sh, a), arr)
 
     def train_step(self, step: int, rng, x, y):
         return self.train_step_device(
@@ -251,9 +257,10 @@ class ShardedParameterStep:
         totals = None
         for mb in batches:
             x = mb["input"]
+            n_rows = (x[0] if isinstance(x, tuple) else x).shape[0]
             w = mb.get("weight")
             if w is None:
-                w = np.ones((x.shape[0],), np.float32)
+                w = np.ones((n_rows,), np.float32)
             stats = fn(self.flat_params, self.model_state,
                        self.shard_batch(x),
                        self.shard_batch(mb["target"]),
@@ -282,7 +289,8 @@ class ShardedParameterStep:
             @jax.jit
             def fwd(flat_p, mstate, x):
                 params = unravel(flat_p[:n_real])
-                out, _ = model.forward(params, mstate, x, training=False)
+                xs = x if isinstance(x, tuple) else (x,)
+                out, _ = model.forward(params, mstate, *xs, training=False)
                 return out
 
             self._predict_jit = fwd
@@ -295,7 +303,8 @@ class ShardedParameterStep:
             host_state = host_fetch(self.model_state)
 
             def run(x):
-                return fwd(jnp.asarray(host_params), host_state, jnp.asarray(x))
+                return fwd(jnp.asarray(host_params), host_state,
+                           jax.tree_util.tree_map(jnp.asarray, x))
         else:
             def run(x):
                 return fwd(self.flat_params, self.model_state,

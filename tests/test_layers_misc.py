@@ -267,3 +267,28 @@ def test_lookup_table_sparse_pad_id_ignored():
         variables["params"]["weight"] = jnp.asarray(table)
         y, _ = layer.apply(variables, sp)
         np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-6)
+
+
+def test_index_and_bifurcate_split():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    idx = np.array([2, 0, 3])
+    empty = {"params": {}, "state": {}}
+    y, _ = nn.Index(0).apply(empty, x, idx)
+    np.testing.assert_array_equal(np.asarray(y), x[[2, 0, 3]])
+
+    (a, b), _ = nn.BifurcateSplitTable(-1).apply(empty, x)
+    np.testing.assert_array_equal(np.asarray(a), x[:, :3])
+    np.testing.assert_array_equal(np.asarray(b), x[:, 3:])
+
+
+def test_negative_entropy_penalty():
+    p = np.full((2, 4), 0.25, np.float32)  # uniform -> max entropy
+    crit = nn.NegativeEntropyPenalty(beta=1.0)
+    v_uniform = float(crit(jnp.asarray(p)))
+    peaked = np.array([[0.97, 0.01, 0.01, 0.01]] * 2, np.float32)
+    v_peaked = float(crit(jnp.asarray(peaked)))
+    # sum(p log p) is most negative at the uniform distribution, so peaked
+    # (low-entropy) outputs receive the HIGHER penalty value — that is the
+    # criterion's purpose (discourage overconfident predictions)
+    assert v_peaked > v_uniform
+    assert v_uniform < 0 and v_peaked < 0
