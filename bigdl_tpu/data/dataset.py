@@ -82,9 +82,10 @@ class ArrayDataSet(DataSet):
                  transform: Optional[Callable[[np.ndarray], np.ndarray]] = None):
         if isinstance(data, (tuple, list)) and labels is None and len(data) == 2:
             data, labels = data
-        # multi-input models: data is a tuple/list of per-input arrays
-        # (labels must be given, else the 2-tuple means (x, y) above)
-        self.multi = isinstance(data, (tuple, list))
+        # multi-input models: data is a TUPLE of per-input arrays (labels
+        # must be given, else the 2-tuple means (x, y) above).  Plain lists
+        # keep their historical meaning of list-of-samples -> one array.
+        self.multi = isinstance(data, tuple)
         if self.multi:
             self.data = tuple(np.asarray(a) for a in data)
             n = len(self.data[0])

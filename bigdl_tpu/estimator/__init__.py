@@ -10,4 +10,9 @@ training through the ZeRO-1 sharded train step over the mesh.
 
 from bigdl_tpu.estimator.estimator import Estimator, init_context, stop_context
 
-__all__ = ["Estimator", "init_context", "stop_context"]
+# reference spellings (orca.common.init_orca_context/stop_orca_context)
+init_orca_context = init_context
+stop_orca_context = stop_context
+
+__all__ = ["Estimator", "init_context", "stop_context",
+           "init_orca_context", "stop_orca_context"]

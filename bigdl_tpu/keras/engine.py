@@ -139,21 +139,23 @@ class Model(Module):
 
     def predict(self, x, batch_size: int = 0):
         self._require_trained()
-        if isinstance(x, (list, tuple)):
-            x = tuple(np.asarray(a) for a in x)
-        else:
-            x = np.asarray(x)
-        return self._trained.predict(x, batch_size=batch_size)
+        return self._trained.predict(self._pack_inputs(x),
+                                     batch_size=batch_size)
+
+    def _pack_inputs(self, x):
+        """list/tuple becomes a multi-input pack ONLY for multi-input
+        models; a plain list of samples on a single-input model keeps its
+        keras meaning of one stacked array."""
+        if isinstance(x, (list, tuple)) and len(self.inputs) > 1:
+            return tuple(np.asarray(a) for a in x)
+        return np.asarray(x)
 
     def evaluate(self, x, y=None, batch_size: int = 32):
         from bigdl_tpu.data import ArrayDataSet
 
         self._require_trained()
-        if isinstance(x, (list, tuple)) and y is not None:
-            ds = ArrayDataSet(tuple(np.asarray(a) for a in x), np.asarray(y))
-        else:
-            ds = ArrayDataSet(np.asarray(x),
-                              None if y is None else np.asarray(y))
+        ds = ArrayDataSet(self._pack_inputs(x),
+                          None if y is None else np.asarray(y))
         from bigdl_tpu.optim import Loss
 
         methods = (self._compiled or {}).get("metrics")

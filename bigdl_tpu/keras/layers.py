@@ -15,7 +15,7 @@ from bigdl_tpu.nn.rnn import BiRecurrent, _RNNBase
 
 
 class Merge(Module):
-    """keras-1 ``Merge([...], mode=...)`` over a table input — modes
+    """keras-1 merge layer, used as ``Merge(mode)([node_a, node_b])`` — modes
     sum | mul | ave | max | concat | dot | cosine.  Each mode delegates to
     the catalog table op with the same semantics (CAddTable, CMulTable,
     CAveTable, CMaxTable, JoinTable, DotProduct, CosineDistance), so Merge

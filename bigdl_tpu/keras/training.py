@@ -89,13 +89,18 @@ def resolve_compile(optimizer, loss, metrics: Sequence) -> Dict[str, Any]:
 def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
                nb_epoch=10, validation_data=None, checkpoint_path=None,
                log_every=10, end_trigger=None) -> TrainedModel:
+    n_inputs = len(getattr(model, "inputs", ()) or ())
+
+    def pack(v):
+        # list/tuple is a multi-input pack only for multi-input models
+        if isinstance(v, (list, tuple)) and n_inputs > 1:
+            return tuple(np.asarray(a) for a in v)
+        return np.asarray(v)
+
     if isinstance(x, ArrayDataSet):
         ds = x
-    elif isinstance(x, (list, tuple)) and y is not None:
-        # multi-input functional model: list of per-input arrays
-        ds = ArrayDataSet(tuple(np.asarray(a) for a in x), np.asarray(y))
     else:
-        ds = ArrayDataSet(np.asarray(x), None if y is None else np.asarray(y))
+        ds = ArrayDataSet(pack(x), None if y is None else np.asarray(y))
     opt = Optimizer(model, ds, compiled["loss"], batch_size=batch_size)
     opt.set_optim_method(compiled["optimizer"])
     opt.set_end_when(end_trigger or Trigger.max_epoch(nb_epoch))
@@ -105,11 +110,7 @@ def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
             vds = validation_data
         else:
             vx, vy = validation_data
-            if isinstance(vx, (list, tuple)):
-                vds = ArrayDataSet(tuple(np.asarray(a) for a in vx),
-                                   np.asarray(vy))
-            else:
-                vds = ArrayDataSet(np.asarray(vx), np.asarray(vy))
+            vds = ArrayDataSet(pack(vx), np.asarray(vy))
         methods = compiled["metrics"] or [Loss(compiled["loss"])]
         opt.set_validation(Trigger.every_epoch(), vds, methods,
                            batch_size=batch_size)

@@ -48,3 +48,61 @@ def inception_v1(classes: int = 1000, dropout: float = 0.4) -> nn.Sequential:
         nn.Linear(1024, classes),
         nn.LogSoftMax(),
     ])
+
+
+# ---------------------------------------------------------------------------
+# Inception-v2 (BN-Inception) — reference dllib/models/inception/
+# Inception_v2.scala: every conv is conv+BN+ReLU, the 5x5 tower becomes a
+# double-3x3 tower, and grid reduction uses stride-2 modules with a
+# pass-through pool tower.
+# ---------------------------------------------------------------------------
+
+
+def _cbr(cin, cout, k, stride=1):
+    return [nn.Conv2D(cin, cout, k, stride=stride, padding="SAME",
+                      with_bias=False),
+            nn.BatchNorm(cout), nn.ReLU()]
+
+
+def inception_v2_module(cin, c1, c3r, c3, d3r, d3, pool_proj,
+                        pool: str = "avg", stride: int = 1):
+    """BN-Inception module.  ``stride=2`` is the grid-reduction form: the
+    1x1 tower is dropped and the pool tower passes through un-projected."""
+    towers = []
+    if stride == 1 and c1 > 0:
+        towers.append(_tower(*_cbr(cin, c1, 1)))
+    towers.append(_tower(*(_cbr(cin, c3r, 1) + _cbr(c3r, c3, 3, stride))))
+    towers.append(_tower(*(_cbr(cin, d3r, 1) + _cbr(d3r, d3, 3)
+                           + _cbr(d3, d3, 3, stride))))
+    if stride == 1:
+        pool_l = (nn.AvgPool2D(3, 1, padding=1) if pool == "avg"
+                  else nn.MaxPool2D(3, 1, padding=1))
+        towers.append(_tower(pool_l, *_cbr(cin, pool_proj, 1)))
+    else:
+        towers.append(_tower(nn.MaxPool2D(3, 2, padding=1)))
+    return nn.Concat(towers, dim=-1)
+
+
+def inception_v2(classes: int = 1000) -> nn.Sequential:
+    return nn.Sequential(
+        _cbr(3, 64, 7, 2) + [nn.MaxPool2D(3, 2, padding=1)]
+        + _cbr(64, 64, 1) + _cbr(64, 192, 3)
+        + [nn.MaxPool2D(3, 2, padding=1)]
+        + [
+            inception_v2_module(192, 64, 64, 64, 64, 96, 32),        # 3a->256
+            inception_v2_module(256, 64, 64, 96, 64, 96, 64),        # 3b->320
+            inception_v2_module(320, 0, 128, 160, 64, 96, 0,
+                                stride=2),                            # 3c->576
+            inception_v2_module(576, 224, 64, 96, 96, 128, 128),     # 4a->576
+            inception_v2_module(576, 192, 96, 128, 96, 128, 128),    # 4b->576
+            inception_v2_module(576, 160, 128, 160, 128, 160, 96),   # 4c->576
+            inception_v2_module(576, 96, 128, 192, 160, 192, 96),    # 4d->576
+            inception_v2_module(576, 0, 128, 192, 192, 256, 0,
+                                stride=2),                            # 4e->1024
+            inception_v2_module(1024, 352, 192, 320, 160, 224, 128),  # 5a
+            inception_v2_module(1024, 352, 192, 320, 192, 224, 128,
+                                pool="max"),                          # 5b
+            nn.GlobalAvgPool2D(),
+            nn.Linear(1024, classes),
+            nn.LogSoftMax(),
+        ])

@@ -45,9 +45,11 @@ class TrainedModel:
 
     def predict(self, x, batch_size: int = 0) -> np.ndarray:
         run = self._engine.predict_fn()
-        multi = isinstance(x, (list, tuple))
+        multi = isinstance(x, tuple)  # tuple = multi-input pack
         if multi:
             x = tuple(np.asarray(a) for a in x)
+        else:
+            x = np.asarray(x)
         # multi-host predict runs per-process (no mesh sharding), so padding
         # to the data-axis multiple is only needed single-process
         ndev = self._engine.ndev if jax.process_count() == 1 else 1
@@ -214,8 +216,8 @@ class Optimizer:
         sample = next(iter(self.dataset.batches(
             self.batch_size, shuffle=False, process_count=jax.process_count())))
         sx = sample["input"]
-        init_args = (tuple(np.asarray(a[:1]) for a in sx)
-                     if isinstance(sx, tuple) else (np.asarray(sx[:1]),))
+        init_args = tuple(np.asarray(a[:1]) for a in sx) \
+            if isinstance(sx, tuple) else (np.asarray(sx[:1]),)
         init_vars = self.model.init(rng, *init_args)
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,

@@ -40,6 +40,12 @@ except ImportError:  # pragma: no cover
 from bigdl_tpu.runtime.mesh import AXIS_DATA
 
 
+def as_inputs(x):
+    """Model-input convention: a tuple is a multi-input pack, anything else
+    is the single input."""
+    return x if isinstance(x, tuple) else (x,)
+
+
 @dataclass
 class GradientClipping:
     """Reference ``optim/parameters/ParameterProcessor.scala``:
@@ -146,7 +152,7 @@ class ShardedParameterStep:
             params = unravel(flat_p[:n_real])
             dev_rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
 
-            xs = x if isinstance(x, tuple) else (x,)
+            xs = as_inputs(x)
 
             def loss_fn(p):
                 out, new_mstate = model.forward(
@@ -207,7 +213,7 @@ class ShardedParameterStep:
 
         def eval_shard(flat_p, mstate, x, y, w):
             params = unravel(flat_p[:n_real])
-            xs = x if isinstance(x, tuple) else (x,)
+            xs = as_inputs(x)
             out, _ = model.forward(params, mstate, *xs, training=False)
             stats = []
             for m in methods:
@@ -257,7 +263,7 @@ class ShardedParameterStep:
         totals = None
         for mb in batches:
             x = mb["input"]
-            n_rows = (x[0] if isinstance(x, tuple) else x).shape[0]
+            n_rows = as_inputs(x)[0].shape[0]
             w = mb.get("weight")
             if w is None:
                 w = np.ones((n_rows,), np.float32)
@@ -289,7 +295,7 @@ class ShardedParameterStep:
             @jax.jit
             def fwd(flat_p, mstate, x):
                 params = unravel(flat_p[:n_real])
-                xs = x if isinstance(x, tuple) else (x,)
+                xs = as_inputs(x)
                 out, _ = model.forward(params, mstate, *xs, training=False)
                 return out
 

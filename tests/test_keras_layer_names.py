@@ -127,3 +127,19 @@ def test_multi_input_fit_predict_evaluate():
     # evaluate with list inputs
     res = model.evaluate([xa, xb], y)
     assert res
+
+
+def test_list_of_samples_still_means_one_array():
+    """Regression: a plain python list of samples on a single-input model
+    keeps its keras meaning (stacked into one array), and is NOT
+    reinterpreted as a multi-input pack."""
+    inp = K.Input((4,))
+    model = K.Model(inp, K.Dense(2)(inp))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    x_list = [[0.1 * i, 0.2, 0.3, 0.4] for i in range(32)]
+    y = np.arange(32) % 2
+    model.fit(x_list, y, batch_size=16, epochs=1, log_every=1000)
+    pred = model.predict(x_list)
+    assert pred.shape == (32, 2)
+    res = model.evaluate(x_list, y)
+    assert res

@@ -159,3 +159,17 @@ def test_fit_accepts_epochs_alias():
     y = np.random.RandomState(1).randint(0, 2, 32)
     model.fit(x, y, batch_size=16, epochs=1, log_every=100)
     assert model.predict(x[:3]).shape == (3, 2)
+
+
+def test_inception_v2_builds_and_forwards():
+    import jax
+
+    from bigdl_tpu.models import inception_v2
+
+    model = inception_v2(classes=10)
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype(np.float32)
+    v = model.init(jax.random.PRNGKey(0), x)
+    y, _ = model.apply(v, x)
+    assert np.asarray(y).shape == (1, 10)
+    # log-probs sum to 1
+    np.testing.assert_allclose(np.exp(np.asarray(y)).sum(), 1.0, rtol=1e-3)
