@@ -1,0 +1,227 @@
+"""Friesian serving stack — recall / feature / ranking / recommender.
+
+Reference analog (unverified — mount empty): ``scala/friesian/src/main``
+(SURVEY.md §3.4) — four gRPC microservices: a feature service (redis/
+rocksdb KV), a recall service (faiss ANN), a ranking service
+(InferenceModel), and a recommender orchestrator.
+
+TPU-native re-design: recall is EXACT brute-force maximum-inner-product
+top-k as one jitted ``matmul + lax.top_k`` — on the MXU a dense
+(B, D) x (D, N) scan over millions of items is faster and simpler than
+CPU ANN graph traversal, and it is exact (the faiss IVF/HNSW recall<1
+tradeoff disappears).  The feature service is an in-process KV store (the
+redis analog without the broker), ranking rides the dynamic-batching
+``InferenceModel``, and the orchestrator chains them exactly like the
+reference's Recommender service.  All four expose the same ``serve()``
+HTTP surface as Cluster Serving for out-of-process callers.
+"""
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.serving.inference_model import InferenceModel
+
+
+class FeatureService:
+    """KV feature store — reference feature service (redis/rocksdb backed
+    there; in-process dict + lock here)."""
+
+    def __init__(self):
+        self._kv: Dict[str, Dict[Any, np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, namespace: str, key, value) -> None:
+        with self._lock:
+            self._kv.setdefault(namespace, {})[key] = np.asarray(value)
+
+    def put_batch(self, namespace: str, keys: Sequence, values) -> None:
+        values = np.asarray(values)
+        with self._lock:
+            ns = self._kv.setdefault(namespace, {})
+            for k, v in zip(keys, values):
+                ns[k] = v
+
+    def get(self, namespace: str, key) -> Optional[np.ndarray]:
+        with self._lock:
+            return self._kv.get(namespace, {}).get(key)
+
+    def get_batch(self, namespace: str, keys: Sequence) -> List[Optional[np.ndarray]]:
+        with self._lock:
+            ns = self._kv.get(namespace, {})
+            return [ns.get(k) for k in keys]
+
+
+class RecallService:
+    """Exact MIPS top-k over item embeddings — the faiss-recall analog.
+
+    ``search`` compiles once per (batch-bucket, k): scores = q @ E^T on the
+    MXU, then ``lax.top_k``.  Items are identified by the caller's ids
+    (row order preserved on ``add_items``)."""
+
+    def __init__(self, embedding_dim: int):
+        self.dim = embedding_dim
+        self._ids: List[Any] = []
+        self._emb: Optional[np.ndarray] = None
+        self._jit_cache: Dict[Tuple[int, int], Callable] = {}
+
+    def add_items(self, ids: Sequence, embeddings) -> None:
+        embeddings = np.asarray(embeddings, np.float32)
+        if embeddings.ndim != 2 or embeddings.shape[1] != self.dim:
+            raise ValueError(
+                f"embeddings must be (n, {self.dim}), got {embeddings.shape}")
+        if len(ids) != embeddings.shape[0]:
+            raise ValueError("ids/embeddings length mismatch")
+        self._ids.extend(ids)
+        self._emb = (embeddings if self._emb is None
+                     else np.concatenate([self._emb, embeddings], axis=0))
+        self._jit_cache.clear()  # item matrix changed; old programs stale
+
+    @property
+    def n_items(self) -> int:
+        return 0 if self._emb is None else self._emb.shape[0]
+
+    def _searcher(self, batch: int, k: int) -> Callable:
+        import jax
+        import jax.numpy as jnp
+
+        key = (batch, k)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            emb = jnp.asarray(self._emb)
+
+            @jax.jit
+            def fn(q):
+                scores = jnp.matmul(q, emb.T,
+                                    preferred_element_type=jnp.float32)
+                return jax.lax.top_k(scores, k)
+
+            self._jit_cache[key] = fn
+        return fn
+
+    def search(self, queries, k: int = 10) -> List[List[Tuple[Any, float]]]:
+        if self.n_items == 0:
+            raise RuntimeError("no items indexed; call add_items first")
+        q = np.atleast_2d(np.asarray(queries, np.float32))
+        k = min(k, self.n_items)
+        scores, idx = self._searcher(q.shape[0], k)(q)
+        scores, idx = np.asarray(scores), np.asarray(idx)
+        return [[(self._ids[j], float(s)) for j, s in zip(row_i, row_s)]
+                for row_i, row_s in zip(idx, scores)]
+
+
+class RankingService:
+    """Model-scored ranking — the InferenceModel-backed ranking service."""
+
+    def __init__(self, model=None, variables=None, predict_fn=None):
+        self._im = InferenceModel(model, variables, predict_fn=predict_fn)
+
+    def rank(self, features: np.ndarray) -> np.ndarray:
+        """features (n_candidates, ...) -> scores (n_candidates,)."""
+        out = np.asarray(self._im.predict(np.asarray(features)))
+        if out.ndim > 1:
+            out = out.reshape(out.shape[0], -1)[:, -1]  # score column
+        return out
+
+
+class Recommender:
+    """Orchestrator — reference recommender service: user features ->
+    recall candidates -> join candidate features -> rank -> top-k."""
+
+    def __init__(self, feature_service: FeatureService,
+                 recall_service: RecallService,
+                 ranking_service: RankingService,
+                 user_namespace: str = "user",
+                 item_namespace: str = "item",
+                 recall_candidates: int = 100):
+        self.features = feature_service
+        self.recall = recall_service
+        self.ranking = ranking_service
+        self.user_ns = user_namespace
+        self.item_ns = item_namespace
+        self.recall_candidates = recall_candidates
+
+    def recommend(self, user_id, k: int = 10) -> List[Tuple[Any, float]]:
+        user_emb = self.features.get(self.user_ns, user_id)
+        if user_emb is None:
+            raise KeyError(f"unknown user {user_id!r}")
+        cands = self.recall.search(user_emb[None, :],
+                                   k=self.recall_candidates)[0]
+        cand_ids = [cid for cid, _ in cands]
+        item_feats = self.features.get_batch(self.item_ns, cand_ids)
+        keep = [(cid, f) for cid, f in zip(cand_ids, item_feats)
+                if f is not None]
+        if not keep:
+            return cands[:k]  # no ranking features: fall back to recall order
+        rows = np.stack([np.concatenate([user_emb, np.asarray(f).ravel()])
+                         for _, f in keep])
+        scores = self.ranking.rank(rows)
+        order = np.argsort(-scores)[:k]
+        return [(keep[i][0], float(scores[i])) for i in order]
+
+
+class RecsysHTTPServer:
+    """HTTP surface for the stack — ``POST /recommend {"user_id":..,"k":..}``
+    and ``POST /recall {"embedding": [...], "k": ..}`` (the gRPC services'
+    transport role, brokerless like Cluster Serving's frontend)."""
+
+    def __init__(self, recommender: Recommender, host: str = "127.0.0.1",
+                 port: int = 0):
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        rec = recommender
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path == "/recommend":
+                        out = rec.recommend(req["user_id"],
+                                            int(req.get("k", 10)))
+                        self._json(200, {"items": [
+                            {"id": i, "score": s} for i, s in out]})
+                    elif self.path == "/recall":
+                        emb = np.asarray(req["embedding"], np.float32)
+                        out = rec.recall.search(emb[None, :],
+                                                int(req.get("k", 10)))[0]
+                        self._json(200, {"items": [
+                            {"id": i, "score": s} for i, s in out]})
+                    else:
+                        self._json(404, {"error": f"no route {self.path}"})
+                except KeyError as e:
+                    self._json(400, {"error": f"missing/unknown key: {e}"})
+                except Exception as e:  # noqa: BLE001 — service stays up
+                    self._json(500, {"error": str(e)})
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        h, p = self._srv.server_address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "RecsysHTTPServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -90,17 +90,18 @@ def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
                nb_epoch=10, validation_data=None, checkpoint_path=None,
                log_every=10, end_trigger=None) -> TrainedModel:
     n_inputs = len(getattr(model, "inputs", ()) or ())
-
-    def pack(v):
-        # list/tuple is a multi-input pack only for multi-input models
-        if isinstance(v, (list, tuple)) and n_inputs > 1:
-            return tuple(np.asarray(a) for a in v)
-        return np.asarray(v)
+    # ONE packing rule for fit/predict/evaluate: Model._pack_inputs
+    pack = getattr(model, "_pack_inputs", np.asarray)
 
     if isinstance(x, ArrayDataSet):
         ds = x
     else:
-        ds = ArrayDataSet(pack(x), None if y is None else np.asarray(y))
+        px = pack(x)
+        if isinstance(px, tuple) and y is None:
+            # without labels a 2-tuple would be silently unpacked as (x, y)
+            raise ValueError(
+                f"multi-input model ({n_inputs} inputs) requires labels y")
+        ds = ArrayDataSet(px, None if y is None else np.asarray(y))
     opt = Optimizer(model, ds, compiled["loss"], batch_size=batch_size)
     opt.set_optim_method(compiled["optimizer"])
     opt.set_end_when(end_trigger or Trigger.max_epoch(nb_epoch))

@@ -1,0 +1,107 @@
+"""Friesian serving stack tests — reference scala/friesian gRPC services
+(feature / recall / ranking / recommender) re-designed brokerless."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from bigdl_tpu.friesian import (
+    FeatureService, RankingService, RecallService, Recommender,
+    RecsysHTTPServer,
+)
+
+
+def _stack(dim=8, n_items=200, seed=0):
+    rng = np.random.RandomState(seed)
+    items = rng.randn(n_items, dim).astype(np.float32)
+    items /= np.linalg.norm(items, axis=1, keepdims=True)
+    ids = [f"item_{i}" for i in range(n_items)]
+
+    fs = FeatureService()
+    fs.put_batch("item", ids, items)  # item feature = its embedding here
+    rs = RecallService(dim)
+    rs.add_items(ids, items)
+
+    # ranking: score = dot(user_half, item_half) via a predict_fn
+    def score(rows):
+        u, it = rows[:, :dim], rows[:, dim:]
+        return (u * it).sum(-1)
+
+    rank = RankingService(predict_fn=score)
+    rec = Recommender(fs, rs, rank, recall_candidates=50)
+    return fs, rs, rank, rec, items, ids, rng
+
+
+def test_recall_exact_topk():
+    fs, rs, _, _, items, ids, rng = _stack()
+    q = rng.randn(3, 8).astype(np.float32)
+    got = rs.search(q, k=5)
+    scores = q @ items.T
+    for row, g in zip(scores, got):
+        expect = np.argsort(-row)[:5]
+        assert [ids[i] for i in expect] == [i for i, _ in g]
+        np.testing.assert_allclose(sorted(row[expect], reverse=True),
+                                   [s for _, s in g], rtol=1e-5)
+
+
+def test_recall_incremental_add_reindexes():
+    rs = RecallService(4)
+    rs.add_items(["a"], [[1, 0, 0, 0]])
+    rs.add_items(["b"], [[0, 1, 0, 0]])
+    out = rs.search(np.array([[0.0, 1.0, 0, 0]]), k=2)[0]
+    assert out[0][0] == "b" and rs.n_items == 2
+
+
+def test_recommender_end_to_end():
+    fs, rs, rank, rec, items, ids, rng = _stack()
+    user = items[7] + 0.05 * rng.randn(8).astype(np.float32)
+    fs.put("user", "u1", user)
+    out = rec.recommend("u1", k=5)
+    assert len(out) == 5
+    # the aligned item must rank at/near the top
+    assert "item_7" in [i for i, _ in out[:3]]
+    # scores descending
+    svals = [s for _, s in out]
+    assert svals == sorted(svals, reverse=True)
+
+
+def test_recommender_unknown_user_raises():
+    _, _, _, rec, *_ = _stack()
+    import pytest
+    with pytest.raises(KeyError):
+        rec.recommend("nobody")
+
+
+def test_http_surface():
+    fs, rs, rank, rec, items, ids, rng = _stack(seed=1)
+    fs.put("user", "u2", items[3])
+    srv = RecsysHTTPServer(rec).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "/recommend",
+            data=json.dumps({"user_id": "u2", "k": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read())
+        assert len(out["items"]) == 4
+        assert out["items"][0]["id"] == "item_3"
+
+        req = urllib.request.Request(
+            srv.url + "/recall",
+            data=json.dumps({"embedding": items[5].tolist(),
+                             "k": 3}).encode())
+        with urllib.request.urlopen(req, timeout=10) as r:
+            out = json.loads(r.read())
+        assert out["items"][0]["id"] == "item_5"
+
+        # bad request -> 400, server stays up
+        req = urllib.request.Request(srv.url + "/recommend",
+                                     data=json.dumps({"k": 1}).encode())
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()

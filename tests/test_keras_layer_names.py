@@ -143,3 +143,15 @@ def test_list_of_samples_still_means_one_array():
     assert pred.shape == (32, 2)
     res = model.evaluate(x_list, y)
     assert res
+
+
+def test_multi_input_fit_without_labels_raises():
+    import pytest as _pytest
+
+    ia = K.Input((3,))
+    ib = K.Input((3,))
+    model = K.Model([ia, ib], K.Merge("sum")([ia, ib]))
+    model.compile(optimizer="adam", loss="mse")
+    xa = np.zeros((8, 3), np.float32)
+    with _pytest.raises(ValueError, match="requires"):
+        model.fit([xa, xa])
