@@ -100,13 +100,21 @@ class RecallService:
             self._jit_cache[key] = fn
         return fn
 
+    BATCH_BUCKETS = (1, 4, 16, 64, 256)
+
     def search(self, queries, k: int = 10) -> List[List[Tuple[Any, float]]]:
         if self.n_items == 0:
             raise RuntimeError("no items indexed; call add_items first")
         q = np.atleast_2d(np.asarray(queries, np.float32))
         k = min(k, self.n_items)
+        n = q.shape[0]
+        # pad to a batch bucket so arbitrary request sizes reuse a handful
+        # of compiled programs (same discipline as InferenceModel)
+        bucket = next((b for b in self.BATCH_BUCKETS if b >= n), n)
+        if bucket > n:
+            q = np.concatenate([q, np.repeat(q[-1:], bucket - n, 0)])
         scores, idx = self._searcher(q.shape[0], k)(q)
-        scores, idx = np.asarray(scores), np.asarray(idx)
+        scores, idx = np.asarray(scores)[:n], np.asarray(idx)[:n]
         return [[(self._ids[j], float(s)) for j, s in zip(row_i, row_s)]
                 for row_i, row_s in zip(idx, scores)]
 
@@ -158,70 +166,47 @@ class Recommender:
                          for _, f in keep])
         scores = self.ranking.rank(rows)
         order = np.argsort(-scores)[:k]
-        return [(keep[i][0], float(scores[i])) for i in order]
+        ranked = [(keep[i][0], float(scores[i])) for i in order]
+        if len(ranked) < k:
+            # featureless candidates backfill in recall order so callers
+            # always get k items when recall produced them
+            ranked_ids = {cid for cid, _ in ranked}
+            ranked += [(cid, s) for cid, s in cands
+                       if cid not in ranked_ids][:k - len(ranked)]
+        return ranked
 
 
 class RecsysHTTPServer:
     """HTTP surface for the stack — ``POST /recommend {"user_id":..,"k":..}``
     and ``POST /recall {"embedding": [...], "k": ..}`` (the gRPC services'
-    transport role, brokerless like Cluster Serving's frontend)."""
+    transport role, brokerless like Cluster Serving's frontend; built on the
+    shared ``serving.json_http.JsonHTTPServer`` scaffolding)."""
 
     def __init__(self, recommender: Recommender, host: str = "127.0.0.1",
                  port: int = 0):
-        import json
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        from bigdl_tpu.serving.json_http import JsonHTTPServer
 
         rec = recommender
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):
-                pass
+        def recommend(req: dict) -> dict:
+            out = rec.recommend(req["user_id"], int(req.get("k", 10)))
+            return {"items": [{"id": i, "score": s} for i, s in out]}
 
-            def _json(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        def recall(req: dict) -> dict:
+            emb = np.asarray(req["embedding"], np.float32)
+            out = rec.recall.search(emb[None, :], int(req.get("k", 10)))[0]
+            return {"items": [{"id": i, "score": s} for i, s in out]}
 
-            def do_POST(self):
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
-                    if self.path == "/recommend":
-                        out = rec.recommend(req["user_id"],
-                                            int(req.get("k", 10)))
-                        self._json(200, {"items": [
-                            {"id": i, "score": s} for i, s in out]})
-                    elif self.path == "/recall":
-                        emb = np.asarray(req["embedding"], np.float32)
-                        out = rec.recall.search(emb[None, :],
-                                                int(req.get("k", 10)))[0]
-                        self._json(200, {"items": [
-                            {"id": i, "score": s} for i, s in out]})
-                    else:
-                        self._json(404, {"error": f"no route {self.path}"})
-                except KeyError as e:
-                    self._json(400, {"error": f"missing/unknown key: {e}"})
-                except Exception as e:  # noqa: BLE001 — service stays up
-                    self._json(500, {"error": str(e)})
-
-        self._srv = ThreadingHTTPServer((host, port), Handler)
-        self._thread: Optional[threading.Thread] = None
+        self._srv = JsonHTTPServer({"/recommend": recommend,
+                                    "/recall": recall}, host, port)
 
     @property
     def url(self) -> str:
-        h, p = self._srv.server_address
-        return f"http://{h}:{p}"
+        return self._srv.url
 
     def start(self) -> "RecsysHTTPServer":
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._srv.start()
         return self
 
     def stop(self) -> None:
-        self._srv.shutdown()
-        if self._thread:
-            self._thread.join(timeout=5)
+        self._srv.stop()

@@ -1,6 +1,8 @@
 from bigdl_tpu.ppml.fl import FLServer, FLClient, FedAvg
 from bigdl_tpu.ppml.psi import PSIServer, psi_intersect, salted_hashes
 from bigdl_tpu.ppml.vfl import VFLNNTrainer
+from bigdl_tpu.ppml.fgboost import FGBoostClassifier, FGBoostRegression
 
 __all__ = ["FLServer", "FLClient", "FedAvg", "PSIServer", "psi_intersect",
-           "salted_hashes", "VFLNNTrainer"]
+           "salted_hashes", "VFLNNTrainer", "FGBoostRegression",
+           "FGBoostClassifier"]

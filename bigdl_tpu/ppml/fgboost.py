@@ -1,0 +1,255 @@
+"""FGBoost — federated gradient-boosted trees.
+
+Reference analog (unverified — mount empty): ``scala/ppml/.../fl/fgboost``
+(SURVEY.md §3.4 PPML FL: "FGBoost (federated gbt)") — horizontally-
+federated XGBoost-style regression/classification: parties hold disjoint
+sample sets, exchange per-bin gradient/hessian histograms through the FL
+server, and every party derives the SAME tree from the aggregated
+histograms (the server is a dumb aggregator; no raw samples ever leave a
+party).
+
+Design: second-order boosting (gain = G²/(H+λ) on histogram prefix sums),
+level-wise growth to ``max_depth``, trees stored as flat arrays so predict
+is a vectorized gather loop (TPU/XLA-friendly; no per-sample recursion).
+Single-party operation (``fl_client=None``) is plain local GBT — the same
+code path minus the sync.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class _Tree:
+    """Flat-array binary tree (complete, level-wise, depth d)."""
+
+    __slots__ = ("feature", "threshold", "leaf_value", "is_leaf")
+
+    def __init__(self, n_nodes: int):
+        self.feature = np.zeros(n_nodes, np.int32)
+        self.threshold = np.zeros(n_nodes, np.float32)
+        self.leaf_value = np.zeros(n_nodes, np.float32)
+        self.is_leaf = np.ones(n_nodes, bool)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        node = np.zeros(len(x), np.int64)
+        depth = int(np.log2(len(self.feature) + 1))
+        for _ in range(depth - 1):
+            leaf = self.is_leaf[node]
+            # <= matches the histogram binning (side='left' searchsorted):
+            # a sample equal to the edge goes LEFT in training too
+            go_left = x[np.arange(len(x)), self.feature[node]] \
+                <= self.threshold[node]
+            child = np.where(go_left, 2 * node + 1, 2 * node + 2)
+            node = np.where(leaf, node, child)
+        return self.leaf_value[node]
+
+
+class FGBoostRegression:
+    """Federated (or local) gradient-boosted regression trees.
+
+    ``fit(x, y, fl_client=...)``: with an ``FLClient`` every histogram
+    round syncs through the FL server; all parties finish with identical
+    models.  Objective: squared error (``objective="squared"``) or
+    logistic (``objective="logistic"`` — use ``predict_proba``)."""
+
+    def __init__(self, n_trees: int = 20, max_depth: int = 4,
+                 learning_rate: float = 0.1, n_bins: int = 32,
+                 reg_lambda: float = 1.0, gamma: float = 0.0,
+                 min_child_weight: float = 1e-3,
+                 objective: str = "squared"):
+        if objective not in ("squared", "logistic"):
+            raise ValueError("objective: squared | logistic")
+        self.n_trees = n_trees
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_bins = n_bins
+        self.reg_lambda = reg_lambda
+        self.gamma = gamma
+        self.min_child_weight = min_child_weight
+        self.objective = objective
+        self.trees: List[_Tree] = []
+        self.base_score = 0.0
+        self.bin_edges: Optional[np.ndarray] = None  # (F, n_bins-1)
+
+    # ------------------------------------------------------------------
+    def _grad_hess(self, y, pred) -> Tuple[np.ndarray, np.ndarray]:
+        if self.objective == "squared":
+            return pred - y, np.ones_like(y)
+        p = 1.0 / (1.0 + np.exp(-pred))
+        return p - y, np.maximum(p * (1 - p), 1e-6)
+
+    def _sync(self, fl_client, flat: Dict[str, np.ndarray]
+              ) -> Dict[str, np.ndarray]:
+        if fl_client is None:
+            return flat
+        tagged = {k + "@sum": v.astype(np.float32) for k, v in flat.items()}
+        out = fl_client.sync(tagged, weight=1.0)
+        return {k[:-len("@sum")]: np.asarray(v, np.float64)
+                for k, v in out.items()}
+
+    def _make_bins(self, x, fl_client):
+        # shared bin edges from the GLOBAL feature range (min/max exchanged
+        # as -max trick so a sum-free aggregate isn't needed: parties send
+        # hist of per-feature min/-min maxima via sum of one-hot... keep it
+        # simple: aggregate means of local min/max — adequate bin cover is
+        # then guaranteed by clipping into the edge bins)
+        lo = x.min(axis=0)
+        hi = x.max(axis=0)
+        agg = self._sync(fl_client, {"lo": lo, "hi": hi})
+        if fl_client is not None:
+            # sums of local mins/maxs; recover averages via the party count
+            n = self._sync(fl_client, {"n": np.ones(1)})["n"][0]
+            lo, hi = agg["lo"] / n, agg["hi"] / n
+        span = np.maximum(hi - lo, 1e-12)
+        qs = np.linspace(0, 1, self.n_bins + 1)[1:-1]
+        self.bin_edges = (lo[:, None] + span[:, None] * qs[None, :]).astype(
+            np.float32)
+
+    def _binned(self, x) -> np.ndarray:
+        out = np.empty(x.shape, np.int32)
+        for f in range(x.shape[1]):
+            out[:, f] = np.searchsorted(self.bin_edges[f], x[:, f])
+        return out  # values in [0, n_bins-1]
+
+    # ------------------------------------------------------------------
+    def fit(self, x, y, fl_client=None) -> "FGBoostRegression":
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32).reshape(-1)
+        n, n_feat = x.shape
+        self._make_bins(x, fl_client)
+        binned = self._binned(x)
+
+        base = self._sync(fl_client, {"ysum": np.array([y.sum()]),
+                                      "cnt": np.array([float(n)])})
+        mean_y = float(base["ysum"][0] / base["cnt"][0])
+        self.base_score = (mean_y if self.objective == "squared"
+                           else float(np.log(np.clip(mean_y, 1e-6, 1 - 1e-6)
+                                             / (1 - np.clip(mean_y, 1e-6,
+                                                            1 - 1e-6)))))
+        pred = np.full(n, self.base_score, np.float32)
+        self.trees = []
+
+        n_nodes = 2 ** self.max_depth - 1
+
+        for _ in range(self.n_trees):
+            g, h = self._grad_hess(y, pred)
+            tree = _Tree(n_nodes)
+            node_of = np.zeros(n, np.int64)  # current node per sample
+            # per-node G/H totals for leaf values + gain baseline
+            for level in range(self.max_depth - 1):
+                lo_n, hi_n = 2 ** level - 1, 2 ** (level + 1) - 1
+                frontier = range(lo_n, hi_n)
+                # histograms for every frontier node in one flat dict
+                hists = {}
+                for node in frontier:
+                    mask = node_of == node
+                    gb = binned[mask]
+                    gw, hw = g[mask], h[mask]
+                    hg = np.zeros((n_feat, self.n_bins))
+                    hh = np.zeros((n_feat, self.n_bins))
+                    for f in range(n_feat):
+                        hg[f] = np.bincount(gb[:, f], weights=gw,
+                                            minlength=self.n_bins)
+                        hh[f] = np.bincount(gb[:, f], weights=hw,
+                                            minlength=self.n_bins)
+                    hists[f"n{node}/g"] = hg
+                    hists[f"n{node}/h"] = hh
+                hists = self._sync(fl_client, hists)
+
+                for node in frontier:
+                    hg, hh = hists[f"n{node}/g"], hists[f"n{node}/h"]
+                    G = hg.sum(axis=1)[0:1].sum()  # same for every feature
+                    H = hh.sum(axis=1)[0:1].sum()
+                    if H < self.min_child_weight:
+                        continue  # stays a leaf
+                    gl = np.cumsum(hg, axis=1)[:, :-1]   # (F, bins-1)
+                    hl = np.cumsum(hh, axis=1)[:, :-1]
+                    gr, hr = G - gl, H - hl
+                    lam = self.reg_lambda
+                    gain = (gl ** 2 / (hl + lam) + gr ** 2 / (hr + lam)
+                            - G ** 2 / (H + lam)) / 2 - self.gamma
+                    ok = (hl >= self.min_child_weight) & \
+                         (hr >= self.min_child_weight)
+                    gain = np.where(ok, gain, -np.inf)
+                    f_best, b_best = np.unravel_index(np.argmax(gain),
+                                                      gain.shape)
+                    if not np.isfinite(gain[f_best, b_best]) or \
+                            gain[f_best, b_best] <= 0:
+                        continue
+                    tree.is_leaf[node] = False
+                    tree.feature[node] = f_best
+                    tree.threshold[node] = self.bin_edges[f_best, b_best]
+                    mask = node_of == node
+                    go_left = binned[mask, f_best] <= b_best
+                    children = np.where(go_left, 2 * node + 1, 2 * node + 2)
+                    node_of[mask] = children
+
+            # leaf values from aggregated G/H of terminal nodes
+            leaf_stats = {}
+            for node in range(n_nodes):
+                mask = node_of == node
+                leaf_stats[f"l{node}"] = np.array(
+                    [g[mask].sum(), h[mask].sum()])
+            leaf_stats = self._sync(fl_client, leaf_stats)
+            for node in range(n_nodes):
+                G, H = leaf_stats[f"l{node}"]
+                tree.leaf_value[node] = (-G / (H + self.reg_lambda)
+                                         * self.learning_rate
+                                         if H > 0 else 0.0)
+            self.trees.append(tree)
+            pred = pred + tree.predict(x)
+        return self
+
+    # ------------------------------------------------------------------
+    def predict(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        out = np.full(len(x), self.base_score, np.float32)
+        for t in self.trees:
+            out += t.predict(x)
+        return out
+
+    def predict_proba(self, x) -> np.ndarray:
+        return 1.0 / (1.0 + np.exp(-self.predict(x)))
+
+    # ------------------------------------------------------------------
+    def save(self, path: str) -> None:
+        blobs = {"base_score": np.array([self.base_score]),
+                 "bin_edges": self.bin_edges,
+                 "objective": np.frombuffer(
+                     self.objective.encode(), np.uint8)}
+        for i, t in enumerate(self.trees):
+            blobs[f"t{i}/feature"] = t.feature
+            blobs[f"t{i}/threshold"] = t.threshold
+            blobs[f"t{i}/leaf_value"] = t.leaf_value
+            blobs[f"t{i}/is_leaf"] = t.is_leaf
+        np.savez(path, **blobs)
+
+    @staticmethod
+    def load(path: str) -> "FGBoostRegression":
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        model = FGBoostRegression()
+        model.base_score = float(data["base_score"][0])
+        model.bin_edges = data["bin_edges"]
+        model.objective = bytes(data["objective"]).decode()
+        i = 0
+        while f"t{i}/feature" in data:
+            t = _Tree(len(data[f"t{i}/feature"]))
+            t.feature = data[f"t{i}/feature"]
+            t.threshold = data[f"t{i}/threshold"]
+            t.leaf_value = data[f"t{i}/leaf_value"]
+            t.is_leaf = data[f"t{i}/is_leaf"]
+            model.trees.append(t)
+            i += 1
+        return model
+
+
+class FGBoostClassifier(FGBoostRegression):
+    """Binary classifier: logistic objective + 0.5 threshold."""
+
+    def __init__(self, **kw):
+        kw.setdefault("objective", "logistic")
+        super().__init__(**kw)
+
+    def predict_class(self, x) -> np.ndarray:
+        return (self.predict_proba(x) >= 0.5).astype(np.int32)

@@ -59,7 +59,12 @@ class FedAvg:
     def result(self) -> Dict[str, np.ndarray]:
         if self._acc is None:
             raise RuntimeError("no updates to aggregate")
-        return {k: (v / self._weight).astype(np.float32)
+        # keys containing "@sum" aggregate as plain weighted SUMS
+        # (histogram exchange for FGBoost); everything else is the weighted
+        # average.  Substring match: client-side pytree flattening decorates
+        # keys (e.g. "['lo@sum']"), so suffix tests would never fire.
+        return {k: (v if "@sum" in k else v / self._weight)
+                .astype(np.float32)
                 for k, v in self._acc.items()}
 
 

@@ -1,0 +1,66 @@
+"""Shared JSON-over-HTTP micro server.
+
+One implementation of the threaded JSON endpoint scaffolding used by the
+serving frontends (Cluster-Serving HTTP frontend, Friesian recsys surface)
+so error mapping, socket lifecycle, and threading cannot drift between
+copies.  Routes are ``{"/path": fn(request_dict) -> response_dict}``;
+handler exceptions map to 400 (KeyError — missing/unknown key) or 500,
+and the server always stays up.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+
+class JsonHTTPServer:
+    def __init__(self, routes: Dict[str, Callable[[dict], dict]],
+                 host: str = "127.0.0.1", port: int = 0):
+        server_routes = dict(routes)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                try:
+                    fn = server_routes.get(self.path)
+                    if fn is None:
+                        self._json(404, {"error": f"no route {self.path}"})
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    self._json(200, fn(req))
+                except KeyError as e:
+                    self._json(400, {"error": f"missing/unknown key: {e}"})
+                except Exception as e:  # noqa: BLE001 — service stays up
+                    self._json(500, {"error": str(e)})
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        h, p = self._srv.server_address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "JsonHTTPServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()  # release the listening socket
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -105,3 +105,21 @@ def test_http_surface():
             assert e.code == 400
     finally:
         srv.stop()
+
+
+def test_recommender_backfills_to_k_when_features_sparse():
+    fs, rs, rank, rec, items, ids, rng = _stack(seed=2)
+    # wipe most item features: only 2 candidates will be rankable
+    fs._kv["item"] = {k: v for k, v in list(fs._kv["item"].items())[:2]}
+    fs.put("user", "u3", items[0])
+    out = rec.recommend("u3", k=10)
+    assert len(out) == 10  # backfilled from recall order
+
+
+def test_recall_bucketed_batches_match():
+    _, rs, *_ , rng = _stack(seed=3)
+    q = rng.randn(3, 8).astype(np.float32)
+    one_by_one = [rs.search(q[i:i + 1], k=4)[0] for i in range(3)]
+    batched = rs.search(q, k=4)
+    for a, b in zip(one_by_one, batched):
+        assert [i for i, _ in a] == [i for i, _ in b]
