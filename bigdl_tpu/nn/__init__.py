@@ -46,6 +46,8 @@ from bigdl_tpu.nn.layers_misc import (
     RoiPooling, SpatialShareConvolution, SpatialDilatedConvolution,
     CTCCriterion, ClassSimplexCriterion, WeightedMSECriterion,
     Index, BifurcateSplitTable, NegativeEntropyPenalty,
+    Contiguous, Copy, Unfold, SpatialDropout3D, VolumetricDropout,
+    MultiLabelMarginCriterion, SmoothL1CriterionWithWeights,
 )
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, LSTMPeephole, GRU, BiRecurrent, TimeDistributed,

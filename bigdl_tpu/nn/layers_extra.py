@@ -942,37 +942,37 @@ class LRN(Module):
 SpatialCrossMapLRN = LRN
 
 
-class SpatialDropout2D(Module):
+class _ChannelDropout(Module):
+    """Drop whole channels: mask (N, 1 x spatial_rank, C).  Shared by the
+    SpatialDropout1D/2D/3D trio so edge cases (p=1.0, dtype) stay
+    identical."""
+
+    spatial_rank = 2
+
+    def __init__(self, p: float = 0.5, name=None):
+        super().__init__(name)
+        self.p = p
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, EMPTY
+        if rng is None:
+            raise ValueError(
+                f"{type(self).__name__} in training mode requires rng")
+        keep = 1.0 - self.p
+        shape = (x.shape[0],) + (1,) * self.spatial_rank + (x.shape[-1],)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), EMPTY
+
+
+class SpatialDropout2D(_ChannelDropout):
     """Drop whole channels — keras/reference ``SpatialDropout2D`` (NHWC)."""
 
-    def __init__(self, p: float = 0.5, name=None):
-        super().__init__(name)
-        self.p = p
-
-    def forward(self, params, state, x, training=False, rng=None):
-        if not training or self.p == 0.0:
-            return x, EMPTY
-        if rng is None:
-            raise ValueError("SpatialDropout2D in training mode requires rng")
-        keep = 1.0 - self.p
-        mask = jax.random.bernoulli(
-            rng, keep, (x.shape[0], 1, 1, x.shape[-1]))
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), EMPTY
+    spatial_rank = 2
 
 
-class SpatialDropout1D(Module):
-    def __init__(self, p: float = 0.5, name=None):
-        super().__init__(name)
-        self.p = p
-
-    def forward(self, params, state, x, training=False, rng=None):
-        if not training or self.p == 0.0:
-            return x, EMPTY
-        if rng is None:
-            raise ValueError("SpatialDropout1D in training mode requires rng")
-        keep = 1.0 - self.p
-        mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, x.shape[-1]))
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), EMPTY
+class SpatialDropout1D(_ChannelDropout):
+    spatial_rank = 1
 
 
 class GaussianNoise(Module):

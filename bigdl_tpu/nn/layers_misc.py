@@ -15,6 +15,7 @@ import numpy as np
 from bigdl_tpu.nn import init as init_mod
 from bigdl_tpu.nn.criterion import Criterion, _reduce
 from bigdl_tpu.nn.layers import Conv2D
+from bigdl_tpu.nn.layers_extra import _ChannelDropout
 from bigdl_tpu.nn.module import EMPTY, Module
 
 __all__ = [
@@ -22,6 +23,8 @@ __all__ = [
     "RoiPooling", "SpatialShareConvolution", "SpatialDilatedConvolution",
     "CTCCriterion", "ClassSimplexCriterion", "WeightedMSECriterion",
     "Index", "BifurcateSplitTable", "NegativeEntropyPenalty",
+    "Contiguous", "Copy", "Unfold", "SpatialDropout3D", "VolumetricDropout",
+    "MultiLabelMarginCriterion", "SmoothL1CriterionWithWeights",
 ]
 
 
@@ -306,3 +309,111 @@ class NegativeEntropyPenalty(Criterion):
     def forward(self, input, target=None):
         p = jnp.clip(input, 1e-12, 1.0)
         return self.beta * jnp.sum(p * jnp.log(p))
+
+
+class Contiguous(Module):
+    """No-op on TPU (XLA owns layout) — reference ``nn/Contiguous.scala``."""
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return x, EMPTY
+
+
+class Copy(Module):
+    """Identity copy — reference ``nn/Copy.scala``."""
+
+    def forward(self, params, state, x, training=False, rng=None):
+        return jnp.asarray(x), EMPTY
+
+
+class Unfold(Module):
+    """Extract sliding patches (im2col) — reference ``nn/Unfold``/torch
+    ``nn.Unfold`` semantics on NHWC: (N,H,W,C) -> (N, L, k*k*C) with
+    channel-major patch rows (C, kh, kw), matching
+    ``conv_general_dilated_patches``."""
+
+    def __init__(self, kernel_size, stride=1, padding: int = 0,
+                 dilation=1, name=None):
+        super().__init__(name)
+        as_pair = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+        self.kernel_size = as_pair(kernel_size)
+        self.stride = as_pair(stride)
+        self.padding = as_pair(padding) if not isinstance(padding, str) \
+            else padding
+        self.dilation = as_pair(dilation)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            ph, pw = self.padding
+            pad = [(ph, ph), (pw, pw)]
+        patches = jax.lax.conv_general_dilated_patches(
+            x, self.kernel_size, self.stride, pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        n, oh, ow, f = patches.shape
+        return patches.reshape(n, oh * ow, f), EMPTY
+
+
+class SpatialDropout3D(_ChannelDropout):
+    """Channel-wise dropout on NDHWC volumes — keras ``SpatialDropout3D`` /
+    reference ``nn/VolumetricDropout``-style semantics (shares the
+    _ChannelDropout helper with the 1D/2D variants)."""
+
+    spatial_rank = 3
+
+
+VolumetricDropout = SpatialDropout3D
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-class multi-label hinge — reference
+    ``nn/MultiLabelMarginCriterion.scala`` (torch semantics: target rows
+    hold class indices, padded with -1; loss sums
+    ``max(0, 1 - (x[target] - x[other])) / C`` over target x non-target
+    pairs)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x = jnp.atleast_2d(input)
+        t = jnp.atleast_2d(jnp.asarray(target, jnp.int32))
+        n, c = x.shape
+        # torch semantics: -1 TERMINATES the row; entries after it (even
+        # non-negative garbage) are ignored
+        valid = (t >= 0) & (jnp.cumsum(t < 0, axis=1) == 0)  # (n, s)
+        t_safe = jnp.maximum(t, 0)
+        is_target = jnp.zeros((n, c), bool)
+        rows = jnp.repeat(jnp.arange(n), t.shape[1])
+        # max, not set: padded entries map to class 0 with valid=False and
+        # must not overwrite a genuine class-0 target
+        is_target = is_target.at[rows, t_safe.reshape(-1)].max(
+            valid.reshape(-1), mode="drop")
+        x_t = jnp.take_along_axis(x, t_safe, axis=1)         # (n, s)
+        # margins for every (target j, class i) pair; zero out i in targets
+        margins = jnp.maximum(
+            0.0, 1.0 - (x_t[:, :, None] - x[:, None, :]))    # (n, s, c)
+        margins = margins * valid[:, :, None]
+        margins = margins * (~is_target)[:, None, :]
+        per_sample = margins.sum(axis=(1, 2)) / c
+        return _reduce(per_sample, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Per-element weighted smooth-L1 — reference
+    ``nn/SmoothL1CriterionWithWeights.scala`` (the Fast-RCNN bbox loss:
+    inside/outside weights; ``target = (y, w_in, w_out)``)."""
+
+    def __init__(self, sigma: float = 1.0, size_average: bool = True):
+        self.sigma2 = sigma * sigma
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        y, w_in, w_out = target
+        d = w_in * (input - y)
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * d * d,
+                         ad - 0.5 / self.sigma2)
+        return _reduce(w_out * loss, self.size_average)

@@ -292,3 +292,86 @@ def test_negative_entropy_penalty():
     # criterion's purpose (discourage overconfident predictions)
     assert v_peaked > v_uniform
     assert v_uniform < 0 and v_peaked < 0
+
+
+def test_unfold_matches_manual_patches():
+    rng = np.random.RandomState(12)
+    x = rng.randn(1, 5, 5, 2).astype(np.float32)
+    layer = nn.Unfold(3, stride=1, padding=0)
+    y, _ = layer.apply({"params": {}, "state": {}}, x)
+    y = np.asarray(y)
+    assert y.shape == (1, 9, 18)
+    # first patch, channel-major (C, kh, kw) rows
+    manual = np.transpose(x[0, :3, :3, :], (2, 0, 1)).reshape(-1)
+    np.testing.assert_allclose(y[0, 0], manual, rtol=1e-6)
+
+
+def test_multilabel_margin_torch_parity():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(13)
+    x = rng.randn(4, 6).astype(np.float32)
+    # target rows: class indices padded with -1
+    t = np.array([[1, 3, -1, -1, -1, -1],
+                  [0, -1, -1, -1, -1, -1],
+                  [2, 4, 5, -1, -1, -1],
+                  [5, -1, -1, -1, -1, -1]], np.int64)
+    ours = float(nn.MultiLabelMarginCriterion()(jnp.asarray(x), t))
+    ref = float(torch.nn.MultiLabelMarginLoss()(torch.tensor(x),
+                                                torch.tensor(t)))
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_smooth_l1_with_weights():
+    rng = np.random.RandomState(14)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    w_in = rng.rand(3, 4).astype(np.float32)
+    w_out = rng.rand(3, 4).astype(np.float32)
+    crit = nn.SmoothL1CriterionWithWeights(sigma=1.0, size_average=False)
+    got = float(crit(jnp.asarray(x), (y, w_in, w_out)))
+    d = w_in * (x - y)
+    ad = np.abs(d)
+    expect = (w_out * np.where(ad < 1, 0.5 * d * d, ad - 0.5)).sum()
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_spatial_dropout3d_channelwise():
+    rng = np.random.RandomState(15)
+    x = np.ones((2, 3, 4, 5, 6), np.float32)
+    layer = nn.SpatialDropout3D(0.5)
+    y, _ = layer.forward({}, {}, x, training=True,
+                         rng=jax.random.PRNGKey(0))
+    y = np.asarray(y)
+    # each channel is either fully zero or fully scaled
+    per_channel = y.reshape(2, -1, 6)
+    for b in range(2):
+        for ch in range(6):
+            vals = np.unique(per_channel[b, :, ch])
+            assert len(vals) == 1
+    # identity in eval mode
+    y2, _ = layer.forward({}, {}, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y2), x)
+
+
+def test_contiguous_copy_identity():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    for layer in (nn.Contiguous(), nn.Copy()):
+        y, _ = layer.apply({"params": {}, "state": {}}, x)
+        np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_multilabel_margin_garbage_after_terminator():
+    torch = pytest.importorskip("torch")
+    x = np.random.RandomState(16).randn(1, 6).astype(np.float32)
+    t = np.array([[2, -1, 4, 0, 0, 0]], np.int64)  # garbage after -1
+    ours = float(nn.MultiLabelMarginCriterion()(jnp.asarray(x), t))
+    ref = float(torch.nn.MultiLabelMarginLoss()(torch.tensor(x),
+                                                torch.tensor(t)))
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_spatial_dropout3d_p1_returns_zeros():
+    x = np.ones((1, 2, 2, 2, 3), np.float32)
+    y, _ = nn.SpatialDropout3D(1.0).forward({}, {}, x, training=True,
+                                            rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(y), np.zeros_like(x))
