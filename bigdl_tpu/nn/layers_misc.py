@@ -391,13 +391,20 @@ class MultiLabelMarginCriterion(Criterion):
         is_target = is_target.at[rows, t_safe.reshape(-1)].max(
             valid.reshape(-1), mode="drop")
         x_t = jnp.take_along_axis(x, t_safe, axis=1)         # (n, s)
-        # margins for every (target j, class i) pair; zero out i in targets
-        margins = jnp.maximum(
-            0.0, 1.0 - (x_t[:, :, None] - x[:, None, :]))    # (n, s, c)
-        margins = margins * valid[:, :, None]
-        margins = margins * (~is_target)[:, None, :]
-        per_sample = margins.sum(axis=(1, 2)) / c
-        return _reduce(per_sample, self.size_average)
+        non_target = (~is_target).astype(x.dtype)            # (n, c)
+
+        # scan over target slots: O(n*c) live memory instead of the (n,s,c)
+        # cube (s == c in torch's calling convention, so the cube is O(n*c²))
+        def slot(acc, sj):
+            xj, vj = sj                                       # (n,), (n,)
+            margins = jnp.maximum(0.0, 1.0 - (xj[:, None] - x))  # (n, c)
+            contrib = (margins * non_target).sum(axis=1) * vj
+            return acc + contrib, None
+
+        per_sample, _ = jax.lax.scan(
+            slot, jnp.zeros(n, x.dtype),
+            (x_t.T, valid.T.astype(x.dtype)))
+        return _reduce(per_sample / c, self.size_average)
 
 
 class SmoothL1CriterionWithWeights(Criterion):

@@ -4,7 +4,7 @@ from bigdl_tpu.optim.optim_method import (
 )
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Step, MultiStep, Exponential, NaturalExp,
-    Poly, Warmup, SequentialSchedule,
+    Poly, Warmup, SequentialSchedule, Plateau,
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (

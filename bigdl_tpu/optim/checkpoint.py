@@ -54,9 +54,15 @@ def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
     np.savez(os.path.join(tmp, "opt_state.npz"), **_flatten_with_paths(opt_state))
     np.savez(os.path.join(tmp, "model_state.npz"),
              **_flatten_with_paths(model_state))
+    def _jsonable(v):
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            return True
+        if isinstance(v, dict):  # nested scalar dicts (e.g. schedule_state)
+            return all(_jsonable(x) for x in v.values())
+        return False
+
     manifest = {"step": step, "driver_state": {
-        k: v for k, v in driver_state.items()
-        if isinstance(v, (int, float, str, bool))}}
+        k: v for k, v in driver_state.items() if _jsonable(v)}}
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f)
     if os.path.exists(d):

@@ -389,6 +389,9 @@ class Optimizer:
 
     def _save_checkpoint(self, step_engine, state):
         state["loss"] = float(state["loss"])
+        schedule = getattr(self.optim_method, "schedule", None)
+        if schedule is not None and hasattr(schedule, "state_dict"):
+            state = dict(state, schedule_state=schedule.state_dict())
         ckpt.save_checkpoint(
             self._ckpt_path, state["iteration"],
             flat_params=np.asarray(step_engine.flat_params),
@@ -409,6 +412,24 @@ class Optimizer:
                                              state["iteration"])
         if results:
             state["score"] = results[0].result
+            # reduce-on-plateau feedback (reference SGD.Plateau): the
+            # schedule decides host-side; an LR change needs a recompile
+            schedule = getattr(self.optim_method, "schedule", None)
+            if schedule is not None and hasattr(schedule, "on_score"):
+                monitor = getattr(schedule, "monitor", None)
+                picked = results[0]
+                if monitor is not None:
+                    matches = [r for r in results if r.name == monitor]
+                    if not matches:
+                        raise ValueError(
+                            f"Plateau monitor {monitor!r} not among "
+                            f"validation methods {[r.name for r in results]}")
+                    picked = matches[0]
+                if schedule.on_score(float(picked.result)):
+                    log.info("Plateau: reducing LR (factor now %g); "
+                             "recompiling train step",
+                             schedule.current_factor)
+                    step_engine._train = step_engine._build_train()
 
     def _try_resume(self, step_engine, state):
         latest = ckpt.latest_checkpoint(self._ckpt_path)
@@ -426,6 +447,13 @@ class Optimizer:
         step_engine.model_state = put_sharded(model_state, step_engine._rep)
         state.update(driver)
         state["epoch_finished"] = False
+        sched_state = state.pop("schedule_state", None)
+        schedule = getattr(self.optim_method, "schedule", None)
+        if sched_state is not None and schedule is not None \
+                and hasattr(schedule, "load_state_dict"):
+            schedule.load_state_dict(sched_state)
+            # the restored factor must be baked into the compiled step
+            step_engine._train = step_engine._build_train()
         log.info("resumed from %s (iteration %d, epoch %d)", latest,
                  state["iteration"], state["epoch"])
 

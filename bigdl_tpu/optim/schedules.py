@@ -8,7 +8,7 @@ multiplier-resolved absolute lr``, traceable inside jit (pure jnp math on the
 step counter, no data-dependent python control flow).
 """
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -122,3 +122,75 @@ class SequentialSchedule(LearningRateSchedule):
             result = val if result is None else jnp.where(active, val, result)
             offset += iters
         return result if result is not None else out
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce-on-plateau — reference ``SGD.Plateau(monitor, factor,
+    patience, mode, epsilon, cooldown, minLr)``.
+
+    Score-driven: the Optimizer feeds validation results to ``on_score``
+    after every validation trigger.  When the monitored score stops
+    improving for ``patience`` validations, the factor shrinks and the
+    driver recompiles the train step with the new effective LR (drops are
+    rare, so the recompile cost is negligible over a run)."""
+
+    def __init__(self, factor: float = 0.1, patience: int = 10,
+                 mode: str = "max", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0,
+                 monitor: Optional[str] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode: min | max")
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.monitor = monitor  # validation-method name; None = first result
+        self.current_factor = 1.0
+        self._best = None
+        self._bad = 0
+        self._cooling = 0
+        self._last_base_lr: Optional[float] = None
+
+    # -- checkpointable state (driver saves/restores across resume) ---------
+    def state_dict(self) -> dict:
+        return {"current_factor": self.current_factor, "best": self._best,
+                "bad": self._bad, "cooling": self._cooling}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.current_factor = float(d["current_factor"])
+        self._best = d["best"]
+        self._bad = int(d["bad"])
+        self._cooling = int(d["cooling"])
+
+    def on_score(self, score: float) -> bool:
+        """Record one validation score; returns True when the LR factor
+        changed (caller must recompile)."""
+        improved = (self._best is None
+                    or (self.mode == "max" and score > self._best + self.epsilon)
+                    or (self.mode == "min" and score < self._best - self.epsilon))
+        if improved:
+            self._best = score
+            self._bad = 0
+            return False
+        if self._cooling > 0:
+            self._cooling -= 1
+            return False
+        self._bad += 1
+        if self._bad > self.patience:
+            self._bad = 0
+            self._cooling = self.cooldown
+            if (self._last_base_lr is not None
+                    and self._last_base_lr * self.current_factor
+                    <= self.min_lr):
+                return False  # already floored: no change, no recompile
+            self.current_factor = self.current_factor * self.factor
+            return True
+        return False
+
+    def __call__(self, lr, step):
+        # current_factor is a host float baked at trace time; the Optimizer
+        # rebuilds the compiled step whenever on_score changes it
+        self._last_base_lr = float(lr)
+        return max(lr * self.current_factor, self.min_lr)

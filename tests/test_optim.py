@@ -158,3 +158,83 @@ class TestValidationMethods:
         tgt = jnp.argmax(out, -1)
         s, c = optim.Top5Accuracy().batch_stats(out, tgt)
         assert float(s) == 10.0
+
+
+def test_plateau_schedule_semantics():
+    from bigdl_tpu.optim import Plateau
+
+    p = Plateau(factor=0.5, patience=2, mode="max", epsilon=0.0)
+    assert p.on_score(0.5) is False        # first score = best
+    assert p.on_score(0.6) is False        # improved
+    assert p.on_score(0.6) is False        # bad 1
+    assert p.on_score(0.6) is False        # bad 2
+    assert p.on_score(0.6) is True         # bad 3 > patience -> drop
+    assert p.current_factor == 0.5
+    assert p.on_score(0.9) is False        # new best resets
+    assert p(1.0, 0) == 0.5                # factor applied
+    floor = Plateau(factor=0.1, patience=0, min_lr=0.05)
+    floor.current_factor = 0.001
+    assert floor(1.0, 0) == 0.05           # min_lr floor
+
+
+def test_plateau_wired_through_validation(tmp_path):
+    """A stalling validation score must shrink the LR factor mid-run."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim import Plateau
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 4).astype(np.float32)
+    y = rng.randint(0, 2, 256).astype(np.int32)  # pure noise -> no progress
+    plateau = Plateau(factor=0.5, patience=1, mode="max", epsilon=1e-6)
+    method = optim.SGD(learning_rate=0.05, learning_rate_schedule=plateau)
+    model = Sequential([nn.Linear(4, 2)])
+    opt = optim.Optimizer(model, ArrayDataSet(x, y), nn.CrossEntropyCriterion(),
+                          batch_size=64)
+    opt.set_optim_method(method)
+    opt.set_end_when(optim.Trigger.max_epoch(8))
+    opt.set_validation(optim.Trigger.every_epoch(),
+                       ArrayDataSet(x[:64], np.zeros(64, np.int32)),
+                       [optim.Top1Accuracy()])
+    opt.log_every = 1000
+    opt.optimize()
+    assert plateau.current_factor < 1.0
+
+
+def test_plateau_state_survives_checkpoint_resume(tmp_path):
+    from bigdl_tpu import nn
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim import Plateau
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(128, 4).astype(np.float32)
+    y = rng.randint(0, 2, 128).astype(np.int32)
+
+    def make_opt(plateau):
+        method = optim.SGD(learning_rate=0.05,
+                           learning_rate_schedule=plateau)
+        model = Sequential([nn.Linear(4, 2)])
+        opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                              nn.CrossEntropyCriterion(), batch_size=64)
+        opt.set_optim_method(method)
+        opt.set_checkpoint(str(tmp_path), optim.Trigger.every_epoch())
+        opt.set_validation(optim.Trigger.every_epoch(),
+                           ArrayDataSet(x[:64], np.zeros(64, np.int32)),
+                           [optim.Top1Accuracy()])
+        opt.log_every = 1000
+        return opt
+
+    p1 = Plateau(factor=0.5, patience=0, mode="max", epsilon=1e-6)
+    opt1 = make_opt(p1)
+    opt1.set_end_when(optim.Trigger.max_epoch(4))
+    opt1.optimize()
+    assert p1.current_factor < 1.0  # dropped during the stalled run
+
+    # fresh process analog: new schedule instance resumes from checkpoint
+    p2 = Plateau(factor=0.5, patience=0, mode="max", epsilon=1e-6)
+    opt2 = make_opt(p2)
+    opt2.set_end_when(optim.Trigger.max_epoch(5))
+    opt2.optimize()
+    assert p2.current_factor <= p1.current_factor  # restored, not reset
