@@ -69,14 +69,18 @@ def main():
     y_dev = step.shard_batch(y)
 
     # warmup / compile
-    step.train_step_device(0, rng, x_dev, y_dev)
-    jax.block_until_ready(step.flat_params)
+    loss = step.train_step_device(0, rng, x_dev, y_dev)
+    float(np.asarray(loss))  # value fetch, not just ready-handle
 
     t0 = time.perf_counter()
     for i in range(steps):
         loss = step.train_step_device(i + 1, rng, x_dev, y_dev)
-    jax.block_until_ready(loss)
+    # fetch the VALUE of the final loss: it is data-dependent on every
+    # step in the chain, so the proxied backend cannot acknowledge early
+    # the way a bare block_until_ready handle can over the tunnel
+    final = float(np.asarray(loss))
     dt = time.perf_counter() - t0
+    assert np.isfinite(final), final
 
     img_per_sec_chip = batch * steps / dt / n_chips
     print(json.dumps({

@@ -10,6 +10,7 @@ Orca-equivalent Estimator exactly like the reference routes through Orca.
 
 from bigdl_tpu.forecast.tsdataset import TSDataset
 from bigdl_tpu.forecast.xshards_tsdataset import XShardsTSDataset
+from bigdl_tpu.forecast.autots import AutoTSEstimator, TSPipeline
 from bigdl_tpu.forecast.forecaster import (
     LSTMForecaster, NBeatsForecaster, Seq2SeqForecaster, TCNForecaster,
     AutoformerForecaster,
@@ -19,7 +20,7 @@ from bigdl_tpu.forecast.detector import (
 )
 
 __all__ = [
-    "TSDataset", "XShardsTSDataset",
+    "TSDataset", "XShardsTSDataset", "AutoTSEstimator", "TSPipeline",
     "TCNForecaster", "LSTMForecaster", "Seq2SeqForecaster",
     "NBeatsForecaster", "AutoformerForecaster",
     "ThresholdDetector", "AEDetector", "DBScanDetector",
