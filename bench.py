@@ -1,14 +1,32 @@
-"""Benchmark harness — prints ONE JSON line.
+"""Benchmark harness — prints ONE JSON line (the LAST line of stdout).
 
-Metric: ResNet-50 ImageNet-shape training throughput (images/sec/chip) on the
-available accelerator — the north-star metric family from BASELINE.json
-("ResNet-50 images/sec/chip"). ``vs_baseline`` is reported against the
-BASELINE.json published numbers when present; the reference published no
-numbers (``published: {}``), so the ratio is against a fixed nominal target
-recorded here.
+Metric: ResNet-50 ImageNet-shape training throughput (images/sec/chip), the
+north-star metric family from BASELINE.json ("ResNet-50 images/sec/chip").
+``vs_baseline`` is reported against a fixed nominal target recorded here (the
+reference published no numbers — BASELINE.json ``published: {}``).
+
+Robustness (round-1 lesson: the TPU backend init can fail *or hang*, and a
+round without a parsed JSON line is a round with zero perf evidence):
+
+- the default invocation is an ORCHESTRATOR: it runs the real bench in a
+  subprocess (``--worker tpu``) under a bounded timeout, and on failure or
+  timeout falls back to a CPU smoke subprocess (``--worker cpu``), annotating
+  the JSON with an ``"error"`` field.  The last stdout line is ALWAYS one
+  JSON object with ``metric/value/unit/vs_baseline``.
+- the TPU worker reports an MFU accounting next to the throughput number:
+  FLOPs/step from XLA's own cost analysis of the compiled train step
+  (analytic ResNet-50 fallback), and the chip's bf16 peak from device_kind.
+
+Env knobs: ``BENCH_TPU_TIMEOUT`` (s, default 1800 — first ResNet-50 compile
+over the tunnel takes minutes; later runs hit ``.jax_cache``),
+``BENCH_CPU_TIMEOUT`` (s, default 900), ``BENCH_SWEEP=1`` adds a per-chip
+batch-size sweep to the TPU worker JSON (extra compiles).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -20,16 +38,60 @@ import numpy as np
 # img/s/chip order of magnitude.
 BASELINE_IMG_PER_SEC_PER_CHIP = 1000.0
 
+# bf16 matmul peak FLOP/s by TPU generation (public spec sheets), keyed by
+# substrings of jax Device.device_kind. Used only for the MFU denominator.
+_PEAK_BF16 = [
+    ("v6", 918e12),          # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),     # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4 lite", 138e12),     # v4i
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def main():
-    import os
+# Analytic fallback: ResNet-50 @224 forward ~4.09 GMACs => ~8.2 GFLOPs;
+# training (fwd + input-grad + weight-grad) ~3x forward.
+_RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.09e9
 
+
+def _peak_flops(device_kind: str):
+    kind = (device_kind or "").lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def _compiled_flops(step, step_args):
+    """FLOPs/step of the compiled train step via XLA cost analysis; None on
+    any backend that doesn't expose it."""
+    try:
+        lowered = step._train.lower(*step_args)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost["flops"])
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _run_bench(platform: str) -> dict:
+    """The actual measurement (runs inside a worker subprocess)."""
     import jax
 
-    from bigdl_tpu.runtime.engine import enable_compile_cache
+    if platform == "cpu":
+        # this image's axon plugin ignores the JAX_PLATFORMS env var; the
+        # config update is what actually forces CPU (tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from bigdl_tpu.runtime.engine import enable_compile_cache
 
-    enable_compile_cache(os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+        enable_compile_cache(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
     import jax.numpy as jnp
 
@@ -45,53 +107,145 @@ def main():
     mesh = build_mesh(MeshSpec(data=n_chips), devices=devices)
 
     if on_tpu:
-        # batch 768/chip: measured knee of the throughput curve on v5e-class
-        # chips (128→2.6k, 256→5.3k, 512→9.6k, 768→12.1k img/s/chip); large
-        # per-chip batch keeps the MXU systolic array full
+        # batch 768/chip: measured knee of the throughput curve on this
+        # chip (128→2.6k, 256→5.3k, 512→9.6k, 768→11.7-12.1k img/s/chip);
+        # large per-chip batch keeps the MXU systolic array full
         batch_per_chip, hw, steps = 768, 224, 10
-    else:  # CPU smoke fallback so bench.py always emits a line
+    else:  # CPU smoke so bench.py always emits a line
         batch_per_chip, hw, steps = 4, 64, 3
 
-    batch = batch_per_chip * n_chips
-    model = resnet50(classes=1000)
-    rng = jax.random.PRNGKey(0)
-    x = np.random.RandomState(0).rand(batch, hw, hw, 3).astype(np.float32)
-    y = np.random.RandomState(1).randint(0, 1000, (batch,)).astype(np.int32)
-    variables = model.init(rng, jnp.asarray(x[:1]))
+    def build_step(batch_per_chip):
+        batch = batch_per_chip * n_chips
+        model = resnet50(classes=1000)
+        rng = jax.random.PRNGKey(0)
+        x = np.random.RandomState(0).rand(
+            batch, hw, hw, 3).astype(np.float32)
+        y = np.random.RandomState(1).randint(
+            0, 1000, (batch,)).astype(np.int32)
+        variables = model.init(rng, jnp.asarray(x[:1]))
+        step = ShardedParameterStep(
+            model, CrossEntropyCriterion(),
+            SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4),
+            mesh, variables)
+        return step, rng, x, y
 
-    step = ShardedParameterStep(
-        model, CrossEntropyCriterion(),
-        SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4), mesh, variables)
+    def measure(step, rng, x, y, steps, device_resident=True):
+        # device-resident batch measures the step engine (steady-state input
+        # is overlapped by the prefetch pipeline in real training)
+        x_dev = step.shard_batch(x)
+        y_dev = step.shard_batch(y)
+        loss = step.train_step_device(0, rng, x_dev, y_dev)
+        float(np.asarray(loss))  # warmup: value fetch, not just ready-handle
+        t0 = time.perf_counter()
+        for i in range(steps):
+            if device_resident:
+                loss = step.train_step_device(i + 1, rng, x_dev, y_dev)
+            else:  # host-fed: pays the host->device transfer each step
+                loss = step.train_step(i + 1, rng, x, y)
+        # fetch the VALUE of the final loss: it is data-dependent on every
+        # step in the chain, so the proxied backend cannot acknowledge early
+        # the way a bare block_until_ready handle can over the tunnel
+        final = float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final), final
+        return x.shape[0] * steps / dt / n_chips, dt / steps
 
-    # device-resident batch (steady-state input is overlapped by the
-    # prefetch pipeline in real training — bench measures the step engine)
-    x_dev = step.shard_batch(x)
-    y_dev = step.shard_batch(y)
+    step, rng, x, y = build_step(batch_per_chip)
+    img_per_sec_chip, step_time = measure(step, rng, x, y, steps)
+    img_per_sec_hostfed, _ = measure(
+        step, rng, x, y, max(steps // 2, 2), device_resident=False)
 
-    # warmup / compile
-    loss = step.train_step_device(0, rng, x_dev, y_dev)
-    float(np.asarray(loss))  # value fetch, not just ready-handle
+    # ---- MFU accounting ------------------------------------------------
+    flops_per_step = _compiled_flops(
+        step, (step.flat_params, step.opt_state, step.model_state,
+               jnp.asarray(0, jnp.int32), rng,
+               step.shard_batch(x), step.shard_batch(y)))
+    flops_source = "xla_cost_analysis"
+    if flops_per_step is None:
+        flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMAGE * x.shape[0] \
+            * (hw / 224.0) ** 2
+        flops_source = "analytic_3x_fwd"
+    peak = _peak_flops(devices[0].device_kind) if on_tpu else None
+    achieved = flops_per_step / step_time / n_chips
+    mfu = round(achieved / peak, 4) if peak else None
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss = step.train_step_device(i + 1, rng, x_dev, y_dev)
-    # fetch the VALUE of the final loss: it is data-dependent on every
-    # step in the chain, so the proxied backend cannot acknowledge early
-    # the way a bare block_until_ready handle can over the tunnel
-    final = float(np.asarray(loss))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final), final
-
-    img_per_sec_chip = batch * steps / dt / n_chips
-    print(json.dumps({
-        "metric": "resnet50_train_throughput"
-                  + ("" if on_tpu else "_cpu_smoke"),
+    out = {
+        "metric": "resnet50_train_throughput" + ("" if on_tpu else "_cpu_smoke"),
         "value": round(img_per_sec_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_PER_SEC_PER_CHIP,
-                             4),
-    }))
+        "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+        "batch_per_chip": batch_per_chip,
+        "image_size": hw,
+        "steps": steps,
+        "n_chips": n_chips,
+        "device_kind": devices[0].device_kind,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "img_per_sec_chip_hostfed": round(img_per_sec_hostfed, 2),
+        "flops_per_step": flops_per_step,
+        "flops_source": flops_source,
+        "achieved_flops_per_chip": round(achieved, 2),
+        "peak_bf16_flops": peak,
+        "mfu": mfu,
+    }
+
+    if on_tpu and os.environ.get("BENCH_SWEEP") == "1":
+        sweep = {}
+        for b in (128, 256, 512):
+            s2, r2, x2, y2 = build_step(b)
+            ips, _ = measure(s2, r2, x2, y2, steps)
+            sweep[str(b)] = round(ips, 2)
+        sweep[str(batch_per_chip)] = round(img_per_sec_chip, 2)
+        out["batch_sweep_img_per_sec_chip"] = sweep
+    return out
+
+
+def _worker(platform: str):
+    print(json.dumps(_run_bench(platform)))
+
+
+def _spawn(platform: str, timeout: float):
+    """Run a worker subprocess; return (parsed_json_or_None, error_or_None)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", platform],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, f"{platform} worker timed out after {timeout:.0f}s"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if lines:
+        try:
+            parsed = json.loads(lines[-1])
+            if proc.returncode == 0:
+                return parsed, None
+        except json.JSONDecodeError:
+            pass
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return None, f"{platform} worker rc={proc.returncode}: " + " | ".join(tail)
+
+
+def main():
+    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
+    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+
+    result, tpu_err = _spawn("tpu", tpu_timeout)
+    if result is None:
+        result, cpu_err = _spawn("cpu", cpu_timeout)
+        if result is not None:
+            result["error"] = f"tpu unavailable ({tpu_err}); cpu smoke fallback"
+        else:
+            result = {
+                "metric": "resnet50_train_throughput",
+                "value": 0.0,
+                "unit": "images/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"tpu: {tpu_err}; cpu: {cpu_err}",
+            }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        _worker(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,92 @@
+"""Scaling-efficiency harness on the simulated mesh — prints ONE JSON line.
+
+North star (BASELINE.md): >=90% scaling efficiency 8->256 chips.  Real
+multi-chip hardware is not reachable from this environment (one tunneled
+chip), so this harness measures what CAN be measured without a slice:
+
+- **strong scaling on the 8-virtual-device CPU mesh** (the ``local[N]``
+  analog, SURVEY.md §5): per-step wall time of the ZeRO-1 train step at
+  data=1/2/4/8 with the GLOBAL batch fixed.  XLA:CPU runs virtual devices
+  on separate host threads, so the mesh delivers real parallel speedup
+  until core contention and collective overhead eat it — speedup(n)=t1/tn
+  and efficiency=speedup/n are the simulated-mesh proxies for the
+  scaling-efficiency curve on a real slice.
+- the analytic per-step collective traffic of the dp step (psum_scatter +
+  all_gather of the flat parameter vector), for sanity-checking against a
+  real profile.
+
+The real-slice protocol (what to run on a v5e pod and what to record) is
+documented in docs/performance.md §"Scaling protocol".
+"""
+
+import json
+import os
+import time
+
+
+def main():
+    from bigdl_tpu.runtime.engine import force_cpu_devices
+
+    import jax
+
+    force_cpu_devices(8)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.models.resnet import resnet_cifar
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    devices = jax.devices()
+    global_batch = 32          # fixed across mesh sizes (strong scaling)
+    steps = 8
+    rs = np.random.RandomState(0)
+    x = rs.rand(global_batch, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, (global_batch,)).astype(np.int32)
+
+    per_mesh = {}
+    for n in (1, 2, 4, 8):
+        model = resnet_cifar(depth=8, classes=10)
+        mesh = build_mesh(MeshSpec(data=n), devices=devices[:n])
+        rng = jax.random.PRNGKey(0)
+        variables = model.init(rng, jnp.asarray(x[:1]))
+        step = ShardedParameterStep(
+            model, CrossEntropyCriterion(),
+            SGD(learning_rate=0.1, momentum=0.9), mesh, variables)
+        xd, yd = step.shard_batch(x), step.shard_batch(y)
+        loss = step.train_step_device(0, rng, xd, yd)
+        float(np.asarray(loss))  # compile + warmup
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = step.train_step_device(i + 1, rng, xd, yd)
+        float(np.asarray(loss))
+        dt = (time.perf_counter() - t0) / steps
+        coll_bytes = step.collective_bytes_per_step
+        per_mesh[str(n)] = {"step_time_ms": round(dt * 1e3, 2),
+                            "collective_bytes_per_step": coll_bytes}
+
+    t1 = per_mesh["1"]["step_time_ms"]
+    speedup = {n: round(t1 / v["step_time_ms"], 3)
+               for n, v in per_mesh.items()}
+    efficiency = {n: round(speedup[n] / int(n), 3) for n in speedup}
+    print(json.dumps({
+        "metric": "simulated_mesh_strong_scaling_speedup_8dev",
+        "value": speedup["8"],
+        "unit": "speedup_vs_1dev",
+        "vs_baseline": round(speedup["8"] / 8.0, 4),
+        "global_batch": global_batch,
+        "per_mesh": per_mesh,
+        "speedup": speedup,
+        "efficiency": efficiency,
+        "note": "fixed global batch on 8 virtual CPU devices (threads of "
+                "one host, NOT chips): speedup saturates at the host's "
+                "physical cores; the real-slice protocol is "
+                "docs/performance.md §Scaling protocol",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -159,29 +159,43 @@ class Estimator:
         return self._last_stats
 
     # -- inference ----------------------------------------------------------
-    def _predict_array(self, x: np.ndarray, batch_size: int):
+    def _loaded_forward(self):
+        """Jitted forward over loaded variables (no train-step engine).
+        Handles the multi-input pack convention like the trained path."""
+        fwd = self.__dict__.get("_loaded_fwd")
+        if fwd is None:
+            from bigdl_tpu.optim.train_step import as_inputs
+
+            model = self.model
+
+            @jax.jit
+            def fwd(params, state, xb):
+                out, _ = model.forward(params, state, *as_inputs(xb),
+                                       training=False)
+                return out
+
+            self._loaded_fwd = fwd
+        return fwd
+
+    def _predict_array(self, x, batch_size: int):
         if self._trained is not None:
             return self._trained.predict(x, batch_size)
         # loaded-weights path: plain jitted forward, no train-step engine
         if self._loaded_variables is None:
             raise RuntimeError("call fit() or load() first")
-        fwd = self.__dict__.get("_loaded_fwd")
-        if fwd is None:
-            model = self.model
+        from bigdl_tpu.optim.train_step import as_inputs
 
-            @jax.jit
-            def fwd(params, state, xb):
-                out, _ = model.forward(params, state, xb, training=False)
-                return out
-
-            self._loaded_fwd = fwd
+        fwd = self._loaded_forward()
         v = self._loaded_variables
+        xs = as_inputs(x)
+        n = len(xs[0])
         outs = []
-        step = batch_size if batch_size > 0 else len(x)
-        for i in range(0, len(x), step):
-            outs.append(np.asarray(fwd(v.get("params", {}),
-                                       v.get("state", {}),
-                                       np.asarray(x[i:i + step]))))
+        step = batch_size if batch_size > 0 else n
+        for i in range(0, n, step):
+            xb = tuple(np.asarray(a[i:i + step]) for a in xs)
+            outs.append(np.asarray(
+                fwd(v.get("params", {}), v.get("state", {}),
+                    xb if len(xb) > 1 else xb[0])))
         return np.concatenate(outs, 0)
 
     def predict(self, data, batch_size: int = 0):
@@ -190,13 +204,41 @@ class Estimator:
                 lambda s: self._predict_array(
                     np.asarray(s if not isinstance(s, dict) else s["x"]),
                     batch_size))
+        if isinstance(data, tuple):  # multi-input pack
+            return self._predict_array(
+                tuple(np.asarray(a) for a in data), batch_size)
         return self._predict_array(np.asarray(data), batch_size)
 
     def evaluate(self, data, methods: Sequence[ValidationMethod],
                  batch_size: int = 32) -> Dict[str, float]:
-        self._require_fit()
         ds = _to_xy(data, batch_size, shuffle=False)
-        res = self._trained.evaluate(ds, list(methods), batch_size)
+        if self._trained is not None:
+            res = self._trained.evaluate(ds, list(methods), batch_size)
+            return {r.name: r.result for r in res}
+        # loaded-weights path: host accumulation over the jitted forward
+        if self._loaded_variables is None:
+            raise RuntimeError("call fit() or load() first")
+        from bigdl_tpu.optim.train_step import as_inputs
+
+        fwd = self._loaded_forward()
+        v = self._loaded_variables
+        methods = list(methods)
+        totals = [(0.0, 0.0)] * len(methods)
+        # every process walks ALL batches (params are replicated, there is
+        # no cross-process psum on this host-accumulation path — sharding
+        # the data here would silently give per-host partial metrics)
+        for mb in ds.batches(batch_size, shuffle=False, drop_last=False):
+            x = mb["input"]
+            n_rows = as_inputs(x)[0].shape[0]
+            w = mb.get("weight")
+            if w is None:
+                w = np.ones((n_rows,), np.float32)
+            out = fwd(v.get("params", {}), v.get("state", {}), x)
+            stats = [m.batch_stats(out, np.asarray(mb["target"]), w)
+                     for m in methods]
+            totals = [(a + float(s), b + float(c))
+                      for (a, b), (s, c) in zip(totals, stats)]
+        res = [m.fold(s, c) for m, (s, c) in zip(methods, totals)]
         return {r.name: r.result for r in res}
 
     # -- model access (reference: get_model / save / load) ------------------

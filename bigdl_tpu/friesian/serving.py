@@ -150,7 +150,13 @@ class Recommender:
         self.item_ns = item_namespace
         self.recall_candidates = recall_candidates
 
-    def recommend(self, user_id, k: int = 10) -> List[Tuple[Any, float]]:
+    def recommend(self, user_id, k: int = 10
+                  ) -> List[Tuple[Any, Optional[float]]]:
+        """Ranked items as (id, score) pairs.  ``score`` is the ranking
+        model's score; entries that could not be model-ranked (no item
+        features) follow in recall order with ``score=None`` — recall
+        (inner-product) scores live on a different scale and are never
+        mixed in as if comparable."""
         user_emb = self.features.get(self.user_ns, user_id)
         if user_emb is None:
             raise KeyError(f"unknown user {user_id!r}")
@@ -161,7 +167,9 @@ class Recommender:
         keep = [(cid, f) for cid, f in zip(cand_ids, item_feats)
                 if f is not None]
         if not keep:
-            return cands[:k]  # no ranking features: fall back to recall order
+            # no ranking features at all: recall order, scores masked to
+            # None for the same reason as backfill below
+            return [(cid, None) for cid, _ in cands[:k]]
         rows = np.stack([np.concatenate([user_emb, np.asarray(f).ravel()])
                          for _, f in keep])
         scores = self.ranking.rank(rows)
@@ -169,9 +177,13 @@ class Recommender:
         ranked = [(keep[i][0], float(scores[i])) for i in order]
         if len(ranked) < k:
             # featureless candidates backfill in recall order so callers
-            # always get k items when recall produced them
+            # always get k items when recall produced them.  Their recall
+            # (inner-product) scores are on a different scale from the model
+            # scores ahead of them, so backfilled entries carry score=None:
+            # the list stays "model-ranked items first, then recall-ordered
+            # backfill" rather than pretending one comparable score ranks it.
             ranked_ids = {cid for cid, _ in ranked}
-            ranked += [(cid, s) for cid, s in cands
+            ranked += [(cid, None) for cid, _ in cands
                        if cid not in ranked_ids][:k - len(ranked)]
         return ranked
 

@@ -154,8 +154,14 @@ class Model(Module):
         from bigdl_tpu.data import ArrayDataSet
 
         self._require_trained()
-        ds = ArrayDataSet(self._pack_inputs(x),
-                          None if y is None else np.asarray(y))
+        px = self._pack_inputs(x)
+        if isinstance(px, tuple) and y is None:
+            # same guard as fit_module: without labels ArrayDataSet would
+            # silently unpack a 2-tuple input pack as (data, labels)
+            raise ValueError(
+                f"multi-input model ({len(self.inputs)} inputs) requires "
+                "labels y for evaluate")
+        ds = ArrayDataSet(px, None if y is None else np.asarray(y))
         from bigdl_tpu.optim import Loss
 
         methods = (self._compiled or {}).get("metrics")

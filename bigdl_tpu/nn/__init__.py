@@ -64,6 +64,13 @@ from bigdl_tpu.nn.criterion import (
     KLDivCriterion, CosineEmbeddingCriterion, MarginRankingCriterion,
     ParallelCriterion, TimeDistributedCriterion,
 )
+from bigdl_tpu.nn.layers_tail import (
+    ActivityRegularization, BinaryThreshold, BinaryTreeLSTM, CrossProduct,
+    DenseToSparse, DetectionOutputFrcnn, DetectionOutputSSD, ExpandSize,
+    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, MaskedSelect,
+    PriorBox, Proposal, SequenceBeamSearch, SpatialConvolutionMap,
+    SpatialZeroPadding,
+)
 from bigdl_tpu.nn.criterion_extra import (
     MultiCriterion, MultiLabelSoftMarginCriterion, MultiMarginCriterion,
     HingeEmbeddingCriterion, L1HingeEmbeddingCriterion, MarginCriterion,
@@ -73,6 +80,7 @@ from bigdl_tpu.nn.criterion_extra import (
     CategoricalCrossEntropy, CosineDistanceCriterion,
     CosineProximityCriterion, RankHingeCriterion, GaussianCriterion,
     KLDCriterion, L1Cost, TransformerCriterion,
+    TimeDistributedMaskCriterion, PGCriterion,
 )
 
 

@@ -305,3 +305,46 @@ class TransformerCriterion(Criterion):
         if self.target_transform is not None:
             target = self.target_transform(target)
         return self.criterion(input, target)
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Per-time-step criterion with a padding mask — reference
+    ``nn/TimeDistributedMaskCriterion.scala``: masked steps contribute
+    nothing and the mean divides by the number of VALID steps (the
+    variable-length sequence-loss form; the mask is derived from the
+    target ``padding_value``)."""
+
+    def __init__(self, criterion, padding_value: int = 0):
+        self.criterion = criterion
+        self.padding_value = padding_value
+
+    def forward(self, input, target):
+        # input (b, t, ...), target (b, t): apply per step, weight by mask
+        b, t = target.shape[:2]
+        mask = (target != self.padding_value).astype(jnp.float32)
+        flat_in = input.reshape((b * t,) + input.shape[2:])
+        flat_tg = target.reshape((b * t,) + target.shape[2:])
+        # per-sample losses via the wrapped criterion in sum mode over one
+        # row at a time is a host loop; instead require the criterion to be
+        # elementwise-decomposable: compute on all rows, weighted resum.
+        per = jax.vmap(
+            lambda i, tg: self.criterion(i[None], tg[None]))(flat_in, flat_tg)
+        per = per.reshape(b, t)
+        total = jnp.sum(per * mask)
+        return total / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion — reference ``nn/PGCriterion.scala``:
+    ``loss = -sum(target * log(input))`` where the target carries the
+    (discounted) reward on the taken action (REINFORCE with the reward
+    folded into the one-hot target)."""
+
+    def __init__(self, size_average: bool = False, eps: float = 1e-12):
+        self.size_average = size_average
+        self.eps = eps
+
+    def forward(self, input, target):
+        ll = jnp.log(jnp.clip(input, self.eps, None)) * target
+        return -( jnp.mean(jnp.sum(ll, axis=-1)) if self.size_average
+                  else jnp.sum(ll))

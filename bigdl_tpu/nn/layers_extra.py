@@ -960,6 +960,8 @@ class _ChannelDropout(Module):
             raise ValueError(
                 f"{type(self).__name__} in training mode requires rng")
         keep = 1.0 - self.p
+        if keep <= 0.0:  # p=1: everything dropped; x/keep would be a NaN
+            return jnp.zeros_like(x), EMPTY  # trap under jit-of-grad
         shape = (x.shape[0],) + (1,) * self.spatial_rank + (x.shape[-1],)
         mask = jax.random.bernoulli(rng, keep, shape)
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype), EMPTY

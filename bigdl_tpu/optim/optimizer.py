@@ -293,7 +293,15 @@ class Optimizer:
                     state["loss"] = loss  # device array; float() when read
                     if self._should_log(state):
                         self._log_progress(state, t_loop)
+                    t_trig = time.perf_counter()
                     self._fire_triggers(step_engine, state)
+                    # trigger work (validation/checkpoint/histograms) is not
+                    # step time: shift the log window start past it
+                    if getattr(self, "_last_log", None) is not None:
+                        self._last_log = (
+                            self._last_log[0]
+                            + (time.perf_counter() - t_trig),
+                            self._last_log[1])
                     if self._preempted:
                         log.warning(
                             "preemption signal received: checkpointing at "
@@ -322,6 +330,7 @@ class Optimizer:
                     e, retries, max_retries)
                 time.sleep(engine.config.failure_retry_interval_s)
                 self._try_resume(step_engine, state)
+                self._last_log = None  # don't count recovery in step time
 
         variables = step_engine.get_variables()
         return TrainedModel(self.model, variables, step_engine)
@@ -343,9 +352,20 @@ class Optimizer:
 
     def _log_progress(self, state, t_loop):
         it = state["iteration"]
+        # fetching the loss VALUE blocks until the step chain has actually
+        # executed (it is data-dependent on every dispatched step), so the
+        # wall-clock window between log points measures real step time —
+        # not async dispatch time, which flatters when log_every > 1 and
+        # the in-flight queue hides device latency.
         loss = float(state["loss"])
         state["loss"] = loss
-        dt = self.metrics.mean("step_dispatch")
+        now = time.perf_counter()
+        last = getattr(self, "_last_log", None)
+        if last is not None and it > last[1]:
+            dt = (now - last[0]) / (it - last[1])
+        else:  # first window: includes compile; dispatch mean is the best proxy
+            dt = self.metrics.mean("step_dispatch")
+        self._last_log = (now, it)
         self.metrics.reset()  # rolling window: throughput reflects recent steps
         lr = float(np.asarray(self.optim_method.get_learning_rate(it - 1)))
         throughput = self.batch_size / max(dt, 1e-9)

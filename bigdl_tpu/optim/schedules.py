@@ -178,7 +178,10 @@ class Plateau(LearningRateSchedule):
             self._cooling -= 1
             return False
         self._bad += 1
-        if self._bad > self.patience:
+        # keras ReduceLROnPlateau semantics (the reference SGD.Plateau
+        # follows them): reduce when wait >= patience, i.e. on the
+        # patience-th consecutive non-improving validation
+        if self._bad >= self.patience:
             self._bad = 0
             self._cooling = self.cooldown
             if (self._last_base_lr is not None

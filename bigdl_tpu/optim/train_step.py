@@ -228,6 +228,12 @@ class ShardedParameterStep:
             out_specs=P(), check_vma=False)
         return jax.jit(mapped)
 
+    @property
+    def collective_bytes_per_step(self) -> int:
+        """Per-step ICI traffic of the ZeRO-1 cycle: psum_scatter of the
+        flat f32 gradient + all_gather of the updated flat params."""
+        return 2 * self.n_pad * 4
+
     # ------------------------------------------------------------------
     def shard_batch(self, arr):
         """Host numpy (per-process shard) -> global device array on the data

@@ -135,3 +135,21 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # pragma: no cover — older jax without the options
         pass
+
+
+def force_cpu_devices(n_devices: int = 8) -> None:
+    """Force the CPU platform with ``n_devices`` virtual devices — the
+    ``local[N]`` simulated-mesh bootstrap (SURVEY.md §5).
+
+    Gotcha this wraps (one place instead of three): this image's axon TPU
+    plugin IGNORES the ``JAX_PLATFORMS`` env var, so the platform must be
+    forced via ``jax.config.update`` — and ``XLA_FLAGS`` must carry the
+    virtual-device count BEFORE the backend initializes.  Call before any
+    ``jax.devices()``/array op."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")

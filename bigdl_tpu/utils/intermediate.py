@@ -163,12 +163,28 @@ def _consumer_counts(nodes: List[IRNode]) -> Dict[int, int]:
     return c
 
 
+def _copy_graph(nodes: List[IRNode], outputs: List[IRNode]):
+    """Uid-preserving deep copy of the node list (parents remapped into the
+    copies).  The fuse pass rewires parents in place; operating on copies
+    keeps the IRGraph itself immutable so a later ``to_model("xla")`` on the
+    same graph still emits the original wiring."""
+    by_uid: Dict[int, IRNode] = {}
+    copies = []
+    for n in nodes:
+        c = copy.copy(n)          # keeps uid (slot-for-slot copy)
+        c.params = dict(n.params)
+        c.state = dict(n.state)
+        c.parents = [by_uid[p.uid] for p in n.parents]
+        by_uid[c.uid] = c
+        copies.append(c)
+    return copies, [by_uid[o.uid] for o in outputs]
+
+
 def _fuse_pass(nodes: List[IRNode], outputs: List[IRNode]):
     from bigdl_tpu.nn import layers as L
     from bigdl_tpu.nn.module import Identity
 
-    nodes = list(nodes)
-    outputs = list(outputs)
+    nodes, outputs = _copy_graph(nodes, outputs)
     out_ids = {o.uid for o in outputs}
 
     # 1. drop inference no-ops (Dropout, Identity) by rewiring consumers

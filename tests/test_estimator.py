@@ -90,3 +90,49 @@ def test_estimator_rejects_unknown_backend():
     with pytest.raises(ValueError):
         Estimator.from_module(lambda c: None, lambda c: None, lambda c: None,
                               backend="ray")
+
+
+def test_estimator_loaded_weights_evaluate_and_multiinput_predict(tmp_path):
+    """Loaded-weights (no prior fit) paths: evaluate works, and predict
+    handles the multi-input tuple pack like the trained path."""
+    from bigdl_tpu.keras.engine import Input, Model
+    from bigdl_tpu.nn.module import Sequential
+
+    init_context("local")
+    x, y = _toy(seed=3)
+    est = _make_est()
+    est.fit((x, y), epochs=15, batch_size=64)
+    ref_eval = est.evaluate((x, y), [Top1Accuracy()])
+    ref_pred = est.predict(x[:16])
+    path = str(tmp_path / "m")
+    est.save(path)
+
+    est2 = _make_est()
+    est2.load(path)
+    # evaluate without a prior fit (used to raise "call fit() first")
+    got = est2.evaluate((x, y), [Top1Accuracy()])
+    assert abs(got["Top1Accuracy"] - ref_eval["Top1Accuracy"]) < 1e-6
+    np.testing.assert_allclose(est2.predict(x[:16]), ref_pred,
+                               rtol=1e-5, atol=1e-6)
+
+    # multi-input model through the loaded-weights predict path
+    ia, ib = Input((4,)), Input((4,))
+    from bigdl_tpu.keras.layers import Merge
+    out = nn.Linear(8, 2)(Merge("concat")([ia, ib]))
+    m = Model([ia, ib], out)
+    a = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+    b = np.random.RandomState(1).rand(32, 4).astype(np.float32)
+    yy = np.random.RandomState(2).randint(0, 2, 32).astype(np.int32)
+    m.compile(Adam(1e-2), CrossEntropyCriterion())
+    m.fit([a, b], yy, batch_size=16, nb_epoch=1)
+
+    est3 = Estimator.from_module(
+        model_creator=lambda cfg: m,
+        optimizer_creator=lambda cfg: Adam(1e-2),
+        loss_creator=lambda cfg: CrossEntropyCriterion())
+    mpath = str(tmp_path / "mi")
+    from bigdl_tpu.utils.serializer import save_model
+    save_model(mpath, m, m._trained.variables)
+    est3.load(mpath)
+    pred = est3.predict((a, b), batch_size=16)
+    assert pred.shape == (32, 2)

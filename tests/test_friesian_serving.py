@@ -114,6 +114,10 @@ def test_recommender_backfills_to_k_when_features_sparse():
     fs.put("user", "u3", items[0])
     out = rec.recommend("u3", k=10)
     assert len(out) == 10  # backfilled from recall order
+    # model-ranked entries carry a float score; backfilled entries carry
+    # None (recall scores are not comparable to model scores)
+    assert sum(s is not None for _, s in out) == 2
+    assert all(s is None for _, s in out[2:])
 
 
 def test_recall_bucketed_batches_match():

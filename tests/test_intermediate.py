@@ -180,3 +180,38 @@ def test_ir_from_functional_multi_output():
     (b1, b2), _ = m2.apply(v2, x)
     np.testing.assert_allclose(np.asarray(a1), np.asarray(b1), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(a2), np.asarray(b2), rtol=1e-5)
+
+
+def test_fused_then_xla_on_same_graph_is_not_corrupted():
+    """to_model("fused") must not mutate the IRGraph: a subsequent
+    to_model("xla") identity rebuild on the SAME graph must still match the
+    original model (regression: the fuse pass used to rewire parents in
+    place, silently dropping BN/Dropout from the later xla rebuild)."""
+    model = Sequential([
+        nn.Conv2D(2, 4, 3, padding="SAME"),
+        nn.BatchNorm(4),
+        nn.ReLU(),
+        nn.Dropout(0.5),
+        nn.Flatten(),
+        nn.Linear(4 * 6 * 6, 5),
+    ])
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6, 6, 2).astype(np.float32)
+    variables = _bn_with_stats(model.init(jax.random.PRNGKey(0), x), rng, 4)
+    y_ref, _ = model.apply(variables, x)
+
+    ir = IRGraph.from_model(model, variables)
+    m_fused, v_fused = ir.to_model("fused")
+    m_xla, v_xla = ir.to_model("xla")
+
+    # the xla rebuild still contains BN + Dropout and matches the original
+    layers = [n.layer for n in m_xla.order if n.layer is not None]
+    assert any(isinstance(l, nn.BatchNorm) for l in layers)
+    assert any(isinstance(l, nn.Dropout) for l in layers)
+    y_xla, _ = m_xla.apply(v_xla, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_xla),
+                               rtol=1e-5, atol=1e-6)
+    # and the fused twin still agrees numerically
+    y_fused, _ = m_fused.apply(v_fused, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_fused),
+                               rtol=1e-4, atol=1e-5)

@@ -173,3 +173,25 @@ def test_inception_v2_builds_and_forwards():
     assert np.asarray(y).shape == (1, 10)
     # log-probs sum to 1
     np.testing.assert_allclose(np.exp(np.asarray(y)).sum(), 1.0, rtol=1e-3)
+
+
+def test_evaluate_multiinput_without_labels_raises():
+    """evaluate() on a multi-input model with y=None must raise — a 2-tuple
+    input pack would otherwise be silently unpacked as (data, labels)."""
+    import pytest
+    from bigdl_tpu.keras.engine import Input, Model
+    from bigdl_tpu.keras.layers import Merge
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+
+    ia, ib = Input((4,)), Input((4,))
+    out = nn.Linear(8, 2)(Merge("concat")([ia, ib]))
+    m = Model([ia, ib], out)
+    a = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    b = np.random.RandomState(1).rand(16, 4).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 2, 16).astype(np.int32)
+    m.compile(Adam(1e-2), CrossEntropyCriterion())
+    m.fit([a, b], y, batch_size=8, nb_epoch=1)
+    with pytest.raises(ValueError, match="requires"):
+        m.evaluate([a, b])

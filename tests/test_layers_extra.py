@@ -266,3 +266,24 @@ def test_extra_layers_in_sequential_jit():
         return y
 
     assert f(v["params"], x).shape == (2, 2)
+
+
+def test_spatial_dropout_p1_is_zero_not_nan():
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.layers_extra import SpatialDropout2D
+
+    layer = SpatialDropout2D(p=1.0)
+    x = jnp.ones((2, 4, 4, 3))
+    y, _ = layer.forward({}, {}, x, training=True,
+                         rng=jax.random.PRNGKey(0))
+    assert np.all(np.asarray(y) == 0.0)
+
+    # NaN trap under jit-of-grad: gradient must be finite (zero), not NaN
+    def loss(x):
+        out, _ = layer.forward({}, {}, x, training=True,
+                               rng=jax.random.PRNGKey(0))
+        return jnp.sum(out ** 2)
+
+    g = jax.jit(jax.grad(loss))(x)
+    assert np.all(np.isfinite(np.asarray(g)))

@@ -1,0 +1,256 @@
+"""Long-tail layer tranche specs (reference per-layer *Spec pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+RNG = jax.random.PRNGKey(0)
+RS = np.random.RandomState(0)
+
+
+def _run(layer, *xs, training=False):
+    v = layer.init(RNG, *map(jnp.asarray, xs))
+    y, _ = layer.forward(v["params"], v["state"], *map(jnp.asarray, xs),
+                         training=training, rng=jax.random.PRNGKey(1))
+    return y, v
+
+
+def test_activity_regularization_grad_carries_penalty():
+    layer = nn.ActivityRegularization(l1=0.3, l2=0.1)
+    x = jnp.asarray(RS.randn(4, 5).astype(np.float32))
+
+    def loss(x):
+        y, _ = layer.forward({}, {}, x, training=True)
+        return jnp.sum(y * 2.0)
+
+    g = jax.grad(loss)(x)
+    expect = 2.0 + 0.3 * np.sign(np.asarray(x)) + 2 * 0.1 * np.asarray(x)
+    np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-5)
+    # inference: pure identity
+    y, _ = layer.forward({}, {}, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_binary_threshold():
+    y, _ = _run(nn.BinaryThreshold(0.5), np.array([[0.2, 0.7, 0.5, 1.0]],
+                                                  np.float32))
+    np.testing.assert_array_equal(np.asarray(y), [[0, 1, 0, 1]])
+
+
+def test_masked_select_compacts_to_front():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    mask = np.array([[1, 0, 1], [0, 0, 1]], bool)
+    layer = nn.MaskedSelect()
+    (vals, valid), _ = layer.forward({}, {}, (jnp.asarray(x),
+                                              jnp.asarray(mask)))
+    np.testing.assert_array_equal(np.asarray(vals)[:3], [0.0, 2.0, 5.0])
+    assert np.asarray(valid).sum() == 3
+    assert not np.asarray(valid)[3:].any()
+    np.testing.assert_array_equal(np.asarray(vals)[3:], 0.0)
+
+
+def test_cross_product_pairwise_dots():
+    a = RS.randn(3, 4).astype(np.float32)
+    b = RS.randn(3, 4).astype(np.float32)
+    c = RS.randn(3, 4).astype(np.float32)
+    layer = nn.CrossProduct()
+    y, _ = layer.forward({}, {}, tuple(map(jnp.asarray, (a, b, c))))
+    assert y.shape == (3, 3)
+    np.testing.assert_allclose(np.asarray(y)[:, 0], (a * b).sum(-1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(y)[:, 2], (b * c).sum(-1),
+                               rtol=1e-5)
+
+
+def test_dense_to_sparse_round_trip():
+    x = np.array([[1.0, 0.0], [0.0, 3.0]], np.float32)
+    layer = nn.DenseToSparse()
+    sp, _ = layer.forward({}, {}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(sp.to_dense()), x)
+
+
+def test_expand_size():
+    y, _ = _run(nn.ExpandSize([3, -1]), np.ones((1, 4), np.float32))
+    assert y.shape == (3, 4)
+
+
+def test_spatial_zero_padding_pad_and_crop():
+    x = RS.randn(1, 4, 4, 2).astype(np.float32)
+    y, _ = _run(nn.SpatialZeroPadding(1, 2, 0, 1), x)
+    assert y.shape == (1, 5, 7, 2)
+    # negative pads crop
+    y2, _ = _run(nn.SpatialZeroPadding(-1, -1, -1, -1), x)
+    assert y2.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(np.asarray(y2), x[:, 1:3, 1:3, :])
+
+
+def test_group_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    c, g = 6, 3
+    x = RS.randn(2, 4, 4, c).astype(np.float32)
+    layer = nn.GroupNorm(g, c)
+    y, v = _run(layer, x)
+    tm = torch.nn.GroupNorm(g, c)
+    with torch.no_grad():
+        ty = tm(torch.tensor(x.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(np.asarray(y),
+                               ty.numpy().transpose(0, 2, 3, 1), atol=1e-5)
+
+
+def test_instance_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    c = 5
+    x = RS.randn(2, 6, 6, c).astype(np.float32)
+    y, _ = _run(nn.InstanceNorm2D(c), x)
+    tm = torch.nn.InstanceNorm2d(c, affine=True)
+    with torch.no_grad():
+        ty = tm(torch.tensor(x.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(np.asarray(y),
+                               ty.numpy().transpose(0, 2, 3, 1), atol=1e-5)
+
+
+def test_spatial_convolution_map_respects_connectivity():
+    # connect in0->out0 and in1->out1 only
+    conn = [[0, 0], [1, 1]]
+    layer = nn.SpatialConvolutionMap(conn, 3, 2, 2, padding=1)
+    x = RS.randn(1, 5, 5, 2).astype(np.float32)
+    y, v = _run(layer, x)
+    assert y.shape == (1, 5, 5, 2)
+    w = np.asarray(v["params"]["weight"])
+    assert np.all(w[:, :, 0, 1] == 0) and np.all(w[:, :, 1, 0] == 0)
+    assert np.any(w[:, :, 0, 0] != 0)
+    # out0 must not depend on in1: perturb channel 1
+    x2 = x.copy()
+    x2[..., 1] += 1.0
+    y2, _ = layer.forward(v["params"], v["state"], jnp.asarray(x2))
+    np.testing.assert_allclose(np.asarray(y2)[..., 0], np.asarray(y)[..., 0],
+                               atol=1e-6)
+
+
+def test_binary_tree_lstm_root_state():
+    b, d, h = 2, 4, 6
+    # 3-node tree: slots 0,1 leaves; slot 2 = parent(0, 1)
+    x = RS.randn(b, 3, d).astype(np.float32)
+    children = np.array([[[-1, -1], [-1, -1], [0, 1]]] * b, np.int32)
+    layer = nn.BinaryTreeLSTM(d, h)
+    v = layer.init(RNG, jnp.asarray(x), jnp.asarray(children))
+    y, _ = layer.forward(v["params"], v["state"], jnp.asarray(x),
+                         jnp.asarray(children))
+    assert y.shape == (b, 3, h)
+    assert np.all(np.isfinite(np.asarray(y)))
+    # root must depend on both leaves
+    x2 = x.copy()
+    x2[:, 0] += 1.0
+    y2, _ = layer.forward(v["params"], v["state"], jnp.asarray(x2),
+                          jnp.asarray(children))
+    assert not np.allclose(np.asarray(y2)[:, 2], np.asarray(y)[:, 2])
+    # grads flow end to end
+    def loss(p):
+        out, _ = layer.forward(p, v["state"], jnp.asarray(x),
+                               jnp.asarray(children))
+        return jnp.sum(out[:, 2] ** 2)
+    g = jax.grad(loss)(v["params"])
+    assert float(jnp.linalg.norm(g["w_leaf"])) > 0
+
+
+def test_prior_box_count_and_bounds():
+    pb = nn.PriorBox(min_size=30.0, max_size=60.0, aspect_ratios=(2.0,),
+                     image_size=(300, 300), clip=True)
+    x = jnp.zeros((1, 4, 4, 8))
+    boxes, _ = pb.forward({}, {}, x)
+    assert boxes.shape == (4 * 4 * pb.num_priors(), 4)
+    b = np.asarray(boxes)
+    assert b.min() >= 0.0 and b.max() <= 300.0
+    assert np.all(b[:, 2] >= b[:, 0]) and np.all(b[:, 3] >= b[:, 1])
+
+
+def test_proposal_layer_shapes():
+    from bigdl_tpu.ops.detection import encode_boxes
+
+    A = 50
+    anchors = np.stack([
+        RS.uniform(0, 40, A), RS.uniform(0, 40, A),
+        RS.uniform(60, 100, A), RS.uniform(60, 100, A)], -1).astype(np.float32)
+    gt = np.array([[10, 10, 50, 50]], np.float32).repeat(A, 0)
+    deltas = np.asarray(encode_boxes(jnp.asarray(gt), jnp.asarray(anchors)))
+    scores = RS.rand(A).astype(np.float32)
+    prop = nn.Proposal(pre_nms_topk=32, post_nms_topk=8, nms_thresh=0.7,
+                       image_size=(128, 128))
+    (boxes, s), _ = prop.forward({}, {}, (jnp.asarray(scores),
+                                          jnp.asarray(deltas),
+                                          jnp.asarray(anchors)))
+    assert boxes.shape == (8, 4) and s.shape == (8,)
+
+
+def test_detection_output_ssd_decodes_obvious_box():
+    P, C = 16, 4
+    priors = np.stack([
+        np.full(P, 10.0), np.full(P, 10.0),
+        np.full(P, 50.0), np.full(P, 50.0)], -1).astype(np.float32)
+    loc = np.zeros((1, P, 4), np.float32)   # deltas 0 -> boxes == priors
+    conf = np.zeros((1, P, C), np.float32)
+    conf[0, :, 2] = 5.0                     # class 2 wins everywhere
+    layer = nn.DetectionOutputSSD(C, keep_topk=5)
+    out, _ = layer.forward({}, {}, (jnp.asarray(loc), jnp.asarray(conf),
+                                    jnp.asarray(priors)))
+    assert out.shape == (1, 5, 6)
+    row = np.asarray(out)[0, 0]
+    assert row[0] == 2.0 and row[1] > 0.5
+    np.testing.assert_allclose(row[2:], [10, 10, 50, 50], atol=1e-3)
+
+
+def test_detection_output_frcnn_shapes():
+    P, C = 12, 3
+    rois = np.stack([
+        RS.uniform(0, 30, P), RS.uniform(0, 30, P),
+        RS.uniform(50, 90, P), RS.uniform(50, 90, P)], -1).astype(np.float32)
+    logits = RS.randn(P, C).astype(np.float32)
+    deltas = (RS.randn(P, C * 4) * 0.1).astype(np.float32)
+    layer = nn.DetectionOutputFrcnn(C, keep_topk=6, image_size=(100, 100))
+    out, _ = layer.forward({}, {}, (jnp.asarray(logits), jnp.asarray(deltas),
+                                    jnp.asarray(rois)))
+    assert out.shape == (6, 6)
+    o = np.asarray(out)
+    assert np.all(o[:, 2:] >= 0) and np.all(o[:, 2:] <= 100)
+
+
+def test_sequence_beam_search_module():
+    d, vocab = 8, 8
+    cell = nn.LSTM(d, d, return_sequences=False)
+    out_layer = nn.Linear(d, vocab)
+    sbs = nn.SequenceBeamSearch(cell, out_layer, vocab_size=vocab,
+                                bos_id=0, eos_id=1, beam_size=3, max_len=6)
+    x = jnp.asarray(RS.randn(2, d).astype(np.float32))
+    v = sbs.init(RNG, x)
+    res, _ = sbs.forward(v["params"], v["state"], x)
+    assert res.tokens.shape[0] == 2          # batch
+    assert res.tokens.shape[1] == 3          # beams
+    assert np.all(np.asarray(res.scores)[:, 0] >= np.asarray(res.scores)[:, 1])
+
+
+def test_time_distributed_mask_criterion_ignores_padding():
+    crit = nn.TimeDistributedMaskCriterion(nn.CrossEntropyCriterion(),
+                                           padding_value=-1)
+    logits = RS.randn(2, 4, 5).astype(np.float32)
+    target = np.array([[1, 2, -1, -1], [0, 3, 4, -1]], np.int32)
+    loss = crit(jnp.asarray(logits), jnp.asarray(target))
+    # equals mean CE over the 5 valid steps only
+    valid = [(0, 0, 1), (0, 1, 2), (1, 0, 0), (1, 1, 3), (1, 2, 4)]
+    ce = nn.CrossEntropyCriterion()
+    manual = np.mean([float(ce(jnp.asarray(logits[b, t][None]),
+                               jnp.asarray(np.array([c], np.int32))))
+                      for b, t, c in valid])
+    np.testing.assert_allclose(float(loss), manual, rtol=1e-5)
+
+
+def test_pg_criterion():
+    probs = np.array([[0.2, 0.8], [0.6, 0.4]], np.float32)
+    # action 1 with reward 2.0; action 0 with reward -1.0
+    target = np.array([[0.0, 2.0], [-1.0, 0.0]], np.float32)
+    crit = nn.PGCriterion()
+    loss = float(crit(jnp.asarray(probs), jnp.asarray(target)))
+    expect = -(2.0 * np.log(0.8) + (-1.0) * np.log(0.6))
+    np.testing.assert_allclose(loss, expect, rtol=1e-5)

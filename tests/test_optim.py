@@ -167,9 +167,9 @@ def test_plateau_schedule_semantics():
     assert p.on_score(0.5) is False        # first score = best
     assert p.on_score(0.6) is False        # improved
     assert p.on_score(0.6) is False        # bad 1
-    assert p.on_score(0.6) is False        # bad 2
-    assert p.on_score(0.6) is True         # bad 3 > patience -> drop
+    assert p.on_score(0.6) is True         # bad 2 >= patience -> drop
     assert p.current_factor == 0.5
+    assert p.on_score(0.6) is False        # counter reset: bad 1 again
     assert p.on_score(0.9) is False        # new best resets
     assert p(1.0, 0) == 0.5                # factor applied
     floor = Plateau(factor=0.1, patience=0, min_lr=0.05)
