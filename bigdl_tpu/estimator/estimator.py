@@ -109,6 +109,53 @@ class Estimator:
                          config, backend)
 
     @staticmethod
+    def from_torch(model_creator, optimizer_creator, loss_creator,
+                   config=None, backend="jax_tpu",
+                   example_input=None) -> "Estimator":
+        """Train a STOCK ``torch.nn.Module`` on the mesh — the reference's
+        headline Orca capability (``Estimator.from_torch``, SURVEY.md §4.3).
+
+        - ``model_creator(config) -> torch.nn.Module``: converted once via
+          ``utils.torch_convert`` (fx graph → keras-engine Model, NHWC;
+          weights carried over) — torch never runs on the hot path.
+        - ``optimizer_creator``: ``(model, config)`` returning a
+          ``torch.optim.Optimizer`` (hyperparameters mapped to the native
+          OptimMethod) or ``(config)`` returning an OptimMethod.
+        - ``loss_creator(config)``: a torch loss (mapped) or a criterion.
+        - ``example_input``: numpy array in TORCH layout (NCHW for conv
+          nets) for shape propagation.  NOTE: after conversion the model
+          consumes channels-LAST inputs.
+
+        ``get_model()`` returns the trained variables; ``state_dict()``
+        exports them back into torch tensors keyed like the original
+        module (via ``utils.interop.to_torch``)."""
+        if backend != "jax_tpu":
+            raise ValueError(f"backend {backend!r} not supported")
+        from bigdl_tpu.utils.torch_convert import (convert_torch_loss,
+                                                   convert_torch_optimizer,
+                                                   from_torch_module)
+
+        import inspect
+
+        cfg = dict(config or {})
+        tmodel = model_creator(cfg)
+        model, variables = from_torch_module(tmodel, example_input)
+        n_args = len(inspect.signature(optimizer_creator).parameters)
+        topt = (optimizer_creator(tmodel, cfg) if n_args >= 2
+                else optimizer_creator(cfg))
+        est = Estimator.__new__(Estimator)
+        est.config = cfg
+        est.model = model
+        est.optim_method = convert_torch_optimizer(topt)
+        est.criterion = convert_torch_loss(loss_creator(cfg))
+        est._trained = None
+        est._loaded_variables = variables   # predict/evaluate pre-finetune
+        est._initial_variables = variables
+        est._torch_model = tmodel
+        est._last_stats = {}
+        return est
+
+    @staticmethod
     def from_keras(model_creator, config=None, backend="jax_tpu") -> "Estimator":
         """model_creator returns a COMPILED keras-style model
         (``model.compile(optimizer, loss, metrics)`` already called)."""
@@ -136,6 +183,8 @@ class Estimator:
         ds = _to_xy(data, batch_size)
         opt = Optimizer(self.model, ds, self.criterion,
                         batch_size=batch_size)
+        if getattr(self, "_initial_variables", None) is not None:
+            opt.set_initial_variables(self._initial_variables)
         opt.set_optim_method(self.optim_method)
         opt.set_end_when(Trigger.max_epoch(epochs))
         if validation_data is not None:
@@ -204,7 +253,9 @@ class Estimator:
                 lambda s: self._predict_array(
                     np.asarray(s if not isinstance(s, dict) else s["x"]),
                     batch_size))
-        if isinstance(data, tuple):  # multi-input pack
+        if isinstance(data, (tuple, list)) and all(
+                isinstance(a, np.ndarray) or hasattr(a, "shape")
+                for a in data):  # multi-input pack (keras-style list too)
             return self._predict_array(
                 tuple(np.asarray(a) for a in data), batch_size)
         return self._predict_array(np.asarray(data), batch_size)
@@ -240,6 +291,13 @@ class Estimator:
                       for (a, b), (s, c) in zip(totals, stats)]
         res = [m.fold(s, c) for m, (s, c) in zip(methods, totals)]
         return {r.name: r.result for r in res}
+
+    def state_dict(self):
+        """For ``from_torch`` estimators: trained weights exported back as
+        a torch ``state_dict`` (keys match the original torch module)."""
+        from bigdl_tpu.utils.torch_convert import export_state_dict
+
+        return export_state_dict(self.model, self.get_model())
 
     # -- model access (reference: get_model / save / load) ------------------
     def get_model(self):

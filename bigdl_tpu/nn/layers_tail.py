@@ -435,6 +435,15 @@ class SequenceBeamSearch(Module):
 # ---------------------------------------------------------------------------
 
 
+def _clip_xyxy(boxes, image_size):
+    """Clip [x1, y1, x2, y2] boxes to an (h, w) image.  NB ops.detection.
+    clip_boxes is the maskrcnn yxyx convention — these layers are xyxy."""
+    h, w = image_size
+    return jnp.stack([
+        boxes[..., 0].clip(0, w), boxes[..., 1].clip(0, h),
+        boxes[..., 2].clip(0, w), boxes[..., 3].clip(0, h)], axis=-1)
+
+
 class PriorBox(Module):
     """SSD prior (anchor) generation — reference ``nn/PriorBox.scala``.
     Forward ignores values; uses the feature map's (h, w) to tile priors.
@@ -504,11 +513,10 @@ class Proposal(Module):
         self.image_size = image_size
 
     def forward(self, params, state, inputs, training=False, rng=None):
-        from bigdl_tpu.ops.detection import (clip_boxes, decode_boxes,
-                                             nms_padded)
+        from bigdl_tpu.ops.detection import decode_boxes, nms_padded
 
         scores, deltas, anchors = inputs   # (A,), (A,4), (A,4)
-        boxes = clip_boxes(decode_boxes(deltas, anchors), *self.image_size)
+        boxes = _clip_xyxy(decode_boxes(deltas, anchors), self.image_size)
         k = min(self.pre, scores.shape[0])
         top_s, top_i = jax.lax.top_k(scores, k)
         keep, valid = nms_padded(boxes[top_i], top_s, self.nms_thresh,
@@ -583,8 +591,7 @@ class DetectionOutputFrcnn(Module):
         self.image_size = image_size
 
     def forward(self, params, state, inputs, training=False, rng=None):
-        from bigdl_tpu.ops.detection import (class_aware_nms, clip_boxes,
-                                             decode_boxes)
+        from bigdl_tpu.ops.detection import class_aware_nms, decode_boxes
 
         cls_logits, box_deltas, rois = inputs
         P, C = cls_logits.shape
@@ -596,7 +603,7 @@ class DetectionOutputFrcnn(Module):
         deltas = box_deltas.reshape(P, C, 4)
         sel = jnp.take_along_axis(deltas, label[:, None, None].repeat(4, -1),
                                   axis=1)[:, 0]
-        boxes = clip_boxes(decode_boxes(sel, rois), *self.image_size)
+        boxes = _clip_xyxy(decode_boxes(sel, rois), self.image_size)
         keep, kvalid = class_aware_nms(boxes, score, label, self.nms_thresh,
                                        self.keep_topk)
         ks, kl, kb = score[keep], label[keep], boxes[keep]

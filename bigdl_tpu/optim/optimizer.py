@@ -136,6 +136,12 @@ class Optimizer:
         self.optim_method = method
         return self
 
+    def set_initial_variables(self, variables: Dict[str, Any]) -> "Optimizer":
+        """Start training from the given variables pytree instead of a
+        fresh ``model.init`` (fine-tuning, e.g. converted torch weights)."""
+        self._initial_variables = variables
+        return self
+
     def set_end_when(self, trigger: Trigger) -> "Optimizer":
         self.end_when = trigger
         return self
@@ -218,7 +224,8 @@ class Optimizer:
         sx = sample["input"]
         init_args = tuple(np.asarray(a[:1]) for a in sx) \
             if isinstance(sx, tuple) else (np.asarray(sx[:1]),)
-        init_vars = self.model.init(rng, *init_args)
+        init_vars = getattr(self, "_initial_variables", None) \
+            or self.model.init(rng, *init_args)
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip)
