@@ -1,0 +1,17 @@
+# Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
+
+.PHONY: test test-fast bench dryrun
+
+test:
+	python -m pytest tests/ -q
+
+# the quick pre-commit loop: skips the slow multi-process/serving suites
+test-fast:
+	python -m pytest tests/ -q -x --ignore=tests/test_multiprocess.py \
+	  --ignore=tests/test_serving.py
+
+bench:
+	python bench.py
+
+dryrun:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -51,10 +51,15 @@ def _concat_objs(objs: Sequence[Any]):
 
 
 class XShards:
-    """A globally-indexed list of data shards; each process owns a slice."""
+    """A globally-indexed list of data shards; each process owns a slice.
 
-    def __init__(self, shards: List[Any]):
+    ``process_local=True`` marks a collection that ALREADY holds only this
+    process's disjoint share (the sharded-read loaders) — ``owned()`` then
+    returns everything local instead of slicing again."""
+
+    def __init__(self, shards: List[Any], process_local: bool = False):
         self._shards = list(shards)
+        self._process_local = process_local
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -85,6 +90,8 @@ class XShards:
 
     def owned(self) -> List[Any]:
         """Shards owned by this process (multi-controller input sharding)."""
+        if self._process_local:
+            return list(self._shards)
         p, n = jax.process_index(), jax.process_count()
         return self._shards[p::n]
 
@@ -102,6 +109,23 @@ class XShards:
 # loaders — reference orca.data.pandas.read_csv / read_parquet
 # ---------------------------------------------------------------------------
 
+def _owned_files(files: List[str], process_id: Optional[int],
+                 process_count: Optional[int]) -> List[str]:
+    """Round-robin file ownership for multihost sharded reads — each
+    process reads a DISJOINT subset (reference: Orca's per-partition RDD
+    reads; here there is no driver, every host derives the same global
+    file index and takes its slice)."""
+    pid = jax.process_index() if process_id is None else process_id
+    pcount = jax.process_count() if process_count is None else process_count
+    owned = files[pid::pcount]
+    if not owned:
+        raise ValueError(
+            f"sharded read: process {pid} of {pcount} owns no files "
+            f"({len(files)} files total) — write at least one file per "
+            "process, or read unsharded and repartition")
+    return owned
+
+
 def _expand(path: Union[str, Sequence[str]]) -> List[str]:
     if isinstance(path, (list, tuple)):
         out: List[str] = []
@@ -116,24 +140,46 @@ def _expand(path: Union[str, Sequence[str]]) -> List[str]:
     return matches or [path]
 
 
-def read_csv(path, num_shards: Optional[int] = None, **kwargs) -> XShards:
-    """One shard per file (repartitioned if num_shards given)."""
+def _read_files(path, loader, num_shards, sharded, process_id,
+                process_count) -> XShards:
+    files = _expand(path)
+    if sharded or process_id is not None or process_count is not None:
+        files = _owned_files(files, process_id, process_count)
+        xs = XShards([loader(f) for f in files], process_local=True)
+        # repartition stays process-local: it only reshapes the local share
+        if num_shards:
+            xs = XShards(_split_obj(_concat_objs(xs._shards), num_shards),
+                         process_local=True)
+        return xs
+    xs = XShards([loader(f) for f in files])
+    return xs.repartition(num_shards) if num_shards else xs
+
+
+def read_csv(path, num_shards: Optional[int] = None, sharded: bool = False,
+             process_id: Optional[int] = None,
+             process_count: Optional[int] = None, **kwargs) -> XShards:
+    """One shard per file (repartitioned if num_shards given).
+
+    ``sharded=True`` (or explicit process_id/process_count): each process
+    reads ONLY its round-robin slice of the file list — the multihost
+    input path (no full-dataset read per host)."""
     import pandas as pd
 
-    shards = [pd.read_csv(f, **kwargs) for f in _expand(path)]
-    xs = XShards(shards)
-    return xs.repartition(num_shards) if num_shards else xs
+    return _read_files(path, lambda f: pd.read_csv(f, **kwargs), num_shards,
+                       sharded, process_id, process_count)
 
 
-def read_parquet(path, num_shards: Optional[int] = None, **kwargs) -> XShards:
+def read_parquet(path, num_shards: Optional[int] = None,
+                 sharded: bool = False, process_id: Optional[int] = None,
+                 process_count: Optional[int] = None, **kwargs) -> XShards:
     import pandas as pd
 
-    shards = [pd.read_parquet(f, **kwargs) for f in _expand(path)]
-    xs = XShards(shards)
-    return xs.repartition(num_shards) if num_shards else xs
+    return _read_files(path, lambda f: pd.read_parquet(f, **kwargs),
+                       num_shards, sharded, process_id, process_count)
 
 
-def read_npy(path, num_shards: Optional[int] = None) -> XShards:
-    shards = [np.load(f) for f in _expand(path)]
-    xs = XShards(shards)
-    return xs.repartition(num_shards) if num_shards else xs
+def read_npy(path, num_shards: Optional[int] = None, sharded: bool = False,
+             process_id: Optional[int] = None,
+             process_count: Optional[int] = None) -> XShards:
+    return _read_files(path, np.load, num_shards, sharded, process_id,
+                       process_count)

@@ -66,19 +66,30 @@ class InferenceOptimizer:
 
     @staticmethod
     def quantize(model, variables, sample=None, precision: str = "int8",
-                 calib_data=None) -> TracedModel:
+                 calib_data=None, calib_method: str = "percentile",
+                 calib_percentile: float = 99.9) -> TracedModel:
         """Post-training quantization.  precision: int8 | bf16.
-        (calib_data accepted for reference parity; abs-max calibration is
-        weight-driven so it is unused.)"""
+
+        ``calib_data``: iterable of input batches for ACTIVATION
+        calibration (reference min/max calibration, SURVEY.md §3.2) —
+        quantized layers then run static per-tensor activation scales
+        (``calib_method``: minmax | percentile).  Without it, activations
+        quantize dynamically per row."""
         if sample is None:
             raise ValueError("quantize needs a sample input for tracing")
         if precision == "bf16":
             return InferenceOptimizer.trace(model, variables, sample, "bf16")
         if precision != "int8":
             raise ValueError(f"precision {precision!r}: int8 or bf16")
+        from bigdl_tpu.nn.quantized import calibrate
         from bigdl_tpu.nn.quantized import quantize as quantize_module
 
-        q_model, q_vars = quantize_module(model, variables)
+        calib = None
+        if calib_data is not None:
+            calib = calibrate(model, variables, calib_data,
+                              method=calib_method,
+                              percentile=calib_percentile)
+        q_model, q_vars = quantize_module(model, variables, calib=calib)
         return TracedModel(_forward_fn(q_model), q_vars, np.asarray(sample),
                            "int8")
 

@@ -15,9 +15,10 @@ MXU int8 path doubles peak throughput vs bf16.
 """
 
 import copy
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.nn import layers as L
 from bigdl_tpu.nn.module import EMPTY, Container, Module
@@ -33,18 +34,22 @@ class QuantizedLinear(Module):
         self.with_bias = with_bias
 
     @staticmethod
-    def from_linear(layer: L.Linear, params) -> Tuple["QuantizedLinear", Dict]:
+    def from_linear(layer: L.Linear, params, act_scale=None
+                    ) -> Tuple["QuantizedLinear", Dict]:
         w_q, scales = quantize_int8(params["weight"], axis=0)
         q = QuantizedLinear(layer.out_features, layer.with_bias,
                             name=layer.name)
         qp = {"weight_q": w_q, "scales": scales}
+        if act_scale is not None:
+            qp["act_scale"] = jnp.asarray(act_scale, jnp.float32)
         if layer.with_bias:
             qp["bias"] = params["bias"]
         return q, qp
 
     def forward(self, params, state, x, training=False, rng=None):
         y = quantized_linear(x, params["weight_q"], params["scales"],
-                             params.get("bias"))
+                             params.get("bias"),
+                             act_scale=params.get("act_scale"))
         return y, EMPTY
 
 
@@ -59,7 +64,8 @@ class QuantizedConv2D(Module):
         self.conv = conv
 
     @staticmethod
-    def from_conv(layer: L.Conv2D, params) -> Tuple["QuantizedConv2D", Dict]:
+    def from_conv(layer: L.Conv2D, params, act_scale=None
+                  ) -> Tuple["QuantizedConv2D", Dict]:
         kh, kw, cin_g, cout = params["weight"].shape
         # conv_general_dilated_patches emits features channel-major
         # (C, kh, kw); store the quantized weight in that row order once
@@ -70,6 +76,8 @@ class QuantizedConv2D(Module):
         w_q, scales = quantize_int8(w2, axis=0)
         q = QuantizedConv2D(layer)
         qp = {"weight_q": w_q, "scales": scales}
+        if act_scale is not None:
+            qp["act_scale"] = jnp.asarray(act_scale, jnp.float32)
         if layer.with_bias:
             qp["bias"] = params["bias"]
         return q, qp
@@ -92,26 +100,36 @@ class QuantizedConv2D(Module):
         n, oh, ow, feat = patches.shape
         y = quantized_linear(
             patches.reshape(n * oh * ow, feat),
-            params["weight_q"], params["scales"], params.get("bias"))
+            params["weight_q"], params["scales"], params.get("bias"),
+            act_scale=params.get("act_scale"))
         return y.reshape(n, oh, ow, -1).astype(x.dtype), EMPTY
 
 
-def quantize(module: Module, variables: Dict[str, Any]
+def quantize(module: Module, variables: Dict[str, Any],
+             calib: Optional[Dict[int, float]] = None
              ) -> Tuple[Module, Dict[str, Any]]:
     """Post-training quantization — reference ``Quantizer.quantize(model)``.
 
-    Returns a new (module, variables); Linear/Conv2D leaves become int8."""
+    Returns a new (module, variables); Linear/Conv2D leaves become int8.
+    ``calib``: optional ``{id(leaf): activation_scale}`` from
+    :func:`calibrate` — calibrated leaves run STATIC per-tensor activation
+    quantization (the reference's min/max-calibrated int8 inference);
+    uncalibrated leaves keep dynamic per-row quantization."""
     params = variables.get("params", EMPTY)
     state = variables.get("state", EMPTY)
-    new_mod, new_params = _quantize_rec(module, params)
+    new_mod, new_params = _quantize_rec(module, params, calib or {})
     return new_mod, {"params": new_params, "state": state}
 
 
-def _quantize_rec(module: Module, params):
+def _quantize_rec(module: Module, params, calib):
     if isinstance(module, L.Linear):
-        return QuantizedLinear.from_linear(module, params)
+        return QuantizedLinear.from_linear(module, params,
+                                           calib.get(id(module)))
     if isinstance(module, L.Conv2D) and module.groups == 1:
-        return QuantizedConv2D.from_conv(module, params)
+        return QuantizedConv2D.from_conv(module, params,
+                                         calib.get(id(module)))
+    if _is_keras_model(module):
+        return _quantize_keras(module, params, calib)
     if isinstance(module, Container):
         new = copy.copy(module)
         new.layers = list(module.layers)
@@ -119,10 +137,133 @@ def _quantize_rec(module: Module, params):
         for i, child in enumerate(module.layers):
             k = module._key(i)
             child_p = params.get(k, EMPTY) if params else EMPTY
-            q_child, q_params = _quantize_rec(child, child_p)
+            q_child, q_params = _quantize_rec(child, child_p, calib)
             if q_child is not child:
                 new.layers[i] = q_child
                 # key embeds the child name, which is preserved
                 new_params[k] = q_params
         return new, new_params
     return module, params
+
+
+# ---------------------------------------------------------------------------
+# activation calibration — reference min/max calibration over a calibration
+# set (SURVEY.md §3.2 quantization row); percentile clipping beats raw
+# abs-max when activations have outliers
+# ---------------------------------------------------------------------------
+
+
+class _RecordInput(Module):
+    """Transparent wrapper: records abs-activation samples entering a
+    quantizable leaf, then delegates (params structure unchanged — the
+    wrapper answers to the leaf's name)."""
+
+    def __init__(self, layer: Module, store: Dict[int, list],
+                 max_samples_per_batch: int = 8192):
+        super().__init__(layer.name)
+        self.layer = layer
+        self.store = store
+        self.cap = max_samples_per_batch
+
+    def forward(self, params, state, x, training=False, rng=None):
+        a = np.abs(np.asarray(x, np.float32)).ravel()
+        if a.size > self.cap:  # reservoir-ish: fixed stride subsample
+            a = a[:: max(1, a.size // self.cap)][: self.cap]
+        self.store.setdefault(id(self.layer), []).append(a)
+        return self.layer.forward(params, state, x, training=training,
+                                  rng=rng)
+
+
+def _recording_twin(module: Module, store):
+    if isinstance(module, L.Linear) or (isinstance(module, L.Conv2D)
+                                        and module.groups == 1):
+        return _RecordInput(module, store)
+    if _is_keras_model(module):
+        return _clone_keras(module,
+                            lambda lay, _: _RecordInput(lay, store))[0]
+    if isinstance(module, Container):
+        new = copy.copy(module)
+        new.layers = [_recording_twin(c, store) for c in module.layers]
+        return new
+    return module
+
+
+# ---------------------------------------------------------------------------
+# keras functional-Model support: params are keyed by NODE name, so the
+# graph is cloned (id-preserving, like utils.intermediate._copy_graph) with
+# quantizable node layers replaced
+# ---------------------------------------------------------------------------
+
+
+def _is_keras_model(module) -> bool:
+    from bigdl_tpu.keras.engine import Model as KModel
+
+    return isinstance(module, KModel)
+
+
+def _clone_keras(model, replace):
+    """Clone a keras Model, calling ``replace(layer, node_name) -> layer``
+    on each quantizable node layer.  Returns (new_model, replaced) where
+    ``replaced`` lists (node_name, old_layer, new_layer)."""
+    from bigdl_tpu.keras.engine import Model as KModel
+
+    by_id: Dict[int, Any] = {}
+    replaced = []
+    for node in model.order:   # topological: parents before children
+        c = copy.copy(node)
+        c.parents = [by_id[p.id] for p in node.parents]
+        by_id[node.id] = c
+        lay = node.layer
+        if isinstance(lay, L.Linear) or (isinstance(lay, L.Conv2D)
+                                         and lay.groups == 1):
+            c.layer = replace(lay, node.name)
+            replaced.append((node.name, lay, c.layer))
+    new_model = KModel([by_id[i.id] for i in model.inputs],
+                       [by_id[o.id] for o in model.outputs],
+                       name=model.name)
+    return new_model, replaced
+
+
+def _quantize_keras(model, params, calib):
+    qparams: Dict[str, Dict] = {}
+
+    def replace(lay, node_name):
+        p = params.get(node_name, {}) if params else {}
+        if isinstance(lay, L.Linear):
+            q, qp = QuantizedLinear.from_linear(lay, p, calib.get(id(lay)))
+        else:
+            q, qp = QuantizedConv2D.from_conv(lay, p, calib.get(id(lay)))
+        qparams[node_name] = qp
+        return q
+
+    new_model, _ = _clone_keras(model, replace)
+    new_params = dict(params) if params else {}
+    new_params.update(qparams)
+    return new_model, new_params
+
+
+def calibrate(module: Module, variables: Dict[str, Any],
+              batches: Iterable, method: str = "percentile",
+              percentile: float = 99.9) -> Dict[int, float]:
+    """Run a calibration set through the model and derive a static
+    activation scale per quantizable leaf.
+
+    ``method``: ``"minmax"`` (abs-max over the set, the reference default)
+    or ``"percentile"`` (clip at the given abs-percentile — robust to
+    activation outliers).  Returns ``{id(leaf): scale}`` for
+    :func:`quantize`'s ``calib`` argument."""
+    if method not in ("minmax", "percentile"):
+        raise ValueError("method: minmax | percentile")
+    store: Dict[int, list] = {}
+    twin = _recording_twin(module, store)
+    params = variables.get("params", EMPTY)
+    state = variables.get("state", EMPTY)
+    for x in batches:
+        twin.forward(params, state, jnp.asarray(x), training=False)
+    out: Dict[int, float] = {}
+    for key, chunks in store.items():
+        a = np.concatenate(chunks)
+        amax = (float(np.max(a)) if method == "minmax"
+                else float(np.percentile(a, percentile)))
+        out[key] = max(amax, 1e-8) / 127.0
+    return out

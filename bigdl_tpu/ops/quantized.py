@@ -84,19 +84,25 @@ def int8_matmul(x_q, w_q, *, block_m: int = 256, block_n: int = 256,
     return out[:m, :n]
 
 
-def quantized_linear(x, w_q, w_scales, bias=None,
+def quantized_linear(x, w_q, w_scales, bias=None, act_scale=None,
                      interpret: Optional[bool] = None):
     """Dense layer with a pre-quantized (in, out) int8 weight.
 
-    Activations are dynamically quantized per row (abs-max), the matmul runs
-    int8×int8→int32, and the result is rescaled: y = (x_q·w_q) · sx ⊗ sw."""
+    Activation quantization is either **dynamic** per-row abs-max
+    (``act_scale=None``) or **static** per-tensor with a calibrated scale
+    (the reference's min/max-calibration path, SURVEY.md §3.2 — values
+    beyond ±127·scale saturate).  The matmul runs int8×int8→int32 and the
+    result is rescaled: y = (x_q·w_q) · sx ⊗ sw."""
     lead = x.shape[:-1]
     k = x.shape[-1]
     x2 = x.reshape(-1, k)
-    sx = abs_max_scales(x2, axis=1)  # (M,)
-    x_q = jnp.clip(jnp.round(x2 / sx[:, None]), -127, 127).astype(jnp.int8)
+    if act_scale is None:
+        sx = abs_max_scales(x2, axis=1)[:, None]  # (M, 1) dynamic
+    else:
+        sx = jnp.asarray(act_scale, jnp.float32)  # scalar, calibrated
+    x_q = jnp.clip(jnp.round(x2 / sx), -127, 127).astype(jnp.int8)
     acc = int8_matmul(x_q, w_q, interpret=interpret)
-    y = acc.astype(jnp.float32) * sx[:, None] * w_scales[None, :]
+    y = acc.astype(jnp.float32) * sx * w_scales[None, :]
     if bias is not None:
         y = y + bias
     return y.reshape(*lead, w_q.shape[1]).astype(x.dtype)

@@ -158,19 +158,30 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class FLServer:
-    """Synchronous-round FedAvg server.  ``with FLServer(world_size=2) as s:``"""
+    """Synchronous-round FedAvg server.  ``with FLServer(world_size=2) as s:``
+
+    TLS (reference ``scala/grpc`` TLS builders): pass ``tls_cert``/
+    ``tls_key`` (see ``ppml.tls.generate_self_signed``) and the transport
+    becomes https; clients pin the same cert via ``FLClient(cafile=...)``."""
 
     def __init__(self, world_size: int, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         self.state = _FLState(world_size)
         handler = type("BoundHandler", (_Handler,), {"state": self.state})
         self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.tls = tls_cert is not None
+        if self.tls:
+            from bigdl_tpu.ppml.tls import server_context
+
+            self.httpd.socket = server_context(tls_cert, tls_key).wrap_socket(
+                self.httpd.socket, server_side=True)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
     @property
     def target(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        return f"http{'s' if self.tls else ''}://127.0.0.1:{self.port}"
 
     def start(self) -> "FLServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -190,14 +201,14 @@ class FLServer:
 
 
 def _http(url: str, data: bytes = None, method: str = "GET",
-          timeout: float = 70.0):
+          timeout: float = 70.0, ctx=None):
     """(status, body) — urllib raises HTTPError on non-2xx; normalize it so
     callers can branch on status codes."""
     from urllib.error import HTTPError
 
     req = urlrequest.Request(url, data=data, method=method)
     try:
-        with urlrequest.urlopen(req, timeout=timeout) as r:
+        with urlrequest.urlopen(req, timeout=timeout, context=ctx) as r:
             return r.status, r.read()
     except HTTPError as e:
         return e.code, e.read()
@@ -206,16 +217,22 @@ def _http(url: str, data: bytes = None, method: str = "GET",
 class FLClient:
     """One federated party: local train steps + round sync."""
 
-    def __init__(self, target: str, client_id: str):
+    def __init__(self, target: str, client_id: str,
+                 cafile: Optional[str] = None):
         self.target = target
         self.client_id = client_id
         self.round = 0
+        self._ctx = None
+        if cafile is not None:
+            from bigdl_tpu.ppml.tls import client_context
+
+            self._ctx = client_context(cafile)
 
     def upload(self, variables: Any, weight: float = 1.0) -> None:
         body = _tree_to_npz_bytes(variables)
         url = (f"{self.target}/update?client={self.client_id}"
                f"&weight={weight}&round={self.round}")
-        code, resp = _http(url, data=body, method="POST")
+        code, resp = _http(url, data=body, method="POST", ctx=self._ctx)
         if code != 200:
             raise RuntimeError(
                 f"upload for round {self.round} failed ({code}): "
@@ -230,7 +247,7 @@ class FLClient:
         url = f"{self.target}/model?round={want}"
         deadline = time.monotonic() + max_wait
         while True:
-            code, body = _http(url)
+            code, body = _http(url, ctx=self._ctx)
             if code == 200:
                 break
             if code == 408 and time.monotonic() < deadline:
@@ -247,5 +264,6 @@ class FLClient:
         return self.download(variables)
 
     def status(self) -> Dict[str, Any]:
-        with urlrequest.urlopen(f"{self.target}/status", timeout=10) as r:
+        with urlrequest.urlopen(f"{self.target}/status", timeout=10,
+                                context=self._ctx) as r:
             return json.loads(r.read())

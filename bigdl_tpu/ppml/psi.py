@@ -74,16 +74,22 @@ def handle_psi_post(handler, state) -> None:
 class PSIServer:
     """Client-side helper speaking the /psi endpoints of an FLServer."""
 
-    def __init__(self, target: str, client_id: str):
+    def __init__(self, target: str, client_id: str, cafile=None):
         self.target = target
         self.client_id = client_id
         self._salt = None
+        self._ctx = None
+        if cafile is not None:
+            from bigdl_tpu.ppml.tls import client_context
+
+            self._ctx = client_context(cafile)
 
     def get_salt(self) -> str:
         if self._salt is None:
             req = urlrequest.Request(f"{self.target}/psi/salt", data=b"",
                                      method="POST")
-            with urlrequest.urlopen(req, timeout=10) as r:
+            with urlrequest.urlopen(req, timeout=10,
+                                    context=self._ctx) as r:
                 self._salt = json.loads(r.read())["salt"]
         return self._salt
 
@@ -94,7 +100,7 @@ class PSIServer:
         req = urlrequest.Request(
             f"{self.target}/psi/upload?client={self.client_id}", data=body,
             method="POST")
-        with urlrequest.urlopen(req, timeout=10) as r:
+        with urlrequest.urlopen(req, timeout=10, context=self._ctx) as r:
             assert r.status == 200
 
     def download_intersection(self, ids: Sequence[str],
@@ -109,7 +115,7 @@ class PSIServer:
         deadline = time.monotonic() + max_wait
         while True:
             code, body = _http(f"{self.target}/psi/intersect", data=b"",
-                               method="POST", timeout=10)
+                               method="POST", timeout=10, ctx=self._ctx)
             if code == 200:
                 inter = set(json.loads(body)["hashes"])
                 break

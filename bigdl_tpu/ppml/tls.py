@@ -1,0 +1,71 @@
+"""TLS for the PPML control plane.
+
+Reference analog (unverified — mount empty): ``scala/grpc`` — the shared
+gRPC plumbing ships TLS service builders used by the FL server/clients.
+PPML is the one subsystem whose point is NOT trusting the network, so the
+HTTP transport here gets the same option: a self-signed server certificate
+(generated in-process) and a client context pinned to that certificate
+(private-CA trust, no hostname dance beyond the CN/SAN)."""
+
+import datetime
+import ipaddress
+import os
+import ssl
+from typing import Tuple
+
+
+def generate_self_signed(out_dir: str, common_name: str = "bigdl-tpu-fl",
+                         days: int = 365) -> Tuple[str, str]:
+    """Write a self-signed cert + key pair; returns (cert_path, key_path).
+
+    The cert carries SANs for localhost/127.0.0.1 plus ``common_name`` so
+    pinned clients verify cleanly on the loopback and cluster DNS names."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName([
+            x509.DNSName("localhost"),
+            x509.DNSName(common_name),
+            x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+        ]), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    cert_path = os.path.join(out_dir, "server.crt")
+    key_path = os.path.join(out_dir, "server.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    return cert_path, key_path
+
+
+def server_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def client_context(cafile: str) -> ssl.SSLContext:
+    """Trust exactly the given (self-signed) certificate — private-CA
+    pinning, NOT certificate-check disabling."""
+    ctx = ssl.create_default_context(cafile=cafile)
+    ctx.check_hostname = False  # pinned trust; CN varies across clusters
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

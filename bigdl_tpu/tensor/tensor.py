@@ -81,6 +81,16 @@ class Tensor:
         return Tensor(jnp.eye(n, dtype=dtype or get_default_dtype()))
 
     @staticmethod
+    def linspace(start, stop, steps, dtype=None) -> "Tensor":
+        return Tensor(jnp.linspace(start, stop, steps,
+                                   dtype=dtype or get_default_dtype()))
+
+    @staticmethod
+    def logspace(start, stop, steps, base=10.0, dtype=None) -> "Tensor":
+        return Tensor(jnp.logspace(start, stop, steps, base=base,
+                                   dtype=dtype or get_default_dtype()))
+
+    @staticmethod
     def rand(*size, key=None, dtype=None) -> "Tensor":
         key = _key(key)
         return Tensor(jax.random.uniform(key, _size(size), dtype or get_default_dtype()))
@@ -244,10 +254,90 @@ class Tensor:
     def cos(self):
         return Tensor(jnp.cos(self.data))
 
+    def tan(self):
+        return Tensor(jnp.tan(self.data))
+
+    def sinh(self):
+        return Tensor(jnp.sinh(self.data))
+
+    def cosh(self):
+        return Tensor(jnp.cosh(self.data))
+
+    def asin(self):
+        return Tensor(jnp.arcsin(self.data))
+
+    def acos(self):
+        return Tensor(jnp.arccos(self.data))
+
+    def atan(self):
+        return Tensor(jnp.arctan(self.data))
+
+    def atan2(self, o):
+        return Tensor(jnp.arctan2(self.data, _unwrap(o)))
+
+    def asinh(self):
+        return Tensor(jnp.arcsinh(self.data))
+
+    def acosh(self):
+        return Tensor(jnp.arccosh(self.data))
+
+    def atanh(self):
+        return Tensor(jnp.arctanh(self.data))
+
+    def log2(self):
+        return Tensor(jnp.log2(self.data))
+
+    def log10(self):
+        return Tensor(jnp.log10(self.data))
+
+    def expm1(self):
+        return Tensor(jnp.expm1(self.data))
+
+    def erfc(self):
+        return Tensor(jax.lax.erfc(self.data))
+
+    def lgamma(self):
+        return Tensor(jax.lax.lgamma(self.data))
+
+    def digamma(self):
+        return Tensor(jax.lax.digamma(self.data))
+
+    def frac(self):
+        """Fractional part with the sign of the input (torch ``frac``)."""
+        return Tensor(self.data - jnp.trunc(self.data))
+
+    def trunc(self):
+        return Tensor(jnp.trunc(self.data))
+
+    def reciprocal(self):
+        return Tensor(1.0 / self.data)
+
+    inv = reciprocal
+
+    def neg(self):
+        return Tensor(-self.data)
+
+    def remainder(self, o):
+        """Python/torch ``remainder``: result has the divisor's sign."""
+        return Tensor(jnp.remainder(self.data, _unwrap(o)))
+
+    def fmod(self, o):
+        """C ``fmod``: result has the dividend's sign."""
+        return Tensor(jnp.fmod(self.data, _unwrap(o)))
+
+    def lerp(self, end, weight):
+        return Tensor(self.data + weight * (_unwrap(end) - self.data))
+
     def clamp(self, min_v, max_v):
         return Tensor(jnp.clip(self.data, min_v, max_v))
 
     clip = clamp
+
+    def clamp_min(self, v):
+        return Tensor(jnp.maximum(self.data, v))
+
+    def clamp_max(self, v):
+        return Tensor(jnp.minimum(self.data, v))
 
     def maximum(self, o):
         return Tensor(jnp.maximum(self.data, _unwrap(o)))
@@ -348,6 +438,61 @@ class Tensor:
         if not largest:
             vals = -vals
         return Tensor(jnp.moveaxis(vals, -1, dim)), Tensor(jnp.moveaxis(idx, -1, dim))
+
+    def cumprod(self, dim=0) -> "Tensor":
+        return Tensor(jnp.cumprod(self.data, axis=dim))
+
+    def median(self, dim=None) -> "Tensor":
+        return Tensor(jnp.median(self.data, axis=dim))
+
+    def kthvalue(self, k: int, dim=-1):
+        """(values, indices) of the k-th SMALLEST along dim (1-indexed,
+        torch semantics)."""
+        order = jnp.argsort(self.data, axis=dim)
+        idx = jnp.take(order, k - 1, axis=dim)
+        vals = jnp.take_along_axis(
+            self.data, jnp.expand_dims(idx, dim), axis=dim).squeeze(dim)
+        return Tensor(vals), Tensor(idx)
+
+    def sort(self, dim=-1, descending=False):
+        d = -self.data if descending else self.data
+        idx = jnp.argsort(d, axis=dim)
+        vals = jnp.take_along_axis(self.data, idx, axis=dim)
+        return Tensor(vals), Tensor(idx)
+
+    def argsort(self, dim=-1, descending=False) -> "Tensor":
+        d = -self.data if descending else self.data
+        return Tensor(jnp.argsort(d, axis=dim))
+
+    def all(self, dim=None) -> "Tensor":
+        return Tensor(jnp.all(self.data, axis=dim))
+
+    def any(self, dim=None) -> "Tensor":
+        return Tensor(jnp.any(self.data, axis=dim))
+
+    def count_nonzero(self, dim=None) -> "Tensor":
+        return Tensor(jnp.count_nonzero(self.data, axis=dim))
+
+    def nansum(self, dim=None) -> "Tensor":
+        return Tensor(jnp.nansum(self.data, axis=dim))
+
+    def nanmean(self, dim=None) -> "Tensor":
+        return Tensor(jnp.nanmean(self.data, axis=dim))
+
+    def dist(self, o, p=2) -> "Tensor":
+        return Tensor(jnp.linalg.norm(
+            (self.data - _unwrap(o)).ravel(), ord=p))
+
+    def renorm(self, p: float, dim: int, max_norm: float) -> "Tensor":
+        """Reference ``renorm``: scale sub-tensors along ``dim`` whose
+        p-norm exceeds ``max_norm`` down to it."""
+        moved = jnp.moveaxis(self.data, dim, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return Tensor(jnp.moveaxis(out.reshape(moved.shape), 0, dim))
 
     # -- shape ops ----------------------------------------------------------
     def view(self, *size) -> "Tensor":
@@ -458,9 +603,157 @@ class Tensor:
         grids[dim] = idx
         return Tensor(self.data.at[tuple(grids)].set(src_a))
 
+    def scatter_add(self, dim: int, index, src) -> "Tensor":
+        idx = _unwrap(index)
+        src_a = jnp.broadcast_to(_unwrap(src), idx.shape)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                             indexing="ij")
+        grids[dim] = idx
+        return Tensor(self.data.at[tuple(grids)].add(src_a))
+
+    def index_fill(self, dim: int, index, value) -> "Tensor":
+        idx = [slice(None)] * self.data.ndim
+        idx[dim] = _unwrap(index)
+        return Tensor(self.data.at[tuple(idx)].set(value))
+
+    def index_copy(self, dim: int, index, src) -> "Tensor":
+        idx = [slice(None)] * self.data.ndim
+        idx[dim] = _unwrap(index)
+        return Tensor(self.data.at[tuple(idx)].set(_unwrap(src)))
+
+    def index_add(self, dim: int, index, src) -> "Tensor":
+        idx = [slice(None)] * self.data.ndim
+        idx[dim] = _unwrap(index)
+        return Tensor(self.data.at[tuple(idx)].add(_unwrap(src)))
+
+    def take(self, index) -> "Tensor":
+        return Tensor(jnp.take(self.data.ravel(), _unwrap(index)))
+
+    # -- structure / linalg --------------------------------------------------
+    def diag(self, k: int = 0) -> "Tensor":
+        return Tensor(jnp.diag(self.data, k=k))
+
+    def triu(self, k: int = 0) -> "Tensor":
+        return Tensor(jnp.triu(self.data, k=k))
+
+    def tril(self, k: int = 0) -> "Tensor":
+        return Tensor(jnp.tril(self.data, k=k))
+
+    def trace(self) -> "Tensor":
+        return Tensor(jnp.trace(self.data))
+
+    def cross(self, o, dim=-1) -> "Tensor":
+        return Tensor(jnp.cross(self.data, _unwrap(o), axis=dim))
+
+    def kron(self, o) -> "Tensor":
+        return Tensor(jnp.kron(self.data, _unwrap(o)))
+
+    def flip(self, dim) -> "Tensor":
+        return Tensor(jnp.flip(self.data, axis=dim))
+
+    def roll(self, shifts, dim=None) -> "Tensor":
+        return Tensor(jnp.roll(self.data, shifts, axis=dim))
+
+    def rot90(self, k: int = 1, dims=(0, 1)) -> "Tensor":
+        return Tensor(jnp.rot90(self.data, k=k, axes=dims))
+
+    def tile(self, reps) -> "Tensor":
+        return Tensor(jnp.tile(self.data, reps))
+
+    def repeat_interleave(self, repeats: int, dim: Optional[int] = None
+                          ) -> "Tensor":
+        return Tensor(jnp.repeat(self.data, repeats, axis=dim))
+
+    def unfold(self, dim: int, size: int, step: int) -> "Tensor":
+        """Sliding windows along ``dim`` (torch ``unfold``): the window
+        axis lands last."""
+        n = (self.data.shape[dim] - size) // step + 1
+        starts = jnp.arange(n) * step
+        moved = jnp.moveaxis(self.data, dim, 0)
+        win = jax.vmap(
+            lambda s: jax.lax.dynamic_slice_in_dim(moved, s, size, 0))(starts)
+        # win: (n, size, *rest) -> (n, *rest, size), then restore dim
+        win = jnp.moveaxis(win, 1, -1)
+        return Tensor(jnp.moveaxis(win, 0, dim))
+
+    def baddbmm(self, b1, b2, beta: float = 1.0, alpha: float = 1.0
+                ) -> "Tensor":
+        prod = jnp.matmul(_unwrap(b1), _unwrap(b2),
+                          preferred_element_type=jnp.float32)
+        return Tensor((beta * self.data.astype(jnp.float32)
+                       + alpha * prod).astype(self.dtype))
+
+    def inverse(self) -> "Tensor":
+        return Tensor(jnp.linalg.inv(self.data))
+
+    def det(self) -> "Tensor":
+        return Tensor(jnp.linalg.det(self.data))
+
+    def svd(self):
+        u, s, vt = jnp.linalg.svd(self.data, full_matrices=False)
+        return Tensor(u), Tensor(s), Tensor(vt)
+
+    def qr(self):
+        q, r = jnp.linalg.qr(self.data)
+        return Tensor(q), Tensor(r)
+
+    def cholesky(self) -> "Tensor":
+        return Tensor(jnp.linalg.cholesky(self.data))
+
+    def solve(self, b) -> "Tensor":
+        return Tensor(jnp.linalg.solve(self.data, _unwrap(b)))
+
+    def matrix_power(self, n: int) -> "Tensor":
+        return Tensor(jnp.linalg.matrix_power(self.data, n))
+
+    # -- random (explicit keys: the TPU PRNG discipline) ---------------------
+    def bernoulli(self, p: float = 0.5, key=None) -> "Tensor":
+        return Tensor(jax.random.bernoulli(
+            _key(key), p, self.shape).astype(self.dtype))
+
+    def uniform(self, low: float = 0.0, high: float = 1.0, key=None
+                ) -> "Tensor":
+        return Tensor(jax.random.uniform(
+            _key(key), self.shape, self.dtype if jnp.issubdtype(
+                self.dtype, jnp.floating) else jnp.float32,
+            minval=low, maxval=high))
+
+    def normal(self, mean: float = 0.0, std: float = 1.0, key=None
+               ) -> "Tensor":
+        return Tensor(mean + std * jax.random.normal(
+            _key(key), self.shape,
+            self.dtype if jnp.issubdtype(self.dtype, jnp.floating)
+            else jnp.float32))
+
+    def multinomial(self, num_samples: int, key=None) -> "Tensor":
+        """Sample category indices from unnormalized row weights:
+        (C,) → (num_samples,); (B, C) → (B, num_samples)."""
+        logits = jnp.log(jnp.maximum(self.data, 1e-30))
+        if logits.ndim == 1:
+            return Tensor(jax.random.categorical(
+                _key(key), logits, shape=(num_samples,)))
+        s = jax.random.categorical(
+            _key(key), logits, shape=(num_samples,) + logits.shape[:-1])
+        return Tensor(jnp.moveaxis(s, 0, -1))
+
     # -- misc ---------------------------------------------------------------
     def isnan(self) -> "Tensor":
         return Tensor(jnp.isnan(self.data))
+
+    def isinf(self) -> "Tensor":
+        return Tensor(jnp.isinf(self.data))
+
+    def isfinite(self) -> "Tensor":
+        return Tensor(jnp.isfinite(self.data))
+
+    def ne(self, o) -> "Tensor":
+        return Tensor(self.data != _unwrap(o))
+
+    def equal(self, o) -> bool:
+        """Exact whole-tensor equality (reference ``equal``)."""
+        o = _unwrap(o)
+        return bool(self.data.shape == o.shape
+                    and jnp.all(self.data == o))
 
     def almost_equal(self, o, tol=1e-5) -> bool:
         return bool(jnp.allclose(self.data, _unwrap(o), atol=tol, rtol=tol))

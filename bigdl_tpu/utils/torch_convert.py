@@ -70,6 +70,11 @@ def _conv1d(tm):
 
 
 def _convtranspose2d(tm):
+    if (tuple(tm.output_padding) != (0, 0) or tuple(tm.dilation) != (1, 1)
+            or tm.groups != 1):
+        raise NotImplementedError(
+            "ConvTranspose2d conversion supports output_padding=0, "
+            "dilation=1, groups=1")
     layer = N.Conv2DTranspose(tm.in_channels, tm.out_channels,
                               tuple(tm.kernel_size), stride=tuple(tm.stride),
                               padding=tuple(tm.padding),
@@ -95,8 +100,12 @@ def _linear(tm, permute_from: Optional[Tuple[int, int, int]] = None):
 
 
 def _batchnorm(tm):
+    if tm.momentum is None:
+        raise NotImplementedError(
+            "BatchNorm momentum=None (cumulative averaging) has no "
+            "equivalent; set a numeric momentum")
     layer = N.BatchNorm(tm.num_features, eps=tm.eps,
-                        momentum=tm.momentum or 0.1,
+                        momentum=tm.momentum,
                         affine=tm.affine)
     p = {}
     if tm.affine:
@@ -313,15 +322,25 @@ def from_torch_module(tmodule, example_input=None):
                 "example_input so shapes can be propagated (a torch dim on "
                 "a 4-D NCHW tensor maps to a different NHWC axis)")
         if len(shape) == 4:
-            return {0: 0, 1: -1, 2: 1, 3: 2, -1: 2, -3: -1}[dim]
+            table = {0: 0, 1: -1, 2: 1, 3: 2, -1: 2, -2: 1, -3: -1, -4: 0}
+            if dim not in table:
+                raise NotImplementedError(f"axis {dim} on a 4-D tensor")
+            return table[dim]
         return dim
 
     def is_flatten_to_vec(node):
-        """view/reshape/flatten collapsing everything after batch."""
-        if node.op == "call_function" and node.target is torch.flatten:
-            return (len(node.args) == 1 or node.args[1] == 1)
-        if node.op == "call_method" and node.target == "flatten":
-            return (len(node.args) == 1 or node.args[1] == 1)
+        """view/reshape/flatten collapsing everything AFTER the batch dim
+        (start_dim=1, end_dim=-1).  Other start/end dims fall through to
+        the generic unsupported-node error — a partial flatten is not a
+        batch-preserving vectorization."""
+        if ((node.op == "call_function" and node.target is torch.flatten)
+                or (node.op == "call_method"
+                    and node.target == "flatten")):
+            start = (node.args[1] if len(node.args) > 1
+                     else node.kwargs.get("start_dim", 0))
+            end = (node.args[2] if len(node.args) > 2
+                   else node.kwargs.get("end_dim", -1))
+            return start == 1 and end == -1
         if node.op == "call_method" and node.target in ("view", "reshape"):
             return len(node.args) == 3 and node.args[2] == -1
         return False
@@ -369,6 +388,16 @@ def from_torch_module(tmodule, example_input=None):
                     f"no conversion for torch module {tname} "
                     f"(at graph node {node.name})")
             conv = _SIMPLE[tname]
+            # elementwise layers preserve the flattened HWC element order,
+            # so a pending Linear weight-permutation marker flows through
+            # (classifier heads commonly interleave Dropout/ReLU between
+            # flatten and fc)
+            _PASSTHROUGH = ("Dropout", "ReLU", "ReLU6", "GELU", "SiLU",
+                            "Sigmoid", "Tanh", "ELU", "LeakyReLU",
+                            "Hardtanh", "Identity", "PReLU")
+            if tname in _PASSTHROUGH and src_nodes \
+                    and src_nodes[0] in pre_flatten:
+                pre_flatten[node] = pre_flatten[src_nodes[0]]
             permute_from = None
             if tname == "Linear":
                 src = src_nodes[0]
@@ -430,6 +459,8 @@ def from_torch_module(tmodule, example_input=None):
             elif is_flatten_to_vec(node):
                 handle_flatten(node, node.args[0])
             elif fn in (torch.relu, torch.nn.functional.relu):
+                if node.args[0] in pre_flatten:
+                    pre_flatten[node] = pre_flatten[node.args[0]]
                 emit(node, N.ReLU(), [sym[node.args[0]]])
             elif fn is torch.nn.functional.gelu:
                 emit(node, N.GELU(), [sym[node.args[0]]])
@@ -440,6 +471,8 @@ def from_torch_module(tmodule, example_input=None):
             elif fn is torch.nn.functional.softmax:
                 emit(node, N.SoftMax(), [sym[node.args[0]]])
             elif fn is torch.nn.functional.dropout:
+                if node.args[0] in pre_flatten:
+                    pre_flatten[node] = pre_flatten[node.args[0]]
                 p = node.args[1] if len(node.args) > 1 else \
                     node.kwargs.get("p", 0.5)
                 emit(node, N.Dropout(p), [sym[node.args[0]]])

@@ -136,3 +136,106 @@ def test_estimator_loaded_weights_evaluate_and_multiinput_predict(tmp_path):
     est3.load(mpath)
     pred = est3.predict((a, b), batch_size=16)
     assert pred.shape == (32, 2)
+
+
+def test_sharded_read_csv_disjoint(tmp_path):
+    """Sharded reads take disjoint round-robin file slices per process."""
+    import pandas as pd
+
+    for i in range(5):
+        pd.DataFrame({"a": np.full(4, i)}).to_csv(
+            tmp_path / f"p{i}.csv", index=False)
+    s0 = read_csv(str(tmp_path), process_id=0, process_count=2)
+    s1 = read_csv(str(tmp_path), process_id=1, process_count=2)
+    v0 = set(s0.concat()["a"])
+    v1 = set(s1.concat()["a"])
+    assert v0 == {0, 2, 4} and v1 == {1, 3}
+    # process-local collections own everything local
+    assert len(s0.owned()) == s0.num_partitions() == 3
+
+
+def test_two_process_sharded_read_feeds_estimator(tmp_path):
+    """VERDICT #8 'Done' spec: 2-process CPU run where each process reads
+    distinct files and the estimator consumes them without a full-host
+    concat."""
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    import pandas as pd
+
+    rs = np.random.RandomState(0)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    for i in range(4):
+        x = rs.rand(64, 4).astype(np.float32)
+        df = pd.DataFrame(x, columns=[f"f{j}" for j in range(4)])
+        df["y"] = (x.sum(1) > 2).astype(np.int32)
+        df.to_csv(data_dir / f"part{i}.csv", index=False)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = textwrap.dedent(f"""
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from bigdl_tpu.data.shards import read_csv
+        from bigdl_tpu.estimator import Estimator, init_context
+        from bigdl_tpu import nn
+        from bigdl_tpu.optim.optim_method import Adam
+
+        init_context("multihost")
+        assert jax.process_count() == 2
+        xs = read_csv({str(data_dir)!r}, sharded=True)
+        assert xs.num_partitions() == 2   # 4 files round-robin over 2 procs
+        df = xs.owned_concat()
+        assert len(df) == 128             # half the 256 global rows
+        data = (df[[c for c in df.columns if c.startswith("f")]].values
+                .astype(np.float32), df["y"].values.astype(np.int32))
+        est = Estimator.from_module(
+            lambda c: nn.Sequential([nn.Linear(4, 2)]),
+            lambda c: Adam(learning_rate=1e-2),
+            lambda c: nn.CrossEntropyCriterion())
+        stats = est.fit(data, epochs=2, batch_size=32)
+        print(f"RANK{{jax.process_index()}}_OK={{stats['num_samples']}}")
+    """)
+    script = tmp_path / "worker.py"
+    script.write_text(worker)
+    import os as _os
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    pythonpath = _os.pathsep.join(
+        p for p in [repo_root, _os.environ.get("PYTHONPATH")] if p)
+    procs = []
+    try:
+        for r in range(2):
+            env = dict(_os.environ,
+                       BIGDL_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       BIGDL_TPU_NUM_PROCESSES="2",
+                       BIGDL_TPU_PROCESS_ID=str(r),
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=pythonpath)
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+        for r, out in enumerate(outs):
+            assert procs[r].returncode == 0, out[-2000:]
+            assert f"RANK{r}_OK=128" in out, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_sharded_read_empty_slice_raises_clearly(tmp_path):
+    import pandas as pd
+
+    d = tmp_path / "few"
+    d.mkdir()
+    pd.DataFrame({"a": [1]}).to_csv(d / "only.csv", index=False)
+    with pytest.raises(ValueError, match="owns no files"):
+        read_csv(str(d), process_id=1, process_count=2)

@@ -159,3 +159,65 @@ def test_unsupported_module_raises_with_node_name():
     with pytest.raises(NotImplementedError):
         from_torch_module(Odd(), example_input=RS.rand(2, 3).astype(
             np.float32))
+
+
+def test_dropout_between_flatten_and_linear_keeps_permutation():
+    """Regression: elementwise ops between flatten and fc must propagate
+    the NCHW->NHWC Linear weight-permutation marker."""
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv = torch.nn.Conv2d(2, 3, 3, padding=1)
+            self.drop = torch.nn.Dropout(0.5)
+            self.fc = torch.nn.Linear(3 * 4 * 4, 5)
+
+        def forward(self, x):
+            y = torch.relu(self.conv(x))
+            y = torch.flatten(y, 1)
+            y = self.drop(y)
+            return self.fc(y)
+
+    tm = Net().eval()
+    x = RS.rand(2, 2, 4, 4).astype(np.float32)
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=2e-4)
+
+
+def test_unsupported_configs_raise_cleanly():
+    # partial flatten is not a batch-preserving vectorization
+    class Partial(torch.nn.Module):
+        def forward(self, x):
+            return torch.flatten(x, start_dim=2)
+
+    with pytest.raises(NotImplementedError):
+        from_torch_module(Partial(),
+                          example_input=RS.rand(2, 3, 4, 4).astype(
+                              np.float32))
+
+    # output_padding has no equivalent
+    with pytest.raises(NotImplementedError):
+        from_torch_module(
+            torch.nn.Sequential(torch.nn.ConvTranspose2d(
+                2, 2, 3, stride=2, padding=1, output_padding=1)),
+            example_input=RS.rand(1, 2, 4, 4).astype(np.float32))
+
+    # BatchNorm cumulative averaging has no equivalent
+    with pytest.raises(NotImplementedError):
+        from_torch_module(
+            torch.nn.Sequential(torch.nn.Conv2d(2, 2, 1),
+                                torch.nn.BatchNorm2d(2, momentum=None)),
+            example_input=RS.rand(1, 2, 4, 4).astype(np.float32))
+
+    # multi-param-group optimizers refuse loudly
+    from bigdl_tpu.utils.torch_convert import convert_torch_optimizer
+
+    lin1, lin2 = torch.nn.Linear(2, 2), torch.nn.Linear(2, 2)
+    topt = torch.optim.Adam([
+        {"params": lin1.parameters(), "lr": 1e-5},
+        {"params": lin2.parameters(), "lr": 1e-3}])
+    with pytest.raises(NotImplementedError):
+        convert_torch_optimizer(topt)

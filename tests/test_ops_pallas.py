@@ -205,3 +205,112 @@ class TestFlashInMHA:
         y_flash, _ = mha_flash.apply(variables, x)
         np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_ref),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestActivationCalibration:
+    """Reference min/max + percentile activation calibration (SURVEY §3.2):
+    static per-tensor activation scales from a calibration set, accuracy
+    within 1% of float on a trained zoo-style model."""
+
+    def _trained_mlp(self):
+        from bigdl_tpu import nn, optim
+        from bigdl_tpu.data.dataset import ArrayDataSet
+        from bigdl_tpu.runtime.engine import Engine, init_engine
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(512, 16).astype(np.float32)
+        y = (x[:, :8].sum(1) > x[:, 8:].sum(1)).astype(np.int32)
+        Engine.reset()
+        init_engine(data=1)
+        model = nn.Sequential([nn.Linear(16, 32), nn.ReLU(),
+                               nn.Linear(32, 2)])
+        opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                              nn.CrossEntropyCriterion(), batch_size=64)
+        opt.set_optim_method(optim.Adam(learning_rate=5e-3))
+        opt.set_end_when(optim.Trigger.max_epoch(20))
+        opt.log_every = 10000
+        trained = opt.optimize()
+        return model, trained.variables, x, y
+
+    def test_calibrated_quantize_accuracy_within_1pct(self):
+        from bigdl_tpu.nn.quantized import calibrate, quantize
+
+        model, variables, x, y = self._trained_mlp()
+
+        def top1(variables_, mod):
+            out, _ = mod.forward(variables_["params"], variables_["state"],
+                                 jnp.asarray(x), training=False)
+            return float((np.asarray(out).argmax(1) == y).mean())
+
+        acc_f32 = top1(variables, model)
+        calib = calibrate(model, variables,
+                          [x[i:i + 64] for i in range(0, 256, 64)],
+                          method="percentile", percentile=99.9)
+        assert len(calib) == 2  # both Linear leaves calibrated
+        q_model, q_vars = quantize(model, variables, calib=calib)
+        # calibrated scales recorded as static act_scale params
+        flat = str(q_vars["params"])
+        assert "act_scale" in flat
+        acc_int8 = top1(q_vars, q_model)
+        assert acc_f32 - acc_int8 < 0.01, (acc_f32, acc_int8)
+
+    def test_minmax_vs_percentile_scales(self):
+        from bigdl_tpu import nn
+        from bigdl_tpu.nn.quantized import calibrate
+
+        model = nn.Sequential([nn.Linear(8, 4)])
+        rs = np.random.RandomState(1)
+        x = rs.randn(64, 8).astype(np.float32)
+        x[0, 0] = 100.0  # outlier
+        v = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        mm = calibrate(model, v, [x], method="minmax")
+        pc = calibrate(model, v, [x], method="percentile", percentile=99.0)
+        (k,) = mm.keys()
+        assert mm[k] > 0.5          # dominated by the outlier (100/127)
+        assert pc[k] < 0.1 * mm[k]  # percentile clips it away
+
+    def test_nano_quantize_with_calibration(self):
+        from bigdl_tpu.nano.inference import InferenceOptimizer
+
+        model, variables, x, y = self._trained_mlp()
+        tm = InferenceOptimizer.quantize(
+            model, variables, sample=x[:64], precision="int8",
+            calib_data=[x[64:128], x[128:192]])
+        out = np.asarray(tm(x[:64]))
+        acc = (out.argmax(1) == y[:64]).mean()
+        assert acc > 0.8
+
+    def test_quantize_and_calibrate_keras_functional_model(self):
+        """Regression: quantize/calibrate must descend keras functional
+        Models (params keyed by node name), not just Containers."""
+        from bigdl_tpu import nn
+        from bigdl_tpu.keras.engine import Input, Model
+        from bigdl_tpu.nn.quantized import (QuantizedLinear, calibrate,
+                                            quantize)
+
+        inp = Input((8,))
+        h = nn.Linear(8, 16)(inp)
+        h = nn.ReLU()(h)
+        out = nn.Linear(16, 3)(h)
+        model = Model(inp, out)
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8).astype(np.float32)
+        v = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+        calib = calibrate(model, v, [x], method="minmax")
+        assert len(calib) == 2
+
+        q_model, q_vars = quantize(model, v, calib=calib)
+        qlayers = [n.layer for n in q_model.order
+                   if isinstance(n.layer, QuantizedLinear)]
+        assert len(qlayers) == 2
+        assert "act_scale" in str(q_vars["params"])
+
+        y_f32, _ = model.apply(v, jnp.asarray(x))
+        y_q, _ = q_model.apply(q_vars, jnp.asarray(x))
+        # int8 with calibrated scales stays close to float
+        err = np.abs(np.asarray(y_q) - np.asarray(y_f32)).max()
+        assert err < 0.1 * np.abs(np.asarray(y_f32)).max()
+        # the ORIGINAL model is untouched
+        assert not any(isinstance(n.layer, QuantizedLinear)
+                       for n in model.order)

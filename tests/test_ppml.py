@@ -147,3 +147,53 @@ def test_vfl_split_nn_trains():
     pred = np.asarray(trainer.predict(xs))
     acc = ((pred[:, 0] > 0) == y[:, 0]).mean()
     assert acc > 0.85, acc
+
+
+def test_fl_round_trip_over_tls(tmp_path):
+    """VERDICT #9: https FL round trip — self-signed server cert, client
+    pinned to it (reference scala/grpc TLS builders)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ppml.fl import FLClient, FLServer
+    from bigdl_tpu.ppml.tls import generate_self_signed
+
+    cert, key = generate_self_signed(str(tmp_path / "tls"))
+    tree = {"w": jnp.asarray([1.0, 2.0]), "b": jnp.asarray([0.5])}
+    with FLServer(world_size=2, tls_cert=cert, tls_key=key) as srv:
+        assert srv.target.startswith("https://")
+        c1 = FLClient(srv.target, "a", cafile=cert)
+        c2 = FLClient(srv.target, "b", cafile=cert)
+        out = {}
+
+        def run(c, scale, key_):
+            scaled = {k: v * scale for k, v in tree.items()}
+            out[key_] = c.sync(scaled)
+
+        t = threading.Thread(target=run, args=(c2, 3.0, "b"))
+        t.start()
+        run(c1, 1.0, "a")
+        t.join(timeout=60)
+        # FedAvg of 1x and 3x = 2x
+        np.testing.assert_allclose(np.asarray(out["a"]["w"]), [2.0, 4.0],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["a"]["b"]),
+                                   np.asarray(out["b"]["b"]), rtol=1e-6)
+
+
+def test_fl_tls_rejects_unpinned_client(tmp_path):
+    """A client without the pinned CA must fail the handshake — the cert
+    is self-signed, so default trust stores reject it."""
+    import urllib.error
+    import urllib.request
+
+    from bigdl_tpu.ppml.fl import FLServer
+    from bigdl_tpu.ppml.tls import generate_self_signed
+
+    cert, key = generate_self_signed(str(tmp_path / "tls"))
+    import pytest
+
+    with FLServer(world_size=1, tls_cert=cert, tls_key=key) as srv:
+        with pytest.raises((urllib.error.URLError, OSError)):
+            urllib.request.urlopen(f"{srv.target}/status", timeout=10)

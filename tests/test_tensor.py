@@ -1,0 +1,206 @@
+"""Tensor facade specs — the reference ``DenseTensorMathSpec``-style
+coverage (torch as golden oracle where semantics are torch-defined)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.tensor.tensor import Tensor
+
+RS = np.random.RandomState(0)
+
+
+def A(*shape):
+    return RS.randn(*shape).astype(np.float32)
+
+
+def test_elementwise_math_tranche():
+    x = Tensor(A(3, 4) * 0.5)
+    for name, ref in [
+        ("tan", np.tan), ("sinh", np.sinh), ("cosh", np.cosh),
+        ("asin", lambda a: np.arcsin(np.clip(a, -1, 1))),
+        ("atan", np.arctan), ("log2", None), ("log10", None),
+        ("expm1", np.expm1), ("trunc", np.trunc),
+    ]:
+        if name in ("asin",):
+            t = Tensor(np.clip(np.asarray(x.data), -1, 1))
+        else:
+            t = x
+        got = np.asarray(getattr(t, name)().data)
+        if ref is not None:
+            np.testing.assert_allclose(got, ref(np.asarray(t.data)),
+                                       rtol=1e-5, atol=1e-6)
+        assert got.shape == t.shape
+
+
+def test_frac_remainder_fmod_match_torch():
+    torch = pytest.importorskip("torch")
+    a = A(4, 5) * 3
+    b = np.abs(A(4, 5)) + 0.5
+    ta = torch.tensor(a)
+    tb = torch.tensor(b)
+    np.testing.assert_allclose(np.asarray(Tensor(a).frac().data),
+                               torch.frac(ta).numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).remainder(b).data),
+                               torch.remainder(ta, tb).numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(Tensor(a).fmod(b).data),
+                               torch.fmod(ta, tb).numpy(), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).lerp(b, 0.3).data),
+        torch.lerp(ta, tb, 0.3).numpy(), atol=1e-6)
+
+
+def test_sort_kthvalue_median():
+    torch = pytest.importorskip("torch")
+    a = A(3, 7)
+    vals, idx = Tensor(a).sort(dim=1)
+    tv, ti = torch.tensor(a).sort(dim=1)
+    np.testing.assert_allclose(np.asarray(vals.data), tv.numpy())
+    np.testing.assert_array_equal(np.asarray(idx.data), ti.numpy())
+    vals, idx = Tensor(a).sort(dim=1, descending=True)
+    tv, _ = torch.tensor(a).sort(dim=1, descending=True)
+    np.testing.assert_allclose(np.asarray(vals.data), tv.numpy())
+    kv, ki = Tensor(a).kthvalue(3, dim=1)
+    tkv, tki = torch.tensor(a).kthvalue(3, dim=1)
+    np.testing.assert_allclose(np.asarray(kv.data), tkv.numpy())
+    np.testing.assert_array_equal(np.asarray(ki.data), tki.numpy())
+
+
+def test_renorm_caps_row_norms():
+    a = A(4, 6) * 5
+    out = np.asarray(Tensor(a).renorm(2, 0, 1.0).data)
+    norms = np.linalg.norm(out.reshape(4, -1), axis=1)
+    assert np.all(norms <= 1.0 + 1e-5)
+    # rows already under the cap are untouched
+    small = np.asarray(Tensor(a * 1e-3).renorm(2, 0, 1.0).data)
+    np.testing.assert_allclose(small, a * 1e-3, rtol=1e-6)
+
+
+def test_structure_ops():
+    a = A(4, 4)
+    np.testing.assert_allclose(np.asarray(Tensor(a).triu(1).data),
+                               np.triu(a, 1))
+    np.testing.assert_allclose(np.asarray(Tensor(a).tril(-1).data),
+                               np.tril(a, -1))
+    np.testing.assert_allclose(float(Tensor(a).trace().data), np.trace(a),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).flip(0).data), a[::-1])
+    np.testing.assert_allclose(np.asarray(Tensor(a).roll(1, 0).data),
+                               np.roll(a, 1, 0))
+    np.testing.assert_allclose(np.asarray(Tensor(a).rot90().data),
+                               np.rot90(a))
+    b = A(2, 3)
+    np.testing.assert_allclose(np.asarray(Tensor(b).kron(np.eye(
+        2, dtype=np.float32)).data), np.kron(b, np.eye(2)), rtol=1e-6)
+
+
+def test_unfold_matches_torch():
+    torch = pytest.importorskip("torch")
+    a = A(2, 10)
+    got = np.asarray(Tensor(a).unfold(1, 4, 3).data)
+    want = torch.tensor(a).unfold(1, 4, 3).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_linalg_ops():
+    a = A(3, 3) + 3 * np.eye(3, dtype=np.float32)
+    inv = np.asarray(Tensor(a).inverse().data)
+    np.testing.assert_allclose(a @ inv, np.eye(3), atol=1e-4)
+    np.testing.assert_allclose(float(Tensor(a).det().data),
+                               np.linalg.det(a), rtol=1e-4)
+    u, s, vt = Tensor(a).svd()
+    np.testing.assert_allclose(
+        np.asarray(u.data) @ np.diag(np.asarray(s.data)) @ np.asarray(vt.data),
+        a, atol=1e-4)
+    q, r = Tensor(a).qr()
+    np.testing.assert_allclose(np.asarray(q.data) @ np.asarray(r.data), a,
+                               atol=1e-4)
+    spd = a @ a.T + np.eye(3, dtype=np.float32)
+    ch = np.asarray(Tensor(spd).cholesky().data)
+    np.testing.assert_allclose(ch @ ch.T, spd, atol=1e-3)
+    b = A(3)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).solve(b).data), np.linalg.solve(a, b),
+        atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).matrix_power(3).data), a @ a @ a, rtol=1e-3)
+
+
+def test_baddbmm_matches_torch():
+    torch = pytest.importorskip("torch")
+    m = A(2, 3, 5)
+    b1, b2 = A(2, 3, 4), A(2, 4, 5)
+    got = np.asarray(Tensor(m).baddbmm(b1, b2, beta=0.5, alpha=2.0).data)
+    want = torch.baddbmm(torch.tensor(m), torch.tensor(b1),
+                         torch.tensor(b2), beta=0.5, alpha=2.0).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_index_ops():
+    a = A(4, 3)
+    idx = np.array([0, 2])
+    out = np.asarray(Tensor(a).index_fill(0, idx, 9.0).data)
+    assert np.all(out[[0, 2]] == 9.0) and np.all(out[1] == a[1])
+    src = A(2, 3)
+    out = np.asarray(Tensor(a).index_copy(0, idx, src).data)
+    np.testing.assert_allclose(out[[0, 2]], src)
+    out = np.asarray(Tensor(a).index_add(0, idx, src).data)
+    np.testing.assert_allclose(out[[0, 2]], a[[0, 2]] + src, rtol=1e-6)
+    out = np.asarray(Tensor(a).scatter_add(
+        1, np.zeros((4, 1), np.int32), np.ones((4, 1), np.float32)).data)
+    np.testing.assert_allclose(out[:, 0], a[:, 0] + 1.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).take(np.array([0, 5, 11])).data),
+        a.ravel()[[0, 5, 11]])
+
+
+def test_random_ops_with_keys():
+    t = Tensor.zeros(1000)
+    k = jax.random.PRNGKey(0)
+    b = np.asarray(t.bernoulli(0.3, key=k).data)
+    assert 0.2 < b.mean() < 0.4
+    u = np.asarray(t.uniform(2.0, 3.0, key=k).data)
+    assert u.min() >= 2.0 and u.max() <= 3.0
+    n = np.asarray(t.normal(1.0, 0.1, key=k).data)
+    assert abs(n.mean() - 1.0) < 0.05
+    w = Tensor(np.asarray([0.0, 0.0, 1.0], np.float32))
+    m = np.asarray(w.multinomial(50, key=k).data)
+    assert np.all(m == 2)
+    wb = Tensor(np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32))
+    mb = np.asarray(wb.multinomial(20, key=k).data)
+    assert mb.shape == (2, 20)
+    assert np.all(mb[0] == 0) and np.all(mb[1] == 1)
+
+
+def test_reductions_and_predicates():
+    a = np.array([[1.0, np.nan], [2.0, 3.0]], np.float32)
+    assert float(Tensor(a).nansum().data) == 6.0
+    np.testing.assert_allclose(float(Tensor(a).nanmean().data), 2.0)
+    assert bool(Tensor(a).isnan().any().data)
+    assert not bool(Tensor(np.ones(3)).isinf().any().data)
+    assert Tensor(np.ones(3)).equal(np.ones(3))
+    assert not Tensor(np.ones(3)).equal(np.ones(4))
+    assert int(Tensor(np.array([0, 1, 2])).count_nonzero().data) == 2
+    np.testing.assert_allclose(
+        float(Tensor(np.array([0., 3.])).dist(np.array([4., 0.])).data),
+        5.0)
+
+
+def test_constructors():
+    np.testing.assert_allclose(np.asarray(Tensor.linspace(0, 1, 5).data),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor.logspace(0, 2, 3).data),
+                               [1.0, 10.0, 100.0], rtol=1e-5)
+
+
+def test_median_cumprod_argsort():
+    a = A(3, 5)
+    np.testing.assert_allclose(np.asarray(Tensor(a).median(1).data),
+                               np.median(a, 1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor(a).cumprod(1).data),
+                               np.cumprod(a, 1), rtol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(Tensor(a).argsort(1, descending=True).data),
+        np.argsort(-a, 1))
