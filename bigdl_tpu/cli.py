@@ -1,0 +1,109 @@
+"""``bigdl-tpu`` console launcher — the ``bigdl-submit`` / ``spark-submit``
+analog (SURVEY.md §2 CLI/launch row).
+
+The reference wraps ``spark-submit`` to place one executor per node with the
+right env.  TPU-natively there is no cluster manager to talk to: a job is N
+identical processes (one per TPU-VM host) that rendezvous through
+``jax.distributed.initialize``.  This launcher covers the two shapes:
+
+- ``bigdl-tpu run script.py``                      one process, all local chips
+- ``bigdl-tpu run -n 4 script.py``                 N LOCAL processes (one per
+  simulated host) with the coordinator/rank env injected — the
+  ``local-cluster`` mode used by the multi-process tests
+- ``bigdl-tpu run --coordinator host:8476 --num-processes 16
+  --process-id 3 script.py``                       one member of a real
+  multihost job (run once per host, e.g. from ``gcloud compute tpus ssh
+  --worker=all``)
+
+plus ``bigdl-tpu bench | dryrun`` for the repo harnesses.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _run(args) -> int:
+    env_base = dict(os.environ)
+    if args.coordinator and args.process_id is not None:
+        # one member of an externally-orchestrated multihost job
+        env_base.update(BIGDL_TPU_COORDINATOR=args.coordinator,
+                        BIGDL_TPU_NUM_PROCESSES=str(args.num_processes),
+                        BIGDL_TPU_PROCESS_ID=str(args.process_id))
+        os.environ.update(env_base)
+        sys.argv = [args.script] + args.script_args
+        with open(args.script) as f:
+            code = compile(f.read(), args.script, "exec")
+        exec(code, {"__name__": "__main__", "__file__": args.script})
+        return 0
+
+    if args.num_processes <= 1:
+        return subprocess.call([sys.executable, args.script]
+                               + args.script_args, env=env_base)
+
+    # local N-process gang (the local-cluster analog): pick a free port,
+    # spawn N children with rank env, fail fast if any member fails
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    if args.cpu:
+        env_base["JAX_PLATFORMS"] = "cpu"
+        env_base.pop("XLA_FLAGS", None)
+    procs = []
+    for r in range(args.num_processes):
+        env = dict(env_base,
+                   BIGDL_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                   BIGDL_TPU_NUM_PROCESSES=str(args.num_processes),
+                   BIGDL_TPU_PROCESS_ID=str(r))
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    if rc:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bigdl-tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="launch a training script")
+    run.add_argument("-n", "--num-processes", type=int, default=1,
+                     help="local process count (local-cluster mode)")
+    run.add_argument("--coordinator", default=None,
+                     help="host:port of process 0 (real multihost mode)")
+    run.add_argument("--process-id", type=int, default=None,
+                     help="this host's rank (real multihost mode)")
+    run.add_argument("--cpu", action="store_true",
+                     help="force the CPU platform in children")
+    run.add_argument("script")
+    run.add_argument("script_args", nargs=argparse.REMAINDER)
+
+    sub.add_parser("bench", help="run the repo benchmark (bench.py)")
+    sub.add_parser("dryrun", help="8-virtual-device multichip dry run")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "run":
+        return _run(args)
+    repo = os.getcwd()
+    if args.cmd == "bench":
+        return subprocess.call([sys.executable,
+                                os.path.join(repo, "bench.py")])
+    if args.cmd == "dryrun":
+        return subprocess.call([
+            sys.executable, "-c",
+            "import __graft_entry__ as g; g.dryrun_multichip(8)"], cwd=repo)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

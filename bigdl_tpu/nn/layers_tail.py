@@ -328,6 +328,8 @@ class BinaryTreeLSTM(Module):
         }, EMPTY
 
     def forward(self, params, state, x, children, training=False, rng=None):
+        x = jnp.asarray(x)
+        children = jnp.asarray(children)  # indexable by scan tracers
         b, n, _ = x.shape
         hdim = self.hidden_size
 

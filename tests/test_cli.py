@@ -1,0 +1,56 @@
+"""bigdl-tpu launcher specs (the bigdl-submit analog, SURVEY §2 CLI row)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+
+def _repo_env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [repo, env.get("PYTHONPATH")] if p)
+    return env
+
+
+def test_cli_run_single_process(tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        print("ARGS", sys.argv[1:])
+    """))
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "run", str(script),
+         "--alpha", "2"],
+        env=_repo_env(), capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "ARGS ['--alpha', '2']" in out.stdout
+
+
+def test_cli_run_local_gang_rendezvous(tmp_path):
+    """-n 2 spawns a local gang whose members rendezvous through
+    jax.distributed — the local-cluster launch mode."""
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from bigdl_tpu.runtime.engine import init_engine
+        init_engine()
+        print(f"RANK{jax.process_index()}/{jax.process_count()}")
+    """))
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "run", "-n", "2", "--cpu",
+         str(script)],
+        env=_repo_env(), capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "RANK0/2" in out.stdout and "RANK1/2" in out.stdout
+
+
+def test_cli_propagates_child_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("raise SystemExit(3)")
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "run", str(script)],
+        env=_repo_env(), capture_output=True, text=True, timeout=120)
+    assert out.returncode == 3

@@ -62,6 +62,15 @@ CATALOG = [
      [(3, 6)]),
     ("MapTable", lambda: nn.MapTable(nn.Linear(6, 2)), [(3, 6), (3, 6)]),
     ("Bottle", lambda: nn.Bottle(nn.Linear(6, 2)), [(2, 3, 6)]),
+    # layers_tail tranche (round 2)
+    ("GroupNorm", lambda: nn.GroupNorm(2, 6), [(3, 6)]),
+    ("InstanceNorm2D", lambda: nn.InstanceNorm2D(3), [(2, 5, 5, 3)]),
+    ("SpatialConvolutionMap",
+     lambda: nn.SpatialConvolutionMap([[0, 0], [1, 1]], 3, 2, 2, padding=1),
+     [(1, 5, 5, 2)]),
+    ("BinaryTreeLSTM", lambda: nn.BinaryTreeLSTM(4, 6),
+     lambda: [RS.rand(2, 3, 4).astype(np.float32),
+              np.array([[[-1, -1], [-1, -1], [0, 1]]] * 2, np.int32)]),
 ]
 
 
@@ -75,7 +84,7 @@ def _sample(shape):
                          CATALOG, ids=[c[0] for c in CATALOG])
 def test_roundtrip(tmp_path, name, factory, shapes):
     layer = factory()
-    xs = [_sample(s) for s in shapes]
+    xs = shapes() if callable(shapes) else [_sample(s) for s in shapes]
     v = layer.init(RNG, *xs)
     y0, _ = layer.apply(v, *xs, training=False)
 
