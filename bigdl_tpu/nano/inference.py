@@ -98,12 +98,20 @@ class InferenceOptimizer:
                  methods: Tuple[str, ...] = ("fp32", "bf16", "int8"),
                  repeats: int = 10,
                  accuracy_fn: Optional[Callable] = None,
-                 accuracy_budget: float = 0.02) -> "OptimizedResult":
+                 accuracy_budget: float = 0.02,
+                 calib_data=None) -> "OptimizedResult":
         """Benchmark every variant on ``sample`` and rank by latency —
         reference ``InferenceOptimizer.optimize`` + ``get_best_model``.
 
         accuracy_fn(outputs) -> float score (higher better); variants whose
-        score drops more than ``accuracy_budget`` below fp32 are rejected."""
+        score drops more than ``accuracy_budget`` below fp32 are rejected.
+        With ``calib_data``, the method list may include
+        ``"int8_calibrated"`` (static activation scales)."""
+        if "int8_calibrated" in methods and calib_data is None:
+            # validate before the loop: the per-variant except would
+            # otherwise swallow this usage error into a 'failed' row
+            raise ValueError("methods includes 'int8_calibrated' but no "
+                             "calib_data was given")
         sample = np.asarray(sample)
         results: Dict[str, Dict[str, Any]] = {}
         baseline_score = None
@@ -112,6 +120,10 @@ class InferenceOptimizer:
                 if name in ("fp32", "bf16"):
                     tm = InferenceOptimizer.trace(model, variables, sample,
                                                   name)
+                elif name == "int8_calibrated":
+                    tm = InferenceOptimizer.quantize(
+                        model, variables, sample, "int8",
+                        calib_data=calib_data)
                 else:
                     tm = InferenceOptimizer.quantize(model, variables, sample,
                                                      name)
@@ -151,10 +163,11 @@ class OptimizedResult:
         return ok[name]["model"], name
 
     def summary(self) -> str:
-        lines = [f"{'method':8} {'latency(ms)':>12} {'score':>8} status"]
+        w = max([6] + [len(k) for k in self.results])
+        lines = [f"{'method':{w}} {'latency(ms)':>12} {'score':>8} status"]
         for k, v in self.results.items():
             lat = ("inf" if v["latency_s"] == float("inf")
                    else f"{v['latency_s'] * 1e3:.3f}")
             sc = "-" if v["score"] is None else f"{v['score']:.4f}"
-            lines.append(f"{k:8} {lat:>12} {sc:>8} {v['status']}")
+            lines.append(f"{k:{w}} {lat:>12} {sc:>8} {v['status']}")
         return "\n".join(lines)

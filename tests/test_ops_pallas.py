@@ -314,3 +314,25 @@ class TestActivationCalibration:
         # the ORIGINAL model is untouched
         assert not any(isinstance(n.layer, QuantizedLinear)
                        for n in model.order)
+
+    def test_nano_optimize_with_calibrated_variant(self):
+        """Accuracy-vs-speed harness: optimize() ranks fp32 / int8 /
+        int8_calibrated under an accuracy budget."""
+        from bigdl_tpu.nano.inference import InferenceOptimizer
+
+        model, variables, x, y = self._trained_mlp()
+
+        def acc(outputs):
+            return float((outputs.argmax(1) == y[:64]).mean())
+
+        res = InferenceOptimizer.optimize(
+            model, variables, x[:64],
+            methods=("fp32", "int8", "int8_calibrated"),
+            repeats=3, accuracy_fn=acc, accuracy_budget=0.02,
+            calib_data=[x[64:192]])
+        assert res.results["fp32"]["status"] == "ok"
+        assert res.results["int8_calibrated"]["status"] in (
+            "ok", "accuracy_drop")
+        best, name = res.get_best_model()
+        assert name in res.results and best is not None
+        assert "int8_calibrated" in res.summary()
