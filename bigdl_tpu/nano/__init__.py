@@ -9,11 +9,14 @@ and ``.optimize()`` benchmarks every variant and picks the winner;
 TPU-native redesign: the "runtimes" are XLA execution modes of the SAME
 model — fp32 jit, bf16-compute jit, int8 Pallas-kernel quantization
 (``bigdl_tpu.nn.quantized``) — so ``trace``/``quantize``/``optimize``
-keep the reference surface without foreign-runtime exports.  (Training
-acceleration is native to the core stack: the Optimizer already jits,
-shards, and runs bf16 — a separate Trainer wrapper would be vestigial.)
+keep the reference surface without foreign-runtime exports.  Training
+acceleration is native to the core stack (the Optimizer already jits,
+shards, and runs bf16); ``nano.Trainer`` is the Lightning-SHAPED front
+over it so reference nano user code ports verbatim — precision="bf16"
+toggles the compute policy, the mesh replaces num_processes.
 """
 
 from bigdl_tpu.nano.inference import InferenceOptimizer, TracedModel
+from bigdl_tpu.nano.trainer import Trainer
 
-__all__ = ["InferenceOptimizer", "TracedModel"]
+__all__ = ["InferenceOptimizer", "TracedModel", "Trainer"]

@@ -1,0 +1,93 @@
+"""Nano Trainer — reference ``nano.pytorch.Trainer`` (a patched Lightning
+trainer: single-node acceleration, multi-process DDP, bf16).
+
+TPU-native re-design: the "acceleration" knobs map onto what actually
+matters on this hardware — the jitted sharded train step already IS the
+fast path, bf16 is the compute-policy toggle, and "num_processes" is the
+mesh (one process per host; in-process devices come for free).  The class
+is a thin Lightning-shaped front over ``optim.Optimizer`` so nano-style
+user code ports verbatim:
+
+    trainer = Trainer(max_epochs=5, precision="bf16")
+    trainer.fit(model, criterion, optimizer, train_data=(x, y),
+                val_data=(vx, vy))
+    trainer.validate(...); trainer.predict(...)
+"""
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.optim.optimizer import Optimizer, TrainedModel
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import Loss, ValidationMethod
+
+
+class Trainer:
+    """Lightning-shaped fit/validate/predict over the sharded step."""
+
+    def __init__(self, max_epochs: int = 10, batch_size: int = 32,
+                 precision: str = "fp32",
+                 checkpoint_path: Optional[str] = None,
+                 log_every: int = 50):
+        if precision not in ("fp32", "bf16"):
+            raise ValueError("precision: fp32 | bf16")
+        self.max_epochs = max_epochs
+        self.batch_size = batch_size
+        self.precision = precision
+        self.checkpoint_path = checkpoint_path
+        self.log_every = log_every
+        self._trained: Optional[TrainedModel] = None
+
+    def _dataset(self, data):
+        from bigdl_tpu.data.dataset import ArrayDataSet, DataSet
+
+        if isinstance(data, DataSet):
+            return data
+        x, y = data
+        return ArrayDataSet(np.asarray(x), np.asarray(y))
+
+    def fit(self, model, criterion, optim_method, train_data,
+            val_data=None,
+            val_methods: Sequence[ValidationMethod] = ()) -> TrainedModel:
+        from bigdl_tpu.tensor.policy import compute_dtype
+
+        opt = Optimizer(model, self._dataset(train_data), criterion,
+                        batch_size=self.batch_size)
+        opt.set_optim_method(optim_method)
+        opt.set_end_when(Trigger.max_epoch(self.max_epochs))
+        opt.log_every = self.log_every
+        if val_data is not None:
+            methods = list(val_methods) or [Loss(criterion)]
+            opt.set_validation(Trigger.every_epoch(),
+                               self._dataset(val_data), methods)
+        if self.checkpoint_path:
+            opt.set_checkpoint(self.checkpoint_path, Trigger.every_epoch())
+        if self.precision == "bf16":
+            import jax.numpy as jnp
+
+            with compute_dtype(jnp.bfloat16):
+                self._trained = opt.optimize()
+        else:
+            self._trained = opt.optimize()
+        return self._trained
+
+    def validate(self, data, methods: Sequence[ValidationMethod]
+                 ) -> Dict[str, float]:
+        self._require_fit()
+        res = self._trained.evaluate(self._dataset(data), list(methods),
+                                     self.batch_size)
+        return {r.name: r.result for r in res}
+
+    def predict(self, x, batch_size: int = 0):
+        self._require_fit()
+        return self._trained.predict(np.asarray(x), batch_size)
+
+    @property
+    def model(self) -> TrainedModel:
+        self._require_fit()
+        return self._trained
+
+    def _require_fit(self):
+        if self._trained is None:
+            raise RuntimeError("call fit() first")

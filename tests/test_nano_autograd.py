@@ -110,3 +110,38 @@ class TestAutograd:
         pred = model.predict(x)
         mse = float(np.mean((np.asarray(pred) - y) ** 2))
         assert mse < 0.1, mse
+
+
+def test_nano_trainer_fit_validate_predict(tmp_path):
+    """Reference nano.pytorch.Trainer surface: Lightning-shaped
+    fit/validate/predict with bf16 precision toggle."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.nano import Trainer
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.optim.validation import Top1Accuracy
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(256, 8).astype(np.float32)
+    y = (x.sum(1) > 4).astype(np.int32)
+    model = nn.Sequential([nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2)])
+
+    trainer = Trainer(max_epochs=40, batch_size=64, log_every=10000,
+                      checkpoint_path=str(tmp_path / "ck"))
+    trainer.fit(model, nn.CrossEntropyCriterion(), Adam(learning_rate=5e-3),
+                train_data=(x, y), val_data=(x[:64], y[:64]),
+                val_methods=[Top1Accuracy()])
+    res = trainer.validate((x, y), [Top1Accuracy()])
+    assert res["Top1Accuracy"] > 0.8
+    pred = trainer.predict(x[:10])
+    assert np.asarray(pred).shape == (10, 2)
+
+    # bf16 precision path trains too
+    t2 = Trainer(max_epochs=3, batch_size=64, precision="bf16",
+                 log_every=10000)
+    t2.fit(nn.Sequential([nn.Linear(8, 2)]), nn.CrossEntropyCriterion(),
+           Adam(learning_rate=1e-2), train_data=(x, y))
+    assert np.asarray(t2.predict(x[:4])).shape == (4, 2)
+
+    import pytest
+    with pytest.raises(RuntimeError):
+        Trainer().predict(x)

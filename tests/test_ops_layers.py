@@ -225,3 +225,81 @@ def test_ops_graph_jits():
     y = np.asarray(f(jnp.abs(jnp.asarray(A)), jnp.abs(jnp.asarray(B))))
     expect = np.log1p((np.abs(A) - np.abs(B)) ** 2).prod(axis=1)
     np.testing.assert_allclose(y, expect, rtol=1e-5, atol=1e-6)
+
+
+class TestIndexedSegmentOps:
+    def test_gather_nd(self):
+        data = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([[0, 1], [2, 3]], np.int32)
+        y, _ = nn.GatherNd().forward({}, {}, (jnp.asarray(data),
+                                              jnp.asarray(idx)))
+        np.testing.assert_array_equal(np.asarray(y), [1.0, 11.0])
+
+    def test_scatter_nd_accumulates(self):
+        idx = np.array([[0], [2], [0]], np.int32)
+        upd = np.array([1.0, 2.0, 3.0], np.float32)
+        y, _ = nn.ScatterNd((4,)).forward({}, {}, (jnp.asarray(idx),
+                                                   jnp.asarray(upd)))
+        np.testing.assert_array_equal(np.asarray(y), [4.0, 0.0, 2.0, 0.0])
+
+    def test_segment_reducers(self):
+        data = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+        seg = np.array([0, 0, 1, 1], np.int32)
+        s, _ = nn.SegmentSum(2).forward({}, {}, (jnp.asarray(data),
+                                                 jnp.asarray(seg)))
+        np.testing.assert_array_equal(np.asarray(s), [[3.0], [7.0]])
+        m, _ = nn.SegmentMean(2).forward({}, {}, (jnp.asarray(data),
+                                                  jnp.asarray(seg)))
+        np.testing.assert_array_equal(np.asarray(m), [[1.5], [3.5]])
+        mx, _ = nn.SegmentMax(2).forward({}, {}, (jnp.asarray(data),
+                                                  jnp.asarray(seg)))
+        np.testing.assert_array_equal(np.asarray(mx), [[2.0], [4.0]])
+        # unsorted ids work (the UnsortedSegmentSum role)
+        seg2 = np.array([1, 0, 1, 0], np.int32)
+        s2, _ = nn.UnsortedSegmentSum(2).forward(
+            {}, {}, (jnp.asarray(data), jnp.asarray(seg2)))
+        np.testing.assert_array_equal(np.asarray(s2), [[6.0], [4.0]])
+
+    def test_strided_slice(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        y, _ = nn.StridedSlice([(1, 4, 2), (0, 6, 3)]).forward(
+            {}, {}, jnp.asarray(x))
+        np.testing.assert_array_equal(np.asarray(y), x[1:4:2, 0:6:3])
+
+    def test_reverse_sequence(self):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+        lengths = np.array([3, 2], np.int32)
+        y, _ = nn.ReverseSequence().forward({}, {}, (jnp.asarray(x),
+                                                     jnp.asarray(lengths)))
+        got = np.asarray(y)[..., 0]
+        np.testing.assert_array_equal(got[0], [2, 1, 0, 3])
+        np.testing.assert_array_equal(got[1], [5, 4, 6, 7])
+
+
+class TestSpatialBlockOps:
+    def test_space_to_batch_round_trip(self):
+        x = np.random.RandomState(0).rand(2, 4, 4, 3).astype(np.float32)
+        y, _ = nn.SpaceToBatchND(2).forward({}, {}, jnp.asarray(x))
+        assert y.shape == (8, 2, 2, 3)
+        z, _ = nn.BatchToSpaceND(2).forward({}, {}, y)
+        np.testing.assert_allclose(np.asarray(z), x)
+
+    def test_dilation2d_zero_filter_is_maxpool(self):
+        x = np.random.RandomState(1).rand(1, 6, 6, 2).astype(np.float32)
+        layer = nn.Dilation2D(kernel_size=3, stride=1, padding="VALID")
+        v = layer.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        y, _ = layer.forward(v["params"], v["state"], jnp.asarray(x))
+        # zero filter -> plain max over 3x3 windows
+        want = np.stack([
+            [[x[0, i:i+3, j:j+3, c].max() for c in range(2)]
+             for j in range(4)] for i in range(4)])[None]
+        np.testing.assert_allclose(np.asarray(y), want, rtol=1e-6)
+
+    def test_resize_nearest(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)
+        y, _ = nn.ResizeNearestNeighbor(2).forward({}, {}, jnp.asarray(x))
+        assert y.shape == (1, 4, 4, 1)
+        np.testing.assert_array_equal(np.asarray(y)[0, :2, :2, 0],
+                                      [[0, 0], [0, 0]])
+        np.testing.assert_array_equal(np.asarray(y)[0, 2:, 2:, 0],
+                                      [[3, 3], [3, 3]])
