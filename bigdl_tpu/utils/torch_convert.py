@@ -272,9 +272,11 @@ def _meta_shape(node):
 def from_torch_module(tmodule, example_input=None):
     """torch.nn.Module → (keras-engine Model, variables) with weights.
 
-    ``example_input``: numpy array in TORCH layout (e.g. NCHW) used for
-    shape propagation — required when the graph flattens conv maps into
-    Linear layers (the weight-permutation fixup needs shapes)."""
+    ``example_input``: numpy array (or tuple of arrays for multi-input
+    modules) in TORCH layout (e.g. NCHW), used for shape propagation —
+    required when the graph flattens conv maps into Linear layers (the
+    weight-permutation fixup needs shapes) or concatenates on mapped
+    axes."""
     import torch
 
     tmodule = tmodule.eval()
@@ -282,7 +284,10 @@ def from_torch_module(tmodule, example_input=None):
     if example_input is not None:
         from torch.fx.passes.shape_prop import ShapeProp
 
-        ShapeProp(gm).propagate(torch.tensor(np.asarray(example_input)))
+        ex = (example_input if isinstance(example_input, (tuple, list))
+              else (example_input,))
+        ShapeProp(gm).propagate(
+            *(torch.tensor(np.asarray(e)) for e in ex))
 
     from bigdl_tpu.keras.engine import Input, Model
 
@@ -424,19 +429,40 @@ def from_torch_module(tmodule, example_input=None):
 
         elif node.op == "call_function":
             fn = node.target
-            if fn in (operator.add, torch.add):
+            if fn in (operator.add, torch.add, operator.sub, torch.sub,
+                      operator.mul, torch.mul, operator.truediv,
+                      torch.div):
                 a, b = node.args[0], node.args[1]
-                if not (isinstance(a, torch.fx.Node)
-                        and isinstance(b, torch.fx.Node)):
-                    raise NotImplementedError("add with a non-tensor operand")
-                from bigdl_tpu.keras.layers import Merge
+                a_t = isinstance(a, torch.fx.Node)
+                b_t = isinstance(b, torch.fx.Node)
+                sub = fn in (operator.sub, torch.sub)
+                div = fn in (operator.truediv, torch.div)
+                mul = fn in (operator.mul, torch.mul)
+                if a_t and b_t:
+                    from bigdl_tpu.keras.layers import Merge
 
-                emit(node, Merge("sum"), [sym[a], sym[b]])
-            elif fn in (operator.mul, torch.mul):
-                from bigdl_tpu.keras.layers import Merge
-
-                emit(node, Merge("mul"),
-                     [sym[node.args[0]], sym[node.args[1]]])
+                    if sub:
+                        emit(node, N.CSubTable(), [sym[a], sym[b]])
+                    elif div:
+                        emit(node, N.CDivTable(), [sym[a], sym[b]])
+                    else:
+                        emit(node, Merge("mul" if mul else "sum"),
+                             [sym[a], sym[b]])
+                elif a_t and isinstance(b, (int, float)):
+                    # scalar arithmetic (x/255.0-style normalization)
+                    if mul:
+                        lay = N.MulConstant(float(b))
+                    elif div:
+                        lay = N.MulConstant(1.0 / float(b))
+                    else:
+                        lay = N.AddConstant(float(-b if sub else b))
+                    if a in pre_flatten:   # elementwise: marker flows on
+                        pre_flatten[node] = pre_flatten[a]
+                    emit(node, lay, [sym[a]])
+                else:
+                    raise NotImplementedError(
+                        f"{fn} with operands ({type(a).__name__}, "
+                        f"{type(b).__name__}) at node {node.name}")
             elif fn is torch.cat:
                 tensors = node.args[0]
                 dim = node.args[1] if len(node.args) > 1 else \

@@ -221,3 +221,51 @@ def test_unsupported_configs_raise_cleanly():
         {"params": lin2.parameters(), "lr": 1e-3}])
     with pytest.raises(NotImplementedError):
         convert_torch_optimizer(topt)
+
+
+def test_scalar_arithmetic_and_sub_div():
+    """Inline normalization (x/255 - 0.5) and tensor-tensor sub/div."""
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = x / 2.0 - 0.5      # scalar div + scalar sub
+            z = self.fc(y)
+            w = z - y              # tensor sub
+            return w * 3.0 + (z / (y + 2.0))   # scalar mul, tensor div
+
+    tm = Net().eval()
+    x = RS.rand(3, 4).astype(np.float32) + 0.5
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x)
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
+
+
+def test_multi_input_torch_module():
+    """Two placeholders become a two-input converted model; the estimator
+    predict path takes the tuple pack."""
+
+    class TwoTower(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = torch.nn.Linear(4, 8)
+            self.b = torch.nn.Linear(6, 8)
+            self.head = torch.nn.Linear(16, 2)
+
+        def forward(self, u, v):
+            return self.head(torch.cat([torch.relu(self.a(u)),
+                                        torch.relu(self.b(v))], dim=1))
+
+    tm = TwoTower().eval()
+    u = RS.rand(3, 4).astype(np.float32)
+    v = RS.rand(3, 6).astype(np.float32)
+    model, variables = from_torch_module(tm, example_input=(u[:1], v[:1]))
+    y, _ = model.apply(variables, u, v)
+    with torch.no_grad():
+        ty = tm(torch.tensor(u), torch.tensor(v))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
