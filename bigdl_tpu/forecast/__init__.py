@@ -18,10 +18,12 @@ from bigdl_tpu.forecast.forecaster import (
 from bigdl_tpu.forecast.detector import (
     AEDetector, DBScanDetector, ThresholdDetector,
 )
+from bigdl_tpu.forecast.classic import ARIMAForecaster, ProphetForecaster
 
 __all__ = [
     "TSDataset", "XShardsTSDataset", "AutoTSEstimator", "TSPipeline",
     "TCNForecaster", "LSTMForecaster", "Seq2SeqForecaster",
     "NBeatsForecaster", "AutoformerForecaster",
+    "ARIMAForecaster", "ProphetForecaster",
     "ThresholdDetector", "AEDetector", "DBScanDetector",
 ]

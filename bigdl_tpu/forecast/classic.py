@@ -1,0 +1,135 @@
+"""Classical forecasters — reference Chronos ``ARIMAForecaster`` /
+``ProphetForecaster`` wrappers.
+
+The reference wraps pmdarima/prophet (host-CPU classical models; they never
+touch the accelerator there either).  pmdarima/prophet are not installed in
+this image, so ARIMA is implemented directly (Hannan-Rissanen two-stage
+least squares — the standard CSS-free estimator for ARMA coefficients) and
+Prophet stays a gated import with the reference surface."""
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+class ARIMAForecaster:
+    """ARIMA(p, d, q) on a univariate series.
+
+    fit(series) → predict(horizon) — reference
+    ``chronos/forecaster/arima_forecaster.py`` surface.  Estimation:
+    difference ``d`` times, long-AR pre-fit for residuals, then OLS of
+    y_t on p AR lags + q MA (residual) lags + intercept."""
+
+    def __init__(self, p: int = 2, d: int = 0, q: int = 0):
+        if p < 1:
+            raise ValueError("p >= 1")
+        self.p, self.d, self.q = p, d, q
+        self._coef = None
+
+    @staticmethod
+    def _lag_matrix(y: np.ndarray, lags: int) -> np.ndarray:
+        return np.stack([y[lags - k - 1:len(y) - k - 1]
+                         for k in range(lags)], axis=1)
+
+    def fit(self, series) -> "ARIMAForecaster":
+        x = np.asarray(series, np.float64).ravel()
+        if len(x) < self.p + self.q + self.d + 10:
+            raise ValueError(
+                f"series too short ({len(x)}) for ARIMA"
+                f"({self.p},{self.d},{self.q})")
+        self._tail = x[-(self.d + self.p + 1):].copy()
+        y = x.copy()
+        for _ in range(self.d):
+            y = np.diff(y)
+
+        p, q = self.p, self.q
+        if q > 0:
+            # stage 1: long AR to estimate the innovation sequence
+            long_p = min(max(2 * (p + q), 8), len(y) // 2)
+            A = self._lag_matrix(y, long_p)
+            b = y[long_p:]
+            phi_long, *_ = np.linalg.lstsq(
+                np.hstack([A, np.ones((len(A), 1))]), b, rcond=None)
+            resid = np.concatenate([
+                np.zeros(long_p), b - np.hstack(
+                    [A, np.ones((len(A), 1))]) @ phi_long])
+        else:
+            resid = np.zeros_like(y)
+
+        # stage 2: y_t on p AR lags (+ q residual lags) + intercept
+        m = max(p, q)
+        rows = []
+        targets = []
+        for t in range(m, len(y)):
+            row = [y[t - 1 - k] for k in range(p)]
+            row += [resid[t - 1 - k] for k in range(q)]
+            rows.append(row + [1.0])
+            targets.append(y[t])
+        X = np.asarray(rows)
+        coef, *_ = np.linalg.lstsq(X, np.asarray(targets), rcond=None)
+        self._coef = coef
+        # state for forecasting: last p diffs + last q residuals
+        self._y_hist = list(y[-p:][::-1])          # most recent first
+        fitted = X @ coef
+        res = np.asarray(targets) - fitted
+        self._e_hist = list(res[-q:][::-1]) if q else []
+        return self
+
+    def predict(self, horizon: int) -> np.ndarray:
+        if self._coef is None:
+            raise RuntimeError("call fit() first")
+        p, q, d = self.p, self.q, self.d
+        yh = list(self._y_hist)
+        eh = list(self._e_hist)
+        out = []
+        for _ in range(horizon):
+            feats = yh[:p] + eh[:q] + [1.0]
+            nxt = float(np.dot(self._coef, feats))
+            out.append(nxt)
+            yh = [nxt] + yh[:p - 1] if p > 1 else [nxt]
+            if q:
+                eh = [0.0] + eh[:q - 1] if q > 1 else [0.0]
+        fc = np.asarray(out)
+        # invert the differencing from the stored tail
+        for k in range(d):
+            base = self._tail.copy()
+            for _ in range(d - 1 - k):
+                base = np.diff(base)
+            fc = np.cumsum(fc) + base[-1]
+        return fc
+
+    def evaluate(self, actual, metrics: Sequence[str] = ("mse",)
+                 ) -> Dict[str, float]:
+        a = np.asarray(actual, np.float64).ravel()
+        f = self.predict(len(a))
+        out = {}
+        for m in metrics:
+            if m.lower() == "mse":
+                out[m] = float(np.mean((a - f) ** 2))
+            elif m.lower() == "mae":
+                out[m] = float(np.mean(np.abs(a - f)))
+            elif m.lower() == "smape":
+                out[m] = float(100 * np.mean(
+                    2 * np.abs(a - f) / (np.abs(a) + np.abs(f) + 1e-12)))
+            else:
+                raise ValueError(f"metric {m!r}: mse | mae | smape")
+        return out
+
+
+class ProphetForecaster:
+    """Reference ``chronos/forecaster/prophet_forecaster.py`` — a thin
+    wrapper over facebook prophet, which is not installed in this image:
+    construction raises with the install hint (the reference gates its
+    optional deps the same way)."""
+
+    def __init__(self, *a, **kw):
+        try:
+            import prophet  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ProphetForecaster needs the optional 'prophet' package "
+                "(pip install prophet); ARIMAForecaster and the neural "
+                "forecasters have no extra dependency") from e
+        raise NotImplementedError(
+            "prophet backend wiring pending — package unavailable in the "
+            "build image so the wrapper is surface-only")

@@ -187,3 +187,41 @@ def test_xshards_tsdataset_short_shard_skipped():
     assert x.shape[0] == 60 - 12 - 3 + 1
     assert dist.num_partitions() == 2
     assert dist.to_xshards().num_partitions() == 1
+
+
+def test_arima_forecaster_recovers_ar_process():
+    """AR(2) data: ARIMA(2,0,0) must beat the naive last-value forecast
+    and roughly recover the coefficients' predictions."""
+    rs = np.random.RandomState(0)
+    n = 600
+    y = np.zeros(n)
+    for t in range(2, n):
+        y[t] = 0.6 * y[t - 1] - 0.3 * y[t - 2] + rs.randn() * 0.1
+    from bigdl_tpu.forecast import ARIMAForecaster
+
+    f = ARIMAForecaster(p=2, d=0, q=0).fit(y[:500])
+    res = f.evaluate(y[500:520], metrics=("mse", "mae"))
+    naive = float(np.mean((y[500:520] - y[499]) ** 2))
+    assert res["mse"] < naive
+
+
+def test_arima_with_differencing_tracks_trend():
+    rs = np.random.RandomState(1)
+    t = np.arange(400, dtype=np.float64)
+    y = 0.5 * t + 3.0 + np.cumsum(rs.randn(400) * 0.05)
+    from bigdl_tpu.forecast import ARIMAForecaster
+
+    f = ARIMAForecaster(p=2, d=1, q=1).fit(y[:380])
+    fc = f.predict(20)
+    # a d=1 model must keep following the linear trend
+    assert abs(fc[-1] - y[399]) < 5.0
+    assert np.all(np.diff(fc) > 0)
+
+
+def test_prophet_wrapper_gated():
+    import pytest
+
+    from bigdl_tpu.forecast import ProphetForecaster
+
+    with pytest.raises(ImportError, match="prophet"):
+        ProphetForecaster()
