@@ -3,7 +3,7 @@
 Reference analog (unverified — mount empty): inner classes of
 ``dllib/optim/SGD.scala`` — ``Default``, ``Step``, ``MultiStep``,
 ``Exponential``, ``Poly``, ``Plateau``, ``Warmup``, ``SequentialSchedule``,
-``EpochDecay``, ``NaturalExp``.  Functional here: ``schedule(step) -> lr
+``EpochStep``, ``EpochDecay``, ``EpochSchedule``, ``NaturalExp``.  Functional here: ``schedule(step) -> lr
 multiplier-resolved absolute lr``, traceable inside jit (pure jnp math on the
 step counter, no data-dependent python control flow).
 """
@@ -86,6 +86,55 @@ class Poly(LearningRateSchedule):
     def __call__(self, lr, step):
         frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
         return lr * (1.0 - frac) ** self.power
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor(epoch / step_size_epochs)) — reference
+    ``SGD.EpochStep``.  The reference reads the epoch from driver state;
+    under jit the epoch is derived as ``step // steps_per_epoch`` (pass
+    the dataset's batches-per-epoch)."""
+
+    def __init__(self, step_size_epochs: int, gamma: float,
+                 steps_per_epoch: int):
+        self.step_size = step_size_epochs
+        self.gamma = gamma
+        self.steps_per_epoch = steps_per_epoch
+
+    def __call__(self, lr, step):
+        epoch = jnp.floor(step / self.steps_per_epoch)
+        return lr * self.gamma ** jnp.floor(epoch / self.step_size)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^(decay_fn(epoch)) — reference ``SGD.EpochDecay`` (the
+    function-of-epoch decay).  ``decay_fn`` must be jnp-traceable (it runs
+    inside the jitted step on a traced epoch index)."""
+
+    def __init__(self, decay_fn, steps_per_epoch: int):
+        self.decay_fn = decay_fn
+        self.steps_per_epoch = steps_per_epoch
+
+    def __call__(self, lr, step):
+        epoch = jnp.floor(step / self.steps_per_epoch)
+        return lr * 0.1 ** self.decay_fn(epoch)
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise-constant lr by epoch regimes — reference
+    ``SGD.EpochSchedule(regimes)`` with ``Regime(startEpoch, endEpoch,
+    lr)``; epochs are 1-based and inclusive like the reference."""
+
+    def __init__(self, regimes: Sequence[Tuple[int, int, float]],
+                 steps_per_epoch: int):
+        self.regimes = tuple(regimes)
+        self.steps_per_epoch = steps_per_epoch
+
+    def __call__(self, lr, step):
+        epoch = jnp.floor(step / self.steps_per_epoch) + 1
+        out = lr
+        for start, end, value in self.regimes:
+            out = jnp.where((epoch >= start) & (epoch <= end), value, out)
+        return out
 
 
 class Warmup(LearningRateSchedule):

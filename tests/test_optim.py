@@ -238,3 +238,35 @@ def test_plateau_state_survives_checkpoint_resume(tmp_path):
     opt2.set_end_when(optim.Trigger.max_epoch(5))
     opt2.optimize()
     assert p2.current_factor <= p1.current_factor  # restored, not reset
+
+
+def test_epoch_based_schedules():
+    """Reference SGD.EpochStep / EpochDecay / EpochSchedule semantics with
+    epoch derived from step // steps_per_epoch."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import EpochDecay, EpochSchedule, EpochStep
+
+    spe = 10  # steps per epoch
+    es = EpochStep(2, 0.5, steps_per_epoch=spe)
+    assert float(es(1.0, 0)) == 1.0            # epoch 0
+    assert float(es(1.0, 19)) == 1.0           # epoch 1 (< step_size)
+    assert float(es(1.0, 20)) == 0.5           # epoch 2
+    assert float(es(1.0, 45)) == 0.25          # epoch 4
+
+    ed = EpochDecay(lambda e: jnp.floor(e / 3), steps_per_epoch=spe)
+    assert float(ed(1.0, 0)) == 1.0
+    np.testing.assert_allclose(float(ed(1.0, 30)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(ed(1.0, 60)), 0.01, rtol=1e-6)
+
+    sched = EpochSchedule([(1, 2, 0.1), (3, 5, 0.01), (6, 100, 0.001)],
+                          steps_per_epoch=spe)
+    assert float(sched(1.0, 0)) == pytest.approx(0.1)     # epoch 1
+    assert float(sched(1.0, 25)) == pytest.approx(0.01)   # epoch 3
+    assert float(sched(1.0, 99)) == pytest.approx(0.001)  # epoch 10
+
+    # schedules stay jittable (they run inside the compiled step)
+    import jax
+
+    f = jax.jit(lambda s: es(1.0, s))
+    assert float(f(jnp.asarray(20))) == 0.5
