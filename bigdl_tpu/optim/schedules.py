@@ -122,16 +122,21 @@ class EpochDecay(LearningRateSchedule):
 class EpochSchedule(LearningRateSchedule):
     """Piecewise-constant lr by epoch regimes — reference
     ``SGD.EpochSchedule(regimes)`` with ``Regime(startEpoch, endEpoch,
-    lr)``; epochs are 1-based and inclusive like the reference."""
+    lr)``; epochs are 1-based and inclusive like the reference.  Past the
+    last regime the LAST regime's rate persists (the reference mutates a
+    persistent config, so its final rate sticks too)."""
 
     def __init__(self, regimes: Sequence[Tuple[int, int, float]],
                  steps_per_epoch: int):
+        if not regimes:
+            raise ValueError("EpochSchedule needs at least one regime")
         self.regimes = tuple(regimes)
         self.steps_per_epoch = steps_per_epoch
 
     def __call__(self, lr, step):
         epoch = jnp.floor(step / self.steps_per_epoch) + 1
-        out = lr
+        out = jnp.where(epoch < self.regimes[0][0], lr,
+                        self.regimes[-1][2])
         for start, end, value in self.regimes:
             out = jnp.where((epoch >= start) & (epoch <= end), value, out)
         return out

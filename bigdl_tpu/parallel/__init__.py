@@ -27,8 +27,13 @@ from bigdl_tpu.parallel.pp import (
     unmicrobatch,
 )
 from bigdl_tpu.parallel.moe import MoE, moe_apply_ep, moe_apply_local
+from bigdl_tpu.parallel.gspmd import (GSPMDTrainStep, build_param_specs,
+                                      tp_spec_for_path)
 
 __all__ = [
+    "GSPMDTrainStep",
+    "build_param_specs",
+    "tp_spec_for_path",
     "ring_attention",
     "column_parallel",
     "row_parallel",

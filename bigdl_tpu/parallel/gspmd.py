@@ -1,0 +1,139 @@
+"""GSPMD training — mesh + sharding ANNOTATIONS, XLA inserts collectives.
+
+The manual path (``optim/train_step.py``) re-derives the reference's
+AllReduceParameter algorithm with explicit ``shard_map`` collectives.  This
+module is the other TPU-native idiom (the scaling-book recipe, and what the
+reference could never do): give every parameter a ``PartitionSpec`` over a
+(data, model) mesh, jit the plain train step with those shardings, and let
+the GSPMD partitioner place the psums/all-gathers — tensor parallelism
+"for free" (SURVEY.md §3.5 TP row).
+
+Default rules shard the transformer family Megatron-style:
+column-split the QKV and FFN-in projections over "model", row-split the
+output/FFN-out projections, replicate norms/biases-of-row-split; the batch
+is sharded over "data".  Optimizer state inherits each parameter's
+sharding, so Adam moments are model-parallel too.
+"""
+
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_MODEL
+
+
+# (path regex, spec builder) — first match wins; paths look like
+# "attn/wq", "ffn/w1", "ln1/weight"
+_DEFAULT_RULES: Tuple[Tuple[str, Callable[[], P]], ...] = (
+    (r"(^|/)(wq|wk|wv)$", lambda: P(None, AXIS_MODEL)),   # column split
+    (r"(^|/)(bq|bk|bv)$", lambda: P(AXIS_MODEL)),
+    (r"(^|/)wo$", lambda: P(AXIS_MODEL, None)),           # row split
+    (r"(^|/)(w1|ffn/l1/weight)$", lambda: P(None, AXIS_MODEL)),
+    (r"(^|/)(b1|ffn/l1/bias)$", lambda: P(AXIS_MODEL)),
+    (r"(^|/)(w2|ffn/l2/weight)$", lambda: P(AXIS_MODEL, None)),
+)
+
+
+def tp_spec_for_path(path: str, leaf) -> P:
+    """Megatron-style PartitionSpec for one parameter path; replicated
+    when no rule matches (norms, output biases, embeddings)."""
+    for pat, spec in _DEFAULT_RULES:
+        if re.search(pat, path):
+            s = spec()
+            # guard: the spec's rank must fit the leaf's rank (a 1-D param
+            # matching a matrix rule falls back to replicated)
+            if len(s) <= np.ndim(leaf):
+                return s
+    return P()
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", k)) for k in path)
+
+
+def build_param_specs(params, rule_fn=tp_spec_for_path):
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: rule_fn(_path_str(p), x), params)
+
+
+class GSPMDTrainStep:
+    """Auto-partitioned (data × model) train step.
+
+    ``model.forward`` is written with NO collectives — plain jnp math.
+    Sharding constraints on params and batch are the entire parallelism
+    story; XLA's SPMD partitioner emits the all-reduces that ``parallel/
+    tp.py`` writes by hand.  Loss/params match the single-device program
+    bit-for-bit up to reduction order (asserted in tests)."""
+
+    def __init__(self, model, criterion, optim_method, mesh: Mesh,
+                 variables: Dict[str, Any],
+                 rule_fn: Callable[[str, Any], P] = tp_spec_for_path):
+        self.model = model
+        self.criterion = criterion
+        self.optim = optim_method
+        self.mesh = mesh
+
+        params = variables["params"]
+        self.specs = build_param_specs(params, rule_fn)
+        to_sh = lambda spec: NamedSharding(mesh, spec)
+        self.param_sh = jax.tree_util.tree_map(
+            to_sh, self.specs, is_leaf=lambda x: isinstance(x, P))
+        # copy=True: device_put may alias its input as one replica shard,
+        # and the jitted step DONATES params — aliasing the caller's
+        # buffers would delete them out from under the caller
+        self.params = jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(jnp.array(x, copy=True), sh),
+            params, self.param_sh)
+        # optimizer state: built from the SHARDED params, so zeros_like
+        # moments inherit each parameter's sharding (model-parallel Adam
+        # state); scalar counters stay replicated
+        self.opt_state = self.optim.init_state(self.params)
+        self.batch_sh = NamedSharding(mesh, P(AXIS_DATA))
+
+        # locals only: the jitted closure must not retain self (and with it
+        # the host-side param copy) in the jit cache
+        model_, criterion_, optim_ = model, criterion, optim_method
+        param_sh = self.param_sh
+
+        def step_fn(params, opt_state, step, rng, x, y):
+            def loss_fn(p):
+                out, _ = model_.forward(p, {}, x, training=True, rng=rng)
+                return criterion_.forward(out, y)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = optim_.update(step, grads, params,
+                                                opt_state)
+            # pin the result layouts so they never drift between steps
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, param_sh)
+            return new_params, new_opt, loss
+
+        self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def train_step(self, step: int, rng, x, y):
+        x = jax.device_put(jnp.asarray(x), self.batch_sh)
+        y = jax.device_put(jnp.asarray(y), self.batch_sh)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, jnp.asarray(step, jnp.int32),
+            rng, x, y)
+        return loss
+
+    def get_params(self):
+        return jax.device_get(self.params)
+
+    def shard_report(self) -> Dict[str, Tuple]:
+        """path -> (global shape, spec) for every model-sharded param —
+        the profiling aid for layout audits."""
+        out = {}
+
+        def visit(path, leaf, spec):
+            if any(a is not None for a in spec):
+                out[_path_str(path)] = (tuple(leaf.shape), tuple(spec))
+
+        jax.tree_util.tree_map_with_path(
+            lambda p, l, s: visit(p, l, s), self.params, self.specs)
+        return out

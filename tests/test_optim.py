@@ -270,3 +270,11 @@ def test_epoch_based_schedules():
 
     f = jax.jit(lambda s: es(1.0, s))
     assert float(f(jnp.asarray(20))) == 0.5
+
+
+def test_epoch_schedule_last_regime_persists():
+    from bigdl_tpu.optim import EpochSchedule
+
+    sched = EpochSchedule([(1, 2, 0.1), (3, 5, 0.01)], steps_per_epoch=10)
+    # past the last regime the final rate sticks (no jump back to base lr)
+    assert float(sched(1.0, 70)) == pytest.approx(0.01)   # epoch 8

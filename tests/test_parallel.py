@@ -78,3 +78,109 @@ def test_tp_linear_pair_matches_dense():
     out = fn(x, w1, b1, w2, b2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+class TestGSPMD:
+    """GSPMD auto-partitioned training: annotations only, no hand-written
+    collectives; results match the single-device program."""
+
+    def _model_and_data(self):
+        from bigdl_tpu import nn
+        from bigdl_tpu.keras.engine import Input, Model
+
+        rs = np.random.RandomState(0)
+        d, heads, t, b = 8, 2, 6, 8
+        inp = Input((t, d))
+        h = nn.TransformerLayer(d, heads, 4 * d, dropout=0.0)(inp)
+        h = nn.Mean(dim=1)(h)
+        out = nn.Linear(d, 2)(h)
+        model = Model(inp, out)
+        x = rs.randn(b, t, d).astype(np.float32)
+        y = rs.randint(0, 2, b).astype(np.int32)
+        return model, x, y
+
+    def test_matches_single_device_training(self):
+        import jax
+        from bigdl_tpu import nn
+        from bigdl_tpu.optim.optim_method import SGD
+        from bigdl_tpu.parallel.gspmd import GSPMDTrainStep
+        from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+        model, x, y = self._model_and_data()
+        rng = jax.random.PRNGKey(0)
+        variables = model.init(rng, jnp.asarray(x[:1]))
+        crit = nn.CrossEntropyCriterion()
+
+        mesh = build_mesh(MeshSpec(data=2, model=4))
+        # SGD+momentum for the oracle comparison: updates are LINEAR in the
+        # gradients, so cross-shard reduction-order noise stays tiny (Adam's
+        # g/sqrt(v) early steps amplify 1-ulp differences to ~lr-sized ones)
+        step = GSPMDTrainStep(model, crit,
+                              SGD(learning_rate=1e-2, momentum=0.9), mesh,
+                              variables)
+        # QKV/FFN weights are actually model-sharded
+        report = step.shard_report()
+        assert any("wq" in k for k in report)
+        assert any("ffn/l1/weight" in k for k in report)
+        losses = [float(step.train_step(i, rng, x, y)) for i in range(5)]
+
+        # single-device oracle: same init, same updates
+        from jax.flatten_util import ravel_pytree
+
+        params = jax.tree_util.tree_map(jnp.asarray, variables["params"])
+        opt = SGD(learning_rate=1e-2, momentum=0.9)
+        state = opt.init_state(params)
+        ref_losses = []
+        for i in range(5):
+            def loss_fn(p):
+                out, _ = model.forward(p, {}, jnp.asarray(x),
+                                       training=True, rng=rng)
+                return crit.forward(out, jnp.asarray(y))
+            l, g = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.update(i, g, params, state)
+            ref_losses.append(float(l))
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+        fa, _ = ravel_pytree(step.get_params())
+        fb, _ = ravel_pytree(params)
+        np.testing.assert_allclose(np.asarray(fa), np.asarray(fb),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_sharding_actually_splits_buffers(self):
+        import jax
+        from bigdl_tpu import nn
+        from bigdl_tpu.optim.optim_method import Adam
+        from bigdl_tpu.parallel.gspmd import GSPMDTrainStep
+        from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+        model, x, y = self._model_and_data()
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+        mesh = build_mesh(MeshSpec(data=2, model=4))
+        step = GSPMDTrainStep(model, nn.CrossEntropyCriterion(),
+                              Adam(learning_rate=1e-2), mesh, variables)
+
+        def find(tree, name):
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                if name in "/".join(str(getattr(k, "key", k))
+                                    for k in path):
+                    return leaf
+            raise KeyError(name)
+
+        wq = find(step.params, "wq")
+        # column-split over model=4: each shard holds 1/4 of the columns
+        shard_shape = wq.addressable_shards[0].data.shape
+        assert shard_shape[1] == wq.shape[1] // 4
+        # Adam moment for wq is sharded identically (no replicated moments)
+        m = find(step.opt_state, "wq")
+        assert m.addressable_shards[0].data.shape == shard_shape
+
+
+def test_gspmd_rank_guard_falls_back_to_replicated():
+    import numpy as _np
+
+    from bigdl_tpu.parallel.gspmd import tp_spec_for_path
+    from jax.sharding import PartitionSpec as P
+
+    # a 1-D param matching a matrix rule must fall back to replicated,
+    # not get a rank-2 spec
+    assert tp_spec_for_path("gate/w2", _np.zeros((5,))) == P()
+    assert tp_spec_for_path("attn/wq", _np.zeros((4, 8))) == P(None, "model")
