@@ -1,0 +1,147 @@
+"""Session-long TPU evidence watcher (VERDICT r2 item 1).
+
+The tunneled TPU chip has been down for whole sessions at a time; a single
+driver-triggered ``bench.py`` run misses any short availability window.  This
+watcher loops for the whole session:
+
+- every ``BENCH_WATCH_INTERVAL`` seconds (default 20 min) it PROBES the TPU
+  backend with a short-timeout subprocess (init either hangs or raises
+  UNAVAILABLE when the tunnel is down — cheap to detect, no full bench spawn);
+- every attempt (probe or bench) is appended to ``BENCH_attempts.jsonl`` as
+  one JSON line ``{ts, kind, ok, error|result}`` — the standing evidence
+  trail the round-2 verdict asked for;
+- on the first successful probe it runs the REAL bench worker
+  (``bench.py --worker tpu``) and, if that parses, snapshots the result to
+  ``BENCH_r03.json`` (with ``baseline_source: "nominal"`` and an MFU sanity
+  gate: ``mfu > 1`` marks the row ``suspect: true``) and also runs
+  ``__graft_entry__.dryrun_tpu_ops()`` to capture Mosaic-compiled Pallas
+  kernel evidence (``PALLAS_TPU_r03.json``);
+- after a successful bench capture it keeps probing (cheap) but stops
+  re-running the expensive bench unless ``BENCH_WATCH_REPEAT=1``.
+
+Run detached at session start:  ``nohup python bench_watch.py &``
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ATTEMPTS = os.path.join(HERE, "BENCH_attempts.jsonl")
+SNAPSHOT = os.path.join(HERE, "BENCH_r03.json")
+PALLAS_SNAPSHOT = os.path.join(HERE, "PALLAS_TPU_r03.json")
+
+PROBE_TIMEOUT = float(os.environ.get("BENCH_WATCH_PROBE_TIMEOUT", "150"))
+BENCH_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
+INTERVAL = float(os.environ.get("BENCH_WATCH_INTERVAL", "1200"))
+
+_PROBE_SRC = (
+    "import jax; ds = jax.devices(); "
+    "import json; print(json.dumps({'platform': ds[0].platform, "
+    "'device_kind': ds[0].device_kind, 'n': len(ds)}))"
+)
+
+
+def _log(entry: dict) -> None:
+    entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(ATTEMPTS, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def _probe():
+    """Short-timeout backend-init probe. Returns (ok, info_or_error)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], capture_output=True,
+            text=True, timeout=PROBE_TIMEOUT, cwd=HERE)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {PROBE_TIMEOUT:.0f}s (backend init hang)"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode == 0 and lines:
+        try:
+            info = json.loads(lines[-1])
+            if info.get("platform") == "tpu":
+                return True, info
+            return False, f"backend came up as {info.get('platform')!r}, not tpu"
+        except json.JSONDecodeError:
+            pass
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-4:]
+    return False, f"probe rc={proc.returncode}: " + " | ".join(tail)
+
+
+def _run_bench():
+    """Full TPU bench worker. Returns (result_or_None, error_or_None)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py"), "--worker", "tpu"],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=HERE)
+    except subprocess.TimeoutExpired:
+        return None, f"tpu worker timed out after {BENCH_TIMEOUT:.0f}s"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1]), None
+        except json.JSONDecodeError:
+            pass
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return None, f"tpu worker rc={proc.returncode}: " + " | ".join(tail)
+
+
+def _run_pallas_dryrun():
+    """dryrun_tpu_ops in a subprocess (Mosaic compile evidence)."""
+    src = ("import json, __graft_entry__ as g; "
+           "print(json.dumps(g.dryrun_tpu_ops()))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", src], capture_output=True, text=True,
+            timeout=BENCH_TIMEOUT, cwd=HERE)
+    except subprocess.TimeoutExpired:
+        return None, "dryrun_tpu_ops timed out"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode == 0 and lines:
+        try:
+            return json.loads(lines[-1]), None
+        except json.JSONDecodeError:
+            pass
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return None, f"dryrun_tpu_ops rc={proc.returncode}: " + " | ".join(tail)
+
+
+def _annotate(result: dict) -> dict:
+    result["baseline_source"] = "nominal"
+    mfu = result.get("mfu")
+    if mfu is not None and mfu > 1.0:
+        result["suspect"] = True
+    return result
+
+
+def main():
+    captured = os.path.exists(SNAPSHOT)
+    repeat = os.environ.get("BENCH_WATCH_REPEAT") == "1"
+    while True:
+        ok, info = _probe()
+        _log({"kind": "probe", "ok": ok,
+              **({"result": info} if ok else {"error": info})})
+        if ok and (not captured or repeat):
+            result, err = _run_bench()
+            if result is not None:
+                result = _annotate(result)
+                with open(SNAPSHOT, "w") as f:
+                    json.dump(result, f, indent=1)
+                captured = True
+            _log({"kind": "bench", "ok": result is not None,
+                  **({"result": result} if result else {"error": err})})
+            pres, perr = _run_pallas_dryrun()
+            if pres is not None:
+                with open(PALLAS_SNAPSHOT, "w") as f:
+                    json.dump(pres, f, indent=1)
+            _log({"kind": "pallas_dryrun", "ok": pres is not None,
+                  **({"result": pres} if pres else {"error": perr})})
+        time.sleep(INTERVAL)
+
+
+if __name__ == "__main__":
+    main()

@@ -60,13 +60,28 @@ def _run(args) -> int:
                    BIGDL_TPU_PROCESS_ID=str(r))
         procs.append(subprocess.Popen(
             [sys.executable, args.script] + args.script_args, env=env))
+    # poll ALL children: a crashed rank leaves its peers blocked in the
+    # jax.distributed rendezvous, so survivors are killed the moment any
+    # member exits nonzero (true fail-fast, not wait-in-order)
+    import time as _time
+
     rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    if rc:
-        for p in procs:
-            if p.poll() is None:
+    live = list(procs)
+    while live:
+        for p in list(live):
+            p_rc = p.poll()
+            if p_rc is None:
+                continue
+            live.remove(p)
+            rc = rc or p_rc
+        if rc:
+            for p in live:
                 p.kill()
+            for p in live:
+                p.wait()
+            break
+        if live:
+            _time.sleep(0.05)
     return rc
 
 

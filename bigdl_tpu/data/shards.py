@@ -73,13 +73,23 @@ class XShards:
 
     # -- RDD-like ops -------------------------------------------------------
     def transform_shard(self, fn: Callable, *args) -> "XShards":
-        return XShards([fn(s, *args) for s in self._shards])
+        # process_local MUST propagate: a sharded read followed by the normal
+        # preprocess chain (transform_shard(...).owned()) would otherwise
+        # re-slice [p::n] over already-disjoint LOCAL shards and silently
+        # drop (n-1)/n of each process's data in multihost jobs.
+        return XShards([fn(s, *args) for s in self._shards],
+                       process_local=self._process_local)
 
     def num_partitions(self) -> int:
         return len(self._shards)
 
     def repartition(self, n: int) -> "XShards":
-        return XShards(_split_obj(_concat_objs(self._shards), n))
+        """Concat + re-split into n shards.  On a process-local collection
+        this reshapes ONLY the local share (there is no cross-process
+        shuffle by design — same stance as the sharded-read loaders), so the
+        result stays process-local."""
+        return XShards(_split_obj(_concat_objs(self._shards), n),
+                       process_local=self._process_local)
 
     def collect(self) -> List[Any]:
         return list(self._shards)

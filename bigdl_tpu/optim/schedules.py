@@ -123,8 +123,9 @@ class EpochSchedule(LearningRateSchedule):
     """Piecewise-constant lr by epoch regimes — reference
     ``SGD.EpochSchedule(regimes)`` with ``Regime(startEpoch, endEpoch,
     lr)``; epochs are 1-based and inclusive like the reference.  Past the
-    last regime the LAST regime's rate persists (the reference mutates a
-    persistent config, so its final rate sticks too)."""
+    last regime — or in a gap BETWEEN regimes — the most recently matched
+    regime's rate persists (the reference mutates a persistent config in
+    order, so the previous regime's rate sticks)."""
 
     def __init__(self, regimes: Sequence[Tuple[int, int, float]],
                  steps_per_epoch: int):
@@ -135,10 +136,11 @@ class EpochSchedule(LearningRateSchedule):
 
     def __call__(self, lr, step):
         epoch = jnp.floor(step / self.steps_per_epoch) + 1
-        out = jnp.where(epoch < self.regimes[0][0], lr,
-                        self.regimes[-1][2])
-        for start, end, value in self.regimes:
-            out = jnp.where((epoch >= start) & (epoch <= end), value, out)
+        # carry-forward semantics: each regime claims epochs from its start
+        # onward until a later regime's start overrides it
+        out = lr
+        for start, _end, value in self.regimes:
+            out = jnp.where(epoch >= start, value, out)
         return out
 
 

@@ -725,16 +725,39 @@ class Tensor:
             self.dtype if jnp.issubdtype(self.dtype, jnp.floating)
             else jnp.float32))
 
-    def multinomial(self, num_samples: int, key=None) -> "Tensor":
+    def multinomial(self, num_samples: int, replacement: bool = False,
+                    key=None) -> "Tensor":
         """Sample category indices from unnormalized row weights:
-        (C,) → (num_samples,); (B, C) → (B, num_samples)."""
+        (C,) → (num_samples,); (B, C) → (B, num_samples).  Default is
+        WITHOUT replacement, matching ``torch.multinomial`` (Gumbel top-k:
+        argtop of log-weights + Gumbel noise is a weighted sample without
+        replacement)."""
         logits = jnp.log(jnp.maximum(self.data, 1e-30))
-        if logits.ndim == 1:
-            return Tensor(jax.random.categorical(
-                _key(key), logits, shape=(num_samples,)))
-        s = jax.random.categorical(
-            _key(key), logits, shape=(num_samples,) + logits.shape[:-1])
-        return Tensor(jnp.moveaxis(s, 0, -1))
+        if replacement:
+            if logits.ndim == 1:
+                return Tensor(jax.random.categorical(
+                    _key(key), logits, shape=(num_samples,)))
+            s = jax.random.categorical(
+                _key(key), logits, shape=(num_samples,) + logits.shape[:-1])
+            return Tensor(jnp.moveaxis(s, 0, -1))
+        if num_samples > logits.shape[-1]:
+            raise ValueError(
+                f"multinomial without replacement: num_samples "
+                f"{num_samples} > categories {logits.shape[-1]}")
+        # torch raises when a row lacks enough NONZERO weights to fill the
+        # draw; zero weights are masked to -inf so they can never win top_k
+        logits = jnp.where(self.data > 0, logits, -jnp.inf)
+        try:
+            nz = int(jnp.min(jnp.sum(self.data > 0, axis=-1)))
+            if num_samples > nz:
+                raise ValueError(
+                    f"multinomial without replacement: num_samples "
+                    f"{num_samples} > nonzero categories {nz}")
+        except jax.errors.TracerArrayConversionError:
+            pass  # traced: the -inf mask still keeps zeros last in top_k
+        g = jax.random.gumbel(_key(key), logits.shape, jnp.float32)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return Tensor(idx)
 
     # -- misc ---------------------------------------------------------------
     def isnan(self) -> "Tensor":

@@ -347,8 +347,50 @@ def from_torch_module(tmodule, example_input=None):
                    else node.kwargs.get("end_dim", -1))
             return start == 1 and end == -1
         if node.op == "call_method" and node.target in ("view", "reshape"):
-            return len(node.args) == 3 and node.args[2] == -1
+            if len(node.args) != 3 or node.args[2] != -1:
+                return False
+            # x.view(n, -1) is only a batch-preserving flatten when n IS the
+            # batch size; x.view(6, -1) on a (2,3,4,5) tensor would otherwise
+            # convert to Flatten() and be silently wrong
+            first = node.args[1]
+            if isinstance(first, torch.fx.Node):
+                # dynamic batch: y.view(x.size(0), -1) traces args[1] as a
+                # size(0)-of-some-node (or shape[0] getitem); accept when
+                # that node's batch dim provably equals the view source's
+                src = node.args[0]
+                import operator
+
+                size_src = None
+                if (first.op == "call_method" and first.target == "size"
+                        and len(first.args) == 2 and first.args[1] == 0):
+                    size_src = first.args[0]
+                elif (first.op == "call_function"
+                        and first.target is operator.getitem
+                        and len(first.args) == 2 and first.args[1] == 0
+                        and isinstance(first.args[0], torch.fx.Node)
+                        and first.args[0].op == "call_function"
+                        and first.args[0].target is getattr
+                        and first.args[0].args[1:] == ("shape",)):
+                    size_src = first.args[0].args[0]
+                if size_src is None:
+                    return False
+                if size_src is src:
+                    return True
+                ss, vs = _meta_shape(size_src), _meta_shape(src)
+                return ss is not None and vs is not None and ss[0] == vs[0]
+            src_shape = _meta_shape(node.args[0])
+            if src_shape is not None and first != src_shape[0]:
+                return False
+            return True
         return False
+
+    def _consumed_by_flatten(node):
+        """Scalar side nodes (size/shape/getitem) are skippable when every
+        consumer is an accepted batch-preserving flatten (possibly through
+        another scalar side node, e.g. getattr-shape → getitem → view)."""
+        users = list(node.users)
+        return bool(users) and all(
+            is_flatten_to_vec(u) or _consumed_by_flatten(u) for u in users)
 
     def handle_flatten(node, src):
         if src in flat_already:     # AdaptiveAvgPool2d(1) already emitted (b,c)
@@ -429,7 +471,10 @@ def from_torch_module(tmodule, example_input=None):
 
         elif node.op == "call_function":
             fn = node.target
-            if fn in (operator.add, torch.add, operator.sub, torch.sub,
+            if (fn is getattr or fn is operator.getitem) \
+                    and _consumed_by_flatten(node):
+                pass  # x.shape[0] chain feeding an accepted flatten
+            elif fn in (operator.add, torch.add, operator.sub, torch.sub,
                       operator.mul, torch.mul, operator.truediv,
                       torch.div):
                 a, b = node.args[0], node.args[1]
@@ -510,6 +555,11 @@ def from_torch_module(tmodule, example_input=None):
         elif node.op == "call_method":
             if is_flatten_to_vec(node):
                 handle_flatten(node, node.args[0])
+            elif node.target == "size" and _consumed_by_flatten(node):
+                # x.size(0) consumed only by accepted batch-preserving
+                # flattens (the x.view(x.size(0), -1) idiom) — scalar side
+                # value, nothing to emit
+                pass
             elif node.target == "contiguous":
                 sym[node] = sym[node.args[0]]
             elif node.target == "mean":

@@ -54,3 +54,26 @@ def test_cli_propagates_child_failure(tmp_path):
         [sys.executable, "-m", "bigdl_tpu.cli", "run", str(script)],
         env=_repo_env(), capture_output=True, text=True, timeout=120)
     assert out.returncode == 3
+
+
+def test_cli_gang_kills_peers_when_one_rank_crashes(tmp_path):
+    """ADVICE r2: one crashed rank must fail the gang FAST — survivors
+    blocked forever (here: rank 0 sleeps 600s) are killed as soon as the
+    crash is observed, not after their own wait() returns."""
+    import time
+
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["BIGDL_TPU_PROCESS_ID"])
+        if rank == 1:
+            sys.exit(7)
+        time.sleep(600)   # simulates a peer stuck in rendezvous
+    """))
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "run", "-n", "2", "--cpu",
+         str(script)],
+        env=_repo_env(), capture_output=True, text=True, timeout=120)
+    assert out.returncode == 7
+    assert time.time() - t0 < 60     # fail-fast, not the 600s sleep

@@ -74,6 +74,24 @@ def test_xshards_ops():
     assert np.allclose(s3.concat(), x)
 
 
+def test_xshards_transform_preserves_process_local():
+    """ADVICE r2 (medium): sharded reads mark their collections
+    process-local; transform_shard/repartition must PROPAGATE that flag or
+    owned() re-slices [p::n] over already-disjoint local shards and drops
+    (n-1)/n of the data in multihost jobs."""
+    x = np.arange(24).reshape(12, 2).astype(np.float32)
+    local = XShards([x[:6], x[6:]], process_local=True)
+    t = local.transform_shard(lambda a: a + 1)
+    assert t._process_local
+    assert np.allclose(np.concatenate(t.owned()), x + 1)  # nothing dropped
+    r = local.repartition(3)
+    assert r._process_local
+    assert np.allclose(np.concatenate(r.owned()), x)
+    # non-local collections keep slicing in owned() (single process: all)
+    glob = XShards([x[:6], x[6:]]).transform_shard(lambda a: a)
+    assert not glob._process_local
+
+
 def test_read_csv(tmp_path):
     import pandas as pd
 

@@ -269,3 +269,77 @@ def test_multi_input_torch_module():
     with torch.no_grad():
         ty = tm(torch.tensor(u), torch.tensor(v))
     np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
+
+
+def test_non_batch_view_is_not_flatten():
+    """ADVICE r2: x.view(6, -1) on a (2,3,4,5) tensor is NOT a
+    batch-preserving flatten — it must raise, not silently convert to
+    Flatten() with wrong numerics.  x.view(batch, -1) still converts."""
+
+    class BadView(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(20, 2)   # (2,3,4,5).view(6,-1) → (6,20)
+
+        def forward(self, x):
+            return self.fc(x.view(6, -1))
+
+    x = RS.rand(2, 3, 4, 5).astype(np.float32)
+    with pytest.raises((NotImplementedError, ValueError)):
+        from_torch_module(BadView(), example_input=x)
+
+    class GoodView(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(60, 2)
+
+        def forward(self, x):
+            return self.fc(x.view(2, -1))
+
+    tm = GoodView().eval()
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))   # ours NHWC
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
+
+
+def test_dynamic_batch_view_converts():
+    """x.view(x.size(0), -1) — the standard dynamic-batch flatten idiom —
+    must keep converting (the batch-size check accepts the size(0) node)."""
+
+    class DynView(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(60, 2)
+
+        def forward(self, x):
+            return self.fc(x.view(x.size(0), -1))
+
+    x = RS.rand(2, 3, 4, 5).astype(np.float32)
+    tm = DynView().eval()
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
+
+
+def test_shape_getitem_view_converts():
+    """x.reshape(x.shape[0], -1) — the other dynamic-batch flatten idiom."""
+
+    class ShapeView(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = torch.nn.Linear(60, 2)
+
+        def forward(self, x):
+            return self.fc(x.reshape(x.shape[0], -1))
+
+    x = RS.rand(2, 3, 4, 5).astype(np.float32)
+    tm = ShapeView().eval()
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)

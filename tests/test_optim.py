@@ -278,3 +278,18 @@ def test_epoch_schedule_last_regime_persists():
     sched = EpochSchedule([(1, 2, 0.1), (3, 5, 0.01)], steps_per_epoch=10)
     # past the last regime the final rate sticks (no jump back to base lr)
     assert float(sched(1.0, 70)) == pytest.approx(0.01)   # epoch 8
+
+
+def test_epoch_schedule_gap_carries_previous_regime():
+    """An epoch in a GAP between regimes inherits the most recently matched
+    regime's rate, not the last regime's (ADVICE r2: the reference mutates
+    config in order, so the previous rate sticks)."""
+    from bigdl_tpu.optim import EpochSchedule
+
+    sched = EpochSchedule([(1, 2, 0.1), (5, 8, 0.01)], steps_per_epoch=10)
+    assert float(sched(1.0, 10)) == pytest.approx(0.1)    # epoch 2, regime 1
+    assert float(sched(1.0, 30)) == pytest.approx(0.1)    # epoch 4: GAP
+    assert float(sched(1.0, 45)) == pytest.approx(0.01)   # epoch 5, regime 2
+    # before the first regime: base lr
+    sched2 = EpochSchedule([(3, 5, 0.5)], steps_per_epoch=10)
+    assert float(sched2(1.0, 0)) == pytest.approx(1.0)    # epoch 1

@@ -166,12 +166,33 @@ def test_random_ops_with_keys():
     n = np.asarray(t.normal(1.0, 0.1, key=k).data)
     assert abs(n.mean() - 1.0) < 0.05
     w = Tensor(np.asarray([0.0, 0.0, 1.0], np.float32))
-    m = np.asarray(w.multinomial(50, key=k).data)
+    m = np.asarray(w.multinomial(50, replacement=True, key=k).data)
     assert np.all(m == 2)
     wb = Tensor(np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32))
-    mb = np.asarray(wb.multinomial(20, key=k).data)
+    mb = np.asarray(wb.multinomial(20, replacement=True, key=k).data)
     assert mb.shape == (2, 20)
     assert np.all(mb[0] == 0) and np.all(mb[1] == 1)
+
+
+def test_multinomial_without_replacement():
+    """torch.multinomial defaults to replacement=False: no duplicate
+    indices, heaviest weights dominate the draw (ADVICE r2)."""
+    import jax
+
+    k = jax.random.PRNGKey(3)
+    w = Tensor(np.asarray([1.0, 5.0, 0.1, 3.0], np.float32))
+    m = np.asarray(w.multinomial(4, key=k).data)      # default: no repl.
+    assert sorted(m.tolist()) == [0, 1, 2, 3]          # a permutation
+    m2 = np.asarray(w.multinomial(2, key=k).data)
+    assert len(set(m2.tolist())) == 2                  # distinct
+    # batched rows each draw without replacement
+    wb = Tensor(np.asarray([[1.0, 1.0, 1.0], [9.0, 1.0, 1.0]], np.float32))
+    mb = np.asarray(wb.multinomial(3, key=k).data)
+    assert mb.shape == (2, 3)
+    assert sorted(mb[0].tolist()) == [0, 1, 2]
+    assert sorted(mb[1].tolist()) == [0, 1, 2]
+    with pytest.raises(ValueError):
+        w.multinomial(5, key=k)                        # 5 > 4 categories
 
 
 def test_reductions_and_predicates():
@@ -204,3 +225,17 @@ def test_median_cumprod_argsort():
     np.testing.assert_array_equal(
         np.asarray(Tensor(a).argsort(1, descending=True).data),
         np.argsort(-a, 1))
+
+
+def test_multinomial_no_replacement_rejects_zero_weight_rows():
+    """torch parity: a row without enough NONZERO weights cannot fill the
+    draw — raise instead of returning impossible indices."""
+    import jax
+
+    k = jax.random.PRNGKey(0)
+    w = Tensor(np.asarray([1.0, 0.0, 0.0, 0.0], np.float32))
+    with pytest.raises(ValueError):
+        w.multinomial(2, key=k)
+    # one nonzero → sampling exactly 1 is fine and must pick it
+    m = np.asarray(w.multinomial(1, key=k).data)
+    assert m.tolist() == [0]
