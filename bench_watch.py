@@ -51,63 +51,48 @@ def _log(entry: dict) -> None:
     print(json.dumps(entry), flush=True)
 
 
-def _probe():
-    """Short-timeout backend-init probe. Returns (ok, info_or_error)."""
+def _run_json(argv, timeout, label, tail_lines=8):
+    """Run a subprocess whose LAST stdout line is one JSON object.
+    Returns (parsed_or_None, error_or_None)."""
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC], capture_output=True,
-            text=True, timeout=PROBE_TIMEOUT, cwd=HERE)
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, cwd=HERE)
     except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {PROBE_TIMEOUT:.0f}s (backend init hang)"
-    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
-    if proc.returncode == 0 and lines:
-        try:
-            info = json.loads(lines[-1])
-            if info.get("platform") == "tpu":
-                return True, info
-            return False, f"backend came up as {info.get('platform')!r}, not tpu"
-        except json.JSONDecodeError:
-            pass
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-4:]
-    return False, f"probe rc={proc.returncode}: " + " | ".join(tail)
-
-
-def _run_bench():
-    """Full TPU bench worker. Returns (result_or_None, error_or_None)."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(HERE, "bench.py"), "--worker", "tpu"],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=HERE)
-    except subprocess.TimeoutExpired:
-        return None, f"tpu worker timed out after {BENCH_TIMEOUT:.0f}s"
+        return None, f"{label} timed out after {timeout:.0f}s"
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     if proc.returncode == 0 and lines:
         try:
             return json.loads(lines[-1]), None
         except json.JSONDecodeError:
             pass
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-    return None, f"tpu worker rc={proc.returncode}: " + " | ".join(tail)
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-tail_lines:]
+    return None, f"{label} rc={proc.returncode}: " + " | ".join(tail)
+
+
+def _probe():
+    """Short-timeout backend-init probe. Returns (ok, info_or_error)."""
+    info, err = _run_json([sys.executable, "-c", _PROBE_SRC], PROBE_TIMEOUT,
+                          "probe", tail_lines=4)
+    if info is None:
+        return False, err
+    if info.get("platform") == "tpu":
+        return True, info
+    return False, f"backend came up as {info.get('platform')!r}, not tpu"
+
+
+def _run_bench():
+    """Full TPU bench worker. Returns (result_or_None, error_or_None)."""
+    return _run_json(
+        [sys.executable, os.path.join(HERE, "bench.py"), "--worker", "tpu"],
+        BENCH_TIMEOUT, "tpu worker")
 
 
 def _run_pallas_dryrun():
     """dryrun_tpu_ops in a subprocess (Mosaic compile evidence)."""
     src = ("import json, __graft_entry__ as g; "
            "print(json.dumps(g.dryrun_tpu_ops()))")
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", src], capture_output=True, text=True,
-            timeout=BENCH_TIMEOUT, cwd=HERE)
-    except subprocess.TimeoutExpired:
-        return None, "dryrun_tpu_ops timed out"
-    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
-    if proc.returncode == 0 and lines:
-        try:
-            return json.loads(lines[-1]), None
-        except json.JSONDecodeError:
-            pass
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-    return None, f"dryrun_tpu_ops rc={proc.returncode}: " + " | ".join(tail)
+    return _run_json([sys.executable, "-c", src], BENCH_TIMEOUT,
+                     "dryrun_tpu_ops")
 
 
 def _annotate(result: dict) -> dict:

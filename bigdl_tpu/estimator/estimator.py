@@ -157,10 +157,43 @@ class Estimator:
 
     @staticmethod
     def from_keras(model_creator, config=None, backend="jax_tpu") -> "Estimator":
-        """model_creator returns a COMPILED keras-style model
-        (``model.compile(optimizer, loss, metrics)`` already called)."""
+        """Train a keras model on the mesh — BOTH kinds (reference
+        ``orca/learn/tf2/estimator.py``: ``Estimator.from_keras`` trains
+        stock ``tf.keras`` models):
+
+        - a COMPILED model built with THIS package's keras API
+          (``bigdl_tpu.keras``), or
+        - a COMPILED **stock tf.keras model** (Keras 3): converted once via
+          ``utils.keras_convert`` (layer graph walked, weights carried
+          over, optimizer/loss mapped to native equivalents) — TF never
+          runs on the hot path.  After ``fit``, ``export_to_keras()``
+          writes the trained weights back into the original keras model.
+        """
         cfg = dict(config or {})
         model = model_creator(cfg)
+        if type(model).__module__.split(".")[0] in ("keras", "tf_keras") \
+                or "tensorflow" in type(model).__module__:
+            from bigdl_tpu.utils.keras_convert import (
+                convert_keras_loss, convert_keras_optimizer, from_tf_keras)
+
+            kmodel = model
+            if getattr(kmodel, "optimizer", None) is None or \
+                    getattr(kmodel, "loss", None) is None:
+                raise ValueError(
+                    "from_keras: compile() the tf.keras model first "
+                    "(optimizer + loss are mapped to native equivalents)")
+            native, variables = from_tf_keras(kmodel)
+            est = Estimator.__new__(Estimator)
+            est.config = cfg
+            est.model = native
+            est.optim_method = convert_keras_optimizer(kmodel.optimizer)
+            est.criterion = convert_keras_loss(kmodel.loss)
+            est._trained = None
+            est._loaded_variables = variables  # predict/evaluate pre-finetune
+            est._initial_variables = variables
+            est._tf_keras_model = kmodel
+            est._last_stats = {}
+            return est
         compiled = getattr(model, "_compiled", None)
         if compiled is None:
             raise ValueError("from_keras: creator must return a compiled model")
@@ -173,6 +206,17 @@ class Estimator:
         est._loaded_variables = None
         est._last_stats = {}
         return est
+
+    def export_to_keras(self):
+        """For stock-tf.keras estimators: write the trained weights back
+        into the ORIGINAL keras model (in place) and return it."""
+        km = getattr(self, "_tf_keras_model", None)
+        if km is None:
+            raise RuntimeError("not a stock-tf.keras estimator")
+        from bigdl_tpu.utils.keras_convert import export_tf_keras_weights
+
+        export_tf_keras_weights(self.model, self.get_model(), km)
+        return km
 
     # -- training -----------------------------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: int = 32,

@@ -186,7 +186,12 @@ class LSTMPeephole(LSTM):
 
 
 class GRU(_RNNBase):
-    """GRU — reference ``dllib/nn/GRU.scala`` (gate order r,z,n)."""
+    """GRU — reference ``dllib/nn/GRU.scala`` (gate order r,z,n).
+
+    The recurrence applies the reset gate AFTER the recurrent matmul
+    (``r * (h @ U)``) — the same form as tf.keras ``reset_after=True``; an
+    optional ``bias_rec`` param (recurrent bias, used by the stock-keras
+    importer) completes exact keras parity."""
 
     n_gates = 3
 
@@ -195,6 +200,8 @@ class GRU(_RNNBase):
         wr = cast_compute(params["w_rec"])
         rec = jnp.matmul(cast_compute(h_prev), wr,
                          preferred_element_type=jnp.float32).astype(h_prev.dtype)
+        if "bias_rec" in params:
+            rec = rec + params["bias_rec"].astype(rec.dtype)
         xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
         hr, hz, hn = jnp.split(rec, 3, axis=-1)
         r = jax.nn.sigmoid(xr + hr)

@@ -131,7 +131,9 @@ class EpochSchedule(LearningRateSchedule):
                  steps_per_epoch: int):
         if not regimes:
             raise ValueError("EpochSchedule needs at least one regime")
-        self.regimes = tuple(regimes)
+        # carry-forward iteration needs start-epoch order (the reference
+        # accepts any order; sorting preserves its semantics)
+        self.regimes = tuple(sorted(regimes, key=lambda r: r[0]))
         self.steps_per_epoch = steps_per_epoch
 
     def __call__(self, lr, step):

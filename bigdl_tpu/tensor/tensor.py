@@ -753,7 +753,7 @@ class Tensor:
                 raise ValueError(
                     f"multinomial without replacement: num_samples "
                     f"{num_samples} > nonzero categories {nz}")
-        except jax.errors.TracerArrayConversionError:
+        except jax.errors.ConcretizationTypeError:
             pass  # traced: the -inf mask still keeps zeros last in top_k
         g = jax.random.gumbel(_key(key), logits.shape, jnp.float32)
         _, idx = jax.lax.top_k(logits + g, num_samples)

@@ -1,0 +1,685 @@
+"""Stock ``tf.keras`` model conversion — the Orca TF2 Estimator path.
+
+Reference analog (unverified — mount empty): ``python/orca/src/bigdl/orca/
+learn/tf2/estimator.py`` — ``Estimator.from_keras(model_creator)`` trains a
+STOCK ``tf.keras`` model data-parallel (workers run
+``MultiWorkerMirroredStrategy``).  TPU-native: the keras model is converted
+ONCE to a native keras-engine :class:`Model` (weights carried over), trained
+with the ZeRO-1 sharded step on the mesh, and trained weights export BACK
+into the original keras model — TF never runs on the hot path, mirroring
+what ``utils/torch_convert.py`` does for torch fx graphs.
+
+Works against Keras 3 (the Keras bundled with TF 2.x in this image) via the
+public layer/config/weights surface: the functional graph is walked through
+each layer's inbound node (``input_tensors → output_tensors``), so
+Sequential, functional (residual/multi-input) and nested Bidirectional
+models all convert.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["from_tf_keras", "export_tf_keras_weights",
+           "convert_keras_optimizer", "convert_keras_loss"]
+
+
+class UnsupportedKerasLayer(NotImplementedError):
+    pass
+
+
+def _cfg(layer) -> Dict[str, Any]:
+    return layer.get_config()
+
+
+def _act_layer(name: Optional[str]):
+    """keras activation string -> catalog layer instance (None = linear)."""
+    from bigdl_tpu import nn as N
+
+    if name is None or name == "linear":
+        return None
+    table = {
+        "relu": N.ReLU, "relu6": N.ReLU6, "sigmoid": N.Sigmoid,
+        "tanh": N.Tanh, "softmax": N.SoftMax, "gelu": N.GELU,
+        "elu": N.ELU, "silu": N.SiLU, "swish": N.Swish,
+        "softplus": N.SoftPlus, "softsign": N.SoftSign,
+        "hard_sigmoid": N.HardSigmoid, "leaky_relu": N.LeakyReLU,
+        "log_softmax": N.LogSoftMax, "mish": N.Mish,
+        "exponential": N.Exp,
+    }
+    if name not in table:
+        raise UnsupportedKerasLayer(f"activation {name!r}")
+    return table[name]()
+
+
+def _pad(cfg) -> str:
+    p = cfg.get("padding", "valid")
+    if p not in ("same", "valid"):
+        raise UnsupportedKerasLayer(f"padding {p!r}")
+    return p
+
+
+def _require_channels_last(cfg, lname):
+    if cfg.get("data_format", "channels_last") != "channels_last":
+        raise UnsupportedKerasLayer(
+            f"{lname}: channels_first (TPU-native layout is NHWC; rebuild "
+            "the keras model channels_last)")
+
+
+# ---------------------------------------------------------------------------
+# per-layer converters: klayer -> list of (our_layer, params, state, kind)
+# ``kind`` keys the weight-export transform (None = no weights)
+# ---------------------------------------------------------------------------
+
+def _conv_dense_like(klayer, cfg, our_layer, kind):
+    w = klayer.get_weights()
+    params = {"weight": w[0]}
+    if cfg.get("use_bias", True):
+        params["bias"] = w[1]
+    steps = [(our_layer, params, {}, kind)]
+    act = _act_layer(cfg.get("activation"))
+    if act is not None:
+        steps.append((act, {}, {}, None))
+    return steps
+
+
+def _convert_dense(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    k = klayer.get_weights()[0]
+    layer = N.Linear(k.shape[0], k.shape[1],
+                     with_bias=cfg.get("use_bias", True))
+    return _conv_dense_like(klayer, cfg, layer, "dense")
+
+
+def _convert_conv2d(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    _require_channels_last(cfg, "Conv2D")
+    k = klayer.get_weights()[0]  # HWIO — same layout as ours
+    groups = cfg.get("groups", 1)
+    layer = N.Conv2D(k.shape[2] * groups, k.shape[3],
+                     kernel_size=tuple(cfg["kernel_size"]),
+                     stride=tuple(cfg["strides"]), padding=_pad(cfg),
+                     dilation=tuple(cfg.get("dilation_rate", (1, 1))),
+                     groups=groups, with_bias=cfg.get("use_bias", True))
+    return _conv_dense_like(klayer, cfg, layer, "conv")
+
+
+def _convert_conv1d(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    k = klayer.get_weights()[0]  # (k, in, out) — same as ours
+    layer = N.Conv1D(k.shape[1], k.shape[2], kernel_size=k.shape[0],
+                     stride=cfg["strides"][0],
+                     padding="valid" if cfg["padding"] == "causal"
+                     else _pad(cfg),
+                     dilation=cfg.get("dilation_rate", (1,))[0],
+                     causal=cfg["padding"] == "causal",
+                     with_bias=cfg.get("use_bias", True))
+    return _conv_dense_like(klayer, cfg, layer, "conv")
+
+
+def _convert_depthwise(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    _require_channels_last(cfg, "DepthwiseConv2D")
+    w = klayer.get_weights()
+    kh, kw, cin, mult = w[0].shape
+    layer = N.DepthwiseConv2D(cin, kernel_size=(kh, kw),
+                              stride=tuple(cfg["strides"]),
+                              padding=_pad(cfg), depth_multiplier=mult,
+                              with_bias=cfg.get("use_bias", True))
+    # keras (h,w,cin,mult) -> ours (h,w,1,cin*mult): C-order flatten keeps
+    # output channel g*mult+m = keras [:, :, g, m]
+    params = {"weight": w[0].reshape(kh, kw, 1, cin * mult)}
+    if cfg.get("use_bias", True):
+        params["bias"] = w[1]
+    steps = [(layer, params, {}, "depthwise")]
+    act = _act_layer(cfg.get("activation"))
+    if act is not None:
+        steps.append((act, {}, {}, None))
+    return steps
+
+
+def _convert_batchnorm(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0]
+    nd = len(klayer.input.shape)
+    if axis not in (-1, nd - 1):
+        raise UnsupportedKerasLayer(
+            f"BatchNormalization over axis {axis} (only last-axis/NHWC)")
+    scale = cfg.get("scale", True)
+    center = cfg.get("center", True)
+    if not (scale and center):
+        raise UnsupportedKerasLayer("BatchNormalization without scale/center")
+    gamma, beta, mean, var = klayer.get_weights()
+    layer = N.BatchNorm(len(gamma), eps=cfg.get("epsilon", 1e-3),
+                        momentum=1.0 - cfg.get("momentum", 0.99))
+    return [(layer, {"weight": gamma, "bias": beta},
+             {"running_mean": mean, "running_var": var}, "bn")]
+
+
+def _convert_layernorm(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[-1] if len(axis) == 1 else axis
+    nd = len(klayer.input.shape)
+    if axis not in (-1, nd - 1):
+        raise UnsupportedKerasLayer(f"LayerNormalization over axis {axis}")
+    gamma, beta = klayer.get_weights()
+    layer = N.LayerNorm(len(gamma), eps=cfg.get("epsilon", 1e-3))
+    return [(layer, {"weight": gamma, "bias": beta}, {}, "ln")]
+
+
+def _convert_embedding(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    w = klayer.get_weights()[0]
+    layer = N.Embedding(w.shape[0], w.shape[1])
+    return [(layer, {"weight": w}, {}, "embedding")]
+
+
+def _rnn_common_checks(cfg, lname):
+    if cfg.get("activation", "tanh") != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise UnsupportedKerasLayer(f"{lname}: non-default activations")
+    for flag in ("return_state", "stateful", "unroll"):
+        if cfg.get(flag):
+            raise UnsupportedKerasLayer(f"{lname}: {flag}=True")
+    if cfg.get("dropout", 0.0) or cfg.get("recurrent_dropout", 0.0):
+        raise UnsupportedKerasLayer(f"{lname}: recurrent dropout")
+
+
+def _lstm_parts(klayer, cfg):
+    """(our LSTM layer, params) — keras gate order i,f,c,o == ours i,f,g,o."""
+    from bigdl_tpu import nn as N
+
+    _rnn_common_checks(cfg, "LSTM")
+    w = klayer.get_weights()
+    kernel, rec = w[0], w[1]
+    layer = N.LSTM(kernel.shape[0], rec.shape[0],
+                   return_sequences=cfg.get("return_sequences", False),
+                   go_backwards=cfg.get("go_backwards", False))
+    params = {"w_in": kernel, "w_rec": rec,
+              "bias": w[2] if cfg.get("use_bias", True)
+              else np.zeros((kernel.shape[1],), np.float32)}
+    return layer, params
+
+
+def _gru_parts(klayer, cfg):
+    """keras GRU (gate order z,r,h; reset_after=True) -> ours (r,z,n with
+    recurrent bias)."""
+    from bigdl_tpu import nn as N
+
+    _rnn_common_checks(cfg, "GRU")
+    if not cfg.get("reset_after", True):
+        raise UnsupportedKerasLayer(
+            "GRU reset_after=False (the pre-matmul reset form; the native "
+            "GRU implements the keras-default reset_after=True recurrence)")
+    w = klayer.get_weights()
+    kernel, rec = w[0], w[1]
+    h = rec.shape[0]
+
+    def permute(m):  # columns [z,r,h] -> [r,z,n]
+        z, r, n = np.split(m, 3, axis=-1)
+        return np.concatenate([r, z, n], axis=-1)
+
+    layer = N.GRU(kernel.shape[0], h,
+                  return_sequences=cfg.get("return_sequences", False),
+                  go_backwards=cfg.get("go_backwards", False))
+    params = {"w_in": permute(kernel), "w_rec": permute(rec)}
+    if cfg.get("use_bias", True):
+        bias = w[2]
+        if bias.ndim == 2:  # (2, 3h): input bias + recurrent bias
+            params["bias"] = permute(bias[0])
+            params["bias_rec"] = permute(bias[1])
+        else:
+            params["bias"] = permute(bias)
+    else:
+        params["bias"] = np.zeros((3 * h,), np.float32)
+    return layer, params
+
+
+def _convert_lstm(klayer, cfg):
+    layer, params = _lstm_parts(klayer, cfg)
+    return [(layer, params, {}, "lstm")]
+
+
+def _convert_gru(klayer, cfg):
+    layer, params = _gru_parts(klayer, cfg)
+    return [(layer, params, {}, "gru")]
+
+
+def _convert_bidirectional(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    mode = cfg.get("merge_mode", "concat")
+    if mode not in ("concat", "sum"):
+        raise UnsupportedKerasLayer(f"Bidirectional merge_mode {mode!r}")
+    fwd_k, bwd_k = klayer.forward_layer, klayer.backward_layer
+    inner = type(fwd_k).__name__
+    if inner == "LSTM":
+        parts, kind = _lstm_parts, "bilstm"
+    elif inner == "GRU":
+        parts, kind = _gru_parts, "bigru"
+    else:
+        raise UnsupportedKerasLayer(f"Bidirectional({inner})")
+    f_layer, f_params = parts(fwd_k, fwd_k.get_config())
+    b_layer, b_params = parts(bwd_k, bwd_k.get_config())
+    b_layer.go_backwards = True
+    layer = N.BiRecurrent(f_layer, b_layer, merge=mode)
+    return [(layer, {"fwd": f_params, "bwd": b_params}, {}, kind)]
+
+
+def _convert_prelu(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    alpha = klayer.get_weights()[0]
+    if alpha.ndim != 1:
+        alpha = alpha.reshape(-1)
+    return [(N.PReLU(len(alpha)), {"alpha": alpha}, {}, "prelu")]
+
+
+def _no_weight(builder):
+    def convert(klayer, cfg):
+        layer = builder(klayer, cfg)
+        return [(layer, {}, {}, None)] if layer is not None else []
+    return convert
+
+
+def _merge(our_name):
+    def build(klayer, cfg):
+        from bigdl_tpu import nn as N
+
+        return getattr(N, our_name)()
+    return _no_weight(build)
+
+
+def _build_pool2d(cls_name):
+    def build(klayer, cfg):
+        from bigdl_tpu import nn as N
+
+        _require_channels_last(cfg, cls_name)
+        return getattr(N, cls_name)(
+            kernel_size=tuple(cfg["pool_size"]),
+            stride=tuple(cfg["strides"] or cfg["pool_size"]),
+            padding=_pad(cfg))
+    return _no_weight(build)
+
+
+def _build_pool1d(cls_name):
+    def build(klayer, cfg):
+        from bigdl_tpu import nn as N
+
+        ps = cfg["pool_size"]
+        ps = ps[0] if isinstance(ps, (list, tuple)) else ps
+        st = cfg["strides"] or ps
+        st = st[0] if isinstance(st, (list, tuple)) else st
+        return getattr(N, cls_name)(kernel_size=ps, stride=st,
+                                    padding=_pad(cfg))
+    return _no_weight(build)
+
+
+def _build_global_pool(cls_name):
+    def build(klayer, cfg):
+        from bigdl_tpu import nn as N
+
+        if cfg.get("keepdims"):
+            raise UnsupportedKerasLayer(f"{cls_name} keepdims=True")
+        return getattr(N, cls_name)()
+    return _no_weight(build)
+
+
+def _build_activation(klayer, cfg):
+    act = cfg["activation"]
+    if not isinstance(act, str):
+        raise UnsupportedKerasLayer(f"Activation({act!r})")
+    layer = _act_layer(act)
+    if layer is None:
+        return []
+    return [(layer, {}, {}, None)]
+
+
+def _build_relu(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    max_value = cfg.get("max_value")
+    slope = cfg.get("negative_slope", 0.0)
+    if max_value not in (None, 6.0) or cfg.get("threshold", 0.0) \
+            or (max_value is not None and slope):
+        raise UnsupportedKerasLayer(
+            "ReLU with max_value/threshold/negative_slope combination")
+    if slope:
+        layer = N.LeakyReLU(slope)
+    elif max_value == 6.0:
+        layer = N.ReLU6()
+    else:
+        layer = N.ReLU()
+    return [(layer, {}, {}, None)]
+
+
+_CONVERTERS = {
+    "Dense": _convert_dense,
+    "Conv2D": _convert_conv2d,
+    "Conv1D": _convert_conv1d,
+    "DepthwiseConv2D": _convert_depthwise,
+    "BatchNormalization": _convert_batchnorm,
+    "LayerNormalization": _convert_layernorm,
+    "Embedding": _convert_embedding,
+    "LSTM": _convert_lstm,
+    "GRU": _convert_gru,
+    "Bidirectional": _convert_bidirectional,
+    "PReLU": _convert_prelu,
+    "Activation": _build_activation,
+    "ReLU": _build_relu,
+    "MaxPooling2D": _build_pool2d("MaxPool2D"),
+    "AveragePooling2D": _build_pool2d("AvgPool2D"),
+    "MaxPooling1D": _build_pool1d("MaxPool1D"),
+    "AveragePooling1D": _build_pool1d("AvgPool1D"),
+    "GlobalAveragePooling2D": _build_global_pool("GlobalAvgPool2D"),
+    "GlobalMaxPooling2D": _build_global_pool("GlobalMaxPool2D"),
+    "GlobalAveragePooling1D": _build_global_pool("GlobalAvgPool1D"),
+    "GlobalMaxPooling1D": _build_global_pool("GlobalMaxPool1D"),
+    "Add": _merge("CAddTable"),
+    "Multiply": _merge("CMulTable"),
+    "Subtract": _merge("CSubTable"),
+    "Average": _merge("CAveTable"),
+    "Maximum": _merge("CMaxTable"),
+    "Minimum": _merge("CMinTable"),
+    "Softmax": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["SoftMax"]).SoftMax()),
+    "Flatten": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["Flatten"]).Flatten()),
+    "Dropout": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["Dropout"]).Dropout(cfg["rate"])),
+    "SpatialDropout2D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["SpatialDropout2D"]).SpatialDropout2D(
+            cfg["rate"])),
+    "Reshape": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["Reshape"]).Reshape(
+            tuple(cfg["target_shape"]))),
+    "Permute": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["Permute"]).Permute(
+            tuple(cfg["dims"]))),
+    "ZeroPadding2D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["ZeroPadding2D"]).ZeroPadding2D(
+            tuple(tuple(p) for p in cfg["padding"]))),
+    "UpSampling2D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["UpSampling2D"]).UpSampling2D(
+            tuple(cfg["size"]))),
+    "Identity": _no_weight(lambda kl, cfg: None),
+}
+
+
+def _convert_concat(klayer, cfg, nd_hint=None):
+    from bigdl_tpu import nn as N
+
+    axis = cfg.get("axis", -1)
+    return [(N.JoinTable(axis), {}, {}, None)]
+
+
+def from_tf_keras(kmodel):
+    """Convert a stock (built) tf.keras model → ``(Model, variables)``.
+
+    Walks each layer's inbound node via the public Keras-3 node API; weights
+    carry over in our conventions (Dense (in,out), Conv HWIO, LSTM fused
+    i,f,g,o gates).  The returned model carries ``_tfkeras_export_map`` so
+    :func:`export_tf_keras_weights` can write trained weights back."""
+    from bigdl_tpu.keras.engine import Input, Model
+
+    if not getattr(kmodel, "built", True) or not getattr(
+            kmodel, "inputs", None):
+        raise ValueError(
+            "from_tf_keras: model must be built with known input shapes "
+            "(use an Input layer or call build())")
+
+    sym: Dict[int, Any] = {}      # id(KerasTensor) -> engine Node
+    inputs = []
+    for kt in kmodel.inputs:
+        if any(d is None for d in kt.shape[1:]):
+            raise UnsupportedKerasLayer(
+                f"input {kt.name!r} has dynamic non-batch dims "
+                f"{tuple(kt.shape)} — XLA needs static shapes; rebuild the "
+                "keras model with concrete sequence/spatial dims (pad or "
+                "bucket variable-length data)")
+        shape = tuple(int(d) for d in kt.shape[1:])
+        node = Input(shape)
+        sym[id(kt)] = node
+        inputs.append(node)
+
+    params: Dict[str, Dict] = {}
+    state: Dict[str, Dict] = {}
+    export_map: List[Tuple[str, str, str]] = []  # (keras name, kind, node)
+
+    pending = [l for l in kmodel.layers
+               if type(l).__name__ != "InputLayer"]
+    progress = True
+    while pending and progress:
+        progress = False
+        for klayer in list(pending):
+            nodes = [n for n in getattr(klayer, "_inbound_nodes", [])
+                     if all(id(t) in sym for t in n.input_tensors)]
+            if not nodes:
+                continue
+            if len(nodes) > 1:
+                raise UnsupportedKerasLayer(
+                    f"layer {klayer.name!r} is used more than once (shared "
+                    "weights are not representable in the converted graph)")
+            knode = nodes[0]
+            lname = type(klayer).__name__
+            cfg = _cfg(klayer)
+            if lname == "Concatenate":
+                steps = _convert_concat(klayer, cfg)
+            elif lname in _CONVERTERS:
+                steps = _CONVERTERS[lname](klayer, cfg)
+            else:
+                raise UnsupportedKerasLayer(
+                    f"no conversion for keras layer {lname} "
+                    f"({klayer.name!r})")
+
+            parents = [sym[id(t)] for t in knode.input_tensors]
+            if not steps:  # identity-like
+                out = parents[0]
+            else:
+                out = None
+                for i, (layer, p, s, kind) in enumerate(steps):
+                    src = parents if (i == 0 and len(parents) > 1) \
+                        else (out if out is not None else parents[0])
+                    out = layer(src)
+                    if p:
+                        params[out.name] = p
+                    if s:
+                        state[out.name] = s
+                    if kind is not None:
+                        export_map.append((klayer.name, kind, out.name))
+            for t in knode.output_tensors:
+                sym[id(t)] = out
+            pending.remove(klayer)
+            progress = True
+    if pending:
+        raise UnsupportedKerasLayer(
+            f"could not resolve graph inputs for layers "
+            f"{[l.name for l in pending]}")
+
+    outputs = [sym[id(t)] for t in kmodel.outputs]
+    model = Model(inputs, outputs, name="KerasConverted")
+    model._tfkeras_export_map = export_map
+
+    def _np(tree):
+        if isinstance(tree, dict):
+            return {k: _np(v) for k, v in tree.items()}
+        return np.asarray(tree, np.float32)
+
+    return model, {"params": _np(params), "state": _np(state)}
+
+
+# ---------------------------------------------------------------------------
+# export back into the live keras model
+# ---------------------------------------------------------------------------
+
+def _unpermute_gru(m):  # ours [r,z,n] -> keras [z,r,h]
+    r, z, n = np.split(np.asarray(m), 3, axis=-1)
+    return np.concatenate([z, r, n], axis=-1)
+
+
+def _rnn_weights(kind, p, klayer_cfg_use_bias=True):
+    if kind == "lstm":
+        out = [np.asarray(p["w_in"]), np.asarray(p["w_rec"])]
+        if klayer_cfg_use_bias:
+            out.append(np.asarray(p["bias"]))
+        return out
+    # gru
+    out = [_unpermute_gru(p["w_in"]), _unpermute_gru(p["w_rec"])]
+    if klayer_cfg_use_bias:
+        if "bias_rec" in p:
+            out.append(np.stack([_unpermute_gru(p["bias"]),
+                                 _unpermute_gru(p["bias_rec"])]))
+        else:
+            out.append(_unpermute_gru(p["bias"]))
+    return out
+
+
+def export_tf_keras_weights(model, variables, kmodel) -> None:
+    """Write trained ``variables`` back into the ORIGINAL keras model
+    in-place (``set_weights``), completing the round trip."""
+    params = variables.get("params", variables)
+    state = variables.get("state", {})
+    by_name = {l.name: l for l in kmodel.layers}
+    for kname, kind, node_name in getattr(model, "_tfkeras_export_map", []):
+        klayer = by_name[kname]
+        p = params.get(node_name, {})
+        s = state.get(node_name, {})
+        use_bias = klayer.get_config().get("use_bias", True)
+        if kind in ("dense", "conv"):
+            w = [np.asarray(p["weight"])]
+            if use_bias:
+                w.append(np.asarray(p["bias"]))
+        elif kind == "depthwise":
+            kh, kw, _one, cout = np.asarray(p["weight"]).shape
+            mult = klayer.get_config().get("depth_multiplier", 1)
+            w = [np.asarray(p["weight"]).reshape(kh, kw, cout // mult, mult)]
+            if use_bias:
+                w.append(np.asarray(p["bias"]))
+        elif kind == "bn":
+            w = [np.asarray(p["weight"]), np.asarray(p["bias"]),
+                 np.asarray(s["running_mean"]), np.asarray(s["running_var"])]
+        elif kind == "ln":
+            w = [np.asarray(p["weight"]), np.asarray(p["bias"])]
+        elif kind == "embedding":
+            w = [np.asarray(p["weight"])]
+        elif kind in ("lstm", "gru"):
+            w = _rnn_weights(kind, p, use_bias)
+        elif kind in ("bilstm", "bigru"):
+            inner = kind[2:]
+            w = (_rnn_weights(inner, p["fwd"], use_bias)
+                 + _rnn_weights(inner, p["bwd"], use_bias))
+        elif kind == "prelu":
+            cur = klayer.get_weights()[0]
+            w = [np.asarray(p["alpha"]).reshape(cur.shape)]
+        else:  # pragma: no cover
+            raise ValueError(f"unknown export kind {kind}")
+        klayer.set_weights(w)
+
+
+# ---------------------------------------------------------------------------
+# optimizer / loss mapping (keras compile() objects -> native)
+# ---------------------------------------------------------------------------
+
+def convert_keras_optimizer(kopt):
+    """keras.optimizers.* -> native OptimMethod."""
+    from bigdl_tpu.optim import optim_method as OM
+
+    name = type(kopt).__name__
+    lr = float(np.asarray(kopt.learning_rate))
+    wd = float(kopt.weight_decay or 0.0) if hasattr(kopt, "weight_decay") \
+        else 0.0
+    if name == "SGD":
+        return OM.SGD(learning_rate=lr,
+                      momentum=float(np.asarray(
+                          getattr(kopt, "momentum", 0.0))),
+                      weight_decay=wd, nesterov=bool(
+                          getattr(kopt, "nesterov", False)))
+    if name == "AdamW" or (name == "Adam" and wd):
+        return OM.AdamWeightDecay(
+            learning_rate=lr, beta1=float(kopt.beta_1),
+            beta2=float(kopt.beta_2), epsilon=float(kopt.epsilon),
+            weight_decay=wd)
+    if name == "Adam":
+        return OM.Adam(learning_rate=lr, beta1=float(kopt.beta_1),
+                       beta2=float(kopt.beta_2), epsilon=float(kopt.epsilon))
+    if name == "RMSprop":
+        return OM.RMSprop(learning_rate=lr, decay_rate=float(kopt.rho),
+                          epsilon=float(kopt.epsilon))
+    if name == "Adagrad":
+        return OM.Adagrad(learning_rate=lr)
+    if name == "Adadelta":
+        return OM.Adadelta(learning_rate=lr, decay_rate=float(kopt.rho),
+                           epsilon=float(kopt.epsilon))
+    raise NotImplementedError(f"no mapping for keras optimizer {name}")
+
+
+class _ProbNLL:
+    """NLL over PROBABILITIES (keras from_logits=False models end in
+    softmax) — log + ClassNLL, matching sparse_categorical_crossentropy."""
+
+    def __init__(self):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+        self._nll = ClassNLLCriterion()
+
+    def forward(self, output, target):
+        import jax.numpy as jnp
+
+        return self._nll.forward(jnp.log(jnp.maximum(output, 1e-12)), target)
+
+    def __call__(self, output, target):
+        return self.forward(output, target)
+
+
+def convert_keras_loss(kloss):
+    """keras loss (string or object) -> native criterion."""
+    from bigdl_tpu.nn import criterion as C
+    from bigdl_tpu.nn import criterion_extra as CE
+
+    if isinstance(kloss, str):
+        name = kloss
+        from_logits = False
+    else:
+        name = type(kloss).__name__
+        from_logits = bool(getattr(kloss, "from_logits", False))
+        # keras serializes config on the instance for the functional form
+        if hasattr(kloss, "get_config"):
+            try:
+                from_logits = bool(
+                    kloss.get_config().get("from_logits", from_logits))
+            except Exception:
+                pass
+    key = name.lower()
+    if key in ("sparsecategoricalcrossentropy",
+               "sparse_categorical_crossentropy"):
+        return C.CrossEntropyCriterion() if from_logits else _ProbNLL()
+    if key in ("categoricalcrossentropy", "categorical_crossentropy"):
+        if from_logits:
+            raise NotImplementedError(
+                "categorical_crossentropy(from_logits=True); use the sparse "
+                "variant or probabilities")
+        return CE.CategoricalCrossEntropy()
+    if key in ("meansquarederror", "mse", "mean_squared_error"):
+        return C.MSECriterion()
+    if key in ("meanabsoluteerror", "mae", "mean_absolute_error"):
+        return C.AbsCriterion()
+    if key in ("binarycrossentropy", "binary_crossentropy"):
+        return C.BCEWithLogitsCriterion() if from_logits else C.BCECriterion()
+    if key in ("huber",):
+        return C.SmoothL1Criterion()
+    if key in ("kldivergence", "kl_divergence"):
+        return CE.KullbackLeiblerDivergenceCriterion()
+    if key in ("poisson",):
+        return CE.PoissonCriterion()
+    raise NotImplementedError(f"no mapping for keras loss {name}")

@@ -843,3 +843,38 @@ _ACT_EXPORT = {
     "Negative": "Neg", "Floor": "Floor", "Ceil": "Ceil", "Sign": "Sign",
     "Sin": "Sin", "Cos": "Cos",
 }
+
+
+def from_tf_function(fn, input_signature=None):
+    """Live-trace a ``tf.function`` (or a callable taking tf tensors, e.g. a
+    keras model's call) into the GraphDef importer: concrete function →
+    variables frozen to constants → serialized GraphDef → ``load_tf_graph``.
+
+    Reference analog: TFNet loading frozen TF graphs for inference
+    (``scala/orca/.../net/TFNet`` ⚠, SURVEY.md §3.2).  The structural keras
+    converter (``utils/keras_convert.from_tf_keras``) is the TRAINING path;
+    this one covers arbitrary traced TF computations for inference.
+
+    ``input_signature``: list of ``tf.TensorSpec`` (batch dim may be
+    concrete) — required unless ``fn`` is already a concrete function.
+    Returns ``(model, variables)``.
+    """
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    if not isinstance(fn, tf.types.experimental.ConcreteFunction):
+        wrapped = fn if isinstance(fn, tf.types.experimental.PolymorphicFunction) \
+            else tf.function(fn)
+        if input_signature is None:
+            raise ValueError("from_tf_function needs input_signature "
+                             "(list of tf.TensorSpec)")
+        fn = wrapped.get_concrete_function(*input_signature)
+    frozen = convert_variables_to_constants_v2(fn)
+    gdef = frozen.graph.as_graph_def()
+    shapes = {}
+    for t in frozen.inputs:
+        name = t.name.split(":")[0]
+        if t.shape.rank is not None:
+            shapes[name] = [d if d is not None else 1 for d in t.shape]
+    return load_tf_graph(gdef.SerializeToString(), input_shapes=shapes)

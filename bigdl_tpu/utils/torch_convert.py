@@ -379,9 +379,9 @@ def from_torch_module(tmodule, example_input=None):
                 ss, vs = _meta_shape(size_src), _meta_shape(src)
                 return ss is not None and vs is not None and ss[0] == vs[0]
             src_shape = _meta_shape(node.args[0])
-            if src_shape is not None and first != src_shape[0]:
-                return False
-            return True
+            # without shape metadata the batch-dim check cannot run — fall
+            # through to the unsupported-node error (pass example_input)
+            return src_shape is not None and first == src_shape[0]
         return False
 
     def _consumed_by_flatten(node):

@@ -293,3 +293,13 @@ def test_epoch_schedule_gap_carries_previous_regime():
     # before the first regime: base lr
     sched2 = EpochSchedule([(3, 5, 0.5)], steps_per_epoch=10)
     assert float(sched2(1.0, 0)) == pytest.approx(1.0)    # epoch 1
+
+
+def test_epoch_schedule_accepts_unsorted_regimes():
+    """Regimes given out of start-epoch order must still resolve correctly
+    (the reference accepts any order)."""
+    from bigdl_tpu.optim import EpochSchedule
+
+    sched = EpochSchedule([(5, 8, 0.01), (1, 2, 0.1)], steps_per_epoch=10)
+    assert float(sched(1.0, 10)) == pytest.approx(0.1)    # epoch 2
+    assert float(sched(1.0, 55)) == pytest.approx(0.01)   # epoch 6
