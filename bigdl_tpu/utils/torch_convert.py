@@ -16,7 +16,8 @@ Conventions/limits (raise with a clear message otherwise):
 - supported leaves: Conv1d/2d, ConvTranspose2d, Linear, BatchNorm1d/2d,
   GroupNorm, LayerNorm, Embedding, PReLU, activations, pooling
   (Max/Avg/AdaptiveAvg(1)), Flatten, Dropout, MultiheadAttention
-  (batch_first), LSTM/GRU (batch_first, single layer, unidirectional).
+  (batch_first), LSTM/GRU (batch_first; any num_layers, bidirectional,
+  inter-layer dropout — converted as a chain of scan layers).
 - supported graph ops: +, *, cat, flatten/view(b,-1), mean over spatial,
   relu/gelu/sigmoid/tanh/softmax, getitem(0) on MHA/LSTM outputs.
 """
@@ -159,38 +160,57 @@ def _mha(tm):
     return layer, p, {}
 
 
-def _lstm(tm):
-    if not tm.batch_first or tm.num_layers != 1 or tm.bidirectional:
-        raise NotImplementedError(
-            "LSTM conversion supports batch_first single-layer "
-            "unidirectional")
-    layer = N.LSTM(tm.input_size, tm.hidden_size, return_sequences=True)
-    p = {"w_in": jnp.asarray(_np(tm.weight_ih_l0).T),
-         "w_rec": jnp.asarray(_np(tm.weight_hh_l0).T),
-         "bias": jnp.asarray(_np(tm.bias_ih_l0) + _np(tm.bias_hh_l0))}
-    return layer, p, {}
+def _rnn_dir_params(tm, kind, k, suffix):
+    """Params of torch layer k, one direction.  Gate orders match ours
+    exactly (torch LSTM i,f,g,o; torch GRU r,z,n), and torch's GRU
+    candidate form ``tanh(x_n + b_in + r*(W_hn h + b_hn))`` is precisely
+    our reset-after recurrence with the ``bias_rec`` recurrent bias."""
+    w_ih = _np(getattr(tm, f"weight_ih_l{k}{suffix}"))
+    w_hh = _np(getattr(tm, f"weight_hh_l{k}{suffix}"))
+    p = {"w_in": jnp.asarray(w_ih.T), "w_rec": jnp.asarray(w_hh.T)}
+    if tm.bias:
+        b_ih = _np(getattr(tm, f"bias_ih_l{k}{suffix}"))
+        b_hh = _np(getattr(tm, f"bias_hh_l{k}{suffix}"))
+        if kind == "GRU":
+            p["bias"] = jnp.asarray(b_ih)
+            p["bias_rec"] = jnp.asarray(b_hh)
+        else:  # LSTM: both biases are additive outside every gate
+            p["bias"] = jnp.asarray(b_ih + b_hh)
+    else:
+        p["bias"] = jnp.zeros((w_ih.shape[0],))
+        if kind == "GRU":
+            p["bias_rec"] = jnp.zeros((w_ih.shape[0],))
+    return p
 
 
-def _gru(tm):
-    if not tm.batch_first or tm.num_layers != 1 or tm.bidirectional:
-        raise NotImplementedError(
-            "GRU conversion supports batch_first single-layer unidirectional")
-    b_hh = _np(tm.bias_hh_l0)
-    h = tm.hidden_size
-    if np.abs(b_hh[2 * h:]).max() > 1e-6:
-        # our GRU folds ONE bias outside the reset gate; torch's b_hn sits
-        # inside r*(...) — only exactly convertible when b_hn == 0
-        raise NotImplementedError(
-            "GRU with non-zero recurrent candidate bias b_hn cannot be "
-            "converted exactly (bias placement differs); zero bias_hh_l0's "
-            "last third or retrain")
-    bias = _np(tm.bias_ih_l0).copy()
-    bias[:2 * h] += b_hh[:2 * h]   # r,z biases are additive outside the gate
-    layer = N.GRU(tm.input_size, tm.hidden_size, return_sequences=True)
-    p = {"w_in": jnp.asarray(_np(tm.weight_ih_l0).T),
-         "w_rec": jnp.asarray(_np(tm.weight_hh_l0).T),
-         "bias": jnp.asarray(bias)}
-    return layer, p, {}
+def _rnn_chain(tm, kind):
+    """torch nn.LSTM/nn.GRU (any num_layers, optionally bidirectional) →
+    list of (our_layer, params, tag) chained in sequence.  ``tag`` keys the
+    export back to the torch ``weight_*_l{k}[_reverse]`` names."""
+    if not tm.batch_first:
+        raise NotImplementedError(f"{kind} conversion needs batch_first=True")
+    cls = N.LSTM if kind == "LSTM" else N.GRU
+    steps = []
+    for k in range(tm.num_layers):
+        d_in = tm.input_size if k == 0 else \
+            tm.hidden_size * (2 if tm.bidirectional else 1)
+        if tm.dropout and k > 0:
+            # torch applies dropout to the OUTPUT of every layer but the
+            # last, i.e. before each subsequent layer's input
+            steps.append((N.Dropout(tm.dropout), {}, None))
+        if tm.bidirectional:
+            fwd = cls(d_in, tm.hidden_size, return_sequences=True)
+            bwd = cls(d_in, tm.hidden_size, return_sequences=True,
+                      go_backwards=True)
+            layer = N.BiRecurrent(fwd, bwd, merge="concat")
+            p = {"fwd": _rnn_dir_params(tm, kind, k, ""),
+                 "bwd": _rnn_dir_params(tm, kind, k, "_reverse")}
+            steps.append((layer, p, f"Bi{kind}@l{k}"))
+        else:
+            layer = cls(d_in, tm.hidden_size, return_sequences=True)
+            steps.append((layer, _rnn_dir_params(tm, kind, k, ""),
+                          f"{kind}@l{k}"))
+    return steps
 
 
 def _prelu(tm):
@@ -235,8 +255,6 @@ _SIMPLE = {
     "Embedding": _embedding,
     "PReLU": _prelu,
     "MultiheadAttention": _mha,
-    "LSTM": _lstm,
-    "GRU": _gru,
     "MaxPool2d": lambda tm: _pool2d(tm, N.MaxPool2D),
     "AvgPool2d": lambda tm: _pool2d(tm, N.AvgPool2D),
 }
@@ -249,7 +267,7 @@ class _ConvertTracer:
     def build(self, tmodule):
         import torch.fx as fx
 
-        leaf_names = set(_SIMPLE) | {"AdaptiveAvgPool2d"}
+        leaf_names = set(_SIMPLE) | {"AdaptiveAvgPool2d", "LSTM", "GRU"}
 
         class T(fx.Tracer):
             def is_leaf_module(self, m, qualname):
@@ -430,6 +448,15 @@ def from_torch_module(tmodule, example_input=None):
                 emit(node, N.GlobalAvgPool2D(), [sym[src_nodes[0]]])
                 flat_already.add(node)
                 continue
+            if tname in ("LSTM", "GRU"):
+                kn = sym[src_nodes[0]]
+                for layer, p, tag in _rnn_chain(tm, tname):
+                    kn = layer(kn)
+                    if p:
+                        params[kn.name] = p
+                        export_map.append((kn.name, node.target, tag, None))
+                sym[node] = kn
+                continue
             if tname not in _SIMPLE:
                 raise NotImplementedError(
                     f"no conversion for torch module {tname} "
@@ -521,12 +548,18 @@ def from_torch_module(tmodule, example_input=None):
                 src = node.args[0]
                 tm_name = (type(gm.get_submodule(src.target)).__name__
                            if src.op == "call_module" else "")
-                if node.args[1] == 0 and tm_name in ("LSTM", "GRU",
-                                                     "MultiheadAttention"):
+                idx = node.args[1]
+                if idx == 0 and tm_name in ("LSTM", "GRU",
+                                            "MultiheadAttention"):
                     sym[node] = sym[src]   # our layer returns the seq output
+                elif (isinstance(idx, tuple) and len(idx) == 2
+                        and idx[0] == slice(None)
+                        and isinstance(idx[1], int)):
+                    # y[:, i] — timestep select (e.g. last RNN output)
+                    emit(node, N.Select(1, idx[1]), [sym[src]])
                 else:
                     raise NotImplementedError(
-                        f"getitem[{node.args[1]}] on {src}")
+                        f"getitem[{idx}] on {src}")
             elif is_flatten_to_vec(node):
                 handle_flatten(node, node.args[0])
             elif fn in (torch.relu, torch.nn.functional.relu):
@@ -668,11 +701,29 @@ def export_state_dict(model, variables) -> Dict[str, Any]:
             out[f"{qual}.in_proj_bias"] = t(b)
             out[f"{qual}.out_proj.weight"] = t(np.asarray(p["wo"]).T)
             out[f"{qual}.out_proj.bias"] = t(p["bo"])
-        elif tname in ("LSTM", "GRU"):
-            out[f"{qual}.weight_ih_l0"] = t(np.asarray(p["w_in"]).T)
-            out[f"{qual}.weight_hh_l0"] = t(np.asarray(p["w_rec"]).T)
-            out[f"{qual}.bias_ih_l0"] = t(p["bias"])
-            out[f"{qual}.bias_hh_l0"] = torch.zeros_like(t(p["bias"]))
+        elif "@l" in tname and tname.split("@")[0].lstrip("Bi") in (
+                "LSTM", "GRU"):
+            base, lk = tname.split("@l")
+            kind = base.lstrip("Bi")
+
+            def put(dp, suffix, lk=lk, kind=kind):
+                out[f"{qual}.weight_ih_l{lk}{suffix}"] = t(
+                    np.asarray(dp["w_in"]).T)
+                out[f"{qual}.weight_hh_l{lk}{suffix}"] = t(
+                    np.asarray(dp["w_rec"]).T)
+                if kind == "GRU":
+                    out[f"{qual}.bias_ih_l{lk}{suffix}"] = t(dp["bias"])
+                    out[f"{qual}.bias_hh_l{lk}{suffix}"] = t(dp["bias_rec"])
+                else:
+                    out[f"{qual}.bias_ih_l{lk}{suffix}"] = t(dp["bias"])
+                    out[f"{qual}.bias_hh_l{lk}{suffix}"] = \
+                        torch.zeros_like(t(dp["bias"]))
+
+            if base.startswith("Bi"):
+                put(p["fwd"], "")
+                put(p["bwd"], "_reverse")
+            else:
+                put(p, "")
         else:  # pragma: no cover — emitters above cover every param leaf
             raise NotImplementedError(f"export for {tname}")
     return out
