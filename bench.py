@@ -107,9 +107,9 @@ def _run_bench(platform: str) -> dict:
     mesh = build_mesh(MeshSpec(data=n_chips), devices=devices)
 
     if on_tpu:
-        # batch 768/chip: measured knee of the throughput curve on this
-        # chip (128→2.6k, 256→5.3k, 512→9.6k, 768→11.7-12.1k img/s/chip);
-        # large per-chip batch keeps the MXU systolic array full
+        # batch 768/chip: knee of the round-1 batch curve (whose absolute
+        # numbers are unverified — docs/performance.md); large per-chip
+        # batch keeps the MXU systolic array full
         batch_per_chip, hw, steps = 768, 224, 10
     else:  # CPU smoke so bench.py always emits a line
         batch_per_chip, hw, steps = 4, 64, 3
@@ -174,6 +174,9 @@ def _run_bench(platform: str) -> dict:
         "value": round(img_per_sec_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 4),
+        # the denominator is a pinned nominal target (reference published
+        # nothing — BASELINE.json "published": {}), not a measured baseline
+        "baseline_source": "nominal",
         "batch_per_chip": batch_per_chip,
         "image_size": hw,
         "steps": steps,
@@ -187,6 +190,11 @@ def _run_bench(platform: str) -> dict:
         "peak_bf16_flops": peak,
         "mfu": mfu,
     }
+    if mfu is not None and mfu > 1.0:
+        # >100% model-flop utilization is physically impossible: either the
+        # device_kind→peak mapping is wrong (e.g. misrecorded hardware) or
+        # the measurement is — flag the row rather than publishing it
+        out["suspect"] = True
 
     if on_tpu and os.environ.get("BENCH_SWEEP") == "1":
         sweep = {}

@@ -204,31 +204,73 @@ class RoiPooling(Module):
 
 class CTCCriterion(Criterion):
     """Connectionist temporal classification loss — reference
-    ``nn/CTCCriterion.scala`` (warp-CTC backed there; optax forward-backward
-    here).
+    ``nn/CTCCriterion.scala`` (warp-CTC backed there; a native alpha
+    (forward) recursion as one ``lax.scan`` over time here — the backward
+    pass is jax autodiff through the scan, which IS the beta recursion).
 
     ``forward(logits, target)`` with logits (B, T, C) UNnormalized and
     ``target = (labels, input_lengths, label_lengths)``; labels (B, S)
     0-padded, blank id = ``blank`` (default 0, so real labels start at 1
     when blank is 0)."""
 
+    _NEG_INF = -1e30
+
     def __init__(self, blank: int = 0, size_average: bool = True):
         self.blank = blank
         self.size_average = size_average
 
     def forward(self, input, target):
-        import optax
-
         labels, input_lengths, label_lengths = target
-        b, t, _ = input.shape
-        s = labels.shape[1]
-        logit_pad = (jnp.arange(t)[None, :]
-                     >= jnp.asarray(input_lengths)[:, None]).astype(jnp.float32)
-        label_pad = (jnp.arange(s)[None, :]
-                     >= jnp.asarray(label_lengths)[:, None]).astype(jnp.float32)
-        per_example = optax.ctc_loss(input, logit_pad,
-                                     jnp.asarray(labels).astype(jnp.int32),
-                                     label_pad, blank_id=self.blank)
+        labels = jnp.asarray(labels).astype(jnp.int32)
+        input_lengths = jnp.asarray(input_lengths)
+        label_lengths = jnp.asarray(label_lengths)
+        b, t_max, _c = input.shape
+        s_max = labels.shape[1]
+        neg_inf = self._NEG_INF
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+
+        # extended label sequence z = [blank, l1, blank, ..., lS, blank]
+        ext = jnp.full((b, 2 * s_max + 1), self.blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(labels)
+        # skip transition s-2 -> s allowed only onto a non-blank that
+        # differs from the symbol two back (CTC repeat rule)
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :-2]
+        can_skip = (ext != self.blank) & (ext != ext_m2)
+
+        e0 = jnp.take_along_axis(logp[:, 0], ext, axis=1)
+        alpha0 = jnp.full((b, 2 * s_max + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+        if s_max > 0:
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(label_lengths >= 1, e0[:, 1], neg_inf))
+
+        def step(alpha, inp):
+            logp_t, t = inp
+            e = jnp.take_along_axis(logp_t, ext, axis=1)
+            prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                            constant_values=neg_inf)[:, :-1]
+            prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                            constant_values=neg_inf)[:, :-2]
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2) + e
+            # beyond this example's input length the lattice is frozen
+            active = (t < input_lengths)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = jax.lax.scan(
+            step, alpha0,
+            (jnp.swapaxes(logp, 0, 1)[1:], jnp.arange(1, t_max)))
+
+        # log-likelihood ends at ext positions L-1 (final blank) and L-2
+        # (final label), L = 2*label_len + 1
+        ell = 2 * label_lengths + 1
+        last = jnp.take_along_axis(alpha, (ell - 1)[:, None], axis=1)[:, 0]
+        last2 = jnp.where(
+            ell >= 2,
+            jnp.take_along_axis(alpha, jnp.maximum(ell - 2, 0)[:, None],
+                                axis=1)[:, 0],
+            neg_inf)
+        per_example = -jnp.logaddexp(last, last2)
         return _reduce(per_example, self.size_average)
 
 
