@@ -196,6 +196,17 @@ def _run_bench(platform: str) -> dict:
         # the measurement is — flag the row rather than publishing it
         out["suspect"] = True
 
+    if on_tpu:
+        # host input-pipeline sustain rate next to the device number
+        # (SURVEY §8 hard part #2): loader_img_per_sec * host_cores is the
+        # budget; if it can't cover value, training is input-bound host-fed
+        try:
+            from bench_loader import measure_loader
+
+            out["loader"] = measure_loader(batch=batch_per_chip, n_batches=2)
+        except Exception as e:  # loader bench must never sink the TPU row
+            out["loader"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     if on_tpu and os.environ.get("BENCH_SWEEP") == "1":
         sweep = {}
         for b in (128, 256, 512):

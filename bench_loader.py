@@ -1,0 +1,100 @@
+"""Input-pipeline sustain bench — prints ONE JSON line (host only).
+
+SURVEY.md §8 hard part #2: at scale the host CPU augmentation pipeline must
+sustain the device's consumption rate or training is input-bound.  This
+measures the loader-only throughput (no device): the native C++ threaded
+pipeline (``native/bigdl_tpu_io.cpp``) running the ResNet-50 training
+transform — bilinear resize 256 → crop 224 → hflip → normalize — on
+batch-768 geometry, plus the pure-python fallback for comparison.
+
+``loader_img_per_sec`` must exceed the device-resident throughput claim in
+``BENCH_r*.json`` for the headline number to be sustainable host-fed; the
+bench.py TPU worker embeds a short version of this measurement next to its
+throughput fields.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def measure_loader(batch: int = 768, n_batches: int = 4,
+                   src_hw: int = 300, out_hw: int = 224,
+                   threads=None, seed: int = 0):
+    """Returns dict with native (and python-fallback) loader img/s at the
+    ResNet-50 train geometry."""
+    from bigdl_tpu.native import lib as nat
+
+    rs = np.random.RandomState(seed)
+    # a pool of distinct source images, reused across batches (decode is
+    # upstream of this pipeline; geometry is what's being measured)
+    pool = rs.randint(0, 255, (64, src_hw, src_hw, 3), np.uint8)
+    idx = rs.randint(0, len(pool), batch)
+    images = [pool[i] for i in idx]
+    mean = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+    std = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+
+    import os
+
+    out = {"batch": batch, "out_hw": out_hw, "src_hw": src_hw,
+           "native_available": nat.available(),
+           # loader scales ~linearly in worker threads; a TPU-VM host has
+           # O(100) cores where this sandbox may have 1 — img/s must be
+           # read against host_cores
+           "host_cores": os.cpu_count()}
+
+    def rand_geom(rng):
+        crops = [(rng.randint(0, 256 - out_hw + 1),
+                  rng.randint(0, 256 - out_hw + 1)) for _ in range(batch)]
+        flips = rng.rand(batch) < 0.5
+        return crops, list(flips)
+
+    if nat.available():
+        pipe = nat.BatchPipeline(num_threads=threads)
+        try:
+            crops, flips = rand_geom(rs)
+            pipe.process_batch(images, (out_hw, out_hw), mean, std,
+                               resize_hw=(256, 256), crops=crops,
+                               flips=flips)  # warmup
+            t0 = time.perf_counter()
+            for b in range(n_batches):
+                crops, flips = rand_geom(rs)
+                y = pipe.process_batch(images, (out_hw, out_hw), mean, std,
+                                       resize_hw=(256, 256), crops=crops,
+                                       flips=flips)
+            dt = time.perf_counter() - t0
+            assert y.shape == (batch, out_hw, out_hw, 3), y.shape
+            out["loader_img_per_sec"] = round(batch * n_batches / dt, 1)
+        finally:
+            pipe.close()
+
+    # single-thread python reference (1 small batch — it is slow)
+    t0 = time.perf_counter()
+    small = images[:64]
+    for img in small:
+        a = nat.resize_bilinear(img, 256, 256) if nat.available() else img
+        y0 = rs.randint(0, 256 - out_hw + 1)
+        x0 = rs.randint(0, 256 - out_hw + 1)
+        c = a[y0:y0 + out_hw, x0:x0 + out_hw]
+        if rs.rand() < 0.5:
+            c = c[:, ::-1]
+        (np.asarray(c, np.float32) - np.asarray(mean)) / np.asarray(std)
+    out["python_ref_img_per_sec"] = round(
+        len(small) / (time.perf_counter() - t0), 1)
+    return out
+
+
+def main():
+    r = measure_loader()
+    r.update({
+        "metric": "resnet50_loader_throughput",
+        "value": r.get("loader_img_per_sec", r["python_ref_img_per_sec"]),
+        "unit": "images/sec/host",
+        "vs_baseline": None,
+    })
+    print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

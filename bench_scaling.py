@@ -77,6 +77,10 @@ def main():
         "value": speedup["8"],
         "unit": "speedup_vs_1dev",
         "vs_baseline": round(speedup["8"] / 8.0, 4),
+        # virtual devices are threads of ONE host: with host_cores=1 no
+        # real parallel speedup is possible — the artifact then validates
+        # the sharded program + collective accounting, not the curve
+        "host_cores": os.cpu_count(),
         "global_batch": global_batch,
         "per_mesh": per_mesh,
         "speedup": speedup,
