@@ -4,19 +4,32 @@ Reference analog (unverified — mount empty): ``python/orca/src/bigdl/orca/
 automl/`` (SURVEY.md §3.3): ``AutoEstimator.fit(data, search_space,
 n_sampling)`` running trials on Ray Tune with the ``hp`` search-space DSL.
 
-TPU-native redesign: trials run sequentially in-process — a TPU slice is
-gang-scheduled to ONE program, so concurrent trials would fight for the
-chips; sequential trials each get the whole mesh (and jit caching makes
-same-shape trials cheap).  The ``hp`` DSL and the Searcher/AutoEstimator
-surface mirror the reference so AutoTS code ports unchanged.
+TPU-native redesign of Ray Tune's actor concurrency (three modes):
+
+- **sequential** (default): one trial at a time with the WHOLE mesh — the
+  right mode when each trial is itself a distributed (sharded) train step:
+  a TPU slice is gang-scheduled to one program, and jit caching makes
+  same-shape trials cheap.
+- **per-device parallel** (``run(..., parallel=k | "auto")``): waves of k
+  concurrent trials on a thread pool, each pinned to its own device via
+  ``trial_device(config)`` — the actor-pool analog for single-device
+  trials on a multi-chip mesh (XLA releases the GIL during execution).
+  ASHA rungs run their members concurrently.
+- **vmapped gang** (``vmap_sweep``): numeric-hyperparameter configs
+  stacked and evaluated inside ONE jitted, device-sharded vmap — the
+  fully XLA-native sweep when the trial is a pure jax function with
+  config-independent shapes.
+
+The ``hp`` DSL and the Searcher/AutoEstimator surface mirror the reference
+so AutoTS code ports unchanged.
 """
 
 from bigdl_tpu.automl import hp
 from bigdl_tpu.automl.auto_estimator import AutoEstimator
 from bigdl_tpu.automl.search import (GridSearcher, RandomSearcher, Searcher,
                                      SuccessiveHalvingSearcher, TPESearcher,
-                                     TrialResult)
+                                     TrialResult, trial_device, vmap_sweep)
 
 __all__ = ["hp", "AutoEstimator", "Searcher", "RandomSearcher",
            "GridSearcher", "SuccessiveHalvingSearcher", "TPESearcher",
-           "TrialResult"]
+           "TrialResult", "trial_device", "vmap_sweep"]

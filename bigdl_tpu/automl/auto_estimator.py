@@ -32,7 +32,8 @@ class AutoEstimator:
 
     def fit(self, data, validation_data=None, *, search_space: Dict[str, Any],
             n_sampling: int = 8, epochs: int = 1, batch_size: Any = 32,
-            searcher: Optional[Searcher] = None) -> "AutoEstimator":
+            searcher: Optional[Searcher] = None,
+            parallel=None) -> "AutoEstimator":
         """data: (x, y) arrays or anything Estimator.fit accepts.  The
         sampled config may carry 'batch_size'/'epochs' overrides."""
         searcher = searcher or RandomSearcher(mode=self.mode)
@@ -59,7 +60,8 @@ class AutoEstimator:
             stats = est.evaluate(val, [make_method(est)])
             return float(list(stats.values())[0]), est
 
-        self.best_result = searcher.run(trial, search_space, n_sampling)
+        self.best_result = searcher.run(trial, search_space, n_sampling,
+                                        parallel=parallel)
         self.best_estimator = self.best_result.artifacts
         self.searcher = searcher
         return self

@@ -1,9 +1,24 @@
-"""Trial searchers — reference ``orca/automl/search/`` (Ray-Tune-backed
-SearchEngine; here in-process sequential trials, see package docstring)."""
+"""Trial searchers — reference ``orca/automl/search/`` (Ray Tune runs
+trials as concurrent actors there).  Here trials run in-process with two
+concurrency modes replacing the actor pool:
+
+- ``parallel=k`` on ``run``: waves of ``k`` trials on a thread pool, each
+  trial pinned to its own device of the mesh via ``trial_device`` (XLA
+  releases the GIL during execution, so k single-device trials execute
+  concurrently on k chips — the per-device-trial mode).  Adaptive
+  searchers (TPE) propose between waves, the standard batched form;
+  successive halving parallelizes within each rung.
+- ``vmap_sweep``: numeric-axis configs stacked and evaluated inside ONE
+  jitted, device-sharded vmap — the gang mode for trials expressible as a
+  pure jax function (shapes must agree across configs).
+"""
 
 import dataclasses
+import threading
 import time
 import traceback
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -12,6 +27,21 @@ from bigdl_tpu.automl import hp as hp_mod
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger(__name__)
+
+
+@contextmanager
+def trial_device(config: Dict[str, Any]):
+    """Pin this trial's computations to the device assigned by the parallel
+    runner (``config["_device_index"]``); no-op for sequential runs."""
+    import jax
+
+    idx = config.get("_device_index")
+    if idx is None:
+        yield None
+        return
+    dev = jax.devices()[idx % jax.device_count()]
+    with jax.default_device(dev):
+        yield dev
 
 
 @dataclasses.dataclass
@@ -34,10 +64,12 @@ class Searcher:
     def _configs(self, space, n_sampling):
         raise NotImplementedError
 
+    _lock = None  # created lazily; Searcher instances are not shared wide
+
     def _run_one(self, trial_fn, config, sign) -> TrialResult:
         """Execute one trial: time it, unpack (metric, artifacts), convert
         failures into an inf-metric result (a bad config must not kill the
-        sweep).  Appends to self.results."""
+        sweep).  Appends to self.results (thread-safe)."""
         t0 = time.perf_counter()
         try:
             out = trial_fn(config)
@@ -58,24 +90,68 @@ class Searcher:
                               time.perf_counter() - t0,
                               error=traceback.format_exc())
             log.warning("trial failed: %s", res.error.splitlines()[-1])
-        self.results.append(res)
+        if self._lock is None:
+            self._lock = threading.Lock()
+        with self._lock:
+            self.results.append(res)
         return res
 
+    def _run_wave(self, trial_fn, configs, sign, parallel) -> List[TrialResult]:
+        """Run a batch of trials, concurrently when parallel > 1; each slot
+        carries a device assignment for ``trial_device``."""
+        if parallel <= 1 or len(configs) <= 1:
+            return [self._run_one(trial_fn, c, sign) for c in configs]
+        cfgs = [dict(c, _device_index=i % parallel)
+                for i, c in enumerate(configs)]
+        with ThreadPoolExecutor(max_workers=parallel) as ex:
+            return list(ex.map(
+                lambda c: self._run_one(trial_fn, c, sign), cfgs))
+
+    @staticmethod
+    def _resolve_parallel(parallel) -> int:
+        if parallel in (None, 0, 1):
+            return 1
+        if parallel == "auto":
+            import jax
+
+            return jax.device_count()
+        return int(parallel)
+
     def run(self, trial_fn: Callable[[Dict], Any], space: Dict[str, Any],
-            n_sampling: int = 8) -> TrialResult:
+            n_sampling: int = 8, parallel=None) -> TrialResult:
+        """``parallel``: None/1 = sequential; k = waves of k concurrent
+        trials (one per device); "auto" = one per local device.  Adaptive
+        searchers observe between waves (batched proposals)."""
         sign = 1.0 if self.mode == "min" else -1.0
+        par = self._resolve_parallel(parallel)
         best = None
-        for i, config in enumerate(self._configs(space, n_sampling)):
-            res = self._run_one(trial_fn, config, sign)
-            if res.error is None and (
-                    best is None or sign * res.metric < sign * best.metric):
-                if best is not None:
-                    best.artifacts = None  # only the winner's model is kept
-                best = res
-            else:
-                res.artifacts = None
-            log.info("trial %d/%s: metric=%s config=%s", i + 1,
-                     n_sampling, res.metric, config)
+        it = iter(self._configs(space, n_sampling))
+        done = 0
+        # n_sampling == 0 means "whatever _configs yields" (grid caps only
+        # when asked) — run until the generator is exhausted
+        limit = n_sampling if n_sampling else None
+        while limit is None or done < limit:
+            room = par if limit is None else min(par, limit - done)
+            wave = []
+            for _ in range(room):
+                try:
+                    wave.append(next(it))
+                except StopIteration:
+                    break
+            if not wave:
+                break
+            for res in self._run_wave(trial_fn, wave, sign, par):
+                done += 1
+                if res.error is None and (
+                        best is None
+                        or sign * res.metric < sign * best.metric):
+                    if best is not None:
+                        best.artifacts = None  # only the winner's model kept
+                    best = res
+                else:
+                    res.artifacts = None
+                log.info("trial %d/%s: metric=%s config=%s", done,
+                         n_sampling, res.metric, res.config)
         if best is None:
             raise RuntimeError("all trials failed; see results[*].error")
         return best
@@ -118,8 +194,10 @@ class SuccessiveHalvingSearcher(Searcher):
         self.max_budget = int(max_budget)
         self.budget_key = budget_key
 
-    def run(self, trial_fn, space, n_sampling: int = 9) -> TrialResult:
+    def run(self, trial_fn, space, n_sampling: int = 9,
+            parallel=None) -> TrialResult:
         sign = 1.0 if self.mode == "min" else -1.0
+        par = self._resolve_parallel(parallel)
         configs = [hp_mod.sample_space(space, self.rng)
                    for _ in range(n_sampling)]
         budget = self.min_budget
@@ -127,11 +205,12 @@ class SuccessiveHalvingSearcher(Searcher):
         best = None  # best of the HIGHEST rung reached — metrics at
         rung = 0     # different budgets are not comparable
         while True:
-            scored = []
-            for config in survivors:
-                cfg = dict(config, **{self.budget_key: budget})
-                res = self._run_one(trial_fn, cfg, sign)
-                scored.append((res, config))
+            # a rung is an independent batch: all its trials run
+            # concurrently (the reference's ASHA runs rung members as
+            # parallel Ray actors)
+            cfgs = [dict(c, **{self.budget_key: budget}) for c in survivors]
+            results = self._run_wave(trial_fn, cfgs, sign, par)
+            scored = list(zip(results, survivors))
             scored.sort(key=lambda rc: sign * rc[0].metric)
             for res, _ in scored[1:]:
                 res.artifacts = None
@@ -298,3 +377,80 @@ class TPESearcher(Searcher):
                 yield hp_mod.sample_space(space, self.rng)
             else:
                 yield self._propose(space)
+
+
+def vmap_sweep(fn: Callable[[Dict[str, Any]], Any], space: Dict[str, Any],
+               n_sampling: int = 8, mode: str = "min", seed: int = 0,
+               mesh=None):
+    """Gang-evaluate ``n_sampling`` configs inside ONE jitted vmap, sharded
+    over the mesh's data axis — the XLA-native replacement for a Ray Tune
+    actor pool when the trial is a pure jax function of its (numeric)
+    hyperparameters with config-independent shapes.
+
+    ``fn(config) -> scalar metric`` receives a config whose NUMERIC leaves
+    are traced scalars (Choice axes are not supported — shapes/branches
+    must not depend on the config).  Returns ``(best_config, best_metric,
+    all_metrics)``; each device evaluates ``n_sampling / n_devices``
+    configs in parallel.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    configs = [hp_mod.sample_space(space, rng) for _ in range(n_sampling)]
+
+    # stack numeric leaves -> a pytree of (n,) arrays; non-numeric leaves
+    # must be identical across configs (they become static closure values)
+    paths: List[tuple] = []
+
+    def walk(sp, path):
+        for k, v in sp.items():
+            if isinstance(v, dict):
+                walk(v, path + (k,))
+            elif isinstance(v, hp_mod.Sampler):
+                if isinstance(v, hp_mod.Choice):
+                    raise ValueError(
+                        "vmap_sweep: Choice axes are not vmappable (shape/"
+                        "branch-changing); use Searcher(parallel=...) for "
+                        "those")
+                paths.append(path + (k,))
+
+    walk(space, ())
+
+    def get(cfg, path):
+        for p in path:
+            cfg = cfg[p]
+        return cfg
+
+    def put(cfg, path, val):
+        out = dict(cfg)
+        node = out
+        for p in path[:-1]:
+            node[p] = dict(node[p])
+            node = node[p]
+        node[path[-1]] = val
+        return out
+
+    stacked = {path: jnp.asarray([get(c, path) for c in configs],
+                                 jnp.float32) for path in paths}
+
+    def one(leaf_vals):
+        cfg = configs[0]
+        for path, v in leaf_vals.items():
+            cfg = put(cfg, path, v)
+        return fn(cfg)
+
+    gang = jax.jit(jax.vmap(one))
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        sharding = NamedSharding(mesh, P(axis))
+        if n_sampling % mesh.devices.size == 0:
+            stacked = {k: jax.device_put(v, sharding)
+                       for k, v in stacked.items()}
+    metrics = np.asarray(jax.device_get(gang(stacked)), np.float64)
+    metrics = np.where(np.isfinite(metrics), metrics,
+                       np.inf if mode == "min" else -np.inf)
+    best_i = int(np.argmin(metrics) if mode == "min" else np.argmax(metrics))
+    return configs[best_i], float(metrics[best_i]), metrics
