@@ -51,11 +51,12 @@ from bigdl_tpu.nn.layers_misc import (
 )
 from bigdl_tpu.nn.rnn import (
     SimpleRNN, LSTM, LSTMPeephole, GRU, BiRecurrent, TimeDistributed,
-    RecurrentDecoder,
+    RecurrentDecoder, RnnCell, Recurrent, MultiRNNCell,
 )
 from bigdl_tpu.nn.decode import beam_search, greedy_decode, DecodeResult
 from bigdl_tpu.nn.attention import (
     MultiHeadAttention, PositionwiseFFN, TransformerLayer,
+    TransformerDecoderLayer, Transformer, Attention, FeedForwardNetwork,
     dot_product_attention, positional_encoding,
 )
 from bigdl_tpu.nn.criterion import (
@@ -65,11 +66,11 @@ from bigdl_tpu.nn.criterion import (
     ParallelCriterion, TimeDistributedCriterion,
 )
 from bigdl_tpu.nn.layers_tail import (
-    ActivityRegularization, BinaryThreshold, BinaryTreeLSTM, CrossProduct,
-    DenseToSparse, DetectionOutputFrcnn, DetectionOutputSSD, ExpandSize,
-    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, MaskedSelect,
-    PriorBox, Proposal, SequenceBeamSearch, SpatialConvolutionMap,
-    SpatialZeroPadding,
+    ActivityRegularization, Anchor, BinaryThreshold, BinaryTreeLSTM,
+    CrossProduct, DenseToSparse, DetectionOutputFrcnn, DetectionOutputSSD,
+    ExpandSize, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    MaskedSelect, PriorBox, Proposal, SequenceBeamSearch,
+    SpatialConvolutionMap, SpatialZeroPadding,
 )
 from bigdl_tpu.nn.criterion_extra import (
     MultiCriterion, MultiLabelSoftMarginCriterion, MultiMarginCriterion,

@@ -218,3 +218,153 @@ def positional_encoding(length: int, dim: int) -> jnp.ndarray:
     pe = pe.at[:, 0::2].set(jnp.sin(angle))
     pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : dim // 2]))
     return pe
+
+
+class TransformerDecoderLayer(Module):
+    """Pre-LN decoder block: causal self-attention, cross-attention over
+    encoder memory, FFN — the decoder half of reference
+    ``nn/Transformer.scala``'s translation mode."""
+
+    def __init__(self, hidden_size: int, num_heads: int, ffn_size: int = 0,
+                 dropout: float = 0.1, name=None):
+        super().__init__(name)
+        self.self_attn = MultiHeadAttention(hidden_size, num_heads,
+                                            attn_dropout=dropout, causal=True)
+        self.cross_attn = MultiHeadAttention(hidden_size, num_heads,
+                                             attn_dropout=dropout)
+        self.ffn = PositionwiseFFN(hidden_size, ffn_size or 4 * hidden_size,
+                                   dropout=dropout)
+        self.ln1 = LayerNorm(hidden_size)
+        self.ln2 = LayerNorm(hidden_size)
+        self.ln3 = LayerNorm(hidden_size)
+        self.dropout = Dropout(dropout)
+
+    def init(self, rng, x, memory):
+        ks = jax.random.split(rng, 6)
+        return {"params": {
+            "self_attn": self.self_attn.init(ks[0], x)["params"],
+            "cross_attn": self.cross_attn.init(ks[1], x, memory)["params"],
+            "ffn": self.ffn.init(ks[2], x)["params"],
+            "ln1": self.ln1.init(ks[3], x)["params"],
+            "ln2": self.ln2.init(ks[4], x)["params"],
+            "ln3": self.ln3.init(ks[5], x)["params"],
+        }, "state": EMPTY}
+
+    def forward(self, params, state, x, memory, training=False, rng=None,
+                memory_mask=None):
+        rs = (jax.random.split(rng, 3) if rng is not None else (None,) * 3)
+        h, _ = self.ln1.forward(params["ln1"], EMPTY, x)
+        a, _ = self.self_attn.forward(params["self_attn"], EMPTY, h,
+                                      training=training, rng=rs[0])
+        x = x + a
+        h, _ = self.ln2.forward(params["ln2"], EMPTY, x)
+        a, _ = self.cross_attn.forward(params["cross_attn"], EMPTY, h,
+                                       context=memory, training=training,
+                                       rng=rs[1], mask=memory_mask)
+        x = x + a
+        h, _ = self.ln3.forward(params["ln3"], EMPTY, x)
+        f, _ = self.ffn.forward(params["ffn"], EMPTY, h, training=training,
+                                rng=rs[2])
+        return x + f, EMPTY
+
+
+class Transformer(Module):
+    """Encoder-decoder transformer — reference ``nn/Transformer.scala``
+    (tensor2tensor lineage; the WMT Seq2Seq config in BASELINE.json).
+
+    Two modes, like the reference: ``mode="translation"`` —
+    ``forward(params, state, src_ids, tgt_ids)`` → (b, t_tgt, vocab)
+    logits; ``mode="lm"`` — ``forward(params, state, ids)`` → causal LM
+    logits.  Token embedding is scaled by sqrt(d) and shared with the
+    output projection (weight tying, as the reference does)."""
+
+    def __init__(self, vocab_size: int, hidden_size: int, num_heads: int,
+                 ffn_size: int = 0, num_layers: int = 2,
+                 dropout: float = 0.1, mode: str = "translation", name=None):
+        super().__init__(name)
+        if mode not in ("translation", "lm"):
+            raise ValueError(f"mode {mode!r}: translation | lm")
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.mode = mode
+        self.dropout = Dropout(dropout)
+        mk = (lambda causal=False: TransformerLayer(
+            hidden_size, num_heads, ffn_size, dropout, causal=causal))
+        self.encoder = [mk() for _ in range(num_layers)] \
+            if mode == "translation" else []
+        if mode == "translation":
+            self.decoder = [TransformerDecoderLayer(
+                hidden_size, num_heads, ffn_size, dropout)
+                for _ in range(num_layers)]
+        else:
+            self.decoder = [mk(causal=True) for _ in range(num_layers)]
+        self.ln_out = LayerNorm(hidden_size)
+
+    def _embed(self, params, ids):
+        e = jnp.take(params["embedding"], ids.astype(jnp.int32), axis=0)
+        e = e * jnp.sqrt(float(self.hidden_size))
+        return e + positional_encoding(ids.shape[1],
+                                       self.hidden_size)[None].astype(e.dtype)
+
+    def init(self, rng, *ids):
+        ks = jax.random.split(rng, 3 + len(self.encoder) + len(self.decoder))
+        d = self.hidden_size
+        params = {"embedding": jax.random.normal(
+            ks[0], (self.vocab_size, d)) * d ** -0.5}
+        x = self._embed(params, jnp.asarray(ids[0]))
+        ki = 1
+        for i, layer in enumerate(self.encoder):
+            params[f"enc{i}"] = layer.init(ks[ki], x)["params"]
+            ki += 1
+        if self.mode == "translation":
+            tgt = self._embed(params, jnp.asarray(ids[1]))
+            for i, layer in enumerate(self.decoder):
+                params[f"dec{i}"] = layer.init(ks[ki], tgt, x)["params"]
+                ki += 1
+        else:
+            for i, layer in enumerate(self.decoder):
+                params[f"dec{i}"] = layer.init(ks[ki], x)["params"]
+                ki += 1
+        params["ln_out"] = self.ln_out.init(ks[ki], x)["params"]
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, src, tgt=None, training=False,
+                rng=None):
+        n_rngs = len(self.encoder) + len(self.decoder) + 1
+        rs = (jax.random.split(rng, n_rngs) if rng is not None
+              else (None,) * n_rngs)
+        ri = 0
+        x = self._embed(params, src)
+        if rs[0] is not None:
+            x, _ = self.dropout.forward(EMPTY, EMPTY, x, training=training,
+                                        rng=rs[0])
+        ri = 1
+        for i, layer in enumerate(self.encoder):
+            x, _ = layer.forward(params[f"enc{i}"], EMPTY, x,
+                                 training=training, rng=rs[ri])
+            ri += 1
+        if self.mode == "translation":
+            if tgt is None:
+                raise ValueError("translation mode needs (src, tgt)")
+            h = self._embed(params, tgt)
+            for i, layer in enumerate(self.decoder):
+                h, _ = layer.forward(params[f"dec{i}"], EMPTY, h, x,
+                                     training=training, rng=rs[ri])
+                ri += 1
+        else:
+            h = x
+            for i, layer in enumerate(self.decoder):
+                h, _ = layer.forward(params[f"dec{i}"], EMPTY, h,
+                                     training=training, rng=rs[ri])
+                ri += 1
+        h, _ = self.ln_out.forward(params["ln_out"], EMPTY, h)
+        # weight-tied output projection
+        emb = cast_compute(params["embedding"])
+        logits = jnp.matmul(cast_compute(h), emb.T,
+                            preferred_element_type=jnp.float32)
+        return logits.astype(jnp.float32), EMPTY
+
+
+# reference ``nn/Attention.scala`` / ``nn/FeedForwardNetwork.scala`` names
+Attention = MultiHeadAttention
+FeedForwardNetwork = PositionwiseFFN

@@ -613,3 +613,25 @@ class DetectionOutputFrcnn(Module):
         return jnp.concatenate([
             (kl.astype(boxes.dtype) * valid)[:, None],
             (ks * valid)[:, None], kb * valid[:, None]], axis=-1), EMPTY
+
+
+class Anchor(Module):
+    """Anchor-grid generator as a layer — reference ``nn/Anchor.scala``
+    (Faster-RCNN RPN anchors).  Anchors depend only on static shapes, so
+    the grid is a host-side constant baked into the jitted program; the
+    forward broadcasts it against the batch of the incoming feature map."""
+
+    def __init__(self, stride: int, sizes=(32.0,), ratios=(0.5, 1.0, 2.0),
+                 name=None):
+        super().__init__(name)
+        self.stride = int(stride)
+        self.sizes = tuple(float(s) for s in sizes)
+        self.ratios = tuple(float(r) for r in ratios)
+
+    def forward(self, params, state, x, training=False, rng=None):
+        from bigdl_tpu.ops.detection import generate_anchors
+
+        fh, fw = x.shape[1], x.shape[2]   # NHWC feature map
+        grids = [generate_anchors([(fh, fw)], [self.stride], [s],
+                                  self.ratios) for s in self.sizes]
+        return jnp.asarray(np.concatenate(grids, axis=0)), EMPTY

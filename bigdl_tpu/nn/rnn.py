@@ -311,3 +311,74 @@ class RecurrentDecoder(Module):
         (_, _), outs = jax.lax.scan(step, (carry, x), None,
                                     length=self.seq_length)
         return jnp.swapaxes(outs, 0, 1), EMPTY  # (b, seq, d)
+
+
+# reference ``nn/RnnCell.scala`` — the tanh cell IS our SimpleRNN driver
+RnnCell = SimpleRNN
+
+
+class Recurrent(Module):
+    """Container driving a cell over the time axis — reference
+    ``nn/Recurrent.scala`` (``Recurrent().add(RnnCell(...))``).  Our cells
+    carry their own ``lax.scan`` driver, so this wrapper only fixes the
+    reference's container surface (add/forward over (b, t, d))."""
+
+    def __init__(self, cell: Optional[_RNNBase] = None, name=None):
+        super().__init__(name)
+        self.cell = cell
+
+    def add(self, cell: _RNNBase) -> "Recurrent":
+        self.cell = cell
+        return self
+
+    def _require(self):
+        if self.cell is None:
+            raise RuntimeError("Recurrent: add(cell) first")
+        return self.cell
+
+    def init(self, rng, x):
+        return self._require().init(rng, x)
+
+    def forward(self, params, state, x, training=False, rng=None, mask=None):
+        return self._require().forward(params, state, x, training=training,
+                                       rng=rng, mask=mask)
+
+
+class MultiRNNCell(Module):
+    """Stack of RNN cells applied in sequence — reference
+    ``nn/MultiRNNCell.scala`` (the stacked-decoder cell).  Works both as a
+    sequence layer (scan per sub-cell, one big gemm each) and as a decode
+    cell (``step``/``init_carry`` chain through the stack)."""
+
+    def __init__(self, cells, name=None):
+        super().__init__(name)
+        if not cells:
+            raise ValueError("MultiRNNCell needs at least one cell")
+        self.cells = list(cells)
+        self.hidden_size = self.cells[-1].hidden_size
+
+    def init(self, rng, x):
+        params = {}
+        ks = jax.random.split(rng, len(self.cells))
+        for i, cell in enumerate(self.cells):
+            v = cell.init(ks[i], x)
+            params[f"cell{i}"] = v["params"]
+            y, _ = cell.apply(v, x)
+            x = y
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, x, training=False, rng=None, mask=None):
+        for i, cell in enumerate(self.cells):
+            x, _ = cell.forward(params[f"cell{i}"], EMPTY, x,
+                                training=training, rng=rng, mask=mask)
+        return x, EMPTY
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return tuple(c.init_carry(batch, dtype) for c in self.cells)
+
+    def step(self, params, carry, x_t):
+        new_carries = []
+        for i, cell in enumerate(self.cells):
+            c, x_t = cell.step(params[f"cell{i}"], carry[i], x_t)
+            new_carries.append(c)
+        return tuple(new_carries), x_t
