@@ -479,3 +479,68 @@ def test_estimator_finetunes_resnet18():
     with torch.no_grad():
         theirs = tm2(torch.tensor(x[:4])).numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-3)
+
+
+class _InvertedResidual(torch.nn.Module):
+    """torchvision.models.mobilenet_v2 InvertedResidual, reconstructed:
+    1x1 expand + ReLU6, 3x3 depthwise (groups=hidden) + ReLU6, 1x1 project,
+    residual when stride 1 and cin==cout."""
+
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hid = cin * expand
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers += [torch.nn.Conv2d(cin, hid, 1, bias=False),
+                       torch.nn.BatchNorm2d(hid), torch.nn.ReLU6()]
+        layers += [
+            torch.nn.Conv2d(hid, hid, 3, stride, 1, groups=hid, bias=False),
+            torch.nn.BatchNorm2d(hid), torch.nn.ReLU6(),
+            torch.nn.Conv2d(hid, cout, 1, bias=False),
+            torch.nn.BatchNorm2d(cout),
+        ]
+        self.conv = torch.nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+def test_mobilenet_v2_style_conversion():
+    """Depthwise (groups=channels) convs, ReLU6, expand/project bottlenecks
+    and Hardswish heads convert with forward parity."""
+
+    class MiniMobileNet(torch.nn.Module):
+        def __init__(self, classes=5):
+            super().__init__()
+            self.stem = torch.nn.Sequential(
+                torch.nn.Conv2d(3, 8, 3, 2, 1, bias=False),
+                torch.nn.BatchNorm2d(8), torch.nn.Hardswish())
+            self.blocks = torch.nn.Sequential(
+                _InvertedResidual(8, 8, 1, 1),
+                _InvertedResidual(8, 12, 2, 4),
+                _InvertedResidual(12, 12, 1, 4),
+            )
+            self.pool = torch.nn.AdaptiveAvgPool2d(1)
+            self.fc = torch.nn.Linear(12, classes)
+
+        def forward(self, x):
+            y = self.blocks(self.stem(x))
+            y = torch.flatten(self.pool(y), 1)
+            return self.fc(y)
+
+    tm = MiniMobileNet().eval()
+    x = RS.rand(2, 3, 32, 32).astype(np.float32)
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-3)
+    # round trip back to torch
+    sd = export_state_dict(model, variables)
+    tm2 = MiniMobileNet()
+    tm2.load_state_dict(sd)
+    tm2.eval()
+    with torch.no_grad():
+        ty2 = tm2(torch.tensor(x))
+    np.testing.assert_allclose(ty2.numpy(), ty.numpy(), atol=1e-5)
