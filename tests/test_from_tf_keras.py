@@ -233,3 +233,27 @@ def test_relu_cap_plus_slope_and_dynamic_dims_raise():
     km2 = tk.Sequential([tk.layers.Input((None, 5)), tk.layers.LSTM(4)])
     with pytest.raises(UnsupportedKerasLayer):
         from_tf_keras(km2)
+
+
+def test_multi_input_functional_model():
+    """Two-input functional keras model: both inputs map to engine inputs,
+    merge layers take multiple parents, predict via the tuple pack."""
+    a = tk.Input((6,))
+    b = tk.Input((6,))
+    ha = tk.layers.Dense(8, activation="relu")(a)
+    hb = tk.layers.Dense(8, activation="relu")(b)
+    merged = tk.layers.Concatenate()([ha, hb])
+    out = tk.layers.Dense(3)(merged)
+    kmodel = tk.Model([a, b], out)
+
+    xa = RS.rand(4, 6).astype(np.float32)
+    xb = RS.rand(4, 6).astype(np.float32)
+    model, variables = from_tf_keras(kmodel)
+    ours, _ = model.apply(variables, xa, xb, training=False)
+    theirs = kmodel.predict([xa, xb], verbose=0)
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-5)
+
+    # weights still export back per layer
+    export_tf_keras_weights(model, variables, kmodel)
+    np.testing.assert_allclose(kmodel.predict([xa, xb], verbose=0), theirs,
+                               atol=1e-6)
