@@ -9,8 +9,8 @@ from bigdl_tpu.nn.layers import (
     SpatialBatchNormalization, LayerNorm, RMSNorm, Dropout, Reshape, View,
     Flatten, Squeeze, Unsqueeze, Transpose, Embedding, LookupTable,
     ZeroPadding2D, ReLU, ReLU6, Tanh, Sigmoid, GELU, SiLU, Swish, SoftPlus,
-    SoftSign, HardSigmoid, SoftMax, LogSoftMax, LeakyReLU, ELU, HardTanh,
-    PReLU,
+    SoftSign, HardSigmoid, HardSwish, SoftMax, LogSoftMax, LeakyReLU,
+    ELU, HardTanh, PReLU,
 )
 from bigdl_tpu.nn.layers_extra import (
     Conv3D, VolumetricConvolution, Conv2DTranspose, SpatialFullConvolution,

@@ -505,6 +505,7 @@ Swish = SiLU
 SoftPlus = _act(jax.nn.softplus, "SoftPlus")
 SoftSign = _act(jax.nn.soft_sign, "SoftSign")
 HardSigmoid = _act(jax.nn.hard_sigmoid, "HardSigmoid")
+HardSwish = _act(jax.nn.hard_swish, "HardSwish")  # x * relu6(x+3)/6
 
 
 class SoftMax(Module):

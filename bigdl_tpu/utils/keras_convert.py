@@ -44,6 +44,7 @@ def _act_layer(name: Optional[str]):
         "elu": N.ELU, "silu": N.SiLU, "swish": N.Swish,
         "softplus": N.SoftPlus, "softsign": N.SoftSign,
         "hard_sigmoid": N.HardSigmoid, "leaky_relu": N.LeakyReLU,
+        "hard_silu": N.HardSwish, "hard_swish": N.HardSwish,
         "log_softmax": N.LogSoftMax, "mish": N.Mish,
         "exponential": N.Exp,
     }

@@ -240,6 +240,8 @@ _SIMPLE = {
     "ELU": lambda tm: (N.ELU(tm.alpha), {}, {}),
     "LeakyReLU": lambda tm: (N.LeakyReLU(tm.negative_slope), {}, {}),
     "Softmax": lambda tm: (N.SoftMax(), {}, {}),
+    "Hardswish": lambda tm: (N.HardSwish(), {}, {}),
+    "Hardsigmoid": lambda tm: (N.HardSigmoid(), {}, {}),
     "Hardtanh": lambda tm: (N.HardTanh(tm.min_val, tm.max_val), {}, {}),
     "Identity": lambda tm: (N.Identity(), {}, {}),
     "Dropout": lambda tm: (N.Dropout(tm.p), {}, {}),
