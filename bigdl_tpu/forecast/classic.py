@@ -5,9 +5,8 @@ The reference wraps pmdarima/prophet (host-CPU classical models; they never
 touch the accelerator there either).  pmdarima/prophet are not installed in
 this image, so ARIMA is implemented directly (Hannan-Rissanen two-stage
 least squares — the standard CSS-free estimator for ARMA coefficients) and
-Prophet is likewise
-implemented natively (piecewise-linear trend + Fourier seasonality, MAP
-ridge fit)."""
+Prophet is likewise implemented natively (piecewise-linear trend + Fourier
+seasonality, MAP ridge fit)."""
 
 from typing import Dict, Sequence
 
