@@ -161,8 +161,8 @@ class AutoTSEstimator:
         self.best_result = None
 
     def fit(self, data: TSDataset, validation_data: Optional[TSDataset] = None,
-            epochs: int = 3, batch_size: int = 32, n_sampling: int = 4
-            ) -> TSPipeline:
+            epochs: int = 3, batch_size: int = 32, n_sampling: int = 4,
+            parallel=None) -> TSPipeline:
         space = dict(self.search_space)
         space["past_seq_len"] = self.past_seq_len
         searcher = RandomSearcher(mode=self.mode, seed=self.seed)
@@ -188,7 +188,8 @@ class AutoTSEstimator:
             res = fc.evaluate((vx, vy), metrics=[self.metric])
             return float(res[self.metric]), fc
 
-        self.best_result = searcher.run(trial, space, n_sampling)
+        self.best_result = searcher.run(trial, space, n_sampling,
+                                        parallel=parallel)
         best_fc = self.best_result.artifacts
         log.info("AutoTS best %s=%.6f config=%s", self.metric,
                  self.best_result.metric, self.best_result.config)

@@ -217,6 +217,28 @@ def _prelu(tm):
     return N.PReLU(), {"alpha": jnp.asarray(_np(tm.weight))}, {}
 
 
+def _upsample(tm):
+    if tm.scale_factor is None:
+        raise NotImplementedError("Upsample with target size (use "
+                                  "scale_factor)")
+    sf = tm.scale_factor
+    sf = tuple(int(s) for s in sf) if isinstance(sf, (tuple, list)) \
+        else (int(sf), int(sf))
+    if any(float(s) != int(s) for s in (
+            tm.scale_factor if isinstance(tm.scale_factor, (tuple, list))
+            else [tm.scale_factor])):
+        raise NotImplementedError("non-integer Upsample scale_factor")
+    mode = tm.mode
+    if mode == "nearest":
+        return N.UpSampling2D(sf, mode="nearest"), {}, {}
+    if mode == "bilinear":
+        if tm.align_corners:
+            raise NotImplementedError("Upsample align_corners=True "
+                                      "(half-pixel centers only)")
+        return N.UpSampling2D(sf, mode="bilinear"), {}, {}
+    raise NotImplementedError(f"Upsample mode {mode!r}")
+
+
 def _pool2d(tm, cls):
     k = tm.kernel_size if isinstance(tm.kernel_size, tuple) else \
         (tm.kernel_size, tm.kernel_size)
@@ -257,6 +279,9 @@ _SIMPLE = {
     "Embedding": _embedding,
     "PReLU": _prelu,
     "MultiheadAttention": _mha,
+    "Upsample": _upsample,
+    "UpsamplingNearest2d": _upsample,
+    "UpsamplingBilinear2d": _upsample,
     "MaxPool2d": lambda tm: _pool2d(tm, N.MaxPool2D),
     "AvgPool2d": lambda tm: _pool2d(tm, N.AvgPool2D),
 }

@@ -544,3 +544,47 @@ def test_mobilenet_v2_style_conversion():
     with torch.no_grad():
         ty2 = tm2(torch.tensor(x))
     np.testing.assert_allclose(ty2.numpy(), ty.numpy(), atol=1e-5)
+
+
+def test_unet_style_upsample_and_skip():
+    """nn.Upsample (nearest + bilinear, align_corners=False) converts; a
+    UNet-style skip concat across the upsample keeps forward parity."""
+
+    class MiniUNet(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.down = torch.nn.Conv2d(3, 6, 3, 2, 1)
+            self.mid = torch.nn.Conv2d(6, 6, 3, 1, 1)
+            self.up = torch.nn.Upsample(scale_factor=2, mode="nearest")
+            self.out = torch.nn.Conv2d(9, 2, 1)
+
+        def forward(self, x):
+            d = torch.relu(self.down(x))
+            u = self.up(torch.relu(self.mid(d)))
+            return self.out(torch.cat([u, x], dim=1))
+
+    tm = MiniUNet().eval()
+    x = RS.rand(2, 3, 8, 8).astype(np.float32)
+    model, variables = from_torch_module(tm, example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty = tm(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y),
+                               ty.numpy().transpose(0, 2, 3, 1), atol=1e-4)
+
+    class Bilin(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.up = torch.nn.Upsample(scale_factor=2, mode="bilinear",
+                                        align_corners=False)
+
+        def forward(self, x):
+            return self.up(x)
+
+    tm2 = Bilin().eval()
+    model2, v2 = from_torch_module(tm2, example_input=x)
+    y2, _ = model2.apply(v2, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty2 = tm2(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y2),
+                               ty2.numpy().transpose(0, 2, 3, 1), atol=1e-5)
