@@ -368,3 +368,59 @@ class Transformer(Module):
 # reference ``nn/Attention.scala`` / ``nn/FeedForwardNetwork.scala`` names
 Attention = MultiHeadAttention
 FeedForwardNetwork = PositionwiseFFN
+
+
+def transformer_decode(model, params, src, bos_id, eos_id, max_len=32,
+                       beam_size: int = 0, length_penalty: float = 0.6):
+    """Autoregressive decode for a translation-mode :class:`Transformer` —
+    the inference half of reference ``nn/Transformer.scala`` +
+    ``nn/SequenceBeamSearch.scala``.
+
+    ``beam_size=0`` → greedy; ``>0`` → beam search with GNMT length
+    penalty.  The decoder re-attends over the full static-length prefix
+    each step (no KV cache — one ``lax.scan``, static shapes; the buffer
+    carries the grown prefix as decode state).  Returns
+    ``(tokens, scores)`` with tokens (b, max_len+1) greedy or
+    (b, beam, max_len+1) beamed, BOS included.
+    """
+    from bigdl_tpu.nn.decode import beam_search, greedy_decode
+
+    if model.mode != "translation":
+        raise ValueError("decode needs a translation-mode Transformer")
+    b = src.shape[0]
+
+    # encode once; memory rides in the decode state (tiled for beams)
+    x = model._embed(params, jnp.asarray(src))
+    for i, layer in enumerate(model.encoder):
+        x, _ = layer.forward(params[f"enc{i}"], EMPTY, x)
+
+    init_state = {
+        "memory": x,
+        "prefix": jnp.full((b, max_len + 1), bos_id, jnp.int32),
+        "pos": jnp.zeros((b,), jnp.int32),
+    }
+
+    def step_fn(last_tokens, state):
+        pos = state["pos"][0]                       # same for every row
+        prefix = state["prefix"].at[:, pos].set(last_tokens)
+        h = model._embed(params, prefix)
+        for i, layer in enumerate(model.decoder):
+            h, _ = layer.forward(params[f"dec{i}"], EMPTY, h,
+                                 state["memory"])
+        h, _ = model.ln_out.forward(params["ln_out"], EMPTY, h)
+        emb = cast_compute(params["embedding"])
+        logits = jnp.matmul(cast_compute(h), emb.T,
+                            preferred_element_type=jnp.float32)
+        lp = logits.astype(jnp.float32)[:, pos]
+        return lp, {"memory": state["memory"], "prefix": prefix,
+                    "pos": state["pos"] + 1}
+
+    vocab = model.vocab_size
+    if beam_size and beam_size > 1:
+        res = beam_search(step_fn, init_state, b, vocab, bos_id, eos_id,
+                          beam_size=beam_size, max_len=max_len,
+                          length_penalty=length_penalty)
+        return res.tokens, res.scores
+    tokens, log_probs, _lengths = greedy_decode(
+        step_fn, init_state, b, bos_id, eos_id, max_len=max_len)
+    return tokens, log_probs

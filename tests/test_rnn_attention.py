@@ -241,3 +241,59 @@ def test_anchor_layer_and_aliases():
     # centered square anchors of side 16 at stride 8
     np.testing.assert_allclose(np.asarray(boxes[0]),
                                [4 - 8, 4 - 8, 4 + 8, 4 + 8])
+
+
+def test_transformer_decode_greedy_and_beam():
+    """Autoregressive decode (SequenceBeamSearch analog) over a trained
+    translation Transformer: greedy reproduces the learned mapping; beam
+    search returns it as the top hypothesis."""
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.nn.attention import transformer_decode
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+
+    rs = np.random.RandomState(0)
+    vocab, t, n = 10, 4, 256
+    BOS, EOS = 1, 0
+    src = rs.randint(2, vocab, (n, t)).astype(np.int32)
+    tgt = src[:, ::-1].copy()                 # learn to reverse
+    # decoder length t+1: t tokens then EOS
+    tgt_full = np.concatenate([tgt, np.full((n, 1), EOS, np.int32)], 1)
+    tgt_in = np.concatenate([np.full((n, 1), BOS, np.int32),
+                             tgt_full[:, :-1]], 1)
+
+    model = Transformer(vocab, hidden_size=24, num_heads=2, num_layers=1,
+                        dropout=0.0)
+    variables = model.init(jax.random.PRNGKey(0), src, tgt_in)
+    params = variables["params"]
+    crit = CrossEntropyCriterion()
+    method = Adam(learning_rate=3e-3)
+    opt_state = method.init_state(params)
+
+    @jax.jit
+    def step(i, params, opt_state):
+        def loss_fn(p):
+            logits, _ = model.forward(p, {}, src, tgt_in)
+            return crit(logits.reshape(-1, vocab), tgt_full.reshape(-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = method.update(i, grads, params, opt_state)
+        return params, opt_state, loss
+
+    for i in range(300):
+        params, opt_state, loss = step(i, params, opt_state)
+    assert float(loss) < 0.1, float(loss)
+
+    src_t = src[:6]
+    tokens, _ = transformer_decode(model, params, src_t, BOS, EOS,
+                                   max_len=t + 1)
+    pred = np.asarray(tokens)[:, 1:t + 1]           # strip BOS, take t steps
+    assert (pred == src_t[:, ::-1]).mean() > 0.95, pred
+
+    btokens, scores = transformer_decode(model, params, src_t, BOS, EOS,
+                                         max_len=t + 1, beam_size=3)
+    assert btokens.shape == (6, 3, t + 2)
+    bpred = np.asarray(btokens)[:, 0, 1:t + 1]      # best beam
+    assert (bpred == src_t[:, ::-1]).mean() > 0.95
+    # beams sorted by score
+    assert np.all(np.asarray(scores)[:, 0] >= np.asarray(scores)[:, 1] - 1e-6)
