@@ -424,3 +424,114 @@ def transformer_decode(model, params, src, bos_id, eos_id, max_len=32,
     tokens, log_probs, _lengths = greedy_decode(
         step_fn, init_state, b, bos_id, eos_id, max_len=max_len)
     return tokens, log_probs
+
+
+def _attn_project(p, x, w, b):
+    return (jnp.matmul(cast_compute(x), cast_compute(p[w]),
+                       preferred_element_type=jnp.float32)
+            + p[b]).astype(x.dtype)
+
+
+def transformer_decode_cached(model, params, src, bos_id, eos_id,
+                              max_len=32):
+    """Greedy decode with per-layer KV caches — O(L) attention per step
+    (O(L²) total) instead of re-running the decoder over the whole prefix
+    (O(L³) total).  The serving-path variant of :func:`transformer_decode`;
+    numerics match the uncached path (asserted in tests).
+
+    Cache layout per decoder layer: self-attention K/V buffers
+    (b, heads, max_len, head_dim) written at the current position each
+    step; cross-attention K/V computed ONCE from the encoder memory.
+    """
+    from bigdl_tpu.nn.decode import greedy_decode
+
+    if model.mode != "translation":
+        raise ValueError("decode needs a translation-mode Transformer")
+    b = src.shape[0]
+    d = model.hidden_size
+
+    mem = model._embed(params, jnp.asarray(src))
+    for i, layer in enumerate(model.encoder):
+        mem, _ = layer.forward(params[f"enc{i}"], EMPTY, mem)
+
+    layers = model.decoder
+    nh = layers[0].self_attn.num_heads
+    hd = layers[0].self_attn.head_dim
+
+    def split_heads(x):                    # (b, t, d) -> (b, h, t, hd)
+        return x.reshape(b, -1, nh, hd).transpose(0, 2, 1, 3)
+
+    # cross-attention K/V once per layer
+    cross_kv = []
+    for i, layer in enumerate(layers):
+        p = params[f"dec{i}"]["cross_attn"]
+        cross_kv.append((split_heads(_attn_project(p, mem, "wk", "bk")),
+                         split_heads(_attn_project(p, mem, "wv", "bv"))))
+
+    pe = positional_encoding(max_len + 1, d)
+    scale = jnp.sqrt(float(d))
+
+    init_state = {
+        "k": jnp.zeros((b, len(layers), nh, max_len, hd), jnp.float32),
+        "v": jnp.zeros((b, len(layers), nh, max_len, hd), jnp.float32),
+        "pos": jnp.zeros((b,), jnp.int32),
+    }
+
+    def step_fn(last_tokens, state):
+        pos = state["pos"][0]
+        x = (jnp.take(params["embedding"], last_tokens.astype(jnp.int32),
+                      axis=0) * scale + pe[pos])[:, None, :]   # (b, 1, d)
+        ks, vs = state["k"], state["v"]
+        # valid-position mask over the cache (positions <= pos)
+        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]
+        for i, layer in enumerate(layers):
+            lp = params[f"dec{i}"]
+            h, _ = layer.ln1.forward(lp["ln1"], EMPTY, x)
+            sp = lp["self_attn"]
+            q = split_heads(_attn_project(sp, h, "wq", "bq"))  # (b,h,1,hd)
+            k_new = split_heads(_attn_project(sp, h, "wk", "bk"))[:, :, 0]
+            v_new = split_heads(_attn_project(sp, h, "wv", "bv"))[:, :, 0]
+            ks = ks.at[:, i, :, pos].set(k_new.astype(ks.dtype))
+            vs = vs.at[:, i, :, pos].set(v_new.astype(vs.dtype))
+            logits = jnp.einsum(
+                "bhqd,bhkd->bhqk", q.astype(jnp.float32), ks[:, i],
+                preferred_element_type=jnp.float32) / jnp.sqrt(float(hd))
+            logits = jnp.where(valid, logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            a = jnp.einsum("bhqk,bhkd->bhqd", w, vs[:, i],
+                           preferred_element_type=jnp.float32)
+            a = a.transpose(0, 2, 1, 3).reshape(b, 1, nh * hd)
+            a = (jnp.matmul(a.astype(x.dtype), cast_compute(sp["wo"]),
+                            preferred_element_type=jnp.float32)
+                 + sp["bo"]).astype(x.dtype)
+            x = x + a
+            # cross attention over the fixed memory
+            h, _ = layer.ln2.forward(lp["ln2"], EMPTY, x)
+            cp = lp["cross_attn"]
+            q = split_heads(_attn_project(cp, h, "wq", "bq"))
+            ck, cv = cross_kv[i]
+            logits = jnp.einsum(
+                "bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                ck.astype(jnp.float32),
+                preferred_element_type=jnp.float32) / jnp.sqrt(float(hd))
+            w = jax.nn.softmax(logits, axis=-1)
+            a = jnp.einsum("bhqk,bhkd->bhqd", w, cv.astype(jnp.float32),
+                           preferred_element_type=jnp.float32)
+            a = a.transpose(0, 2, 1, 3).reshape(b, 1, nh * hd)
+            a = (jnp.matmul(a.astype(x.dtype), cast_compute(cp["wo"]),
+                            preferred_element_type=jnp.float32)
+                 + cp["bo"]).astype(x.dtype)
+            x = x + a
+            h, _ = layer.ln3.forward(lp["ln3"], EMPTY, x)
+            f, _ = layer.ffn.forward(lp["ffn"], EMPTY, h)
+            x = x + f
+        h, _ = model.ln_out.forward(params["ln_out"], EMPTY, x)
+        emb = cast_compute(params["embedding"])
+        lp_out = jnp.matmul(cast_compute(h), emb.T,
+                            preferred_element_type=jnp.float32)
+        return lp_out.astype(jnp.float32)[:, 0], \
+            {"k": ks, "v": vs, "pos": state["pos"] + 1}
+
+    tokens, log_probs, _lengths = greedy_decode(
+        step_fn, init_state, b, bos_id, eos_id, max_len=max_len)
+    return tokens, log_probs

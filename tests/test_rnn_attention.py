@@ -297,3 +297,50 @@ def test_transformer_decode_greedy_and_beam():
     assert (bpred == src_t[:, ::-1]).mean() > 0.95
     # beams sorted by score
     assert np.all(np.asarray(scores)[:, 0] >= np.asarray(scores)[:, 1] - 1e-6)
+
+
+def test_cached_decode_matches_uncached():
+    """KV-cached greedy decode is numerically the same decode as the
+    re-run-the-prefix path (same tokens, log-probs within fp tolerance)."""
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.nn.attention import (transformer_decode,
+                                        transformer_decode_cached)
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+
+    rs = np.random.RandomState(4)
+    vocab, t, n = 10, 4, 128
+    BOS, EOS = 1, 0
+    src = rs.randint(2, vocab, (n, t)).astype(np.int32)
+    tgt_full = np.concatenate([src[:, ::-1],
+                               np.full((n, 1), EOS, np.int32)], 1)
+    tgt_in = np.concatenate([np.full((n, 1), BOS, np.int32),
+                             tgt_full[:, :-1]], 1)
+    model = Transformer(vocab, hidden_size=16, num_heads=2, num_layers=2,
+                        dropout=0.0)
+    variables = model.init(jax.random.PRNGKey(0), src, tgt_in)
+    params = variables["params"]
+    crit = CrossEntropyCriterion()
+    method = Adam(learning_rate=3e-3)
+    opt_state = method.init_state(params)
+
+    @jax.jit
+    def step(i, params, opt_state):
+        def loss_fn(p):
+            logits, _ = model.forward(p, {}, src, tgt_in)
+            return crit(logits.reshape(-1, vocab), tgt_full.reshape(-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return (*method.update(i, grads, params, opt_state), loss)
+
+    for i in range(100):
+        params, opt_state, _ = step(i, params, opt_state)
+
+    src_t = src[:5]
+    tok_u, lp_u = transformer_decode(model, params, src_t, BOS, EOS,
+                                     max_len=t + 1)
+    tok_c, lp_c = transformer_decode_cached(model, params, src_t, BOS, EOS,
+                                            max_len=t + 1)
+    np.testing.assert_array_equal(np.asarray(tok_u), np.asarray(tok_c))
+    np.testing.assert_allclose(np.asarray(lp_u), np.asarray(lp_c),
+                               atol=1e-3)
