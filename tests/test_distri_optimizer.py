@@ -175,3 +175,47 @@ def test_prefetch_to_device_order_and_depth():
 
     with pytest.raises(ValueError):
         list(prefetch_to_device(iter([1]), put, size=0))
+
+
+def test_bf16_grads_and_remat_options():
+    """bf16 gradient reduce-scatter (the FP16CompressedTensor analog)
+    halves the collective bytes and still converges; remat produces the
+    same loss trajectory as the plain step (identical numerics, only the
+    backward's memory/compute tradeoff changes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    mesh = build_mesh(MeshSpec(data=8))
+
+    def make(**kw):
+        model = Sequential([nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2)])
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+        return ShardedParameterStep(model, nn.CrossEntropyCriterion(),
+                                    SGD(learning_rate=0.2), mesh, variables,
+                                    **kw)
+
+    plain = make()
+    bf16 = make(bf16_grads=True)
+    remat = make(remat=True)
+    assert bf16.collective_bytes_per_step < plain.collective_bytes_per_step
+
+    rng = jax.random.PRNGKey(1)
+    losses = {"plain": [], "bf16": [], "remat": []}
+    for i in range(30):
+        losses["plain"].append(float(plain.train_step(i, rng, x, y)))
+        losses["bf16"].append(float(bf16.train_step(i, rng, x, y)))
+        losses["remat"].append(float(remat.train_step(i, rng, x, y)))
+    # remat is numerically the SAME program
+    np.testing.assert_allclose(losses["remat"], losses["plain"], rtol=1e-5)
+    # bf16 grads converge to the same ballpark
+    assert losses["bf16"][-1] < 0.5 * losses["bf16"][0]
+    assert abs(losses["bf16"][-1] - losses["plain"][-1]) < 0.1
