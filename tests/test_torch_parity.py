@@ -103,6 +103,9 @@ _ACTIVATIONS = [
     ("logsoftmax", lambda: nn.LogSoftMax(),
      lambda: torch.nn.LogSoftmax(dim=-1)),
     ("softmin", lambda: nn.SoftMin(), lambda: torch.nn.Softmin(dim=-1)),
+    ("hardswish", lambda: nn.HardSwish(), lambda: torch.nn.Hardswish()),
+    ("hardsigmoid", lambda: nn.HardSigmoid(),
+     lambda: torch.nn.Hardsigmoid()),
 ]
 
 
@@ -241,7 +244,36 @@ _PARAM_LAYERS = [
      lambda: torch.nn.LayerNorm(7, eps=1e-6), (4, 7), "same", _sync_norm),
     ("prelu", lambda: nn.PReLU(), lambda: torch.nn.PReLU(),
      (4, 9), "same", _sync_prelu),
+    ("groupnorm", lambda: nn.GroupNorm(2, 6),
+     lambda: _affine_norm(torch.nn.GroupNorm(2, 6)), (2, 4, 4, 6), "nhwc",
+     _sync_norm),
+    ("instancenorm2d", lambda: nn.InstanceNorm2D(5),
+     lambda: _affine_norm(torch.nn.InstanceNorm2d(5, affine=True)),
+     (2, 6, 6, 5), "nhwc", _sync_norm),
+    ("depthwise_conv2d",
+     lambda: nn.DepthwiseConv2D(4, 3, padding=1, depth_multiplier=2),
+     lambda: torch.nn.Conv2d(4, 8, 3, padding=1, groups=4),
+     (2, 6, 6, 4), "nhwc", lambda p, s, tm: _sync_depthwise(p, s, tm)),
 ]
+
+
+def _affine_norm(m):
+    with torch.no_grad():
+        m.weight.copy_(torch.tensor(
+            (1 + 0.2 * RS.randn(m.weight.shape[0])).astype(np.float32)))
+        m.bias.copy_(torch.tensor(
+            RS.randn(m.bias.shape[0]).astype(np.float32) * 0.1))
+    return m
+
+
+def _sync_depthwise(params, state, tm):
+    # torch grouped-conv weight (cout, 1, kh, kw) with groups=cin ->
+    # ours (kh, kw, 1, cout)
+    params["weight"] = jnp.asarray(
+        tm.weight.detach().numpy().transpose(2, 3, 1, 0))
+    if tm.bias is not None:
+        params["bias"] = jnp.asarray(tm.bias.detach().numpy())
+    return params, state
 
 
 def _bn_with_stats(c):
