@@ -74,6 +74,36 @@ class DataSet:
         return ArrayDataSet(feats, labels)
 
 
+def batch_index_plan(n: int, batch_size: int, *, shuffle=True, seed=0,
+                     epoch=0, drop_last=True, process_id=0, process_count=1):
+    """Yield ``(sel, n_real)`` index batches with the framework's sharding
+    contract: same global permutation on every host (shared seed), each
+    process takes its stride slice, step count computed from GLOBAL sizes
+    (so every process dispatches the same number of collective-bearing
+    steps), short tails cyclic-padded to the static batch size with
+    ``n_real`` marking how many rows are genuine."""
+    idx = np.arange(n)
+    if shuffle:
+        rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2 ** 31))
+        rng.shuffle(idx)
+    local = idx[process_id::process_count]
+    if batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by {process_count} hosts")
+    per_host = batch_size // process_count
+    min_local = n // process_count
+    max_local = min_local + (1 if n % process_count else 0)
+    n_batches = (min_local // per_host if drop_last
+                 else math.ceil(max_local / per_host))
+    filler = local if len(local) else idx[:1]
+    for b in range(n_batches):
+        sel = local[b * per_host:(b + 1) * per_host]
+        n_real = len(sel)
+        if n_real < per_host:
+            sel = np.concatenate([sel, np.resize(filler, per_host - n_real)])
+        yield sel, n_real
+
+
 class ArrayDataSet(DataSet):
     """In-memory (host RAM) dataset over numpy arrays, with optional
     per-sample transform applied at batch time (the Transformer chain hook)."""
@@ -112,33 +142,10 @@ class ArrayDataSet(DataSet):
 
     def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
                 drop_last=True, process_id=0, process_count=1):
-        n = self.size()
-        idx = np.arange(n)
-        if shuffle:
-            # same global permutation on every host (shared seed), then shard
-            rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2 ** 31))
-            rng.shuffle(idx)
-        local = idx[process_id::process_count]
-        if batch_size % process_count != 0:
-            raise ValueError(
-                f"global batch {batch_size} not divisible by {process_count} hosts")
-        per_host = batch_size // process_count
-        # the step count must be computed from GLOBAL sizes so every process
-        # dispatches the same number of collective-bearing steps (different
-        # local shard lengths would deadlock a multi-host job)
-        min_local = n // process_count
-        max_local = min_local + (1 if n % process_count else 0)
-        n_batches = (min_local // per_host if drop_last
-                     else math.ceil(max_local / per_host))
-        filler = local if len(local) else idx[:1]
-        for b in range(n_batches):
-            sel = local[b * per_host:(b + 1) * per_host]
-            n_real_sel = len(sel)
-            if n_real_sel < per_host:
-                # cyclic-pad to the static batch size; padded rows carry
-                # weight 0 so metrics stay exact per-sample
-                sel = np.concatenate(
-                    [sel, np.resize(filler, per_host - n_real_sel)])
+        for sel, n_real in batch_index_plan(
+                self.size(), batch_size, shuffle=shuffle, seed=seed,
+                epoch=epoch, drop_last=drop_last, process_id=process_id,
+                process_count=process_count):
             x = (tuple(a[sel] for a in self.data) if self.multi
                  else self.data[sel])
             if self.transform is not None:
@@ -146,9 +153,10 @@ class ArrayDataSet(DataSet):
             mb = MiniBatch(input=x)
             if self.labels is not None:
                 mb["target"] = self.labels[sel]
-            if len(sel) != n_real_sel:
+            if len(sel) != n_real:
+                # padded rows carry weight 0 so metrics stay exact
                 w = np.zeros(len(sel), np.float32)
-                w[:n_real_sel] = 1.0
+                w[:n_real] = 1.0
                 mb["weight"] = w
             yield mb
 

@@ -60,8 +60,17 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p, f32p, f32p]
     lib.btio_gather_rows_f32.argtypes = [
         ctypes.c_void_p, f32p, i64p, ctypes.c_int, ctypes.c_int64, f32p]
+    lib.btio_records_open.argtypes = [ctypes.c_char_p]
+    lib.btio_records_open.restype = ctypes.c_void_p
+    lib.btio_records_count.argtypes = [ctypes.c_void_p]
+    lib.btio_records_count.restype = ctypes.c_int64
+    lib.btio_records_bytes.argtypes = [ctypes.c_void_p]
+    lib.btio_records_bytes.restype = ctypes.c_int64
+    lib.btio_records_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, i64p, ctypes.c_int, u8p]
+    lib.btio_records_close.argtypes = [ctypes.c_void_p]
     lib.btio_version.restype = ctypes.c_int
-    if lib.btio_version() != 1:
+    if lib.btio_version() != 2:
         return None
     return lib
 
@@ -264,3 +273,49 @@ class BatchPipeline:
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(idx), row, _f32p(out))
         return out
+
+
+class RecordReader:
+    """Memory-mapped fixed-size-record reader over the native lib (the
+    data-loader executor) with threaded batch gather; ``None`` handle when
+    the lib is unavailable (callers fall back to np.memmap)."""
+
+    def __init__(self, path: str, pipeline: "BatchPipeline" = None):
+        lib = _get()
+        self._lib = lib
+        self._h = lib.btio_records_open(
+            os.fsencode(path)) if lib is not None else None
+        if lib is not None and not self._h:
+            raise ValueError(f"not a BTRECv1 record file: {path}")
+        self._pipe = pipeline
+
+    @property
+    def ok(self) -> bool:
+        return self._h is not None
+
+    def count(self) -> int:
+        return int(self._lib.btio_records_count(self._h))
+
+    def record_bytes(self) -> int:
+        return int(self._lib.btio_records_bytes(self._h))
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        """(n,) int64 indices -> (n, record_bytes) uint8."""
+        idx = np.ascontiguousarray(idx, np.int64)
+        out = np.empty((len(idx), self.record_bytes()), np.uint8)
+        self._lib.btio_records_gather(
+            self._h, self._pipe._pipe if self._pipe is not None else None,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(idx),
+            _u8p(out))
+        return out
+
+    def close(self):
+        if self._h is not None:
+            self._lib.btio_records_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -23,6 +23,11 @@
 #include <thread>
 #include <vector>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -258,6 +263,98 @@ void btio_gather_rows_f32(void* pipe, const float* src, const int64_t* idx,
   p->wait();
 }
 
-int btio_version() { return 1; }
+// ---------------------------------------------------------------------------
+// Record file reader: fixed-size records, memory-mapped — the native
+// data-loader executor (the RDD-partition file-read analog).  Layout:
+//   bytes 0..7   magic "BTRECv1\0"
+//   bytes 8..15  uint64 record_bytes
+//   bytes 16..23 uint64 n_records
+//   bytes 24..   records, contiguous
+// ---------------------------------------------------------------------------
+
+struct RecordFile {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  size_t map_len = 0;
+  uint64_t record_bytes = 0;
+  uint64_t n_records = 0;
+};
+
+void* btio_records_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < 24) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (m == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  uint8_t* b = (uint8_t*)m;
+  if (std::memcmp(b, "BTRECv1\0", 8) != 0) {
+    munmap(m, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  RecordFile* rf = new RecordFile();
+  rf->fd = fd;
+  rf->map = b;
+  rf->map_len = st.st_size;
+  std::memcpy(&rf->record_bytes, b + 8, 8);
+  std::memcpy(&rf->n_records, b + 16, 8);
+  if (24 + rf->record_bytes * rf->n_records > rf->map_len) {
+    munmap(m, st.st_size);
+    ::close(fd);
+    delete rf;
+    return nullptr;
+  }
+  return rf;
+}
+
+int64_t btio_records_count(void* h) {
+  return h ? (int64_t)((RecordFile*)h)->n_records : -1;
+}
+
+int64_t btio_records_bytes(void* h) {
+  return h ? (int64_t)((RecordFile*)h)->record_bytes : -1;
+}
+
+// Gather records[idx[0..n)] into out (n, record_bytes), fanned out over the
+// pipeline's worker threads (memcpy from the mapped region; the page cache
+// is the shared buffer pool).
+void btio_records_gather(void* h, void* pipe, const int64_t* idx, int n,
+                         uint8_t* out) {
+  RecordFile* rf = (RecordFile*)h;
+  const uint8_t* base = rf->map + 24;
+  const size_t rb = rf->record_bytes;
+  Pipeline* p = (Pipeline*)pipe;
+  if (p == nullptr) {
+    for (int i = 0; i < n; ++i)
+      std::memcpy(out + (size_t)i * rb, base + (size_t)idx[i] * rb, rb);
+    return;
+  }
+  const int chunk = std::max(1, n / (int)(p->workers.size() * 4));
+  for (int s = 0; s < n; s += chunk) {
+    const int e = std::min(n, s + chunk);
+    p->submit([=] {
+      for (int i = s; i < e; ++i)
+        std::memcpy(out + (size_t)i * rb, base + (size_t)idx[i] * rb, rb);
+    });
+  }
+  p->wait();
+}
+
+void btio_records_close(void* h) {
+  RecordFile* rf = (RecordFile*)h;
+  if (!rf) return;
+  if (rf->map) munmap(rf->map, rf->map_len);
+  if (rf->fd >= 0) ::close(rf->fd);
+  delete rf;
+}
+
+int btio_version() { return 2; }
 
 }  // extern "C"

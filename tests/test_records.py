@@ -1,0 +1,108 @@
+"""Native record-file loader specs (the cached-RDD[Sample] storage analog:
+mmap fixed records + threaded gather in native/bigdl_tpu_io.cpp)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.data.records import RecordDataSet, write_records
+from bigdl_tpu.native import lib as nat
+
+RS = np.random.RandomState(0)
+
+
+@pytest.fixture
+def rec(tmp_path):
+    x = RS.rand(100, 4, 4, 3).astype(np.float32)
+    y = RS.randint(0, 5, 100).astype(np.int32)
+    p = str(tmp_path / "train.btrec")
+    write_records(p, {"x": x, "y": y})
+    return p, x, y
+
+
+def test_roundtrip_and_shuffle(rec):
+    p, x, y = rec
+    ds = RecordDataSet(p)
+    assert ds.size() == 100
+    gx = np.concatenate([mb["input"] for mb in ds.batches(20, shuffle=False)])
+    gy = np.concatenate([mb["target"] for mb in ds.batches(20, shuffle=False)])
+    np.testing.assert_array_equal(gx, x)
+    np.testing.assert_array_equal(gy, y)
+    # shuffled epoch is a permutation, deterministic per (seed, epoch)
+    a1 = np.concatenate([mb["target"]
+                         for mb in ds.batches(20, seed=3, epoch=1)])
+    a2 = np.concatenate([mb["target"]
+                         for mb in ds.batches(20, seed=3, epoch=1)])
+    np.testing.assert_array_equal(a1, a2)
+    assert not np.array_equal(a1, y)
+    np.testing.assert_array_equal(np.sort(a1), np.sort(y))
+    ds.close()
+
+
+def test_matches_array_dataset_sharding(rec):
+    """Per-process batches equal ArrayDataSet's (same index plan)."""
+    from bigdl_tpu.data.dataset import ArrayDataSet
+
+    p, x, y = rec
+    ds = RecordDataSet(p)
+    ads = ArrayDataSet(x, y)
+    for pid in (0, 1):
+        got = list(ds.batches(32, shuffle=True, seed=5, process_id=pid,
+                              process_count=2))
+        want = list(ads.batches(32, shuffle=True, seed=5, process_id=pid,
+                                process_count=2))
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g["input"], w["input"])
+            np.testing.assert_array_equal(g["target"], w["target"])
+    ds.close()
+
+
+def test_trains_through_optimizer(rec, tmp_path):
+    """RecordDataSet feeds the distributed Optimizer end to end."""
+    import jax
+
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.nn.module import Sequential
+
+    n, classes = 200, 3
+    x = RS.rand(n, 6).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.int32)
+    p = str(tmp_path / "clf.btrec")
+    write_records(p, {"x": x, "y": y})
+    ds = RecordDataSet(p)
+    model = Sequential([nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 2)])
+    opt = optim.Optimizer(model, ds, nn.CrossEntropyCriterion(),
+                          batch_size=40)
+    opt.set_optim_method(optim.Adam(learning_rate=0.02))
+    opt.set_end_when(optim.Trigger.max_epoch(20))
+    trained = opt.optimize()
+    res = trained.evaluate(ds, [optim.Top1Accuracy()], 40)
+    assert res[0].result > 0.85, res
+    ds.close()
+
+
+def test_bad_fields_raise(tmp_path):
+    with pytest.raises(ValueError):
+        write_records(str(tmp_path / "b.btrec"),
+                      {"x": np.zeros((3, 2)), "y": np.zeros(4)})
+    x = np.zeros((4, 2), np.float32)
+    p = str(tmp_path / "ok.btrec")
+    write_records(p, {"x": x})
+    with pytest.raises(ValueError):
+        RecordDataSet(p, feature="nope")
+
+
+@pytest.mark.skipif(not nat.available(), reason="native lib unavailable")
+def test_native_reader_direct(rec):
+    p, x, y = rec
+    r = nat.RecordReader(p)
+    assert r.count() == 100
+    raw = r.gather(np.asarray([0, 7, 99], np.int64))
+    assert raw.shape == (3, r.record_bytes())
+    xb = raw[:, :x[0].nbytes].view(np.float32).reshape(3, 4, 4, 3)
+    np.testing.assert_array_equal(xb, x[[0, 7, 99]])
+    r.close()
+    with pytest.raises(ValueError):
+        nat.RecordReader(p + ".json")   # not a record file
