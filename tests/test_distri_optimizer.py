@@ -219,3 +219,61 @@ def test_bf16_grads_and_remat_options():
     # bf16 grads converge to the same ballpark
     assert losses["bf16"][-1] < 0.5 * losses["bf16"][0]
     assert abs(losses["bf16"][-1] - losses["plain"][-1]) < 0.1
+
+
+def test_failure_retry_resumes_from_checkpoint(tmp_path):
+    """SURVEY §6.3 driver retry: a mid-epoch failure (input pipeline
+    raises, the task-closure-throw analog) is retried from the last
+    checkpoint and training still completes; without a checkpoint the
+    failure is fatal."""
+    import jax
+
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.module import Sequential
+
+    from bigdl_tpu.runtime.engine import Engine, init_engine
+
+    init_engine()
+    Engine.get().config.failure_retry_interval_s = 0.1  # keep the test fast
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 6).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+
+    class FlakyDataSet(ArrayDataSet):
+        """Raises ONCE partway through the second epoch (epochs are
+        1-based like the reference, so that's epoch == 2 — after the
+        first every_epoch checkpoint exists)."""
+
+        fired = False
+
+        def batches(self, *a, **kw):
+            for i, mb in enumerate(super().batches(*a, **kw)):
+                if kw.get("epoch") == 2 and i == 1 \
+                        and not FlakyDataSet.fired:
+                    FlakyDataSet.fired = True
+                    raise RuntimeError("injected input failure")
+                yield mb
+
+    def build(ds, ckpt=None):
+        model = Sequential([nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2)])
+        opt = optim.Optimizer(model, ds, nn.CrossEntropyCriterion(),
+                              batch_size=32)
+        opt.set_optim_method(optim.SGD(learning_rate=0.3))
+        opt.set_end_when(optim.Trigger.max_epoch(6))
+        if ckpt:
+            opt.set_checkpoint(ckpt, optim.Trigger.every_epoch())
+        return opt
+
+    FlakyDataSet.fired = False
+    trained = build(FlakyDataSet(x, y),
+                    str(tmp_path / "ck")).optimize()
+    assert FlakyDataSet.fired          # the failure really happened
+    res = trained.evaluate(ArrayDataSet(x, y), [optim.Top1Accuracy()], 32)
+    assert res[0].result > 0.9, res
+
+    # no checkpoint configured -> failure is fatal (reference semantics)
+    FlakyDataSet.fired = False
+    with pytest.raises(RuntimeError, match="injected"):
+        build(FlakyDataSet(x, y)).optimize()
