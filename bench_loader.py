@@ -69,6 +69,34 @@ def measure_loader(batch: int = 768, n_batches: int = 4,
         finally:
             pipe.close()
 
+    # record-file IO: mmap + threaded gather throughput at the same batch
+    # geometry (the native sample-storage read path, data/records.py)
+    try:
+        import tempfile
+
+        from bigdl_tpu.data.records import RecordDataSet, write_records
+
+        with tempfile.TemporaryDirectory() as d:
+            import os as _os
+
+            p = _os.path.join(d, "bench.btrec")
+            xs = rs.randint(0, 255, (512, out_hw, out_hw, 3), np.uint8)
+            write_records(p, {"x": xs})
+            ds = RecordDataSet(p)
+            list(ds.batches(batch, shuffle=True, drop_last=False))  # warm
+            t0 = time.perf_counter()
+            nb = 0
+            for _mb in ds.batches(batch, shuffle=True, seed=1,
+                                  drop_last=False):
+                nb += len(_mb["input"])
+            dt = time.perf_counter() - t0
+            out["record_read_img_per_sec"] = round(nb / dt, 1)
+            out["record_read_mb_per_sec"] = round(
+                nb * xs[0].nbytes / dt / 1e6, 1)
+            ds.close()
+    except Exception as e:  # records bench must not sink the loader bench
+        out["record_read_error"] = f"{type(e).__name__}: {e}"[:160]
+
     # single-thread python reference (1 small batch — it is slow)
     t0 = time.perf_counter()
     small = images[:64]
