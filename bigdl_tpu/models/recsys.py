@@ -135,3 +135,75 @@ class WideAndDeep(Module):
         if self.include_sigmoid:
             y = jax.nn.sigmoid(y)
         return y, EMPTY
+
+
+class TwoTower(Module):
+    """Two-tower retrieval model — the reference Friesian stack's recall
+    model (its FeatureTable builds user histories for exactly this; the
+    serving side's recall service does MIPS over the item tower's
+    embeddings, `friesian/serving.py`).
+
+    User tower: user-id embedding + mean-pooled history-item embeddings →
+    MLP.  Item tower: item-id embedding (+ optional category) → MLP.
+    Towers produce L2-normalized d-dim vectors; training score is their
+    dot product (in-batch softmax or BCE on sampled pairs).
+
+    Inputs: ``(user_ids (N,), hist_item_ids (N, H), item_ids (N,))`` —
+    history padded with 0 (id 0 reserved for padding, masked out of the
+    mean).  ``encode_users``/``encode_items`` expose the towers for
+    offline embedding export into the recall service."""
+
+    def __init__(self, n_users: int, n_items: int, dim: int = 32,
+                 hidden: Sequence[int] = (64,), name=None):
+        super().__init__(name)
+        self.n_users = n_users
+        self.n_items = n_items
+        self.dim = dim
+        self.hidden = tuple(hidden)
+
+    def build(self, rng, user_ids, hist, item_ids):
+        ks = jax.random.split(rng, 4 + 2 * len(self.hidden))
+        d = self.dim
+        params = {
+            "user_emb": jax.random.normal(ks[0], (self.n_users, d)) * 0.05,
+            "item_emb": jax.random.normal(ks[1], (self.n_items, d)) * 0.05,
+        }
+        ki = 2
+        for tower in ("u", "i"):
+            din = 2 * d if tower == "u" else d
+            for li, h in enumerate(self.hidden):
+                params[f"{tower}w{li}"] = jax.random.normal(
+                    ks[ki], (din, h)) * jnp.sqrt(2.0 / din)
+                params[f"{tower}b{li}"] = jnp.zeros((h,))
+                din = h
+                ki += 1
+            params[f"{tower}w_out"] = jax.random.normal(
+                ks[ki % len(ks)], (din, d)) * jnp.sqrt(1.0 / din)
+        return params, EMPTY
+
+    def _tower(self, params, x, tower):
+        for li in range(len(self.hidden)):
+            x = jax.nn.relu(
+                jnp.matmul(x, params[f"{tower}w{li}"])
+                + params[f"{tower}b{li}"])
+        v = jnp.matmul(x, params[f"{tower}w_out"])
+        return v / (jnp.linalg.norm(v, axis=-1, keepdims=True) + 1e-8)
+
+    def encode_users(self, params, user_ids, hist):
+        ue = jnp.take(params["user_emb"], user_ids.astype(jnp.int32), axis=0)
+        he = jnp.take(params["item_emb"], hist.astype(jnp.int32), axis=0)
+        mask = (hist > 0).astype(he.dtype)[..., None]
+        pooled = (he * mask).sum(1) / (mask.sum(1) + 1e-8)
+        return self._tower(params, jnp.concatenate([ue, pooled], -1), "u")
+
+    def encode_items(self, params, item_ids):
+        ie = jnp.take(params["item_emb"], item_ids.astype(jnp.int32), axis=0)
+        return self._tower(params, ie, "i")
+
+    def forward(self, params, state, user_ids, hist, item_ids,
+                training=False, rng=None):
+        u = self.encode_users(params, user_ids, hist)
+        v = self.encode_items(params, item_ids)
+        # in-batch sampled-softmax logits: (N, N) of u_i . v_j — the
+        # standard two-tower training objective (targets = arange(N))
+        return jnp.matmul(u, v.T) * 10.0, EMPTY
