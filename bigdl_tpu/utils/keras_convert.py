@@ -143,6 +143,55 @@ def _convert_depthwise(klayer, cfg):
     return steps
 
 
+def _convert_conv2d_transpose(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    _require_channels_last(cfg, "Conv2DTranspose")
+    if tuple(cfg.get("dilation_rate", (1, 1))) != (1, 1):
+        raise UnsupportedKerasLayer("Conv2DTranspose with dilation")
+    if cfg.get("output_padding") not in (None, (0, 0)):
+        raise UnsupportedKerasLayer("Conv2DTranspose output_padding")
+    k = klayer.get_weights()[0]   # (kh, kw, out, in) — our storage exactly
+    layer = N.Conv2DTranspose(k.shape[3], k.shape[2],
+                              kernel_size=tuple(cfg["kernel_size"]),
+                              stride=tuple(cfg["strides"]),
+                              padding=_pad(cfg),
+                              with_bias=cfg.get("use_bias", True))
+    return _conv_dense_like(klayer, cfg, layer, "conv_transpose")
+
+
+def _convert_separable(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    _require_channels_last(cfg, "SeparableConv2D")
+    w = klayer.get_weights()
+    dk, pk = w[0], w[1]           # (kh,kw,cin,mult), (1,1,cin*mult,out)
+    kh, kw, cin, mult = dk.shape
+    layer = N.SeparableConv2D(cin, pk.shape[3],
+                              kernel_size=(kh, kw),
+                              stride=tuple(cfg["strides"]),
+                              padding=_pad(cfg), depth_multiplier=mult,
+                              with_bias=cfg.get("use_bias", True))
+    params = {"depthwise": {"weight": dk.reshape(kh, kw, 1, cin * mult)},
+              "pointwise": {"weight": pk}}
+    if cfg.get("use_bias", True):
+        params["pointwise"]["bias"] = w[2]
+    steps = [(layer, params, {}, "separable")]
+    act = _act_layer(cfg.get("activation"))
+    if act is not None:
+        steps.append((act, {}, {}, None))
+    return steps
+
+
+def _convert_time_distributed(klayer, cfg):
+    inner = klayer.layer
+    if type(inner).__name__ != "Dense":
+        raise UnsupportedKerasLayer(
+            f"TimeDistributed({type(inner).__name__}) — only Dense (which "
+            "the native Linear already applies per timestep)")
+    return _convert_dense(inner, inner.get_config())
+
+
 def _convert_batchnorm(klayer, cfg):
     from bigdl_tpu import nn as N
 
@@ -370,6 +419,9 @@ _CONVERTERS = {
     "Conv2D": _convert_conv2d,
     "Conv1D": _convert_conv1d,
     "DepthwiseConv2D": _convert_depthwise,
+    "Conv2DTranspose": _convert_conv2d_transpose,
+    "SeparableConv2D": _convert_separable,
+    "TimeDistributed": _convert_time_distributed,
     "BatchNormalization": _convert_batchnorm,
     "LayerNormalization": _convert_layernorm,
     "Embedding": _convert_embedding,
@@ -557,10 +609,18 @@ def export_tf_keras_weights(model, variables, kmodel) -> None:
         p = params.get(node_name, {})
         s = state.get(node_name, {})
         use_bias = klayer.get_config().get("use_bias", True)
-        if kind in ("dense", "conv"):
+        if kind in ("dense", "conv", "conv_transpose"):
             w = [np.asarray(p["weight"])]
-            if use_bias:
+            if "bias" in p:
                 w.append(np.asarray(p["bias"]))
+        elif kind == "separable":
+            dw = np.asarray(p["depthwise"]["weight"])
+            kh, kw, _one, cm = dw.shape
+            mult = klayer.get_config().get("depth_multiplier", 1)
+            w = [dw.reshape(kh, kw, cm // mult, mult),
+                 np.asarray(p["pointwise"]["weight"])]
+            if "bias" in p["pointwise"]:
+                w.append(np.asarray(p["pointwise"]["bias"]))
         elif kind == "depthwise":
             kh, kw, _one, cout = np.asarray(p["weight"]).shape
             mult = klayer.get_config().get("depth_multiplier", 1)

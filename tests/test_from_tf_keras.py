@@ -257,3 +257,30 @@ def test_multi_input_functional_model():
     export_tf_keras_weights(model, variables, kmodel)
     np.testing.assert_allclose(kmodel.predict([xa, xb], verbose=0), theirs,
                                atol=1e-6)
+
+
+def test_separable_transpose_timedistributed_parity():
+    """SeparableConv2D (Xception-style), Conv2DTranspose (decoder /
+    segmentation upsampling), and TimeDistributed(Dense) convert with
+    forward parity and per-layer weight export."""
+    km = tk.Sequential([
+        tk.layers.Input((8, 8, 4)),
+        tk.layers.SeparableConv2D(6, 3, padding="same", activation="relu",
+                                  depth_multiplier=2),
+        tk.layers.Conv2DTranspose(3, 3, strides=2, padding="same"),
+    ])
+    x = RS.rand(2, 8, 8, 4).astype(np.float32)
+    model, variables = _assert_forward_parity(km, x, atol=5e-4)
+    export_tf_keras_weights(model, variables, km)   # no raise, same values
+    np.testing.assert_allclose(km.predict(x, verbose=0),
+                               np.asarray(model.apply(variables, x)[0]),
+                               atol=5e-4)
+
+    km2 = tk.Sequential([
+        tk.layers.Input((5, 6)),
+        tk.layers.TimeDistributed(tk.layers.Dense(4, activation="tanh")),
+        tk.layers.GlobalAveragePooling1D(),
+        tk.layers.Dense(2),
+    ])
+    x2 = RS.rand(3, 5, 6).astype(np.float32)
+    _assert_forward_parity(km2, x2, atol=1e-5)
