@@ -207,3 +207,83 @@ class TwoTower(Module):
         # in-batch sampled-softmax logits: (N, N) of u_i . v_j — the
         # standard two-tower training objective (targets = arange(N))
         return jnp.matmul(u, v.T) * 10.0, EMPTY
+
+
+class DIEN(Module):
+    """Deep Interest Evolution Network (DIN/DIEN family) — the ranking
+    model the reference Friesian FeatureTable's ``add_hist_seq`` exists to
+    feed.  TPU-native shape: interest extraction is ONE scan-GRU over the
+    padded history, interest evolution is attention between the target
+    item and the GRU states (AUGRU simplified to attention-weighted
+    pooling of evolution states — compiler-friendly, no per-step host
+    control flow), head is an MLP over [user, target, evolved interest].
+
+    Inputs: ``(user_ids (N,), hist_item_ids (N, H), target_item_ids (N,))``
+    with 0-padded history.  Output: (N, 1) CTR logit.
+    """
+
+    def __init__(self, n_users: int, n_items: int, dim: int = 24,
+                 gru_hidden: int = 24, hidden: Sequence[int] = (64, 32),
+                 name=None):
+        super().__init__(name)
+        self.n_users = n_users
+        self.n_items = n_items
+        self.dim = dim
+        self.gru = nn.GRU(dim, gru_hidden, return_sequences=True)
+        self.hidden = tuple(hidden)
+        self.gru_hidden = gru_hidden
+
+    def init(self, rng, user_ids, hist, target_ids):
+        ks = jax.random.split(rng, 6 + len(self.hidden))
+        d, gh = self.dim, self.gru_hidden
+        he = jnp.zeros((hist.shape[0], hist.shape[1], d))
+        params = {
+            "user_emb": jax.random.normal(ks[0], (self.n_users, d)) * 0.05,
+            "item_emb": jax.random.normal(ks[1], (self.n_items, d)) * 0.05,
+            "gru": self.gru.init(ks[2], he)["params"],
+            # attention: score = v . tanh(W [state; target; state*target])
+            "att_w": jax.random.normal(ks[3], (2 * gh + d, gh)) * 0.1,
+            "att_b": jnp.zeros((gh,)),
+            "att_v": jax.random.normal(ks[4], (gh,)) * 0.1,
+        }
+        din = d + d + gh
+        for li, h in enumerate(self.hidden):
+            params[f"w{li}"] = jax.random.normal(
+                ks[5 + li], (din, h)) * jnp.sqrt(2.0 / din)
+            params[f"b{li}"] = jnp.zeros((h,))
+            din = h
+        params["w_out"] = jax.random.normal(ks[-1], (din, 1)) * 0.1
+        params["b_out"] = jnp.zeros((1,))
+        return {"params": params, "state": EMPTY}
+
+    def forward(self, params, state, user_ids, hist, target_ids,
+                training=False, rng=None):
+        ue = jnp.take(params["user_emb"], user_ids.astype(jnp.int32), axis=0)
+        te = jnp.take(params["item_emb"], target_ids.astype(jnp.int32),
+                      axis=0)
+        he = jnp.take(params["item_emb"], hist.astype(jnp.int32), axis=0)
+        mask = (hist > 0).astype(he.dtype)                     # (N, H)
+        # interest extraction (masked scan-GRU; padded steps freeze state)
+        states, _ = self.gru.forward(params["gru"], EMPTY, he, mask=mask)
+        # interest evolution: target-conditioned attention over GRU states
+        gh = states.shape[-1]
+        tb = jnp.broadcast_to(te[:, None, :], he.shape)
+        # align target to state width for the product term
+        t_pad = jnp.pad(tb, ((0, 0), (0, 0), (0, max(0, gh - tb.shape[-1])))
+                        )[..., :gh]
+        feats = jnp.concatenate([states, tb, states * t_pad], axis=-1)
+        scores = jnp.einsum(
+            "nhk,k->nh",
+            jnp.tanh(jnp.einsum("nhf,fk->nhk", feats, params["att_w"])
+                     + params["att_b"]),
+            params["att_v"])
+        scores = jnp.where(mask > 0, scores, -1e30)
+        att = jax.nn.softmax(scores, axis=-1)
+        # fully-masked rows (no history) contribute a zero interest vector
+        att = att * mask
+        interest = jnp.einsum("nh,nhk->nk", att, states)
+        x = jnp.concatenate([ue, te, interest], axis=-1)
+        for li in range(len(self.hidden)):
+            x = jax.nn.relu(jnp.matmul(x, params[f"w{li}"])
+                            + params[f"b{li}"])
+        return jnp.matmul(x, params["w_out"]) + params["b_out"], EMPTY

@@ -231,3 +231,46 @@ def test_two_tower_trains_and_retrieves():
     allv = model.encode_items(params, np.arange(n_items).astype(np.int32))
     top1 = np.asarray(jnp.argmax(u @ allv.T, axis=-1))
     assert (top1 == pos[:8]).mean() >= 0.75, (top1, pos[:8])
+
+
+def test_dien_learns_history_dependent_ctr():
+    """DIEN: the label depends on whether the TARGET item appears in the
+    user's history — learnable only through the attention-over-GRU-states
+    path (user/target embeddings alone can't separate it)."""
+    import jax
+
+    from bigdl_tpu.models.recsys import DIEN
+    from bigdl_tpu.nn.criterion import BCEWithLogitsCriterion
+
+    rs = np.random.RandomState(1)
+    n_users, n_items, H, N = 20, 15, 5, 256
+    users = rs.randint(0, n_users, N).astype(np.int32)
+    hist = rs.randint(1, n_items, (N, H)).astype(np.int32)
+    hist[rs.rand(N, H) < 0.2] = 0                     # padding holes
+    target = rs.randint(1, n_items, N).astype(np.int32)
+    y = (hist == target[:, None]).any(1).astype(np.float32)[:, None]
+
+    from bigdl_tpu.optim.optim_method import Adam
+
+    model = DIEN(n_users, n_items, dim=16, gru_hidden=16, hidden=(32,))
+    variables = model.init(jax.random.PRNGKey(0), users, hist, target)
+    params = variables["params"]
+    crit = BCEWithLogitsCriterion()
+    method = Adam(learning_rate=5e-3)
+    opt_state = method.init_state(params)
+
+    @jax.jit
+    def step(i, params, opt_state):
+        def loss_fn(p):
+            logits, _ = model.forward(p, {}, users, hist, target)
+            return crit(logits, y)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = method.update(i, g, params, opt_state)
+        return params, opt_state, loss
+
+    for i in range(400):
+        params, opt_state, loss = step(i, params, opt_state)
+    logits, _ = model.forward(params, {}, users, hist, target)
+    acc = ((np.asarray(logits) > 0) == (y > 0.5)).mean()
+    assert acc > 0.85, (acc, float(loss))
