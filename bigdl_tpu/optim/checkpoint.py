@@ -110,3 +110,48 @@ def _gc(path: str, keep_last: int):
                 continue
     for _, name in sorted(entries)[:-keep_last] if keep_last > 0 else []:
         shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint WRITES with training (preemptible-slice posture:
+    frequent cheap checkpoints).  ``submit`` snapshots everything to host
+    synchronously (values are exact for the trigger step — training may
+    donate/overwrite device buffers immediately after), then the npz
+    serialization + atomic rename runs on a background thread.  One write
+    in flight; a second submit joins the first.  Call ``wait()`` before
+    reading ``latest_checkpoint`` (resume/exit paths)."""
+
+    def __init__(self):
+        import threading
+
+        self._threading = threading
+        self._thread = None
+        self._error = None
+
+    def submit(self, path: str, step: int, *, flat_params, opt_state,
+               model_state, driver_state, keep_last: int = 3) -> None:
+        self.wait()
+        host = dict(
+            flat_params=np.asarray(flat_params),
+            opt_state=jax.device_get(opt_state),
+            model_state=jax.device_get(model_state),
+            driver_state=dict(driver_state), keep_last=keep_last)
+
+        def run():
+            try:
+                save_checkpoint(path, step, **host)
+            except Exception as e:  # surfaced at the next wait()
+                self._error = e
+
+        self._thread = self._threading.Thread(
+            target=run, name="bigdl-tpu-ckpt", daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err

@@ -146,9 +146,15 @@ class Optimizer:
         self.end_when = trigger
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       async_write: bool = False) -> "Optimizer":
+        """``async_write=True`` snapshots to host at the trigger and runs
+        the npz serialization on a background thread (one in flight) —
+        the cheap-frequent-checkpoint posture for preemptible slices."""
         self._ckpt_path = path
         self._ckpt_trigger = trigger
+        self._ckpt_async = (ckpt.AsyncCheckpointer() if async_write
+                            else None)
         return self
 
     def set_validation(self, trigger: Trigger, dataset: DataSet,
@@ -328,6 +334,10 @@ class Optimizer:
                 # recovery REQUIRES a checkpoint to restore from; the epoch
                 # restarts cleanly from the resumed driver state.
                 retries += 1
+                try:
+                    self._ckpt_drain()  # in-flight async write may BE the
+                except Exception:       # latest checkpoint
+                    pass
                 can_resume = (self._ckpt_path and
                               ckpt.latest_checkpoint(self._ckpt_path))
                 if retries > max_retries or not can_resume:
@@ -339,6 +349,7 @@ class Optimizer:
                 self._try_resume(step_engine, state)
                 self._last_log = None  # don't count recovery in step time
 
+        self._ckpt_drain()
         variables = step_engine.get_variables()
         return TrainedModel(self.model, variables, step_engine)
 
@@ -419,12 +430,23 @@ class Optimizer:
         schedule = getattr(self.optim_method, "schedule", None)
         if schedule is not None and hasattr(schedule, "state_dict"):
             state = dict(state, schedule_state=schedule.state_dict())
-        ckpt.save_checkpoint(
-            self._ckpt_path, state["iteration"],
+        kw = dict(
             flat_params=np.asarray(step_engine.flat_params),
             opt_state=host_fetch(step_engine.opt_state),
             model_state=host_fetch(step_engine.model_state),
             driver_state=state)
+        writer = getattr(self, "_ckpt_async", None)
+        if writer is not None:
+            writer.submit(self._ckpt_path, state["iteration"], **kw)
+        else:
+            ckpt.save_checkpoint(self._ckpt_path, state["iteration"], **kw)
+
+    def _ckpt_drain(self):
+        """Join any in-flight async write (resume and exit paths read
+        latest_checkpoint, which must see a completed directory)."""
+        writer = getattr(self, "_ckpt_async", None)
+        if writer is not None:
+            writer.wait()
 
     def _run_validation(self, step_engine, state):
         batches = self._val_dataset.batches(

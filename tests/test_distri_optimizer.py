@@ -277,3 +277,44 @@ def test_failure_retry_resumes_from_checkpoint(tmp_path):
     FlakyDataSet.fired = False
     with pytest.raises(RuntimeError, match="injected"):
         build(FlakyDataSet(x, y)).optimize()
+
+
+def test_async_checkpoint_write(tmp_path):
+    """async_write=True checkpoints on a background thread; the trained
+    run leaves complete, loadable checkpoints and resume works."""
+    import jax
+
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim import checkpoint as ckpt
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, 5).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    d = str(tmp_path / "ck")
+
+    def build():
+        model = Sequential([nn.Linear(5, 8), nn.ReLU(), nn.Linear(8, 2)])
+        opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                              nn.CrossEntropyCriterion(), batch_size=32)
+        opt.set_optim_method(optim.SGD(learning_rate=0.3))
+        opt.set_checkpoint(d, optim.Trigger.every_epoch(), async_write=True)
+        return opt
+
+    opt = build()
+    opt.set_end_when(optim.Trigger.max_epoch(4))
+    trained = opt.optimize()
+    last = ckpt.latest_checkpoint(d)
+    assert last and last.endswith("ckpt-12")        # 3 batches x 4 epochs
+    # the directory is complete (manifest + all blobs)
+    import os
+
+    assert {"manifest.json", "params.npz", "opt_state.npz",
+            "model_state.npz"} <= set(os.listdir(last))
+    # resume from the async-written checkpoint continues cleanly
+    opt2 = build()
+    opt2.set_end_when(optim.Trigger.max_epoch(6))
+    trained2 = opt2.optimize()
+    res = trained2.evaluate(ArrayDataSet(x, y), [optim.Top1Accuracy()], 32)
+    assert res[0].result > 0.9, res
