@@ -112,46 +112,47 @@ def _gc(path: str, keep_last: int):
         shutil.rmtree(os.path.join(path, name), ignore_errors=True)
 
 
+import threading as _threading
+
+
 class AsyncCheckpointer:
     """Overlap checkpoint WRITES with training (preemptible-slice posture:
-    frequent cheap checkpoints).  ``submit`` snapshots everything to host
-    synchronously (values are exact for the trigger step — training may
-    donate/overwrite device buffers immediately after), then the npz
-    serialization + atomic rename runs on a background thread.  One write
-    in flight; a second submit joins the first.  Call ``wait()`` before
-    reading ``latest_checkpoint`` (resume/exit paths)."""
+    frequent cheap checkpoints).  The CALLER owns the host snapshot (it
+    must pass host arrays — the optimizer's ``host_fetch`` also handles
+    multi-host sharded state, which a plain ``device_get`` here could
+    not); this class owns the background npz serialization + atomic
+    rename.  One write in flight; a later submit joins the previous one
+    first.
+
+    Error policy: a failed BACKGROUND write is not a training failure —
+    it is logged and remembered; ``wait(raise_error=True)`` (the
+    resume/exit paths, where a missing checkpoint matters) re-raises it,
+    while ``submit`` only logs and proceeds with the newer write."""
 
     def __init__(self):
-        import threading
-
-        self._threading = threading
         self._thread = None
         self._error = None
 
-    def submit(self, path: str, step: int, *, flat_params, opt_state,
-               model_state, driver_state, keep_last: int = 3) -> None:
-        self.wait()
-        host = dict(
-            flat_params=np.asarray(flat_params),
-            opt_state=jax.device_get(opt_state),
-            model_state=jax.device_get(model_state),
-            driver_state=dict(driver_state), keep_last=keep_last)
+    def submit(self, path: str, step: int, **host_kw) -> None:
+        self.wait(raise_error=False)
 
         def run():
             try:
-                save_checkpoint(path, step, **host)
-            except Exception as e:  # surfaced at the next wait()
+                save_checkpoint(path, step, **host_kw)
+            except Exception as e:
+                log.warning("async checkpoint write failed: %s", e)
                 self._error = e
 
-        self._thread = self._threading.Thread(
+        self._thread = _threading.Thread(
             target=run, name="bigdl-tpu-ckpt", daemon=True)
         self._thread.start()
 
-    def wait(self) -> None:
+    def wait(self, raise_error: bool = True) -> None:
         t = self._thread
         if t is not None:
             t.join()
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
-            raise err
+            if raise_error:
+                raise err

@@ -114,6 +114,7 @@ class Optimizer:
         self.clip: Optional[GradientClipping] = None
         self._ckpt_path: Optional[str] = None
         self._ckpt_trigger: Optional[Trigger] = None
+        self._ckpt_async = None
         self._val_trigger: Optional[Trigger] = None
         self._val_dataset: Optional[DataSet] = None
         self._val_methods: Optional[List[ValidationMethod]] = None
@@ -334,10 +335,8 @@ class Optimizer:
                 # recovery REQUIRES a checkpoint to restore from; the epoch
                 # restarts cleanly from the resumed driver state.
                 retries += 1
-                try:
-                    self._ckpt_drain()  # in-flight async write may BE the
-                except Exception:       # latest checkpoint
-                    pass
+                # in-flight async write may BE the latest checkpoint
+                self._ckpt_drain(raise_error=False)
                 can_resume = (self._ckpt_path and
                               ckpt.latest_checkpoint(self._ckpt_path))
                 if retries > max_retries or not can_resume:
@@ -349,7 +348,17 @@ class Optimizer:
                 self._try_resume(step_engine, state)
                 self._last_log = None  # don't count recovery in step time
 
-        self._ckpt_drain()
+        try:
+            self._ckpt_drain()
+        except Exception as e:
+            # training finished and device state is valid — a failed FINAL
+            # write must not discard the model; retry once synchronously
+            log.warning("final checkpoint write failed (%s); retrying "
+                        "synchronously", e)
+            try:
+                self._save_checkpoint_sync_last(step_engine, state)
+            except Exception as e2:
+                log.error("synchronous checkpoint retry also failed: %s", e2)
         variables = step_engine.get_variables()
         return TrainedModel(self.model, variables, step_engine)
 
@@ -435,18 +444,25 @@ class Optimizer:
             opt_state=host_fetch(step_engine.opt_state),
             model_state=host_fetch(step_engine.model_state),
             driver_state=state)
-        writer = getattr(self, "_ckpt_async", None)
-        if writer is not None:
-            writer.submit(self._ckpt_path, state["iteration"], **kw)
+        if self._ckpt_async is not None:
+            self._ckpt_async.submit(self._ckpt_path,
+                                    state["iteration"], **kw)
         else:
             ckpt.save_checkpoint(self._ckpt_path, state["iteration"], **kw)
 
-    def _ckpt_drain(self):
+    def _save_checkpoint_sync_last(self, step_engine, state):
+        ckpt.save_checkpoint(
+            self._ckpt_path, state["iteration"],
+            flat_params=np.asarray(step_engine.flat_params),
+            opt_state=host_fetch(step_engine.opt_state),
+            model_state=host_fetch(step_engine.model_state),
+            driver_state=dict(state, loss=float(state["loss"])))
+
+    def _ckpt_drain(self, raise_error: bool = True):
         """Join any in-flight async write (resume and exit paths read
         latest_checkpoint, which must see a completed directory)."""
-        writer = getattr(self, "_ckpt_async", None)
-        if writer is not None:
-            writer.wait()
+        if self._ckpt_async is not None:
+            self._ckpt_async.wait(raise_error=raise_error)
 
     def _run_validation(self, step_engine, state):
         batches = self._val_dataset.batches(
