@@ -284,3 +284,31 @@ def test_separable_transpose_timedistributed_parity():
     ])
     x2 = RS.rand(3, 5, 6).astype(np.float32)
     _assert_forward_parity(km2, x2, atol=1e-5)
+
+
+def test_quantized_inference_on_converted_keras_model():
+    """Interop composes with the quantization path: a converted stock
+    keras model runs through nano.InferenceOptimizer int8 with small
+    accuracy drift vs fp32."""
+    from bigdl_tpu.nano.inference import InferenceOptimizer
+
+    tk.utils.set_random_seed(1)
+    km = tk.Sequential([
+        tk.layers.Input((10,)),
+        tk.layers.Dense(32, activation="relu"),
+        tk.layers.Dense(16, activation="relu"),
+        tk.layers.Dense(4),
+    ])
+    model, variables = from_tf_keras(km)
+    x = RS.rand(64, 10).astype(np.float32)
+    fp32 = InferenceOptimizer.trace(model, variables, x)
+    int8 = InferenceOptimizer.quantize(model, variables, sample=x,
+                                       precision="int8")
+    y32 = np.asarray(fp32(x))
+    y8 = np.asarray(int8(x))
+    assert y32.shape == y8.shape == (64, 4)
+    # int8 tracks fp32 closely on this scale of model
+    rel = np.abs(y8 - y32).mean() / (np.abs(y32).mean() + 1e-8)
+    assert rel < 0.1, rel
+    # and fp32 path matches keras itself
+    np.testing.assert_allclose(y32, km.predict(x, verbose=0), atol=2e-4)
