@@ -593,6 +593,22 @@ def from_torch_module(tmodule, example_input=None):
                 if node.args[0] in pre_flatten:
                     pre_flatten[node] = pre_flatten[node.args[0]]
                 emit(node, N.ReLU(), [sym[node.args[0]]])
+            elif fn is torch.nn.functional.interpolate:
+                sf = node.kwargs.get("scale_factor") or (
+                    node.args[2] if len(node.args) > 2 else None)
+                mode = node.kwargs.get("mode", "nearest")
+                if sf is None:
+                    raise NotImplementedError(
+                        "F.interpolate with target size (use scale_factor)")
+                sfp = tuple(int(s) for s in sf) if isinstance(
+                    sf, (tuple, list)) else (int(sf), int(sf))
+                if mode not in ("nearest", "bilinear") or (
+                        mode == "bilinear"
+                        and node.kwargs.get("align_corners")):
+                    raise NotImplementedError(
+                        f"F.interpolate mode {mode!r}/align_corners")
+                emit(node, N.UpSampling2D(sfp, mode=mode),
+                     [sym[node.args[0]]])
             elif fn is torch.nn.functional.gelu:
                 emit(node, N.GELU(), [sym[node.args[0]]])
             elif fn in (torch.sigmoid, torch.nn.functional.sigmoid):

@@ -588,3 +588,18 @@ def test_unet_style_upsample_and_skip():
         ty2 = tm2(torch.tensor(x))
     np.testing.assert_allclose(np.asarray(y2),
                                ty2.numpy().transpose(0, 2, 3, 1), atol=1e-5)
+
+
+def test_functional_interpolate_converts():
+    class Net(torch.nn.Module):
+        def forward(self, x):
+            return torch.nn.functional.interpolate(x, scale_factor=2,
+                                                   mode="nearest")
+
+    x = RS.rand(2, 3, 4, 4).astype(np.float32)
+    model, variables = from_torch_module(Net().eval(), example_input=x)
+    y, _ = model.apply(variables, x.transpose(0, 2, 3, 1))
+    with torch.no_grad():
+        ty = Net()(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y),
+                               ty.numpy().transpose(0, 2, 3, 1), atol=1e-6)
