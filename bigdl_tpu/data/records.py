@@ -63,19 +63,24 @@ class RecordDataSet(DataSet):
     native lib is unavailable) and decode to per-field numpy arrays.
 
     ``feature``/``label``: which manifest fields feed ``input``/``target``
-    (defaults: first field / second field if present)."""
+    (defaults: first field / second field if present).  ``feature`` may be
+    a LIST of field names — the batch input is then a tuple, the
+    framework's multi-input pack convention (e.g. Seq2Seq src + tgt_in)."""
 
-    def __init__(self, path: str, feature: Optional[str] = None,
-                 label: Optional[str] = None, pipeline=None):
+    def __init__(self, path: str, feature=None, label: Optional[str] = None,
+                 pipeline=None):
         with open(path + ".json") as f:
             self.manifest = json.load(f)
         self.path = path
         self._fields = self.manifest["fields"]
         names = [f["name"] for f in self._fields]
-        self.feature = feature or names[0]
-        self.label = label if label is not None else (
-            names[1] if len(names) > 1 else None)
-        for want in filter(None, (self.feature, self.label)):
+        self.feature = feature if feature is not None else names[0]
+        used = (list(self.feature)
+                if isinstance(self.feature, (list, tuple))
+                else [self.feature])
+        self.label = label if label is not None else next(
+            (n for n in names if n not in used), None)
+        for want in filter(None, used + [self.label]):
             if want not in names:
                 raise ValueError(f"field {want!r} not in manifest {names}")
 
@@ -123,7 +128,11 @@ class RecordDataSet(DataSet):
                 epoch=epoch, drop_last=drop_last, process_id=process_id,
                 process_count=process_count):
             raw = self._gather(np.asarray(sel, np.int64))
-            mb = MiniBatch(input=self._decode(raw, self.feature))
+            if isinstance(self.feature, (list, tuple)):
+                x = tuple(self._decode(raw, f) for f in self.feature)
+            else:
+                x = self._decode(raw, self.feature)
+            mb = MiniBatch(input=x)
             if self.label is not None:
                 mb["target"] = self._decode(raw, self.label)
             if len(sel) != n_real:

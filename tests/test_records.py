@@ -106,3 +106,19 @@ def test_native_reader_direct(rec):
     r.close()
     with pytest.raises(ValueError):
         nat.RecordReader(p + ".json")   # not a record file
+
+
+def test_multi_field_feature_pack(tmp_path):
+    """feature=[a, b] yields tuple inputs (the multi-input convention)."""
+    a = RS.rand(20, 3).astype(np.float32)
+    b = RS.randint(0, 9, (20, 2)).astype(np.int32)
+    y = RS.rand(20).astype(np.float32)
+    p = str(tmp_path / "multi.btrec")
+    write_records(p, {"a": a, "b": b, "y": y})
+    ds = RecordDataSet(p, feature=["a", "b"], label="y")
+    mb = next(ds.batches(10, shuffle=False))
+    xa, xb = mb["input"]
+    np.testing.assert_array_equal(xa, a[:10])
+    np.testing.assert_array_equal(xb, b[:10])
+    np.testing.assert_array_equal(mb["target"], y[:10])
+    ds.close()
