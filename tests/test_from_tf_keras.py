@@ -312,3 +312,24 @@ def test_quantized_inference_on_converted_keras_model():
     assert rel < 0.1, rel
     # and fp32 path matches keras itself
     np.testing.assert_allclose(y32, km.predict(x, verbose=0), atol=2e-4)
+
+
+def test_converted_model_serializer_roundtrip(tmp_path):
+    """Converted keras models save/load through the durable model format
+    (the ModuleSerializer analog) — predictions identical after reload."""
+    from bigdl_tpu.utils.serializer import load_model, save_model
+
+    tk.utils.set_random_seed(2)
+    km = tk.Sequential([
+        tk.layers.Input((6, 5)),
+        tk.layers.GRU(7),
+        tk.layers.Dense(3, activation="softmax"),
+    ])
+    model, variables = from_tf_keras(km)
+    x = RS.rand(4, 6, 5).astype(np.float32)
+    y0, _ = model.apply(variables, x)
+    p = str(tmp_path / "m")
+    save_model(p, model, variables)
+    v2 = load_model(p)
+    y1, _ = model.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1))
