@@ -31,3 +31,38 @@ def prefetch_to_device(batches: Iterable[T], put: Callable[[T], D],
             yield queue.popleft()
     while queue:
         yield queue.popleft()
+
+
+def thread_prefetch(batches: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """HOST-side lookahead: a daemon thread runs the (IO/augmentation-
+    bound) batch producer up to ``depth`` batches ahead of the consumer.
+    Complements :func:`prefetch_to_device` (device-transfer lookahead):
+    native gathers/augmentation release the GIL, so producer and the
+    dispatch loop genuinely overlap.  Exceptions re-raise at the
+    consumer's next pull (the driver retry loop sees them normally)."""
+    import queue as _queue
+    import threading as _threading
+
+    if depth < 1:
+        raise ValueError(f"thread_prefetch depth must be >= 1, got {depth}")
+    q: "_queue.Queue" = _queue.Queue(maxsize=depth)
+    _END, _ERR = object(), object()
+
+    def produce():
+        try:
+            for b in batches:
+                q.put(b)
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — surfaces at consumer
+            q.put((_ERR, e))
+
+    t = _threading.Thread(target=produce, name="bigdl-tpu-prefetch",
+                          daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+            raise item[1]
+        yield item

@@ -19,7 +19,7 @@ import jax
 import numpy as np
 
 from bigdl_tpu.data.dataset import DataSet
-from bigdl_tpu.data.prefetch import prefetch_to_device
+from bigdl_tpu.data.prefetch import prefetch_to_device, thread_prefetch
 from bigdl_tpu.optim import checkpoint as ckpt
 from bigdl_tpu.optim.metrics import Metrics, SummaryWriter, Timer
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
@@ -123,6 +123,7 @@ class Optimizer:
         self._val_summary: Optional[SummaryWriter] = None
         self.log_every = 1
         self.prefetch = 2  # device-transfer lookahead depth (1 = no overlap)
+        self.host_prefetch = 0  # host-side producer lookahead (0 = inline)
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
@@ -295,6 +296,10 @@ class Optimizer:
                 self.batch_size, shuffle=True, seed=self.seed, epoch=epoch,
                 process_id=jax.process_index(),
                 process_count=jax.process_count())
+            if self.host_prefetch:
+                # host-side lookahead: IO/augmentation runs a thread ahead
+                batch_iter = thread_prefetch(batch_iter,
+                                             depth=self.host_prefetch)
             # double-buffer host→device DMA behind the running step
             batch_iter = prefetch_to_device(
                 batch_iter,

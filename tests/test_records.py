@@ -122,3 +122,55 @@ def test_multi_field_feature_pack(tmp_path):
     np.testing.assert_array_equal(xb, b[:10])
     np.testing.assert_array_equal(mb["target"], y[:10])
     ds.close()
+
+
+def test_thread_prefetch_overlap_and_errors():
+    import time
+
+    from bigdl_tpu.data.prefetch import thread_prefetch
+
+    def slow_producer():
+        for i in range(4):
+            time.sleep(0.05)
+            yield i
+
+    t0 = time.time()
+    out = []
+    for b in thread_prefetch(slow_producer(), depth=2):
+        time.sleep(0.05)          # consumer work overlaps producer work
+        out.append(b)
+    dt = time.time() - t0
+    assert out == [0, 1, 2, 3]
+    assert dt < 0.35, dt          # sequential would be ~0.4s
+
+    def bad():
+        yield 1
+        raise RuntimeError("producer boom")
+
+    it = thread_prefetch(bad(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+    with pytest.raises(ValueError):
+        list(thread_prefetch(iter([1]), depth=0))
+
+
+def test_optimizer_with_host_prefetch(rec):
+    """host_prefetch=2 trains correctly from a record file (producer runs a
+    thread ahead of the device dispatch loop)."""
+    import jax
+
+    from bigdl_tpu import nn, optim
+
+    p, x, y = rec
+    ds = RecordDataSet(p)
+    model = nn.Sequential([nn.Flatten(), nn.Linear(48, 5)])
+    opt = optim.Optimizer(model, ds, nn.CrossEntropyCriterion(),
+                          batch_size=40)
+    opt.host_prefetch = 2
+    opt.set_optim_method(optim.Adam(learning_rate=0.05))
+    opt.set_end_when(optim.Trigger.max_epoch(3))
+    trained = opt.optimize()
+    assert trained is not None
+    ds.close()
