@@ -106,6 +106,15 @@ def main(argv=None) -> int:
     sub.add_parser("bench", help="run the repo benchmark (bench.py)")
     sub.add_parser("dryrun", help="8-virtual-device multichip dry run")
 
+    pack = sub.add_parser(
+        "pack", help="pack arrays into a BTRECv1 record file "
+        "(train-from-disk input, data/records.py)")
+    pack.add_argument("src", help=".npz (fields = array names) or .csv "
+                      "(fields x=float cols, y=label col)")
+    pack.add_argument("out", help="output .btrec path")
+    pack.add_argument("--label-col", default=None,
+                      help="csv: which column is the label (default: last)")
+
     args = ap.parse_args(argv)
     if args.cmd == "run":
         return _run(args)
@@ -117,7 +126,36 @@ def main(argv=None) -> int:
         return subprocess.call([
             sys.executable, "-c",
             "import __graft_entry__ as g; g.dryrun_multichip(8)"], cwd=repo)
+    if args.cmd == "pack":
+        return _pack(args)
     return 2
+
+
+def _pack(args) -> int:
+    import numpy as np
+
+    from bigdl_tpu.data.records import write_records
+
+    if args.src.endswith(".npz"):
+        data = np.load(args.src)
+        fields = {k: data[k] for k in data.files}
+    elif args.src.endswith(".csv"):
+        import pandas as pd
+
+        df = pd.read_csv(args.src)
+        label = args.label_col or df.columns[-1]
+        fields = {
+            "x": df.drop(columns=[label]).to_numpy(np.float32),
+            "y": df[label].to_numpy(),
+        }
+    else:
+        print(f"pack: unsupported source {args.src!r} (.npz or .csv)",
+              file=sys.stderr)
+        return 2
+    write_records(args.out, fields)
+    n = len(next(iter(fields.values())))
+    print(f"packed {n} records x {list(fields)} -> {args.out}")
+    return 0
 
 
 if __name__ == "__main__":

@@ -77,3 +77,34 @@ def test_cli_gang_kills_peers_when_one_rank_crashes(tmp_path):
         env=_repo_env(), capture_output=True, text=True, timeout=120)
     assert out.returncode == 7
     assert time.time() - t0 < 60     # fail-fast, not the 600s sleep
+
+
+def test_cli_pack_npz_and_csv(tmp_path):
+    import numpy as np
+
+    np.savez(tmp_path / "d.npz", x=np.random.rand(10, 3).astype("float32"),
+             y=np.arange(10, dtype="int32"))
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "pack",
+         str(tmp_path / "d.npz"), str(tmp_path / "d.btrec")],
+        env=_repo_env(), capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    from bigdl_tpu.data.records import RecordDataSet
+
+    ds = RecordDataSet(str(tmp_path / "d.btrec"))
+    assert ds.size() == 10 and ds.label == "y"
+    ds.close()
+
+    import pandas as pd
+
+    pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0],
+                  "label": [0, 1]}).to_csv(tmp_path / "d.csv", index=False)
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "pack",
+         str(tmp_path / "d.csv"), str(tmp_path / "c.btrec")],
+        env=_repo_env(), capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    ds = RecordDataSet(str(tmp_path / "c.btrec"))
+    mb = next(ds.batches(2, shuffle=False, drop_last=False))
+    assert mb["input"].shape == (2, 2)
+    ds.close()
