@@ -17,7 +17,9 @@ Conventions/limits (raise with a clear message otherwise):
   GroupNorm, LayerNorm, Embedding, PReLU, activations, pooling
   (Max/Avg/AdaptiveAvg(1)), Flatten, Dropout, MultiheadAttention
   (batch_first), LSTM/GRU (batch_first; any num_layers, bidirectional,
-  inter-layer dropout — converted as a chain of scan layers).
+  inter-layer dropout — converted as a chain of scan layers),
+  TransformerEncoder/TransformerEncoderLayer (structural leaf, both norm
+  orders; their forwards break under symbolic trace), Upsample.
 - supported graph ops: +, *, cat, flatten/view(b,-1), mean over spatial,
   relu/gelu/sigmoid/tanh/softmax, getitem(0) on MHA/LSTM outputs.
 """
@@ -294,7 +296,9 @@ class _ConvertTracer:
     def build(self, tmodule):
         import torch.fx as fx
 
-        leaf_names = set(_SIMPLE) | {"AdaptiveAvgPool2d", "LSTM", "GRU"}
+        leaf_names = set(_SIMPLE) | {"AdaptiveAvgPool2d", "LSTM", "GRU",
+                             "TransformerEncoder",
+                             "TransformerEncoderLayer"}
 
         class T(fx.Tracer):
             def is_leaf_module(self, m, qualname):
@@ -325,6 +329,23 @@ def from_torch_module(tmodule, example_input=None):
     import torch
 
     tmodule = tmodule.eval()
+    # fx always traces the ROOT's forward, so a module that must convert
+    # as a leaf (RNNs, TransformerEncoder[Layer], MHA — their forwards
+    # break under symbolic trace) gets a trivial wrapper root; export
+    # quals drop the wrapper prefix again below
+    _LEAF_ROOTS = {"LSTM", "GRU", "TransformerEncoder",
+                   "TransformerEncoderLayer", "MultiheadAttention"}
+    wrapped = type(tmodule).__name__ in _LEAF_ROOTS
+    if wrapped:
+        class _Root(torch.nn.Module):
+            def __init__(self, m):
+                super().__init__()
+                self.mod = m
+
+            def forward(self, x):
+                return self.mod(x)
+
+        tmodule = _Root(tmodule)
     gm = _ConvertTracer().build(tmodule)
     if example_input is not None:
         from torch.fx.passes.shape_prop import ShapeProp
@@ -482,6 +503,77 @@ def from_torch_module(tmodule, example_input=None):
                     if p:
                         params[kn.name] = p
                         export_map.append((kn.name, node.target, tag, None))
+                sym[node] = kn
+                continue
+            if tname in ("TransformerEncoder", "TransformerEncoderLayer"):
+                # torch's forward has mask-canonicalization that breaks fx
+                # tracing, so the layer is a LEAF converted structurally:
+                # its anatomy (self_attn/linear1/linear2/norm1/norm2,
+                # norm_first) is fixed by torch
+                def put(kn2, layer, p, qual2, sub_tname):
+                    kn2 = layer(kn2)
+                    if p:
+                        params[kn2.name] = p
+                        export_map.append((kn2.name, qual2, sub_tname, None))
+                    return kn2
+
+                def one_block(tl, kn_in, qual2):
+                    if tl.self_attn.batch_first is False:
+                        raise NotImplementedError(
+                            "TransformerEncoderLayer needs batch_first=True")
+                    act = {torch.nn.functional.relu: N.ReLU,
+                           torch.nn.functional.gelu: N.GELU}.get(
+                        tl.activation)
+                    if act is None:
+                        raise NotImplementedError(
+                            f"encoder activation {tl.activation}")
+                    mha_l, mha_p, _ = _mha(tl.self_attn)
+
+                    def attn_part(kn_x):
+                        a = put(kn_x, mha_l, mha_p,
+                                f"{qual2}.self_attn", "MultiheadAttention")
+                        if tl.dropout1.p:
+                            a = N.Dropout(tl.dropout1.p)(a)
+                        return a
+
+                    def ff_part(kn_x):
+                        l1, p1, _ = _linear(tl.linear1)
+                        h = put(kn_x, l1, p1, f"{qual2}.linear1", "Linear")
+                        h = act()(h)
+                        if tl.dropout.p:
+                            h = N.Dropout(tl.dropout.p)(h)
+                        l2, p2, _ = _linear(tl.linear2)
+                        h = put(h, l2, p2, f"{qual2}.linear2", "Linear")
+                        if tl.dropout2.p:
+                            h = N.Dropout(tl.dropout2.p)(h)
+                        return h
+
+                    def norm(kn_x, tn, name):
+                        nl, np_, _ = _layernorm(tn)
+                        return put(kn_x, nl, np_, f"{qual2}.{name}",
+                                   "LayerNorm")
+
+                    if tl.norm_first:
+                        a = attn_part(norm(kn_in, tl.norm1, "norm1"))
+                        x1 = N.CAddTable()([kn_in, a])
+                        f = ff_part(norm(x1, tl.norm2, "norm2"))
+                        return N.CAddTable()([x1, f])
+                    a = attn_part(kn_in)
+                    x1 = norm(N.CAddTable()([kn_in, a]), tl.norm1, "norm1")
+                    f = ff_part(x1)
+                    return norm(N.CAddTable()([x1, f]), tl.norm2, "norm2")
+
+                kn = sym[src_nodes[0]]
+                if tname == "TransformerEncoder":
+                    for li, tl in enumerate(tm.layers):
+                        kn = one_block(tl, kn,
+                                       f"{node.target}.layers.{li}")
+                    if tm.norm is not None:
+                        nl, np_, _ = _layernorm(tm.norm)
+                        kn = put(kn, nl, np_, f"{node.target}.norm",
+                                 "LayerNorm")
+                else:
+                    kn = one_block(tm, kn, node.target)
                 sym[node] = kn
                 continue
             if tname not in _SIMPLE:
@@ -670,6 +762,11 @@ def from_torch_module(tmodule, example_input=None):
             raise NotImplementedError(
                 f"free tensor attribute {node.target} in the graph")
 
+    if wrapped:  # strip the wrapper prefix from export quals
+        def _strip(q):
+            return q[4:] if q.startswith("mod.") else ("" if q == "mod" else q)
+
+        export_map = [(n, _strip(q), t, pf) for n, q, t, pf in export_map]
     model = Model(inputs, outputs, name="TorchConverted")
     model._torch_export_map = export_map
     return model, {"params": params, "state": state}

@@ -603,3 +603,58 @@ def test_functional_interpolate_converts():
         ty = Net()(torch.tensor(x))
     np.testing.assert_allclose(np.asarray(y),
                                ty.numpy().transpose(0, 2, 3, 1), atol=1e-6)
+
+
+def test_transformer_encoder_conversion():
+    """torch.nn.TransformerEncoder (both norm orders) converts as a
+    structural leaf — its forward's mask canonicalization breaks fx — with
+    forward parity and exact state_dict export round-trip."""
+    for norm_first in (False, True):
+        enc = torch.nn.TransformerEncoder(
+            torch.nn.TransformerEncoderLayer(
+                16, 2, 32, batch_first=True, dropout=0.0,
+                activation="gelu", norm_first=norm_first),
+            num_layers=2).eval()
+        x = RS.rand(2, 5, 16).astype(np.float32)
+        model, variables = from_torch_module(enc, example_input=x)
+        y, _ = model.apply(variables, x)
+        with torch.no_grad():
+            ty = enc(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-3,
+                                   err_msg=f"norm_first={norm_first}")
+        enc2 = torch.nn.TransformerEncoder(
+            torch.nn.TransformerEncoderLayer(
+                16, 2, 32, batch_first=True, dropout=0.0,
+                activation="gelu", norm_first=norm_first), 2)
+        enc2.load_state_dict(export_state_dict(model, variables),
+                             strict=True)
+        enc2.eval()
+        with torch.no_grad():
+            ty2 = enc2(torch.tensor(x))
+        np.testing.assert_allclose(ty2.numpy(), ty.numpy(), atol=1e-5)
+
+
+def test_bert_like_classifier_with_encoder_stack():
+    """Embedding + TransformerEncoder + mean-pool + head — the standard
+    huggingface-ish composition — converts and fine-tunes."""
+
+    class Clf(torch.nn.Module):
+        def __init__(self, vocab=40, d=16):
+            super().__init__()
+            self.emb = torch.nn.Embedding(vocab, d)
+            self.enc = torch.nn.TransformerEncoder(
+                torch.nn.TransformerEncoderLayer(
+                    d, 2, 32, batch_first=True, dropout=0.0), 1)
+            self.cls = torch.nn.Linear(d, 2)
+
+        def forward(self, ids):
+            h = self.enc(self.emb(ids))
+            return self.cls(h.mean(dim=[1]))
+
+    tm = Clf().eval()
+    ids = RS.randint(0, 40, (3, 6)).astype(np.int64)
+    model, variables = from_torch_module(tm, example_input=ids)
+    y, _ = model.apply(variables, ids.astype(np.int32))
+    with torch.no_grad():
+        ty = tm(torch.tensor(ids))
+    np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-3)
