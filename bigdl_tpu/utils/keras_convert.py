@@ -192,6 +192,38 @@ def _convert_time_distributed(klayer, cfg):
     return _convert_dense(inner, inner.get_config())
 
 
+def _convert_mha(klayer, cfg):
+    """keras-3 MultiHeadAttention: einsum-shaped per-head kernels
+    (d, heads, head_dim) reshape onto the native fused projections
+    (d, heads*head_dim)."""
+    from bigdl_tpu import nn as N
+
+    heads, kd = cfg["num_heads"], cfg["key_dim"]
+    if cfg.get("value_dim") not in (None, kd):
+        raise UnsupportedKerasLayer("MultiHeadAttention value_dim != key_dim")
+    if cfg.get("output_shape") is not None:
+        raise UnsupportedKerasLayer("MultiHeadAttention output_shape")
+    if tuple(cfg.get("attention_axes") or (1,)) != (1,):
+        raise UnsupportedKerasLayer("MultiHeadAttention attention_axes")
+    if not cfg.get("use_bias", True):
+        raise UnsupportedKerasLayer("MultiHeadAttention use_bias=False")
+    w = klayer.get_weights()
+    qk, qb, kk, kb, vk, vb, ok, ob = w
+    d_model = qk.shape[0]
+    h = heads * kd
+    layer = N.MultiHeadAttention(h, heads, attn_dropout=cfg.get("dropout", 0))
+    if h != d_model:
+        # our wo is (h, d_model) already — shapes line up either way
+        pass
+    params = {
+        "wq": qk.reshape(d_model, h), "bq": qb.reshape(h),
+        "wk": kk.reshape(kk.shape[0], h), "bk": kb.reshape(h),
+        "wv": vk.reshape(vk.shape[0], h), "bv": vb.reshape(h),
+        "wo": ok.reshape(h, ok.shape[-1]), "bo": ob,
+    }
+    return [(layer, params, {}, "mha")]
+
+
 def _convert_batchnorm(klayer, cfg):
     from bigdl_tpu import nn as N
 
@@ -422,6 +454,7 @@ _CONVERTERS = {
     "Conv2DTranspose": _convert_conv2d_transpose,
     "SeparableConv2D": _convert_separable,
     "TimeDistributed": _convert_time_distributed,
+    "MultiHeadAttention": _convert_mha,
     "BatchNormalization": _convert_batchnorm,
     "LayerNormalization": _convert_layernorm,
     "Embedding": _convert_embedding,
@@ -537,6 +570,16 @@ def from_tf_keras(kmodel):
                     f"({klayer.name!r})")
 
             parents = [sym[id(t)] for t in knode.input_tensors]
+            if lname == "MultiHeadAttention":
+                # call(query, value, key=value): our layer consumes
+                # (x, context) with k and v both from context
+                if len(parents) == 3:
+                    if knode.input_tensors[1] is not knode.input_tensors[2]:
+                        raise UnsupportedKerasLayer(
+                            "MultiHeadAttention with key is not value")
+                    parents = parents[:2]
+                if len(parents) == 2 and parents[0] is parents[1]:
+                    parents = parents[:1]          # plain self-attention
             if not steps:  # identity-like
                 out = parents[0]
             else:
@@ -640,6 +683,17 @@ def export_tf_keras_weights(model, variables, kmodel) -> None:
             inner = kind[2:]
             w = (_rnn_weights(inner, p["fwd"], use_bias)
                  + _rnn_weights(inner, p["bwd"], use_bias))
+        elif kind == "mha":
+            kcfg = klayer.get_config()
+            heads, kd = kcfg["num_heads"], kcfg["key_dim"]
+            w = [np.asarray(p["wq"]).reshape(-1, heads, kd),
+                 np.asarray(p["bq"]).reshape(heads, kd),
+                 np.asarray(p["wk"]).reshape(-1, heads, kd),
+                 np.asarray(p["bk"]).reshape(heads, kd),
+                 np.asarray(p["wv"]).reshape(-1, heads, kd),
+                 np.asarray(p["bv"]).reshape(heads, kd),
+                 np.asarray(p["wo"]).reshape(heads, kd, -1),
+                 np.asarray(p["bo"])]
         elif kind == "prelu":
             cur = klayer.get_weights()[0]
             w = [np.asarray(p["alpha"]).reshape(cur.shape)]
