@@ -103,7 +103,8 @@ class ShardedParameterStep:
     def __init__(self, model, criterion, optim_method, mesh: Mesh,
                  init_variables: Dict[str, Any],
                  clip: Optional[GradientClipping] = None,
-                 bf16_grads: bool = False, remat: bool = False):
+                 bf16_grads: bool = False, remat: bool = False,
+                 accum_steps: int = 1):
         """``bf16_grads``: reduce-scatter the gradient vector in bfloat16 —
         halves the per-step collective bytes (the FP16CompressedTensor
         analog; worthwhile when the data axis spans DCN, unnecessary over
@@ -111,7 +112,14 @@ class ShardedParameterStep:
 
         ``remat``: wrap the forward in ``jax.checkpoint`` so the backward
         recomputes activations instead of storing them — trades FLOPs for
-        HBM on memory-bound models (big batch / long sequence)."""
+        HBM on memory-bound models (big batch / long sequence).
+
+        ``accum_steps``: gradient accumulation — each device splits its
+        per-step batch into ``accum_steps`` microbatches, runs fwd+bwd per
+        microbatch under ``lax.scan`` (activations for ONE microbatch live
+        at a time) summing flat gradients in f32, then does a single ZeRO-1
+        update.  Numerically the mean gradient of the full batch; the
+        per-device batch must be divisible by it."""
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -119,6 +127,7 @@ class ShardedParameterStep:
         self.clip = clip
         self.bf16_grads = bf16_grads
         self.remat = remat
+        self.accum_steps = int(accum_steps)
         self.ndev = mesh.shape[AXIS_DATA]
 
         flat, self.unravel = ravel_pytree(init_variables["params"])
@@ -159,24 +168,54 @@ class ShardedParameterStep:
         clip = self.clip
         elementwise = optim.elementwise
         bf16_grads, remat = self.bf16_grads, self.remat
+        accum = max(1, self.accum_steps)
 
         def step_shard(flat_p, opt_state, mstate, step, rng, x, y):
             params = unravel(flat_p[:n_real])
             dev_rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
 
-            xs = as_inputs(x)
+            def grad_of(p, ms, xs_mb, y_mb, rng_mb):
+                def loss_fn(pp):
+                    out, new_ms = model.forward(
+                        pp, ms, *xs_mb, training=True, rng=rng_mb)
+                    return criterion.forward(out, y_mb), new_ms
 
-            def loss_fn(p):
-                out, new_mstate = model.forward(
-                    p, mstate, *xs, training=True, rng=dev_rng)
-                return criterion.forward(out, y), new_mstate
+                if remat:
+                    loss_fn = jax.checkpoint(loss_fn)
+                return jax.value_and_grad(loss_fn, has_aux=True)(p)
 
-            if remat:
-                loss_fn = jax.checkpoint(loss_fn)
+            if accum == 1:
+                (loss, new_mstate), grads = grad_of(
+                    params, mstate, as_inputs(x), y, dev_rng)
+                flat_g, _ = ravel_pytree(grads)
+            else:
+                # microbatch scan: one microbatch's activations live at a
+                # time; flat f32 gradient accumulates across iterations
+                def split(a):
+                    return a.reshape((accum, a.shape[0] // accum)
+                                     + a.shape[1:])
 
-            (loss, new_mstate), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            flat_g, _ = ravel_pytree(grads)
+                xs_s = tuple(split(a) for a in as_inputs(x))
+                y_s = split(y)
+
+                def micro(carry, inp):
+                    ms_c, gsum, lsum, k = carry
+                    xs_mb = inp[:-1]
+                    y_mb = inp[-1]
+                    rng_mb = jax.random.fold_in(dev_rng, k)
+                    (l, new_ms), grads = grad_of(params, ms_c, xs_mb, y_mb,
+                                                 rng_mb)
+                    fg, _ = ravel_pytree(grads)
+                    return (new_ms, gsum + fg.astype(jnp.float32),
+                            lsum + l, k + 1), None
+
+                gsum0 = jnp.zeros((n_real,), jnp.float32)
+                (new_mstate, gsum, lsum, _), _ = jax.lax.scan(
+                    micro, (mstate, gsum0, jnp.asarray(0.0, jnp.float32),
+                            jnp.asarray(0, jnp.int32)),
+                    xs_s + (y_s,))
+                flat_g = gsum / accum
+                loss = lsum / accum
             flat_g = jnp.pad(flat_g, (0, flat_p.shape[0] - n_real))
             if bf16_grads:
                 flat_g = flat_g.astype(jnp.bfloat16)
@@ -198,6 +237,8 @@ class ShardedParameterStep:
             else:
                 # layerwise methods (LARS): plain psum allreduce + replicated
                 # update (matches the reference's treatment pre-slice-sharding)
+                if accum > 1:   # re-tree the accumulated flat gradient
+                    grads = unravel(flat_g[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, AXIS_DATA), grads)
                 if clip is not None and clip.l2_norm is not None:
