@@ -124,6 +124,9 @@ class Optimizer:
         self.log_every = 1
         self.prefetch = 2  # device-transfer lookahead depth (1 = no overlap)
         self.host_prefetch = 0  # host-side producer lookahead (0 = inline)
+        self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
+        self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
+        self.accum_steps = 1     # gradient-accumulation microbatches
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
@@ -236,7 +239,8 @@ class Optimizer:
             or self.model.init(rng, *init_args)
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
-            clip=self.clip)
+            clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,
+            accum_steps=self.accum_steps)
         n_params = step_engine.n_real
         log.info("model has %s parameters; mesh data axis = %d; ZeRO shard = %s",
                  f"{n_params:,}", step_engine.ndev,

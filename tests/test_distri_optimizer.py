@@ -364,3 +364,19 @@ def test_grad_accumulation_matches_full_batch():
                                 mesh, variables, accum_steps=2)
     l0 = float(lars.train_step(0, rng, x, y))
     assert np.isfinite(l0)
+
+
+def test_optimizer_exposes_step_knobs():
+    """bf16_grads/remat/accum_steps set on the Optimizer reach the step
+    engine and training still converges."""
+    x, y = synthetic_classification(n=256)
+    ds = ArrayDataSet(x, y)
+    opt = optim.Optimizer(mlp(), ds, nn.ClassNLLCriterion(), batch_size=64)
+    opt.accum_steps = 2
+    opt.remat = True
+    opt.set_optim_method(optim.Adam(learning_rate=1e-2))
+    opt.set_end_when(optim.Trigger.max_epoch(6))
+    opt.log_every = 100
+    trained = opt.optimize()
+    res = trained.evaluate(ds, [optim.Top1Accuracy()])
+    assert res[0].result > 0.9, res
