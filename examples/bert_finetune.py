@@ -8,6 +8,20 @@ bigdl_tpu BERT classifier over the mesh with the ZeRO-1 sharded step.
     python examples/bert_finetune.py [--steps 30]
 """
 
+import os
+
+if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
+    # default to the simulated CPU mesh: with the TPU tunnel down, backend
+    # init would hang; set BIGDL_TPU_REAL_CHIPS=1 to use real chips
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
+    jax.config.update("jax_platforms", "cpu")
+
 import argparse
 
 import numpy as np
@@ -37,7 +51,7 @@ def synthetic_sentences(n=1024, seq=64, vocab=1000, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--steps", type=int, default=120)
     ap.add_argument("--batch", type=int, default=64)
     args = ap.parse_args()
 

@@ -1,0 +1,95 @@
+"""End-to-end Seq2Seq: record-file input → encoder-decoder Transformer →
+KV-cached decode.
+
+Integrates three round-3 subsystems: the native mmap record loader
+(``data/records.py``; samples never have to fit in Python RAM), the WMT-
+style ``nn.Transformer`` (translation mode, weight-tied embedding), and
+``transformer_decode_cached`` (per-layer KV caches at inference).
+
+Task: translate a token sequence to its reverse.  Run:
+``python examples/seq2seq_records.py``
+"""
+
+import os
+import tempfile
+
+if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from bigdl_tpu.data.records import RecordDataSet, write_records
+from bigdl_tpu.nn import Transformer
+from bigdl_tpu.nn.attention import transformer_decode_cached
+from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+from bigdl_tpu.optim.optim_method import Adam
+
+BOS, EOS = 1, 0
+
+
+def main():
+    rs = np.random.RandomState(0)
+    vocab, t, n = 24, 6, 1024
+    src = rs.randint(2, vocab, (n, t)).astype(np.int32)
+    tgt = np.concatenate([src[:, ::-1], np.full((n, 1), EOS, np.int32)], 1)
+    tgt_in = np.concatenate([np.full((n, 1), BOS, np.int32),
+                             tgt[:, :-1]], 1)
+
+    # pack the corpus into ONE record file; training reads it back through
+    # the native mmap gather (no full-dataset array resident in the loop)
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "wmt_toy.btrec")
+    write_records(path, {"src": src, "tgt_in": tgt_in, "tgt": tgt})
+    ds = RecordDataSet(path, feature=["src", "tgt_in"], label="tgt")
+    print(f"record file: {os.path.getsize(path) / 1e3:.0f} kB, "
+          f"{ds.size()} samples")
+
+    model = Transformer(vocab, hidden_size=32, num_heads=4, num_layers=2,
+                        dropout=0.0)
+    variables = model.init(jax.random.PRNGKey(0), src[:2], tgt_in[:2])
+    params = variables["params"]
+    crit = CrossEntropyCriterion()
+    method = Adam(learning_rate=2e-3)
+    opt_state = method.init_state(params)
+
+    @jax.jit
+    def step(i, params, opt_state, src_b, tgt_in_b, tgt_b):
+        def loss_fn(p):
+            logits, _ = model.forward(p, {}, src_b, tgt_in_b)
+            return crit(logits.reshape(-1, vocab), tgt_b.reshape(-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = method.update(i, grads, params, opt_state)
+        return params, opt_state, loss
+
+    it = 0
+    for epoch in range(25):
+        for mb in ds.batches(128, shuffle=True, seed=0, epoch=epoch):
+            src_b, tgt_in_b = mb["input"]          # multi-field record pack
+            params, opt_state, loss = step(
+                it, params, opt_state, src_b, tgt_in_b, mb["target"])
+            it += 1
+        if epoch % 5 == 4:
+            print(f"epoch {epoch}: loss {float(loss):.4f}")
+    ds.close()
+
+    # KV-cached greedy decode — O(L) attention per generated token
+    tokens, _ = transformer_decode_cached(model, params, src[:4], BOS, EOS,
+                                          max_len=t + 1)
+    pred = np.asarray(tokens)[:, 1:t + 1]
+    acc = (pred == src[:4, ::-1]).mean()
+    print(f"decode token accuracy: {acc:.2f}")
+    assert acc > 0.9, acc
+    print("src[0]    :", src[0].tolist())
+    print("decoded[0]:", pred[0].tolist())
+
+
+if __name__ == "__main__":
+    main()
