@@ -1,0 +1,28 @@
+"""Shared example bootstrap: ``import _sim_mesh`` FIRST in every example.
+
+Defaults to the simulated 8-virtual-device CPU mesh — with the TPU tunnel
+down, real-backend init hangs, so examples must opt IN to real chips with
+``BIGDL_TPU_REAL_CHIPS=1`` ("0"/"false"/empty count as off).
+
+In simulated mode CPU is forced UNCONDITIONALLY: this image exports
+``JAX_PLATFORMS=axon`` by default (not a user choice), and the axon plugin
+also ignores the env var — so both the env var and ``jax.config`` are set
+to cpu (the tests/conftest gotcha).  With real chips opted in, nothing is
+touched.
+"""
+
+import os
+
+
+def _on(v: str) -> bool:
+    return v.strip().lower() not in ("", "0", "false", "no")
+
+
+if not _on(os.environ.get("BIGDL_TPU_REAL_CHIPS", "")):
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

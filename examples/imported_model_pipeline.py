@@ -4,24 +4,11 @@ int8-quantize -> serve over the dynamic-batching engine.
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/imported_model_pipeline.py
 """
 
-import os
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    # default to the simulated CPU mesh: with the TPU tunnel down, backend
-    # init would hang; set BIGDL_TPU_REAL_CHIPS=1 to use real chips
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    jax.config.update("jax_platforms", "cpu")
+import _sim_mesh  # noqa: F401  (must be first: simulated-mesh default)
 
 import os
 import tempfile
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import jax
 import numpy as np

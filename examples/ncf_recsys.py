@@ -4,19 +4,7 @@ evaluation — the BigDL NCF headline workload shape.
     python examples/ncf_recsys.py [--steps 200]
 """
 
-import os
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    # default to the simulated CPU mesh: with the TPU tunnel down, backend
-    # init would hang; set BIGDL_TPU_REAL_CHIPS=1 to use real chips
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    jax.config.update("jax_platforms", "cpu")
+import _sim_mesh  # noqa: F401  (must be first: simulated-mesh default)
 
 import argparse
 

@@ -10,18 +10,12 @@ Task: translate a token sequence to its reverse.  Run:
 ``python examples/seq2seq_records.py``
 """
 
+import _sim_mesh  # noqa: F401  (must be first: simulated-mesh default)
 import os
 import tempfile
 
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -44,8 +38,8 @@ def main():
 
     # pack the corpus into ONE record file; training reads it back through
     # the native mmap gather (no full-dataset array resident in the loop)
-    d = tempfile.mkdtemp()
-    path = os.path.join(d, "wmt_toy.btrec")
+    tmp = tempfile.TemporaryDirectory()
+    path = os.path.join(tmp.name, "wmt_toy.btrec")
     write_records(path, {"src": src, "tgt_in": tgt_in, "tgt": tgt})
     ds = RecordDataSet(path, feature=["src", "tgt_in"], label="tgt")
     print(f"record file: {os.path.getsize(path) / 1e3:.0f} kB, "
@@ -79,6 +73,7 @@ def main():
         if epoch % 5 == 4:
             print(f"epoch {epoch}: loss {float(loss):.4f}")
     ds.close()
+    tmp.cleanup()
 
     # KV-cached greedy decode — O(L) attention per generated token
     tokens, _ = transformer_decode_cached(model, params, src[:4], BOS, EOS,

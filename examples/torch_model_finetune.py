@@ -10,17 +10,7 @@ Run: ``python examples/torch_model_finetune.py``
 (CPU: forces an 8-virtual-device mesh; on a TPU host it uses the chips.)
 """
 
-import os
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    jax.config.update("jax_platforms", "cpu")
+import _sim_mesh  # noqa: F401  (must be first: simulated-mesh default)
 
 import numpy as np
 import torch

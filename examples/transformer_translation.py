@@ -9,17 +9,9 @@ but exercises the full encoder-decoder path end to end.
 Run: ``python examples/transformer_translation.py``
 """
 
-import os
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=8")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import _sim_mesh  # noqa: F401  (must be first: simulated-mesh default)
 
 import jax
-
-if not os.environ.get("BIGDL_TPU_REAL_CHIPS"):
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
