@@ -35,6 +35,10 @@ _DEFAULT_RULES: Tuple[Tuple[str, Callable[[], P]], ...] = (
     (r"(^|/)(w1|ffn/l1/weight)$", lambda: P(None, AXIS_MODEL)),
     (r"(^|/)(b1|ffn/l1/bias)$", lambda: P(AXIS_MODEL)),
     (r"(^|/)(w2|ffn/l2/weight)$", lambda: P(AXIS_MODEL, None)),
+    # the (vocab, d) embedding — usually the single biggest parameter —
+    # shards along vocab; gathers/tied-output matmuls get GSPMD-inserted
+    # collectives
+    (r"(^|/)(embedding|emb/weight)$", lambda: P(AXIS_MODEL, None)),
 )
 
 
