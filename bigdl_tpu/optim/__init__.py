@@ -5,7 +5,7 @@ from bigdl_tpu.optim.optim_method import (
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Step, MultiStep, Exponential, NaturalExp,
     Poly, Warmup, SequentialSchedule, Plateau,
-    EpochStep, EpochDecay, EpochSchedule,
+    EpochStep, EpochDecay, EpochSchedule, Cosine,
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (

@@ -146,6 +146,23 @@ class EpochSchedule(LearningRateSchedule):
         return out
 
 
+class Cosine(LearningRateSchedule):
+    """Cosine decay to ``alpha * lr`` over ``decay_steps`` (the standard
+    TPU large-batch recipe tail; pair with ``Warmup`` in a
+    ``SequentialSchedule``).  Past ``decay_steps`` the floor persists."""
+
+    def __init__(self, decay_steps: int, alpha: float = 0.0):
+        if decay_steps <= 0:
+            raise ValueError("decay_steps must be positive")
+        self.decay_steps = decay_steps
+        self.alpha = alpha
+
+    def __call__(self, lr, step):
+        frac = jnp.clip(step / self.decay_steps, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return lr * ((1 - self.alpha) * cos + self.alpha)
+
+
 class Warmup(LearningRateSchedule):
     """Linear ramp by delta per step — SGD.Warmup (pair inside
     SequentialSchedule like the reference's large-batch ImageNet recipe)."""

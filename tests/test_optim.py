@@ -303,3 +303,28 @@ def test_epoch_schedule_accepts_unsorted_regimes():
     sched = EpochSchedule([(5, 8, 0.01), (1, 2, 0.1)], steps_per_epoch=10)
     assert float(sched(1.0, 10)) == pytest.approx(0.1)    # epoch 2
     assert float(sched(1.0, 55)) == pytest.approx(0.01)   # epoch 6
+
+
+def test_cosine_schedule():
+    """Warmup -> cosine-to-floor, the standard TPU large-batch recipe."""
+    from bigdl_tpu.optim import Cosine, SequentialSchedule, Warmup
+
+    c = optim.Cosine(100, alpha=0.1)
+    assert float(c(1.0, 0)) == pytest.approx(1.0)
+    assert float(c(1.0, 50)) == pytest.approx(0.55)     # midpoint
+    assert float(c(1.0, 100)) == pytest.approx(0.1)     # floor
+    assert float(c(1.0, 500)) == pytest.approx(0.1)     # floor persists
+
+    seq = SequentialSchedule()
+    seq.add(Warmup(0.01), 10).add(Cosine(90), 90)
+    assert float(seq(0.1, 0)) == pytest.approx(0.1)
+    assert float(seq(0.1, 10)) == pytest.approx(0.1)    # cosine start
+    assert float(seq(0.1, 100)) == pytest.approx(0.0, abs=1e-6)
+
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda s: c(1.0, s))                    # jit-traceable
+    assert float(f(jnp.asarray(50))) == pytest.approx(0.55)
+    with pytest.raises(ValueError):
+        optim.Cosine(0)
