@@ -10,7 +10,7 @@ from bigdl_tpu.optim.schedules import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss, MAE,
-    MSE, HitRatio, NDCG, AUC,
+    MSE, HitRatio, NDCG, AUC, Precision, Recall,
 )
 from bigdl_tpu.optim.optimizer import (
     Optimizer, DistriOptimizer, LocalOptimizer, TrainedModel,

@@ -140,6 +140,41 @@ def _rank_of_positive(output, target):
     return jnp.where(bad, jnp.asarray(output.shape[-1], rank.dtype), rank)
 
 
+class Precision(ValidationMethod):
+    """Per-class precision TP / predicted-positive (default: class 1, the
+    binary-positive convention)."""
+
+    name = "Precision"
+
+    def __init__(self, positive_class: int = 1):
+        self.cls = positive_class
+
+    def batch_stats(self, output, target, weight=None):
+        pred = jnp.argmax(output, axis=-1).reshape(-1)
+        tgt = _class_target(output, target).reshape(pred.shape)
+        w = _w(weight, pred.shape[0])
+        pp = (pred == self.cls).astype(jnp.float32) * w
+        tp = pp * (tgt == self.cls)
+        return jnp.sum(tp), jnp.sum(pp)
+
+
+class Recall(ValidationMethod):
+    """Per-class recall TP / actual-positive (default: class 1)."""
+
+    name = "Recall"
+
+    def __init__(self, positive_class: int = 1):
+        self.cls = positive_class
+
+    def batch_stats(self, output, target, weight=None):
+        pred = jnp.argmax(output, axis=-1).reshape(-1)
+        tgt = _class_target(output, target).reshape(pred.shape)
+        w = _w(weight, pred.shape[0])
+        ap = (tgt == self.cls).astype(jnp.float32) * w
+        tp = ap * (pred == self.cls)
+        return jnp.sum(tp), jnp.sum(ap)
+
+
 class HitRatio(ValidationMethod):
     """HR@k over candidate scores — reference ``optim/ValidationMethod.scala``
     ``HitRatio(k, negNum)`` (recsys eval: did the positive item rank in the

@@ -328,3 +328,20 @@ def test_cosine_schedule():
     assert float(f(jnp.asarray(50))) == pytest.approx(0.55)
     with pytest.raises(ValueError):
         optim.Cosine(0)
+
+
+def test_precision_recall_methods():
+    import jax.numpy as jnp
+
+    out = jnp.asarray([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]])
+    tgt = jnp.asarray([1, 1, 0, 0])      # preds: 0,1,1,0
+    p = optim.Precision()
+    s, c = p.batch_stats(out, tgt)
+    assert (float(s), float(c)) == (1.0, 2.0)   # TP=1 of 2 predicted-pos
+    r = optim.Recall()
+    s, c = r.batch_stats(out, tgt)
+    assert (float(s), float(c)) == (1.0, 2.0)   # TP=1 of 2 actual-pos
+    # padded rows (weight 0) are excluded
+    w = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+    s, c = p.batch_stats(out, tgt, w)
+    assert (float(s), float(c)) == (1.0, 1.0)
