@@ -104,7 +104,7 @@ class ShardedParameterStep:
                  init_variables: Dict[str, Any],
                  clip: Optional[GradientClipping] = None,
                  bf16_grads: bool = False, remat: bool = False,
-                 accum_steps: int = 1):
+                 accum_steps: int = 1, ema_decay: float = 0.0):
         """``bf16_grads``: reduce-scatter the gradient vector in bfloat16 —
         halves the per-step collective bytes (the FP16CompressedTensor
         analog; worthwhile when the data axis spans DCN, unnecessary over
@@ -119,7 +119,11 @@ class ShardedParameterStep:
         microbatch under ``lax.scan`` (activations for ONE microbatch live
         at a time) summing flat gradients in f32, then does a single ZeRO-1
         update.  Numerically the mean gradient of the full batch; the
-        per-device batch must be divisible by it."""
+        per-device batch must be divisible by it.
+
+        ``ema_decay``: keep an exponential moving average of the flat
+        params inside the jitted step (``ema = d*ema + (1-d)*params``, the
+        ImageNet/TPU recipe); read it with ``get_variables(ema=True)``."""
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -128,6 +132,7 @@ class ShardedParameterStep:
         self.bf16_grads = bf16_grads
         self.remat = remat
         self.accum_steps = int(accum_steps)
+        self.ema_decay = float(ema_decay)
         self.ndev = mesh.shape[AXIS_DATA]
 
         flat, self.unravel = ravel_pytree(init_variables["params"])
@@ -144,6 +149,17 @@ class ShardedParameterStep:
             jnp.pad(flat, (0, self.n_pad - self.n_real)), self._rep)
         self.model_state = jax.device_put(init_variables.get("state", {}),
                                           self._rep)
+        # jnp.copy: device_put of an already-placed array is a no-op and
+        # would ALIAS ema to flat_params (double donation)
+        self.ema_flat = (jax.device_put(jnp.copy(self.flat_params),
+                                        self._rep)
+                         if self.ema_decay else None)
+        # EMA disabled: a distinct 1-element buffer rides the donated slot
+        # (donating flat_params twice is an XLA error); it is re-captured
+        # from the step output each iteration (donation aliases it through)
+        self._ema_dummy = (None if self.ema_decay else
+                           jax.device_put(jnp.zeros((1,), flat.dtype),
+                                          self._rep))
         if self.optim.elementwise:
             opt_state = self.optim.init_state(jnp.zeros((self.n_pad,), flat.dtype))
             self.opt_state = jax.device_put(opt_state, self._sharded_vec)
@@ -169,8 +185,9 @@ class ShardedParameterStep:
         elementwise = optim.elementwise
         bf16_grads, remat = self.bf16_grads, self.remat
         accum = max(1, self.accum_steps)
+        ema_decay = self.ema_decay
 
-        def step_shard(flat_p, opt_state, mstate, step, rng, x, y):
+        def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y):
             params = unravel(flat_p[:n_real])
             dev_rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
 
@@ -255,16 +272,19 @@ class ShardedParameterStep:
                 lambda a: jax.lax.pmean(a, AXIS_DATA)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
                 new_mstate)
-            return new_flat, new_opt, new_mstate, loss
+            new_ema = (ema_decay * ema + (1.0 - ema_decay) * new_flat
+                       if ema_decay else ema)
+            return new_flat, new_ema, new_opt, new_mstate, loss
 
         opt_spec = (P(AXIS_DATA) if elementwise else P())
         mapped = shard_map(
             step_shard, mesh=self.mesh,
-            in_specs=(P(), opt_spec, P(), P(), P(), P(AXIS_DATA), P(AXIS_DATA)),
-            out_specs=(P(), opt_spec, P(), P()),
+            in_specs=(P(), P(), opt_spec, P(), P(), P(), P(AXIS_DATA),
+                      P(AXIS_DATA)),
+            out_specs=(P(), P(), opt_spec, P(), P()),
             check_vma=False,
         )
-        return jax.jit(mapped, donate_argnums=(0, 1, 2))
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
     def _build_eval(self, methods: Tuple):
@@ -313,9 +333,16 @@ class ShardedParameterStep:
     def train_step_device(self, step: int, rng, x_dev, y_dev):
         """Variant taking already-sharded device arrays (the prefetch path —
         see ``bigdl_tpu.data.prefetch``)."""
-        self.flat_params, self.opt_state, self.model_state, loss = self._train(
-            self.flat_params, self.opt_state, self.model_state,
+        ema_in = self.ema_flat if self.ema_flat is not None \
+            else self._ema_dummy
+        (self.flat_params, new_ema, self.opt_state, self.model_state,
+         loss) = self._train(
+            self.flat_params, ema_in, self.opt_state, self.model_state,
             jnp.asarray(step, jnp.int32), rng, x_dev, y_dev)
+        if self.ema_flat is not None:
+            self.ema_flat = new_ema
+        else:
+            self._ema_dummy = new_ema
         return loss
 
     def evaluate(self, methods, batches) -> list:
@@ -346,8 +373,10 @@ class ShardedParameterStep:
         return [m.fold(s, c) for m, (s, c) in zip(methods, totals or [])]
 
     # ------------------------------------------------------------------
-    def get_variables(self) -> Dict[str, Any]:
-        flat = np.asarray(self.flat_params)[: self.n_real]
+    def get_variables(self, ema: bool = False) -> Dict[str, Any]:
+        src = self.ema_flat if (ema and self.ema_flat is not None) \
+            else self.flat_params
+        flat = np.asarray(src)[: self.n_real]
         return {"params": self.unravel(jnp.asarray(flat)),
                 "state": jax.device_get(self.model_state)}
 
