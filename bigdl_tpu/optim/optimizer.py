@@ -127,6 +127,7 @@ class Optimizer:
         self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
         self.accum_steps = 1     # gradient-accumulation microbatches
+        self.ema_decay = 0.0     # weight EMA inside the step (0 = off)
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
@@ -240,7 +241,7 @@ class Optimizer:
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,
-            accum_steps=self.accum_steps)
+            accum_steps=self.accum_steps, ema_decay=self.ema_decay)
         n_params = step_engine.n_real
         log.info("model has %s parameters; mesh data axis = %d; ZeRO shard = %s",
                  f"{n_params:,}", step_engine.ndev,
