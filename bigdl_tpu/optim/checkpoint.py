@@ -43,7 +43,7 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
 
 def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
                     model_state, driver_state: Dict[str, Any],
-                    keep_last: int = 3) -> str:
+                    keep_last: int = 3, ema_flat=None) -> str:
     """Write checkpoint dir ``<path>/ckpt-<step>``; returns the dir."""
     if jax.process_index() != 0:
         return ""
@@ -51,6 +51,8 @@ def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
     tmp = d + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     np.savez(os.path.join(tmp, "params.npz"), flat=np.asarray(flat_params))
+    if ema_flat is not None:
+        np.savez(os.path.join(tmp, "ema.npz"), flat=np.asarray(ema_flat))
     np.savez(os.path.join(tmp, "opt_state.npz"), **_flatten_with_paths(opt_state))
     np.savez(os.path.join(tmp, "model_state.npz"),
              **_flatten_with_paths(model_state))
@@ -93,11 +95,13 @@ def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
     with open(os.path.join(ckpt_dir, "manifest.json")) as f:
         manifest = json.load(f)
     flat = np.load(os.path.join(ckpt_dir, "params.npz"))["flat"]
+    ema_path = os.path.join(ckpt_dir, "ema.npz")
+    ema = np.load(ema_path)["flat"] if os.path.exists(ema_path) else None
     opt_flat = dict(np.load(os.path.join(ckpt_dir, "opt_state.npz")))
     mstate_flat = dict(np.load(os.path.join(ckpt_dir, "model_state.npz")))
     opt_state = _unflatten_like(opt_state_template, opt_flat)
     model_state = _unflatten_like(model_state_template, mstate_flat)
-    return flat, opt_state, model_state, manifest["driver_state"]
+    return flat, opt_state, model_state, manifest["driver_state"], ema
 
 
 def _gc(path: str, keep_last: int):

@@ -78,6 +78,16 @@ class TrainedModel:
             process_id=jax.process_index(), process_count=jax.process_count())
         return self._engine.evaluate(list(methods), batches)
 
+    @property
+    def ema_variables(self):
+        """EMA weights when the run used ``ema_decay`` (the ImageNet
+        EMA-eval recipe), else None.  Evaluate them via
+        ``model.apply(trained.ema_variables, x)`` or
+        ``trained.set_variables(trained.ema_variables)``."""
+        if getattr(self._engine, "ema_flat", None) is None:
+            return None
+        return self._engine.get_variables(ema=True)
+
     def set_variables(self, variables: Dict[str, Any]) -> None:
         """Overwrite the engine's weights/state with a loaded variables
         pytree (``Module.loadModule`` analog)."""
@@ -127,7 +137,8 @@ class Optimizer:
         self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
         self.accum_steps = 1     # gradient-accumulation microbatches
-        self.ema_decay = 0.0     # weight EMA inside the step (0 = off)
+        self.ema_decay = 0.0     # weight EMA (0 = off); read the result
+        #                          via TrainedModel.ema_variables
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
@@ -454,6 +465,8 @@ class Optimizer:
             opt_state=host_fetch(step_engine.opt_state),
             model_state=host_fetch(step_engine.model_state),
             driver_state=state)
+        if step_engine.ema_flat is not None:
+            kw["ema_flat"] = np.asarray(step_engine.ema_flat)
         if self._ckpt_async is not None:
             self._ckpt_async.submit(self._ckpt_path,
                                     state["iteration"], **kw)
@@ -461,12 +474,15 @@ class Optimizer:
             ckpt.save_checkpoint(self._ckpt_path, state["iteration"], **kw)
 
     def _save_checkpoint_sync_last(self, step_engine, state):
+        kw = {}
+        if step_engine.ema_flat is not None:
+            kw["ema_flat"] = np.asarray(step_engine.ema_flat)
         ckpt.save_checkpoint(
             self._ckpt_path, state["iteration"],
             flat_params=np.asarray(step_engine.flat_params),
             opt_state=host_fetch(step_engine.opt_state),
             model_state=host_fetch(step_engine.model_state),
-            driver_state=dict(state, loss=float(state["loss"])))
+            driver_state=dict(state, loss=float(state["loss"])), **kw)
 
     def _ckpt_drain(self, raise_error: bool = True):
         """Join any in-flight async write (resume and exit paths read
@@ -510,12 +526,19 @@ class Optimizer:
         latest = ckpt.latest_checkpoint(self._ckpt_path)
         if latest is None:
             return
-        flat, opt_state, model_state, driver = ckpt.load_checkpoint(
+        flat, opt_state, model_state, driver, ema = ckpt.load_checkpoint(
             latest,
             opt_state_template=step_engine.opt_template,
             model_state_template=step_engine.model_state_template)
         step_engine.flat_params = put_sharded(
             jax.numpy.asarray(flat), step_engine._rep)
+        if step_engine.ema_flat is not None:
+            # a failed donated step consumed the old EMA buffer too; restore
+            # the checkpointed EMA, or re-seed from the restored params when
+            # the checkpoint predates EMA
+            src = ema if ema is not None else flat
+            step_engine.ema_flat = put_sharded(
+                jax.numpy.asarray(src).copy(), step_engine._rep)
         opt_sh = (step_engine._sharded_vec if step_engine.optim.elementwise
                   else step_engine._rep)
         step_engine.opt_state = put_sharded(opt_state, opt_sh)
