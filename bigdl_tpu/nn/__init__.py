@@ -57,7 +57,8 @@ from bigdl_tpu.nn.decode import beam_search, greedy_decode, DecodeResult
 from bigdl_tpu.nn.attention import (
     MultiHeadAttention, PositionwiseFFN, TransformerLayer,
     TransformerDecoderLayer, Transformer, Attention, FeedForwardNetwork,
-    dot_product_attention, positional_encoding,
+    dot_product_attention, positional_encoding, transformer_decode,
+    transformer_decode_cached,
 )
 from bigdl_tpu.nn.criterion import (
     Criterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,
