@@ -802,3 +802,49 @@ def _key(key):
         return key
     _seed_counter[0] += 1
     return jax.random.PRNGKey(_seed_counter[0])
+
+
+def _tensor_tail_ops():
+    """Late-bound tranche (keeps the class body above readable)."""
+
+    def bincount(self, minlength: int = 0, weights=None) -> "Tensor":
+        # static length: jnp.bincount needs a bound; use max+1 eagerly like
+        # torch (data-dependent — not for use under jit)
+        n = int(jnp.max(self.data)) + 1 if self.data.size else 0
+        length = max(n, minlength)
+        return Tensor(jnp.bincount(
+            self.data.astype(jnp.int32).ravel(),
+            weights=None if weights is None else _unwrap(weights).ravel(),
+            length=length))
+
+    def histc(self, bins: int = 100, min: float = 0.0, max: float = 0.0
+              ) -> "Tensor":
+        lo, hi = float(min), float(max)
+        if lo == 0.0 and hi == 0.0:
+            lo = float(jnp.min(self.data))
+            hi = float(jnp.max(self.data))
+        hist, _ = jnp.histogram(self.data.ravel(), bins=bins,
+                                range=(lo, hi))
+        return Tensor(hist.astype(jnp.float32))
+
+    def where(self, condition, other) -> "Tensor":
+        return Tensor(jnp.where(_unwrap(condition), self.data,
+                                _unwrap(other)))
+
+    def logsumexp(self, dim: int, keepdim: bool = False) -> "Tensor":
+        return Tensor(jax.nn.logsumexp(self.data, axis=dim,
+                                       keepdims=keepdim))
+
+    def softmax(self, dim: int = -1) -> "Tensor":
+        return Tensor(jax.nn.softmax(self.data, axis=dim))
+
+    def diagonal(self, offset: int = 0, dim1: int = 0, dim2: int = 1
+                 ) -> "Tensor":
+        return Tensor(jnp.diagonal(self.data, offset=offset, axis1=dim1,
+                                   axis2=dim2))
+
+    for fn in (bincount, histc, where, logsumexp, softmax, diagonal):
+        setattr(Tensor, fn.__name__, fn)
+
+
+_tensor_tail_ops()

@@ -239,3 +239,33 @@ def test_multinomial_no_replacement_rejects_zero_weight_rows():
     # one nonzero → sampling exactly 1 is fine and must pick it
     m = np.asarray(w.multinomial(1, key=k).data)
     assert m.tolist() == [0]
+
+
+def test_tail_ops_match_torch():
+    torch = pytest.importorskip("torch")
+    a = A(4, 6)
+    t = torch.tensor(a)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).logsumexp(1).data),
+        torch.logsumexp(t, 1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).softmax(-1).data),
+        torch.softmax(t, -1).numpy(), rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).diagonal(1).data),
+        torch.diagonal(t, 1).numpy())
+    cond = a > 0
+    b = A(4, 6)
+    np.testing.assert_allclose(
+        np.asarray(Tensor(a).where(cond, b).data),
+        torch.where(torch.tensor(cond), t, torch.tensor(b)).numpy())
+    ids = np.array([0, 1, 1, 3, 3, 3], np.int64)
+    np.testing.assert_array_equal(
+        np.asarray(Tensor(ids).bincount().data),
+        torch.bincount(torch.tensor(ids)).numpy())
+    np.testing.assert_array_equal(
+        np.asarray(Tensor(ids).bincount(minlength=8).data),
+        torch.bincount(torch.tensor(ids), minlength=8).numpy())
+    h_ours = np.asarray(Tensor(a).histc(10, -2, 2).data)
+    h_torch = torch.histc(t, 10, -2, 2).numpy()
+    np.testing.assert_allclose(h_ours, h_torch)
