@@ -7,6 +7,8 @@ from bigdl_tpu.data.augmentation import (
     Grayscale, Expand, Filler, FixedCrop, AspectScale, RandomAspectScale,
     PixelNormalizer, RandomTransformer,
 )
+from bigdl_tpu.data.records import RecordDataSet, write_records
+from bigdl_tpu.data.prefetch import prefetch_to_device, thread_prefetch
 from bigdl_tpu.data.segmentation import (
     rle_encode, rle_decode, rle_area, polygons_to_mask, mask_to_bbox,
     annotation_to_mask,
@@ -15,6 +17,8 @@ from bigdl_tpu.data.segmentation import (
 __all__ = [
     "DataSet", "ArrayDataSet", "Sample", "MiniBatch", "SampleToMiniBatch",
     "Transformer", "IdentityTransformer",
+    "RecordDataSet", "write_records", "prefetch_to_device",
+    "thread_prefetch",
     "Brightness", "Contrast", "Saturation", "Hue", "ColorJitter",
     "ChannelOrder", "Grayscale", "Expand", "Filler", "FixedCrop",
     "AspectScale", "RandomAspectScale", "PixelNormalizer",
