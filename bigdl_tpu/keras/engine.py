@@ -80,7 +80,14 @@ class Model(Module):
     def init(self, rng, *sample_inputs):
         values: Dict[int, Any] = {}
         for node, x in zip(self.inputs, sample_inputs):
-            values[node.id] = np.asarray(x)
+            x = np.asarray(x)
+            # canonicalize host dtypes (python lists arrive float64/int64;
+            # x64 is disabled so downstream astype would warn + truncate)
+            if x.dtype == np.float64:
+                x = x.astype(np.float32)
+            elif x.dtype == np.int64:
+                x = x.astype(np.int32)
+            values[node.id] = x
         params, state = {}, {}
         for i, node in enumerate(self.order):
             if node.layer is None:
