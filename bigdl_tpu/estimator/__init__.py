@@ -10,9 +10,11 @@ training through the ZeRO-1 sharded train step over the mesh.
 
 from bigdl_tpu.estimator.estimator import Estimator, init_context, stop_context
 
-# reference spellings (orca.common.init_orca_context/stop_orca_context)
+# reference spellings: orca.common.init_orca_context/stop_orca_context and
+# the dllib entry init_nncontext (returns the engine, the SparkContext role)
 init_orca_context = init_context
 stop_orca_context = stop_context
+init_nncontext = init_context
 
 __all__ = ["Estimator", "init_context", "stop_context",
-           "init_orca_context", "stop_orca_context"]
+           "init_orca_context", "stop_orca_context", "init_nncontext"]
