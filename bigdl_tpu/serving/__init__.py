@@ -17,5 +17,8 @@ from bigdl_tpu.serving.server import ServingConfig, ServingServer
 from bigdl_tpu.serving.client import InputQueue, OutputQueue
 from bigdl_tpu.serving.http_frontend import HttpClient, HttpFrontend
 
-__all__ = ["InferenceModel", "ServingServer", "ServingConfig",
+from bigdl_tpu.serving.seq2seq import Seq2SeqService
+
+__all__ = [
+    "Seq2SeqService","InferenceModel", "ServingServer", "ServingConfig",
            "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient"]

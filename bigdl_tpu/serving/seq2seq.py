@@ -1,0 +1,80 @@
+"""Seq2Seq decode service — serving for translation Transformers.
+
+Reference analog: Cluster Serving's ``InferenceModel`` holds classification
+models; its Seq2Seq story (``models/rnn`` + ``SequenceBeamSearch``) never
+got a serving surface.  Here decode IS servable: requests are bucketed to a
+few batch sizes (same discipline as ``ServingServer``/``RecallService``) so
+arbitrary request counts reuse a handful of compiled programs, and each
+bucket's program is the whole autoregressive loop (one ``lax.scan`` — KV
+caches inside, nothing host-side per token).
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+
+class Seq2SeqService:
+    """Holds a translation-mode :class:`~bigdl_tpu.nn.Transformer` and
+    serves ``translate(src_batch)``.
+
+    ``beam_size=0`` → KV-cached greedy (the fast path); ``>0`` → beam
+    search with GNMT length penalty (re-attends over the prefix)."""
+
+    BATCH_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
+
+    def __init__(self, model, params, bos_id: int, eos_id: int,
+                 max_len: int = 32, beam_size: int = 0,
+                 batch_buckets: Optional[Sequence[int]] = None):
+        if model.mode != "translation":
+            raise ValueError("Seq2SeqService needs a translation-mode "
+                             "Transformer")
+        self.model = model
+        self.params = params
+        self.bos_id, self.eos_id = bos_id, eos_id
+        self.max_len = max_len
+        self.beam_size = beam_size
+        self.buckets = tuple(batch_buckets or self.BATCH_BUCKETS)
+        self._cache = {}
+
+    def _decode_fn(self, batch: int):
+        fn = self._cache.get(batch)
+        if fn is None:
+            from bigdl_tpu.nn.attention import (transformer_decode,
+                                                transformer_decode_cached)
+
+            if self.beam_size and self.beam_size > 1:
+                def run(params, src):
+                    toks, scores = transformer_decode(
+                        self.model, params, src, self.bos_id, self.eos_id,
+                        max_len=self.max_len, beam_size=self.beam_size)
+                    return toks[:, 0], scores[:, 0]   # best beam
+            else:
+                def run(params, src):
+                    return transformer_decode_cached(
+                        self.model, params, src, self.bos_id, self.eos_id,
+                        max_len=self.max_len)
+
+            fn = jax.jit(run)
+            self._cache[batch] = fn
+        return fn
+
+    def translate(self, src) -> Tuple[np.ndarray, np.ndarray]:
+        """src: (n, t_src) int tokens → (tokens (n, max_len+1) incl. BOS,
+        scores (n,)).  n is padded up to a bucket; pad rows are dropped."""
+        src = np.asarray(src, np.int32)
+        n = src.shape[0]
+        bucket = next((b for b in self.buckets if b >= n), None)
+        if bucket is None:  # larger than the biggest bucket: chunk it
+            big = self.buckets[-1]
+            outs = [self.translate(src[i:i + big]) for i in
+                    range(0, n, big)]
+            return (np.concatenate([o[0] for o in outs]),
+                    np.concatenate([o[1] for o in outs]))
+        if bucket > n:
+            src = np.concatenate(
+                [src, np.repeat(src[-1:], bucket - n, axis=0)])
+        tokens, scores = self._decode_fn(bucket)(self.params, src)
+        return np.asarray(tokens)[:n], np.asarray(scores)[:n]

@@ -144,3 +144,59 @@ def test_inference_model_tf_and_caffe_backends(tmp_path):
     save_caffe(model, variables, sample=x, path=cf_path)
     got2 = InferenceModel.load_caffe(cf_path).predict(x)
     np.testing.assert_allclose(got2, np.asarray(expect), rtol=1e-4, atol=1e-5)
+
+
+def test_seq2seq_service_buckets_and_translates():
+    """Decode-as-a-service: a trained translation Transformer served with
+    batch bucketing; greedy (KV-cached) and beam modes agree on the task."""
+    import jax
+
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.serving import Seq2SeqService
+
+    rs = np.random.RandomState(0)
+    vocab, t, n = 10, 4, 256
+    BOS, EOS = 1, 0
+    src = rs.randint(2, vocab, (n, t)).astype(np.int32)
+    tgt_full = np.concatenate([src[:, ::-1],
+                               np.full((n, 1), EOS, np.int32)], 1)
+    tgt_in = np.concatenate([np.full((n, 1), BOS, np.int32),
+                             tgt_full[:, :-1]], 1)
+    model = Transformer(vocab, hidden_size=16, num_heads=2, num_layers=1,
+                        dropout=0.0)
+    variables = model.init(jax.random.PRNGKey(0), src, tgt_in)
+    params = variables["params"]
+    crit = CrossEntropyCriterion()
+    method = Adam(learning_rate=3e-3)
+    opt_state = method.init_state(params)
+
+    @jax.jit
+    def step(i, p, o):
+        def loss_fn(pp):
+            logits, _ = model.forward(pp, {}, src, tgt_in)
+            return crit(logits.reshape(-1, vocab), tgt_full.reshape(-1))
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        return (*method.update(i, g, p, o), loss)
+
+    for i in range(200):
+        params, opt_state, _ = step(i, params, opt_state)
+
+    svc = Seq2SeqService(model, params, BOS, EOS, max_len=t + 1,
+                         batch_buckets=(2, 4, 8))
+    # odd request size -> padded to bucket 4; rows beyond biggest bucket
+    # chunk transparently
+    for req_n in (3, 8, 11):
+        toks, scores = svc.translate(src[:req_n])
+        assert toks.shape[0] == req_n and scores.shape == (req_n,)
+        pred = toks[:, 1:t + 1]
+        assert (pred == src[:req_n, ::-1]).mean() > 0.9
+    # one compiled program per bucket actually cached
+    assert set(svc._cache) <= {2, 4, 8}
+
+    beam = Seq2SeqService(model, params, BOS, EOS, max_len=t + 1,
+                          beam_size=3, batch_buckets=(4,))
+    toks, _ = beam.translate(src[:4])
+    assert (toks[:, 1:t + 1] == src[:4, ::-1]).mean() > 0.9
