@@ -57,6 +57,23 @@ class Vocabulary:
         return toks
 
 
+    def save(self, path: str) -> None:
+        """Persist the vocabulary (one token per line, frequency order) —
+        re-loadable with :meth:`load` for serving-side tokenization."""
+        with open(path, "w", encoding="utf-8") as f:
+            for tok in self.itos:
+                f.write(tok + "\n")
+
+    @staticmethod
+    def load(path: str) -> "Vocabulary":
+        with open(path, encoding="utf-8") as f:
+            tokens = [ln.rstrip("\n") for ln in f]
+        v = Vocabulary.__new__(Vocabulary)
+        v.itos = tokens
+        v.stoi = {t: i for i, t in enumerate(tokens)}
+        return v
+
+
 def char_tokenize(text: str) -> List[str]:
     return list(text)
 

@@ -85,3 +85,17 @@ class TestLanguageModel:
         pred = np.argmax(np.asarray(logits), axis=-1)
         acc = (pred[:, :-1] == y[:8, :-1]).mean()
         assert acc > 0.9, acc
+
+
+def test_vocabulary_save_load_roundtrip(tmp_path):
+    from bigdl_tpu.data.text import Vocabulary, word_tokenize
+
+    corpus = [word_tokenize("the cat sat"), word_tokenize("the dog ran the")]
+    v = Vocabulary.build(corpus)
+    p = str(tmp_path / "vocab.txt")
+    v.save(p)
+    v2 = Vocabulary.load(p)
+    assert v2.itos == v.itos and len(v2) == len(v)
+    ids = v.encode(word_tokenize("the cat"), add_eos=True)
+    assert v2.encode(word_tokenize("the cat"), add_eos=True) == ids
+    assert v2.decode(ids) == ["the", "cat"]
