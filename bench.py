@@ -243,11 +243,34 @@ def _spawn(platform: str, timeout: float):
     return None, f"{platform} worker rc={proc.returncode}: " + " | ".join(tail)
 
 
+def _probe_tpu(timeout: float):
+    """Short backend-init probe: with the tunnel down, init hangs — don't
+    spend the full BENCH_TPU_TIMEOUT discovering that.  Returns
+    (ok, error_or_None)."""
+    src = ("import jax; d = jax.devices()[0]; "
+           "print('PLATFORM=' + d.platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", src],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, f"tpu probe timed out after {timeout:.0f}s (backend init hang)"
+    if proc.returncode == 0 and "PLATFORM=tpu" in proc.stdout:
+        return True, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+    return False, f"tpu probe rc={proc.returncode}: " + " | ".join(tail)
+
+
 def main():
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 
-    result, tpu_err = _spawn("tpu", tpu_timeout)
+    ok, probe_err = _probe_tpu(probe_timeout)
+    if ok:
+        result, tpu_err = _spawn("tpu", tpu_timeout)
+    else:
+        result, tpu_err = None, probe_err
     if result is None:
         result, cpu_err = _spawn("cpu", cpu_timeout)
         if result is not None:
