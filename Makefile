@@ -15,3 +15,14 @@ bench:
 
 dryrun:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# strong-scaling + loader-throughput artifacts (committed per round)
+bench-scaling:
+	python bench_scaling.py
+
+bench-loader:
+	python bench_loader.py
+
+# session-long TPU availability watcher (BENCH_attempts.jsonl evidence)
+watch:
+	nohup python bench_watch.py > bench_watch.log 2>&1 &

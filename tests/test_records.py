@@ -174,3 +174,18 @@ def test_optimizer_with_host_prefetch(rec):
     trained = opt.optimize()
     assert trained is not None
     ds.close()
+
+
+def test_measure_loader_smoke():
+    """bench_loader's measurement helper stays importable and returns the
+    documented fields (tiny geometry — the artifact run uses batch 768)."""
+    import sys
+
+    sys.path.insert(0, ".")
+    from bench_loader import measure_loader
+
+    r = measure_loader(batch=16, n_batches=1, src_hw=40, out_hw=32)
+    assert r["batch"] == 16 and "host_cores" in r
+    assert "python_ref_img_per_sec" in r
+    if r["native_available"]:
+        assert r["loader_img_per_sec"] > 0
