@@ -111,6 +111,38 @@ class FeatureTable:
             df[c] = (df[c] - lo) / (hi - lo) if hi > lo else 0.0
         return FeatureTable(df), stats
 
+    def target_encode(self, cat_cols: Union[str, Sequence[str]],
+                      target_col: str, smooth: float = 20.0,
+                      out_suffix: str = "_te"
+                      ) -> Tuple["FeatureTable", Dict[str, Dict]]:
+        """Smoothed mean-target encoding per category — reference
+        ``FeatureTable.target_encode`` (the CTR feature-engineering
+        staple): ``te = (sum + smooth*global_mean) / (count + smooth)``.
+        Returns the table with ``<col><out_suffix>`` columns and the
+        per-column mapping (apply it to serving-time frames)."""
+        cols = [cat_cols] if isinstance(cat_cols, str) else list(cat_cols)
+        df = self.df.copy()
+        g_mean = float(df[target_col].mean())
+        mappings: Dict[str, Dict] = {}
+        for c in cols:
+            grp = df.groupby(c)[target_col].agg(["sum", "count"])
+            te = (grp["sum"] + smooth * g_mean) / (grp["count"] + smooth)
+            mapping = te.to_dict()
+            mappings[c] = {"mapping": mapping, "default": g_mean}
+            df[c + out_suffix] = df[c].map(mapping).fillna(g_mean)
+        return FeatureTable(df), mappings
+
+    def count_encode(self, columns: Union[str, Sequence[str]],
+                     out_suffix: str = "_count") -> "FeatureTable":
+        """Per-category occurrence count — reference ``count_encode``
+        (popularity features)."""
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        df = self.df.copy()
+        for c in cols:
+            counts = df[c].value_counts()
+            df[c + out_suffix] = df[c].map(counts).astype("int64")
+        return FeatureTable(df)
+
     def cross_columns(self, cross_cols: Sequence[Sequence[str]],
                       bucket_sizes: Sequence[int]) -> "FeatureTable":
         """Hashed cross features — reference ``cross_columns``."""

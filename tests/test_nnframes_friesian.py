@@ -137,3 +137,28 @@ class TestFeatureTable:
         j = a.fillna(0.0, ["x"]).join(b, on="k")
         assert j.df["x"].tolist() == [1.0, 0.0]
         assert set(j.select("k", "y").df.columns) == {"k", "y"}
+
+
+def test_target_and_count_encode():
+    """Smoothed target encoding (CTR staple) + popularity counts."""
+    import pandas as pd
+
+    from bigdl_tpu.friesian.table import FeatureTable
+
+    df = pd.DataFrame({
+        "cat": ["a", "a", "a", "b", "b", "c"],
+        "y":   [1.0, 1.0, 0.0, 0.0, 0.0, 1.0],
+    })
+    t = FeatureTable.from_pandas(df)
+    out, maps = t.target_encode("cat", "y", smooth=2.0)
+    g = df["y"].mean()                                   # 0.5
+    # a: (2 + 2*0.5) / (3 + 2) = 0.6 ; b: (0 + 1)/(2+2)=0.25
+    got = out.to_pandas()
+    np.testing.assert_allclose(got[got.cat == "a"]["cat_te"].iloc[0], 0.6)
+    np.testing.assert_allclose(got[got.cat == "b"]["cat_te"].iloc[0], 0.25)
+    # unseen categories fall back to the global mean via the mapping
+    np.testing.assert_allclose(maps["cat"]["default"], g)
+
+    out2 = t.count_encode("cat").to_pandas()
+    assert out2[out2.cat == "a"]["cat_count"].iloc[0] == 3
+    assert out2[out2.cat == "c"]["cat_count"].iloc[0] == 1
