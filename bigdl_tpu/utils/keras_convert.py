@@ -739,6 +739,21 @@ def convert_keras_optimizer(kopt):
     raise NotImplementedError(f"no mapping for keras optimizer {name}")
 
 
+class _OneHotLogitsCE:
+    """Categorical cross-entropy over LOGITS with one-hot targets
+    (keras CategoricalCrossentropy(from_logits=True))."""
+
+    def forward(self, output, target):
+        import jax
+        import jax.numpy as jnp
+
+        logp = jax.nn.log_softmax(output.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.sum(target * logp, axis=-1))
+
+    def __call__(self, output, target):
+        return self.forward(output, target)
+
+
 class _ProbNLL:
     """NLL over PROBABILITIES (keras from_logits=False models end in
     softmax) — log + ClassNLL, matching sparse_categorical_crossentropy."""
@@ -780,11 +795,8 @@ def convert_keras_loss(kloss):
                "sparse_categorical_crossentropy"):
         return C.CrossEntropyCriterion() if from_logits else _ProbNLL()
     if key in ("categoricalcrossentropy", "categorical_crossentropy"):
-        if from_logits:
-            raise NotImplementedError(
-                "categorical_crossentropy(from_logits=True); use the sparse "
-                "variant or probabilities")
-        return CE.CategoricalCrossEntropy()
+        return _OneHotLogitsCE() if from_logits \
+            else CE.CategoricalCrossEntropy()
     if key in ("meansquarederror", "mse", "mean_squared_error"):
         return C.MSECriterion()
     if key in ("meanabsoluteerror", "mae", "mean_absolute_error"):

@@ -364,3 +364,13 @@ def test_keras_mha_self_and_cross_attention_parity():
     ours, _ = model2.apply(v2, qx, mx, training=False)
     theirs = km2.predict([qx, mx], verbose=0)
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=5e-4)
+
+
+def test_categorical_crossentropy_from_logits_mapping():
+    logits = np.asarray([[2.0, -1.0, 0.5], [0.1, 0.2, 3.0]], np.float32)
+    onehot = np.asarray([[1, 0, 0], [0, 0, 1]], np.float32)
+    ours = float(convert_keras_loss(
+        tk.losses.CategoricalCrossentropy(from_logits=True))(logits, onehot))
+    theirs = float(tk.losses.CategoricalCrossentropy(from_logits=True)(
+        onehot, logits))
+    assert ours == pytest.approx(theirs, rel=1e-5)
