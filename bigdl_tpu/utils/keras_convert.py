@@ -338,6 +338,35 @@ def _convert_gru(klayer, cfg):
     return [(layer, params, {}, "gru")]
 
 
+def _convert_convlstm2d(klayer, cfg):
+    """keras ConvLSTM2D: separate input/recurrent kernels (kh,kw,cin,4f) /
+    (kh,kw,f,4f), gate order i,f,c,o — concatenated along the input-channel
+    axis they ARE the native fused [x;h] kernel."""
+    from bigdl_tpu import nn as N
+
+    _require_channels_last(cfg, "ConvLSTM2D")
+    if cfg.get("activation", "tanh") != "tanh" or \
+            cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
+        raise UnsupportedKerasLayer("ConvLSTM2D: non-default activations")
+    if tuple(cfg.get("strides", (1, 1))) != (1, 1) or \
+            cfg.get("padding") != "same":
+        raise UnsupportedKerasLayer(
+            "ConvLSTM2D: needs strides=1, padding='same' (the native "
+            "recurrence keeps the spatial shape)")
+    if cfg.get("dropout", 0.0) or cfg.get("recurrent_dropout", 0.0):
+        raise UnsupportedKerasLayer("ConvLSTM2D: recurrent dropout")
+    w = klayer.get_weights()
+    kernel, rec = w[0], w[1]
+    kh, kw, cin, four_f = kernel.shape
+    f = four_f // 4
+    layer = N.ConvLSTM2D(cin, f, (kh, kw), peephole=False,
+                         return_sequences=cfg.get("return_sequences", False))
+    params = {"weight": np.concatenate([kernel, rec], axis=2),
+              "bias": (w[2] if cfg.get("use_bias", True)
+                       else np.zeros((four_f,), np.float32))}
+    return [(layer, params, {}, "convlstm")]
+
+
 def _convert_bidirectional(klayer, cfg):
     from bigdl_tpu import nn as N
 
@@ -461,6 +490,7 @@ _CONVERTERS = {
     "LSTM": _convert_lstm,
     "GRU": _convert_gru,
     "Bidirectional": _convert_bidirectional,
+    "ConvLSTM2D": _convert_convlstm2d,
     "PReLU": _convert_prelu,
     "Activation": _build_activation,
     "ReLU": _build_relu,
@@ -677,6 +707,13 @@ def export_tf_keras_weights(model, variables, kmodel) -> None:
             w = [np.asarray(p["weight"]), np.asarray(p["bias"])]
         elif kind == "embedding":
             w = [np.asarray(p["weight"])]
+        elif kind == "convlstm":
+            fused = np.asarray(p["weight"])
+            kcfg = klayer.get_config()
+            cin = fused.shape[2] - fused.shape[3] // 4
+            w = [fused[:, :, :cin], fused[:, :, cin:]]
+            if kcfg.get("use_bias", True):
+                w.append(np.asarray(p["bias"]))
         elif kind in ("lstm", "gru"):
             w = _rnn_weights(kind, p, use_bias)
         elif kind in ("bilstm", "bigru"):

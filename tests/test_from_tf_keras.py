@@ -374,3 +374,22 @@ def test_categorical_crossentropy_from_logits_mapping():
     theirs = float(tk.losses.CategoricalCrossentropy(from_logits=True)(
         onehot, logits))
     assert ours == pytest.approx(theirs, rel=1e-5)
+
+
+def test_convlstm2d_forward_parity():
+    """keras ConvLSTM2D converts onto the native fused-[x;h] ConvLSTM."""
+    for ret_seq in (False, True):
+        km = tk.Sequential([
+            tk.layers.Input((4, 6, 6, 3)),
+            tk.layers.ConvLSTM2D(5, 3, padding="same",
+                                 return_sequences=ret_seq),
+        ])
+        x = RS.rand(2, 4, 6, 6, 3).astype(np.float32)
+        model, variables = from_tf_keras(km)
+        ours, _ = model.apply(variables, x, training=False)
+        theirs = km.predict(x, verbose=0)
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=1e-4,
+                                   err_msg=f"return_sequences={ret_seq}")
+        export_tf_keras_weights(model, variables, km)
+        np.testing.assert_allclose(km.predict(x, verbose=0), theirs,
+                                   atol=1e-6)
