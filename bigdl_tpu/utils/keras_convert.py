@@ -328,6 +328,31 @@ def _gru_parts(klayer, cfg):
     return layer, params
 
 
+def _simplernn_parts(klayer, cfg):
+    from bigdl_tpu import nn as N
+
+    if cfg.get("activation", "tanh") != "tanh":
+        raise UnsupportedKerasLayer("SimpleRNN: non-tanh activation")
+    for flag in ("return_state", "stateful", "unroll"):
+        if cfg.get(flag):
+            raise UnsupportedKerasLayer(f"SimpleRNN: {flag}=True")
+    if cfg.get("dropout", 0.0) or cfg.get("recurrent_dropout", 0.0):
+        raise UnsupportedKerasLayer("SimpleRNN: recurrent dropout")
+    w = klayer.get_weights()
+    layer = N.SimpleRNN(w[0].shape[0], w[1].shape[0],
+                        return_sequences=cfg.get("return_sequences", False),
+                        go_backwards=cfg.get("go_backwards", False))
+    params = {"w_in": w[0], "w_rec": w[1],
+              "bias": (w[2] if cfg.get("use_bias", True)
+                       else np.zeros((w[0].shape[1],), np.float32))}
+    return layer, params
+
+
+def _convert_simplernn(klayer, cfg):
+    layer, params = _simplernn_parts(klayer, cfg)
+    return [(layer, params, {}, "lstm")]   # same 3-blob export layout
+
+
 def _convert_lstm(klayer, cfg):
     layer, params = _lstm_parts(klayer, cfg)
     return [(layer, params, {}, "lstm")]
@@ -488,6 +513,7 @@ _CONVERTERS = {
     "LayerNormalization": _convert_layernorm,
     "Embedding": _convert_embedding,
     "LSTM": _convert_lstm,
+    "SimpleRNN": _convert_simplernn,
     "GRU": _convert_gru,
     "Bidirectional": _convert_bidirectional,
     "ConvLSTM2D": _convert_convlstm2d,

@@ -393,3 +393,14 @@ def test_convlstm2d_forward_parity():
         export_tf_keras_weights(model, variables, km)
         np.testing.assert_allclose(km.predict(x, verbose=0), theirs,
                                    atol=1e-6)
+
+
+def test_simplernn_forward_parity():
+    km = tk.Sequential([
+        tk.layers.Input((6, 4)),
+        tk.layers.SimpleRNN(5, return_sequences=True),
+        tk.layers.SimpleRNN(3),
+        tk.layers.Dense(2),
+    ])
+    x = RS.rand(3, 6, 4).astype(np.float32)
+    _assert_forward_parity(km, x, atol=5e-4)
