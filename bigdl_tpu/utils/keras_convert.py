@@ -262,6 +262,11 @@ def _convert_layernorm(klayer, cfg):
 def _convert_embedding(klayer, cfg):
     from bigdl_tpu import nn as N
 
+    if cfg.get("mask_zero"):
+        raise UnsupportedKerasLayer(
+            "Embedding(mask_zero=True): keras propagates an implicit mask "
+            "into downstream RNNs; the converted graph would silently drop "
+            "it — pad-bucket the data or pass masks explicitly instead")
     w = klayer.get_weights()[0]
     layer = N.Embedding(w.shape[0], w.shape[1])
     return [(layer, {"weight": w}, {}, "embedding")]

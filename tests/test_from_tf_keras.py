@@ -404,3 +404,13 @@ def test_simplernn_forward_parity():
     ])
     x = RS.rand(3, 6, 4).astype(np.float32)
     _assert_forward_parity(km, x, atol=5e-4)
+
+
+def test_mask_zero_embedding_rejected():
+    """mask_zero carries an implicit mask the converted graph cannot honor
+    — silent numerics divergence is refused."""
+    km = tk.Sequential([tk.layers.Input((5,), dtype="int32"),
+                        tk.layers.Embedding(10, 4, mask_zero=True),
+                        tk.layers.LSTM(3)])
+    with pytest.raises(UnsupportedKerasLayer, match="mask_zero"):
+        from_tf_keras(km)
