@@ -409,6 +409,8 @@ def _convert_bidirectional(klayer, cfg):
         parts, kind = _lstm_parts, "bilstm"
     elif inner == "GRU":
         parts, kind = _gru_parts, "bigru"
+    elif inner == "SimpleRNN":
+        parts, kind = _simplernn_parts, "bilstm"  # same 3-blob export
     else:
         raise UnsupportedKerasLayer(f"Bidirectional({inner})")
     f_layer, f_params = parts(fwd_k, fwd_k.get_config())

@@ -414,3 +414,13 @@ def test_mask_zero_embedding_rejected():
                         tk.layers.LSTM(3)])
     with pytest.raises(UnsupportedKerasLayer, match="mask_zero"):
         from_tf_keras(km)
+
+
+def test_bidirectional_simplernn_parity():
+    km = tk.Sequential([
+        tk.layers.Input((5, 4)),
+        tk.layers.Bidirectional(tk.layers.SimpleRNN(3)),
+        tk.layers.Dense(2),
+    ])
+    x = RS.rand(2, 5, 4).astype(np.float32)
+    _assert_forward_parity(km, x, atol=5e-4)
