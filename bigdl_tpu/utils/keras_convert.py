@@ -563,6 +563,28 @@ _CONVERTERS = {
         "bigdl_tpu.nn", fromlist=["UpSampling2D"]).UpSampling2D(
             tuple(cfg["size"]))),
     "Identity": _no_weight(lambda kl, cfg: None),
+    "Cropping2D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["Cropping2D"]).Cropping2D(
+            tuple(tuple(c) for c in cfg["cropping"]))),
+    "Cropping1D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["Cropping1D"]).Cropping1D(
+            tuple(cfg["cropping"]))),
+    "ZeroPadding1D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["ZeroPadding1D"]).ZeroPadding1D(
+            tuple(cfg["padding"]) if isinstance(cfg["padding"], (list, tuple))
+            else cfg["padding"])),
+    "UpSampling1D": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["UpSampling1D"]).UpSampling1D(
+            int(cfg["size"]))),
+    "GaussianNoise": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["GaussianNoise"]).GaussianNoise(
+            cfg["stddev"])),
+    "GaussianDropout": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["GaussianDropout"]).GaussianDropout(
+            cfg["rate"])),
+    "RepeatVector": _no_weight(lambda kl, cfg: __import__(
+        "bigdl_tpu.nn", fromlist=["RepeatVector"]).RepeatVector(
+            int(cfg["n"]))),
 }
 
 

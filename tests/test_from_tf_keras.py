@@ -424,3 +424,33 @@ def test_bidirectional_simplernn_parity():
     ])
     x = RS.rand(2, 5, 4).astype(np.float32)
     _assert_forward_parity(km, x, atol=5e-4)
+
+
+def test_shape_op_layers_parity():
+    """Cropping/padding/upsampling/repeat keras layers convert (inference
+    parity; noise layers are train-time-only identities here)."""
+    km = tk.Sequential([
+        tk.layers.Input((8, 8, 3)),
+        tk.layers.Cropping2D(((1, 1), (2, 1))),
+        tk.layers.UpSampling2D(2),
+        tk.layers.GaussianNoise(0.5),      # inference: identity
+    ])
+    x = RS.rand(2, 8, 8, 3).astype(np.float32)
+    _assert_forward_parity(km, x, atol=1e-6)
+
+    km2 = tk.Sequential([
+        tk.layers.Input((10, 4)),
+        tk.layers.Cropping1D((2, 1)),
+        tk.layers.ZeroPadding1D((1, 2)),
+        tk.layers.UpSampling1D(2),
+    ])
+    x2 = RS.rand(2, 10, 4).astype(np.float32)
+    _assert_forward_parity(km2, x2, atol=1e-6)
+
+    km3 = tk.Sequential([
+        tk.layers.Input((6,)),
+        tk.layers.RepeatVector(3),
+        tk.layers.SimpleRNN(4),
+    ])
+    x3 = RS.rand(2, 6).astype(np.float32)
+    _assert_forward_parity(km3, x3, atol=5e-4)
