@@ -264,6 +264,14 @@ _SIMPLE = {
     "ELU": lambda tm: (N.ELU(tm.alpha), {}, {}),
     "LeakyReLU": lambda tm: (N.LeakyReLU(tm.negative_slope), {}, {}),
     "Softmax": lambda tm: (N.SoftMax(), {}, {}),
+    "LogSoftmax": lambda tm: (N.LogSoftMax(), {}, {}),
+    "Mish": lambda tm: (N.Mish(), {}, {}),
+    "Softplus": lambda tm: (N.SoftPlus(), {}, {}),
+    "Softsign": lambda tm: (N.SoftSign(), {}, {}),
+    "Tanhshrink": lambda tm: (N.TanhShrink(), {}, {}),
+    "Softshrink": lambda tm: (N.SoftShrink(tm.lambd), {}, {}),
+    "Hardshrink": lambda tm: (N.HardShrink(tm.lambd), {}, {}),
+    "LogSigmoid": lambda tm: (N.LogSigmoid(), {}, {}),
     "Hardswish": lambda tm: (N.HardSwish(), {}, {}),
     "Hardsigmoid": lambda tm: (N.HardSigmoid(), {}, {}),
     "Hardtanh": lambda tm: (N.HardTanh(tm.min_val, tm.max_val), {}, {}),

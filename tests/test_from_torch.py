@@ -658,3 +658,29 @@ def test_bert_like_classifier_with_encoder_stack():
     with torch.no_grad():
         ty = tm(torch.tensor(ids))
     np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-3)
+
+
+def test_activation_module_tail_converts():
+    acts = [torch.nn.LogSoftmax(dim=-1), torch.nn.Mish(),
+            torch.nn.Softplus(), torch.nn.Softsign(),
+            torch.nn.Tanhshrink(), torch.nn.Softshrink(0.3),
+            torch.nn.Hardshrink(0.3), torch.nn.LogSigmoid()]
+
+    class Net(torch.nn.Module):
+        def __init__(self, act):
+            super().__init__()
+            self.fc = torch.nn.Linear(6, 6)
+            self.act = act
+
+        def forward(self, x):
+            return self.act(self.fc(x))
+
+    x = RS.rand(3, 6).astype(np.float32)
+    for act in acts:
+        tm = Net(act).eval()
+        model, variables = from_torch_module(tm, example_input=x)
+        y, _ = model.apply(variables, x)
+        with torch.no_grad():
+            ty = tm(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5,
+                                   err_msg=type(act).__name__)
