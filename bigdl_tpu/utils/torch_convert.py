@@ -709,6 +709,22 @@ def from_torch_module(tmodule, example_input=None):
                         f"F.interpolate mode {mode!r}/align_corners")
                 emit(node, N.UpSampling2D(sfp, mode=mode),
                      [sym[node.args[0]]])
+            elif fn in (torch.nn.functional.silu,):
+                emit(node, N.SiLU(), [sym[node.args[0]]])
+            elif fn is torch.nn.functional.leaky_relu:
+                slope = (node.args[1] if len(node.args) > 1
+                         else node.kwargs.get("negative_slope", 0.01))
+                emit(node, N.LeakyReLU(float(slope)), [sym[node.args[0]]])
+            elif fn in (torch.nn.functional.elu,):
+                alpha = (node.args[1] if len(node.args) > 1
+                         else node.kwargs.get("alpha", 1.0))
+                emit(node, N.ELU(float(alpha)), [sym[node.args[0]]])
+            elif fn is torch.nn.functional.log_softmax:
+                emit(node, N.LogSoftMax(), [sym[node.args[0]]])
+            elif fn in (torch.nn.functional.hardswish,):
+                emit(node, N.HardSwish(), [sym[node.args[0]]])
+            elif fn in (torch.nn.functional.softplus,):
+                emit(node, N.SoftPlus(), [sym[node.args[0]]])
             elif fn is torch.nn.functional.gelu:
                 emit(node, N.GELU(), [sym[node.args[0]]])
             elif fn in (torch.sigmoid, torch.nn.functional.sigmoid):

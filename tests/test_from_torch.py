@@ -684,3 +684,29 @@ def test_activation_module_tail_converts():
             ty = tm(torch.tensor(x))
         np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5,
                                    err_msg=type(act).__name__)
+
+
+def test_functional_activation_tail_converts():
+    import torch.nn.functional as F
+
+    cases = [lambda x: F.silu(x), lambda x: F.leaky_relu(x, 0.2),
+             lambda x: F.elu(x, 0.7), lambda x: F.log_softmax(x, dim=-1),
+             lambda x: F.hardswish(x), lambda x: F.softplus(x)]
+
+    x = RS.rand(3, 6).astype(np.float32)
+    for i, f in enumerate(cases):
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = torch.nn.Linear(6, 6)
+
+            def forward(self, z):
+                return f(self.fc(z))
+
+        tm = Net().eval()
+        model, variables = from_torch_module(tm, example_input=x)
+        y, _ = model.apply(variables, x)
+        with torch.no_grad():
+            ty = tm(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5,
+                                   err_msg=f"case {i}")
