@@ -20,8 +20,11 @@ Conventions/limits (raise with a clear message otherwise):
   inter-layer dropout — converted as a chain of scan layers),
   TransformerEncoder/TransformerEncoderLayer (structural leaf, both norm
   orders; their forwards break under symbolic trace), Upsample.
-- supported graph ops: +, *, cat, flatten/view(b,-1), mean over spatial,
-  relu/gelu/sigmoid/tanh/softmax, getitem(0) on MHA/LSTM outputs.
+- supported graph ops: +, -, *, / (tensor and scalar), cat,
+  flatten/view(b,-1) incl. dynamic x.size(0)/x.shape[0] forms, mean over
+  spatial dims, y[:, i] timestep select, F.interpolate (scale_factor),
+  functional activations (relu/gelu/sigmoid/tanh/softmax/silu/leaky_relu/
+  elu/log_softmax/hardswish/softplus), getitem(0) on MHA/LSTM outputs.
 """
 
 import operator
