@@ -75,7 +75,8 @@ class GSPMDTrainStep:
 
     def __init__(self, model, criterion, optim_method, mesh: Mesh,
                  variables: Dict[str, Any],
-                 rule_fn: Callable[[str, Any], P] = tp_spec_for_path):
+                 rule_fn: Callable[[str, Any], P] = tp_spec_for_path,
+                 remat: bool = False):
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -108,6 +109,8 @@ class GSPMDTrainStep:
                 out, _ = model_.forward(p, {}, x, training=True, rng=rng)
                 return criterion_.forward(out, y)
 
+            if remat:  # recompute activations in the backward (HBM relief)
+                loss_fn = jax.checkpoint(loss_fn)
             loss, grads = jax.value_and_grad(loss_fn)(params)
             new_params, new_opt = optim_.update(step, grads, params,
                                                 opt_state)

@@ -239,3 +239,39 @@ def test_gspmd_auto_partitions_encoder_decoder_transformer():
         1, jax.random.PRNGKey(0), (src, tgt_in), src.reshape(-1))))
     assert np.isfinite(l0) and np.isfinite(l1)
     assert len(step.shard_report()) >= 16
+
+
+def test_gspmd_remat_matches_plain():
+    """remat on the GSPMD step is numerically the identical program."""
+    import numpy as np
+
+    from bigdl_tpu.keras.engine import Input as KInput, Model as KModel
+    from bigdl_tpu.nn.attention import TransformerLayer
+    from bigdl_tpu.nn.layers import Linear
+    from bigdl_tpu.nn.layers_extra import Mean
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.parallel.gspmd import GSPMDTrainStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    rs = np.random.RandomState(0)
+    d = 8
+    gi = KInput((6, d))
+    gh = TransformerLayer(d, 2, 4 * d, dropout=0.0)(gi)
+    go = Linear(d, 2)(Mean(dim=1)(gh))
+    gmodel = KModel(gi, go)
+    gx = rs.randn(8, 6, d).astype(np.float32)
+    gy = rs.randint(0, 2, 8).astype(np.int32)
+    rng = jax.random.PRNGKey(0)
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    crit = CrossEntropyCriterion()
+
+    losses = {}
+    for remat in (False, True):
+        gvars = gmodel.init(jax.random.PRNGKey(1), jnp.asarray(gx[:1]))
+        step = GSPMDTrainStep(gmodel, crit, SGD(learning_rate=1e-2), mesh,
+                              gvars, remat=remat)
+        ls = [float(np.asarray(step.train_step(i, rng, gx, gy)))
+              for i in range(5)]
+        losses[remat] = ls
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
