@@ -105,6 +105,8 @@ def main(argv=None) -> int:
 
     sub.add_parser("bench", help="run the repo benchmark (bench.py)")
     sub.add_parser("dryrun", help="8-virtual-device multichip dry run")
+    sub.add_parser("watch", help="session-long TPU availability watcher "
+                   "(bench_watch.py; logs BENCH_attempts.jsonl)")
 
     pack = sub.add_parser(
         "pack", help="pack arrays into a BTRECv1 record file "
@@ -128,6 +130,9 @@ def main(argv=None) -> int:
             "import __graft_entry__ as g; g.dryrun_multichip(8)"], cwd=repo)
     if args.cmd == "pack":
         return _pack(args)
+    if args.cmd == "watch":
+        return subprocess.call([sys.executable,
+                                os.path.join(repo, "bench_watch.py")])
     return 2
 
 
