@@ -12,10 +12,10 @@ watcher loops for the whole session:
   trail the round-2 verdict asked for;
 - on the first successful probe it runs the REAL bench worker
   (``bench.py --worker tpu``) and, if that parses, snapshots the result to
-  ``BENCH_r03.json`` (with ``baseline_source: "nominal"`` and an MFU sanity
+  ``BENCH_r04.json`` (with ``baseline_source: "nominal"`` and an MFU sanity
   gate: ``mfu > 1`` marks the row ``suspect: true``) and also runs
   ``__graft_entry__.dryrun_tpu_ops()`` to capture Mosaic-compiled Pallas
-  kernel evidence (``PALLAS_TPU_r03.json``);
+  kernel evidence (``PALLAS_TPU_r04.json``);
 - after a successful bench capture it keeps probing (cheap) but stops
   re-running the expensive bench unless ``BENCH_WATCH_REPEAT=1``.
 
@@ -30,8 +30,8 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 ATTEMPTS = os.path.join(HERE, "BENCH_attempts.jsonl")
-SNAPSHOT = os.path.join(HERE, "BENCH_r03.json")
-PALLAS_SNAPSHOT = os.path.join(HERE, "PALLAS_TPU_r03.json")
+SNAPSHOT = os.path.join(HERE, "BENCH_r04.json")
+PALLAS_SNAPSHOT = os.path.join(HERE, "PALLAS_TPU_r04.json")
 
 PROBE_TIMEOUT = float(os.environ.get("BENCH_WATCH_PROBE_TIMEOUT", "150"))
 BENCH_TIMEOUT = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))

@@ -47,22 +47,45 @@ def thread_prefetch(batches: Iterable[T], depth: int = 2) -> Iterator[T]:
         raise ValueError(f"thread_prefetch depth must be >= 1, got {depth}")
     q: "_queue.Queue" = _queue.Queue(maxsize=depth)
     _END, _ERR = object(), object()
+    stop = _threading.Event()
+
+    def _put(item) -> bool:
+        # A plain q.put would block forever once the consumer abandons the
+        # generator (preemption break, end_when mid-epoch, exception in the
+        # training loop), leaking the thread + buffered batches + upstream
+        # iterator per abandoned epoch — poll the stop flag instead.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
 
     def produce():
         try:
             for b in batches:
-                q.put(b)
-            q.put(_END)
+                if not _put(b):
+                    return
+            _put(_END)
         except BaseException as e:  # noqa: BLE001 — surfaces at consumer
-            q.put((_ERR, e))
+            _put((_ERR, e))
+        finally:
+            close = getattr(batches, "close", None)
+            if stop.is_set() and close is not None:
+                close()
 
     t = _threading.Thread(target=produce, name="bigdl-tpu-prefetch",
                           daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
-            raise item[1]
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] is _ERR):
+                raise item[1]
+            yield item
+    finally:
+        stop.set()

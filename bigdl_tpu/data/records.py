@@ -39,6 +39,10 @@ def write_records(path: str, fields: Dict[str, np.ndarray]) -> None:
         raise ValueError("fields differ in leading dim: "
                          + str({k: len(a) for k, a in zip(names, arrays)}))
     record_bytes = sum(a.nbytes // n for a in arrays)
+    if record_bytes == 0:
+        # the native reader rejects rb==0 headers (overflow guard); refuse
+        # to produce a file the two read paths would treat differently
+        raise ValueError("records must be at least one byte wide")
     manifest = {
         "record_bytes": record_bytes,
         "n_records": n,
@@ -86,12 +90,26 @@ class RecordDataSet(DataSet):
 
         from bigdl_tpu.native import lib as nat
 
+        # The gather path drives indices/strides from the JSON sidecar; a
+        # stale sidecar paired with a different record file would walk out
+        # of bounds (native memcpy) or decode garbage (memmap), so
+        # cross-check sidecar vs the file's own header before either path.
+        n = int(self.manifest["n_records"])
+        rb = int(self.manifest["record_bytes"])
+        with open(path, "rb") as f:
+            hdr = f.read(24)
+        if len(hdr) < 24 or hdr[:8] != b"BTRECv1\0":
+            raise ValueError(f"not a BTRECv1 record file: {path}")
+        h_rb, h_n = struct.unpack("<QQ", hdr[8:24])
+        if (h_n, h_rb) != (n, rb):
+            raise ValueError(
+                f"sidecar {path}.json does not match record header: "
+                f"manifest n={n} rb={rb}, header n={h_n} rb={h_rb}")
+
         self._reader = None
         if nat.available():
             self._reader = nat.RecordReader(path, pipeline=pipeline)
         else:  # pure-numpy fallback: memmap over the record region
-            n = self.manifest["n_records"]
-            rb = self.manifest["record_bytes"]
             self._mm = np.memmap(path, np.uint8, "r", offset=24,
                                  shape=(n, rb))
 

@@ -19,6 +19,7 @@ import numpy as np
 from bigdl_tpu.data.dataset import MiniBatch
 
 PAD, UNK, BOS, EOS = "<pad>", "<unk>", "<bos>", "<eos>"
+_VOCAB_V2 = "#bigdl-tpu-vocab-v2"
 
 
 class Vocabulary:
@@ -59,15 +60,43 @@ class Vocabulary:
 
     def save(self, path: str) -> None:
         """Persist the vocabulary (one token per line, frequency order) —
-        re-loadable with :meth:`load` for serving-side tokenization."""
-        with open(path, "w", encoding="utf-8") as f:
+        re-loadable with :meth:`load` for serving-side tokenization.
+        Newlines/backslashes inside a token are escaped so a pathological
+        token cannot shift every subsequent id on reload; a version sentinel
+        on the first line keeps raw (pre-escaping) files loading
+        unchanged."""
+        with open(path, "w", encoding="utf-8", newline="\n") as f:
+            f.write(_VOCAB_V2 + "\n")
             for tok in self.itos:
-                f.write(tok + "\n")
+                f.write(tok.replace("\\", "\\\\").replace("\n", "\\n")
+                        .replace("\r", "\\r") + "\n")
+
+    @staticmethod
+    def _unescape(s: str) -> str:
+        out, i = [], 0
+        while i < len(s):
+            c = s[i]
+            if c == "\\" and i + 1 < len(s):
+                nxt = s[i + 1]
+                out.append({"n": "\n", "r": "\r", "\\": "\\"}.get(nxt,
+                                                                  "\\" + nxt))
+                i += 2
+            else:
+                out.append(c)
+                i += 1
+        return "".join(out)
 
     @staticmethod
     def load(path: str) -> "Vocabulary":
         with open(path, encoding="utf-8") as f:
-            tokens = [ln.rstrip("\n") for ln in f]
+            lines = [ln.rstrip("\n") for ln in f]
+        if lines and lines[0].rstrip("\r") == _VOCAB_V2:
+            if lines[0].endswith("\r"):  # CRLF-translated v2 file
+                lines = [ln[:-1] if ln.endswith("\r") else ln
+                         for ln in lines]
+            tokens = [Vocabulary._unescape(ln) for ln in lines[1:]]
+        else:  # legacy raw format: tokens verbatim, no unescaping
+            tokens = lines
         v = Vocabulary.__new__(Vocabulary)
         v.itos = tokens
         v.stoi = {t: i for i, t in enumerate(tokens)}

@@ -178,7 +178,8 @@ class TwoTower(Module):
                 din = h
                 ki += 1
             params[f"{tower}w_out"] = jax.random.normal(
-                ks[ki % len(ks)], (din, d)) * jnp.sqrt(1.0 / din)
+                ks[ki], (din, d)) * jnp.sqrt(1.0 / din)
+            ki += 1
         return params, EMPTY
 
     def _tower(self, params, x, tower):

@@ -457,9 +457,14 @@ class Optimizer:
 
     def _save_checkpoint(self, step_engine, state):
         state["loss"] = float(state["loss"])
+        # Snapshot unconditionally: the async writer serializes driver_state
+        # in a background thread while the training loop keeps mutating the
+        # live dict, so the manifest could otherwise record a later iteration
+        # than the params it accompanies.
+        state = dict(state)
         schedule = getattr(self.optim_method, "schedule", None)
         if schedule is not None and hasattr(schedule, "state_dict"):
-            state = dict(state, schedule_state=schedule.state_dict())
+            state["schedule_state"] = schedule.state_dict()
         kw = dict(
             flat_params=np.asarray(step_engine.flat_params),
             opt_state=host_fetch(step_engine.opt_state),

@@ -305,7 +305,10 @@ void* btio_records_open(const char* path) {
   rf->map_len = st.st_size;
   std::memcpy(&rf->record_bytes, b + 8, 8);
   std::memcpy(&rf->n_records, b + 16, 8);
-  if (24 + rf->record_bytes * rf->n_records > rf->map_len) {
+  // Overflow-safe bounds check: record_bytes * n_records can wrap uint64 for
+  // a corrupt/hostile header, so divide instead of multiplying.
+  if (rf->record_bytes == 0 ||
+      rf->n_records > (rf->map_len - 24) / rf->record_bytes) {
     munmap(m, st.st_size);
     ::close(fd);
     delete rf;

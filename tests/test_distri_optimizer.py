@@ -458,3 +458,47 @@ def test_ema_checkpoints_and_survives_resume(tmp_path):
     trained.set_variables(ema_vars)
     res_ema = trained.evaluate(ds, [optim.Top1Accuracy()])
     assert res_ema[0].result > 0.7, (res[0].result, res_ema[0].result)
+
+
+def test_async_checkpoint_snapshots_driver_state(tmp_path):
+    """ADVICE r3: the async writer must serialize a SNAPSHOT of the driver
+    state — the training loop keeps mutating the live dict, and a manifest
+    recording a later iteration than its params skews resume."""
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.module import Sequential
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 5).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    model = Sequential([nn.Linear(5, 4), nn.ReLU(), nn.Linear(4, 2)])
+    opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                          nn.CrossEntropyCriterion(), batch_size=32)
+    opt.set_optim_method(optim.SGD(learning_rate=0.1))
+    opt.set_checkpoint(str(tmp_path / "ck"),
+                       optim.Trigger.every_epoch(), async_write=True)
+
+    captured = {}
+
+    class CapturingAsync:
+        def submit(self, path, step, **kw):
+            captured["driver_state"] = kw["driver_state"]
+
+        def wait(self, raise_error=True):
+            pass
+
+    opt._ckpt_async = CapturingAsync()
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.engine import Engine
+
+    init_vars = model.init(jax.random.PRNGKey(0), x[:1])
+    engine = ShardedParameterStep(model, opt.criterion, opt.optim_method,
+                                  Engine.get().mesh, init_vars)
+    state = {"iteration": 7, "epoch": 1, "loss": np.float32(0.5)}
+    opt._save_checkpoint(engine, state)
+    state["iteration"] = 99          # training loop moves on
+    state["loss"] = np.float32(9.9)
+    snap = captured["driver_state"]
+    assert snap is not state
+    assert snap["iteration"] == 7
+    assert float(snap["loss"]) == pytest.approx(0.5)

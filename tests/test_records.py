@@ -189,3 +189,97 @@ def test_measure_loader_smoke():
     assert "python_ref_img_per_sec" in r
     if r["native_available"]:
         assert r["loader_img_per_sec"] > 0
+
+
+def test_thread_prefetch_abandoned_consumer_stops_producer():
+    """ADVICE r3: abandoning the generator (preemption break / end_when /
+    exception mid-epoch) must stop the producer thread, not leak it
+    blocked on q.put forever."""
+    import threading
+    import time
+
+    from bigdl_tpu.data.prefetch import thread_prefetch
+
+    closed = []
+
+    def producer():
+        try:
+            i = 0
+            while True:
+                yield i
+                i += 1
+        finally:
+            closed.append(True)
+
+    it = thread_prefetch(producer(), depth=1)
+    assert next(it) == 0
+    it.close()  # consumer abandons mid-stream
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not any(t.name == "bigdl-tpu-prefetch" and t.is_alive()
+                   for t in threading.enumerate()) and closed:
+            break
+        time.sleep(0.05)
+    assert closed, "upstream iterator was not closed"
+    assert not any(t.name == "bigdl-tpu-prefetch" and t.is_alive()
+                   for t in threading.enumerate()), "producer thread leaked"
+
+
+@pytest.mark.skipif(not nat.available(), reason="native lib unavailable")
+def test_stale_sidecar_rejected(rec, tmp_path):
+    """ADVICE r3: a sidecar whose n_records/record_bytes disagree with the
+    native header must be rejected (it drives the gather strides)."""
+    import json
+
+    p, x, y = rec
+    with open(p + ".json") as f:
+        manifest = json.load(f)
+    manifest["n_records"] = 10_000  # stale/mismatched sidecar
+    with open(p + ".json", "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="does not match record header"):
+        RecordDataSet(p)
+
+
+@pytest.mark.skipif(not nat.available(), reason="native lib unavailable")
+def test_overflow_header_rejected(tmp_path):
+    """ADVICE r3: record_bytes * n_records wrapping uint64 must not pass the
+    native bounds check (2**32 * 2**32 == 0 mod 2**64)."""
+    import struct
+
+    p = str(tmp_path / "evil.btrec")
+    with open(p, "wb") as f:
+        f.write(b"BTRECv1\0")
+        f.write(struct.pack("<QQ", 2 ** 32, 2 ** 32))
+        f.write(b"\0" * 64)
+    with pytest.raises(ValueError, match="not a BTRECv1 record file"):
+        nat.RecordReader(p)
+
+
+@pytest.mark.skipif(not nat.available(), reason="native lib unavailable")
+def test_zero_record_bytes_rejected(tmp_path):
+    import struct
+
+    p = str(tmp_path / "zero.btrec")
+    with open(p, "wb") as f:
+        f.write(b"BTRECv1\0")
+        f.write(struct.pack("<QQ", 0, 5))
+        f.write(b"\0" * 64)
+    with pytest.raises(ValueError, match="not a BTRECv1 record file"):
+        nat.RecordReader(p)
+
+
+def test_stale_sidecar_rejected_numpy_fallback(rec, monkeypatch):
+    """The memmap fallback must apply the same sidecar/header cross-check
+    as the native reader."""
+    import json
+
+    p, x, y = rec
+    with open(p + ".json") as f:
+        manifest = json.load(f)
+    manifest["n_records"] = 10_000
+    with open(p + ".json", "w") as f:
+        json.dump(manifest, f)
+    monkeypatch.setattr(nat, "available", lambda: False)
+    with pytest.raises(ValueError, match="does not match record header"):
+        RecordDataSet(p)

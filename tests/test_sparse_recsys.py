@@ -274,3 +274,22 @@ def test_dien_learns_history_dependent_ctr():
     logits, _ = model.forward(params, {}, users, hist, target)
     acc = ((np.asarray(logits) > 0) == (y > 0.5)).mean()
     assert acc > 0.85, (acc, float(loss))
+
+
+def test_twotower_init_keys_distinct():
+    """ADVICE r3: uw_out and the item tower's first layer must not draw
+    from the same RNG key (ki was not incremented after w_out)."""
+    from bigdl_tpu.models.recsys import TwoTower
+
+    model = TwoTower(8, 8, dim=16, hidden=(16,))
+    params, _ = model.build(
+        jax.random.PRNGKey(0), np.zeros(2, np.int32),
+        np.zeros((2, 3), np.int32), np.zeros(2, np.int32))
+    # same (16,16) shape; under the bug these were the same normal draw
+    # at different scales
+    a = np.asarray(params["uw_out"]) / np.sqrt(1.0 / 16)
+    b = np.asarray(params["iw0"]) / np.sqrt(2.0 / 16)
+    assert not np.allclose(a, b, atol=1e-5)
+    c = np.asarray(params["iw_out"]) / np.sqrt(1.0 / 16)
+    assert not np.allclose(a, c, atol=1e-5)
+    assert not np.allclose(b, c, atol=1e-5)

@@ -99,3 +99,47 @@ def test_vocabulary_save_load_roundtrip(tmp_path):
     ids = v.encode(word_tokenize("the cat"), add_eos=True)
     assert v2.encode(word_tokenize("the cat"), add_eos=True) == ids
     assert v2.decode(ids) == ["the", "cat"]
+
+
+def test_vocabulary_newline_token_roundtrip(tmp_path):
+    """ADVICE r3: a token containing a newline must not shift every
+    subsequent id on reload."""
+    from bigdl_tpu.data.text import Vocabulary
+
+    v = Vocabulary.build([["a\nb", "plain", "c\rd", "back\\slash", "z"]],
+                         min_freq=1)
+    p = str(tmp_path / "v.txt")
+    v.save(p)
+    v2 = Vocabulary.load(p)
+    assert v2.itos == v.itos
+    assert v2.stoi == v.stoi
+
+
+def test_vocabulary_legacy_raw_file_loads_verbatim(tmp_path):
+    """Files saved by the pre-escaping format (no version sentinel) must
+    load without unescaping — a literal backslash-n token stays two chars."""
+    from bigdl_tpu.data.text import Vocabulary
+
+    p = str(tmp_path / "legacy.txt")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("<pad>\n<unk>\n<bos>\n<eos>\n\\n\nback\\\\slash\n")
+    v = Vocabulary.load(p)
+    assert v.itos[4] == "\\n"          # two characters, not a newline
+    assert v.itos[5] == "back\\\\slash"
+
+
+def test_vocabulary_v2_crlf_file_loads(tmp_path):
+    """A v2 vocab file rewritten with CRLF endings (git autocrlf etc.) must
+    still be detected as v2 and unescaped."""
+    from bigdl_tpu.data.text import Vocabulary
+
+    v = Vocabulary.build([["a\nb", "hello"]])
+    p = str(tmp_path / "v.txt")
+    v.save(p)
+    with open(p, encoding="utf-8", newline="") as f:
+        content = f.read()
+    assert "\r" not in content            # save forces LF
+    with open(p, "w", encoding="utf-8", newline="") as f:
+        f.write(content.replace("\n", "\r\n"))
+    v2 = Vocabulary.load(p)
+    assert v2.itos == v.itos
