@@ -19,9 +19,78 @@ The real-slice protocol (what to run on a v5e pod and what to record) is
 documented in docs/performance.md §"Scaling protocol".
 """
 
+import argparse
 import json
 import os
 import time
+
+
+def main_real(args):
+    """REAL-slice scaling measurement: launch one process per host via
+    ``bigdl-tpu run bench_scaling.py -- --real`` (the gang launcher sets the
+    rendezvous env).  Measures the full-mesh ZeRO-1 step (dcn_data
+    auto-detected from the slice topology) and prints one JSON line from
+    rank 0; the 8->256 curve comes from invoking this at each slice size
+    (docs/performance.md §Scaling protocol)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.models.resnet import resnet50, resnet_cifar
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.engine import Engine, init_engine
+    from bigdl_tpu.runtime.mesh import detect_slice_count
+
+    engine = init_engine()
+    mesh = engine.mesh
+    devices = jax.devices()
+    n_dev = len(devices)
+    per_dev_batch = args.per_device_batch
+    global_batch = per_dev_batch * n_dev
+    model = (resnet50(classes=1000) if args.model == "resnet50"
+             else resnet_cifar(depth=8, classes=10))
+    side = 224 if args.model == "resnet50" else 32
+    classes = 1000 if args.model == "resnet50" else 10
+
+    rs = np.random.RandomState(0)
+    local = global_batch // jax.process_count()
+    x = rs.rand(local, side, side, 3).astype(np.float32)
+    y = rs.randint(0, classes, (local,)).astype(np.int32)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.asarray(x[:1]))
+    step = ShardedParameterStep(
+        model, CrossEntropyCriterion(),
+        SGD(learning_rate=0.1, momentum=0.9), mesh, variables,
+        # bf16 reduce-scatter pays off once the data axis crosses hosts
+        # (DCN-bound); over a single slice's ICI f32 is free
+        bf16_grads=jax.process_count() > 1)
+    xd, yd = step.shard_batch(x), step.shard_batch(y)
+    float(np.asarray(step.train_step_device(0, rng, xd, yd)))  # compile
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = step.train_step_device(i + 1, rng, xd, yd)
+    final = float(np.asarray(loss))
+    dt = (time.perf_counter() - t0) / args.steps
+    if jax.process_index() == 0:
+        print(json.dumps({
+            "metric": "real_slice_img_per_s",
+            "value": round(global_batch / dt, 1),
+            "unit": "img/s",
+            "vs_baseline": None,
+            "model": args.model,
+            "n_devices": n_dev,
+            "n_slices": detect_slice_count(devices),
+            "n_processes": jax.process_count(),
+            "device_kind": devices[0].device_kind,
+            "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+            "global_batch": global_batch,
+            "step_time_ms": round(dt * 1e3, 2),
+            "ici_bytes_per_step": step.collective_bytes_per_step,
+            "dcn_bytes_per_step": step.dcn_bytes_per_step,
+            "final_loss": round(final, 4),
+        }))
 
 
 def main():
@@ -93,4 +162,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--real", action="store_true",
+                    help="measure the REAL device mesh (launch via "
+                         "`bigdl-tpu run bench_scaling.py -- --real`)")
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "resnet_cifar"])
+    ap.add_argument("--per-device-batch", type=int, default=96)
+    ap.add_argument("--steps", type=int, default=20)
+    cli_args = ap.parse_args()
+    if cli_args.steps < 1:
+        ap.error("--steps must be >= 1")
+    if cli_args.real:
+        main_real(cli_args)
+    else:
+        main()

@@ -52,7 +52,8 @@ class TrainedModel:
             x = np.asarray(x)
         # multi-host predict runs per-process (no mesh sharding), so padding
         # to the data-axis multiple is only needed single-process
-        ndev = self._engine.ndev if jax.process_count() == 1 else 1
+        ndev = (self._engine.n_data_replicas
+                if jax.process_count() == 1 else 1)
         n = (x[0] if multi else x).shape[0]
 
         def pad_to(arrs, k):

@@ -37,7 +37,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from bigdl_tpu.runtime.mesh import AXIS_DATA
+from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_DCN
 
 
 def as_inputs(x):
@@ -133,7 +133,16 @@ class ShardedParameterStep:
         self.remat = remat
         self.accum_steps = int(accum_steps)
         self.ema_decay = float(ema_decay)
-        self.ndev = mesh.shape[AXIS_DATA]
+        # ICI (within-slice) data axis: the ZeRO-1 shard denominator.  A
+        # multislice mesh adds an outer "dcn_data" axis; gradients
+        # reduce-scatter over ICI first and only 1/ndev of the vector
+        # crosses DCN (hierarchical allreduce — BASELINE.md 8->256 target).
+        axes = dict(mesh.shape)
+        self.ndev = axes[AXIS_DATA]
+        self.dcn = axes.get(AXIS_DCN, 1)
+        self._dcn_axis = AXIS_DCN if self.dcn > 1 else None
+        self._batch_axes = ((AXIS_DCN, AXIS_DATA) if AXIS_DCN in axes
+                            else (AXIS_DATA,))
 
         flat, self.unravel = ravel_pytree(init_variables["params"])
         self.n_real = flat.shape[0]
@@ -142,7 +151,7 @@ class ShardedParameterStep:
 
         self._rep = NamedSharding(mesh, P())
         self._sharded_vec = NamedSharding(mesh, P(AXIS_DATA))
-        self._batch_sh = NamedSharding(mesh, P(AXIS_DATA))
+        self._batch_sh = NamedSharding(mesh, P(self._batch_axes))
 
         # initial device state
         self.flat_params = jax.device_put(
@@ -187,9 +196,15 @@ class ShardedParameterStep:
         accum = max(1, self.accum_steps)
         ema_decay = self.ema_decay
 
+        dcn_axis, n_replicas = self._dcn_axis, self.ndev * self.dcn
+        batch_axes = self._batch_axes
+
         def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y):
             params = unravel(flat_p[:n_real])
-            dev_rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
+            replica = jax.lax.axis_index(AXIS_DATA)
+            if dcn_axis:
+                replica = replica + ndev * jax.lax.axis_index(dcn_axis)
+            dev_rng = jax.random.fold_in(rng, replica)
 
             def grad_of(p, ms, xs_mb, y_mb, rng_mb):
                 def loss_fn(pp):
@@ -240,9 +255,16 @@ class ShardedParameterStep:
             if elementwise:
                 # reduce-scatter (mean) -> sharded update -> all-gather:
                 # exactly AllReduceParameter's put/aggregate/send cycle.
+                # Multislice: scatter rides ICI first, then only the
+                # 1/ndev slice is psum'd across DCN; every slice computes
+                # the identical update, so no parameter bytes cross DCN.
                 g_slice = jax.lax.psum_scatter(
-                    flat_g, AXIS_DATA, scatter_dimension=0,
-                    tiled=True).astype(jnp.float32) / ndev
+                    flat_g, AXIS_DATA, scatter_dimension=0, tiled=True)
+                if dcn_axis:
+                    # still in the gradient dtype: with bf16_grads the DCN
+                    # hop carries half the bytes (FP16CompressedTensor role)
+                    g_slice = jax.lax.psum(g_slice, dcn_axis)
+                g_slice = g_slice.astype(jnp.float32) / n_replicas
                 g_slice = _clip_slice(g_slice, clip, AXIS_DATA)
                 rank = jax.lax.axis_index(AXIS_DATA)
                 p_slice = jax.lax.dynamic_slice(
@@ -257,7 +279,7 @@ class ShardedParameterStep:
                 if accum > 1:   # re-tree the accumulated flat gradient
                     grads = unravel(flat_g[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
-                    lambda g: jax.lax.pmean(g, AXIS_DATA), grads)
+                    lambda g: jax.lax.pmean(g, batch_axes), grads)
                 if clip is not None and clip.l2_norm is not None:
                     fg, _ = ravel_pytree(grads)
                     norm = jnp.linalg.norm(fg)
@@ -267,9 +289,9 @@ class ShardedParameterStep:
                 nf, _ = ravel_pytree(new_params)
                 new_flat = jnp.pad(nf, (0, flat_p.shape[0] - n_real))
 
-            loss = jax.lax.pmean(loss, AXIS_DATA)
+            loss = jax.lax.pmean(loss, batch_axes)
             new_mstate = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, AXIS_DATA)
+                lambda a: jax.lax.pmean(a, batch_axes)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
                 new_mstate)
             new_ema = (ema_decay * ema + (1.0 - ema_decay) * new_flat
@@ -277,10 +299,11 @@ class ShardedParameterStep:
             return new_flat, new_ema, new_opt, new_mstate, loss
 
         opt_spec = (P(AXIS_DATA) if elementwise else P())
+        batch_spec = P(self._batch_axes)
         mapped = shard_map(
             step_shard, mesh=self.mesh,
-            in_specs=(P(), P(), opt_spec, P(), P(), P(), P(AXIS_DATA),
-                      P(AXIS_DATA)),
+            in_specs=(P(), P(), opt_spec, P(), P(), P(), batch_spec,
+                      batch_spec),
             out_specs=(P(), P(), opt_spec, P(), P()),
             check_vma=False,
         )
@@ -290,6 +313,8 @@ class ShardedParameterStep:
     def _build_eval(self, methods: Tuple):
         model, unravel, n_real = self.model, self.unravel, self.n_real
 
+        batch_axes = self._batch_axes
+
         def eval_shard(flat_p, mstate, x, y, w):
             params = unravel(flat_p[:n_real])
             xs = as_inputs(x)
@@ -297,13 +322,14 @@ class ShardedParameterStep:
             stats = []
             for m in methods:
                 s, c = m.batch_stats(out, y, w)
-                stats.append((jax.lax.psum(s, AXIS_DATA),
-                              jax.lax.psum(c, AXIS_DATA)))
+                stats.append((jax.lax.psum(s, batch_axes),
+                              jax.lax.psum(c, batch_axes)))
             return tuple(stats)
 
+        batch_spec = P(batch_axes)
         mapped = shard_map(
             eval_shard, mesh=self.mesh,
-            in_specs=(P(), P(), P(AXIS_DATA), P(AXIS_DATA), P(AXIS_DATA)),
+            in_specs=(P(), P(), batch_spec, batch_spec, batch_spec),
             out_specs=P(), check_vma=False)
         return jax.jit(mapped)
 
@@ -314,6 +340,20 @@ class ShardedParameterStep:
         the updated flat f32 params."""
         grad_bytes = self.n_pad * (2 if self.bf16_grads else 4)
         return grad_bytes + self.n_pad * 4
+
+    @property
+    def n_data_replicas(self) -> int:
+        """Total data-parallel degree (ICI x DCN) — batch dim multiples."""
+        return self.ndev * self.dcn
+
+    @property
+    def dcn_bytes_per_step(self) -> int:
+        """Per-step CROSS-SLICE (DCN) traffic: the hierarchical allreduce
+        moves only the 1/ndev gradient slice over DCN (psum ~ 2x slice
+        bytes); parameters never cross slices."""
+        if self.dcn <= 1:
+            return 0
+        return 2 * self.shard_size * (2 if self.bf16_grads else 4)
 
     # ------------------------------------------------------------------
     def shard_batch(self, arr):

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_MODEL
+from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_DCN, AXIS_MODEL
 
 
 # (path regex, spec builder) — first match wins; paths look like
@@ -97,7 +97,13 @@ class GSPMDTrainStep:
         # moments inherit each parameter's sharding (model-parallel Adam
         # state); scalar counters stay replicated
         self.opt_state = self.optim.init_state(self.params)
-        self.batch_sh = NamedSharding(mesh, P(AXIS_DATA))
+        # batch shards over every data-parallel axis: on a multislice mesh
+        # the outer dcn_data axis must carry batch shards too, else each
+        # slice redundantly computes the same gradients
+        axes = dict(mesh.shape)
+        batch_axes = ((AXIS_DCN, AXIS_DATA) if AXIS_DCN in axes
+                      else (AXIS_DATA,))
+        self.batch_sh = NamedSharding(mesh, P(batch_axes))
 
         # locals only: the jitted closure must not retain self (and with it
         # the host-side param copy) in the jit cache

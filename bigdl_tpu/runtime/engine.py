@@ -50,6 +50,12 @@ class EngineConfig:
             cfg.process_id = int(os.environ.get("BIGDL_TPU_PROCESS_ID", "0"))
         if os.environ.get("BIGDL_TPU_RETRY_TIMES"):
             cfg.failure_retry_times = int(os.environ["BIGDL_TPU_RETRY_TIMES"])
+        if os.environ.get("BIGDL_TPU_DCN_SLICES"):
+            # force the cross-slice data-parallel degree where the runtime
+            # exposes no slice topology (e.g. multi-host CPU, GKE multislice
+            # before the plugin reports slice_index)
+            cfg.mesh = dataclasses.replace(
+                cfg.mesh, dcn_data=int(os.environ["BIGDL_TPU_DCN_SLICES"]))
         return cfg
 
 
