@@ -67,14 +67,17 @@ class InferenceOptimizer:
     @staticmethod
     def quantize(model, variables, sample=None, precision: str = "int8",
                  calib_data=None, calib_method: str = "percentile",
-                 calib_percentile: float = 99.9) -> TracedModel:
+                 calib_percentile: float = 99.9,
+                 calib_granularity: str = "tensor") -> TracedModel:
         """Post-training quantization.  precision: int8 | bf16.
 
         ``calib_data``: iterable of input batches for ACTIVATION
         calibration (reference min/max calibration, SURVEY.md §3.2) —
-        quantized layers then run static per-tensor activation scales
-        (``calib_method``: minmax | percentile).  Without it, activations
-        quantize dynamically per row."""
+        quantized layers then run static activation scales
+        (``calib_method``: minmax | percentile; ``calib_granularity``:
+        tensor | channel, per-channel folds activation scales into the
+        int8 weight rows).  Without it, activations quantize dynamically
+        per row."""
         if sample is None:
             raise ValueError("quantize needs a sample input for tracing")
         if precision == "bf16":
@@ -88,7 +91,8 @@ class InferenceOptimizer:
         if calib_data is not None:
             calib = calibrate(model, variables, calib_data,
                               method=calib_method,
-                              percentile=calib_percentile)
+                              percentile=calib_percentile,
+                              granularity=calib_granularity)
         q_model, q_vars = quantize_module(model, variables, calib=calib)
         return TracedModel(_forward_fn(q_model), q_vars, np.asarray(sample),
                            "int8")

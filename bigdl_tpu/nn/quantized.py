@@ -36,7 +36,13 @@ class QuantizedLinear(Module):
     @staticmethod
     def from_linear(layer: L.Linear, params, act_scale=None
                     ) -> Tuple["QuantizedLinear", Dict]:
-        w_q, scales = quantize_int8(params["weight"], axis=0)
+        w = params["weight"]
+        if act_scale is not None and np.ndim(act_scale) == 1:
+            # per-channel activation scales fold into the weight rows (the
+            # output rescale then needs no activation factor — see
+            # ops.quantized.quantized_linear)
+            w = w * jnp.asarray(act_scale, jnp.float32)[:, None]
+        w_q, scales = quantize_int8(w, axis=0)
         q = QuantizedLinear(layer.out_features, layer.with_bias,
                             name=layer.name)
         qp = {"weight_q": w_q, "scales": scales}
@@ -73,6 +79,12 @@ class QuantizedConv2D(Module):
         # unaffected by the row permutation).
         w2 = params["weight"].transpose(2, 0, 1, 3).reshape(
             cin_g * kh * kw, cout)
+        if act_scale is not None and np.ndim(act_scale) == 1:
+            # per-input-CHANNEL scales (cin,) expand to the channel-major
+            # patch-feature layout and fold into the weight rows
+            act_scale = np.repeat(np.asarray(act_scale, np.float32),
+                                  kh * kw)
+            w2 = w2 * jnp.asarray(act_scale)[:, None]
         w_q, scales = quantize_int8(w2, axis=0)
         q = QuantizedConv2D(layer)
         qp = {"weight_q": w_q, "scales": scales}
@@ -166,9 +178,12 @@ class _RecordInput(Module):
         self.cap = max_samples_per_batch
 
     def forward(self, params, state, x, training=False, rng=None):
-        a = np.abs(np.asarray(x, np.float32)).ravel()
-        if a.size > self.cap:  # reservoir-ish: fixed stride subsample
-            a = a[:: max(1, a.size // self.cap)][: self.cap]
+        # keep the channel (last) axis so calibrate() can derive either a
+        # per-tensor scalar or per-input-channel scales from the same record
+        a = np.abs(np.asarray(x, np.float32)).reshape(-1, x.shape[-1])
+        if a.shape[0] * a.shape[1] > self.cap:  # fixed-stride row subsample
+            stride = max(1, (a.shape[0] * a.shape[1]) // self.cap)
+            a = a[::stride][: max(1, self.cap // a.shape[1])]
         self.store.setdefault(id(self.layer), []).append(a)
         return self.layer.forward(params, state, x, training=training,
                                   rng=rng)
@@ -244,26 +259,40 @@ def _quantize_keras(model, params, calib):
 
 def calibrate(module: Module, variables: Dict[str, Any],
               batches: Iterable, method: str = "percentile",
-              percentile: float = 99.9) -> Dict[int, float]:
-    """Run a calibration set through the model and derive a static
-    activation scale per quantizable leaf.
+              percentile: float = 99.9,
+              granularity: str = "tensor") -> Dict[int, Any]:
+    """Run a calibration set through the model and derive static
+    activation scales per quantizable leaf.
 
     ``method``: ``"minmax"`` (abs-max over the set, the reference default)
     or ``"percentile"`` (clip at the given abs-percentile — robust to
-    activation outliers).  Returns ``{id(leaf): scale}`` for
-    :func:`quantize`'s ``calib`` argument."""
+    activation outliers).
+
+    ``granularity``: ``"tensor"`` (one scalar scale per leaf) or
+    ``"channel"`` (one scale per input channel — the scales are folded
+    into the int8 weight rows at :func:`quantize` time, so outlier
+    channels stop dictating the whole tensor's resolution).  Returns
+    ``{id(leaf): scale-or-vector}`` for :func:`quantize`'s ``calib``
+    argument."""
     if method not in ("minmax", "percentile"):
         raise ValueError("method: minmax | percentile")
+    if granularity not in ("tensor", "channel"):
+        raise ValueError("granularity: tensor | channel")
     store: Dict[int, list] = {}
     twin = _recording_twin(module, store)
     params = variables.get("params", EMPTY)
     state = variables.get("state", EMPTY)
     for x in batches:
         twin.forward(params, state, jnp.asarray(x), training=False)
-    out: Dict[int, float] = {}
+    out: Dict[int, Any] = {}
     for key, chunks in store.items():
-        a = np.concatenate(chunks)
-        amax = (float(np.max(a)) if method == "minmax"
-                else float(np.percentile(a, percentile)))
-        out[key] = max(amax, 1e-8) / 127.0
+        a = np.concatenate(chunks)          # (rows, channels)
+        if granularity == "channel":
+            amax = (a.max(axis=0) if method == "minmax"
+                    else np.percentile(a, percentile, axis=0))
+            out[key] = np.maximum(amax, 1e-8).astype(np.float32) / 127.0
+        else:
+            amax = (float(np.max(a)) if method == "minmax"
+                    else float(np.percentile(a, percentile)))
+            out[key] = max(amax, 1e-8) / 127.0
     return out

@@ -88,21 +88,32 @@ def quantized_linear(x, w_q, w_scales, bias=None, act_scale=None,
                      interpret: Optional[bool] = None):
     """Dense layer with a pre-quantized (in, out) int8 weight.
 
-    Activation quantization is either **dynamic** per-row abs-max
-    (``act_scale=None``) or **static** per-tensor with a calibrated scale
-    (the reference's min/max-calibration path, SURVEY.md §3.2 — values
-    beyond ±127·scale saturate).  The matmul runs int8×int8→int32 and the
-    result is rescaled: y = (x_q·w_q) · sx ⊗ sw."""
+    Activation quantization is **dynamic** per-row abs-max
+    (``act_scale=None``), **static per-tensor** with a calibrated scalar
+    scale (the reference's min/max-calibration path, SURVEY.md §3.2 —
+    values beyond ±127·scale saturate), or **static per-channel** with a
+    calibrated (K,) scale vector.  In the per-channel case the caller must
+    have FOLDED the activation scales into the weight before quantizing it
+    (``w'[k,n] = w[k,n]·s[k]``): then ``x_q·w'_q ≈ Σₖ (x/s)·(w·s)/sw`` and
+    the output rescale is the weight scale alone.  The matmul always runs
+    int8×int8→int32."""
     lead = x.shape[:-1]
     k = x.shape[-1]
     x2 = x.reshape(-1, k)
+    per_channel_act = (act_scale is not None
+                      and jnp.ndim(act_scale) == 1)
     if act_scale is None:
         sx = abs_max_scales(x2, axis=1)[:, None]  # (M, 1) dynamic
+    elif per_channel_act:
+        sx = jnp.asarray(act_scale, jnp.float32)[None, :]   # (1, K)
     else:
         sx = jnp.asarray(act_scale, jnp.float32)  # scalar, calibrated
     x_q = jnp.clip(jnp.round(x2 / sx), -127, 127).astype(jnp.int8)
     acc = int8_matmul(x_q, w_q, interpret=interpret)
-    y = acc.astype(jnp.float32) * sx * w_scales[None, :]
+    if per_channel_act:   # act scales already folded into w_q's rows
+        y = acc.astype(jnp.float32) * w_scales[None, :]
+    else:
+        y = acc.astype(jnp.float32) * sx * w_scales[None, :]
     if bias is not None:
         y = y + bias
     return y.reshape(*lead, w_q.shape[1]).astype(x.dtype)
