@@ -1,0 +1,247 @@
+"""Shard-parallel Friesian feature engineering over :class:`XShards`.
+
+Reference analog (unverified — mount empty): ``friesian/feature/table.py``
+runs its feature ops over a Spark DataFrame, so categorical vocabularies,
+count/target statistics, and min/max ranges are computed DISTRIBUTED with a
+global merge.  The pandas-backed :class:`~bigdl_tpu.friesian.table.
+FeatureTable` is the single-host twin; this module is the distributed one:
+every op follows the two-phase shape
+
+    per-shard partial stats  ->  global merge  ->  per-shard apply
+
+where "global" also crosses processes (a pickled-stat allgather over the
+``jax.distributed`` rendezvous) in multi-controller jobs, so each process
+only ever touches its own shards — the Spark-executor posture.
+
+Results are IDENTICAL to running the single-host op on the concatenated
+frame (asserted in ``tests/test_friesian_sharded.py``); the tie-break in
+``gen_string_idx`` is deterministic by (count desc, value str) on both
+paths for exactly this reason.
+"""
+
+import pickle
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from bigdl_tpu.data.shards import XShards
+from bigdl_tpu.friesian.table import FeatureTable, StringIndex
+
+
+def _allgather_objects(obj):
+    """Gather one picklable object from every process (list, rank order).
+    Single-process: ``[obj]``.  Multi-process: pad pickled bytes to the
+    global max and allgather as uint8 (stats are small — vocab counts, not
+    data)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    buf = np.frombuffer(pickle.dumps(obj), np.uint8)
+    n = np.asarray([buf.size], np.int64)
+    sizes = np.asarray(multihost_utils.process_allgather(n)).ravel()
+    padded = np.zeros((int(sizes.max()),), np.uint8)
+    padded[: buf.size] = buf
+    all_bufs = np.asarray(multihost_utils.process_allgather(padded))
+    return [pickle.loads(all_bufs[i, : int(sizes[i])].tobytes())
+            for i in range(len(sizes))]
+
+
+def _merge_counts(dicts: Sequence[Dict]) -> Dict:
+    out: Dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+class ShardedFeatureTable:
+    """Feature ops over an ``XShards`` of pandas DataFrames.
+
+    Construction: ``ShardedFeatureTable(xshards)`` from a sharded read
+    (``bigdl_tpu.data.shards.read_csv(..., sharded=True)``) or
+    ``ShardedFeatureTable.partition(df, n)`` from one in-memory frame.
+    Stat-producing ops (``gen_string_idx`` / ``count_encode`` /
+    ``target_encode`` / ``min_max_scale``) merge partials across ALL
+    shards of ALL processes; row-local ops map shard-by-shard."""
+
+    def __init__(self, shards: XShards):
+        self.shards = shards
+
+    @staticmethod
+    def partition(df, num_shards: Optional[int] = None
+                  ) -> "ShardedFeatureTable":
+        return ShardedFeatureTable(XShards.partition(df, num_shards))
+
+    # -- plumbing -----------------------------------------------------------
+    def _map(self, fn) -> "ShardedFeatureTable":
+        return ShardedFeatureTable(self.shards.transform_shard(fn))
+
+    def _owned_partials(self, fn) -> List:
+        """``fn`` over each owned shard, then allgather across processes
+        (flattened, deterministic rank-then-shard order)."""
+        local = [fn(s) for s in self.shards.owned()]
+        gathered = _allgather_objects(local)
+        return [p for proc in gathered for p in proc]
+
+    def num_partitions(self) -> int:
+        return self.shards.num_partitions()
+
+    def __len__(self):
+        return sum(len(s) for s in self.shards)
+
+    def to_table(self) -> FeatureTable:
+        """Materialize the process-local rows as one FeatureTable."""
+        import pandas as pd
+
+        return FeatureTable(pd.concat(list(self.shards),
+                                      ignore_index=True))
+
+    # -- row-local ops (no global state) ------------------------------------
+    def select(self, *cols: str) -> "ShardedFeatureTable":
+        return self._map(lambda df: df[list(cols)].copy())
+
+    def fillna(self, value, columns: Optional[Sequence[str]] = None
+               ) -> "ShardedFeatureTable":
+        def one(df):
+            df = df.copy()
+            cols = list(columns) if columns else df.columns
+            df[cols] = df[cols].fillna(value)
+            return df
+        return self._map(one)
+
+    def cross_columns(self, cross_cols: Sequence[Sequence[str]],
+                      bucket_sizes: Sequence[int]) -> "ShardedFeatureTable":
+        # hashing is row-local: shard-parallel == single-host by definition
+        return self._map(lambda df: FeatureTable(df).cross_columns(
+            cross_cols, bucket_sizes).df)
+
+    def encode_string(self, columns, indices) -> "ShardedFeatureTable":
+        return self._map(lambda df: FeatureTable(df).encode_string(
+            columns, indices).df)
+
+    # -- stat-producing ops: partial -> merge -> apply -----------------------
+    def gen_string_idx(self, columns: Union[str, Sequence[str]],
+                       freq_limit: int = 0
+                       ) -> Union[StringIndex, List[StringIndex]]:
+        """Distributed category→id maps: per-shard value counts, global
+        sum-merge, same (count desc, value str) order as the single-host
+        twin."""
+        single = isinstance(columns, str)
+        cols = [columns] if single else list(columns)
+
+        partials = self._owned_partials(
+            lambda df: {c: df[c].value_counts().to_dict() for c in cols})
+        out = []
+        for c in cols:
+            counts = _merge_counts([p[c] for p in partials])
+            if freq_limit:
+                counts = {k: v for k, v in counts.items()
+                          if v >= freq_limit}
+            order = sorted(counts.items(),
+                           key=lambda kv: (-kv[1], str(kv[0])))
+            out.append(StringIndex(
+                {v: i + 1 for i, (v, _) in enumerate(order)}, c))
+        return out[0] if single else out
+
+    def category_encode(self, columns, freq_limit: int = 0):
+        idx = self.gen_string_idx(columns, freq_limit)
+        return self.encode_string(columns, idx), idx
+
+    def count_encode(self, columns: Union[str, Sequence[str]],
+                     out_suffix: str = "_count") -> "ShardedFeatureTable":
+        """GLOBAL occurrence counts (a per-shard count would understate
+        every category by the rows living on other shards)."""
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        partials = self._owned_partials(
+            lambda df: {c: df[c].value_counts().to_dict() for c in cols})
+        merged = {c: _merge_counts([p[c] for p in partials]) for c in cols}
+
+        def one(df):
+            df = df.copy()
+            for c in cols:
+                df[c + out_suffix] = df[c].map(merged[c]).astype("int64")
+            return df
+        return self._map(one)
+
+    def target_encode(self, cat_cols: Union[str, Sequence[str]],
+                      target_col: str, smooth: float = 20.0,
+                      out_suffix: str = "_te"
+                      ) -> Tuple["ShardedFeatureTable", Dict[str, Dict]]:
+        """Smoothed mean-target encoding from GLOBAL (sum, count) per
+        category: ``te = (sum + smooth*g_mean) / (count + smooth)`` with
+        the global target mean — identical to the single-host formula."""
+        cols = [cat_cols] if isinstance(cat_cols, str) else list(cat_cols)
+
+        def partial(df):
+            stats = {}
+            for c in cols:
+                grp = df.groupby(c)[target_col].agg(["sum", "count"])
+                stats[c] = {k: (float(r["sum"]), int(r["count"]))
+                            for k, r in grp.iterrows()}
+            return {"stats": stats,
+                    "t_sum": float(df[target_col].sum()),
+                    "t_cnt": int(len(df))}
+
+        partials = self._owned_partials(partial)
+        t_cnt = sum(p["t_cnt"] for p in partials)
+        g_mean = (sum(p["t_sum"] for p in partials) / t_cnt
+                  if t_cnt else 0.0)
+        mappings: Dict[str, Dict] = {}
+        for c in cols:
+            sums: Dict = {}
+            cnts: Dict = {}
+            for p in partials:
+                for k, (s, n) in p["stats"].get(c, {}).items():
+                    sums[k] = sums.get(k, 0.0) + s
+                    cnts[k] = cnts.get(k, 0) + n
+            te = {k: (sums[k] + smooth * g_mean) / (cnts[k] + smooth)
+                  for k in sums}
+            mappings[c] = {"mapping": te, "default": g_mean}
+
+        def apply(df):
+            df = df.copy()
+            for c in cols:
+                df[c + out_suffix] = df[c].map(
+                    mappings[c]["mapping"]).fillna(g_mean)
+            return df
+        return self._map(apply), mappings
+
+    def min_max_scale(self, columns: Union[str, Sequence[str]]
+                      ) -> Tuple["ShardedFeatureTable",
+                                 Dict[str, Tuple[float, float]]]:
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        partials = self._owned_partials(
+            lambda df: {c: (float(df[c].min()), float(df[c].max()))
+                        for c in cols})
+        stats = {c: (min(p[c][0] for p in partials),
+                     max(p[c][1] for p in partials)) for c in cols}
+
+        def one(df):
+            df = df.copy()
+            for c in cols:
+                lo, hi = stats[c]
+                df[c] = (df[c] - lo) / (hi - lo) if hi > lo else 0.0
+            return df
+        return self._map(one), stats
+
+    def add_negative_samples(self, item_size: int, item_col: str = "item",
+                             label_col: str = "label", neg_num: int = 1,
+                             seed: int = 0) -> "ShardedFeatureTable":
+        """Row-local given the GLOBAL ``item_size``; each shard draws from
+        an independent stream (``seed + shard_index``) so two shards never
+        replay the same negatives."""
+        import jax
+
+        # process-local shards are numbered per process; offset by rank so
+        # no two processes replay the same stream either
+        base = (seed + jax.process_index() * 100003
+                if self.shards._process_local else seed)
+        out = [FeatureTable(df).add_negative_samples(
+                   item_size, item_col=item_col, label_col=label_col,
+                   neg_num=neg_num, seed=base + i).df
+               for i, df in enumerate(self.shards)]
+        return ShardedFeatureTable(
+            XShards(out, process_local=self.shards._process_local))

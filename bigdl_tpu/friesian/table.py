@@ -79,7 +79,10 @@ class FeatureTable:
             vc = self.df[c].value_counts()
             if freq_limit:
                 vc = vc[vc >= freq_limit]
-            mapping = {v: i + 1 for i, v in enumerate(vc.index)}
+            # deterministic tie-break by value string: shard-parallel
+            # gen_string_idx (friesian.sharded) must reproduce this order
+            order = sorted(vc.items(), key=lambda kv: (-kv[1], str(kv[0])))
+            mapping = {v: i + 1 for i, (v, _) in enumerate(order)}
             out.append(StringIndex(mapping, c))
         return out[0] if single else out
 

