@@ -1,0 +1,175 @@
+"""Out-of-process serving: engine + HTTP frontend in a SUBPROCESS, driven
+by concurrent clients over real sockets.
+
+Reference analog (unverified — mount empty): ``scala/serving/`` decouples
+the serving engine from clients via Flink/Redis processes; these specs
+prove the TPU-native stack holds up across a process boundary — dynamic
+batching under concurrency, bounded-queue backpressure (blocking, never
+dropping), and recorded p50/p99 latency (VERDICT r3 #9).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from urllib import request as urlreq
+
+import numpy as np
+import pytest
+
+SERVER = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.serving.inference_model import InferenceModel
+    from bigdl_tpu.serving.server import ServingConfig, ServingServer
+    from bigdl_tpu.serving.http_frontend import HttpFrontend
+
+    model = nn.Sequential([nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4)])
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 8), np.float32))
+    im = InferenceModel(model, variables)
+    srv = ServingServer(im, ServingConfig(batch_size=16,
+                                          batch_timeout_s=0.01,
+                                          queue_capacity=64)).start()
+    fe = HttpFrontend(srv, port=0).start()
+    print(f"URL={fe.url}", flush=True)
+    sys.stdin.readline()        # parent closes stdin to stop us
+    fe.stop(); srv.stop()
+    print(f"STATS={srv.stats['batches']},{srv.stats['requests']}",
+          flush=True)
+""")
+
+
+def _post(url, payload, timeout=30.0):
+    req = urlreq.Request(url, data=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+    with urlreq.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+def test_serving_subprocess_concurrent_clients(tmp_path):
+    script = tmp_path / "server.py"
+    script.write_text(SERVER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pythonpath = os.pathsep.join(
+        p for p in [repo_root, os.environ.get("PYTHONPATH")] if p)
+    env = dict(os.environ, PYTHONPATH=pythonpath, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("URL="), line
+        url = line[4:] + "/predict"
+
+        rs = np.random.RandomState(0)
+        n_clients, n_requests = 8, 20
+        latencies = [[] for _ in range(n_clients)]
+        errors = []
+
+        def client(ci):
+            try:
+                for _ in range(n_requests):
+                    x = rs.rand(2, 8).astype(np.float32)
+                    t0 = time.perf_counter()
+                    out = _post(url, {"instances": x.tolist()})
+                    latencies[ci].append(time.perf_counter() - t0)
+                    preds = np.asarray(out["predictions"])
+                    assert preds.shape == (2, 4), preds.shape
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.time() - t0
+        assert not errors, errors
+
+        # health endpoint reports engine stats across the process boundary
+        with urlreq.urlopen(line[4:] + "/health", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        total = n_clients * n_requests
+        assert health["requests"] == total, health
+        # concurrency => dynamic batching actually coalesced requests
+        assert health["batches"] < total, health
+
+        lat = np.sort(np.concatenate(latencies))
+        artifact = {
+            "requests": total,
+            "concurrent_clients": n_clients,
+            "batches": int(health["batches"]),
+            "avg_batch_size": round(total / health["batches"], 2),
+            "wall_s": round(wall, 2),
+            "throughput_rps": round(total / wall, 1),
+            "p50_ms": round(float(lat[int(0.50 * (len(lat) - 1))]) * 1e3, 2),
+            "p99_ms": round(float(lat[int(0.99 * (len(lat) - 1))]) * 1e3, 2),
+        }
+        print("SERVING_LATENCY " + json.dumps(artifact))
+        if os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
+            with open(os.path.join(repo_root, "SERVING_r04.json"), "w") as f:
+                json.dump(artifact, f, indent=1)
+    finally:
+        if proc.poll() is None:
+            proc.stdin.close()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    out_rest = proc.stdout.read()
+    assert "STATS=" in out_rest, out_rest
+
+
+def test_bounded_queue_backpressure():
+    """The request queue is BOUNDED: producers block (never drop) when the
+    engine falls behind, and every request still completes."""
+    from bigdl_tpu.serving.inference_model import InferenceModel
+    from bigdl_tpu.serving.server import ServingConfig, ServingServer
+
+    def slow_predict(x):
+        time.sleep(0.02)
+        return x * 2.0
+
+    im = InferenceModel(predict_fn=slow_predict)
+    srv = ServingServer(im, ServingConfig(batch_size=4,
+                                          batch_timeout_s=0.001,
+                                          queue_capacity=4)).start()
+    try:
+        seen_qsize = []
+        rids = []
+        lock = threading.Lock()
+
+        def producer(k):
+            for i in range(10):
+                rid = srv.enqueue(np.full((1, 3), float(k * 10 + i),
+                                          np.float32))
+                with lock:
+                    rids.append(rid)
+                    seen_qsize.append(srv._in.qsize())
+
+        threads = [threading.Thread(target=producer, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert max(seen_qsize) <= 4, max(seen_qsize)
+        for rid in rids:
+            res = srv.query(rid, timeout=30)
+            assert res.shape == (1, 3)
+        assert srv.stats["requests"] == 40
+    finally:
+        srv.stop()
