@@ -444,8 +444,10 @@ def vmap_sweep(fn: Callable[[Dict[str, Any]], Any], space: Dict[str, Any],
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        axis = mesh.axis_names[0]
-        sharding = NamedSharding(mesh, P(axis))
+        # shard the trial dimension over ALL mesh axes jointly (axis_names
+        # [0] alone is the size-1 outer axis — dcn_data/pipe — which would
+        # leave every trial on device 0)
+        sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
         if n_sampling % mesh.devices.size == 0:
             stacked = {k: jax.device_put(v, sharding)
                        for k, v in stacked.items()}

@@ -433,10 +433,29 @@ def test_vmap_sweep_gang_mode():
         # quadratic bowl in (lr, wd) — pure jax fn of traced numeric leaves
         return (config["lr"] - target) ** 2 + (config["wd"] - 0.01) ** 2
 
-    best_cfg, best_metric, metrics = vmap_sweep(
-        trial, {"lr": hp.uniform(0.0, 1.0), "wd": hp.uniform(0.0, 0.1)},
-        n_sampling=32, mode="min", seed=3, mesh=mesh)
+    # spy on the REAL device_put vmap_sweep issues: the trial sharding
+    # must actually SPREAD over the devices (a size-1 outer axis like
+    # dcn_data/pipe would park every trial on device 0)
+    from jax.sharding import NamedSharding
+
+    seen_shardings = []
+    real_put = jax.device_put
+
+    def spy(x, sharding=None, **kw):
+        if isinstance(sharding, NamedSharding):
+            seen_shardings.append(sharding)
+        return real_put(x, sharding, **kw)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(jax, "device_put", spy):
+        best_cfg, best_metric, metrics = vmap_sweep(
+            trial, {"lr": hp.uniform(0.0, 1.0), "wd": hp.uniform(0.0, 0.1)},
+            n_sampling=32, mode="min", seed=3, mesh=mesh)
     assert metrics.shape == (32,)
+    assert seen_shardings, "vmap_sweep no longer shards its trial batch"
+    probe = real_put(jnp.zeros((32,)), seen_shardings[0])
+    assert len(probe.sharding.device_set) == 8
     # matches evaluating each config individually
     per = [float((c["lr"] - target) ** 2 + (c["wd"] - 0.01) ** 2)
            for c in ([best_cfg])]
