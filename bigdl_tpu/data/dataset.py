@@ -74,6 +74,17 @@ class DataSet:
         return ArrayDataSet(feats, labels)
 
 
+def _per_host_batch(batch_size: int, process_count: int) -> int:
+    """The global-batch contract, in one place: every host feeds
+    ``batch_size / process_count`` rows per step."""
+    process_count = max(process_count, 1)
+    if batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by "
+            f"{process_count} hosts")
+    return batch_size // process_count
+
+
 def batch_index_plan(n: int, batch_size: int, *, shuffle=True, seed=0,
                      epoch=0, drop_last=True, process_id=0, process_count=1):
     """Yield ``(sel, n_real)`` index batches with the framework's sharding
@@ -87,10 +98,7 @@ def batch_index_plan(n: int, batch_size: int, *, shuffle=True, seed=0,
         rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2 ** 31))
         rng.shuffle(idx)
     local = idx[process_id::process_count]
-    if batch_size % process_count != 0:
-        raise ValueError(
-            f"global batch {batch_size} not divisible by {process_count} hosts")
-    per_host = batch_size // process_count
+    per_host = _per_host_batch(batch_size, process_count)
     min_local = n // process_count
     max_local = min_local + (1 if n % process_count else 0)
     n_batches = (min_local // per_host if drop_last
@@ -192,3 +200,50 @@ class SampleToMiniBatch:
         if buf[0].label is not None:
             mb["target"] = np.stack([s.label for s in buf])
         return mb
+
+
+class ProcessLocalDataSet(DataSet):
+    """Wrap a dataset of rows that are ALREADY this process's disjoint
+    share (XShards ``owned_concat`` — the Spark-executor posture), so the
+    driver's ``process_id``/``process_count`` sharding must NOT slice it
+    again.
+
+    Every process must dispatch the SAME number of collective-bearing
+    steps per epoch, so the per-epoch batch count is agreed once from the
+    allgathered local sizes (min over processes, cyclic-padded tails keep
+    short processes in step)."""
+
+    def __init__(self, local: DataSet):
+        self.local = local
+        self._global_min: Optional[int] = None
+
+    def size(self) -> int:
+        # local rows; the GLOBAL dataset is the union over processes
+        return self.local.size()
+
+    def _agreed_size(self) -> int:
+        if self._global_min is None:
+            import jax
+
+            if jax.process_count() == 1:
+                self._global_min = self.local.size()
+            else:
+                from bigdl_tpu.friesian.sharded import _allgather_objects
+
+                self._global_min = min(_allgather_objects(
+                    self.local.size()))
+        return self._global_min
+
+    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                drop_last=True, process_id=0, process_count=1):
+        per_host = _per_host_batch(batch_size, process_count)
+        agreed = self._agreed_size()
+        n_batches = (agreed // per_host if drop_last
+                     else math.ceil(agreed / per_host))
+        it = self.local.batches(per_host, shuffle=shuffle, seed=seed,
+                                epoch=epoch, drop_last=False,
+                                process_id=0, process_count=1)
+        for b, mb in enumerate(it):
+            if b >= n_batches:
+                break
+            yield mb

@@ -3,11 +3,32 @@
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
+import jax
 import numpy as np
 
 from bigdl_tpu.data.dataset import DataSet
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.trigger import Trigger
+
+
+def _as_local_frame(df):
+    """Accept a pandas DataFrame, a FeatureTable, an ``XShards`` of
+    frames, or a ``ShardedFeatureTable`` — reference nnframes sit on Spark
+    DataFrames, so the distributed containers are first-class inputs.
+    Returns (pandas_frame_of_local_rows, was_distributed)."""
+    from bigdl_tpu.data.shards import XShards
+    from bigdl_tpu.friesian.sharded import ShardedFeatureTable
+    from bigdl_tpu.friesian.table import FeatureTable
+
+    if isinstance(df, ShardedFeatureTable):
+        df = df.shards
+    if isinstance(df, XShards):
+        import pandas as pd
+
+        return pd.concat(list(df.owned()), ignore_index=True), True
+    if isinstance(df, FeatureTable):
+        return df.df, False
+    return df, False
 
 
 def _col_matrix(df, cols: Union[str, Sequence[str]]) -> np.ndarray:
@@ -87,9 +108,20 @@ class NNEstimator:
             y = np.asarray(self.label_preprocessing(y))
         return x, y
 
-    def fit(self, df) -> "NNModel":
-        x, y = self._xy(df)
+    def _dataset(self, df):
+        frame, distributed = _as_local_frame(df)
+        x, y = self._xy(frame)
         ds = DataSet.array(x, self._label_cast(y))
+        if distributed and jax.process_count() > 1:
+            # the frame already holds only this process's rows — wrap so
+            # the driver's process sharding doesn't slice it again
+            from bigdl_tpu.data.dataset import ProcessLocalDataSet
+
+            ds = ProcessLocalDataSet(ds)
+        return ds
+
+    def fit(self, df) -> "NNModel":
+        ds = self._dataset(df)
         opt = Optimizer(self.model, ds, self.criterion,
                         batch_size=self._batch_size)
         if self._optim_method is not None:
@@ -98,9 +130,7 @@ class NNEstimator:
                          or Trigger.max_epoch(self._max_epoch))
         if self._validation is not None:
             trig, vdf, methods, vbs = self._validation
-            vx, vy = self._xy(vdf)
-            opt.set_validation(trig, DataSet.array(vx, self._label_cast(vy)),
-                               list(methods))
+            opt.set_validation(trig, self._dataset(vdf), list(methods))
         if self._checkpoint is not None:
             opt.set_checkpoint(*self._checkpoint)
         trained = opt.optimize()
@@ -139,6 +169,9 @@ class NNModel:
                                                batch_size))
 
     def transform(self, df, batch_size: int = 0):
+        sharded = self._maybe_transform_shards(df, batch_size)
+        if sharded is not None:
+            return sharded
         out = df.copy()
         pred = self._raw_predict(df, batch_size)
         pred = pred.reshape(len(pred), -1)
@@ -147,6 +180,20 @@ class NNModel:
         out[self.prediction_col] = (pred[:, 0].astype(np.float32)
                                     if pred.shape[1] == 1 else list(pred))
         return out
+
+    def _maybe_transform_shards(self, df, batch_size):
+        """XShards / ShardedFeatureTable input -> per-shard transform,
+        shard structure preserved (the distributed scoring path)."""
+        from bigdl_tpu.data.shards import XShards
+        from bigdl_tpu.friesian.sharded import ShardedFeatureTable
+
+        if isinstance(df, ShardedFeatureTable):
+            return ShardedFeatureTable(
+                self._maybe_transform_shards(df.shards, batch_size))
+        if isinstance(df, XShards):
+            return df.transform_shard(
+                lambda s: self.transform(s, batch_size))
+        return None
 
 
 class NNClassifier(NNEstimator):
@@ -164,6 +211,9 @@ class NNClassifier(NNEstimator):
 
 class NNClassifierModel(NNModel):
     def transform(self, df, batch_size: int = 0):
+        sharded = self._maybe_transform_shards(df, batch_size)
+        if sharded is not None:
+            return sharded
         out = df.copy()
         logits = self._raw_predict(df, batch_size)
         out[self.prediction_col] = np.argmax(logits, axis=-1).astype(np.int64)

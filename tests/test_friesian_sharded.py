@@ -196,6 +196,28 @@ MP_WORKER = textwrap.dedent("""
                                                    smooth=5.0)
     for k, v in m_single["cat"]["mapping"].items():
         assert abs(m_sh["cat"]["mapping"][k] - v) < 1e-9
+
+    # distributed frame -> training handoff: NNEstimator.fit over the
+    # process-local shards (ProcessLocalDataSet keeps step counts agreed)
+    full["f0"] = rs.rand(120).astype("float32")
+    full["f1"] = rs.rand(120).astype("float32")
+    mine2 = full.iloc[rank * 60:(rank + 1) * 60]
+    from bigdl_tpu.nnframes import NNClassifier
+    from bigdl_tpu.nn.layers import Linear, ReLU
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+
+    est = (NNClassifier(Sequential([Linear(2, 8), ReLU(), Linear(8, 2)]),
+                        CrossEntropyCriterion())
+           .set_max_epoch(3).set_batch_size(20)
+           .set_optim_method(Adam(learning_rate=1e-2)))
+    est.features_col = ["f0", "f1"]
+    est.label_col = "label"
+    model = est.fit(ShardedFeatureTable(XShards([mine2],
+                                                process_local=True)))
+    w = np.asarray(model.trained.variables["params"]["0_Linear"]["weight"])
+    print(f"RANK{rank}_WSUM={float(np.abs(w).sum()):.8f}")
     print(f"RANK{rank}_FRIESIAN_OK")
 """)
 
@@ -237,3 +259,8 @@ def test_two_process_stat_merge(tmp_path):
     assert codes == [0, 0], f"exit {codes}\n{outs[0]}\n{outs[1]}"
     assert all(any("_FRIESIAN_OK" in ln for ln in o.splitlines())
                for o in outs)
+    # the cross-process collectives kept the trained weights in sync even
+    # though each process fed DIFFERENT (disjoint) rows
+    wsums = sorted(ln.split("=")[1] for o in outs for ln in o.splitlines()
+                   if "_WSUM=" in ln)
+    assert len(wsums) == 2 and wsums[0] == wsums[1], wsums
