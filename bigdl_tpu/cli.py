@@ -108,6 +108,15 @@ def main(argv=None) -> int:
     sub.add_parser("watch", help="session-long TPU availability watcher "
                    "(bench_watch.py; logs BENCH_attempts.jsonl)")
 
+    serve = sub.add_parser(
+        "serve", help="multi-worker serving pool: N process-isolated "
+        "engines behind one round-robin proxy (serving/pool.py)")
+    serve.add_argument("loader", help="module:function returning an "
+                       "InferenceModel (imported inside each worker)")
+    serve.add_argument("--workers", type=int, default=2)
+    serve.add_argument("--port", type=int, default=8000)
+    serve.add_argument("--batch-size", type=int, default=32)
+
     pack = sub.add_parser(
         "pack", help="pack arrays into a BTRECv1 record file "
         "(train-from-disk input, data/records.py)")
@@ -128,6 +137,12 @@ def main(argv=None) -> int:
         return subprocess.call([
             sys.executable, "-c",
             "import __graft_entry__ as g; g.dryrun_multichip(8)"], cwd=repo)
+    if args.cmd == "serve":
+        return subprocess.call([
+            sys.executable, "-m", "bigdl_tpu.serving.pool",
+            "--loader", args.loader, "--workers", str(args.workers),
+            "--port", str(args.port), "--batch-size",
+            str(args.batch_size)])
     if args.cmd == "pack":
         return _pack(args)
     if args.cmd == "watch":

@@ -18,7 +18,9 @@ from bigdl_tpu.serving.client import InputQueue, OutputQueue
 from bigdl_tpu.serving.http_frontend import HttpClient, HttpFrontend
 
 from bigdl_tpu.serving.seq2seq import Seq2SeqService
+from bigdl_tpu.serving.pool import ServingPool
 
 __all__ = [
-    "Seq2SeqService","InferenceModel", "ServingServer", "ServingConfig",
-           "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient"]
+    "Seq2SeqService", "InferenceModel", "ServingServer", "ServingConfig",
+    "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient",
+    "ServingPool"]

@@ -1,0 +1,288 @@
+"""Multi-worker serving scale-out — process-isolated engine replicas.
+
+Reference analog (unverified — mount empty): Cluster Serving's Flink job
+(``scala/serving/.../ClusterServing.scala``) bought three things beyond
+the single engine loop: process isolation (a poisoned model copy cannot
+take the frontend down), horizontal scale-out (N task managers), and
+supervision (Flink restarts failed tasks).  The TPU-native equivalent is
+this pool: N worker subprocesses — each running the dynamic-batch
+``ServingServer`` + ``HttpFrontend`` on its own port, each able to own
+its own device — behind one round-robin HTTP proxy that health-checks
+and RESTARTS dead workers.
+
+    pool = ServingPool("my_pkg.my_mod:make_model", workers=2).start()
+    # pool.url -> proxy endpoint: POST /predict, GET /health
+    pool.stop()
+
+``loader`` is a ``module:function`` spec resolving to a zero-arg callable
+returning an :class:`~bigdl_tpu.serving.inference_model.InferenceModel` —
+workers import it in their own interpreter (the model never crosses the
+process boundary, exactly the reference's model-per-task-manager
+posture).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib import request as _urlreq
+
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.serving.pool")
+
+
+def _worker_main(loader: str, batch_size: int, queue_capacity: int) -> None:
+    """Entry point inside a worker subprocess."""
+    import importlib
+
+    import jax
+
+    if os.environ.get("BIGDL_TPU_POOL_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    mod_name, _, fn_name = loader.partition(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+
+    from bigdl_tpu.serving.http_frontend import HttpFrontend
+    from bigdl_tpu.serving.server import ServingConfig, ServingServer
+
+    srv = ServingServer(fn(), ServingConfig(
+        batch_size=batch_size, queue_capacity=queue_capacity)).start()
+    fe = HttpFrontend(srv, port=0).start()
+    print(f"WORKER_URL={fe.url}", flush=True)
+    sys.stdin.readline()           # parent closes stdin to stop us
+    fe.stop()
+    srv.stop()
+
+
+class _Worker:
+    def __init__(self, loader: str, batch_size: int, queue_capacity: int,
+                 env: Optional[dict] = None):
+        self.loader = loader
+        self.batch_size = batch_size
+        self.queue_capacity = queue_capacity
+        self.env = env
+        self.proc: Optional[subprocess.Popen] = None
+        self.url: Optional[str] = None
+
+    def spawn(self, timeout: float = 120.0) -> None:
+        env = dict(os.environ, **(self.env or {}))
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "bigdl_tpu.serving.pool", "--worker",
+             "--loader", self.loader, "--batch-size",
+             str(self.batch_size), "--queue-capacity",
+             str(self.queue_capacity)],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True)
+        # readline blocks with no deadline, so read on a helper thread: a
+        # loader that hangs before printing must not stall spawn() (the
+        # supervisor calls spawn inline — a hung respawn would freeze ALL
+        # supervision)
+        found: List[str] = []
+
+        def read_url():
+            while True:
+                line = self.proc.stdout.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if line.startswith("WORKER_URL="):
+                    found.append(line[len("WORKER_URL="):])
+                    return
+
+        t = threading.Thread(target=read_url, daemon=True)
+        t.start()
+        t.join(timeout)
+        if found:
+            self.url = found[0]
+            return
+        if self.proc.poll() is None:
+            self.proc.kill()
+        raise RuntimeError(
+            f"serving worker failed to start within {timeout}s")
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def stop(self) -> None:
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.close()
+                self.proc.wait(timeout=10)
+            except Exception:
+                self.proc.kill()
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    server_version = "bigdl-tpu-serving-pool/1"
+
+    def log_message(self, fmt, *args):
+        log.debug(fmt, *args)
+
+    def _forward(self, method: str, url: str, body: Optional[bytes]):
+        req = _urlreq.Request(url, data=body, method=method, headers={
+            "Content-Type": "application/json"})
+        with _urlreq.urlopen(req, timeout=self.server.predict_timeout) as r:
+            return r.status, r.read()
+
+    def _reply(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        import urllib.error
+
+        pool: "ServingPool" = self.server.pool
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        # try each worker once, starting at the round-robin cursor: a DEAD
+        # worker (connection-level failure) is skipped instead of failing
+        # the request; the supervisor thread notices the corpse and
+        # respawns it independently
+        last_err = None
+        for url in pool._next_urls():
+            try:
+                code, out = self._forward("POST", url + self.path, body)
+                return self._reply(code, out)
+            except urllib.error.HTTPError as e:
+                # the worker is ALIVE and answered (400 bad payload / 500
+                # model error): relay its verdict, do NOT retry elsewhere
+                return self._reply(e.code, e.read())
+            except Exception as e:  # noqa: BLE001 — worker down mid-request
+                last_err = e
+        self._reply(503, json.dumps(
+            {"error": f"no serving worker available: {last_err}"}).encode())
+
+    def do_GET(self):
+        pool: "ServingPool" = self.server.pool
+        if self.path != "/health":
+            return self._reply(404, b'{"error": "unknown path"}')
+        agg = {"status": "ok", "workers": []}
+        for w in pool.workers:
+            one = {"url": w.url, "alive": w.alive()}
+            if w.alive():
+                try:
+                    _, out = self._forward("GET", w.url + "/health", None)
+                    one.update(json.loads(out))
+                except Exception as e:  # noqa: BLE001
+                    one["error"] = str(e)
+            agg["workers"].append(one)
+        agg["requests"] = sum(int(w.get("requests", 0))
+                              for w in agg["workers"])
+        agg["batches"] = sum(int(w.get("batches", 0))
+                             for w in agg["workers"])
+        self._reply(200, json.dumps(agg).encode())
+
+
+class ServingPool:
+    """N process-isolated serving workers behind one round-robin proxy
+    with liveness supervision (dead workers are respawned)."""
+
+    def __init__(self, loader: str, workers: int = 2, batch_size: int = 32,
+                 queue_capacity: int = 4096, host: str = "127.0.0.1",
+                 port: int = 0, predict_timeout: float = 30.0,
+                 worker_env: Optional[dict] = None,
+                 supervise_interval_s: float = 1.0):
+        self.loader = loader
+        self.n = workers
+        self.batch_size = batch_size
+        self.queue_capacity = queue_capacity
+        self.worker_env = worker_env
+        self.workers: List[_Worker] = []
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._supervise_interval = supervise_interval_s
+        self._httpd = ThreadingHTTPServer((host, port), _ProxyHandler)
+        self._httpd.pool = self  # type: ignore[attr-defined]
+        self._httpd.predict_timeout = predict_timeout  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._threads: List[threading.Thread] = []
+        self.restarts = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- routing ------------------------------------------------------------
+    def _next_urls(self) -> List[str]:
+        with self._rr_lock:
+            self._rr += 1
+            start = self._rr
+        ordered = [self.workers[(start + i) % len(self.workers)]
+                   for i in range(len(self.workers))]
+        return [w.url for w in ordered if w.alive() and w.url]
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServingPool":
+        for _ in range(self.n):
+            w = _Worker(self.loader, self.batch_size, self.queue_capacity,
+                        self.worker_env)
+            w.spawn()
+            self.workers.append(w)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        s = threading.Thread(target=self._supervise, daemon=True)
+        s.start()
+        self._threads = [t, s]
+        log.info("serving pool: %d workers behind %s", self.n, self.url)
+        return self
+
+    def _supervise(self) -> None:
+        """Flink-style task supervision: respawn dead workers."""
+        while not self._stop.is_set():
+            for w in self.workers:
+                if not w.alive() and not self._stop.is_set():
+                    log.warning("serving worker %s died; respawning", w.url)
+                    try:
+                        w.spawn()
+                        self.restarts += 1
+                    except Exception as e:  # noqa: BLE001 — retried next tick
+                        log.error("respawn failed: %s", e)
+            self._stop.wait(self._supervise_interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        for w in self.workers:
+            w.stop()
+
+
+def _main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--loader", required=True)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--queue-capacity", type=int, default=4096)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--port", type=int, default=8000)
+    args = ap.parse_args()
+    if args.worker:
+        _worker_main(args.loader, args.batch_size, args.queue_capacity)
+        return
+    pool = ServingPool(args.loader, workers=args.workers,
+                       batch_size=args.batch_size,
+                       queue_capacity=args.queue_capacity,
+                       port=args.port).start()
+    print(f"POOL_URL={pool.url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pool.stop()
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,6 +1,7 @@
 """Serving tests: dynamic batching, concurrent clients, bucketed predict
 (reference analog: cluster-serving integration tests — SURVEY.md §5)."""
 
+import pytest
 import threading
 
 import numpy as np
@@ -10,6 +11,8 @@ from bigdl_tpu import nn
 from bigdl_tpu.serving import (
     InferenceModel, InputQueue, OutputQueue, ServingConfig, ServingServer,
 )
+
+pytestmark = pytest.mark.slow  # serving integration: excluded from the quick test-fast loop
 
 
 def _model_and_vars():

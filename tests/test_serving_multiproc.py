@@ -173,3 +173,69 @@ def test_bounded_queue_backpressure():
         assert srv.stats["requests"] == 40
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-WORKER scale-out: N process-isolated engines behind one round-robin
+# proxy with supervision (the Flink task-manager posture)
+
+def _pool_loader():
+    """Worker-side model factory (resolved as tests.test_serving_multiproc:
+    _pool_loader in the worker's own interpreter)."""
+    import numpy as np
+    import jax
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.serving.inference_model import InferenceModel
+
+    model = nn.Sequential([nn.Linear(8, 4)])
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 8), np.float32))
+    return InferenceModel(model, variables)
+
+
+@pytest.mark.slow
+def test_serving_pool_scaleout_and_supervision():
+    from bigdl_tpu.serving.pool import ServingPool
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pythonpath = os.pathsep.join(
+        p for p in [repo_root, os.environ.get("PYTHONPATH")] if p)
+    env = {"PYTHONPATH": pythonpath, "BIGDL_TPU_POOL_CPU": "1",
+           "JAX_PLATFORMS": "cpu"}
+    pool = ServingPool("tests.test_serving_multiproc:_pool_loader",
+                       workers=2, batch_size=8, worker_env=env,
+                       supervise_interval_s=0.3)
+    pool.start()
+    try:
+        rs = np.random.RandomState(0)
+
+        def many(n):
+            for _ in range(n):
+                x = rs.rand(2, 8).astype(np.float32)
+                out = _post(pool.url + "/predict", {"instances": x.tolist()})
+                assert np.asarray(out["predictions"]).shape == (2, 4)
+
+        many(12)
+        with urlreq.urlopen(pool.url + "/health", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["requests"] == 12
+        per_worker = [int(w.get("requests", 0)) for w in health["workers"]]
+        # round-robin actually spread load over BOTH workers
+        assert all(p > 0 for p in per_worker), per_worker
+
+        # supervision: kill one worker; requests keep succeeding (the
+        # proxy skips the corpse) and the supervisor respawns it
+        victim = pool.workers[0]
+        victim.proc.kill()
+        victim.proc.wait(timeout=10)
+        many(6)                      # served by the survivor
+        deadline = time.time() + 60
+        while time.time() < deadline and not (victim.alive()
+                                              and pool.restarts >= 1):
+            time.sleep(0.2)
+        assert pool.restarts >= 1
+        assert all(w.alive() for w in pool.workers)
+        many(6)                      # both workers back in rotation
+    finally:
+        pool.stop()
