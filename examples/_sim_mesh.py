@@ -26,3 +26,14 @@ if not _on(os.environ.get("BIGDL_TPU_REAL_CHIPS", "")):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def tiny() -> bool:
+    """CI tiny-size mode: ``BIGDL_TPU_EXAMPLES_TINY=1`` shrinks every
+    example's epochs/steps/data so the whole set runs in minutes (the
+    reference's nightly example runs, SURVEY.md §5, scaled for CI)."""
+    return _on(os.environ.get("BIGDL_TPU_EXAMPLES_TINY", ""))
+
+
+def tiny_int(normal: int, small: int) -> int:
+    return small if tiny() else normal

@@ -39,8 +39,10 @@ def synthetic_sentences(n=1024, seq=64, vocab=1000, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=120)
-    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--steps", type=int,
+                    default=_sim_mesh.tiny_int(120, 6))
+    ap.add_argument("--batch", type=int,
+                    default=_sim_mesh.tiny_int(64, 16))
     args = ap.parse_args()
 
     init_engine()

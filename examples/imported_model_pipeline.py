@@ -34,7 +34,7 @@ def main():
     opt = optim.Optimizer(model, ArrayDataSet(x, y),
                           nn.CrossEntropyCriterion(), batch_size=64)
     opt.set_optim_method(optim.Adam(learning_rate=1e-2))
-    opt.set_end_when(optim.Trigger.max_epoch(3))
+    opt.set_end_when(optim.Trigger.max_epoch(_sim_mesh.tiny_int(3, 1)))
     trained = opt.optimize()
 
     # 1. export the trained model as a frozen TF GraphDef and re-import it

@@ -41,8 +41,10 @@ def synthetic_mnist(n=4096, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=5)
-    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--epochs", type=int,
+                    default=_sim_mesh.tiny_int(5, 1))
+    ap.add_argument("--batch", type=int,
+                    default=_sim_mesh.tiny_int(256, 64))
     args = ap.parse_args()
 
     init_engine()

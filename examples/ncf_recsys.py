@@ -34,7 +34,8 @@ def synthetic_interactions(users=200, items=500, per_user=20, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--steps", type=int,
+                    default=_sim_mesh.tiny_int(800, 30))
     args = ap.parse_args()
 
     init_engine()

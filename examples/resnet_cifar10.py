@@ -34,16 +34,19 @@ def synthetic_cifar(n=4096, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=10)
-    ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--epochs", type=int,
+                    default=_sim_mesh.tiny_int(10, 1))
+    ap.add_argument("--batch", type=int,
+                    default=_sim_mesh.tiny_int(256, 128))
+    ap.add_argument("--depth", type=int,
+                    default=_sim_mesh.tiny_int(20, 8))
     ap.add_argument("--int8", action="store_true",
                     help="after training, int8-quantize (per-channel "
                          "calibration) and check top-1 within 1 pt")
     args = ap.parse_args()
 
     init_engine()
-    x, y = synthetic_cifar()
+    x, y = synthetic_cifar(n=_sim_mesh.tiny_int(4096, 1024))
     n_val = len(x) // 8
     train = ArrayDataSet(x[n_val:], y[n_val:])
     val = ArrayDataSet(x[:n_val], y[:n_val])

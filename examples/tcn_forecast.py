@@ -27,7 +27,8 @@ def synthetic_series(n=2000, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--epochs", type=int,
+                    default=_sim_mesh.tiny_int(5, 1))
     ap.add_argument("--lookback", type=int, default=48)
     ap.add_argument("--horizon", type=int, default=24)
     args = ap.parse_args()

@@ -48,7 +48,8 @@ def main():
     ])
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
-    model.fit(x[:448], labels[:448], batch_size=32, epochs=5,
+    model.fit(x[:448], labels[:448], batch_size=32,
+              epochs=_sim_mesh.tiny_int(5, 1),
               validation_data=(x[448:], labels[448:]))
     pred = model.predict(x[448:])
     acc = (np.argmax(pred, -1) == labels[448:]).mean()

@@ -49,7 +49,7 @@ def main():
 
     est = Estimator.from_keras(model_creator, config={"classes": classes})
     before = est.evaluate((x, y), [Top1Accuracy()])["Top1Accuracy"]
-    est.fit((x, y), epochs=10, batch_size=64)
+    est.fit((x, y), epochs=_sim_mesh.tiny_int(10, 1), batch_size=64)
     after = est.evaluate((x, y), [Top1Accuracy()])["Top1Accuracy"]
     print(f"accuracy {before:.2f} -> {after:.2f} on {jax.device_count()} "
           "devices")

@@ -52,7 +52,8 @@ def main():
         example_input=x[:1])
 
     x_nhwc = x.transpose(0, 2, 3, 1)   # converted model is channels-last
-    est.fit((x_nhwc, y), epochs=10, batch_size=128)
+    est.fit((x_nhwc, y), epochs=_sim_mesh.tiny_int(10, 2),
+            batch_size=128)
     acc = est.evaluate((x_nhwc, y), [Top1Accuracy()])["Top1Accuracy"]
     print(f"top-1 after fine-tune: {acc:.3f}")
 

@@ -25,6 +25,19 @@ jax.config.update("jax_threefry_partitionable", True)
 # TPU-native default (bf16 passes on MXU).
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent compile cache: the suite spends most of its wall time
+# re-compiling the same tiny XLA programs run after run.  Same-machine
+# only (cross-machine AOT artifacts can trip XLA:CPU feature mismatch),
+# so it is NOT shared via CI caches; opt out with BIGDL_TPU_TEST_CACHE=0.
+if os.environ.get("BIGDL_TPU_TEST_CACHE", "1") not in ("0", "false"):
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # older jax without the knobs
+        pass
+
 import pytest  # noqa: E402
 
 

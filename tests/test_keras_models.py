@@ -70,18 +70,21 @@ class TestZooShapes:
         assert y.shape == (2, 10)
         assert st  # BN state updated
 
+    @pytest.mark.slow
     def test_resnet50_tiny_input(self):
         m = models.resnet50(classes=10)
         x = jnp.zeros((1, 64, 64, 3))
         v = m.init(KEY, x)
         assert m(v, x).shape == (1, 10)
 
+    @pytest.mark.slow
     def test_inception_v1(self):
         m = models.inception_v1(classes=10)
         x = jnp.zeros((1, 64, 64, 3))
         v = m.init(KEY, x)
         assert m(v, x).shape == (1, 10)
 
+    @pytest.mark.slow
     def test_vgg_cifar(self):
         m = models.vgg_cifar10()
         x = jnp.zeros((1, 32, 32, 3))
@@ -161,6 +164,7 @@ def test_fit_accepts_epochs_alias():
     assert model.predict(x[:3]).shape == (3, 2)
 
 
+@pytest.mark.slow
 def test_inception_v2_builds_and_forwards():
     import jax
 

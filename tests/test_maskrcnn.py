@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # minutes-scale XLA compiles, shape-only checks
+
 from bigdl_tpu.ops import detection as D
 
 RS = np.random.RandomState(0)

@@ -4,11 +4,14 @@ jax.distributed and run the ZeRO-1 step with cross-process collectives.
 This is the step beyond the in-process 8-device simulation (conftest): the
 reference's ``local-cluster`` Spark mode analog (SURVEY.md §5)."""
 
+import pytest
 import os
 import socket
 import subprocess
 import sys
 import textwrap
+
+pytestmark = pytest.mark.slow  # multi-process/serving integration: excluded from the quick test-fast loop
 
 
 def _free_port() -> int:
