@@ -51,12 +51,14 @@ def _log(entry: dict) -> None:
     print(json.dumps(entry), flush=True)
 
 
-def _run_json(argv, timeout, label, tail_lines=8):
+def _run_json(argv, timeout, label, tail_lines=8, env=None):
     """Run a subprocess whose LAST stdout line is one JSON object.
     Returns (parsed_or_None, error_or_None)."""
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=timeout, cwd=HERE)
+                              timeout=timeout, cwd=HERE,
+                              env=(dict(os.environ, **env) if env
+                                   else None))
     except subprocess.TimeoutExpired:
         return None, f"{label} timed out after {timeout:.0f}s"
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
@@ -80,11 +82,17 @@ def _probe():
     return False, f"backend came up as {info.get('platform')!r}, not tpu"
 
 
-def _run_bench():
-    """Full TPU bench worker. Returns (result_or_None, error_or_None)."""
+def _run_bench(sweep: bool = False):
+    """Full TPU bench worker. Returns (result_or_None, error_or_None).
+
+    ``sweep``: add the 128/256/512 per-chip batch sweep (VERDICT r3 #1) —
+    ~4x the compile/measure work, so it runs as a SEPARATE second pass
+    with its own doubled timeout AFTER the headline snapshot is already
+    on disk (a sweep timeout must never cost the chip-up evidence)."""
     return _run_json(
         [sys.executable, os.path.join(HERE, "bench.py"), "--worker", "tpu"],
-        BENCH_TIMEOUT, "tpu worker")
+        BENCH_TIMEOUT * (2 if sweep else 1), "tpu worker",
+        env={"BENCH_SWEEP": "1"} if sweep else None)
 
 
 def _run_pallas_dryrun():
@@ -119,6 +127,17 @@ def main():
                 captured = True
             _log({"kind": "bench", "ok": result is not None,
                   **({"result": result} if result else {"error": err})})
+            if result is not None:
+                # second pass: batch sweep, merged into the snapshot only
+                # if it survives its own (doubled) timeout
+                sres, serr = _run_bench(sweep=True)
+                if sres is not None and "batch_sweep_img_per_sec_chip" in sres:
+                    result["batch_sweep_img_per_sec_chip"] = (
+                        sres["batch_sweep_img_per_sec_chip"])
+                    with open(SNAPSHOT, "w") as f:
+                        json.dump(result, f, indent=1)
+                _log({"kind": "bench_sweep", "ok": sres is not None,
+                      **({} if sres else {"error": serr})})
             pres, perr = _run_pallas_dryrun()
             if pres is not None:
                 with open(PALLAS_SNAPSHOT, "w") as f:

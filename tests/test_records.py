@@ -283,3 +283,24 @@ def test_stale_sidecar_rejected_numpy_fallback(rec, monkeypatch):
     monkeypatch.setattr(nat, "available", lambda: False)
     with pytest.raises(ValueError, match="does not match record header"):
         RecordDataSet(p)
+
+
+def test_process_local_dataset_batching():
+    """ProcessLocalDataSet: no double process-sharding, agreed batch
+    count, divisibility contract."""
+    from bigdl_tpu.data.dataset import ArrayDataSet, ProcessLocalDataSet
+
+    x = np.arange(40, dtype=np.float32).reshape(40, 1)
+    y = np.arange(40, dtype=np.int32)
+    ds = ProcessLocalDataSet(ArrayDataSet(x, y))
+    assert ds.size() == 40
+    # process_count=2 halves the per-host batch but does NOT slice rows:
+    # this process's local rows all flow through
+    got = np.concatenate([mb["input"] for mb in ds.batches(
+        8, shuffle=False, process_id=0, process_count=2)])
+    np.testing.assert_array_equal(got.ravel(), x.ravel())
+    # agreed count: 40 rows / 4-per-host -> 10 batches
+    n = sum(1 for _ in ds.batches(8, shuffle=False, process_count=2))
+    assert n == 10
+    with pytest.raises(ValueError, match="not divisible"):
+        list(ds.batches(7, process_count=2))
