@@ -104,7 +104,10 @@ def _run_bench(platform: str) -> dict:
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
     n_chips = len(devices)
-    mesh = build_mesh(MeshSpec(data=n_chips), devices=devices)
+    # default spec: data fills all devices, and on a multislice pod the
+    # auto-detected dcn_data axis makes the step's gradient reduction
+    # hierarchical (ICI reduce-scatter, 1/ndev slice over DCN)
+    mesh = build_mesh(MeshSpec(), devices=devices)
 
     if on_tpu:
         # batch 768/chip: knee of the round-1 batch curve (whose absolute

@@ -7,6 +7,9 @@ This package adds the TPU-native axes on the same ``Mesh``:
 - ``ring_attention``: sequence/context parallelism — blockwise attention with
   K/V blocks rotating around the "seq" axis via ``ppermute`` (ICI ring),
   flash-style online-softmax accumulation, exact (not approximate).
+- ``ulysses``: the all-to-all sequence-parallel alternative — two dense
+  ``all_to_all`` collectives re-shard sequence→heads and back around an
+  unmodified full-attention kernel (DeepSpeed-Ulysses recipe).
 - ``tp``: tensor parallelism — column/row-parallel Linear pairs with one
   ``psum`` per pair over the "model" axis (Megatron layout, expressed as
   shard_map-friendly functions + GSPMD sharding rules).
@@ -19,6 +22,8 @@ This package adds the TPU-native axes on the same ``Mesh``:
 """
 
 from bigdl_tpu.parallel.ring_attention import ring_attention
+from bigdl_tpu.parallel.ulysses import (ulysses_attention,
+                                        ulysses_attention_sharded)
 from bigdl_tpu.parallel.tp import (
     column_parallel, row_parallel, tp_linear_pair,
 )
@@ -35,6 +40,8 @@ __all__ = [
     "build_param_specs",
     "tp_spec_for_path",
     "ring_attention",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "column_parallel",
     "row_parallel",
     "tp_linear_pair",

@@ -90,10 +90,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(mesh, q, k, v, axis_name: str = "seq",
-                           causal: bool = False):
-    """Convenience: apply ring attention to GLOBAL (b, h, L, d) arrays by
-    shard_map-ping over the mesh's ``axis_name``."""
+def seq_sharded_call(kernel, mesh, q, k, v, axis_name: str,
+                     causal: bool):
+    """Shared wrapper for sequence-parallel attention kernels: shard GLOBAL
+    (b, h, L, d) arrays over the mesh's ``axis_name`` (sequence dim) and
+    run ``kernel(q, k, v, axis_name=..., causal=...)`` under shard_map.
+    Used by both ring and Ulysses attention."""
     try:
         from jax import shard_map
     except ImportError:  # pragma: no cover
@@ -102,7 +104,15 @@ def ring_attention_sharded(mesh, q, k, v, axis_name: str = "seq",
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
+        partial(kernel, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
+
+
+def ring_attention_sharded(mesh, q, k, v, axis_name: str = "seq",
+                           causal: bool = False):
+    """Convenience: apply ring attention to GLOBAL (b, h, L, d) arrays by
+    shard_map-ping over the mesh's ``axis_name``."""
+    return seq_sharded_call(ring_attention, mesh, q, k, v, axis_name,
+                            causal)

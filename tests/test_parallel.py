@@ -275,3 +275,50 @@ def test_gspmd_remat_matches_plain():
               for i in range(5)]
         losses[remat] = ls
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism — the second long-context
+# strategy: must agree with full attention AND with ring attention.
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(seq_mesh, causal):
+    from bigdl_tpu.parallel import ulysses_attention_sharded
+
+    rs = np.random.RandomState(2)
+    b, h, L, d = 2, 4, 32, 8      # heads divisible by the seq axis (4)
+    q = jnp.asarray(rs.randn(b, h, L, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, L, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, L, d), jnp.float32)
+
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
+    ref = dot_product_attention(q, k, v, mask=mask)
+
+    out = ulysses_attention_sharded(seq_mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    ring = ring_attention_sharded(seq_mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ring),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_grad_finite_and_head_constraint(seq_mesh):
+    from bigdl_tpu.parallel import ulysses_attention_sharded
+
+    rs = np.random.RandomState(3)
+    q = jnp.asarray(rs.randn(1, 4, 16, 4), jnp.float32)
+
+    def loss(q):
+        out = ulysses_attention_sharded(seq_mesh, q, q, q, causal=True)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(q)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+    # heads (3) not divisible by the seq axis (4) -> clear error
+    bad = jnp.asarray(rs.randn(1, 3, 16, 4), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(seq_mesh, bad, bad, bad)
