@@ -5,10 +5,12 @@ Reference analog (unverified — mount empty): ``dllib/nn/Attention.scala``,
 ``BERT.scala`` (Analytics-Zoo lineage): full O(L²) single-device attention.
 
 TPU-native: attention computed in one fused einsum chain (bf16 in, f32
-accumulate), optionally routed through the blockwise-Pallas kernel
-(``bigdl_tpu.ops.attention``) for long sequences, and sequence-parallel ring
-attention (``bigdl_tpu.parallel.ring_attention``) when the mesh's "seq" axis
-is >1 — both capabilities the reference lacks (SURVEY.md §6.7).
+accumulate), optionally routed through the fused Pallas flash kernel
+(``bigdl_tpu.ops.flash_attention``), or — with
+``MultiHeadAttention(seq_parallel="ring"|"ulysses")`` traced inside a
+shard_map carrying the "seq" axis — through sequence-parallel ring or
+all-to-all attention (``bigdl_tpu.parallel``) — capabilities the
+reference lacks (SURVEY.md §6.7).
 """
 
 import math
@@ -54,7 +56,9 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attn_dropout: float = 0.0, causal: bool = False,
-                 weight_init=init_mod.xavier, use_flash=None, name=None):
+                 weight_init=init_mod.xavier, use_flash=None,
+                 seq_parallel: Optional[str] = None,
+                 seq_axis: str = "seq", name=None):
         super().__init__(name)
         assert hidden_size % num_heads == 0
         self.hidden_size = hidden_size
@@ -66,6 +70,16 @@ class MultiHeadAttention(Module):
         # None = auto: the fused Pallas kernel (bigdl_tpu.ops.flash_attention)
         # when on TPU and the mask is none/causal with no attention dropout.
         self.use_flash = use_flash
+        # "ring" | "ulysses": run sequence-parallel attention over the
+        # mesh's ``seq_axis``.  The module must then be traced INSIDE a
+        # shard_map that carries that axis with the sequence dim sharded
+        # over it (the parallel/ composition pattern — see
+        # tests/test_parallel.py); self-attention only, no extra mask or
+        # attention dropout.
+        if seq_parallel not in (None, "ring", "ulysses"):
+            raise ValueError("seq_parallel: None | 'ring' | 'ulysses'")
+        self.seq_parallel = seq_parallel
+        self.seq_axis = seq_axis
 
     def build(self, rng, x, context=None):
         h = self.hidden_size
@@ -103,6 +117,22 @@ class MultiHeadAttention(Module):
         q, k, v = self._split(q), self._split(k), self._split(v)
 
         dropout_active = self.attn_dropout > 0.0 and training
+        if self.seq_parallel is not None:
+            if context is not None or mask is not None or dropout_active:
+                raise ValueError(
+                    "seq_parallel attention supports self-attention with "
+                    "no extra mask and no attention dropout")
+            if self.seq_parallel == "ring":
+                from bigdl_tpu.parallel.ring_attention import ring_attention
+
+                out = ring_attention(q, k, v, axis_name=self.seq_axis,
+                                     causal=self.causal)
+            else:
+                from bigdl_tpu.parallel.ulysses import ulysses_attention
+
+                out = ulysses_attention(q, k, v, axis_name=self.seq_axis,
+                                        causal=self.causal)
+            return self._merge_project(params, x, out)
         flash_ok = mask is None and not dropout_active
         if self.use_flash is None:
             from bigdl_tpu.ops.common import on_tpu
@@ -125,6 +155,9 @@ class MultiHeadAttention(Module):
             out = dot_product_attention(
                 q, k, v, mask=attn_mask, dropout_p=self.attn_dropout, rng=rng,
                 training=training)
+        return self._merge_project(params, x, out)
+
+    def _merge_project(self, params, x, out):
         b, h, t, dh = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
         y = (jnp.matmul(cast_compute(out), cast_compute(params["wo"]),
@@ -168,10 +201,17 @@ class TransformerLayer(Module):
     stability, documented divergence)."""
 
     def __init__(self, hidden_size: int, num_heads: int, ffn_size: int = 0,
-                 dropout: float = 0.1, causal: bool = False, name=None):
+                 dropout: float = 0.1, causal: bool = False,
+                 seq_parallel: Optional[str] = None, name=None):
         super().__init__(name)
-        self.attn = MultiHeadAttention(hidden_size, num_heads,
-                                       attn_dropout=dropout, causal=causal)
+        # seq-parallel kernels don't support attention-weight dropout;
+        # keep the residual/FFN dropout and drop only the attn one so the
+        # long-sequence TRAINING path (the whole point of seq_parallel)
+        # still works
+        self.attn = MultiHeadAttention(
+            hidden_size, num_heads,
+            attn_dropout=0.0 if seq_parallel else dropout,
+            causal=causal, seq_parallel=seq_parallel)
         self.ffn = PositionwiseFFN(hidden_size, ffn_size or 4 * hidden_size,
                                    dropout=dropout)
         self.ln1 = LayerNorm(hidden_size)

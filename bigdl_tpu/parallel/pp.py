@@ -15,9 +15,10 @@ the whole pipeline is ONE SPMD program over the mesh's "pipe" axis:
   transposes — backward pipelining falls out of ``jax.grad`` for free).
 
 Composability: ``spmd_pipeline`` is written to run INSIDE an enclosing
-``shard_map`` so it composes with data/tensor/sequence/expert axes (the
-5-axis flagship step in ``optim/parallel_train_step.py``).  The standalone
-wrapper ``pipeline_apply`` builds its own shard_map for single-axis use.
+``shard_map`` so it composes with the data/tensor/sequence/expert axes
+(all six parallel modes compose on one mesh — see ``__graft_entry__.
+dryrun_multichip``).  The standalone wrapper ``pipeline_apply`` builds
+its own shard_map for single-axis use.
 """
 
 from functools import partial
