@@ -158,6 +158,19 @@ def _run_bench(platform: str) -> dict:
     img_per_sec_hostfed, _ = measure(
         step, rng, x, y, max(steps // 2, 2), device_resident=False)
 
+    if on_tpu and os.environ.get("BENCH_TRACE") == "1":
+        # one profiled window for the step-time breakdown
+        # (docs/performance.md §Breakdown): load the trace in
+        # tensorboard/xprof to read compute vs collective vs infeed
+        # fractions.  Never sinks the bench row.
+        try:
+            trace_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "profile_r04")
+            with jax.profiler.trace(trace_dir):
+                measure(step, rng, x, y, 3)
+        except Exception:
+            pass
+
     # ---- MFU accounting ------------------------------------------------
     flops_per_step = _compiled_flops(
         step, (step.flat_params, step.opt_state, step.model_state,

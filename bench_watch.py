@@ -92,7 +92,9 @@ def _run_bench(sweep: bool = False):
     return _run_json(
         [sys.executable, os.path.join(HERE, "bench.py"), "--worker", "tpu"],
         BENCH_TIMEOUT * (2 if sweep else 1), "tpu worker",
-        env={"BENCH_SWEEP": "1"} if sweep else None)
+        # the sweep pass also records the one profiled window (cheap next
+        # to the sweep; keeps the first headline pass minimal)
+        env={"BENCH_SWEEP": "1", "BENCH_TRACE": "1"} if sweep else None)
 
 
 def _run_pallas_dryrun():

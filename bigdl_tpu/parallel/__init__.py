@@ -28,8 +28,9 @@ from bigdl_tpu.parallel.tp import (
     column_parallel, row_parallel, tp_linear_pair,
 )
 from bigdl_tpu.parallel.pp import (
-    microbatch, pipeline_apply, spmd_pipeline, stack_stage_params,
-    unmicrobatch,
+    microbatch, pipeline_apply, pipeline_apply_circular, spmd_pipeline,
+    spmd_pipeline_circular, stack_stage_params,
+    stack_stage_params_circular, unmicrobatch,
 )
 from bigdl_tpu.parallel.moe import MoE, moe_apply_ep, moe_apply_local
 from bigdl_tpu.parallel.gspmd import (GSPMDTrainStep, build_param_specs,
@@ -47,8 +48,11 @@ __all__ = [
     "tp_linear_pair",
     "microbatch",
     "pipeline_apply",
+    "pipeline_apply_circular",
     "spmd_pipeline",
+    "spmd_pipeline_circular",
     "stack_stage_params",
+    "stack_stage_params_circular",
     "unmicrobatch",
     "MoE",
     "moe_apply_ep",

@@ -97,6 +97,131 @@ def spmd_pipeline(stage_fn: Callable, params, x, *, n_stages: int,
     return jax.lax.psum(out, axis_name)
 
 
+def stack_stage_params_circular(layer_params: Sequence[Any],
+                                n_stages: int):
+    """Stack ``n_stages * k`` per-layer param pytrees in the INTERLEAVED
+    order a circular pipeline shards: device ``s`` must own layers
+    ``{s, s + n, s + 2n, ...}``, and ``P("pipe")`` hands each device a
+    contiguous block of the leading dim — so row ``s*k + v`` holds layer
+    ``v*n + s``."""
+    total = len(layer_params)
+    if total % n_stages != 0:
+        raise ValueError(
+            f"{total} layers not divisible by {n_stages} stages")
+    k = total // n_stages
+    order = [v * n_stages + s for s in range(n_stages) for v in range(k)]
+    return stack_stage_params([layer_params[i] for i in order])
+
+
+def spmd_pipeline_circular(stage_fn: Callable, params, x, *, n_stages: int,
+                           num_microbatches: int, circular_repeats: int,
+                           axis_name: str = AXIS_PIPE):
+    """Circular (interleaved-stage) pipeline forward — k× smaller bubble.
+
+    Each device owns ``k = circular_repeats`` NON-adjacent layers
+    (``s, s+n, s+2n, …``, leading dim ``k`` of its param shard), and
+    activations loop around the ring ``k`` times, so the fill/drain
+    bubble is ``n-1`` ticks of ONE layer each instead of the blocked
+    (GPipe, k consecutive layers per stage) schedule's ``n-1`` ticks of
+    ``k`` layers: total ticks ``M·k + n − 1`` vs ``(M + n − 1)·k``.
+    Microbatches stream in rounds of ``n`` (``num_microbatches`` must be
+    divisible by ``n_stages``), which keeps the schedule collision-free:
+    stage 0 injects a new microbatch exactly when no looped-back
+    activation needs it.
+
+    stage_fn(params_v, mb, mb_index) -> mb: ``params_v`` is the device's
+    layer-``v`` slice (leading dim of size 1 kept, like
+    :func:`spmd_pipeline`).  MUST be called inside shard_map.  Returns
+    (num_microbatches, mb_size, ...) — last layer's outputs, replicated
+    over the pipe axis.
+    """
+    n, k, M = n_stages, circular_repeats, num_microbatches
+    if M % n != 0:
+        raise ValueError(
+            f"circular pipeline needs num_microbatches ({M}) divisible by "
+            f"n_stages ({n})")
+    if k < 1:
+        raise ValueError("circular_repeats must be >= 1")
+    # the local shard must hold exactly k layer rows — a mismatched
+    # circular_repeats would otherwise CLAMP the layer index silently
+    # (dynamic_index_in_dim) and produce wrong numerics
+    for leaf in jax.tree_util.tree_leaves(params):
+        if leaf.shape[0] != k:
+            raise ValueError(
+                f"param shard leading dim {leaf.shape[0]} != "
+                f"circular_repeats {k}: stack n_stages*circular_repeats "
+                "layers with stack_stage_params_circular")
+    stage = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    period = n * k
+    total = (M // n) * period + n - 1
+
+    mb0 = jnp.zeros(x.shape[1:], x.dtype)
+
+    def tick(carry, t):
+        state, out = carry
+        rel = t - stage
+        relm = rel % period          # python-mod: >=0 even for rel<0
+        v = relm // n                # which of this device's k layers
+        # microbatch id: round base + within-round position
+        m = (rel // period) * n + (relm % n)
+        # stage 0 injects a NEW microbatch exactly on its loop-0 ticks
+        inj = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(m, 0, M - 1), 0, keepdims=False)
+        state = jnp.where(jnp.logical_and(stage == 0, v == 0), inj, state)
+        params_v = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, v, 0, keepdims=True),
+            params)
+        y = stage_fn(params_v, state, m)
+        emit = jnp.logical_and(
+            jnp.logical_and(stage == n - 1, v == k - 1),
+            jnp.logical_and(m >= 0, m < M))
+        safe = jnp.clip(m, 0, M - 1)
+        cur = jax.lax.dynamic_index_in_dim(out, safe, 0, keepdims=False)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, jnp.where(emit, y, cur), safe, 0)
+        # ring rotation: the n-1 -> 0 edge carries the loop-back (consumed
+        # by stage 0 on its v>0 ticks, overwritten by injection on v==0)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, out), None
+
+    out0 = jnp.zeros((M,) + x.shape[1:], x.dtype)
+    (_, out), _ = jax.lax.scan(tick, (mb0, out0), jnp.arange(total))
+    out = jnp.where(stage == n - 1, out, jnp.zeros_like(out))
+    return jax.lax.psum(out, axis_name)
+
+
+def pipeline_apply_circular(mesh: Mesh, stage_fn: Callable, stacked_params,
+                            x, num_microbatches: int,
+                            circular_repeats: int,
+                            axis_name: str = AXIS_PIPE):
+    """Standalone circular-pipelined forward (cf. :func:`pipeline_apply`).
+
+    stacked_params: leaves of shape (n_stages * circular_repeats, ...) in
+    the INTERLEAVED row order of :func:`stack_stage_params_circular`.
+    """
+    n_stages = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages * circular_repeats:
+            raise ValueError(
+                f"stacked params leading dim {leaf.shape[0]} != n_stages "
+                f"({n_stages}) * circular_repeats ({circular_repeats})")
+
+    def fn(p, xmb):
+        return spmd_pipeline_circular(
+            stage_fn, p, xmb, n_stages=n_stages,
+            num_microbatches=num_microbatches,
+            circular_repeats=circular_repeats, axis_name=axis_name)
+
+    mapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
+                                         stacked_params), P()),
+        out_specs=P(), check_vma=False)
+    return unmicrobatch(mapped(stacked_params,
+                               microbatch(x, num_microbatches)))
+
+
 def microbatch(x, num_microbatches: int):
     """(B, ...) -> (num_microbatches, B/num_microbatches, ...)."""
     b = x.shape[0]
