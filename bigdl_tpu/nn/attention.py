@@ -25,6 +25,16 @@ from bigdl_tpu.nn.module import EMPTY, Module
 from bigdl_tpu.tensor.policy import cast_compute
 
 
+def _axis_bound(name: str) -> bool:
+    """True when ``name`` is a mapped axis in the current trace (i.e. we
+    are inside a shard_map/pmap that carries it)."""
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except NameError:
+        return False
+
+
 def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
                           training=False):
     """q,k,v: (b, heads, len, dim).  mask: broadcastable to (b, h, lq, lk),
@@ -117,7 +127,10 @@ class MultiHeadAttention(Module):
         q, k, v = self._split(q), self._split(k), self._split(v)
 
         dropout_active = self.attn_dropout > 0.0 and training
-        if self.seq_parallel is not None:
+        if self.seq_parallel is not None and _axis_bound(self.seq_axis):
+            # outside a shard_map carrying the axis (init's shape-inference
+            # forward, single-device inference) the plain path below
+            # computes the identical function on the full sequence
             if context is not None or mask is not None or dropout_active:
                 raise ValueError(
                     "seq_parallel attention supports self-attention with "

@@ -140,6 +140,9 @@ class Optimizer:
         self.accum_steps = 1     # gradient-accumulation microbatches
         self.ema_decay = 0.0     # weight EMA (0 = off); read the result
         #                          via TrainedModel.ema_variables
+        self.seq_parallel = False  # shard dim 1 over the mesh "seq" axis
+        #                            (long-context; model attention must be
+        #                            seq_parallel-aware)
         self.metrics = Metrics()
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
@@ -253,7 +256,8 @@ class Optimizer:
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,
-            accum_steps=self.accum_steps, ema_decay=self.ema_decay)
+            accum_steps=self.accum_steps, ema_decay=self.ema_decay,
+            seq_parallel=self.seq_parallel)
         n_params = step_engine.n_real
         log.info("model has %s parameters; mesh data axis = %d; ZeRO shard = %s",
                  f"{n_params:,}", step_engine.ndev,

@@ -37,7 +37,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_DCN
+from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_DCN, AXIS_SEQ
 
 
 def as_inputs(x):
@@ -96,6 +96,47 @@ def put_sharded(tree, sharding):
     return jax.tree_util.tree_map(put_one, tree)
 
 
+def _iter_modules(module, seen=None):
+    """Best-effort walk of a module tree (containers, attribute children,
+    lists of children)."""
+    from bigdl_tpu.nn.module import Module
+
+    if seen is None:
+        seen = set()
+    if id(module) in seen:
+        return
+    seen.add(id(module))
+    yield module
+    for v in vars(module).values():
+        children = v if isinstance(v, (list, tuple)) else [v]
+        for c in children:
+            if isinstance(c, Module):
+                yield from _iter_modules(c, seen)
+
+
+def _check_seq_parallel_model(model) -> None:
+    """Sequence-sharded inputs feed PLAIN attention block-diagonal windows
+    (silently wrong numerics), so seq_parallel training demands
+    seq-parallel-aware attention layers.  Models with no catalog attention
+    at all (hand-written kernels) only get a warning."""
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    from bigdl_tpu.utils.log import get_logger
+
+    mhas = [m for m in _iter_modules(model)
+            if isinstance(m, MultiHeadAttention)]
+    if mhas and not any(m.seq_parallel for m in mhas):
+        raise ValueError(
+            "seq_parallel=True but none of the model's attention layers "
+            "is sequence-parallel-aware — build them with "
+            "MultiHeadAttention/TransformerLayer(seq_parallel='ring'|"
+            "'ulysses') or plain attention will silently attend only "
+            "within each sequence block")
+    if not mhas:
+        get_logger("bigdl_tpu.optim").warning(
+            "seq_parallel=True with no catalog attention layers found: "
+            "make sure custom attention uses the seq-axis collectives")
+
+
 class ShardedParameterStep:
     """Builds the jitted ZeRO-1 train/eval steps for a model+criterion over a
     mesh.  Owns the flat-parameter layout (the ``AllReduceParameter`` role)."""
@@ -104,7 +145,8 @@ class ShardedParameterStep:
                  init_variables: Dict[str, Any],
                  clip: Optional[GradientClipping] = None,
                  bf16_grads: bool = False, remat: bool = False,
-                 accum_steps: int = 1, ema_decay: float = 0.0):
+                 accum_steps: int = 1, ema_decay: float = 0.0,
+                 seq_parallel: bool = False):
         """``bf16_grads``: reduce-scatter the gradient vector in bfloat16 —
         halves the per-step collective bytes (the FP16CompressedTensor
         analog; worthwhile when the data axis spans DCN, unnecessary over
@@ -123,7 +165,17 @@ class ShardedParameterStep:
 
         ``ema_decay``: keep an exponential moving average of the flat
         params inside the jitted step (``ema = d*ema + (1-d)*params``, the
-        ImageNet/TPU recipe); read it with ``get_variables(ema=True)``."""
+        ImageNet/TPU recipe); read it with ``get_variables(ema=True)``.
+
+        ``seq_parallel``: additionally shard the SEQUENCE dimension (dim 1
+        of every rank>=2 input/target) over the mesh's "seq" axis — the
+        long-context training path.  The model's attention layers must be
+        sequence-parallel-aware (``MultiHeadAttention(seq_parallel="ring"
+        |"ulysses")``); position-wise layers need no change.  Per-block
+        gradients are pmean'd over the seq axis before the ZeRO-1 cycle;
+        losses/targets must be per-token means so block means compose
+        (every block has equal token counts).  The jitted step is built
+        lazily on the first batch (leaf ranks decide which dims shard)."""
         self.model = model
         self.criterion = criterion
         self.optim = optim_method
@@ -143,6 +195,14 @@ class ShardedParameterStep:
         self._dcn_axis = AXIS_DCN if self.dcn > 1 else None
         self._batch_axes = ((AXIS_DCN, AXIS_DATA) if AXIS_DCN in axes
                             else (AXIS_DATA,))
+        self.n_seq = axes.get(AXIS_SEQ, 1)
+        self.seq_parallel = bool(seq_parallel)
+        if self.seq_parallel:
+            if self.n_seq <= 1:
+                raise ValueError(
+                    "seq_parallel needs a mesh seq axis > 1 "
+                    "(init_engine(seq=N))")
+            _check_seq_parallel_model(model)
 
         flat, self.unravel = ravel_pytree(init_variables["params"])
         self.n_real = flat.shape[0]
@@ -182,11 +242,35 @@ class ShardedParameterStep:
         self.opt_template = _z(opt_state)
         self.model_state_template = _z(init_variables.get("state", {}))
 
-        self._train = self._build_train()
+        # seq_parallel specs depend on leaf ranks (which dims shard), so
+        # the jitted step is built lazily on the first batch
+        self._train = None if self.seq_parallel else self._build_train()
         self._eval_cache: Dict[Any, Callable] = {}
 
     # ------------------------------------------------------------------
-    def _build_train(self):
+    def _leaf_spec(self, a) -> P:
+        """Batch sharding spec for one input/target leaf: dim 0 over the
+        data axes, dim 1 over the seq axis when sequence-parallel and the
+        leaf carries a sequence dimension."""
+        if self.seq_parallel and jnp.ndim(a) >= 2:
+            return P(self._batch_axes, AXIS_SEQ)
+        return P(self._batch_axes)
+
+    def _leaf_sharding(self, a) -> NamedSharding:
+        # only two distinct shardings exist; cache them off the hot path
+        if self.seq_parallel and jnp.ndim(a) >= 2:
+            sh = getattr(self, "_batch_seq_sh", None)
+            if sh is None:
+                sh = self._batch_seq_sh = NamedSharding(
+                    self.mesh, P(self._batch_axes, AXIS_SEQ))
+            return sh
+        return self._batch_sh
+
+    def _batch_specs(self, tree):
+        return jax.tree_util.tree_map(self._leaf_spec, tree)
+
+    # ------------------------------------------------------------------
+    def _build_train(self, x_ex=None, y_ex=None):
         model, criterion, optim = self.model, self.criterion, self.optim
         unravel, n_real = self.unravel, self.n_real
         ndev, shard_size = self.ndev, self.shard_size
@@ -198,12 +282,19 @@ class ShardedParameterStep:
 
         dcn_axis, n_replicas = self._dcn_axis, self.ndev * self.dcn
         batch_axes = self._batch_axes
+        seq_par = self.seq_parallel
+        # axes every per-block statistic (loss, model state, layerwise
+        # grads) averages over
+        stat_axes = batch_axes + ((AXIS_SEQ,) if seq_par else ())
 
         def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y):
             params = unravel(flat_p[:n_real])
             replica = jax.lax.axis_index(AXIS_DATA)
             if dcn_axis:
                 replica = replica + ndev * jax.lax.axis_index(dcn_axis)
+            if seq_par:
+                replica = (replica * jax.lax.axis_size(AXIS_SEQ)
+                           + jax.lax.axis_index(AXIS_SEQ))
             dev_rng = jax.random.fold_in(rng, replica)
 
             def grad_of(p, ms, xs_mb, y_mb, rng_mb):
@@ -248,6 +339,12 @@ class ShardedParameterStep:
                     xs_s + (y_s,))
                 flat_g = gsum / accum
                 loss = lsum / accum
+            if seq_par:
+                # per-sequence-block grads average over the seq axis (the
+                # loss is a per-token mean, blocks are equal-sized); params
+                # stay replicated across seq so the ZeRO cycle below only
+                # spans the data axes
+                flat_g = jax.lax.pmean(flat_g, AXIS_SEQ)
             flat_g = jnp.pad(flat_g, (0, flat_p.shape[0] - n_real))
             if bf16_grads:
                 flat_g = flat_g.astype(jnp.bfloat16)
@@ -276,7 +373,7 @@ class ShardedParameterStep:
             else:
                 # layerwise methods (LARS): plain psum allreduce + replicated
                 # update (matches the reference's treatment pre-slice-sharding)
-                if accum > 1:   # re-tree the accumulated flat gradient
+                if accum > 1 or seq_par:  # re-tree the flat gradient
                     grads = unravel(flat_g[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, batch_axes), grads)
@@ -289,9 +386,9 @@ class ShardedParameterStep:
                 nf, _ = ravel_pytree(new_params)
                 new_flat = jnp.pad(nf, (0, flat_p.shape[0] - n_real))
 
-            loss = jax.lax.pmean(loss, batch_axes)
+            loss = jax.lax.pmean(loss, stat_axes)
             new_mstate = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, batch_axes)
+                lambda a: jax.lax.pmean(a, stat_axes)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
                 new_mstate)
             new_ema = (ema_decay * ema + (1.0 - ema_decay) * new_flat
@@ -299,21 +396,28 @@ class ShardedParameterStep:
             return new_flat, new_ema, new_opt, new_mstate, loss
 
         opt_spec = (P(AXIS_DATA) if elementwise else P())
-        batch_spec = P(self._batch_axes)
+        if seq_par:
+            x_spec = self._batch_specs(x_ex)
+            y_spec = self._batch_specs(y_ex)
+        else:
+            x_spec = y_spec = P(self._batch_axes)
         mapped = shard_map(
             step_shard, mesh=self.mesh,
-            in_specs=(P(), P(), opt_spec, P(), P(), P(), batch_spec,
-                      batch_spec),
+            in_specs=(P(), P(), opt_spec, P(), P(), P(), x_spec, y_spec),
             out_specs=(P(), P(), opt_spec, P(), P()),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
     # ------------------------------------------------------------------
-    def _build_eval(self, methods: Tuple):
+    def _build_eval(self, methods: Tuple, x_ex=None, y_ex=None, w_ex=None):
         model, unravel, n_real = self.model, self.unravel, self.n_real
 
-        batch_axes = self._batch_axes
+        # seq_parallel models MUST see seq-sharded inputs in eval too (their
+        # attention layers run seq collectives unconditionally); stats then
+        # sum over the seq axis as well — correct for per-token metrics
+        stat_axes = self._batch_axes + ((AXIS_SEQ,)
+                                        if self.seq_parallel else ())
 
         def eval_shard(flat_p, mstate, x, y, w):
             params = unravel(flat_p[:n_real])
@@ -322,14 +426,19 @@ class ShardedParameterStep:
             stats = []
             for m in methods:
                 s, c = m.batch_stats(out, y, w)
-                stats.append((jax.lax.psum(s, batch_axes),
-                              jax.lax.psum(c, batch_axes)))
+                stats.append((jax.lax.psum(s, stat_axes),
+                              jax.lax.psum(c, stat_axes)))
             return tuple(stats)
 
-        batch_spec = P(batch_axes)
+        if self.seq_parallel:
+            x_spec = self._batch_specs(x_ex)
+            y_spec = self._batch_specs(y_ex)
+            w_spec = self._batch_specs(w_ex)
+        else:
+            x_spec = y_spec = w_spec = P(self._batch_axes)
         mapped = shard_map(
             eval_shard, mesh=self.mesh,
-            in_specs=(P(), P(), batch_spec, batch_spec, batch_spec),
+            in_specs=(P(), P(), x_spec, y_spec, w_spec),
             out_specs=P(), check_vma=False)
         return jax.jit(mapped)
 
@@ -358,13 +467,14 @@ class ShardedParameterStep:
     # ------------------------------------------------------------------
     def shard_batch(self, arr):
         """Host numpy (per-process shard) -> global device array on the data
-        axis.  Accepts a pytree (tuple of arrays for multi-input models)."""
+        axis (and the seq axis for rank>=2 leaves when sequence-parallel).
+        Accepts a pytree (tuple of arrays for multi-input models)."""
         if jax.process_count() == 1:
             return jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, self._batch_sh), arr)
+                lambda a: jax.device_put(a, self._leaf_sharding(a)), arr)
         return jax.tree_util.tree_map(
             lambda a: jax.make_array_from_process_local_data(
-                self._batch_sh, a), arr)
+                self._leaf_sharding(a), a), arr)
 
     def train_step(self, step: int, rng, x, y):
         return self.train_step_device(
@@ -373,6 +483,8 @@ class ShardedParameterStep:
     def train_step_device(self, step: int, rng, x_dev, y_dev):
         """Variant taking already-sharded device arrays (the prefetch path —
         see ``bigdl_tpu.data.prefetch``)."""
+        if self._train is None:  # seq_parallel: specs need leaf ranks
+            self._train = self._build_train(x_dev, y_dev)
         ema_in = self.ema_flat if self.ema_flat is not None \
             else self._ema_dummy
         (self.flat_params, new_ema, self.opt_state, self.model_state,
@@ -389,11 +501,6 @@ class ShardedParameterStep:
         # cache key must be the method *instances* (two Loss() objects with
         # different criteria are different programs); holding them in the
         # cache keeps ids stable
-        key = tuple(id(m) for m in methods)
-        if key not in self._eval_cache:
-            self._eval_cache[key] = (tuple(methods),
-                                     self._build_eval(tuple(methods)))
-        _, fn = self._eval_cache[key]
         totals = None
         for mb in batches:
             x = mb["input"]
@@ -401,6 +508,16 @@ class ShardedParameterStep:
             w = mb.get("weight")
             if w is None:
                 w = np.ones((n_rows,), np.float32)
+            # cache key: method instances AND the spec-relevant batch
+            # structure (the baked in_specs depend on leaf ranks)
+            ranks = tuple(np.ndim(a) for a in
+                          jax.tree_util.tree_leaves((x, mb["target"], w)))
+            key = (tuple(id(m) for m in methods), ranks)
+            if key not in self._eval_cache:
+                # built on the first batch: seq_parallel specs need ranks
+                self._eval_cache[key] = (tuple(methods), self._build_eval(
+                    tuple(methods), x, mb["target"], w))
+            _, fn = self._eval_cache[key]
             stats = fn(self.flat_params, self.model_state,
                        self.shard_batch(x),
                        self.shard_batch(mb["target"]),
@@ -428,16 +545,40 @@ class ShardedParameterStep:
         if fwd is None:
             model, unravel, n_real = self.model, self.unravel, self.n_real
 
-            @jax.jit
-            def fwd(flat_p, mstate, x):
+            def raw(flat_p, mstate, x):
                 params = unravel(flat_p[:n_real])
                 xs = as_inputs(x)
                 out, _ = model.forward(params, mstate, *xs, training=False)
                 return out
 
+            if self.seq_parallel:
+                # seq-parallel attention runs seq collectives, so inference
+                # too must live inside a shard_map carrying the axis; output
+                # leaves must be per-token (batch, seq, ...) — pooled heads
+                # are not representable under sequence sharding
+                out_spec = P(self._batch_axes, AXIS_SEQ)
+                mesh = self.mesh
+                _cache: Dict[Any, Callable] = {}
+
+                def fwd(flat_p, mstate, x):
+                    key = jax.tree_util.tree_structure(x)
+                    if key not in _cache:
+                        _cache[key] = jax.jit(shard_map(
+                            raw, mesh=mesh,
+                            in_specs=(P(), P(), self._batch_specs(x)),
+                            out_specs=out_spec, check_vma=False))
+                    return _cache[key](flat_p, mstate, x)
+            else:
+                fwd = jax.jit(raw)
+
             self._predict_jit = fwd
 
         if jax.process_count() > 1:
+            if self.seq_parallel:
+                raise NotImplementedError(
+                    "multi-host predict with seq_parallel: run evaluate() "
+                    "(mesh-wide) or export the params for single-host "
+                    "inference")
             # multi-host: predict locally per process (params are replicated,
             # so each host can run inference on its own shard of requests
             # without building a non-addressable global output)
