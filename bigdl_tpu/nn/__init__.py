@@ -55,10 +55,10 @@ from bigdl_tpu.nn.rnn import (
 )
 from bigdl_tpu.nn.decode import beam_search, greedy_decode, DecodeResult
 from bigdl_tpu.nn.attention import (
-    MultiHeadAttention, PositionwiseFFN, TransformerLayer,
-    TransformerDecoderLayer, Transformer, Attention, FeedForwardNetwork,
-    dot_product_attention, positional_encoding, transformer_decode,
-    transformer_decode_cached,
+    MultiHeadAttention, PositionwiseFFN, PositionalEncoding,
+    TransformerLayer, TransformerDecoderLayer, Transformer, Attention,
+    FeedForwardNetwork, dot_product_attention, positional_encoding,
+    transformer_decode, transformer_decode_cached,
 )
 from bigdl_tpu.nn.criterion import (
     Criterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,

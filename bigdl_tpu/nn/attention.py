@@ -260,17 +260,40 @@ class TransformerLayer(Module):
         return x + f, EMPTY
 
 
-def positional_encoding(length: int, dim: int) -> jnp.ndarray:
+def positional_encoding(length: int, dim: int,
+                        offset=0) -> jnp.ndarray:
     """Sinusoidal positions — reference ``Transformer.scala`` encoding.
-    Handles odd dims (sin gets ceil(dim/2) columns, cos the rest)."""
+    Handles odd dims (sin gets ceil(dim/2) columns, cos the rest).
+    ``offset`` (traceable) shifts the position range: a sequence-parallel
+    block at global start ``offset`` gets its TRUE positions."""
     n_sin = (dim + 1) // 2
-    pos = jnp.arange(length)[:, None].astype(jnp.float32)
+    pos = (jnp.arange(length) + offset)[:, None].astype(jnp.float32)
     i = jnp.arange(n_sin)[None, :].astype(jnp.float32)
     angle = pos / jnp.power(10000.0, 2 * i / dim)
     pe = jnp.zeros((length, dim))
     pe = pe.at[:, 0::2].set(jnp.sin(angle))
     pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : dim // 2]))
     return pe
+
+
+class PositionalEncoding(Module):
+    """Add sinusoidal positions to (batch, seq, dim) activations.
+
+    Sequence-parallel aware: traced inside a shard_map carrying
+    ``seq_axis``, each block offsets by ``axis_index * block_len`` so
+    positions stay GLOBAL (a plain PE layer would restart every block at
+    position 0 and silently break any position-dependent task)."""
+
+    def __init__(self, seq_axis: str = "seq", name=None):
+        super().__init__(name)
+        self.seq_axis = seq_axis
+
+    def forward(self, params, state, x, training=False, rng=None):
+        c, d = x.shape[1], x.shape[2]
+        offset = (jax.lax.axis_index(self.seq_axis) * c
+                  if _axis_bound(self.seq_axis) else 0)
+        return (x + positional_encoding(c, d, offset)[None]
+                .astype(x.dtype)), EMPTY
 
 
 class TransformerDecoderLayer(Module):

@@ -1,0 +1,73 @@
+"""Long-context LM training with SEQUENCE PARALLELISM — the production
+path for sequences too long for one chip's HBM (a capability the
+reference lacks: its Transformer attention is single-device O(L²)).
+
+The mesh carries a "seq" axis; every (batch, seq, ...) tensor is sharded
+over it, the model's attention runs the ring (or Ulysses all-to-all)
+sequence-parallel kernel, and the standard ZeRO-1 Optimizer drives the
+whole thing — `opt.seq_parallel = True` is the only training-loop change.
+
+    python examples/long_context_lm.py [--strategy ring|ulysses]
+"""
+
+import _sim_mesh  # noqa: F401  (must be first: simulated-mesh default)
+
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.data.dataset import ArrayDataSet
+from bigdl_tpu.nn.attention import TransformerLayer
+from bigdl_tpu.runtime.engine import init_engine
+
+
+def copy_task(rs, n, L, vocab):
+    """Predict token t-1 at position t (needs attention, not pointwise)."""
+    x = rs.randint(4, vocab, (n, L)).astype(np.int32)
+    y = np.concatenate([np.zeros((n, 1), np.int64), x[:, :-1]], 1)
+    return x, y.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--strategy", default="ring",
+                    choices=["ring", "ulysses"])
+    ap.add_argument("--seq-len", type=int,
+                    default=_sim_mesh.tiny_int(256, 64))
+    ap.add_argument("--epochs", type=int,
+                    default=_sim_mesh.tiny_int(40, 30))
+    args = ap.parse_args()
+
+    # 2-way data x 4-way sequence parallelism on the 8-device mesh
+    init_engine(data=2, seq=4)
+    rs = np.random.RandomState(0)
+    vocab, d_model, heads = 32, 32, 4
+    x, y = copy_task(rs, 256, args.seq_len, vocab)
+
+    model = nn.Sequential([
+        nn.LookupTable(vocab, d_model),
+        # shard-aware: each sequence block offsets positions by its
+        # global block start (a plain PE would restart every block at 0)
+        nn.PositionalEncoding(),
+        TransformerLayer(d_model, heads, dropout=0.0, causal=True,
+                         seq_parallel=args.strategy),
+        nn.Linear(d_model, vocab),
+    ])
+    opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                          nn.CrossEntropyCriterion(), batch_size=32)
+    opt.set_optim_method(optim.Adam(learning_rate=3e-3))
+    opt.set_end_when(optim.Trigger.max_epoch(args.epochs))
+    opt.seq_parallel = True
+    trained = opt.optimize()
+
+    logits = trained.predict(x[:16])          # (B, L, vocab), seq-sharded fwd
+    pred = np.argmax(np.asarray(logits), -1)
+    acc = float((pred[:, 1:] == y[:16, 1:]).mean())
+    print(f"{args.strategy} seq-parallel next-token acc: {acc:.3f} "
+          f"(seq_len {args.seq_len} sharded 4-way)")
+    assert acc > 0.9, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -482,3 +482,26 @@ def test_seq_parallel_rejects_plain_attention_model():
     with pytest.raises(ValueError, match="sequence-parallel-aware"):
         ShardedParameterStep(model, MSECriterion(), SGD(0.1), mesh, v,
                              seq_parallel=True)
+
+
+def test_positional_encoding_global_offsets(seq_mesh):
+    """PositionalEncoding under sequence sharding must produce the SAME
+    values as on the unsharded sequence (each block offset by its global
+    start, not restarting at 0)."""
+    from bigdl_tpu.nn.attention import PositionalEncoding
+
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(2, 32, 12), jnp.float32)
+    layer = PositionalEncoding()
+    ref, _ = layer.forward({}, {}, x)
+
+    def block(xb):
+        out, _ = layer.forward({}, {}, xb)
+        return out
+
+    spec = P(None, AXIS_SEQ, None)
+    fn = shard_map(block, mesh=seq_mesh, in_specs=(spec,),
+                   out_specs=spec, check_vma=False)
+    out = fn(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
