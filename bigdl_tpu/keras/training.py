@@ -88,7 +88,8 @@ def resolve_compile(optimizer, loss, metrics: Sequence) -> Dict[str, Any]:
 
 def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
                nb_epoch=10, validation_data=None, checkpoint_path=None,
-               log_every=10, end_trigger=None) -> TrainedModel:
+               log_every=10, end_trigger=None,
+               seq_parallel=False) -> TrainedModel:
     n_inputs = len(getattr(model, "inputs", ()) or ())
     # ONE packing rule for fit/predict/evaluate: Model._pack_inputs
     pack = getattr(model, "_pack_inputs", np.asarray)
@@ -106,6 +107,9 @@ def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
     opt.set_optim_method(compiled["optimizer"])
     opt.set_end_when(end_trigger or Trigger.max_epoch(nb_epoch))
     opt.log_every = log_every
+    # long-context: shard dim 1 over the mesh "seq" axis (the model's
+    # attention must be seq_parallel-aware — see optim.train_step)
+    opt.seq_parallel = bool(seq_parallel)
     if validation_data is not None:
         if isinstance(validation_data, ArrayDataSet):
             vds = validation_data

@@ -199,3 +199,31 @@ def test_evaluate_multiinput_without_labels_raises():
     m.fit([a, b], y, batch_size=8, nb_epoch=1)
     with pytest.raises(ValueError, match="requires"):
         m.evaluate([a, b])
+
+
+def test_keras_fit_seq_parallel():
+    """model.fit(..., seq_parallel=True) trains a long-context model over
+    the (data, seq) mesh through the keras surface."""
+    from bigdl_tpu.keras.engine import Input, Model
+    from bigdl_tpu.nn.attention import TransformerLayer
+    from bigdl_tpu.nn.layers import Linear
+    from bigdl_tpu.runtime.engine import Engine, EngineConfig, init_engine
+    from bigdl_tpu.runtime.mesh import MeshSpec
+
+    Engine.reset()
+    init_engine(EngineConfig(mesh=MeshSpec(data=2, seq=4)))
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 16, 8).astype(np.float32)
+    y = np.roll(x, 1, axis=1).astype(np.float32)
+
+    inp = Input((16, 8))
+    h = TransformerLayer(8, 4, dropout=0.0, causal=True,
+                         seq_parallel="ulysses")(inp)
+    out = Linear(8, 8)(h)
+    model = Model(inp, out)
+    model.compile("adam", "mse")
+    trained = model.fit(x, y, batch_size=16, epochs=3, log_every=100,
+                        seq_parallel=True)
+    pred = trained.predict(x[:16])
+    assert pred.shape == (16, 16, 8)
+    Engine.reset()
