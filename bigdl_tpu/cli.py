@@ -103,6 +103,8 @@ def main(argv=None) -> int:
     run.add_argument("script")
     run.add_argument("script_args", nargs=argparse.REMAINDER)
 
+    sub.add_parser("doctor", help="environment diagnostic: devices, mesh, "
+                   "native lib, rendezvous env (safe to run anywhere)")
     sub.add_parser("bench", help="run the repo benchmark (bench.py)")
     sub.add_parser("dryrun", help="8-virtual-device multichip dry run")
     sub.add_parser("watch", help="session-long TPU availability watcher "
@@ -137,6 +139,8 @@ def main(argv=None) -> int:
         return subprocess.call([
             sys.executable, "-c",
             "import __graft_entry__ as g; g.dryrun_multichip(8)"], cwd=repo)
+    if args.cmd == "doctor":
+        return _doctor()
     if args.cmd == "serve":
         return subprocess.call([
             sys.executable, "-m", "bigdl_tpu.serving.pool",
@@ -149,6 +153,78 @@ def main(argv=None) -> int:
         return subprocess.call([sys.executable,
                                 os.path.join(repo, "bench_watch.py")])
     return 2
+
+
+def _doctor() -> int:
+    """Environment diagnostic — one JSON report: backend/devices (probed
+    in a SUBPROCESS with a timeout, because a broken TPU tunnel HANGS
+    backend init rather than failing), mesh resolution, native lib,
+    rendezvous env.  Exit 0 = healthy enough to train on something."""
+    import json
+
+    report = {"rendezvous_env": {
+        k: os.environ.get(k) for k in
+        ("BIGDL_TPU_COORDINATOR", "BIGDL_TPU_NUM_PROCESSES",
+         "BIGDL_TPU_PROCESS_ID", "BIGDL_TPU_PLATFORM",
+         "BIGDL_TPU_DCN_SLICES", "JAX_PLATFORMS", "XLA_FLAGS")
+        if os.environ.get(k)}}
+
+    probe_src = (
+        "import json, os, jax\n"
+        "p = os.environ.get('BIGDL_TPU_PLATFORM')\n"
+        "_ = p and jax.config.update('jax_platforms', p)\n"
+        "ds = jax.devices()\n"
+        "print(json.dumps({'platform': ds[0].platform,"
+        " 'device_kind': ds[0].device_kind, 'n_devices': len(ds),"
+        " 'slices': len({getattr(d, 'slice_index', 0) for d in ds})}))\n")
+    # same override knob as bench_watch's probe (slow tunnels)
+    timeout = float(os.environ.get("BENCH_WATCH_PROBE_TIMEOUT", "150"))
+    try:
+        proc = subprocess.run([sys.executable, "-c", probe_src],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        backend = None
+        if proc.returncode == 0:
+            # last stdout line should be the JSON report; tolerate extra
+            # library chatter on stdout
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    backend = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if backend is None:
+            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+            backend = {"error": tail[-1] if tail
+                       else f"probe rc={proc.returncode}, no output"}
+        report["backend"] = backend
+    except subprocess.TimeoutExpired:
+        report["backend"] = {
+            "error": f"backend init timed out after {timeout:.0f}s — TPU "
+                     "tunnel down? force CPU with BIGDL_TPU_PLATFORM=cpu"}
+
+    from bigdl_tpu.native import lib as nat
+
+    report["native_lib"] = {"available": nat.available()}
+    backend = report.get("backend", {})
+    if os.environ.get("BIGDL_TPU_NUM_PROCESSES"):
+        # the probe runs without the rendezvous, so process count comes
+        # from the job env, not jax.process_count()
+        report["configured_processes"] = int(
+            os.environ["BIGDL_TPU_NUM_PROCESSES"])
+    if "n_devices" in backend:
+        # resolve the SAME mesh Engine would build (env overrides applied)
+        from bigdl_tpu.runtime.engine import EngineConfig
+
+        try:
+            report["mesh"] = EngineConfig.from_env().mesh.resolve(
+                backend["n_devices"], backend.get("slices", 1))
+        except ValueError as e:
+            report["mesh"] = {"error": str(e)}
+    print(json.dumps(report, indent=1))
+    healthy = ("error" not in backend
+               and "error" not in report.get("mesh", {}))
+    return 0 if healthy else 1
 
 
 def _pack(args) -> int:

@@ -108,3 +108,44 @@ def test_cli_pack_npz_and_csv(tmp_path):
     mb = next(ds.batches(2, shuffle=False, drop_last=False))
     assert mb["input"].shape == (2, 2)
     ds.close()
+
+
+def test_cli_doctor_reports_environment():
+    env = _repo_env()
+    env["BIGDL_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.cli", "doctor"],
+        env=env, capture_output=True, text=True, timeout=200)
+    assert out.returncode == 0, out.stderr
+    import json
+
+    report = json.loads(out.stdout)
+    assert report["backend"]["platform"] == "cpu"
+    assert report["backend"]["n_devices"] == 8
+    assert report["mesh"]["data"] == 8
+    assert "available" in report["native_lib"]
+
+
+def test_cli_doctor_honors_dcn_env_and_fails_on_bad_mesh():
+    import json
+
+    env = _repo_env()
+    env["BIGDL_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["BIGDL_TPU_DCN_SLICES"] = "2"
+    out = subprocess.run([sys.executable, "-m", "bigdl_tpu.cli", "doctor"],
+                         env=env, capture_output=True, text=True,
+                         timeout=200)
+    report = json.loads(out.stdout)
+    assert report["mesh"] == {"dcn_data": 2, "data": 4, "model": 1,
+                              "seq": 1, "expert": 1, "pipe": 1}
+    assert out.returncode == 0
+
+    env["BIGDL_TPU_DCN_SLICES"] = "3"   # 8 devices not divisible by 3
+    out = subprocess.run([sys.executable, "-m", "bigdl_tpu.cli", "doctor"],
+                         env=env, capture_output=True, text=True,
+                         timeout=200)
+    report = json.loads(out.stdout)
+    assert "error" in report["mesh"]
+    assert out.returncode == 1
