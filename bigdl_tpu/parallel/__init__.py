@@ -33,6 +33,7 @@ from bigdl_tpu.parallel.pp import (
     stack_stage_params_circular, unmicrobatch,
 )
 from bigdl_tpu.parallel.moe import MoE, moe_apply_ep, moe_apply_local
+from bigdl_tpu.parallel.pp_train import PipelineTrainStep
 from bigdl_tpu.parallel.gspmd import (GSPMDTrainStep, build_param_specs,
                                       tp_spec_for_path)
 
@@ -57,4 +58,5 @@ __all__ = [
     "MoE",
     "moe_apply_ep",
     "moe_apply_local",
+    "PipelineTrainStep",
 ]

@@ -1,0 +1,138 @@
+"""Pipeline-parallel TRAINING engine — dp x pipe on one mesh.
+
+Completes the training-engine matrix (all beyond the reference, whose
+DistriOptimizer is data-parallel only — SURVEY.md §3.5):
+
+- data (+multislice, +seq):  ``optim.train_step.ShardedParameterStep``
+- data x model (GSPMD):      ``parallel.gspmd.GSPMDTrainStep``
+- data x pipe (this file):   ``PipelineTrainStep``
+
+Design: parameters stay stacked on a leading stage dim and sharded
+``P("pipe")`` — each device OWNS its stages' parameters and optimizer
+state outright (naturally stage-sharded, no gather anywhere).  A step is
+one ``shard_map`` program over (data, pipe): the GPipe (or circular)
+scan runs the forward, ``jax.grad`` differentiates through it (scan +
+ppermute transpose = backward pipelining for free), gradients ``pmean``
+over the data axis only, and the optimizer update runs on each device's
+local stage block.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from bigdl_tpu.parallel.pp import (microbatch, spmd_pipeline,
+                                   spmd_pipeline_circular, unmicrobatch)
+from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_DCN, AXIS_PIPE
+
+
+class PipelineTrainStep:
+    """Train a pipeline of identical-signature stages over (data, pipe).
+
+    ``stage_fn(params_slice, mb, mb_index) -> mb`` applies one stage
+    (leading stage dim of size 1 kept, as in ``spmd_pipeline``).
+    ``stacked_params``: leaves of shape (n_stages * circular_repeats, ...)
+    — interleaved row order (``stack_stage_params_circular``) when
+    ``circular_repeats > 1``, plain ``stack_stage_params`` order otherwise.
+    ``criterion(output, target) -> scalar`` is a per-example mean.
+    """
+
+    def __init__(self, stage_fn: Callable, stacked_params, criterion,
+                 optim_method, mesh: Mesh, num_microbatches: int,
+                 circular_repeats: int = 1):
+        if not optim_method.elementwise:
+            raise ValueError(
+                "PipelineTrainStep needs an elementwise OptimMethod "
+                "(the update runs on each device's local stage block)")
+        self.stage_fn = stage_fn
+        self.criterion = criterion
+        self.optim = optim_method
+        self.mesh = mesh
+        self.M = num_microbatches
+        self.k = circular_repeats
+        self.n_stages = mesh.shape[AXIS_PIPE]
+        self.n_data = mesh.shape[AXIS_DATA]
+
+        axes = dict(mesh.shape)
+        if axes.get(AXIS_DCN, 1) > 1:
+            raise ValueError(
+                "PipelineTrainStep does not span multislice meshes "
+                "(batch shards over the data axis only); keep dcn_data=1 "
+                "or use ShardedParameterStep/GSPMDTrainStep across slices")
+        self._p_spec = jax.tree_util.tree_map(
+            lambda _: P(AXIS_PIPE), stacked_params)
+        p_sh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P(AXIS_PIPE)), stacked_params)
+        # copy=True: device_put may alias the caller's buffer as a shard,
+        # and the jitted step DONATES params (same hazard gspmd guards)
+        self.params = jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(jnp.array(x, copy=True), sh),
+            stacked_params, p_sh)
+        # built from the SHARDED params: zeros_like moments inherit each
+        # parameter's P("pipe") sharding, scalar counters stay replicated
+        self.opt_state = self.optim.init_state(self.params)
+        rows = self.n_stages * self.k
+        self._opt_spec = jax.tree_util.tree_map(
+            lambda s: (P(AXIS_PIPE) if jnp.ndim(s) >= 1
+                       and s.shape[0] == rows else P()),
+            self.opt_state)
+        self._batch_sh = NamedSharding(mesh, P(AXIS_DATA))
+        self._step = self._build()
+
+    def _build(self):
+        stage_fn, criterion, optim = self.stage_fn, self.criterion, self.optim
+        n, k, M = self.n_stages, self.k, self.M
+
+        def shard(params, opt_state, step, x, y):
+            xm = microbatch(x, M)
+
+            def loss_fn(p):
+                if k > 1:
+                    out = spmd_pipeline_circular(
+                        stage_fn, p, xm, n_stages=n, num_microbatches=M,
+                        circular_repeats=k)
+                else:
+                    out = spmd_pipeline(stage_fn, p, xm, n_stages=n,
+                                        num_microbatches=M)
+                return criterion(unmicrobatch(out), y)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            # every pipe rank evaluates the (identical, psum-replicated)
+            # loss, and the psum's transpose SUMS their equal cotangents —
+            # an exact x n_stages amplification; undo it, then mean over
+            # the data axis (the pipe axis needs no reduction: each
+            # device's grads are for the stages only it owns)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, AXIS_DATA) / n, grads)
+            new_params, new_opt = optim.update(step, grads, params,
+                                               opt_state)
+            return new_params, new_opt, jax.lax.pmean(loss, AXIS_DATA)
+
+        mapped = shard_map(
+            shard, mesh=self.mesh,
+            in_specs=(self._p_spec, self._opt_spec, P(), P(AXIS_DATA),
+                      P(AXIS_DATA)),
+            out_specs=(self._p_spec, self._opt_spec, P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def train_step(self, step: int, x, y):
+        x = jax.device_put(jnp.asarray(x), self._batch_sh)
+        y = jax.device_put(jnp.asarray(y), self._batch_sh)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, jnp.asarray(step, jnp.int32),
+            x, y)
+        return loss
+
+    def get_params(self):
+        """Full stacked params on host (stage order as constructed)."""
+        return jax.tree_util.tree_map(np.asarray, jax.device_get(
+            self.params))
