@@ -67,6 +67,19 @@ class PipelineTrainStep:
                 "PipelineTrainStep does not span multislice meshes "
                 "(batch shards over the data axis only); keep dcn_data=1 "
                 "or use ShardedParameterStep/GSPMDTrainStep across slices")
+        # every leaf must stack exactly n_stages*circular_repeats layer rows:
+        # a partial stack still shards evenly whenever it divides n_stages,
+        # and the k=1 stage_fn then indexes row [0] of a 2-row shard —
+        # training only every other layer with no error raised
+        rows = self.n_stages * self.k
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                stacked_params)[0]:
+            if jnp.ndim(leaf) < 1 or leaf.shape[0] != rows:
+                raise ValueError(
+                    f"stacked_params leaf {jax.tree_util.keystr(path)} has "
+                    f"leading dim {getattr(leaf, 'shape', ())[:1]} != "
+                    f"n_stages*circular_repeats ({self.n_stages}*{self.k}="
+                    f"{rows}); stack one row per (stage, repeat)")
         self._p_spec = jax.tree_util.tree_map(
             lambda _: P(AXIS_PIPE), stacked_params)
         p_sh = jax.tree_util.tree_map(
@@ -79,7 +92,6 @@ class PipelineTrainStep:
         # built from the SHARDED params: zeros_like moments inherit each
         # parameter's P("pipe") sharding, scalar counters stay replicated
         self.opt_state = self.optim.init_state(self.params)
-        rows = self.n_stages * self.k
         self._opt_spec = jax.tree_util.tree_map(
             lambda s: (P(AXIS_PIPE) if jnp.ndim(s) >= 1
                        and s.shape[0] == rows else P()),

@@ -1,0 +1,206 @@
+"""Compiled-kernel selfcheck (VERDICT r3 item 2) — produces KERNELS_r04.json.
+
+Runs the three flagship Pallas kernels on the REAL device with Mosaic
+compilation (interpret=False), at realistic shapes, and for each records:
+
+- ``parity``: max |kernel - XLA-native reference| (relative, fp32 accumulate)
+- ``kernel_ms`` / ``naive_ms``: median wall time over repeats (block_until_ready)
+- ``speedup``: naive_ms / kernel_ms
+
+The XLA-native references are the straightforward jnp programs XLA would fuse
+itself — softmax attention, (x-mean)/std layernorm, and a dequantize-matmul —
+so "speedup" is honest: it is kernel vs what a user would write without us.
+
+Matches the reference's native-kernel layer (upstream bigdl-core MKL/oneDNN
+``.so``s, SURVEY.md §3.2): there the proof was "the JNI kernels run in anger";
+here it is "Mosaic accepts the block specs and the numbers match XLA".
+
+Usage:  python kernels_selfcheck.py [out.json]
+Exit 0 iff every kernel compiled AND matched parity.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.ops.flash_attention import flash_attention
+from bigdl_tpu.ops.fused import fused_layernorm
+from bigdl_tpu.ops.quantized import dequantize_int8, int8_matmul, quantize_int8
+
+REPEATS = int(os.environ.get("KERNELS_REPEATS", "20"))
+# KERNELS_SMALL=1: tiny shapes + 2 repeats for CPU/interpret harness checks
+SMALL = os.environ.get("KERNELS_SMALL", "0") == "1"
+
+
+def _median_ms(fn, repeats=REPEATS):
+    fn()  # warm (compile)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def _rel_err(a, b):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    denom = max(1e-6, float(np.max(np.abs(b))))
+    return float(np.max(np.abs(a - b)) / denom)
+
+
+def main(out_path):
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    interpret = None if on_tpu else True
+    rs = np.random.RandomState(0)
+    report = {
+        "device_kind": dev.device_kind,
+        "platform": dev.platform,
+        "mosaic": bool(on_tpu),
+        "interpret": bool(interpret) if interpret is not None else False,
+        "repeats": REPEATS,
+        "kernels": {},
+    }
+
+    def record(name, kernel_fn, naive_fn, tol):
+        rec = {"tol": tol}
+        try:
+            t0 = time.perf_counter()
+            k_out = jax.block_until_ready(kernel_fn())
+            rec["compile_s"] = round(time.perf_counter() - t0, 2)
+            n_out = jax.block_until_ready(naive_fn())
+            rec["parity"] = _rel_err(k_out, n_out)
+            rec["parity_ok"] = rec["parity"] <= tol
+            rec["kernel_ms"] = round(_median_ms(kernel_fn), 3)
+            rec["naive_ms"] = round(_median_ms(naive_fn), 3)
+            rec["speedup"] = round(rec["naive_ms"] / rec["kernel_ms"], 3)
+            rec["ok"] = bool(rec["parity_ok"])
+        except Exception as e:
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {str(e)[:400]}"
+        report["kernels"][name] = rec
+        status = "ok" if rec.get("ok") else "FAIL"
+        print(f"[{status}] {name}: {json.dumps(rec)[:300]}", flush=True)
+
+    # --- flash attention, bf16 realistic shape (batch 4, 8 heads, 2k x 128)
+    B, H, S, D = (1, 2, 256, 64) if SMALL else (4, 8, 2048, 128)
+    q = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+    scale = 1.0 / np.sqrt(D)
+
+    def naive_attn(qq, kk, vv):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qq.astype(jnp.float32),
+                       kk.astype(jnp.float32)) * scale
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32))
+
+    record(
+        "flash_attention_fwd",
+        jax.jit(lambda: flash_attention(q, k, v, causal=True,
+                                        interpret=interpret)),
+        jax.jit(lambda: naive_attn(q, k, v)),
+        tol=2e-2,  # bf16 inputs
+    )
+
+    def flash_loss(args):
+        qq, kk, vv = args
+        return flash_attention(qq, kk, vv, causal=True,
+                               interpret=interpret).astype(jnp.float32).sum()
+
+    def naive_loss(args):
+        qq, kk, vv = args
+        return naive_attn(qq, kk, vv).sum()
+
+    record(
+        "flash_attention_bwd",
+        jax.jit(lambda: jax.grad(flash_loss)((q, k, v))),
+        jax.jit(lambda: jax.grad(naive_loss)((q, k, v))),
+        tol=5e-2,
+    )
+
+    # --- fused layernorm, transformer-activation shape
+    rows, cols = (512, 256) if SMALL else (8192, 1024)
+    x = jnp.asarray(rs.randn(rows, cols), jnp.float32)
+    g = jnp.asarray(rs.randn(cols), jnp.float32)
+    b = jnp.asarray(rs.randn(cols), jnp.float32)
+
+    def naive_ln(xx):
+        mu = xx.mean(-1, keepdims=True)
+        var = ((xx - mu) ** 2).mean(-1, keepdims=True)
+        return (xx - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    record(
+        "fused_layernorm_fwd",
+        jax.jit(lambda: fused_layernorm(x, g, b, interpret=interpret)),
+        jax.jit(lambda: naive_ln(x)),
+        tol=1e-4,
+    )
+    record(
+        "fused_layernorm_bwd",
+        jax.jit(lambda: jax.grad(lambda xx: fused_layernorm(
+            xx, g, b, interpret=interpret).sum())(x)),
+        jax.jit(lambda: jax.grad(lambda xx: naive_ln(xx).sum())(x)),
+        tol=1e-3,
+    )
+
+    # --- int8 matmul on the MXU, GEMM shape; naive = dequantize + fp32 matmul
+    m, kk_, n = (256, 512, 256) if SMALL else (1024, 2048, 1024)
+    a = jnp.asarray(rs.randn(m, kk_), jnp.float32)
+    w = jnp.asarray(rs.randn(kk_, n), jnp.float32)
+    a_q, a_s = quantize_int8(a, 1)
+    w_q, w_s = quantize_int8(w, 0)
+
+    record(
+        "int8_matmul",
+        jax.jit(lambda: int8_matmul(a_q, w_q)
+                if interpret is None else
+                int8_matmul(a_q, w_q, interpret=interpret)),
+        jax.jit(lambda: dequantize_int8(a_q, a_s, 1) @
+                dequantize_int8(w_q, w_s, 0)),
+        # int32 accumulate vs fp32: exact up to scale handling; int8_matmul
+        # returns raw int32 accumulators, so compare after applying scales
+        tol=float("inf"),  # replaced below with a scaled comparison
+    )
+    # proper parity for int8: the kernel's int32 accumulator must be
+    # bit-exact against an int64 numpy matmul of the quantized operands (the
+    # MXU accumulates integers exactly; any deviation is a real kernel bug).
+    # The fp32 dequantized matmul above is only the *timing* baseline — its
+    # own accumulation rounding (~1e-3 over K=2048) is not our error.
+    try:
+        acc = np.asarray(int8_matmul(a_q, w_q, interpret=interpret),
+                         np.int64)
+        exact = np.asarray(a_q, np.int64) @ np.asarray(w_q, np.int64)
+        rec = report["kernels"]["int8_matmul"]
+        rec["parity"] = float(np.max(np.abs(acc - exact)))
+        rec["parity_ok"] = rec["parity"] == 0.0
+        rec["tol"] = 0.0
+        rec["parity_metric"] = "max |int32 acc - int64 numpy acc| (exact)"
+        rec["ok"] = bool(rec.get("ok")) and rec["parity_ok"]
+    except Exception as e:
+        report["kernels"]["int8_matmul"]["ok"] = False
+        report["kernels"]["int8_matmul"]["error"] = str(e)[:400]
+
+    report["all_ok"] = all(k.get("ok") for k in report["kernels"].values())
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"all_ok": report["all_ok"], "out": out_path}))
+    return 0 if report["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "KERNELS_r04.json")
+    sys.exit(main(out))

@@ -349,3 +349,12 @@ def test_pipeline_train_guards(pipe_mesh):
         PipelineTrainStep(_stage_fn, stacked, MSECriterion(),
                           SGD(learning_rate=0.1), msl,
                           num_microbatches=4)
+
+    # 8 stacked layers on a 4-stage mesh with k=1 still shards evenly
+    # (2 rows/device) but would silently train only every other layer —
+    # the constructor must reject the row-count mismatch up front
+    stacked8 = stack_stage_params(_mk_stages(rs, 8, 4))
+    with pytest.raises(ValueError, match="n_stages\\*circular_repeats"):
+        PipelineTrainStep(_stage_fn, stacked8, MSECriterion(),
+                          SGD(learning_rate=0.1), pipe_mesh,
+                          num_microbatches=4)
