@@ -224,13 +224,46 @@ def _run_bench(platform: str) -> dict:
             out["loader"] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     if on_tpu and os.environ.get("BENCH_SWEEP") == "1":
-        sweep = {}
-        for b in (128, 256, 512):
-            s2, r2, x2, y2 = build_step(b)
-            ips, _ = measure(s2, r2, x2, y2, steps)
+        sweep = {str(batch_per_chip): round(img_per_sec_chip, 2)}
+        best = (img_per_sec_chip, batch_per_chip, step_time)
+        # the r04 curve was still rising at 768 — probe above it too; a
+        # batch that OOMs (or hits any compile error) just drops out of
+        # the sweep rather than sinking the row
+        for b in (128, 256, 512, 1024, 1536):
+            try:
+                s2, r2, x2, y2 = build_step(b)
+                ips, st = measure(s2, r2, x2, y2, steps)
+            except Exception as e:
+                sweep[str(b)] = f"failed: {type(e).__name__}"
+                continue
             sweep[str(b)] = round(ips, 2)
-        sweep[str(batch_per_chip)] = round(img_per_sec_chip, 2)
+            if ips > best[0]:
+                best = (ips, b, st)
         out["batch_sweep_img_per_sec_chip"] = sweep
+        if best[1] != batch_per_chip:
+            # promote the best sweep point to the headline (same measure()
+            # protocol, so the numbers are directly comparable)
+            ips, b, st = best
+            out["value"] = round(ips, 2)
+            out["vs_baseline"] = round(ips / BASELINE_IMG_PER_SEC_PER_CHIP, 4)
+            out["batch_per_chip"] = b
+            out["step_time_ms"] = round(st * 1e3, 2)
+            # provenance: hostfed/loader companion fields were measured at
+            # the original batch, and FLOPs/step is a linear rescale of the
+            # original batch's cost analysis, not a fresh compile
+            out["headline_promoted_from_sweep"] = True
+            out["companion_fields_batch"] = batch_per_chip
+            out["flops_source"] = flops_source + "+linear_batch_scale"
+            scale = b * n_chips / x.shape[0]
+            out["flops_per_step"] = flops_per_step * scale
+            achieved = flops_per_step * scale / st / n_chips
+            out["achieved_flops_per_chip"] = round(achieved, 2)
+            if peak:
+                out["mfu"] = round(achieved / peak, 4)
+                if out["mfu"] > 1.0:
+                    # re-apply the sanity gate: the promoted number must
+                    # honor the same impossible-MFU flag as the original
+                    out["suspect"] = True
     return out
 
 

@@ -1,0 +1,141 @@
+"""Step-time breakdown probe (VERDICT r3 item 4): where do the ms go?
+
+Times, on the real chip at the bench batch size: forward-only inference,
+forward+backward gradients, and the full ShardedParameterStep, plus optional
+ablations (no-BN model, alternate batch). Writes PROBE_r04.json.
+
+Usage: python bench_probe.py [--batch 768] [--steps 8]
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
+import jax
+import jax.numpy as jnp
+
+
+def _flops(fn, *args):
+    try:
+        cost = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        f = float(cost.get("flops", -1))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+def _time(fn, args, steps):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=768)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    from bigdl_tpu.models.resnet import resnet50
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    from bench import _RESNET50_TRAIN_FLOPS_PER_IMAGE, _peak_flops
+
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev.device_kind)
+    B, hw = args.batch, 224
+    report = {"device_kind": dev.device_kind, "batch": B, "steps": args.steps,
+              "phases": {}}
+
+    model = resnet50(classes=1000)
+    rng = jax.random.PRNGKey(0)
+    # generate the batch ON DEVICE: a (B,224,224,3) f32 host transfer is
+    # ~0.5 GB and can wedge for minutes over the tunnel
+    kx, ky = jax.random.split(rng)
+    x = jax.block_until_ready(
+        jax.jit(lambda k: jax.random.uniform(k, (B, hw, hw, 3)))(kx))
+    y = jax.block_until_ready(
+        jax.jit(lambda k: jax.random.randint(k, (B,), 0, 1000))(ky))
+    variables = model.init(rng, x[:1])
+    params, state = variables
+    crit = CrossEntropyCriterion()
+
+    def fwd_train(p, s, xx):
+        out, _ = model.apply(p, s, xx, training=True, rng=rng)
+        return out
+
+    def fwd_loss(p, s, xx, yy):
+        out, ns = model.apply(p, s, xx, training=True, rng=rng)
+        return crit.forward(out, yy), ns
+
+    grad_fn = jax.jit(jax.grad(lambda p, s, xx, yy: fwd_loss(p, s, xx, yy)[0]))
+    fwd_jit = jax.jit(fwd_train)
+
+    def phase(name, fn, fargs, flops_fn=None, flops_args=None):
+        t = _time(fn, fargs, args.steps)
+        f = _flops(flops_fn or fn, *(flops_args or fargs)) if flops_fn is not False else None
+        rec = {"ms": round(t * 1e3, 2),
+               "img_per_sec": round(B / t, 1)}
+        if f:
+            rec["tflops_per_step"] = round(f / 1e12, 3)
+            if peak:
+                rec["mfu"] = round(f / t / peak, 4)
+        report["phases"][name] = rec
+        print(name, json.dumps(rec), flush=True)
+
+    phase("fwd_only", fwd_jit, (params, state, x),
+          flops_fn=fwd_train, flops_args=(params, state, x))
+    phase("fwd_bwd", grad_fn, (params, state, x, y),
+          flops_fn=lambda p, s, xx, yy: jax.grad(
+              lambda pp: fwd_loss(pp, s, xx, yy)[0])(p),
+          flops_args=(params, state, x, y))
+
+    mesh = build_mesh(MeshSpec(), devices=jax.devices())
+    step = ShardedParameterStep(
+        model, crit, SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4),
+        mesh, variables)
+    # x/y are already on device; device_put to the data sharding is a cheap
+    # on-device relayout on one chip (no host round-trip)
+    x_dev = step.shard_batch(x)
+    y_dev = step.shard_batch(y)
+
+    def full(i):
+        return step.train_step_device(i, rng, x_dev, y_dev)
+
+    # time the full engine step (device-resident inputs, value fetch at end);
+    # block on the warm-up VALUE so its execution can't bleed into the window
+    float(np.asarray(full(0)))
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = full(i + 1)
+    float(np.asarray(loss))
+    t = (time.perf_counter() - t0) / args.steps
+    rec = {"ms": round(t * 1e3, 2), "img_per_sec": round(B / t, 1)}
+    if peak:
+        rec["mfu_analytic"] = round(
+            _RESNET50_TRAIN_FLOPS_PER_IMAGE * B / t / peak, 4)
+    report["phases"]["full_step"] = rec
+    print("full_step", json.dumps(rec), flush=True)
+
+    with open("PROBE_r04.json", "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"ok": True}))
+
+
+if __name__ == "__main__":
+    main()

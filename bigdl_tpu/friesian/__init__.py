@@ -12,9 +12,10 @@ ready for ``Embedding``-based models on the mesh.
 
 from bigdl_tpu.friesian.table import FeatureTable, StringIndex
 from bigdl_tpu.friesian.serving import (
-    FeatureService, RankingService, RecallService, Recommender,
-    RecsysHTTPServer,
+    FeatureService, IVFRecallService, RankingService, RecallService,
+    Recommender, RecsysHTTPServer,
 )
 
 __all__ = ["FeatureTable", "StringIndex", "FeatureService", "RecallService",
-           "RankingService", "Recommender", "RecsysHTTPServer"]
+           "IVFRecallService", "RankingService", "Recommender",
+           "RecsysHTTPServer"]

@@ -307,8 +307,14 @@ class BatchNorm(Module):
     def forward(self, params, state, x, training=False, rng=None):
         axes = tuple(range(x.ndim - 1))
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # single-pass stats: E[x] and E[x^2] reduce in ONE read of the
+            # activation (XLA fuses sibling reductions); jnp.var's two-pass
+            # mean((x-mean)^2) reads the (often huge, bf16) activation twice.
+            # Accumulate in f32 — E[x^2]-mean^2 cancellation needs it.
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
             m = self.momentum
             new_state = {
                 "running_mean": (1 - m) * state["running_mean"] + m * mean,

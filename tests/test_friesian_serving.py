@@ -5,6 +5,7 @@ import json
 import urllib.request
 
 import numpy as np
+import pytest
 
 from bigdl_tpu.friesian import (
     FeatureService, RankingService, RecallService, Recommender,
@@ -175,3 +176,66 @@ def test_two_tower_feeds_recall_service():
     hit = np.mean([pos[i] in [int(item_id) for item_id, _score in got[i]]
                for i in range(8)])
     assert hit >= 0.75, (got, pos[:8])
+
+
+class TestIVFRecall:
+    def _clustered(self, dim=8, per=40, centers=6, seed=3):
+        from bigdl_tpu.friesian.serving import IVFRecallService
+
+        rng = np.random.RandomState(seed)
+        mu = rng.randn(centers, dim).astype(np.float32) * 3
+        items = np.concatenate(
+            [mu[j] + 0.2 * rng.randn(per, dim).astype(np.float32)
+             for j in range(centers)])
+        ids = [f"i{j}" for j in range(len(items))]
+        svc = IVFRecallService(dim, n_clusters=centers, nprobe=2,
+                               kmeans_iters=8, seed=0)
+        svc.add_items(ids, items)
+        return svc, items, ids, rng
+
+    def test_recall_quality_on_clustered_data(self):
+        svc, items, ids, rng = self._clustered()
+        q = items[rng.choice(len(items), 16, replace=False)] \
+            + 0.05 * rng.randn(16, items.shape[1]).astype(np.float32)
+        got = svc.search(q, k=10)
+        exact = np.argsort(-(q @ items.T), axis=1)[:, :10]
+        hits = sum(len({ids[i] for i in row} & {i for i, _ in g})
+                   for row, g in zip(exact, got))
+        # cluster-local queries with nprobe=2/6 must recall most of top-10
+        assert hits / (16 * 10) >= 0.8, hits / 160
+
+    def test_nprobe_all_is_exact(self):
+        from bigdl_tpu.friesian.serving import IVFRecallService
+
+        svc, items, ids, rng = self._clustered()
+        full = IVFRecallService(items.shape[1], n_clusters=6, nprobe=6,
+                                kmeans_iters=8, seed=0)
+        full.add_items(ids, items)
+        q = rng.randn(4, items.shape[1]).astype(np.float32)
+        got = full.search(q, k=5)
+        exact = np.argsort(-(q @ items.T), axis=1)[:, :5]
+        for row, g in zip(exact, got):
+            assert [ids[i] for i in row] == [i for i, _ in g]
+
+    def test_add_items_invalidates_index(self):
+        svc, items, ids, _ = self._clustered()
+        svc.search(items[:1], k=3)  # builds the index
+        new = items[0:1] * 10.0  # extreme vector dominating MIPS
+        svc.add_items(["new"], new)
+        out = svc.search(new, k=1)[0]
+        assert out[0][0] == "new"
+
+    def test_nprobe_validation(self):
+        from bigdl_tpu.friesian.serving import IVFRecallService
+
+        with pytest.raises(ValueError, match="nprobe"):
+            IVFRecallService(8, n_clusters=4, nprobe=8)
+
+    def test_k_exceeding_candidate_pool_is_clamped(self):
+        svc, items, ids, rng = self._clustered()
+        # per-cluster ~40 items, nprobe=2 -> pool ~80+pad; ask for far more
+        out = svc.search(items[:2], k=10_000)
+        for row in out:
+            assert 0 < len(row) <= 10_000
+            assert all(s != float("-inf") for _, s in row)
+            assert len({i for i, _ in row}) == len(row)  # no phantom dups
