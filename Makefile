@@ -2,7 +2,14 @@
 
 .PHONY: test test-fast bench dryrun examples bench-scaling bench-loader watch
 
+# full suite, parallelized over cores (pytest-xdist): each worker is its
+# own process with its own 8-virtual-device CPU mesh, so distribution
+# tests stay isolated.  ~12.5 min serial on 1 core; -n auto cuts CI
+# (2-core) wall time roughly in half.
 test:
+	python -m pytest tests/ -q -n auto
+
+test-serial:
 	python -m pytest tests/ -q
 
 # the quick pre-commit loop: skips tests marked slow (multi-process
@@ -10,7 +17,7 @@ test:
 # still runs everything.  A persistent same-machine compile cache
 # (tests/conftest.py) makes repeat runs much faster than cold ones.
 test-fast:
-	python -m pytest tests/ -q -x -m "not slow"
+	python -m pytest tests/ -q -x -m "not slow" -n auto
 
 bench:
 	python bench.py
