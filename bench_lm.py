@@ -1,0 +1,147 @@
+"""Secondary headline benchmark: decoder-only transformer LM training
+throughput (tokens/sec/chip) with MFU accounting.
+
+BASELINE.json config 3 is the reference's Seq2Seq/Transformer-on-WMT path;
+this measures the same model family on the flagship training engine
+(`ShardedParameterStep` ZeRO-1) with the causal flash-attention Pallas
+kernel in the layer stack.  Transformers keep the MXU far busier than
+ResNet's small convs, so this is the framework's best-MFU evidence.
+
+Model: GPT-2-small-class decoder-only LM — 12 layers, d=768, 12 heads,
+ffn 3072, vocab 32k, seq 1024, weight-tied output projection
+(`nn/attention.py` Transformer(mode="lm")).
+
+Prints ONE JSON line; run by `chipup_r04.py` on chip-up, snapshot goes to
+`BENCH_LM_r04.json`.  On CPU it runs a tiny smoke so the harness is
+testable without the chip (BENCH_LM_TINY=1 forces it).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+
+import jax
+import jax.numpy as jnp
+
+from bench import _peak_flops
+
+
+def _analytic_flops_per_token(n_layers, d, seq, vocab):
+    """Training FLOPs/token: 3x forward; forward = 2 FLOPs per matmul
+    param-use (QKVO 4d^2 + FFN 8d^2 per layer, + vocab projection) plus
+    the attention score/value matmuls 2*2*seq*d per layer."""
+    per_layer = 2 * (12 * d * d) + 4 * seq * d
+    return 3 * (n_layers * per_layer + 2 * d * vocab)
+
+
+def main():
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    tiny = os.environ.get("BENCH_LM_TINY") == "1" or not on_tpu
+    n_chips = len(devices)
+    mesh = build_mesh(MeshSpec(), devices=devices)
+
+    if tiny:
+        L, D, H, V, S, batches, steps = 2, 64, 4, 512, 128, (2,), 2
+    else:
+        L, D, H, V, S, batches, steps = 12, 768, 12, 32768, 1024, \
+            (4, 8, 16), 10
+
+    model = Transformer(vocab_size=V, hidden_size=D, num_heads=H,
+                        ffn_size=4 * D, num_layers=L, dropout=0.0,
+                        mode="lm")
+    crit = CrossEntropyCriterion()
+    rng = jax.random.PRNGKey(0)
+    n_params = None
+
+    def measure(batch_per_chip):
+        nonlocal n_params
+        B = batch_per_chip * n_chips
+        ids = jax.block_until_ready(jax.jit(
+            lambda k: jax.random.randint(k, (B, S), 0, V))(rng))
+        tgt = jax.block_until_ready(jax.jit(
+            lambda k: jax.random.randint(k, (B, S), 0, V))(
+                jax.random.fold_in(rng, 1)))
+        variables = model.init(rng, jnp.asarray(ids[:1]))
+        if n_params is None:
+            n_params = int(sum(np.prod(l.shape) for l in
+                               jax.tree_util.tree_leaves(
+                                   variables["params"])))
+        step = ShardedParameterStep(model, crit, Adam(learning_rate=1e-4),
+                                    mesh, variables)
+        x_dev = step.shard_batch(ids)
+        y_dev = step.shard_batch(tgt)
+        loss = step.train_step_device(0, rng, x_dev, y_dev)
+        float(np.asarray(loss))  # block on the warm-up VALUE
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = step.train_step_device(i + 1, rng, x_dev, y_dev)
+        final = float(np.asarray(loss))
+        dt = (time.perf_counter() - t0) / steps
+        assert np.isfinite(final), final
+        return B * S / dt / n_chips, dt
+
+    sweep = {}
+    best = (0.0, None, None)
+    for b in batches:
+        try:
+            tps, st = measure(b)
+        except Exception as e:
+            sweep[str(b)] = f"failed: {type(e).__name__}"
+            continue
+        sweep[str(b)] = round(tps, 1)
+        if tps > best[0]:
+            best = (tps, b, st)
+
+    if best[1] is None:
+        print(json.dumps({"metric": "transformer_lm_train_throughput",
+                          "value": None, "unit": "tokens/sec/chip",
+                          "error": "all batch sizes failed",
+                          "sweep": sweep}))
+        return 1
+
+    tps, b, st = best
+    fpt = _analytic_flops_per_token(L, D, S, V)
+    achieved = tps * fpt
+    peak = _peak_flops(devices[0].device_kind) if on_tpu else None
+    mfu = round(achieved / peak, 4) if peak else None
+    out = {
+        "metric": "transformer_lm_train_throughput",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # reference published no transformer numbers
+        "model": f"decoder-only L{L} d{D} h{H} vocab{V}",
+        "n_params": n_params,
+        "seq_len": S,
+        "batch_per_chip": b,
+        "steps": steps,
+        "n_chips": n_chips,
+        "step_time_ms": round(st * 1e3, 2),
+        "device_kind": devices[0].device_kind,
+        "flops_per_token": fpt,
+        "flops_source": "analytic_3x_fwd",
+        "achieved_flops_per_chip": round(achieved, 2),
+        "peak_bf16_flops": peak,
+        "mfu": mfu,
+        "tiny_smoke": tiny,
+        "batch_sweep_tokens_per_sec_chip": sweep,
+    }
+    if mfu is not None and mfu > 1.0:
+        out["suspect"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

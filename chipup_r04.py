@@ -113,6 +113,25 @@ def main():
                 _log({"kind": "bench", "ok": False,
                       "error": (err or out)[-300:]})
 
+            rc, out, err = _run([sys.executable, "bench_lm.py"], 2400)
+            if rc == 0:
+                try:
+                    row = json.loads(out.strip().splitlines()[-1])
+                    row["captured_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                    if not row.get("suspect") and not row.get("tiny_smoke") \
+                            and row.get("value"):
+                        with open(os.path.join(
+                                HERE, "BENCH_LM_r04.json"), "w") as f:
+                            json.dump(row, f, indent=1)
+                    _log({"kind": "bench_lm", "ok": True,
+                          "value": row.get("value"), "mfu": row.get("mfu")})
+                except Exception as e:
+                    _log({"kind": "bench_lm", "ok": False,
+                          "error": str(e)[:200]})
+            else:
+                _log({"kind": "bench_lm", "ok": False,
+                      "error": (err or out)[-300:]})
+
             rc, out, err = _run(
                 [sys.executable, "kernels_selfcheck.py",
                  KERNELS + ".tmp"], 1800)
