@@ -117,9 +117,14 @@ def _run_bench(platform: str) -> dict:
     else:  # CPU smoke so bench.py always emits a line
         batch_per_chip, hw, steps = 4, 64, 3
 
+    # s2d: the MXU-friendly space-to-depth stem, mathematically equivalent
+    # to the 7x7/s2 conv (pack_stem_kernel parity test) — the MLPerf-style
+    # ResNet-on-TPU layout.  BENCH_STEM=conv measures the standard stem.
+    stem = os.environ.get("BENCH_STEM", "s2d" if on_tpu else "conv")
+
     def build_step(batch_per_chip):
         batch = batch_per_chip * n_chips
-        model = resnet50(classes=1000)
+        model = resnet50(classes=1000, stem=stem)
         rng = jax.random.PRNGKey(0)
         x = np.random.RandomState(0).rand(
             batch, hw, hw, 3).astype(np.float32)
@@ -195,6 +200,7 @@ def _run_bench(platform: str) -> dict:
         "baseline_source": "nominal",
         "batch_per_chip": batch_per_chip,
         "image_size": hw,
+        "stem": stem,
         "steps": steps,
         "n_chips": n_chips,
         "device_kind": devices[0].device_kind,

@@ -62,7 +62,9 @@ def main():
     report = {"device_kind": dev.device_kind, "batch": B, "steps": args.steps,
               "phases": {}}
 
-    model = resnet50(classes=1000)
+    stem = os.environ.get("BENCH_STEM", "s2d")
+    report["stem"] = stem
+    model = resnet50(classes=1000, stem=stem)
     rng = jax.random.PRNGKey(0)
     # generate the batch ON DEVICE: a (B,224,224,3) f32 host transfer is
     # ~0.5 GB and can wedge for minutes over the tunnel

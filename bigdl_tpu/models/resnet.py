@@ -9,6 +9,7 @@ reference default)."""
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu import nn
@@ -35,6 +36,77 @@ class _BN(nn.BatchNorm):
         if self.gamma_zero:
             params["weight"] = jnp.zeros_like(params["weight"])
         return params, state
+
+
+class SpaceToDepthStem(Module):
+    """MXU-friendly ImageNet stem: 2x2 space-to-depth, then a 4x4 stride-1
+    conv over 12 channels — mathematically EQUIVALENT to the standard
+    7x7/stride-2 conv over 3 channels (``pack_stem_kernel`` maps a 7x7
+    kernel onto the packed one exactly; asserted in
+    ``tests/test_nn_layers.py``), but far better laid out for the TPU: 3
+    input channels waste 125 of the MXU's 128 lanes, 12 waste 4x fewer,
+    and the stride-1 window tiles cleanly.  The packed kernel's (di==7)
+    positions are extra degrees of freedom when trained from scratch.
+
+    Reference analog: the ImageNet stem of ``models/resnet/ResNet.scala``
+    (⚠ unverified — mount empty), re-laid-out for the systolic array."""
+
+    def __init__(self, out_channels: int = 64, name=None):
+        super().__init__(name)
+        self.out_channels = out_channels
+
+    def build(self, rng, x):
+        if x.shape[1] % 2 or x.shape[2] % 2:
+            raise ValueError(f"H/W must be even for 2x2 space-to-depth, "
+                             f"got {x.shape}")
+        cin = x.shape[-1]
+        # init with the EFFECTIVE receptive field's fan-in (7*7*cin), not
+        # the packed shape's, so variance matches the standard stem
+        fan_in, fan_out = 7 * 7 * cin, 7 * 7 * self.out_channels
+        w = init_mod.msra(rng, (4, 4, 4 * cin, self.out_channels),
+                          fan_in, fan_out)
+        return {"weight": w}, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        from bigdl_tpu.nn.layers import _conv_accum
+        from bigdl_tpu.tensor.policy import cast_compute
+
+        n, h, w, c = x.shape
+        x2 = x.reshape(n, h // 2, 2, w // 2, 2, c) \
+              .transpose(0, 1, 3, 2, 4, 5) \
+              .reshape(n, h // 2, w // 2, 4 * c)
+        xc, wc = cast_compute(x2, params["weight"])
+        y = jax.lax.conv_general_dilated(
+            xc, wc, window_strides=(1, 1),
+            # window offsets -1..+2 in s2d coords == the 7x7/s2 SAME pad
+            padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            **_conv_accum(xc))
+        return y.astype(x.dtype), EMPTY
+
+
+def pack_stem_kernel(k7):
+    """Map a (7, 7, C, out) stride-2 stem kernel onto the (4, 4, 4C, out)
+    space-to-depth kernel such that SpaceToDepthStem(x) ==
+    Conv2D(k=7, s=2, SAME)(x) exactly.  Used by the parity test and for
+    importing pretrained standard-stem weights."""
+    k7 = jnp.asarray(k7)
+    kh, kw, c, cout = k7.shape
+    assert kh == 7 and kw == 7, k7.shape
+    k2 = jnp.zeros((4, 4, 4 * c, cout), k7.dtype)
+    for r in range(4):
+        for p in range(2):
+            di = 2 * r + p
+            if di > 6:
+                continue
+            for s in range(4):
+                for q in range(2):
+                    dj = 2 * s + q
+                    if dj > 6:
+                        continue
+                    ch = (p * 2 + q) * c
+                    k2 = k2.at[r, s, ch:ch + c, :].set(k7[di, dj])
+    return k2
 
 
 class BasicBlock(Module):
@@ -110,10 +182,17 @@ def resnet_cifar(depth: int = 20, classes: int = 10) -> nn.Sequential:
     return nn.Sequential(layers)
 
 
-def resnet50(classes: int = 1000, include_top: bool = True) -> nn.Sequential:
+def resnet50(classes: int = 1000, include_top: bool = True,
+             stem: str = "conv") -> nn.Sequential:
     """ImageNet ResNet-50 — reference TrainImageNet path.  Input NHWC
-    224x224x3."""
-    layers = _conv_bn(3, 64, 7, stride=2)
+    224x224x3.  ``stem="s2d"`` swaps the 7x7/s2 conv for the equivalent
+    MXU-friendly space-to-depth stem (SpaceToDepthStem)."""
+    if stem == "s2d":
+        layers = [SpaceToDepthStem(64), _BN(64), nn.ReLU()]
+    elif stem == "conv":
+        layers = _conv_bn(3, 64, 7, stride=2)
+    else:
+        raise ValueError(f"stem {stem!r}: conv | s2d")
     layers.append(nn.MaxPool2D(3, 2, padding=1))
     cin = 64
     for stage, (width, blocks) in enumerate([(64, 3), (128, 4), (256, 6),

@@ -221,3 +221,60 @@ class TestCriterions:
         tl = torch.nn.functional.binary_cross_entropy_with_logits(
             torch.tensor(np.asarray(x)), torch.tensor(np.asarray(t)))
         assert float(loss) == pytest.approx(float(tl), rel=1e-5)
+
+
+class TestSpaceToDepthStem:
+    def test_exactly_matches_7x7_stride2_conv(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.models.resnet import SpaceToDepthStem, pack_stem_kernel
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(2, 32, 32, 3), jnp.float32)
+        k7 = jnp.asarray(rs.randn(7, 7, 3, 8) * 0.1, jnp.float32)
+
+        conv = nn.Conv2D(3, 8, 7, stride=2, padding="SAME", with_bias=False)
+        ref, _ = conv.forward({"weight": k7}, {}, x)
+
+        stem = SpaceToDepthStem(8)
+        got, _ = stem.forward({"weight": pack_stem_kernel(k7)}, {}, x)
+
+        assert got.shape == ref.shape == (2, 16, 16, 8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_resnet50_s2d_variant_trains(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.models.resnet import resnet50
+
+        model = resnet50(classes=10, stem="s2d")
+        rng = jax.random.PRNGKey(0)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 64, 64, 3),
+                        jnp.float32)
+        variables = model.init(rng, x)
+        params, state = variables["params"], variables.get("state", {})
+
+        def loss_fn(p):
+            out, _ = model.forward(p, state, x, training=True, rng=rng)
+            return -out[:, 0].mean()  # logsoftmax head
+
+        l, g = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(l))
+        gn = sum(float(jnp.sum(jnp.abs(a))) for a in
+                 jax.tree_util.tree_leaves(g))
+        assert gn > 0
+
+    def test_odd_input_rejected(self):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.models.resnet import SpaceToDepthStem
+
+        stem = SpaceToDepthStem(8)
+        with pytest.raises(ValueError, match="even"):
+            stem.build(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 33, 32, 3), jnp.float32))
