@@ -26,6 +26,13 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 import jax
+
+# this image's axon plugin ignores the JAX_PLATFORMS *env var*; honor
+# it here so CPU smokes don't hang on a down TPU tunnel (conftest
+# does the same for tests)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from bench import _peak_flops

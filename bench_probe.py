@@ -18,6 +18,13 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 import jax
+
+# this image's axon plugin ignores the JAX_PLATFORMS *env var*; honor
+# it here so CPU smokes don't hang on a down TPU tunnel (conftest
+# does the same for tests)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 
@@ -74,15 +81,15 @@ def main():
     y = jax.block_until_ready(
         jax.jit(lambda k: jax.random.randint(k, (B,), 0, 1000))(ky))
     variables = model.init(rng, x[:1])
-    params, state = variables
+    params, state = variables["params"], variables.get("state", {})
     crit = CrossEntropyCriterion()
 
     def fwd_train(p, s, xx):
-        out, _ = model.apply(p, s, xx, training=True, rng=rng)
+        out, _ = model.forward(p, s, xx, training=True, rng=rng)
         return out
 
     def fwd_loss(p, s, xx, yy):
-        out, ns = model.apply(p, s, xx, training=True, rng=rng)
+        out, ns = model.forward(p, s, xx, training=True, rng=rng)
         return crit.forward(out, yy), ns
 
     grad_fn = jax.jit(jax.grad(lambda p, s, xx, yy: fwd_loss(p, s, xx, yy)[0]))

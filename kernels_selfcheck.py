@@ -30,6 +30,13 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 import jax
+
+# this image's axon plugin ignores the JAX_PLATFORMS *env var*; honor
+# it here so CPU smokes don't hang on a down TPU tunnel (conftest
+# does the same for tests)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from bigdl_tpu.ops.flash_attention import flash_attention
@@ -104,7 +111,12 @@ def main(out_path):
             rec["kernel_ms"] = round(_median_ms(kernel_fn), 3)
             rec["naive_ms"] = round(_median_ms(naive_fn), 3)
             rec["speedup"] = round(rec["naive_ms"] / rec["kernel_ms"], 3)
-            if kernel_chain is not None and naive_chain is not None:
+            # chains only on the real device: interpret-mode Pallas inside
+            # fori_loop unrolls the grid as host callbacks and takes
+            # minutes to even build on CPU; single-dispatch timing is
+            # already honest there (no tunnel)
+            if kernel_chain is not None and naive_chain is not None \
+                    and interpret is None:
                 # single-dispatch wall time is tunnel-latency bound (~60ms
                 # round trip); the chained numbers are the honest per-op
                 # cost.  Timing is OPTIONAL evidence: a chain-only failure
