@@ -41,8 +41,10 @@ from bench import _peak_flops
 def _analytic_flops_per_token(n_layers, d, seq, vocab):
     """Training FLOPs/token: 3x forward; forward = 2 FLOPs per matmul
     param-use (QKVO 4d^2 + FFN 8d^2 per layer, + vocab projection) plus
-    the attention score/value matmuls 2*2*seq*d per layer."""
-    per_layer = 2 * (12 * d * d) + 4 * seq * d
+    the attention score/value matmuls.  CAUSAL accounting: a token attends
+    to seq/2 keys on average, so scores+AV cost 2*(seq/2)*d*2 = 2*seq*d —
+    the conservative (undercounting) convention, so MFU is a floor."""
+    per_layer = 2 * (12 * d * d) + 2 * seq * d
     return 3 * (n_layers * per_layer + 2 * d * vocab)
 
 
