@@ -8,7 +8,9 @@ order, each in its own subprocess so one hang cannot sink the rest:
 2. ``bench.py`` (sweep)    -> candidate bench row  (merged into
    BENCH_r04.json only if it beats the current non-suspect value — the
    same upgrade-only gate as bench_watch)
-3. ``kernels_selfcheck.py``-> KERNELS_r04.json     (refreshed with the
+3. ``bench_lm.py``         -> BENCH_LM_r04.json    (transformer LM
+   tokens/sec/chip, the second headline)
+4. ``kernels_selfcheck.py``-> KERNELS_r04.json     (refreshed with the
    amortized chain timings; only overwritten when all_ok)
 
 Then drops back to cheap probing for the rest of the session.  Run:
