@@ -108,7 +108,8 @@ def main():
 
             rc, out, err = _run(
                 [sys.executable, "bench.py"], 3600,
-                env={"BENCH_SWEEP": "1", "BENCH_TPU_TIMEOUT": "3000"})
+                env={"BENCH_SWEEP": "1", "BENCH_TPU_TIMEOUT": "3000",
+                     "BENCH_TRACE": "1"})
             if rc == 0:
                 _merge_bench(out)
             else:
