@@ -137,6 +137,7 @@ class Optimizer:
         self.host_prefetch = 0  # host-side producer lookahead (0 = inline)
         self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
+        self.remat_policy = None  # None|'nothing'|'dots' (keep MXU outputs)
         self.accum_steps = 1     # gradient-accumulation microbatches
         self.ema_decay = 0.0     # weight EMA (0 = off); read the result
         #                          via TrainedModel.ema_variables
@@ -256,6 +257,7 @@ class Optimizer:
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,
+            remat_policy=self.remat_policy,
             accum_steps=self.accum_steps, ema_decay=self.ema_decay,
             seq_parallel=self.seq_parallel)
         n_params = step_engine.n_real

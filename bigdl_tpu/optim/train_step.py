@@ -145,6 +145,7 @@ class ShardedParameterStep:
                  init_variables: Dict[str, Any],
                  clip: Optional[GradientClipping] = None,
                  bf16_grads: bool = False, remat: bool = False,
+                 remat_policy: Optional[str] = None,
                  accum_steps: int = 1, ema_decay: float = 0.0,
                  seq_parallel: bool = False):
         """``bf16_grads``: reduce-scatter the gradient vector in bfloat16 —
@@ -183,6 +184,23 @@ class ShardedParameterStep:
         self.clip = clip
         self.bf16_grads = bf16_grads
         self.remat = remat
+        # selective rematerialization: keep the MXU outputs (matmul/conv
+        # results — expensive to recompute, cheap to store) and recompute
+        # only the fused elementwise tail.  "dots": jax's
+        # dots_with_no_batch_dims_saveable policy (the standard long-
+        # context recipe); "nothing": recompute everything (max memory
+        # savings); None: jax default (= nothing saveable).
+        if remat_policy in (None, "nothing"):
+            self.remat_policy = None
+        elif remat_policy == "dots":
+            self.remat_policy = (jax.checkpoint_policies
+                                 .dots_with_no_batch_dims_saveable)
+        elif callable(remat_policy):
+            self.remat_policy = remat_policy
+        else:
+            raise ValueError(
+                f"remat_policy {remat_policy!r}: None | 'nothing' | 'dots' "
+                "| a jax.checkpoint_policies callable")
         self.accum_steps = int(accum_steps)
         self.ema_decay = float(ema_decay)
         # ICI (within-slice) data axis: the ZeRO-1 shard denominator.  A
@@ -277,6 +295,7 @@ class ShardedParameterStep:
         clip = self.clip
         elementwise = optim.elementwise
         bf16_grads, remat = self.bf16_grads, self.remat
+        remat_policy = self.remat_policy
         accum = max(1, self.accum_steps)
         ema_decay = self.ema_decay
 
@@ -304,7 +323,7 @@ class ShardedParameterStep:
                     return criterion.forward(out, y_mb), new_ms
 
                 if remat:
-                    loss_fn = jax.checkpoint(loss_fn)
+                    loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
                 return jax.value_and_grad(loss_fn, has_aux=True)(p)
 
             if accum == 1:

@@ -218,6 +218,14 @@ def test_bf16_grads_and_remat_options():
     np.testing.assert_allclose(losses["remat"], losses["plain"], rtol=1e-5)
     # bf16 grads converge to the same ballpark
     assert losses["bf16"][-1] < 0.5 * losses["bf16"][0]
+
+    # selective remat ("dots": keep MXU outputs, recompute the elementwise
+    # tail) is also the same program numerically
+    dots = make(remat=True, remat_policy="dots")
+    ld = [float(dots.train_step(i, rng, x, y)) for i in range(10)]
+    np.testing.assert_allclose(ld, losses["plain"][:10], rtol=1e-5)
+    with pytest.raises(ValueError, match="remat_policy"):
+        make(remat=True, remat_policy="bogus")
     assert abs(losses["bf16"][-1] - losses["plain"][-1]) < 0.1
 
 
