@@ -87,8 +87,15 @@ class ImageFrame:
         if labels is not None and len(labels) != len(paths):
             raise ValueError(
                 f"{len(labels)} labels for {len(paths)} resolved images")
+        from bigdl_tpu.native import lib as native
+
         imgs = []
         for p in paths:
+            if p.lower().endswith((".jpg", ".jpeg")):
+                # native libjpeg fast path (PIL fallback inside)
+                with open(p, "rb") as f:
+                    imgs.append(native.decode_jpeg(f.read()))
+                continue
             with _PILImage.open(p) as im:
                 imgs.append(np.asarray(im.convert("RGB"), np.uint8))
         frame = ImageFrame.from_arrays(

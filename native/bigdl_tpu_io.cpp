@@ -28,6 +28,14 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+// JPEG decode (the OpenCV-JNI imdecode analog).  Built with -ljpeg when
+// libjpeg is present; -DBTIO_NO_JPEG compiles the stubs so every other op
+// still loads on boxes without the library (python falls back to PIL).
+#ifndef BTIO_NO_JPEG
+#include <csetjmp>
+#include <jpeglib.h>
+#endif
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -173,6 +181,84 @@ void btio_pipeline_destroy(void* p) { delete (Pipeline*)p; }
 // One image job: src uint8 HWC (sh, sw, c) -> batch slot i of a float32
 // NHWC batch (n, oh, ow, c):  resize to (rh, rw) -> crop (oh, ow) at
 // (cy, cx) -> optional hflip -> normalize.
+// ---------------------------------------------------------------------------
+// JPEG decode
+// ---------------------------------------------------------------------------
+
+#ifndef BTIO_NO_JPEG
+struct BtioJpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+static void btio_jpeg_fail(j_common_ptr cinfo) {
+  longjmp(((BtioJpegErr*)cinfo->err)->jb, 1);
+}
+
+// Peek the dimensions of an encoded JPEG; returns 0 on success.
+int btio_jpeg_dims(const uint8_t* data, int64_t len, int* h, int* w,
+                   int* c) {
+  jpeg_decompress_struct cinfo;
+  BtioJpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = btio_jpeg_fail;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  *h = (int)cinfo.image_height;
+  *w = (int)cinfo.image_width;
+  *c = 3;  // decode always lands in RGB
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// Decode into caller-allocated (h, w, 3) RGB uint8; returns 0 on success.
+int btio_jpeg_decode(const uint8_t* data, int64_t len, uint8_t* dst,
+                     int h, int w) {
+  jpeg_decompress_struct cinfo;
+  BtioJpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = btio_jpeg_fail;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, (unsigned long)len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // grayscale/CMYK sources land as RGB
+  jpeg_start_decompress(&cinfo);
+  if ((int)cinfo.output_height != h || (int)cinfo.output_width != w ||
+      cinfo.output_components != 3) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = dst + (size_t)cinfo.output_scanline * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+int btio_jpeg_available() { return 1; }
+#else
+int btio_jpeg_dims(const uint8_t*, int64_t, int*, int*, int*) { return -1; }
+int btio_jpeg_decode(const uint8_t*, int64_t, uint8_t*, int, int) {
+  return -1;
+}
+int btio_jpeg_available() { return 0; }
+#endif
+
 struct ImageJob {
   const uint8_t* src;
   int sh, sw, c;
@@ -238,6 +324,64 @@ void btio_process_batch(void* pipe, int n, const uint8_t** srcs,
     j.oh = oh;
     j.ow = ow;
     p->submit([j] { run_image_job(j); });
+  }
+  p->wait();
+}
+
+// Decode+transform batch: srcs are ENCODED JPEG buffers (lens[i] bytes
+// each); each worker decodes to RGB then runs the same resize/crop/flip/
+// normalize job.  geom as in btio_process_batch.  Per-image status lands
+// in status[i] (0 ok, -1 decode failure; that slot's dst is untouched).
+void btio_decode_batch(void* pipe, int n, const uint8_t** srcs,
+                       const int64_t* lens, const int* geom, int oh, int ow,
+                       const float* mean, const float* stdv, float* dst,
+                       int* status) {
+  Pipeline* p = (Pipeline*)pipe;
+  const size_t slot = (size_t)oh * ow * 3;
+  for (int i = 0; i < n; ++i) {
+    const uint8_t* src = srcs[i];
+    int64_t len = lens[i];
+    const int* g = geom + 5 * i;
+    float* out = dst + slot * i;
+    int* st = status + i;
+    p->submit([src, len, g, oh, ow, mean, stdv, out, st] {
+      int h, w, c;
+      if (btio_jpeg_dims(src, len, &h, &w, &c) != 0) {
+        *st = -1;
+        return;
+      }
+      std::vector<uint8_t> pix((size_t)h * w * 3);
+      if (btio_jpeg_decode(src, len, pix.data(), h, w) != 0) {
+        *st = -1;
+        return;
+      }
+      // bounds-check the crop against the post-resize dims — the caller
+      // could not know them before decode, and run_image_job's crop
+      // would read out of bounds on a violation
+      const int eh = g[0] > 0 ? g[0] : h;
+      const int ew = g[0] > 0 ? g[1] : w;
+      if (g[2] < 0 || g[3] < 0 || g[2] + oh > eh || g[3] + ow > ew) {
+        *st = -2;
+        return;
+      }
+      ImageJob j;
+      j.src = pix.data();
+      j.sh = h;
+      j.sw = w;
+      j.c = 3;
+      j.rh = g[0];
+      j.rw = g[1];
+      j.cy = g[2];
+      j.cx = g[3];
+      j.flip = g[4];
+      j.mean = mean;
+      j.stdv = stdv;
+      j.dst = out;
+      j.oh = oh;
+      j.ow = ow;
+      run_image_job(j);
+      *st = 0;
+    });
   }
   p->wait();
 }
@@ -358,6 +502,6 @@ void btio_records_close(void* h) {
   delete rf;
 }
 
-int btio_version() { return 2; }
+int btio_version() { return 3; }
 
 }  // extern "C"

@@ -124,3 +124,89 @@ class TestVisionPipeline:
         for b in batches:
             assert b["input"].shape == (4, 24, 24, 3)
             assert b["target"].shape == (4,)
+
+
+class TestJpegDecode:
+    def _jpeg_bytes(self, rs, h=40, w=56, quality=92):
+        import io
+
+        from PIL import Image
+
+        arr = (rs.rand(h, w, 3) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+        return buf.getvalue()
+
+    def test_native_decode_matches_pil(self):
+        import io
+
+        from PIL import Image
+
+        from bigdl_tpu.native import lib as native
+
+        rs = np.random.RandomState(0)
+        data = self._jpeg_bytes(rs)
+        got = native.decode_jpeg(data)
+        with Image.open(io.BytesIO(data)) as im:
+            ref = np.asarray(im.convert("RGB"), np.uint8)
+        assert got.shape == ref.shape
+        # different IDCT implementations may differ by a few levels
+        diff = np.abs(got.astype(np.int16) - ref.astype(np.int16))
+        assert float(diff.mean()) < 2.0, float(diff.mean())
+        assert int(diff.max()) <= 32
+
+    def test_decode_batch_matches_single(self):
+        from bigdl_tpu.native import lib as native
+
+        rs = np.random.RandomState(1)
+        enc = [self._jpeg_bytes(rs, 48, 64), self._jpeg_bytes(rs, 40, 40),
+               self._jpeg_bytes(rs, 64, 48)]
+        mean = np.array([0.5, 0.5, 0.5], np.float32)
+        std = np.array([0.25, 0.25, 0.25], np.float32)
+        pipe = native.BatchPipeline(2)
+        try:
+            out = pipe.decode_batch(enc, (32, 32), mean, std,
+                                    resize_hw=(36, 36),
+                                    crops=[(0, 0), (2, 2), (4, 4)],
+                                    flips=[False, True, False])
+            assert out.shape == (3, 32, 32, 3)
+            ref = pipe.process_batch(
+                [native.decode_jpeg(e) for e in enc], (32, 32), mean, std,
+                resize_hw=(36, 36), crops=[(0, 0), (2, 2), (4, 4)],
+                flips=[False, True, False])
+            np.testing.assert_allclose(out, ref, atol=1e-5)
+        finally:
+            pipe.close()
+
+    def test_corrupt_jpeg_raises(self):
+        from bigdl_tpu.native import lib as native
+
+        with pytest.raises(ValueError):
+            native.decode_jpeg(b"\xff\xd8\xff notajpeg")
+
+        pipe = native.BatchPipeline(2)
+        try:
+            # native path reports the failing batch indices; the PIL
+            # fallback raises from decode_jpeg — ValueError either way
+            with pytest.raises(ValueError):
+                pipe.decode_batch(
+                    [b"\xff\xd8\xff junk"], (8, 8),
+                    np.zeros(3, np.float32), np.ones(3, np.float32))
+        finally:
+            pipe.close()
+
+    def test_crop_out_of_bounds_flagged(self):
+        from bigdl_tpu.native import lib as native
+
+        if not native.jpeg_available():
+            pytest.skip("native libjpeg not available")
+        rs = np.random.RandomState(2)
+        enc = [self._jpeg_bytes(rs, 24, 24)]
+        pipe = native.BatchPipeline(1)
+        try:
+            with pytest.raises(ValueError):
+                # crop 32x32 from a 24x24 decode with no resize
+                pipe.decode_batch(enc, (32, 32), np.zeros(3, np.float32),
+                                  np.ones(3, np.float32))
+        finally:
+            pipe.close()
