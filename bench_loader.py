@@ -69,6 +69,40 @@ def measure_loader(batch: int = 768, n_batches: int = 4,
         finally:
             pipe.close()
 
+    # JPEG decode+transform: the full ImageNet-style ingest (encoded bytes
+    # -> decode -> resize -> crop -> flip -> normalize) in C++ workers
+    if nat.available() and nat.jpeg_available():
+        try:
+            import io
+
+            from PIL import Image
+
+            enc_pool = []
+            for i in range(16):
+                buf = io.BytesIO()
+                Image.fromarray(pool[i]).save(buf, "JPEG", quality=90)
+                enc_pool.append(buf.getvalue())
+            enc = [enc_pool[i % len(enc_pool)] for i in range(batch)]
+            pipe = nat.BatchPipeline(num_threads=threads)
+            try:
+                crops, flips = rand_geom(rs)
+                pipe.decode_batch(enc, (out_hw, out_hw), mean, std,
+                                  resize_hw=(256, 256), crops=crops,
+                                  flips=flips)  # warmup
+                t0 = time.perf_counter()
+                for b in range(max(1, n_batches // 2)):
+                    crops, flips = rand_geom(rs)
+                    y = pipe.decode_batch(enc, (out_hw, out_hw), mean, std,
+                                          resize_hw=(256, 256), crops=crops,
+                                          flips=flips)
+                dt = time.perf_counter() - t0
+                out["jpeg_decode_img_per_sec"] = round(
+                    batch * max(1, n_batches // 2) / dt, 1)
+            finally:
+                pipe.close()
+        except Exception as e:
+            out["jpeg_decode_error"] = f"{type(e).__name__}: {e}"[:160]
+
     # record-file IO: mmap + threaded gather throughput at the same batch
     # geometry (the native sample-storage read path, data/records.py)
     try:
