@@ -122,6 +122,33 @@ class _PerImage(Transformer):
         raise NotImplementedError
 
 
+class BytesToMat(_PerImage):
+    """Decode encoded image bytes into the uint8 HWC image slot —
+    reference ``image/BytesToMat.scala`` (OpenCV imdecode).  Reads
+    ``KEY_BYTES`` (JPEG via the native libjpeg path, anything else via
+    PIL) and fills ``KEY_IMAGE``."""
+
+    KEY_BYTES = "bytes"
+
+    def transform_one(self, f):
+        data = f.get(self.KEY_BYTES)
+        if data is None:
+            raise KeyError(
+                "BytesToMat: feature has no 'bytes' entry "
+                "(set ImageFeature(bytes=...) or load uris first)")
+        try:
+            f[ImageFeature.KEY_IMAGE] = native.decode_jpeg(data)
+        except ValueError:
+            import io
+
+            from PIL import Image as _PILImage
+
+            with _PILImage.open(io.BytesIO(data)) as im:
+                f[ImageFeature.KEY_IMAGE] = np.asarray(
+                    im.convert("RGB"), np.uint8)
+        return f
+
+
 class Resize(_PerImage):
     """Bilinear resize — reference ``augmentation/Resize.scala``."""
 

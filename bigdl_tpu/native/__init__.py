@@ -8,11 +8,13 @@ has a pure-numpy fallback so the package works where no toolchain exists
 (mirroring the reference's pure-JVM fallback when MKL is absent).
 
 Public surface: ``available()``, ``resize_bilinear``, ``normalize``,
-``hflip``, ``crop``, ``BatchPipeline`` (threaded transform→assemble).
+``hflip``, ``crop``, ``decode_jpeg``, ``jpeg_available``,
+``BatchPipeline`` (threaded decode/transform→assemble).
 """
 
-from bigdl_tpu.native.lib import (BatchPipeline, available, crop, hflip,
+from bigdl_tpu.native.lib import (BatchPipeline, available, crop,
+                                  decode_jpeg, hflip, jpeg_available,
                                   normalize, resize_bilinear)
 
 __all__ = ["available", "resize_bilinear", "normalize", "hflip", "crop",
-           "BatchPipeline"]
+           "decode_jpeg", "jpeg_available", "BatchPipeline"]

@@ -210,3 +210,28 @@ class TestJpegDecode:
                                   np.ones(3, np.float32))
         finally:
             pipe.close()
+
+
+def test_bytes_to_mat_transformer():
+    """reference BytesToMat.scala: encoded bytes -> image slot, chains
+    with the rest of the augmentation DSL."""
+    import io
+
+    from PIL import Image
+
+    from bigdl_tpu.data.vision import (BytesToMat, ImageFeature, ImageFrame,
+                                       Resize)
+
+    rs = np.random.RandomState(0)
+    arr = (rs.rand(30, 40, 3) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=95)
+
+    frame = ImageFrame([ImageFeature(bytes=buf.getvalue(), label=1)])
+    out = frame.transform(BytesToMat() >> Resize(16, 16))
+    f = out.features[0]
+    assert f.image.shape == (16, 16, 3)
+    assert f[ImageFeature.KEY_LABEL] == 1
+
+    with pytest.raises(KeyError, match="bytes"):
+        ImageFrame([ImageFeature(image=arr)]).transform(BytesToMat())
