@@ -22,7 +22,8 @@ import numpy as np
 
 from bigdl_tpu.nn import layers as L
 from bigdl_tpu.nn.module import EMPTY, Container, Module
-from bigdl_tpu.ops.quantized import quantize_int8, quantized_linear
+from bigdl_tpu.ops.quantized import (abs_max_scales, quantize_int8,
+                                     quantized_linear)
 
 
 class QuantizedLinear(Module):
@@ -73,19 +74,33 @@ class QuantizedConv2D(Module):
     def from_conv(layer: L.Conv2D, params, act_scale=None
                   ) -> Tuple["QuantizedConv2D", Dict]:
         kh, kw, cin_g, cout = params["weight"].shape
+        g = layer.groups
         # conv_general_dilated_patches emits features channel-major
         # (C, kh, kw); store the quantized weight in that row order once
         # so forward is a straight matmul (scales are per-out-column and
         # unaffected by the row permutation).
         w2 = params["weight"].transpose(2, 0, 1, 3).reshape(
             cin_g * kh * kw, cout)
+        if g > 1:
+            # (rows, cout) -> (g, rows, cout/g): group j's weight columns
+            # [j*cout/g, (j+1)*cout/g) consume input channels
+            # [j*cin_g, (j+1)*cin_g) — reference nGroup semantics
+            w2 = jnp.stack(
+                [w2[:, j * (cout // g):(j + 1) * (cout // g)]
+                 for j in range(g)])
         if act_scale is not None and np.ndim(act_scale) == 1:
             # per-input-CHANNEL scales (cin,) expand to the channel-major
             # patch-feature layout and fold into the weight rows
-            act_scale = np.repeat(np.asarray(act_scale, np.float32),
-                                  kh * kw)
-            w2 = w2 * jnp.asarray(act_scale)[:, None]
-        w_q, scales = quantize_int8(w2, axis=0)
+            act_scale = np.repeat(
+                np.asarray(act_scale, np.float32).reshape(g, cin_g),
+                kh * kw, axis=1)  # (g, rows)
+            if g == 1:
+                act_scale = act_scale[0]
+                w2 = w2 * jnp.asarray(act_scale)[:, None]
+            else:
+                w2 = w2 * jnp.asarray(act_scale)[:, :, None]
+        # reduction axis = the patch-feature rows (axis 0 flat, 1 grouped)
+        w_q, scales = quantize_int8(w2, axis=0 if g == 1 else 1)
         q = QuantizedConv2D(layer)
         qp = {"weight_q": w_q, "scales": scales}
         if act_scale is not None:
@@ -99,8 +114,7 @@ class QuantizedConv2D(Module):
 
         c = self.conv
         kh, kw = c.kernel_size
-        if c.groups != 1:
-            raise NotImplementedError("grouped quantized conv")
+        g = c.groups
         patches = jax.lax.conv_general_dilated_patches(
             x.astype(jnp.float32),
             filter_shape=(kh, kw),
@@ -110,11 +124,43 @@ class QuantizedConv2D(Module):
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         n, oh, ow, feat = patches.shape
-        y = quantized_linear(
-            patches.reshape(n * oh * ow, feat),
-            params["weight_q"], params["scales"], params.get("bias"),
-            act_scale=params.get("act_scale"))
-        return y.reshape(n, oh, ow, -1).astype(x.dtype), EMPTY
+        if g == 1:
+            y = quantized_linear(
+                patches.reshape(n * oh * ow, feat),
+                params["weight_q"], params["scales"], params.get("bias"),
+                act_scale=params.get("act_scale"))
+            return y.reshape(n, oh, ow, -1).astype(x.dtype), EMPTY
+
+        # grouped: channel-major patch rows put each group's features
+        # contiguous -> (M, g, rows); the per-group int8 contraction rides
+        # XLA's batched int8 dot_general on the MXU (the Pallas kernel
+        # covers the g==1 hot path)
+        w_q, scales = params["weight_q"], params["scales"]  # (g,rows,og)
+        m = n * oh * ow
+        xg = patches.reshape(m, g, feat // g)
+        act_scale = params.get("act_scale")
+        per_channel = act_scale is not None and jnp.ndim(act_scale) == 2
+        if act_scale is None:
+            sx = abs_max_scales(xg, axis=2)[..., None]      # (M, g, 1)
+        elif per_channel:
+            sx = act_scale[None, :, :]                      # (1, g, rows)
+        else:
+            sx = jnp.asarray(act_scale, jnp.float32)        # scalar
+        x_q = jnp.clip(jnp.round(xg / sx), -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            x_q, w_q,
+            dimension_numbers=(((2,), (1,)), ((1,), (0,))),
+            preferred_element_type=jnp.int32,
+            precision=jax.lax.Precision.DEFAULT)            # (g, M, og)
+        acc = acc.transpose(1, 0, 2).astype(jnp.float32)    # (M, g, og)
+        if per_channel:  # act scales already folded into the weight rows
+            y = acc * scales[None, :, :]
+        else:
+            y = acc * sx * scales[None, :, :]
+        y = y.reshape(n, oh, ow, -1)
+        if params.get("bias") is not None:
+            y = y + params["bias"]
+        return y.astype(x.dtype), EMPTY
 
 
 def quantize(module: Module, variables: Dict[str, Any],
@@ -137,7 +183,7 @@ def _quantize_rec(module: Module, params, calib):
     if isinstance(module, L.Linear):
         return QuantizedLinear.from_linear(module, params,
                                            calib.get(id(module)))
-    if isinstance(module, L.Conv2D) and module.groups == 1:
+    if isinstance(module, L.Conv2D):
         return QuantizedConv2D.from_conv(module, params,
                                          calib.get(id(module)))
     if _is_keras_model(module):

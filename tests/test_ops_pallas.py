@@ -125,6 +125,48 @@ class TestQuantizedModules:
         rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
                / (np.abs(np.asarray(y_ref)).max() + 1e-8))
         assert rel < 0.1, rel
+
+    @pytest.mark.parametrize("groups", [2, 4, 8])
+    def test_quantize_grouped_conv(self, groups):
+        """reference nGroup int8 conv — incl. depthwise (groups == cin)."""
+        from bigdl_tpu.nn.layers import Conv2D
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.quantized import QuantizedConv2D, quantize
+
+        rng = np.random.default_rng(5)
+        model = Sequential([Conv2D(8, 16, 3, stride=1, padding="SAME",
+                                   groups=groups)])
+        x = _rand(rng, 2, 8, 8, 8)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = model.apply(variables, x)
+        q_model, q_vars = quantize(model, variables)
+        assert isinstance(q_model.layers[0], QuantizedConv2D)
+        y_q, _ = q_model.apply(q_vars, x)
+        assert y_q.shape == y_ref.shape
+        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
+               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
+        assert rel < 0.1, (groups, rel)
+
+    def test_grouped_conv_per_channel_calibration(self):
+        """per-input-channel static activation scales fold per group."""
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.layers import Conv2D
+        from bigdl_tpu.nn.quantized import QuantizedConv2D
+
+        rng = np.random.default_rng(6)
+        layer = Conv2D(8, 8, 3, padding="SAME", groups=2)
+        x = _rand(rng, 2, 8, 8, 8)
+        variables = layer.init(jax.random.PRNGKey(1), x)
+        y_ref, _ = layer.apply(variables, x)
+        # per-channel scales from the actual activation range
+        scales = np.abs(np.asarray(x)).max(axis=(0, 1, 2)) / 127.0
+        q, qp = QuantizedConv2D.from_conv(layer, variables["params"],
+                                          act_scale=scales)
+        y_q, _ = q.forward(qp, {}, jnp.asarray(x))
+        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
+               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
+        assert rel < 0.1, rel
         # original untouched
         y_again, _ = model.apply(variables, x)
         np.testing.assert_array_equal(np.asarray(y_again), np.asarray(y_ref))
@@ -143,6 +185,48 @@ class TestQuantizedModules:
         assert isinstance(q_model.layers[0], QuantizedConv2D)
         y_q, _ = q_model.apply(q_vars, x)
         assert y_q.shape == y_ref.shape
+        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
+               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
+        assert rel < 0.1, rel
+
+    @pytest.mark.parametrize("groups", [2, 4, 8])
+    def test_quantize_grouped_conv(self, groups):
+        """reference nGroup int8 conv — incl. depthwise (groups == cin)."""
+        from bigdl_tpu.nn.layers import Conv2D
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.quantized import QuantizedConv2D, quantize
+
+        rng = np.random.default_rng(5)
+        model = Sequential([Conv2D(8, 16, 3, stride=1, padding="SAME",
+                                   groups=groups)])
+        x = _rand(rng, 2, 8, 8, 8)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = model.apply(variables, x)
+        q_model, q_vars = quantize(model, variables)
+        assert isinstance(q_model.layers[0], QuantizedConv2D)
+        y_q, _ = q_model.apply(q_vars, x)
+        assert y_q.shape == y_ref.shape
+        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
+               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
+        assert rel < 0.1, (groups, rel)
+
+    def test_grouped_conv_per_channel_calibration(self):
+        """per-input-channel static activation scales fold per group."""
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.layers import Conv2D
+        from bigdl_tpu.nn.quantized import QuantizedConv2D
+
+        rng = np.random.default_rng(6)
+        layer = Conv2D(8, 8, 3, padding="SAME", groups=2)
+        x = _rand(rng, 2, 8, 8, 8)
+        variables = layer.init(jax.random.PRNGKey(1), x)
+        y_ref, _ = layer.apply(variables, x)
+        # per-channel scales from the actual activation range
+        scales = np.abs(np.asarray(x)).max(axis=(0, 1, 2)) / 127.0
+        q, qp = QuantizedConv2D.from_conv(layer, variables["params"],
+                                          act_scale=scales)
+        y_q, _ = q.forward(qp, {}, jnp.asarray(x))
         rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
                / (np.abs(np.asarray(y_ref)).max() + 1e-8))
         assert rel < 0.1, rel
