@@ -1,0 +1,180 @@
+"""Quantization-aware training (QAT) — fake-quant fine-tuning that feeds
+the int8 inference path.
+
+Beyond the reference (its ``nn/quantized`` stack is post-training only):
+``prepare_qat`` wraps every ``Linear``/``Conv2D`` in a fake-quant twin
+that simulates int8 (symmetric, per-out-channel weight scales + an
+EMA-tracked per-tensor activation range) with straight-through-estimator
+gradients, so a few fine-tune epochs let the weights adapt to the
+quantization grid.  ``convert_qat`` then produces the SAME
+``QuantizedLinear``/``QuantizedConv2D`` modules as :func:`quantize`
+— the learned activation ranges become the static calibration scales,
+and inference runs the int8 MXU kernels unchanged.
+
+TPU notes: fake-quant is a handful of elementwise ops that XLA fuses
+into the surrounding matmul/conv, so QAT steps cost ~the same as plain
+training; everything stays jit-compatible (no Python branching on
+values).
+"""
+
+import copy
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import layers as L
+from bigdl_tpu.nn.module import EMPTY, Container, Module
+from bigdl_tpu.nn.quantized import quantize
+from bigdl_tpu.tensor.policy import cast_compute
+
+__all__ = ["QATLinear", "QATConv2D", "prepare_qat", "convert_qat",
+           "fake_quant"]
+
+
+def fake_quant(x, scale):
+    """Symmetric int8 fake quantization with a straight-through estimator:
+    forward rounds onto the int8 grid, backward passes gradients through
+    unchanged (the STE — rounding has zero gradient almost everywhere)."""
+    q = jnp.clip(jnp.round(x / scale), -127, 127) * scale
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _track_amax(state, x, ema, training):
+    """EMA of the activation abs-max; state carries one scalar."""
+    amax = state["act_amax"]
+    if not training:
+        return jnp.maximum(amax, 1e-8), EMPTY
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    new = jnp.where(amax > 0, ema * amax + (1 - ema) * cur, cur)
+    return jnp.maximum(new, 1e-8), {"act_amax": new}
+
+
+class QATLinear(Module):
+    """Fake-quant twin of ``Linear`` — same params (same container key:
+    the name is preserved), plus an ``act_amax`` state scalar."""
+
+    def __init__(self, inner: L.Linear, ema: float = 0.99, name=None):
+        super().__init__(name or inner.name)
+        self.inner = inner
+        self.ema = ema
+
+    def build(self, rng, x):
+        params, _ = self.inner.build(rng, x)
+        return params, {"act_amax": jnp.zeros((), jnp.float32)}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        amax, new_state = _track_amax(state, x, self.ema, training)
+        xc, wc = cast_compute(x, params["weight"])
+        xq = fake_quant(xc.astype(jnp.float32), amax / 127.0)
+        w_scale = jnp.maximum(
+            jnp.max(jnp.abs(wc.astype(jnp.float32)), axis=0), 1e-8) / 127.0
+        wq = fake_quant(wc.astype(jnp.float32), w_scale)
+        y = jnp.matmul(xq, wq, preferred_element_type=jnp.float32)
+        if self.inner.with_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype), new_state
+
+
+class QATConv2D(Module):
+    """Fake-quant twin of ``Conv2D`` (per-out-channel weight scales)."""
+
+    def __init__(self, inner: L.Conv2D, ema: float = 0.99, name=None):
+        super().__init__(name or inner.name)
+        self.inner = inner
+        self.ema = ema
+
+    def build(self, rng, x):
+        params, _ = self.inner.build(rng, x)
+        return params, {"act_amax": jnp.zeros((), jnp.float32)}
+
+    def forward(self, params, state, x, training=False, rng=None):
+        amax, new_state = _track_amax(state, x, self.ema, training)
+        c = self.inner
+        kh, kw = c.kernel_size
+        xc, wc = cast_compute(x, params["weight"])
+        xq = fake_quant(xc.astype(jnp.float32), amax / 127.0)
+        w = wc.astype(jnp.float32)
+        w_scale = jnp.maximum(
+            jnp.max(jnp.abs(w), axis=(0, 1, 2)), 1e-8) / 127.0
+        wq = fake_quant(w, w_scale)
+        y = jax.lax.conv_general_dilated(
+            xq, wq,
+            window_strides=c.stride,
+            padding=L._conv_padding(c.padding, kh, kw),
+            rhs_dilation=c.dilation,
+            feature_group_count=c.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if c.with_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype), new_state
+
+
+def _prepare_rec(module: Module, state, ema):
+    if isinstance(module, L.Linear):
+        return QATLinear(module, ema), {"act_amax": jnp.zeros((),
+                                                             jnp.float32)}
+    if isinstance(module, L.Conv2D):
+        return QATConv2D(module, ema), {"act_amax": jnp.zeros((),
+                                                              jnp.float32)}
+    if isinstance(module, Container):
+        new = copy.copy(module)
+        new.layers = list(module.layers)
+        new_state = dict(state) if state else {}
+        for i, child in enumerate(module.layers):
+            k = module._key(i)
+            new.layers[i], st = _prepare_rec(
+                child, (state or {}).get(k, EMPTY), ema)
+            if st:
+                new_state[k] = st
+        return new, new_state
+    return module, state
+
+
+def prepare_qat(module: Module, variables: Dict[str, Any],
+                ema: float = 0.99) -> Tuple[Module, Dict[str, Any]]:
+    """Wrap quantizable leaves in fake-quant twins.  Params are reused
+    verbatim (wrapper names match, so container keys are unchanged);
+    state gains one ``act_amax`` scalar per wrapped leaf.  Fine-tune the
+    result with any engine, then :func:`convert_qat`."""
+    new_mod, new_state = _prepare_rec(
+        module, variables.get("state", EMPTY), ema)
+    return new_mod, {"params": variables.get("params", EMPTY),
+                     "state": new_state}
+
+
+def _collect_and_unwrap(module: Module, state, calib):
+    """Replace QAT wrappers with their inner layers, harvesting each
+    learned activation range into ``calib[id(inner)] = amax / 127``."""
+    if isinstance(module, (QATLinear, QATConv2D)):
+        amax = float((state or {}).get("act_amax", 0.0))
+        if amax > 0:
+            calib[id(module.inner)] = amax / 127.0
+        return module.inner, EMPTY
+    if isinstance(module, Container):
+        new = copy.copy(module)
+        new.layers = list(module.layers)
+        new_state = dict(state) if state else {}
+        for i, child in enumerate(module.layers):
+            k = module._key(i)
+            new.layers[i], st = _collect_and_unwrap(
+                child, (state or {}).get(k, EMPTY), calib)
+            if st:
+                new_state[k] = st
+            else:
+                new_state.pop(k, None)
+        return new, new_state
+    return module, state
+
+
+def convert_qat(module: Module, variables: Dict[str, Any]
+                ) -> Tuple[Module, Dict[str, Any]]:
+    """QAT model -> int8 inference model.  The learned activation ranges
+    become static per-tensor calibration scales on the SAME
+    ``QuantizedLinear``/``QuantizedConv2D`` path as :func:`quantize`
+    (Pallas int8 matmul / batched int8 dot_general)."""
+    calib: Dict[int, float] = {}
+    plain, plain_state = _collect_and_unwrap(
+        module, variables.get("state", EMPTY), calib)
+    return quantize(plain, {"params": variables.get("params", EMPTY),
+                            "state": plain_state}, calib=calib)

@@ -511,3 +511,110 @@ class TestPerChannelActivationQuant:
         with pytest.raises(ValueError, match="granularity"):
             calibrate(model, v, [np.zeros((2, 4), np.float32)],
                       granularity="row")
+
+
+class TestQAT:
+    """Quantization-aware training: fake-quant fine-tune -> int8 convert
+    (beyond the reference's PTQ-only nn/quantized stack)."""
+
+    def _setup(self):
+        from bigdl_tpu.nn.layers import Linear, ReLU
+        from bigdl_tpu.nn.module import Sequential
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float32)
+        w_true = rs.randn(8, 1).astype(np.float32)
+        y = x @ w_true
+        model = Sequential([Linear(8, 32), ReLU(), Linear(32, 1)])
+        variables = model.init(jax.random.PRNGKey(0), x[:2])
+        return model, variables, x, y
+
+    def _train(self, model, variables, x, y, steps=150, lr=0.05):
+        import jax.numpy as jnp
+
+        params, state = variables["params"], variables["state"]
+
+        @jax.jit
+        def step(p, s):
+            def loss_fn(p):
+                out, ns = model.forward(p, s, jnp.asarray(x), training=True)
+                return jnp.mean((out - jnp.asarray(y)) ** 2), ns
+
+            (l, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g), \
+                ns, l
+
+        for _ in range(steps):
+            params, state, loss = step(params, state)
+        return {"params": params, "state": state}, float(loss)
+
+    def _mse(self, model, variables, x, y):
+        import jax.numpy as jnp
+
+        out, _ = model.apply(variables, jnp.asarray(x))
+        return float(np.mean((np.asarray(out) - y) ** 2))
+
+    def test_qat_roundtrip_and_conversion(self):
+        from bigdl_tpu.nn.qat import QATLinear, convert_qat, prepare_qat
+        from bigdl_tpu.nn.quantized import QuantizedLinear
+
+        model, variables, x, y = self._setup()
+        variables, _ = self._train(model, variables, x, y)
+        fp32_mse = self._mse(model, variables, x, y)
+
+        qat_model, qat_vars = prepare_qat(model, variables)
+        # params are reused verbatim: same keys, same arrays
+        assert set(qat_vars["params"].keys()) == set(
+            variables["params"].keys())
+        assert any(isinstance(m, QATLinear) for m in qat_model.layers)
+
+        qat_vars, _ = self._train(qat_model, qat_vars, x, y, steps=80,
+                                  lr=0.01)
+        # EMA activation ranges were tracked
+        amaxes = [float(s["act_amax"]) for s in
+                  qat_vars["state"].values() if "act_amax" in s]
+        assert amaxes and all(a > 0 for a in amaxes)
+
+        int8_model, int8_vars = convert_qat(qat_model, qat_vars)
+        assert any(isinstance(m, QuantizedLinear)
+                   for m in int8_model.layers)
+        # learned ranges became static calibration scales
+        leaf = next(m for m in int8_model.layers
+                    if isinstance(m, QuantizedLinear))
+        k = int8_model._key(int8_model.layers.index(leaf))
+        assert "act_scale" in int8_vars["params"][k]
+
+        int8_mse = self._mse(int8_model, int8_vars, x, y)
+        # int8 stays close to the fp32 model it was trained from
+        assert int8_mse < max(4 * fp32_mse, 5e-2), (int8_mse, fp32_mse)
+
+    def test_qat_beats_naive_ptq_on_outlier_activations(self):
+        """An input channel with a huge range wrecks per-tensor PTQ's
+        activation grid; QAT's fine-tune adapts the weights to it."""
+        from bigdl_tpu.nn.layers import Linear
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.qat import convert_qat, prepare_qat
+        from bigdl_tpu.nn.quantized import calibrate, quantize
+
+        rs = np.random.RandomState(1)
+        x = rs.randn(256, 8).astype(np.float32)
+        x[:, 0] *= 60.0  # outlier channel
+        y = (x @ rs.randn(8, 1).astype(np.float32) / 60.0)
+        model = Sequential([Linear(8, 1)])
+        variables = model.init(jax.random.PRNGKey(0), x[:2])
+        variables, _ = self._train(model, variables, x, y, steps=400,
+                                   lr=2e-4)
+
+        # per-tensor static PTQ (minmax) — the naive reference path
+        calib = calibrate(model, variables, [x], method="minmax",
+                          granularity="tensor")
+        ptq_model, ptq_vars = quantize(model, variables, calib=calib)
+        ptq_mse = self._mse(ptq_model, ptq_vars, x, y)
+
+        qat_model, qat_vars = prepare_qat(model, variables)
+        qat_vars, _ = self._train(qat_model, qat_vars, x, y, steps=300,
+                                  lr=2e-4)
+        int8_model, int8_vars = convert_qat(qat_model, qat_vars)
+        qat_mse = self._mse(int8_model, int8_vars, x, y)
+
+        assert qat_mse <= ptq_mse * 1.05, (qat_mse, ptq_mse)
