@@ -1,0 +1,67 @@
+"""Finite-difference gradient checker — reference ``nn/GradientChecker
+.scala`` (⚠ unverified — mount empty): central-difference validation of a
+layer's backward against its forward.
+
+In a jax.grad world autodiff is correct by construction for composite
+ops; what still needs this check is every op with a HAND-WRITTEN
+backward — the ``jax.custom_vjp`` Pallas kernels (flash attention, fused
+layernorm) whose bwd rules are code, not derivation.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["numeric_grad", "check_grad"]
+
+
+def numeric_grad(fn: Callable, x: np.ndarray, eps: float = 1e-3,
+                 samples: int = 0, seed: int = 0) -> np.ndarray:
+    """Central-difference gradient of scalar-valued ``fn`` at ``x``.
+
+    ``samples > 0``: only that many randomly chosen coordinates are
+    probed (the rest of the returned array is NaN) — full probing is
+    O(2·size) forwards and pointless for large inputs.
+    """
+    x = np.asarray(x, np.float64)
+    flat = x.reshape(-1).copy()
+    g = np.full(flat.shape, np.nan)
+    idx = np.arange(flat.size)
+    if samples and samples < flat.size:
+        idx = np.random.RandomState(seed).choice(flat.size, samples,
+                                                 replace=False)
+    for i in idx:
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = float(fn(jnp.asarray(flat.reshape(x.shape), jnp.float32)))
+        flat[i] = orig - eps
+        fm = float(fn(jnp.asarray(flat.reshape(x.shape), jnp.float32)))
+        flat[i] = orig
+        g[i] = (fp - fm) / (2 * eps)
+    return g.reshape(x.shape)
+
+
+def check_grad(fn: Callable, x: np.ndarray, eps: float = 1e-3,
+               rtol: float = 5e-2, atol: float = 1e-3,
+               samples: int = 64, seed: int = 0) -> float:
+    """Assert ``jax.grad(fn)(x)`` matches central differences on a random
+    coordinate sample; returns the max abs deviation over the sample.
+
+    Tolerances are loose by design: finite differences in f32 forwards
+    carry O(eps^2 + ulp/eps) noise — this catches *wrong formulas*
+    (missing terms, transposed operands), not last-ulp drift.
+    """
+    auto = np.asarray(jax.grad(fn)(jnp.asarray(x, jnp.float32)), np.float64)
+    num = numeric_grad(fn, x, eps=eps, samples=samples, seed=seed)
+    mask = ~np.isnan(num)
+    dev = np.abs(auto[mask] - num[mask])
+    bound = atol + rtol * np.abs(num[mask])
+    if not (dev <= bound).all():
+        worst = int(np.argmax(dev - bound))
+        raise AssertionError(
+            f"gradient mismatch: autodiff {auto[mask][worst]:.6f} vs "
+            f"numeric {num[mask][worst]:.6f} (|Δ|={dev[worst]:.2e}, "
+            f"bound {bound[worst]:.2e}) at sampled coord {worst}")
+    return float(dev.max()) if dev.size else 0.0
