@@ -111,12 +111,24 @@ class QATConv2D(Module):
 
 
 def _prepare_rec(module: Module, state, ema):
+    from bigdl_tpu.nn.quantized import _clone_keras, _is_keras_model
+
     if isinstance(module, L.Linear):
         return QATLinear(module, ema), {"act_amax": jnp.zeros((),
                                                              jnp.float32)}
     if isinstance(module, L.Conv2D):
         return QATConv2D(module, ema), {"act_amax": jnp.zeros((),
                                                               jnp.float32)}
+    if _is_keras_model(module):
+        new_model, replaced = _clone_keras(
+            module,
+            lambda lay, name: (QATLinear(lay, ema)
+                               if isinstance(lay, L.Linear)
+                               else QATConv2D(lay, ema)))
+        new_state = dict(state) if state else {}
+        for name, _old, _new in replaced:
+            new_state[name] = {"act_amax": jnp.zeros((), jnp.float32)}
+        return new_model, new_state
     if isinstance(module, Container):
         new = copy.copy(module)
         new.layers = list(module.layers)
@@ -146,11 +158,29 @@ def prepare_qat(module: Module, variables: Dict[str, Any],
 def _collect_and_unwrap(module: Module, state, calib):
     """Replace QAT wrappers with their inner layers, harvesting each
     learned activation range into ``calib[id(inner)] = amax / 127``."""
+    from bigdl_tpu.nn.quantized import _clone_keras, _is_keras_model
+
     if isinstance(module, (QATLinear, QATConv2D)):
         amax = float((state or {}).get("act_amax", 0.0))
         if amax > 0:
             calib[id(module.inner)] = amax / 127.0
         return module.inner, EMPTY
+    if _is_keras_model(module):
+        def unwrap(lay, name):
+            if isinstance(lay, (QATLinear, QATConv2D)):
+                amax = float(((state or {}).get(name) or
+                              {}).get("act_amax", 0.0))
+                if amax > 0:
+                    calib[id(lay.inner)] = amax / 127.0
+                return lay.inner
+            return lay
+        new_model, replaced = _clone_keras(
+            module, unwrap,
+            match=lambda lay: isinstance(lay, (QATLinear, QATConv2D)))
+        new_state = dict(state) if state else {}
+        for name, _old, _new in replaced:
+            new_state.pop(name, None)
+        return new_model, new_state
     if isinstance(module, Container):
         new = copy.copy(module)
         new.layers = list(module.layers)

@@ -262,12 +262,15 @@ def _is_keras_model(module) -> bool:
     return isinstance(module, KModel)
 
 
-def _clone_keras(model, replace):
+def _clone_keras(model, replace, match=None):
     """Clone a keras Model, calling ``replace(layer, node_name) -> layer``
-    on each quantizable node layer.  Returns (new_model, replaced) where
+    on each node layer selected by ``match`` (default: the quantizable
+    Linear/Conv2D leaves).  Returns (new_model, replaced) where
     ``replaced`` lists (node_name, old_layer, new_layer)."""
     from bigdl_tpu.keras.engine import Model as KModel
 
+    if match is None:
+        match = lambda lay: isinstance(lay, (L.Linear, L.Conv2D))
     by_id: Dict[int, Any] = {}
     replaced = []
     for node in model.order:   # topological: parents before children
@@ -275,8 +278,7 @@ def _clone_keras(model, replace):
         c.parents = [by_id[p.id] for p in node.parents]
         by_id[node.id] = c
         lay = node.layer
-        if isinstance(lay, L.Linear) or (isinstance(lay, L.Conv2D)
-                                         and lay.groups == 1):
+        if lay is not None and match(lay):
             c.layer = replace(lay, node.name)
             replaced.append((node.name, lay, c.layer))
     new_model = KModel([by_id[i.id] for i in model.inputs],
