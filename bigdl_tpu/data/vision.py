@@ -87,15 +87,18 @@ class ImageFrame:
         if labels is not None and len(labels) != len(paths):
             raise ValueError(
                 f"{len(labels)} labels for {len(paths)} resolved images")
-        from bigdl_tpu.native import lib as native
-
         imgs = []
         for p in paths:
             if p.lower().endswith((".jpg", ".jpeg")):
-                # native libjpeg fast path (PIL fallback inside)
+                # native libjpeg fast path; PIL rescues what libjpeg
+                # rejects (CMYK/Adobe JPEGs, mislabeled PNGs)
                 with open(p, "rb") as f:
-                    imgs.append(native.decode_jpeg(f.read()))
-                continue
+                    data = f.read()
+                try:
+                    imgs.append(native.decode_jpeg(data))
+                    continue
+                except ValueError:
+                    pass
             with _PILImage.open(p) as im:
                 imgs.append(np.asarray(im.convert("RGB"), np.uint8))
         frame = ImageFrame.from_arrays(

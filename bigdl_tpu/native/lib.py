@@ -314,10 +314,17 @@ class BatchPipeline:
             geom.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             oh, ow, _f32p(mean), _f32p(std), _f32p(out),
             status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-        bad = np.flatnonzero(status != 0)
-        if len(bad):
+        bad_decode = np.flatnonzero(status == -1)
+        bad_crop = np.flatnonzero(status == -2)
+        if len(bad_crop):
             raise ValueError(
-                f"JPEG decode failed for batch indices {bad.tolist()[:8]}")
+                "crop out of bounds of the decoded/resized image for batch "
+                f"indices {bad_crop.tolist()[:8]} — pass resize_hw or "
+                "shrink the crop (geometry bug, not corrupt data)")
+        if len(bad_decode):
+            raise ValueError(
+                f"JPEG decode failed for batch indices "
+                f"{bad_decode.tolist()[:8]}")
         return out
 
     def gather_rows(self, src: np.ndarray, idx: np.ndarray) -> np.ndarray:

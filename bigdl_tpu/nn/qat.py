@@ -44,10 +44,19 @@ def _track_amax(state, x, ema, training):
     """EMA of the activation abs-max; state carries one scalar."""
     amax = state["act_amax"]
     if not training:
-        return jnp.maximum(amax, 1e-8), EMPTY
+        return amax, EMPTY
     cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
     new = jnp.where(amax > 0, ema * amax + (1 - ema) * cur, cur)
-    return jnp.maximum(new, 1e-8), {"act_amax": new}
+    return new, {"act_amax": new}
+
+
+def _fq_act(x, amax):
+    """Fake-quantize an activation with the tracked range; an UNTRACKED
+    range (eval before any training step: amax == 0) passes through
+    unquantized — quantizing with the epsilon floor would collapse the
+    activation to ~0 and silently wreck pre-QAT baseline evals."""
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    return jnp.where(amax > 0, fake_quant(x, scale), x)
 
 
 class QATLinear(Module):
@@ -66,7 +75,7 @@ class QATLinear(Module):
     def forward(self, params, state, x, training=False, rng=None):
         amax, new_state = _track_amax(state, x, self.ema, training)
         xc, wc = cast_compute(x, params["weight"])
-        xq = fake_quant(xc.astype(jnp.float32), amax / 127.0)
+        xq = _fq_act(xc.astype(jnp.float32), amax)
         w_scale = jnp.maximum(
             jnp.max(jnp.abs(wc.astype(jnp.float32)), axis=0), 1e-8) / 127.0
         wq = fake_quant(wc.astype(jnp.float32), w_scale)
@@ -93,7 +102,7 @@ class QATConv2D(Module):
         c = self.inner
         kh, kw = c.kernel_size
         xc, wc = cast_compute(x, params["weight"])
-        xq = fake_quant(xc.astype(jnp.float32), amax / 127.0)
+        xq = _fq_act(xc.astype(jnp.float32), amax)
         w = wc.astype(jnp.float32)
         w_scale = jnp.maximum(
             jnp.max(jnp.abs(w), axis=(0, 1, 2)), 1e-8) / 127.0

@@ -235,3 +235,26 @@ def test_bytes_to_mat_transformer():
 
     with pytest.raises(KeyError, match="bytes"):
         ImageFrame([ImageFeature(image=arr)]).transform(BytesToMat())
+
+
+def test_decode_batch_distinguishes_crop_bug_from_corrupt_data():
+    from bigdl_tpu.native import lib as native
+
+    if not native.jpeg_available():
+        pytest.skip("native libjpeg not available")
+    import io
+
+    from PIL import Image
+
+    rs = np.random.RandomState(3)
+    buf = io.BytesIO()
+    Image.fromarray((rs.rand(24, 24, 3) * 255).astype(np.uint8)).save(
+        buf, "JPEG")
+    pipe = native.BatchPipeline(1)
+    try:
+        with pytest.raises(ValueError, match="geometry bug"):
+            pipe.decode_batch([buf.getvalue()], (32, 32),
+                              np.zeros(3, np.float32),
+                              np.ones(3, np.float32))
+    finally:
+        pipe.close()

@@ -125,48 +125,6 @@ class TestQuantizedModules:
         rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
                / (np.abs(np.asarray(y_ref)).max() + 1e-8))
         assert rel < 0.1, rel
-
-    @pytest.mark.parametrize("groups", [2, 4, 8])
-    def test_quantize_grouped_conv(self, groups):
-        """reference nGroup int8 conv — incl. depthwise (groups == cin)."""
-        from bigdl_tpu.nn.layers import Conv2D
-        from bigdl_tpu.nn.module import Sequential
-        from bigdl_tpu.nn.quantized import QuantizedConv2D, quantize
-
-        rng = np.random.default_rng(5)
-        model = Sequential([Conv2D(8, 16, 3, stride=1, padding="SAME",
-                                   groups=groups)])
-        x = _rand(rng, 2, 8, 8, 8)
-        variables = model.init(jax.random.PRNGKey(0), x)
-        y_ref, _ = model.apply(variables, x)
-        q_model, q_vars = quantize(model, variables)
-        assert isinstance(q_model.layers[0], QuantizedConv2D)
-        y_q, _ = q_model.apply(q_vars, x)
-        assert y_q.shape == y_ref.shape
-        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
-               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
-        assert rel < 0.1, (groups, rel)
-
-    def test_grouped_conv_per_channel_calibration(self):
-        """per-input-channel static activation scales fold per group."""
-        import jax.numpy as jnp
-
-        from bigdl_tpu.nn.layers import Conv2D
-        from bigdl_tpu.nn.quantized import QuantizedConv2D
-
-        rng = np.random.default_rng(6)
-        layer = Conv2D(8, 8, 3, padding="SAME", groups=2)
-        x = _rand(rng, 2, 8, 8, 8)
-        variables = layer.init(jax.random.PRNGKey(1), x)
-        y_ref, _ = layer.apply(variables, x)
-        # per-channel scales from the actual activation range
-        scales = np.abs(np.asarray(x)).max(axis=(0, 1, 2)) / 127.0
-        q, qp = QuantizedConv2D.from_conv(layer, variables["params"],
-                                          act_scale=scales)
-        y_q, _ = q.forward(qp, {}, jnp.asarray(x))
-        rel = (np.abs(np.asarray(y_q) - np.asarray(y_ref)).max()
-               / (np.abs(np.asarray(y_ref)).max() + 1e-8))
-        assert rel < 0.1, rel
         # original untouched
         y_again, _ = model.apply(variables, x)
         np.testing.assert_array_equal(np.asarray(y_again), np.asarray(y_ref))
@@ -588,6 +546,55 @@ class TestQAT:
         # int8 stays close to the fp32 model it was trained from
         assert int8_mse < max(4 * fp32_mse, 5e-2), (int8_mse, fp32_mse)
 
+    def test_qat_on_keras_functional_model(self):
+        """prepare_qat/convert_qat descend keras graphs like quantize."""
+        from bigdl_tpu import nn
+        from bigdl_tpu.keras.engine import Input, Model
+        from bigdl_tpu.nn.qat import QATLinear, convert_qat, prepare_qat
+        from bigdl_tpu.nn.quantized import QuantizedLinear
+
+        inp = Input((8,))
+        h = nn.Linear(8, 16)(inp)
+        h = nn.ReLU()(h)
+        out = nn.Linear(16, 3)(h)
+        model = Model(inp, out)
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8).astype(np.float32)
+        v = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+        qat_model, qat_vars = prepare_qat(model, v)
+        assert sum(isinstance(n.layer, QATLinear)
+                   for n in qat_model.order) == 2
+        # params reused verbatim; a forward in training mode tracks ranges
+        y, st = qat_model.forward(qat_vars["params"], qat_vars["state"],
+                                  jnp.asarray(x), training=True)
+        qat_vars = {"params": qat_vars["params"], "state": st}
+        amaxes = [float(s["act_amax"]) for s in st.values()
+                  if isinstance(s, dict) and "act_amax" in s]
+        assert len(amaxes) == 2 and all(a > 0 for a in amaxes)
+
+        int8_model, int8_vars = convert_qat(qat_model, qat_vars)
+        assert sum(isinstance(n.layer, QuantizedLinear)
+                   for n in int8_model.order) == 2
+        y_f32, _ = model.apply(v, jnp.asarray(x))
+        y_q, _ = int8_model.apply(int8_vars, jnp.asarray(x))
+        err = np.abs(np.asarray(y_q) - np.asarray(y_f32)).max()
+        assert err < 0.15 * np.abs(np.asarray(y_f32)).max()
+
+    def test_qat_eval_before_training_passes_through(self):
+        """amax untracked (eval before any train step) must NOT quantize
+        with the epsilon floor — that collapses activations to ~0."""
+        from bigdl_tpu.nn.qat import prepare_qat
+
+        model, variables, x, y = self._setup()
+        qat_model, qat_vars = prepare_qat(model, variables)
+        y_fp32, _ = model.apply(variables, jnp.asarray(x))
+        y_qat, _ = qat_model.apply(qat_vars, jnp.asarray(x))
+        # weights fake-quantize (small error); activations pass through
+        rel = (np.abs(np.asarray(y_qat) - np.asarray(y_fp32)).max()
+               / (np.abs(np.asarray(y_fp32)).max() + 1e-8))
+        assert rel < 0.05, rel
+
     def test_qat_beats_naive_ptq_on_outlier_activations(self):
         """An input channel with a huge range wrecks per-tensor PTQ's
         activation grid; QAT's fine-tune adapts the weights to it."""
@@ -677,38 +684,3 @@ class TestGradientChecker:
         x = np.random.RandomState(2).randn(8).astype(np.float32)
         with pytest.raises(AssertionError, match="gradient mismatch"):
             check_grad(broken_square, x, samples=8)
-
-    def test_qat_on_keras_functional_model(self):
-        """prepare_qat/convert_qat descend keras graphs like quantize."""
-        from bigdl_tpu import nn
-        from bigdl_tpu.keras.engine import Input, Model
-        from bigdl_tpu.nn.qat import QATLinear, convert_qat, prepare_qat
-        from bigdl_tpu.nn.quantized import QuantizedLinear
-
-        inp = Input((8,))
-        h = nn.Linear(8, 16)(inp)
-        h = nn.ReLU()(h)
-        out = nn.Linear(16, 3)(h)
-        model = Model(inp, out)
-        rs = np.random.RandomState(0)
-        x = rs.randn(32, 8).astype(np.float32)
-        v = model.init(jax.random.PRNGKey(0), jnp.asarray(x))
-
-        qat_model, qat_vars = prepare_qat(model, v)
-        assert sum(isinstance(n.layer, QATLinear)
-                   for n in qat_model.order) == 2
-        # params reused verbatim; a forward in training mode tracks ranges
-        y, st = qat_model.forward(qat_vars["params"], qat_vars["state"],
-                                  jnp.asarray(x), training=True)
-        qat_vars = {"params": qat_vars["params"], "state": st}
-        amaxes = [float(s["act_amax"]) for s in st.values()
-                  if isinstance(s, dict) and "act_amax" in s]
-        assert len(amaxes) == 2 and all(a > 0 for a in amaxes)
-
-        int8_model, int8_vars = convert_qat(qat_model, qat_vars)
-        assert sum(isinstance(n.layer, QuantizedLinear)
-                   for n in int8_model.order) == 2
-        y_f32, _ = model.apply(v, jnp.asarray(x))
-        y_q, _ = int8_model.apply(int8_vars, jnp.asarray(x))
-        err = np.abs(np.asarray(y_q) - np.asarray(y_f32)).max()
-        assert err < 0.15 * np.abs(np.asarray(y_f32)).max()
