@@ -82,11 +82,19 @@ class InferenceOptimizer:
             raise ValueError("quantize needs a sample input for tracing")
         if precision == "bf16":
             return InferenceOptimizer.trace(model, variables, sample, "bf16")
-        if precision != "int8":
-            raise ValueError(f"precision {precision!r}: int8 or bf16")
+        if precision not in ("int8", "int8_wo"):
+            raise ValueError(
+                f"precision {precision!r}: int8 | int8_wo | bf16")
         from bigdl_tpu.nn.quantized import calibrate
         from bigdl_tpu.nn.quantized import quantize as quantize_module
 
+        if precision == "int8_wo":
+            # weight-only: int8 weights, full-precision activations — no
+            # calibration applies (nothing quantizes at runtime)
+            q_model, q_vars = quantize_module(model, variables,
+                                              weight_only=True)
+            return TracedModel(_forward_fn(q_model), q_vars,
+                               np.asarray(sample), "int8_wo")
         calib = None
         if calib_data is not None:
             calib = calibrate(model, variables, calib_data,

@@ -163,8 +163,77 @@ class QuantizedConv2D(Module):
         return y.astype(x.dtype), EMPTY
 
 
+class WeightOnlyLinear(Module):
+    """Weight-ONLY int8 Linear: the weight is stored int8 with per-out-
+    channel scales and dequantized into the compute dtype at matmul time
+    (XLA fuses the convert+scale into the weight read).  No activation
+    quantization — accuracy ~bf16, weight HBM traffic 4x lower: the right
+    trade for decode-bound (weight-bandwidth-bound) transformer serving.
+    Beyond the reference (its int8 path always quantizes activations)."""
+
+    def __init__(self, out_features: int, with_bias: bool = True, name=None):
+        super().__init__(name)
+        self.out_features = out_features
+        self.with_bias = with_bias
+
+    @staticmethod
+    def from_linear(layer: L.Linear, params
+                    ) -> Tuple["WeightOnlyLinear", Dict]:
+        w_q, scales = quantize_int8(params["weight"], axis=0)
+        q = WeightOnlyLinear(layer.out_features, layer.with_bias,
+                             name=layer.name)
+        qp = {"weight_q": w_q, "scales": scales}
+        if layer.with_bias:
+            qp["bias"] = params["bias"]
+        return q, qp
+
+    def forward(self, params, state, x, training=False, rng=None):
+        from bigdl_tpu.tensor.policy import cast_compute, get_compute_dtype
+
+        dt = get_compute_dtype()
+        w = params["weight_q"].astype(dt) * params["scales"].astype(dt)
+        xc = cast_compute(x)
+        y = jnp.matmul(xc, w, preferred_element_type=jnp.float32)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype), EMPTY
+
+
+class WeightOnlyConv2D(Module):
+    """Weight-only int8 Conv2D (see :class:`WeightOnlyLinear`)."""
+
+    def __init__(self, conv: L.Conv2D, name=None):
+        super().__init__(name or conv.name)
+        self.conv = conv
+
+    @staticmethod
+    def from_conv(layer: L.Conv2D, params
+                  ) -> Tuple["WeightOnlyConv2D", Dict]:
+        # per-out-channel scales over the (kh, kw, cin_g) reduction axes
+        w = params["weight"]
+        amax = jnp.max(jnp.abs(w), axis=(0, 1, 2))
+        scales = (jnp.maximum(amax, 1e-8) / 127.0).astype(jnp.float32)
+        w_q = jnp.clip(jnp.round(w / scales), -127, 127).astype(jnp.int8)
+        q = WeightOnlyConv2D(layer)
+        qp = {"weight_q": w_q, "scales": scales}
+        if layer.with_bias:
+            qp["bias"] = params["bias"]
+        return q, qp
+
+    def forward(self, params, state, x, training=False, rng=None):
+        from bigdl_tpu.tensor.policy import get_compute_dtype
+
+        dt = get_compute_dtype()
+        w = params["weight_q"].astype(dt) * params["scales"].astype(dt)
+        p = {"weight": w}
+        if self.conv.with_bias:
+            p["bias"] = params["bias"]
+        return self.conv.forward(p, state, x, training=training, rng=rng)
+
+
 def quantize(module: Module, variables: Dict[str, Any],
-             calib: Optional[Dict[int, float]] = None
+             calib: Optional[Dict[int, float]] = None,
+             weight_only: bool = False
              ) -> Tuple[Module, Dict[str, Any]]:
     """Post-training quantization — reference ``Quantizer.quantize(model)``.
 
@@ -172,22 +241,31 @@ def quantize(module: Module, variables: Dict[str, Any],
     ``calib``: optional ``{id(leaf): activation_scale}`` from
     :func:`calibrate` — calibrated leaves run STATIC per-tensor activation
     quantization (the reference's min/max-calibrated int8 inference);
-    uncalibrated leaves keep dynamic per-row quantization."""
+    uncalibrated leaves keep dynamic per-row quantization.
+
+    ``weight_only=True``: int8 weights but full-precision activations and
+    accumulation (``WeightOnlyLinear``/``WeightOnlyConv2D``) — no
+    activation quantization error, 4x weight memory saving."""
     params = variables.get("params", EMPTY)
     state = variables.get("state", EMPTY)
-    new_mod, new_params = _quantize_rec(module, params, calib or {})
+    new_mod, new_params = _quantize_rec(module, params, calib or {},
+                                        weight_only)
     return new_mod, {"params": new_params, "state": state}
 
 
-def _quantize_rec(module: Module, params, calib):
+def _quantize_rec(module: Module, params, calib, weight_only=False):
     if isinstance(module, L.Linear):
+        if weight_only:
+            return WeightOnlyLinear.from_linear(module, params)
         return QuantizedLinear.from_linear(module, params,
                                            calib.get(id(module)))
     if isinstance(module, L.Conv2D):
+        if weight_only:
+            return WeightOnlyConv2D.from_conv(module, params)
         return QuantizedConv2D.from_conv(module, params,
                                          calib.get(id(module)))
     if _is_keras_model(module):
-        return _quantize_keras(module, params, calib)
+        return _quantize_keras(module, params, calib, weight_only)
     if isinstance(module, Container):
         new = copy.copy(module)
         new.layers = list(module.layers)
@@ -195,7 +273,8 @@ def _quantize_rec(module: Module, params, calib):
         for i, child in enumerate(module.layers):
             k = module._key(i)
             child_p = params.get(k, EMPTY) if params else EMPTY
-            q_child, q_params = _quantize_rec(child, child_p, calib)
+            q_child, q_params = _quantize_rec(child, child_p, calib,
+                                              weight_only)
             if q_child is not child:
                 new.layers[i] = q_child
                 # key embeds the child name, which is preserved
@@ -287,15 +366,19 @@ def _clone_keras(model, replace, match=None):
     return new_model, replaced
 
 
-def _quantize_keras(model, params, calib):
+def _quantize_keras(model, params, calib, weight_only=False):
     qparams: Dict[str, Dict] = {}
 
     def replace(lay, node_name):
         p = params.get(node_name, {}) if params else {}
         if isinstance(lay, L.Linear):
-            q, qp = QuantizedLinear.from_linear(lay, p, calib.get(id(lay)))
+            q, qp = (WeightOnlyLinear.from_linear(lay, p) if weight_only
+                     else QuantizedLinear.from_linear(lay, p,
+                                                      calib.get(id(lay))))
         else:
-            q, qp = QuantizedConv2D.from_conv(lay, p, calib.get(id(lay)))
+            q, qp = (WeightOnlyConv2D.from_conv(lay, p) if weight_only
+                     else QuantizedConv2D.from_conv(lay, p,
+                                                    calib.get(id(lay))))
         qparams[node_name] = qp
         return q
 

@@ -684,3 +684,58 @@ class TestGradientChecker:
         x = np.random.RandomState(2).randn(8).astype(np.float32)
         with pytest.raises(AssertionError, match="gradient mismatch"):
             check_grad(broken_square, x, samples=8)
+
+
+class TestWeightOnly:
+    """Weight-only int8 (int8 weights, full-precision compute) — the
+    decode-bound serving trade; beyond the reference's always-quantized
+    activations."""
+
+    def test_weight_only_closer_than_full_int8(self):
+        from bigdl_tpu.nn.layers import Linear, ReLU
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.quantized import WeightOnlyLinear, quantize
+
+        rng = np.random.default_rng(0)
+        model = Sequential([Linear(32, 64), ReLU(), Linear(64, 8)])
+        x = _rand(rng, 16, 32)
+        v = model.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = model.apply(v, x)
+
+        wo_model, wo_vars = quantize(model, v, weight_only=True)
+        assert isinstance(wo_model.layers[0], WeightOnlyLinear)
+        y_wo, _ = wo_model.apply(wo_vars, x)
+
+        full_model, full_vars = quantize(model, v)
+        y_full, _ = full_model.apply(full_vars, x)
+
+        err_wo = np.abs(np.asarray(y_wo) - np.asarray(y_ref)).max()
+        err_full = np.abs(np.asarray(y_full) - np.asarray(y_ref)).max()
+        # no activation-quantization error -> strictly tighter
+        assert err_wo <= err_full, (err_wo, err_full)
+        assert err_wo < 0.05 * np.abs(np.asarray(y_ref)).max()
+        # weights really are int8 on disk
+        assert wo_vars["params"][wo_model._key(0)]["weight_q"].dtype == \
+            jnp.int8
+
+    def test_weight_only_conv_and_nano_surface(self):
+        from bigdl_tpu.nano.inference import InferenceOptimizer
+        from bigdl_tpu.nn.layers import Conv2D
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.nn.quantized import WeightOnlyConv2D, quantize
+
+        rng = np.random.default_rng(1)
+        model = Sequential([Conv2D(3, 8, 3, padding="SAME", groups=1)])
+        x = _rand(rng, 2, 8, 8, 3)
+        v = model.init(jax.random.PRNGKey(0), x)
+        y_ref, _ = model.apply(v, x)
+        wo_model, wo_vars = quantize(model, v, weight_only=True)
+        assert isinstance(wo_model.layers[0], WeightOnlyConv2D)
+        y_wo, _ = wo_model.apply(wo_vars, x)
+        err = np.abs(np.asarray(y_wo) - np.asarray(y_ref)).max()
+        assert err < 0.05 * np.abs(np.asarray(y_ref)).max()
+
+        tm = InferenceOptimizer.quantize(model, v, sample=x,
+                                         precision="int8_wo")
+        out = np.asarray(tm(x))
+        assert out.shape == np.asarray(y_ref).shape
