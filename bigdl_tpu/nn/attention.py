@@ -509,17 +509,22 @@ def _attn_project(p, x, w, b):
 
 
 def transformer_decode_cached(model, params, src, bos_id, eos_id,
-                              max_len=32):
+                              max_len=32, *, rng=None,
+                              temperature: float = 1.0, top_k: int = 0,
+                              top_p: float = 1.0):
     """Greedy decode with per-layer KV caches — O(L) attention per step
     (O(L²) total) instead of re-running the decoder over the whole prefix
     (O(L³) total).  The serving-path variant of :func:`transformer_decode`;
     numerics match the uncached path (asserted in tests).
 
+    ``rng`` switches to STOCHASTIC decoding (``nn.decode.sample_decode``):
+    temperature + top-k + nucleus top-p over the same cached step.
+
     Cache layout per decoder layer: self-attention K/V buffers
     (b, heads, max_len, head_dim) written at the current position each
     step; cross-attention K/V computed ONCE from the encoder memory.
     """
-    from bigdl_tpu.nn.decode import greedy_decode
+    from bigdl_tpu.nn.decode import greedy_decode, sample_decode
 
     if model.mode != "translation":
         raise ValueError("decode needs a translation-mode Transformer")
@@ -608,6 +613,11 @@ def transformer_decode_cached(model, params, src, bos_id, eos_id,
         return lp_out.astype(jnp.float32)[:, 0], \
             {"k": ks, "v": vs, "pos": state["pos"] + 1}
 
-    tokens, log_probs, _lengths = greedy_decode(
-        step_fn, init_state, b, bos_id, eos_id, max_len=max_len)
+    if rng is not None:
+        tokens, log_probs, _lengths = sample_decode(
+            step_fn, init_state, b, bos_id, eos_id, rng, max_len=max_len,
+            temperature=temperature, top_k=top_k, top_p=top_p)
+    else:
+        tokens, log_probs, _lengths = greedy_decode(
+            step_fn, init_state, b, bos_id, eos_id, max_len=max_len)
     return tokens, log_probs

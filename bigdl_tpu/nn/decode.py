@@ -124,3 +124,70 @@ def greedy_decode(step_fn, init_state: Any, batch_size: int,
     any_eos = jnp.any(is_eos, axis=-1)
     lengths = jnp.where(any_eos, jnp.argmax(is_eos, axis=-1) + 1, max_len)
     return tokens, logp, lengths
+
+
+def sample_decode(step_fn, init_state: Any, batch_size: int,
+                  bos_id: int, eos_id: int, rng, max_len: int = 32,
+                  temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0):
+    """Stochastic decode: temperature + top-k + top-p (nucleus) filtering,
+    one categorical draw per step (beyond the reference — its
+    SequenceBeamSearch has no sampling path; table stakes for LM serving).
+
+    All filters are static-shape jit-friendly: top-k masks below the k-th
+    logit via ``lax.top_k``; top-p masks tokens whose sorted cumulative
+    probability EXCLUDING themselves is already >= ``top_p`` (so the token
+    crossing the threshold stays includable, the standard nucleus rule).
+    ``temperature=0`` degrades to greedy argmax.
+
+    Returns (tokens (B, max_len+1), log_probs (B,), lengths (B,)) like
+    :func:`greedy_decode`; log_probs accumulate the UNfiltered
+    log-likelihood of the sampled tokens.
+    """
+    B = batch_size
+    tokens0 = jnp.full((B, max_len + 1), bos_id, jnp.int32)
+    logp0 = jnp.zeros((B,), jnp.float32)
+    fin0 = jnp.zeros((B,), bool)
+    greedy = temperature <= 0.0
+
+    def body(carry, inp):
+        t, key = inp
+        tokens, logp, finished, state = carry
+        logits, state = step_fn(tokens[:, t], state)
+        logits = logits.astype(jnp.float32)
+        lp_full = jax.nn.log_softmax(logits, axis=-1)
+        if greedy:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            z = logits / temperature
+            if top_k and top_k > 0:
+                kth = jax.lax.top_k(z, top_k)[0][:, -1:]
+                z = jnp.where(z < kth, -jnp.inf, z)
+            if top_p < 1.0:
+                zs = jnp.sort(z, axis=-1)[:, ::-1]             # desc
+                ps = jax.nn.softmax(zs, axis=-1)
+                # cumulative mass BEFORE each token (exclusive cumsum):
+                # once >= top_p, that token and everything after drop
+                prev_mass = jnp.cumsum(ps, axis=-1) - ps
+                keep_sorted = prev_mass < top_p
+                # min kept z value per row maps the sorted mask back
+                minz = jnp.min(jnp.where(keep_sorted, zs, jnp.inf),
+                               axis=-1, keepdims=True)
+                z = jnp.where(z < minz, -jnp.inf, z)
+            tok = jax.random.categorical(key, z, axis=-1).astype(jnp.int32)
+        tok = jnp.where(finished, eos_id, tok)
+        step_lp = jnp.where(finished, 0.0,
+                            jnp.take_along_axis(lp_full, tok[:, None],
+                                                axis=1)[:, 0])
+        tokens = tokens.at[:, t + 1].set(tok)
+        return (tokens, logp + step_lp, finished | (tok == eos_id),
+                state), None
+
+    keys = jax.random.split(rng, max_len)
+    (tokens, logp, _, _), _ = jax.lax.scan(
+        body, (tokens0, logp0, fin0, init_state),
+        (jnp.arange(max_len), keys))
+    is_eos = tokens[:, 1:] == eos_id
+    any_eos = jnp.any(is_eos, axis=-1)
+    lengths = jnp.where(any_eos, jnp.argmax(is_eos, axis=-1) + 1, max_len)
+    return tokens, logp, lengths

@@ -124,3 +124,103 @@ def test_beam_search_jits_and_state_reorders():
     # scores sorted descending
     s = np.asarray(res.scores)
     assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
+class TestSampleDecode:
+    def _uniformish(self):
+        # bos -> {2,3,4} with probs .5/.3/.2, symbols -> eos
+        tr = np.full((V, V), 1e-9, np.float32)
+        tr[BOS, 2], tr[BOS, 3], tr[BOS, 4] = 0.5, 0.3, 0.2
+        for s in (2, 3, 4):
+            tr[s, EOS] = 1.0
+        tr[EOS, EOS] = 1.0
+        return tr
+
+    def test_temperature_zero_is_greedy(self):
+        from bigdl_tpu.nn.decode import sample_decode
+
+        tr = self._uniformish()
+        g_tok, _, _ = greedy_decode(_markov_step(tr), {}, 3, BOS, EOS,
+                                    max_len=4)
+        s_tok, _, _ = sample_decode(_markov_step(tr), {}, 3, BOS, EOS,
+                                    jax.random.PRNGKey(0), max_len=4,
+                                    temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(g_tok), np.asarray(s_tok))
+
+    def test_top_k_one_is_greedy(self):
+        from bigdl_tpu.nn.decode import sample_decode
+
+        tr = self._uniformish()
+        g_tok, _, _ = greedy_decode(_markov_step(tr), {}, 2, BOS, EOS,
+                                    max_len=4)
+        s_tok, _, _ = sample_decode(_markov_step(tr), {}, 2, BOS, EOS,
+                                    jax.random.PRNGKey(1), max_len=4,
+                                    temperature=1.0, top_k=1)
+        np.testing.assert_array_equal(np.asarray(g_tok), np.asarray(s_tok))
+
+    def test_sampling_matches_distribution(self):
+        from bigdl_tpu.nn.decode import sample_decode
+
+        tr = self._uniformish()
+        counts = {2: 0, 3: 0, 4: 0}
+        toks, _, _ = sample_decode(_markov_step(tr), {}, 512, BOS, EOS,
+                                   jax.random.PRNGKey(2), max_len=2)
+        first = np.asarray(toks[:, 1])
+        for s in counts:
+            counts[s] = int((first == s).sum())
+        total = sum(counts.values())
+        assert total == 512
+        assert abs(counts[2] / total - 0.5) < 0.08
+        assert abs(counts[3] / total - 0.3) < 0.08
+
+    def test_top_p_excludes_the_tail(self):
+        from bigdl_tpu.nn.decode import sample_decode
+
+        tr = self._uniformish()
+        # nucleus .5: only token 2 (p=.5) is kept (prev_mass 0 < .5; next
+        # token's prev_mass .5 not < .5) -> deterministic choice of 2
+        toks, _, _ = sample_decode(_markov_step(tr), {}, 64, BOS, EOS,
+                                   jax.random.PRNGKey(3), max_len=2,
+                                   top_p=0.5)
+        assert set(np.asarray(toks[:, 1]).tolist()) == {2}
+
+    def test_same_key_is_deterministic_and_jittable(self):
+        from functools import partial
+
+        from bigdl_tpu.nn.decode import sample_decode
+
+        tr = self._uniformish()
+        fn = jax.jit(partial(sample_decode, _markov_step(tr), {}, 8, BOS,
+                             EOS, max_len=4, temperature=1.0, top_k=2))
+        a = fn(jax.random.PRNGKey(7))
+        b = fn(jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        # unfiltered log-likelihood accumulates (negative, finite)
+        assert np.isfinite(np.asarray(a[1])).all()
+
+
+def test_cached_transformer_sampling_path():
+    """transformer_decode_cached(rng=...) runs the stochastic decoder over
+    the KV-cached step; temperature->0 matches its own greedy path."""
+    from bigdl_tpu.nn.attention import Transformer, transformer_decode_cached
+
+    model = Transformer(vocab_size=12, hidden_size=16, num_heads=2,
+                        num_layers=1, dropout=0.0, mode="translation")
+    src = np.array([[0, 3, 4, 1]], np.int32)
+    v = model.init(jax.random.PRNGKey(0), jnp.asarray(src),
+                   jnp.asarray(src))
+    g_tok, _ = transformer_decode_cached(model, v["params"], src, 0, 1,
+                                         max_len=6)
+    s_tok, _ = transformer_decode_cached(model, v["params"], src, 0, 1,
+                                         max_len=6,
+                                         rng=jax.random.PRNGKey(1),
+                                         temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(g_tok), np.asarray(s_tok))
+    # stochastic run with high temperature still emits valid tokens
+    r_tok, _ = transformer_decode_cached(model, v["params"], src, 0, 1,
+                                         max_len=6,
+                                         rng=jax.random.PRNGKey(2),
+                                         temperature=2.0, top_k=5)
+    r = np.asarray(r_tok)
+    assert r.shape == np.asarray(g_tok).shape
+    assert ((0 <= r) & (r < 12)).all()
