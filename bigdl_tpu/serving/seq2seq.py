@@ -21,13 +21,20 @@ class Seq2SeqService:
     serves ``translate(src_batch)``.
 
     ``beam_size=0`` → KV-cached greedy (the fast path); ``>0`` → beam
-    search with GNMT length penalty (re-attends over the prefix)."""
+    search with GNMT length penalty (re-attends over the prefix);
+    ``temperature>0`` with ``sample=True`` → KV-cached stochastic decode
+    (temperature / top-k / nucleus top-p, fresh fold of ``seed`` per
+    request so repeated requests differ)."""
 
     BATCH_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
 
     def __init__(self, model, params, bos_id: int, eos_id: int,
                  max_len: int = 32, beam_size: int = 0,
-                 batch_buckets: Optional[Sequence[int]] = None):
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+        if sample and beam_size and beam_size > 1:
+            raise ValueError("sample=True and beam_size>1 are exclusive")
         if model.mode != "translation":
             raise ValueError("Seq2SeqService needs a translation-mode "
                              "Transformer")
@@ -37,6 +44,12 @@ class Seq2SeqService:
         self.max_len = max_len
         self.beam_size = beam_size
         self.buckets = tuple(batch_buckets or self.BATCH_BUCKETS)
+        self.sample = bool(sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self._seed = jax.random.PRNGKey(seed)
+        self._n_requests = 0
         self._cache = {}
 
     def _decode_fn(self, batch: int):
@@ -46,13 +59,20 @@ class Seq2SeqService:
                                                 transformer_decode_cached)
 
             if self.beam_size and self.beam_size > 1:
-                def run(params, src):
+                def run(params, src, rng):
                     toks, scores = transformer_decode(
                         self.model, params, src, self.bos_id, self.eos_id,
                         max_len=self.max_len, beam_size=self.beam_size)
                     return toks[:, 0], scores[:, 0]   # best beam
+            elif self.sample:
+                def run(params, src, rng):
+                    return transformer_decode_cached(
+                        self.model, params, src, self.bos_id, self.eos_id,
+                        max_len=self.max_len, rng=rng,
+                        temperature=self.temperature, top_k=self.top_k,
+                        top_p=self.top_p)
             else:
-                def run(params, src):
+                def run(params, src, rng):
                     return transformer_decode_cached(
                         self.model, params, src, self.bos_id, self.eos_id,
                         max_len=self.max_len)
@@ -76,5 +96,7 @@ class Seq2SeqService:
         if bucket > n:
             src = np.concatenate(
                 [src, np.repeat(src[-1:], bucket - n, axis=0)])
-        tokens, scores = self._decode_fn(bucket)(self.params, src)
+        self._n_requests += 1
+        rng = jax.random.fold_in(self._seed, self._n_requests)
+        tokens, scores = self._decode_fn(bucket)(self.params, src, rng)
         return np.asarray(tokens)[:n], np.asarray(scores)[:n]

@@ -203,3 +203,34 @@ def test_seq2seq_service_buckets_and_translates():
                           beam_size=3, batch_buckets=(4,))
     toks, _ = beam.translate(src[:4])
     assert (toks[:, 1:t + 1] == src[:4, ::-1]).mean() > 0.9
+
+
+def test_seq2seq_service_sampling_mode():
+    """sample=True serves stochastic decode; different requests draw
+    different tokens (per-request key fold), greedy stays deterministic."""
+    import jax
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.serving.seq2seq import Seq2SeqService
+
+    model = Transformer(vocab_size=16, hidden_size=16, num_heads=2,
+                        num_layers=1, dropout=0.0, mode="translation")
+    src = np.array([[0, 5, 6, 1]], np.int32)
+    v = model.init(jax.random.PRNGKey(0), src, src)
+
+    svc = Seq2SeqService(model, v["params"], bos_id=0, eos_id=1,
+                         max_len=8, sample=True, temperature=3.0)
+    outs = [svc.translate(src)[0] for _ in range(6)]
+    # high temperature on random weights: not every request identical
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+    greedy = Seq2SeqService(model, v["params"], bos_id=0, eos_id=1,
+                            max_len=8)
+    g1 = greedy.translate(src)[0]
+    g2 = greedy.translate(src)[0]
+    np.testing.assert_array_equal(g1, g2)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="exclusive"):
+        Seq2SeqService(model, v["params"], 0, 1, sample=True, beam_size=4)
