@@ -1,0 +1,162 @@
+"""LoRA — low-rank adaptation for parameter-efficient fine-tuning.
+
+Beyond the reference (BigDL predates PEFT): ``apply_lora`` wraps every
+selected ``Linear`` in a twin computing ``y = x W + (alpha/r) x A B``
+with the base weight FROZEN and only the (in, r)+(r, out) adapters
+trainable.  ``merge_lora`` folds trained adapters back into plain dense
+weights, so serving (incl. int8 quantization) sees an ordinary model.
+
+TPU notes: the adapter matmuls are two skinny MXU contractions XLA
+schedules alongside the frozen base matmul; freezing is expressed
+functionally — adapters live in a SEPARATE params subtree ("lora_a"/
+"lora_b" keys inside the wrapped leaf's params), so training loops can
+``jax.grad`` w.r.t. the adapter leaves only (``trainable_filter``).
+"""
+
+import copy
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn import layers as L
+from bigdl_tpu.nn.module import EMPTY, Container, Module
+from bigdl_tpu.tensor.policy import cast_compute
+
+__all__ = ["LoRALinear", "apply_lora", "merge_lora", "lora_filter"]
+
+
+class LoRALinear(Module):
+    """``Linear`` + trainable low-rank bypass; base weight/bias frozen."""
+
+    def __init__(self, inner: L.Linear, rank: int = 8, alpha: float = 16.0,
+                 name=None):
+        super().__init__(name or inner.name)
+        self.inner = inner
+        self.rank = rank
+        self.alpha = alpha
+
+    def init_adapters(self, rng, in_features: int) -> Dict[str, Any]:
+        # A ~ N(0, 1/r) fan-in style, B = 0: the bypass starts as identity
+        # (zero delta), the standard LoRA init
+        a = jax.random.normal(
+            rng, (in_features, self.rank), jnp.float32) / max(1, self.rank)
+        b = jnp.zeros((self.rank, self.inner.out_features), jnp.float32)
+        return {"lora_a": a, "lora_b": b}
+
+    def build(self, rng, x):
+        params, _ = self.inner.build(rng, x)
+        params.update(self.init_adapters(
+            jax.random.fold_in(rng, 1), x.shape[-1]))
+        return params, EMPTY
+
+    def forward(self, params, state, x, training=False, rng=None):
+        y, _ = self.inner.forward(
+            {k: v for k, v in params.items()
+             if k not in ("lora_a", "lora_b")}, EMPTY, x,
+            training=training, rng=rng)
+        xc, a, b = cast_compute(x, params["lora_a"], params["lora_b"])
+        delta = jnp.matmul(jnp.matmul(xc, a), b,
+                           preferred_element_type=jnp.float32)
+        scale = self.alpha / max(1, self.rank)
+        return (y.astype(jnp.float32) + scale * delta).astype(x.dtype), \
+            EMPTY
+
+
+def _walk(module, params, fn):
+    """Generic (module, params) rewriter over Containers + keras graphs."""
+    from bigdl_tpu.nn.quantized import _clone_keras, _is_keras_model
+
+    out = fn(module, params)
+    if out is not None:
+        return out
+    if _is_keras_model(module):
+        new_params = dict(params) if params else {}
+
+        def replace(lay, node_name):
+            p = (params or {}).get(node_name, {})
+            got = fn(lay, p)
+            if got is None:
+                return lay
+            new_lay, new_p = got
+            new_params[node_name] = new_p
+            return new_lay
+
+        new_model, _ = _clone_keras(
+            module, replace, match=lambda lay: fn(lay, None, probe=True))
+        return new_model, new_params
+    if isinstance(module, Container):
+        new = copy.copy(module)
+        new.layers = list(module.layers)
+        new_params = dict(params) if params else {}
+        for i, child in enumerate(module.layers):
+            k = module._key(i)
+            child_p = (params or {}).get(k, EMPTY)
+            new.layers[i], got_p = _walk(child, child_p, fn)
+            if new.layers[i] is not child:
+                new_params[k] = got_p
+        return new, new_params
+    return module, params
+
+
+def apply_lora(module: Module, variables: Dict[str, Any], rank: int = 8,
+               alpha: float = 16.0, rng=None,
+               match: Optional[Callable[[L.Linear], bool]] = None
+               ) -> Tuple[Module, Dict[str, Any]]:
+    """Wrap matching ``Linear`` leaves (default: all) with LoRA adapters.
+
+    Base params are reused verbatim (names preserved → container keys
+    unchanged); each wrapped leaf's params gain ``lora_a``/``lora_b``.
+    Train with ``lora_filter`` masking gradients to the adapters."""
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    counter = [0]
+
+    def fn(mod, p, probe=False):
+        if not isinstance(mod, L.Linear) or (match and not match(mod)):
+            return None if not probe else False
+        if probe:
+            return True
+        counter[0] += 1
+        wrapped = LoRALinear(mod, rank=rank, alpha=alpha)
+        in_features = p["weight"].shape[0]
+        new_p = dict(p)
+        new_p.update(wrapped.init_adapters(
+            jax.random.fold_in(rng, counter[0]), in_features))
+        return wrapped, new_p
+
+    new_mod, new_params = _walk(module, variables.get("params", EMPTY), fn)
+    return new_mod, {"params": new_params,
+                     "state": variables.get("state", EMPTY)}
+
+
+def lora_filter(params) -> Any:
+    """Boolean pytree: True on adapter leaves — multiply gradients by it
+    (or route through ``jax.tree_util.tree_map``) to freeze the base."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    mask = [any(getattr(k, "key", None) in ("lora_a", "lora_b")
+                for k in path) for path, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, mask)
+
+
+def merge_lora(module: Module, variables: Dict[str, Any]
+               ) -> Tuple[Module, Dict[str, Any]]:
+    """Fold trained adapters into the dense weights: ``W' = W +
+    (alpha/r) A B`` — returns plain ``Linear`` leaves (quantize/serve as
+    usual)."""
+
+    def fn(mod, p, probe=False):
+        if not isinstance(mod, LoRALinear):
+            return None if not probe else False
+        if probe:
+            return True
+        scale = mod.alpha / max(1, mod.rank)
+        new_p = {k: v for k, v in p.items()
+                 if k not in ("lora_a", "lora_b")}
+        new_p["weight"] = (jnp.asarray(p["weight"], jnp.float32)
+                           + scale * jnp.matmul(p["lora_a"], p["lora_b"])
+                           ).astype(p["weight"].dtype)
+        return mod.inner, new_p
+
+    new_mod, new_params = _walk(module, variables.get("params", EMPTY), fn)
+    return new_mod, {"params": new_params,
+                     "state": variables.get("state", EMPTY)}
