@@ -106,3 +106,27 @@ def test_match_predicate_selects_layers():
         match=lambda lin: lin.out_features == 2)  # only the head
     assert sum(isinstance(m, LoRALinear) for m in lmodel.layers) == 1
     assert isinstance(lmodel.layers[2], LoRALinear)
+
+
+def test_lora_on_converted_torch_model():
+    """PEFT the interop path: a stock torch MLP converts to a keras graph
+    whose Linear nodes LoRA can wrap (adapt a converted model without
+    touching its imported weights)."""
+    import torch
+
+    from bigdl_tpu.utils.torch_convert import from_torch_module
+
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4))
+    model, variables = from_torch_module(
+        tm, example_input=torch.zeros(1, 8))
+
+    lmodel, lvars = apply_lora(model, variables, rank=2)
+    n_wrapped = sum(isinstance(n.layer, LoRALinear)
+                    for n in getattr(lmodel, "order", []))
+    assert n_wrapped == 2, n_wrapped
+
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    y0, _ = model.apply(variables, jnp.asarray(x))
+    y1, _ = lmodel.apply(lvars, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-6)
