@@ -177,10 +177,15 @@ def _run_bench(platform: str) -> dict:
             pass
 
     # ---- MFU accounting ------------------------------------------------
+    # arg list mirrors train_step_device exactly (ema slot + mask scalar
+    # included — the earlier omission of ema made every cost-analysis
+    # attempt fail silently into the analytic fallback)
+    ema_in = step.ema_flat if step.ema_flat is not None else step._ema_dummy
     flops_per_step = _compiled_flops(
-        step, (step.flat_params, step.opt_state, step.model_state,
+        step, (step.flat_params, ema_in, step.opt_state, step.model_state,
                jnp.asarray(0, jnp.int32), rng,
-               step.shard_batch(x), step.shard_batch(y)))
+               step.shard_batch(x), step.shard_batch(y),
+               jnp.asarray(1.0, jnp.float32)))
     flops_source = "xla_cost_analysis"
     if flops_per_step is None:
         flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMAGE * x.shape[0] \

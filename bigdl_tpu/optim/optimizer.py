@@ -138,6 +138,7 @@ class Optimizer:
         self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
         self.remat_policy = None  # None|'nothing'|'dots' (keep MXU outputs)
+        self.trainable_mask = None  # bool pytree over params (LoRA/freeze)
         self.accum_steps = 1     # gradient-accumulation microbatches
         self.ema_decay = 0.0     # weight EMA (0 = off); read the result
         #                          via TrainedModel.ema_variables
@@ -258,6 +259,7 @@ class Optimizer:
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,
             remat_policy=self.remat_policy,
+            trainable_mask=self.trainable_mask,
             accum_steps=self.accum_steps, ema_decay=self.ema_decay,
             seq_parallel=self.seq_parallel)
         n_params = step_engine.n_real

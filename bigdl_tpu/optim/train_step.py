@@ -147,7 +147,7 @@ class ShardedParameterStep:
                  bf16_grads: bool = False, remat: bool = False,
                  remat_policy: Optional[str] = None,
                  accum_steps: int = 1, ema_decay: float = 0.0,
-                 seq_parallel: bool = False):
+                 seq_parallel: bool = False, trainable_mask=None):
         """``bf16_grads``: reduce-scatter the gradient vector in bfloat16 —
         halves the per-step collective bytes (the FP16CompressedTensor
         analog; worthwhile when the data axis spans DCN, unnecessary over
@@ -226,6 +226,28 @@ class ShardedParameterStep:
         self.n_real = flat.shape[0]
         self.n_pad = -(-self.n_real // self.ndev) * self.ndev
         self.shard_size = self.n_pad // self.ndev
+
+        # partial-training mask (LoRA / linear probe / freezing): a pytree
+        # matching params with bool leaves (per-leaf scalars, e.g.
+        # nn.lora.lora_filter, or per-element arrays).  Frozen entries get
+        # zero gradient (optimizer moments stay clean) AND are restored
+        # bitwise after the update (weight decay cannot drift them).
+        self._mask_flat = None
+        if trainable_mask is not None:
+            import numpy as _np
+
+            leaves_p = jax.tree_util.tree_leaves(init_variables["params"])
+            leaves_m = jax.tree_util.tree_leaves(trainable_mask)
+            if len(leaves_p) != len(leaves_m):
+                raise ValueError(
+                    "trainable_mask structure does not match params "
+                    f"({len(leaves_m)} leaves vs {len(leaves_p)})")
+            parts = [_np.broadcast_to(
+                _np.asarray(m, bool), _np.shape(p)).reshape(-1)
+                for p, m in zip(leaves_p, leaves_m)]
+            mask = _np.concatenate(parts).astype(_np.float32)
+            self._mask_flat = jnp.pad(jnp.asarray(mask),
+                                      (0, self.n_pad - self.n_real))
 
         self._rep = NamedSharding(mesh, P())
         self._sharded_vec = NamedSharding(mesh, P(AXIS_DATA))
@@ -306,7 +328,10 @@ class ShardedParameterStep:
         # grads) averages over
         stat_axes = batch_axes + ((AXIS_SEQ,) if seq_par else ())
 
-        def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y):
+        def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y,
+                       mask):
+            # mask: trainable-mask vector (n_pad,) — or the scalar 1.0
+            # when everything trains (broadcast no-op)
             params = unravel(flat_p[:n_real])
             replica = jax.lax.axis_index(AXIS_DATA)
             if dcn_axis:
@@ -365,6 +390,8 @@ class ShardedParameterStep:
                 # spans the data axes
                 flat_g = jax.lax.pmean(flat_g, AXIS_SEQ)
             flat_g = jnp.pad(flat_g, (0, flat_p.shape[0] - n_real))
+            # frozen entries: zero gradient (keeps optimizer moments clean)
+            flat_g = flat_g * mask.astype(flat_g.dtype)
             if bf16_grads:
                 flat_g = flat_g.astype(jnp.bfloat16)
 
@@ -392,8 +419,9 @@ class ShardedParameterStep:
             else:
                 # layerwise methods (LARS): plain psum allreduce + replicated
                 # update (matches the reference's treatment pre-slice-sharding)
-                if accum > 1 or seq_par:  # re-tree the flat gradient
-                    grads = unravel(flat_g[:n_real].astype(jnp.float32))
+                # re-tree the flat (masked) gradient so the trainable_mask
+                # reaches this path's optimizer update too
+                grads = unravel(flat_g[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, batch_axes), grads)
                 if clip is not None and clip.l2_norm is not None:
@@ -405,6 +433,9 @@ class ShardedParameterStep:
                 nf, _ = ravel_pytree(new_params)
                 new_flat = jnp.pad(nf, (0, flat_p.shape[0] - n_real))
 
+            # restore frozen entries bitwise: weight decay / bias-corrected
+            # moments must not drift parameters that carry no gradient
+            new_flat = jnp.where(mask > 0, new_flat, flat_p)
             loss = jax.lax.pmean(loss, stat_axes)
             new_mstate = jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, stat_axes)
@@ -422,7 +453,8 @@ class ShardedParameterStep:
             x_spec = y_spec = P(self._batch_axes)
         mapped = shard_map(
             step_shard, mesh=self.mesh,
-            in_specs=(P(), P(), opt_spec, P(), P(), P(), x_spec, y_spec),
+            in_specs=(P(), P(), opt_spec, P(), P(), P(), x_spec, y_spec,
+                      P()),
             out_specs=(P(), P(), opt_spec, P(), P()),
             check_vma=False,
         )
@@ -506,10 +538,12 @@ class ShardedParameterStep:
             self._train = self._build_train(x_dev, y_dev)
         ema_in = self.ema_flat if self.ema_flat is not None \
             else self._ema_dummy
+        mask_in = (self._mask_flat if self._mask_flat is not None
+                   else jnp.asarray(1.0, jnp.float32))
         (self.flat_params, new_ema, self.opt_state, self.model_state,
          loss) = self._train(
             self.flat_params, ema_in, self.opt_state, self.model_state,
-            jnp.asarray(step, jnp.int32), rng, x_dev, y_dev)
+            jnp.asarray(step, jnp.int32), rng, x_dev, y_dev, mask_in)
         if self.ema_flat is not None:
             self.ema_flat = new_ema
         else:
