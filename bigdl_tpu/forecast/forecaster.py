@@ -106,6 +106,47 @@ class BaseForecaster:
             x = np.asarray(data, np.float32)
         return np.asarray(self._trained.predict(x, batch_size))
 
+    # -- optimized inference (reference predict_with_onnx/_openvino +
+    # forecaster.quantize analogs, over the nano InferenceOptimizer) ------
+    def optimize_predict(self, precision: str = "bf16") -> "BaseForecaster":
+        """Select an optimized predict variant: ``"fp32" | "bf16" |
+        "int8" | "int8_wo"`` — the reference's ``predict_with_onnx`` /
+        ``quantize`` pairing, TPU-natively over the nano
+        InferenceOptimizer.  Tracing is per input shape (AOT artifacts
+        are shape-fixed), built lazily on first predict."""
+        self._check_fit()
+        if precision not in ("fp32", "bf16", "int8", "int8_wo"):
+            raise ValueError(
+                f"precision {precision!r}: fp32 | bf16 | int8 | int8_wo")
+        self._opt_precision = precision
+        self._opt_cache = {}
+        return self
+
+    def predict_with_optimized(self, data, batch_size: int = 0
+                               ) -> np.ndarray:
+        """Predict through the :meth:`optimize_predict` variant."""
+        precision = getattr(self, "_opt_precision", None)
+        if precision is None:
+            raise RuntimeError("call optimize_predict(precision) first")
+        if isinstance(data, TSDataset):
+            x, _ = data.to_numpy()
+        elif isinstance(data, (tuple, list)):
+            x = np.asarray(data[0], np.float32)
+        else:
+            x = np.asarray(data, np.float32)
+        tm = self._opt_cache.get(x.shape)
+        if tm is None:
+            from bigdl_tpu.nano.inference import InferenceOptimizer
+
+            v = self._trained.variables
+            if precision in ("fp32", "bf16"):
+                tm = InferenceOptimizer.trace(self.model, v, x, precision)
+            else:
+                tm = InferenceOptimizer.quantize(self.model, v, sample=x,
+                                                 precision=precision)
+            self._opt_cache[x.shape] = tm
+        return np.asarray(tm(x))
+
     def evaluate(self, data, metrics: Sequence[str] = ("mse",),
                  batch_size: int = 32) -> Dict[str, float]:
         self._check_fit()

@@ -257,3 +257,32 @@ def test_prophet_native_trend_and_seasonality():
 
     with pytest.raises(ValueError):
         ProphetForecaster().fit(np.arange(10.0))
+
+
+def test_forecaster_optimized_predict_variants():
+    """reference predict_with_onnx / forecaster.quantize analogs: traced
+    bf16 and weight-only int8 predict stay close to the plain path."""
+    from bigdl_tpu.forecast import TCNForecaster
+
+    ts = _tsdata()
+    x_all, y_all = ts.to_numpy()
+
+    f = TCNForecaster(past_seq_len=24, future_seq_len=4,
+                      input_feature_num=1, output_feature_num=1,
+                      num_channels=(8,))
+    f.fit((x_all, y_all), epochs=2, batch_size=64)
+    x = x_all[:8]
+    base = f.predict(x)
+
+    for prec in ("bf16", "int8_wo"):
+        out = f.optimize_predict(prec).predict_with_optimized(x)
+        assert out.shape == base.shape
+        denom = np.abs(base).max() + 1e-6
+        assert np.abs(out - base).max() / denom < 0.1, prec
+
+    import pytest
+
+    g = TCNForecaster(past_seq_len=24, future_seq_len=4,
+                      input_feature_num=1, output_feature_num=1)
+    with pytest.raises(RuntimeError):
+        g.predict_with_optimized(x)
