@@ -1,0 +1,66 @@
+"""Layer freezing — the keras-1 ``layer.trainable = False`` convention.
+
+Reference analog: keras-side layer freezing for transfer learning
+(Analytics-Zoo keras API lineage, ⚠ unverified — mount empty).  Here a
+module marked ``mod.trainable = False`` contributes a False region to a
+params-shaped bool pytree; the ZeRO-1 engine's ``trainable_mask`` then
+zeroes its gradients and restores its params bitwise every step
+(``optim/train_step.py``).  ``Optimizer`` applies this automatically when
+any frozen module is present.
+"""
+
+from typing import Any, Dict
+
+import jax
+
+from bigdl_tpu.nn.module import Container, Module
+
+__all__ = ["trainable_mask_for", "has_frozen"]
+
+
+def _is_keras_model(mod) -> bool:
+    from bigdl_tpu.nn.quantized import _is_keras_model as f
+
+    return f(mod)
+
+
+def _mask(mod: Module, params, frozen: bool):
+    frozen = frozen or (getattr(mod, "trainable", True) is False)
+    if _is_keras_model(mod):
+        out = {}
+        for node in mod.order:
+            if node.layer is None or node.name not in (params or {}):
+                continue
+            out[node.name] = _mask(node.layer, params[node.name], frozen)
+        # keras graphs may carry non-node params entries (none today);
+        # default them to trainable
+        for k in (params or {}):
+            out.setdefault(k, jax.tree_util.tree_map(
+                lambda _: not frozen, params[k]))
+        return out
+    if isinstance(mod, Container):
+        out = dict(params) if params else {}
+        for i, child in enumerate(mod.layers):
+            k = mod._key(i)
+            if k in out:
+                out[k] = _mask(child, out[k], frozen)
+        return out
+    return jax.tree_util.tree_map(lambda _: not frozen, params)
+
+
+def trainable_mask_for(module: Module, params) -> Any:
+    """Bool pytree matching ``params``: False under modules whose
+    ``trainable`` attribute is False (inherited by all descendants)."""
+    return _mask(module, params, False)
+
+
+def has_frozen(module: Module) -> bool:
+    """True if the module tree contains any ``trainable=False`` marker."""
+    if getattr(module, "trainable", True) is False:
+        return True
+    if _is_keras_model(module):
+        return any(node.layer is not None and has_frozen(node.layer)
+                   for node in module.order)
+    if isinstance(module, Container):
+        return any(has_frozen(c) for c in module.layers)
+    return False

@@ -255,6 +255,14 @@ class Optimizer:
             if isinstance(sx, tuple) else (np.asarray(sx[:1]),)
         init_vars = getattr(self, "_initial_variables", None) \
             or self.model.init(rng, *init_args)
+        if self.trainable_mask is None:
+            # keras-1 layer.trainable=False convention: derive the mask
+            # automatically when any module in the tree is frozen
+            from bigdl_tpu.nn.freeze import has_frozen, trainable_mask_for
+
+            if has_frozen(self.model):
+                self.trainable_mask = trainable_mask_for(
+                    self.model, init_vars["params"])
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,

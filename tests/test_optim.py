@@ -345,3 +345,43 @@ def test_precision_recall_methods():
     w = jnp.asarray([1.0, 1.0, 0.0, 1.0])
     s, c = p.batch_stats(out, tgt, w)
     assert (float(s), float(c)) == (1.0, 1.0)
+
+
+def test_layer_trainable_false_freezes_through_optimizer():
+    """keras-1 layer.trainable=False: the Optimizer auto-derives the
+    engine mask; frozen layer params stay bitwise fixed while the rest
+    train."""
+    import jax
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.criterion import MSECriterion
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.optim.trigger import Trigger
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 6).astype(np.float32)
+    y = rs.randn(64, 2).astype(np.float32)
+
+    frozen = nn.Linear(6, 16)
+    frozen.trainable = False
+    model = Sequential([frozen, nn.Tanh(), nn.Linear(16, 2)])
+
+    init_vars = model.init(jax.random.PRNGKey(0), x[:1])
+    init = jax.tree_util.tree_map(np.copy, init_vars["params"])
+    opt = (Optimizer(model, ArrayDataSet(x, y), MSECriterion(),
+                     batch_size=32)
+           .set_optim_method(SGD(learning_rate=0.1))
+           .set_end_when(Trigger.max_epoch(3)))
+    opt._initial_variables = init_vars  # pin the starting point
+    trained = opt.optimize()
+    params = trained.variables["params"]
+    k0 = model._key(0)
+    np.testing.assert_array_equal(np.asarray(params[k0]["weight"]),
+                                  np.asarray(init[k0]["weight"]))
+    # the head DID train
+    k2 = model._key(2)
+    assert np.abs(np.asarray(params[k2]["weight"])
+                  - np.asarray(init[k2]["weight"])).max() > 1e-4
