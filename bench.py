@@ -187,7 +187,11 @@ def _run_bench(platform: str) -> dict:
                step.shard_batch(x), step.shard_batch(y),
                jnp.asarray(1.0, jnp.float32)))
     flops_source = "xla_cost_analysis"
-    if flops_per_step is None:
+    if flops_per_step is not None:
+        # cost analysis sees the per-device SPMD module; this row's
+        # flops_per_step convention is GLOBAL per step
+        flops_per_step *= n_chips
+    else:
         flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMAGE * x.shape[0] \
             * (hw / 224.0) ** 2
         flops_source = "analytic_3x_fwd"

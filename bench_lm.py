@@ -74,7 +74,11 @@ def main():
     rng = jax.random.PRNGKey(0)
     n_params = None
 
-    last_build = {}  # most recent (step, rng, ids, tgt) keyed by batch
+    # per-batch lowering handles for cost analysis: the jitted step plus
+    # ShapeDtypeStructs of its args — keeps NO device buffers alive, and
+    # every sweep point stays analyzable even when the best batch is not
+    # the last one measured
+    last_build = {}
 
     def measure(batch_per_chip):
         nonlocal n_params
@@ -91,10 +95,21 @@ def main():
                                    variables["params"])))
         step = ShardedParameterStep(model, crit, Adam(learning_rate=1e-4),
                                     mesh, variables)
-        last_build.clear()
-        last_build[batch_per_chip] = (step, ids, tgt)
         x_dev = step.shard_batch(ids)
         y_dev = step.shard_batch(tgt)
+
+        def sds(t):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.asarray(a).dtype), t)
+
+        ema_in = step.ema_flat if step.ema_flat is not None \
+            else step._ema_dummy
+        last_build[batch_per_chip] = (step._train, (
+            sds(step.flat_params), sds(ema_in), sds(step.opt_state),
+            sds(step.model_state), sds(jnp.asarray(0, jnp.int32)),
+            sds(rng), sds(x_dev), sds(y_dev),
+            sds(jnp.asarray(1.0, jnp.float32))))
         loss = step.train_step_device(0, rng, x_dev, y_dev)
         float(np.asarray(loss))  # block on the warm-up VALUE
         t0 = time.perf_counter()
@@ -130,18 +145,12 @@ def main():
     # prefer XLA's own cost analysis of the compiled step (exact,
     # includes the attention/vocab matmuls as lowered)
     try:
-        from bench import _compiled_flops
-
-        step2, ids2, tgt2 = last_build[b]  # only if best == last build
-        f = _compiled_flops(step2, (
-            step2.flat_params,
-            step2.ema_flat if step2.ema_flat is not None
-            else step2._ema_dummy,
-            step2.opt_state, step2.model_state,
-            jnp.asarray(0, jnp.int32), rng,
-            step2.shard_batch(ids2), step2.shard_batch(tgt2),
-            jnp.asarray(1.0, jnp.float32)))
-        if f:
+        train_fn, abstract_args = last_build[b]
+        cost = train_fn.lower(*abstract_args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        f = float(cost.get("flops", -1))
+        if f > 0:
             # cost analysis sees the per-device SPMD module: divide by
             # PER-DEVICE tokens (b is already batch-per-chip)
             fpt = f / (b * S)

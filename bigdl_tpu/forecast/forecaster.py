@@ -94,17 +94,22 @@ class BaseForecaster:
             opt.set_validation(Trigger.every_epoch(),
                                DataSet.array(vx, vy), [MSE()])
         self._trained = opt.optimize()
+        self._opt_cache = {}  # weights changed: optimized traces are stale
         return self
+
+    @staticmethod
+    def _coerce_x(data) -> np.ndarray:
+        if isinstance(data, TSDataset):
+            x, _ = data.to_numpy()
+            return x
+        if isinstance(data, (tuple, list)):
+            return np.asarray(data[0], np.float32)
+        return np.asarray(data, np.float32)
 
     def predict(self, data, batch_size: int = 0) -> np.ndarray:
         self._check_fit()
-        if isinstance(data, TSDataset):
-            x, _ = data.to_numpy()
-        elif isinstance(data, (tuple, list)):
-            x = np.asarray(data[0], np.float32)
-        else:
-            x = np.asarray(data, np.float32)
-        return np.asarray(self._trained.predict(x, batch_size))
+        return np.asarray(self._trained.predict(self._coerce_x(data),
+                                                batch_size))
 
     # -- optimized inference (reference predict_with_onnx/_openvino +
     # forecaster.quantize analogs, over the nano InferenceOptimizer) ------
@@ -122,18 +127,14 @@ class BaseForecaster:
         self._opt_cache = {}
         return self
 
-    def predict_with_optimized(self, data, batch_size: int = 0
-                               ) -> np.ndarray:
-        """Predict through the :meth:`optimize_predict` variant."""
+    def predict_with_optimized(self, data) -> np.ndarray:
+        """Predict through the :meth:`optimize_predict` variant.  Traces
+        are per input shape; keep request batch shapes stable (bucket
+        upstream) to reuse compiled programs."""
         precision = getattr(self, "_opt_precision", None)
         if precision is None:
             raise RuntimeError("call optimize_predict(precision) first")
-        if isinstance(data, TSDataset):
-            x, _ = data.to_numpy()
-        elif isinstance(data, (tuple, list)):
-            x = np.asarray(data[0], np.float32)
-        else:
-            x = np.asarray(data, np.float32)
+        x = self._coerce_x(data)
         tm = self._opt_cache.get(x.shape)
         if tm is None:
             from bigdl_tpu.nano.inference import InferenceOptimizer
@@ -180,6 +181,7 @@ class BaseForecaster:
         opt.set_end_when(Trigger.max_iteration(0))
         self._trained = opt.optimize()
         self._trained.set_variables(variables)
+        self._opt_cache = {}  # weights changed: optimized traces are stale
 
     def _check_fit(self):
         if self._trained is None:

@@ -392,6 +392,10 @@ class ShardedParameterStep:
             flat_g = jnp.pad(flat_g, (0, flat_p.shape[0] - n_real))
             # frozen entries: zero gradient (keeps optimizer moments clean)
             flat_g = flat_g * mask.astype(flat_g.dtype)
+            # the layerwise path re-trees from the PRE-cast vector so
+            # bf16_grads (an elementwise reduce-scatter bandwidth knob)
+            # never costs it mantissa
+            flat_g_f32 = flat_g
             if bf16_grads:
                 flat_g = flat_g.astype(jnp.bfloat16)
 
@@ -421,7 +425,7 @@ class ShardedParameterStep:
                 # update (matches the reference's treatment pre-slice-sharding)
                 # re-tree the flat (masked) gradient so the trainable_mask
                 # reaches this path's optimizer update too
-                grads = unravel(flat_g[:n_real].astype(jnp.float32))
+                grads = unravel(flat_g_f32[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, batch_axes), grads)
                 if clip is not None and clip.l2_norm is not None:
