@@ -51,6 +51,38 @@ class Trigger:
                        f"max_score({v})")
 
     @staticmethod
+    def plateau(monitor: str = "score", patience: int = 3,
+                min_delta: float = 0.0) -> "Trigger":
+        """Early stopping: fire when ``monitor`` ("score": higher-better
+        validation score; "loss": lower-better) has not improved by
+        ``min_delta`` for ``patience`` consecutive observations.  The
+        keras-EarlyStopping analog expressed as an end-when trigger
+        (stateful: one instance tracks one run)."""
+        higher_better = monitor != "loss"
+        best = [None]
+        stale = [0]
+
+        def fn(s):
+            v = s.get(monitor)
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                return False
+            if v != v or v in (float("inf"), float("-inf")):
+                return False
+            improved = (best[0] is None
+                        or (v > best[0] + min_delta if higher_better
+                            else v < best[0] - min_delta))
+            if improved:
+                best[0] = v
+                stale[0] = 0
+            else:
+                stale[0] += 1
+            return stale[0] >= patience
+
+        return Trigger(fn, f"plateau({monitor}, patience={patience})")
+
+    @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
         return Trigger(lambda s: all(t(s) for t in triggers), "and")
 

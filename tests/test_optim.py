@@ -385,3 +385,24 @@ def test_layer_trainable_false_freezes_through_optimizer():
     k2 = model._key(2)
     assert np.abs(np.asarray(params[k2]["weight"])
                   - np.asarray(init[k2]["weight"])).max() > 1e-4
+
+
+def test_plateau_trigger_early_stops():
+    """keras-EarlyStopping analog: fires after `patience` observations
+    without improvement; resets staleness on improvement; ignores NaN."""
+    from bigdl_tpu.optim.trigger import Trigger
+
+    t = Trigger.plateau(monitor="loss", patience=2, min_delta=0.01)
+    seq = [1.0, 0.8, 0.795, 0.796]          # two non-improvements -> fire
+    fired = [t({"loss": v}) for v in seq]
+    assert fired == [False, False, False, True]
+
+    t2 = Trigger.plateau(monitor="loss", patience=2, min_delta=0.01)
+    # improvement in between resets the counter
+    fired2 = [t2({"loss": v}) for v in [1.0, 0.99, 0.5, 0.499, 0.498]]
+    assert fired2 == [False, False, False, False, True]
+
+    t3 = Trigger.plateau(monitor="score", patience=1)
+    assert t3({"score": float("nan")}) is False
+    assert t3({"score": 0.5}) is False       # first observation: baseline
+    assert t3({"score": 0.5}) is True        # no improvement, patience 1
