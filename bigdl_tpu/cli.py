@@ -205,7 +205,8 @@ def _doctor() -> int:
 
     from bigdl_tpu.native import lib as nat
 
-    report["native_lib"] = {"available": nat.available()}
+    report["native_lib"] = {"available": nat.available(),
+                            "jpeg": nat.jpeg_available()}
     backend = report.get("backend", {})
     if os.environ.get("BIGDL_TPU_NUM_PROCESSES"):
         # the probe runs without the rendezvous, so process count comes
