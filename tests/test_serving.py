@@ -234,3 +234,25 @@ def test_seq2seq_service_sampling_mode():
 
     with pytest.raises(ValueError, match="exclusive"):
         Seq2SeqService(model, v["params"], 0, 1, sample=True, beam_size=4)
+
+
+def test_serving_quantized_model_end_to_end():
+    """Weight-only int8 model through the dynamic-batch serving engine —
+    the quantize-then-serve path users actually deploy."""
+    from bigdl_tpu.nn.quantized import quantize
+
+    model, v = _model_and_vars()
+    q_model, q_vars = quantize(model, v, weight_only=True)
+    server = ServingServer(InferenceModel(q_model, q_vars),
+                           ServingConfig(batch_size=8)).start()
+    try:
+        x = np.random.RandomState(2).rand(5, 4).astype(np.float32)
+        rid = server.enqueue(x)
+        out = server.query(rid, timeout=30)
+        ref, _ = model.apply(v, x)
+        # int8 weights: close to the fp32 model, identical shape
+        assert out.shape == np.asarray(ref).shape
+        denom = np.abs(np.asarray(ref)).max() + 1e-6
+        assert np.abs(out - np.asarray(ref)).max() / denom < 0.05
+    finally:
+        server.stop()
