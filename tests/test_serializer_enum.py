@@ -139,3 +139,34 @@ def test_shape_mismatch_rejected(tmp_path):
     x5 = RS.rand(2, 5).astype(np.float32)
     with pytest.raises((ValueError, KeyError)):
         load_model(str(tmp_path / "m"), template=other.init(RNG, x5))
+
+
+def test_weight_only_linear_roundtrip(tmp_path):
+    from bigdl_tpu.nn.quantized import WeightOnlyLinear
+
+    x = RS.rand(3, 6).astype(np.float32)
+    lin = nn.Linear(6, 4)
+    v = lin.init(RNG, x)
+    q, qp = WeightOnlyLinear.from_linear(lin, v["params"])
+    y0, _ = q.forward(qp, {}, x)
+    save_model(str(tmp_path / "wo"), q, {"params": qp})
+    loaded = load_model(str(tmp_path / "wo"), template={"params": qp})
+    assert loaded["params"]["weight_q"].dtype == np.int8 or \
+        str(loaded["params"]["weight_q"].dtype) == "int8"
+    y1, _ = q.forward(loaded["params"], {}, x)
+    np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+
+
+def test_lora_variables_roundtrip(tmp_path):
+    from bigdl_tpu.nn.lora import apply_lora
+    from bigdl_tpu.nn.module import Sequential
+
+    x = RS.rand(4, 6).astype(np.float32)
+    model = Sequential([nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 2)])
+    v = model.init(RNG, x)
+    lmodel, lvars = apply_lora(model, v, rank=2)
+    y0, _ = lmodel.apply(lvars, x)
+    save_model(str(tmp_path / "lora"), lmodel, lvars)
+    loaded = load_model(str(tmp_path / "lora"), template=lvars)
+    y1, _ = lmodel.apply(loaded, x)
+    np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
