@@ -46,12 +46,15 @@ class TestInferenceOptimizer:
     def test_optimize_picks_best(self):
         model, variables, x = _model_and_vars()
         res = InferenceOptimizer.optimize(
-            model, variables, x, methods=("fp32", "bf16", "int8"),
-            repeats=3)
+            model, variables, x,
+            methods=("fp32", "bf16", "int8", "int8_wo"), repeats=3)
         best, name = res.get_best_model()
-        assert name in ("fp32", "bf16", "int8")
+        assert name in ("fp32", "bf16", "int8", "int8_wo")
         assert np.asarray(best(x)).shape == (8, 4)
         assert "latency" in res.summary()
+        # the weight-only variant ran (not a 'failed' row)
+        assert "int8_wo" in res.results
+        assert res.results["int8_wo"]["status"] == "ok"
 
     def test_accuracy_gate(self):
         model, variables, x = _model_and_vars()
