@@ -525,6 +525,9 @@ class Optimizer:
                                              state["iteration"])
         if results:
             state["score"] = results[0].result
+            # observation counter for event-cadenced triggers
+            # (Trigger.plateau counts validation events, not iterations)
+            state["n_validations"] = state.get("n_validations", 0) + 1
             # reduce-on-plateau feedback (reference SGD.Plateau): the
             # schedule decides host-side; an LR change needs a recompile
             schedule = getattr(self.optim_method, "schedule", None)

@@ -54,15 +54,28 @@ class Trigger:
     def plateau(monitor: str = "score", patience: int = 3,
                 min_delta: float = 0.0) -> "Trigger":
         """Early stopping: fire when ``monitor`` ("score": higher-better
-        validation score; "loss": lower-better) has not improved by
-        ``min_delta`` for ``patience`` consecutive observations.  The
-        keras-EarlyStopping analog expressed as an end-when trigger
-        (stateful: one instance tracks one run)."""
-        higher_better = monitor != "loss"
+        validation score, observed once per VALIDATION EVENT; "loss":
+        lower-better training loss, observed once per EPOCH) has not
+        improved by ``min_delta`` for ``patience`` consecutive
+        observations.  The keras-EarlyStopping analog as an end-when
+        trigger (stateful: one instance tracks one run).  end_when runs
+        every iteration, so observations are gated on the event counter —
+        re-seeing the same score between validations does not burn
+        patience."""
+        if monitor not in ("score", "loss"):
+            raise ValueError(
+                f"plateau monitor {monitor!r}: 'score' (validation, "
+                "higher-better) or 'loss' (training, lower-better)")
+        higher_better = monitor == "score"
+        event_key = "n_validations" if monitor == "score" else "epoch"
         best = [None]
         stale = [0]
+        last_event = [None]
 
         def fn(s):
+            event = s.get(event_key)
+            if event is None or event == last_event[0]:
+                return stale[0] >= patience  # no NEW observation
             v = s.get(monitor)
             try:
                 v = float(v)
@@ -70,6 +83,7 @@ class Trigger:
                 return False
             if v != v or v in (float("inf"), float("-inf")):
                 return False
+            last_event[0] = event
             improved = (best[0] is None
                         or (v > best[0] + min_delta if higher_better
                             else v < best[0] - min_delta))

@@ -388,21 +388,36 @@ def test_layer_trainable_false_freezes_through_optimizer():
 
 
 def test_plateau_trigger_early_stops():
-    """keras-EarlyStopping analog: fires after `patience` observations
-    without improvement; resets staleness on improvement; ignores NaN."""
+    """keras-EarlyStopping analog: observes once per validation event (or
+    epoch for loss), fires after `patience` stale observations, resets on
+    improvement, ignores NaN, and re-seeing the same score between events
+    does NOT burn patience."""
+    import pytest
+
     from bigdl_tpu.optim.trigger import Trigger
 
     t = Trigger.plateau(monitor="loss", patience=2, min_delta=0.01)
     seq = [1.0, 0.8, 0.795, 0.796]          # two non-improvements -> fire
-    fired = [t({"loss": v}) for v in seq]
+    fired = [t({"loss": v, "epoch": i}) for i, v in enumerate(seq)]
     assert fired == [False, False, False, True]
 
     t2 = Trigger.plateau(monitor="loss", patience=2, min_delta=0.01)
-    # improvement in between resets the counter
-    fired2 = [t2({"loss": v}) for v in [1.0, 0.99, 0.5, 0.499, 0.498]]
+    fired2 = [t2({"loss": v, "epoch": i}) for i, v in
+              enumerate([1.0, 0.99, 0.5, 0.499, 0.498])]
     assert fired2 == [False, False, False, False, True]
 
     t3 = Trigger.plateau(monitor="score", patience=1)
-    assert t3({"score": float("nan")}) is False
-    assert t3({"score": 0.5}) is False       # first observation: baseline
-    assert t3({"score": 0.5}) is True        # no improvement, patience 1
+    assert t3({"score": float("nan"), "n_validations": 1}) is False
+    assert t3({"score": 0.5, "n_validations": 2}) is False  # baseline
+    # SAME event re-seen on later iterations: patience not burned
+    assert t3({"score": 0.5, "n_validations": 2}) is False
+    assert t3({"score": 0.5, "n_validations": 2}) is False
+    # next validation event with no improvement -> fire (patience 1)
+    assert t3({"score": 0.5, "n_validations": 3}) is True
+
+    # no validation ever run: trigger stays inert, never fires
+    t4 = Trigger.plateau(monitor="score", patience=1)
+    assert t4({"loss": 1.0}) is False
+
+    with pytest.raises(ValueError, match="plateau monitor"):
+        Trigger.plateau(monitor="val_loss")
