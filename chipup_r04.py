@@ -4,12 +4,13 @@ Loops probing the tunneled chip (evidence lines into BENCH_attempts.jsonl,
 same trail as bench_watch).  On the first successful probe it runs, in
 order, each in its own subprocess so one hang cannot sink the rest:
 
-1. ``bench_probe.py``      -> PROBE_r04.json       (step-time breakdown)
-2. ``bench.py`` (sweep)    -> candidate bench row  (merged into
+1. ``bench.py`` (sweep)    -> candidate bench row  (merged into
    BENCH_r04.json only if it beats the current non-suspect value — the
-   same upgrade-only gate as bench_watch)
-3. ``bench_lm.py``         -> BENCH_LM_r04.json    (transformer LM
+   same upgrade-only gate as bench_watch; most valuable artifact first
+   in case the window is short)
+2. ``bench_lm.py``         -> BENCH_LM_r04.json    (transformer LM
    tokens/sec/chip, the second headline)
+3. ``bench_probe.py``      -> PROBE_r04.json       (step-time breakdown)
 4. ``kernels_selfcheck.py``-> KERNELS_r04.json     (refreshed with the
    amortized chain timings; only overwritten when all_ok)
 
@@ -102,10 +103,6 @@ def main():
         _log({"kind": "probe", "ok": ok,
               **({"result": info} if ok else {"error": info})})
         if ok and not sequence_done:
-            rc, out, err = _run([sys.executable, "bench_probe.py"], 1500)
-            _log({"kind": "probe_breakdown", "ok": rc == 0,
-                  **({} if rc == 0 else {"error": (err or out)[-300:]})})
-
             rc, out, err = _run(
                 [sys.executable, "bench.py"], 3600,
                 env={"BENCH_SWEEP": "1", "BENCH_TPU_TIMEOUT": "3000",
@@ -134,6 +131,10 @@ def main():
             else:
                 _log({"kind": "bench_lm", "ok": False,
                       "error": (err or out)[-300:]})
+
+            rc, out, err = _run([sys.executable, "bench_probe.py"], 1500)
+            _log({"kind": "probe_breakdown", "ok": rc == 0,
+                  **({} if rc == 0 else {"error": (err or out)[-300:]})})
 
             rc, out, err = _run(
                 [sys.executable, "kernels_selfcheck.py",
