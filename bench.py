@@ -187,6 +187,9 @@ def _run_bench(platform: str) -> dict:
                step.shard_batch(x), step.shard_batch(y),
                jnp.asarray(1.0, jnp.float32)))
     flops_source = "xla_cost_analysis"
+    flops_convention = ("compiled-program flops (counts layout/padding "
+                        "math, e.g. the s2d stem's zero positions) — an "
+                        "upper bound on model flops")
     if flops_per_step is not None:
         # cost analysis sees the per-device SPMD module; this row's
         # flops_per_step convention is GLOBAL per step
@@ -195,6 +198,7 @@ def _run_bench(platform: str) -> dict:
         flops_per_step = _RESNET50_TRAIN_FLOPS_PER_IMAGE * x.shape[0] \
             * (hw / 224.0) ** 2
         flops_source = "analytic_3x_fwd"
+        flops_convention = "model flops (standard-stem ResNet-50 math)"
     peak = _peak_flops(devices[0].device_kind) if on_tpu else None
     achieved = flops_per_step / step_time / n_chips
     mfu = round(achieved / peak, 4) if peak else None
@@ -217,6 +221,7 @@ def _run_bench(platform: str) -> dict:
         "img_per_sec_chip_hostfed": round(img_per_sec_hostfed, 2),
         "flops_per_step": flops_per_step,
         "flops_source": flops_source,
+        "flops_convention": flops_convention,
         "achieved_flops_per_chip": round(achieved, 2),
         "peak_bf16_flops": peak,
         "mfu": mfu,
