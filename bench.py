@@ -57,6 +57,16 @@ _PEAK_BF16 = [
 _RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.09e9
 
 
+def is_good_row(row) -> bool:
+    """ONE definition of 'a trustworthy bench row' (shared with
+    chipup_r04.py): not suspect, no error, and a sane MFU."""
+    try:
+        return (not row.get("suspect") and "error" not in row
+                and bool(row.get("mfu")) and 0 < row["mfu"] <= 1)
+    except Exception:
+        return False
+
+
 def _peak_flops(device_kind: str):
     kind = (device_kind or "").lower()
     for key, peak in _PEAK_BF16:
@@ -351,8 +361,7 @@ def main():
         try:
             with open(snap_path) as f:
                 snap = json.load(f)
-            good = (not snap.get("suspect") and "error" not in snap
-                    and snap.get("mfu") and 0 < snap["mfu"] <= 1)
+            good = is_good_row(snap)
         except Exception:
             snap, good = None, False
         if good:

@@ -74,7 +74,12 @@ class Trigger:
 
         def fn(s):
             event = s.get(event_key)
-            if event is None or event == last_event[0]:
+            # strictly monotonic: a failure-retry resume rolls the driver
+            # state back and REPLAYS events — re-observing them would burn
+            # patience twice and fire early.  Skipping replays only delays
+            # the stop (conservative).
+            if event is None or (last_event[0] is not None
+                                 and event <= last_event[0]):
                 return stale[0] >= patience  # no NEW observation
             v = s.get(monitor)
             try:

@@ -74,13 +74,15 @@ def _merge_bench(stdout):
     except Exception as e:
         _log({"kind": "bench", "ok": False, "error": f"unparseable: {e}"})
         return
-    bad = row.get("suspect") or "error" in row or row.get("mfu") in (None, 0)
+    from bench import is_good_row
+
+    bad = not is_good_row(row)
     prev_value = None
     if os.path.exists(SNAPSHOT):
         try:
             with open(SNAPSHOT) as f:
                 prev = json.load(f)
-            if not prev.get("suspect") and "error" not in prev:
+            if is_good_row(prev):
                 prev_value = prev.get("value")
         except Exception:
             pass

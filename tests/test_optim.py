@@ -419,5 +419,16 @@ def test_plateau_trigger_early_stops():
     t4 = Trigger.plateau(monitor="score", patience=1)
     assert t4({"loss": 1.0}) is False
 
+    # failure-retry rollback REPLAYS events: replayed (<= last seen)
+    # observations must not burn patience a second time
+    t5 = Trigger.plateau(monitor="score", patience=2)
+    assert t5({"score": 0.9, "n_validations": 1}) is False  # baseline
+    assert t5({"score": 0.9, "n_validations": 2}) is False  # stale 1
+    # rollback to event 1 and replay: skipped, stale stays 1
+    assert t5({"score": 0.9, "n_validations": 1}) is False
+    assert t5({"score": 0.9, "n_validations": 2}) is False
+    # a genuinely NEW event with no improvement -> stale 2 -> fire
+    assert t5({"score": 0.9, "n_validations": 3}) is True
+
     with pytest.raises(ValueError, match="plateau monitor"):
         Trigger.plateau(monitor="val_loss")
