@@ -32,9 +32,10 @@ bench-scaling:
 bench-loader:
 	python bench_loader.py
 
-# session-long TPU availability watcher (BENCH_attempts.jsonl evidence)
+# session-long TPU evidence orchestrator (single instance via flock;
+# BENCH_attempts.jsonl evidence trail)
 watch:
-	nohup python bench_watch.py > bench_watch.log 2>&1 &
+	nohup python chipup.py >> chipup.log 2>&1 &
 
 # every example end-to-end at tiny sizes (the reference's nightly example
 # runs, SURVEY.md §5, scaled for CI); fails on the first broken example

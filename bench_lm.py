@@ -11,8 +11,8 @@ Model: GPT-2-small-class decoder-only LM — 12 layers, d=768, 12 heads,
 ffn 3072, vocab 32k, seq 1024, weight-tied output projection
 (`nn/attention.py` Transformer(mode="lm")).
 
-Prints ONE JSON line; run by `chipup_r04.py` on chip-up, snapshot goes to
-`BENCH_LM_r04.json`.  On CPU it runs a tiny smoke so the harness is
+Prints ONE JSON line; run by `chipup.py` on chip-up, snapshot goes to
+`BENCH_LM_r05.json`.  On CPU it runs a tiny smoke so the harness is
 testable without the chip (BENCH_LM_TINY=1 forces it).
 """
 

@@ -2,7 +2,7 @@
 
 Times, on the real chip at the bench batch size: forward-only inference,
 forward+backward gradients, and the full ShardedParameterStep, plus optional
-ablations (no-BN model, alternate batch). Writes PROBE_r04.json.
+ablations (no-BN model, alternate batch). Writes PROBE_r05.json.
 
 Usage: python bench_probe.py [--batch 768] [--steps 8]
 """
@@ -141,8 +141,10 @@ def main():
     report["phases"]["full_step"] = rec
     print("full_step", json.dumps(rec), flush=True)
 
-    with open("PROBE_r04.json", "w") as f:
+    # atomic: a timeout-kill mid-dump must not leave a truncated artifact
+    with open("PROBE_r05.json.tmp", "w") as f:
         json.dump(report, f, indent=1)
+    os.replace("PROBE_r05.json.tmp", "PROBE_r05.json")
     print(json.dumps({"ok": True}))
 
 

@@ -108,7 +108,7 @@ def main(argv=None) -> int:
     sub.add_parser("bench", help="run the repo benchmark (bench.py)")
     sub.add_parser("dryrun", help="8-virtual-device multichip dry run")
     sub.add_parser("watch", help="session-long TPU availability watcher "
-                   "(bench_watch.py; logs BENCH_attempts.jsonl)")
+                   "(chipup.py; logs BENCH_attempts.jsonl)")
 
     serve = sub.add_parser(
         "serve", help="multi-worker serving pool: N process-isolated "
@@ -151,7 +151,7 @@ def main(argv=None) -> int:
         return _pack(args)
     if args.cmd == "watch":
         return subprocess.call([sys.executable,
-                                os.path.join(repo, "bench_watch.py")])
+                                os.path.join(repo, "chipup.py")])
     return 2
 
 
@@ -177,8 +177,11 @@ def _doctor() -> int:
         "print(json.dumps({'platform': ds[0].platform,"
         " 'device_kind': ds[0].device_kind, 'n_devices': len(ds),"
         " 'slices': len({getattr(d, 'slice_index', 0) for d in ds})}))\n")
-    # same override knob as bench_watch's probe (slow tunnels)
-    timeout = float(os.environ.get("BENCH_WATCH_PROBE_TIMEOUT", "150"))
+    # same override knob as chipup's probe (slow tunnels); the legacy
+    # BENCH_WATCH_PROBE_TIMEOUT name still works as a fallback
+    timeout = float(os.environ.get(
+        "CHIPUP_PROBE_TIMEOUT",
+        os.environ.get("BENCH_WATCH_PROBE_TIMEOUT", "150")))
     try:
         proc = subprocess.run([sys.executable, "-c", probe_src],
                               capture_output=True, text=True,

@@ -307,14 +307,19 @@ class BatchNorm(Module):
     def forward(self, params, state, x, training=False, rng=None):
         axes = tuple(range(x.ndim - 1))
         if training:
-            # single-pass stats: E[x] and E[x^2] reduce in ONE read of the
-            # activation (XLA fuses sibling reductions); jnp.var's two-pass
+            # single-pass stats: two sibling reductions in ONE read of the
+            # activation (XLA fuses them); jnp.var's two-pass
             # mean((x-mean)^2) reads the (often huge, bf16) activation twice.
-            # Accumulate in f32 — E[x^2]-mean^2 cancellation needs it.
+            # Shifted by the running mean so E[d^2]-E[d]^2 cancellation is
+            # benign even when |mean| >> std (unnormalized inputs): with
+            # shift ~ mean, E[d] ~ 0 and the subtraction loses no bits.
             xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=axes)
+            shift = state["running_mean"].astype(jnp.float32)
+            d = xf - shift
+            dmean = jnp.mean(d, axis=axes)
             var = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
+                jnp.mean(jnp.square(d), axis=axes) - jnp.square(dmean), 0.0)
+            mean = dmean + shift
             m = self.momentum
             new_state = {
                 "running_mean": (1 - m) * state["running_mean"] + m * mean,

@@ -9,6 +9,7 @@ bucket's program is the whole autoregressive loop (one ``lax.scan`` — KV
 caches inside, nothing host-side per token).
 """
 
+import itertools
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -49,7 +50,9 @@ class Seq2SeqService:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self._seed = jax.random.PRNGKey(seed)
-        self._n_requests = 0
+        # itertools.count.__next__ is atomic under the GIL: the threaded
+        # serving frontends must never hand two requests the same fold
+        self._request_ids = itertools.count(1)
         self._cache = {}
 
     def _decode_fn(self, batch: int):
@@ -96,7 +99,6 @@ class Seq2SeqService:
         if bucket > n:
             src = np.concatenate(
                 [src, np.repeat(src[-1:], bucket - n, axis=0)])
-        self._n_requests += 1
-        rng = jax.random.fold_in(self._seed, self._n_requests)
+        rng = jax.random.fold_in(self._seed, next(self._request_ids))
         tokens, scores = self._decode_fn(bucket)(self.params, src, rng)
         return np.asarray(tokens)[:n], np.asarray(scores)[:n]

@@ -1,0 +1,306 @@
+"""THE session evidence orchestrator — the repo's single watcher entry point.
+
+Round-4 postmortem (VERDICT r4 Weak #7): two watchers (bench_watch.py +
+chipup_r04.py) ran concurrently and double-appended the evidence trail.
+This file replaces both.  Guarantees:
+
+- SINGLE INSTANCE: an exclusive ``flock`` on ``chipup.lock`` held for the
+  process lifetime; a second launch exits immediately with a log line.
+- ATOMIC ARTIFACTS: every JSON artifact is written tmp-then-``os.replace``.
+- REPLACE, NOT RATCHET (advisor r4 medium): a newer non-suspect live bench
+  row REPLACES ``BENCH_r05.json`` even if its value is lower — full history
+  stays in ``BENCH_attempts.jsonl``; a regression must be visible.
+
+Loop: probe the tunneled chip every ``CHIPUP_INTERVAL`` s (default 390 —
+the chip has been up for minutes per 12 h session; probes must be dense).
+Every probe/run appends one JSON line to ``BENCH_attempts.jsonl``.
+
+On the FIRST successful probe, run the full sequence, most valuable first,
+each in its own subprocess so one hang cannot sink the rest:
+
+1. ``bench.py --worker tpu``  (sweep+trace)  -> BENCH_r05.json
+2. ``bench_lm.py``                           -> BENCH_LM_r05.json
+3. ``kernels_selfcheck.py``   (amortized)    -> KERNELS_r05.json (all_ok only)
+4. ``bench_e2e.py``           (host-fed)     -> BENCH_E2E_r05.json
+5. ``bench_probe.py``         (breakdown)    -> PROBE_r05.json
+6. ``dryrun_tpu_ops``         (Mosaic proof) -> PALLAS_TPU_r05.json
+
+On LATER windows: re-run whatever is missing/failed, plus a quick
+(no-sweep) bench refresh whose good rows replace the snapshot.
+``CHIPUP_REPEAT=1`` forces the full sequence every window.
+
+Run detached at session start:  ``nohup python chipup.py >> chipup.log &``
+"""
+
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+# env overrides exist so tests can exercise the lock/sequence machinery
+# without touching the session's real evidence trail
+ATTEMPTS = os.environ.get("CHIPUP_ATTEMPTS",
+                          os.path.join(HERE, "BENCH_attempts.jsonl"))
+LOCK = os.environ.get("CHIPUP_LOCK", os.path.join(HERE, "chipup.lock"))
+BENCH = os.path.join(HERE, "BENCH_r05.json")
+LM = os.path.join(HERE, "BENCH_LM_r05.json")
+KERNELS = os.path.join(HERE, "KERNELS_r05.json")
+E2E = os.path.join(HERE, "BENCH_E2E_r05.json")
+PROBE = os.path.join(HERE, "PROBE_r05.json")
+PALLAS = os.path.join(HERE, "PALLAS_TPU_r05.json")
+
+INTERVAL = float(os.environ.get("CHIPUP_INTERVAL", "390"))
+PROBE_TIMEOUT = float(os.environ.get("CHIPUP_PROBE_TIMEOUT", "150"))
+
+_PROBE_SRC = (
+    "import jax, json; d = jax.devices()[0]; "
+    "print(json.dumps({'platform': d.platform, "
+    "'device_kind': d.device_kind}))"
+)
+
+
+def _log(entry):
+    entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(ATTEMPTS, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def _atomic_write(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _acquire_lock():
+    """Exclusive non-blocking flock; the fd must stay open for process
+    lifetime.  Returns the fd or None if another instance holds it."""
+    fd = os.open(LOCK, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return None
+    os.ftruncate(fd, 0)
+    os.write(fd, f"{os.getpid()}\n".encode())
+    return fd
+
+
+def _probe():
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC], cwd=HERE,
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {PROBE_TIMEOUT:.0f}s"
+    if r.returncode == 0 and r.stdout.strip():
+        try:
+            info = json.loads(r.stdout.strip().splitlines()[-1])
+        except json.JSONDecodeError:
+            return False, "unparseable probe output"
+        if info.get("platform") == "tpu":
+            return True, info
+        return False, f"backend is {info.get('platform')!r}, not tpu"
+    return False, (r.stderr or r.stdout or "")[-200:]
+
+
+def _run(argv, timeout, env=None):
+    e = dict(os.environ, **(env or {}))
+    try:
+        r = subprocess.run(argv, cwd=HERE, capture_output=True, text=True,
+                           timeout=timeout, env=e)
+        return r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired:
+        return -1, "", f"timed out after {timeout:.0f}s"
+
+
+def _last_json(stdout):
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        return None
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return None
+
+
+def _merge_bench(row):
+    """Replace-not-ratchet: any good live row becomes the snapshot.  The
+    replaced row's FULL contents are appended to the trail first, so
+    nothing measured ever exists nowhere.  With no good snapshot on disk,
+    even a not-good live row is written (suspect flags intact) — a flagged
+    measurement beats zero evidence (bench_watch's documented behavior)."""
+    from bench import is_good_row
+
+    if row is None:
+        _log({"kind": "bench", "ok": False, "error": "unparseable stdout"})
+        return False
+    prev = None
+    if os.path.exists(BENCH):
+        try:
+            with open(BENCH) as f:
+                prev = json.load(f)
+        except Exception:
+            pass
+    good = is_good_row(row) and row.get("live")
+    if not good:
+        if prev is not None and is_good_row(
+                prev.get("parsed") if isinstance(prev.get("parsed"), dict)
+                else prev):
+            _log({"kind": "bench_rejected", "value": row.get("value"),
+                  "mfu": row.get("mfu"), "suspect": bool(row.get("suspect")),
+                  "live": bool(row.get("live"))})
+            return False
+        # no good snapshot exists: flagged evidence beats none
+        row.setdefault("suspect", True)
+    if prev is not None:
+        # full-history invariant: the replaced snapshot goes to the trail
+        _log({"kind": "bench_replaced_row", "row": prev})
+    row["captured_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    _atomic_write(BENCH, row)
+    _log({"kind": "bench", "ok": True, "good": bool(good),
+          "value": row.get("value"), "mfu": row.get("mfu"),
+          "batch": row.get("batch_per_chip")})
+    return bool(good)
+
+
+def _bench_pass(sweep):
+    env = {"BENCH_SWEEP": "1", "BENCH_TRACE": "1"} if sweep else {
+        "BENCH_TRACE": "1"}
+    if not sweep and os.path.exists(BENCH):
+        # quick refresh must measure the snapshot's own (possibly sweep-
+        # promoted) batch — refreshing at the default 768 would replace a
+        # better-batch headline with a config change, not a regression
+        try:
+            with open(BENCH) as f:
+                snap = json.load(f)
+            if isinstance(snap.get("parsed"), dict):
+                snap = snap["parsed"]  # round-driver {…, parsed} wrapper
+            b = snap.get("batch_per_chip")
+            if b:
+                env["BENCH_BATCH"] = str(int(b))
+        except Exception:
+            pass
+    base_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
+    rc, out, err = _run([sys.executable, "bench.py", "--worker", "tpu"],
+                        base_timeout * (2 if sweep else 1), env=env)
+    if rc != 0:
+        _log({"kind": "bench", "ok": False, "error": (err or out)[-300:]})
+        return False
+    return _merge_bench(_last_json(out))
+
+
+def _lm_pass():
+    rc, out, err = _run([sys.executable, "bench_lm.py"], 2400)
+    if rc != 0:
+        _log({"kind": "bench_lm", "ok": False, "error": (err or out)[-300:]})
+        return False
+    row = _last_json(out)
+    if row is None:
+        _log({"kind": "bench_lm", "ok": False, "error": "unparseable"})
+        return False
+    if row.get("suspect") or row.get("tiny_smoke") or not row.get("value"):
+        _log({"kind": "bench_lm_rejected", "value": row.get("value"),
+              "suspect": bool(row.get("suspect"))})
+        return False
+    row["captured_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    _atomic_write(LM, row)
+    _log({"kind": "bench_lm", "ok": True, "value": row.get("value"),
+          "mfu": row.get("mfu")})
+    return True
+
+
+def _kernels_pass():
+    tmp = KERNELS + ".run"
+    rc, out, err = _run([sys.executable, "kernels_selfcheck.py", tmp], 1800)
+    ok = rc == 0 and os.path.exists(tmp)
+    if ok:
+        os.replace(tmp, KERNELS)  # exit 0 == all_ok (selfcheck's contract)
+    elif os.path.exists(tmp):
+        os.remove(tmp)
+    _log({"kind": "kernels", "ok": ok,
+          **({} if ok else {"error": (err or out)[-300:]})})
+    return ok
+
+
+def _e2e_pass():
+    rc, out, err = _run([sys.executable, "bench_e2e.py"], 2400,
+                        env={"E2E_TRACE": "1"})
+    row = _last_json(out) if rc == 0 else None
+    ok = (row is not None and not row.get("error")
+          and not row.get("suspect") and not row.get("tiny_smoke"))
+    if ok:
+        row["captured_ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        _atomic_write(E2E, row)
+    _log({"kind": "bench_e2e", "ok": ok,
+          **({"value": row.get("value")} if ok
+             else {"error": (err or out)[-300:]})})
+    return ok
+
+
+def _probe_pass():
+    rc, out, err = _run([sys.executable, "bench_probe.py"], 1500)
+    ok = rc == 0 and os.path.exists(PROBE)
+    _log({"kind": "probe_breakdown", "ok": ok,
+          **({} if ok else {"error": (err or out)[-300:]})})
+    return ok
+
+
+def _pallas_pass():
+    """Mosaic on-device Pallas dryrun (__graft_entry__.dryrun_tpu_ops) —
+    the L0 native-kernel evidence bench_watch used to capture."""
+    src = ("import json, __graft_entry__ as g; "
+           "print(json.dumps(g.dryrun_tpu_ops()))")
+    rc, out, err = _run([sys.executable, "-c", src], 1800)
+    row = _last_json(out) if rc == 0 else None
+    ok = row is not None
+    if ok:
+        _atomic_write(PALLAS, row)
+    _log({"kind": "pallas_dryrun", "ok": ok,
+          **({} if ok else {"error": (err or out)[-300:]})})
+    return ok
+
+
+def main():
+    fd = _acquire_lock()
+    if fd is None:
+        print(json.dumps({"kind": "chipup_duplicate", "pid": os.getpid(),
+                          "error": "another chipup.py holds the lock"}),
+              flush=True)
+        return 1
+    _log({"kind": "chipup_start", "pid": os.getpid(),
+          "interval_s": INTERVAL})
+    done = {"bench": False, "lm": False, "kernels": False, "e2e": False,
+            "probe": False, "pallas": False}
+    repeat = os.environ.get("CHIPUP_REPEAT") == "1"
+    while True:
+        ok, info = _probe()
+        _log({"kind": "probe", "ok": ok,
+              **({"result": info} if ok else {"error": str(info)[-200:]})})
+        if ok:
+            first = not any(done.values())
+            if first or repeat or not done["bench"]:
+                done["bench"] = _bench_pass(sweep=True) or done["bench"]
+            else:
+                # later windows: quick refresh; good rows replace
+                _bench_pass(sweep=False)
+            if repeat or not done["lm"]:
+                done["lm"] = _lm_pass() or done["lm"]
+            if repeat or not done["kernels"]:
+                done["kernels"] = _kernels_pass() or done["kernels"]
+            if repeat or not done["e2e"]:
+                done["e2e"] = _e2e_pass() or done["e2e"]
+            if repeat or not done["probe"]:
+                done["probe"] = _probe_pass() or done["probe"]
+            if repeat or not done["pallas"]:
+                done["pallas"] = _pallas_pass() or done["pallas"]
+            _log({"kind": "sequence_state", **done})
+        time.sleep(INTERVAL)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

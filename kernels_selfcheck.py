@@ -1,4 +1,4 @@
-"""Compiled-kernel selfcheck (VERDICT r3 item 2) — produces KERNELS_r04.json.
+"""Compiled-kernel selfcheck (VERDICT r3 item 2) — produces KERNELS_r05.json.
 
 Runs the three flagship Pallas kernels on the REAL device with Mosaic
 compilation (interpret=False), at realistic shapes, and for each records:
@@ -294,5 +294,5 @@ def main(out_path):
 
 if __name__ == "__main__":
     out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "KERNELS_r04.json")
+        os.path.dirname(os.path.abspath(__file__)), "KERNELS_r05.json")
     sys.exit(main(out))

@@ -1,0 +1,66 @@
+"""Single evidence-writer discipline (VERDICT r4 item 6).
+
+Round 4's dual-watcher incident: two long-lived watchers double-appended
+the evidence trail for ~80 minutes.  The repo now has EXACTLY ONE watcher
+entry point (``chipup.py``) and it takes an exclusive flock, so a second
+instance exits immediately.  These tests make the regression impossible:
+CI fails if a second watcher script reappears or the lock stops excluding.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# scripts that loop appending to BENCH_attempts.jsonl; exactly one allowed
+RETIRED_WATCHERS = ("bench_watch.py", "chipup_r04.py", "chipup_r05.py")
+
+
+def test_exactly_one_watcher_entry_point():
+    assert os.path.exists(os.path.join(REPO, "chipup.py"))
+    for name in RETIRED_WATCHERS:
+        assert not os.path.exists(os.path.join(REPO, name)), (
+            f"{name} reintroduces a second evidence writer; fold it into "
+            "chipup.py (VERDICT r4 Weak #7)")
+
+
+def test_makefile_watch_uses_chipup():
+    with open(os.path.join(REPO, "Makefile")) as f:
+        mk = f.read()
+    assert "chipup.py" in mk
+    assert "bench_watch.py" not in mk
+
+
+def test_flock_excludes_second_instance(tmp_path):
+    lock = str(tmp_path / "chipup.lock")
+    attempts = str(tmp_path / "attempts.jsonl")
+    env = dict(os.environ, CHIPUP_LOCK=lock, CHIPUP_ATTEMPTS=attempts,
+               CHIPUP_PROBE_TIMEOUT="1", CHIPUP_INTERVAL="60")
+    first = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "chipup.py")], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        # wait for the first instance to take the lock (it logs
+        # chipup_start to the attempts trail right after acquiring)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if os.path.exists(attempts):
+                with open(attempts) as f:
+                    if any(json.loads(ln).get("kind") == "chipup_start"
+                           for ln in f if ln.strip()):
+                        break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("first chipup never logged chipup_start")
+        second = subprocess.run(
+            [sys.executable, os.path.join(REPO, "chipup.py")], env=env,
+            capture_output=True, text=True, timeout=30)
+        assert second.returncode == 1, second.stdout + second.stderr
+        assert "chipup_duplicate" in second.stdout
+        assert first.poll() is None, "first instance must still be running"
+    finally:
+        first.terminate()
+        first.wait(timeout=10)
