@@ -23,6 +23,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from bigdl_tpu.data.dataset import DataSet, MiniBatch, batch_index_plan
+from bigdl_tpu.utils import storage
 
 _MAGIC = b"BTRECv1\x00"
 
@@ -50,15 +51,55 @@ def write_records(path: str, fields: Dict[str, np.ndarray]) -> None:
                     "shape": list(a.shape[1:])}
                    for k, a in zip(names, arrays)],
     }
-    with open(path, "wb") as f:
+    # data first, sidecar last: on object stores (no atomic rename) the
+    # sidecar's presence marks the record file complete
+    with storage.open_file(path, "wb") as f:
         f.write(_MAGIC)
         f.write(struct.pack("<QQ", record_bytes, n))
         # interleave per record so one record is one contiguous read
         packed = np.concatenate(
             [a.reshape(n, -1).view(np.uint8) for a in arrays], axis=1)
         f.write(np.ascontiguousarray(packed).tobytes())
-    with open(path + ".json", "w") as f:
-        json.dump(manifest, f)
+    storage.write_json(path + ".json", manifest)
+
+
+def _ensure_local(path: str) -> str:
+    """Remote record URIs (``gs://…``) download once into a local cache —
+    the mmap/native read path needs random access a remote object can't
+    give.  Cache dir: ``$BIGDL_TPU_RECORD_CACHE`` (default under the
+    system tempdir); keyed by URI hash so distinct sources never collide.
+    Set ``BIGDL_TPU_RECORD_CACHE_REFRESH=1`` to force re-download."""
+    if not storage.is_remote(path):
+        return path
+    import hashlib
+    import shutil
+    import tempfile
+
+    cache_root = os.environ.get(
+        "BIGDL_TPU_RECORD_CACHE",
+        os.path.join(tempfile.gettempdir(), "bigdl_tpu_records"))
+    os.makedirs(cache_root, exist_ok=True)
+    key = hashlib.sha1(path.encode()).hexdigest()[:16]
+    local = os.path.join(cache_root, key + "_" + storage.basename(path))
+    refresh = os.environ.get("BIGDL_TPU_RECORD_CACHE_REFRESH") == "1"
+    # sidecar-last write order means: if the remote sidecar exists, the
+    # data object is complete; download data first + sidecar last locally
+    # too, so a crashed download is re-fetched (no local sidecar)
+    for src, dst in ((path, local), (path + ".json", local + ".json")):
+        if refresh or not os.path.exists(dst):
+            # per-process tmp name: two processes racing on the same URI
+            # must not truncate each other's in-flight download; whichever
+            # os.replace lands last wins with a complete file
+            tmp = f"{dst}.part.{os.getpid()}"
+            try:
+                with storage.open_file(src, "rb") as fi, \
+                        open(tmp, "wb") as fo:
+                    shutil.copyfileobj(fi, fo, 1 << 20)
+                os.replace(tmp, dst)
+            finally:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+    return local
 
 
 class RecordDataSet(DataSet):
@@ -73,6 +114,7 @@ class RecordDataSet(DataSet):
 
     def __init__(self, path: str, feature=None, label: Optional[str] = None,
                  pipeline=None):
+        path = _ensure_local(path)  # gs://… downloads once to local cache
         with open(path + ".json") as f:
             self.manifest = json.load(f)
         self.path = path

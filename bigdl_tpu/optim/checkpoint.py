@@ -8,9 +8,15 @@ TPU-native: step-tagged directories with npz blobs + a JSON manifest.  Flat
 params are replicated so process 0 writes them; the sharded optimizer state is
 gathered before write (cheap relative to training; an Orbax-style per-host
 sharded write is the planned optimization for pod scale).
+
+``path`` may be local OR a remote URI (``gs://…`` via fsspec+gcsfs — the
+reference's ``Optimizer.setCheckpoint`` takes an HDFS URI the same way,
+``utils/File.scala``).  Atomicity differs by backend: local uses
+write-tmp-then-rename; object stores have no atomic rename, so remote
+writes order the manifest LAST and readers treat a ``ckpt-<step>``
+prefix without a manifest as not-a-checkpoint.
 """
 
-import json
 import os
 import shutil
 from typing import Any, Dict, Optional, Tuple
@@ -18,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from bigdl_tpu.utils import storage
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.checkpoint")
@@ -47,15 +54,29 @@ def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
     """Write checkpoint dir ``<path>/ckpt-<step>``; returns the dir."""
     if jax.process_index() != 0:
         return ""
-    d = os.path.join(path, f"ckpt-{step}")
-    tmp = d + ".tmp"
-    os.makedirs(tmp, exist_ok=True)
-    np.savez(os.path.join(tmp, "params.npz"), flat=np.asarray(flat_params))
+    d = storage.join(path, f"ckpt-{step}")
+    remote = storage.is_remote(path)
+    # local: write into a tmp dir, rename atomically.  remote: write blobs
+    # straight under the final prefix, manifest LAST — a crash mid-write
+    # leaves a prefix without a manifest, which readers skip.
+    tmp = d if remote else d + ".tmp"
+    if remote and storage.exists(storage.join(d, "manifest.json")):
+        # re-reaching a step (preemption loop, rerun into the same bucket):
+        # the old manifest must go FIRST, or a crash mid-rewrite leaves new
+        # blobs certified complete by the stale manifest
+        storage.remove_tree(d, ignore_errors=False)
+    storage.makedirs(tmp)
+
+    def _savez(name, **arrs):
+        with storage.open_file(storage.join(tmp, name), "wb") as f:
+            np.savez(f, **arrs)
+
+    _savez("params.npz", flat=np.asarray(flat_params))
     if ema_flat is not None:
-        np.savez(os.path.join(tmp, "ema.npz"), flat=np.asarray(ema_flat))
-    np.savez(os.path.join(tmp, "opt_state.npz"), **_flatten_with_paths(opt_state))
-    np.savez(os.path.join(tmp, "model_state.npz"),
-             **_flatten_with_paths(model_state))
+        _savez("ema.npz", flat=np.asarray(ema_flat))
+    _savez("opt_state.npz", **_flatten_with_paths(opt_state))
+    _savez("model_state.npz", **_flatten_with_paths(model_state))
+
     def _jsonable(v):
         if isinstance(v, (int, float, str, bool)) or v is None:
             return True
@@ -65,55 +86,76 @@ def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
 
     manifest = {"step": step, "driver_state": {
         k: v for k, v in driver_state.items() if _jsonable(v)}}
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
-    if os.path.exists(d):
-        shutil.rmtree(d)
-    os.rename(tmp, d)
+    storage.write_json(storage.join(tmp, "manifest.json"), manifest)
+    if not remote:
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        os.rename(tmp, d)
     _gc(path, keep_last)
     log.info("checkpoint saved: %s", d)
     return d
 
 
-def latest_checkpoint(path: str) -> Optional[str]:
-    if not os.path.isdir(path):
-        return None
+def _complete_steps(path: str):
+    """(step, name) for every COMPLETE checkpoint under ``path`` — one
+    whose manifest exists (remote writes order it last, so a prefix
+    without one is a partial write; local tmp dirs are excluded by name)."""
+    if not storage.isdir(path):
+        return []
     steps = []
-    for name in os.listdir(path):
+    for name in storage.listdir(path):
         if name.startswith("ckpt-") and not name.endswith(".tmp"):
             try:
-                steps.append((int(name.split("-")[1]), name))
+                step = int(name.split("-")[1])
             except ValueError:
                 continue
+            if storage.exists(storage.join(path, name, "manifest.json")):
+                steps.append((step, name))
+    return steps
+
+
+def latest_checkpoint(path: str) -> Optional[str]:
+    steps = _complete_steps(path)
     if not steps:
         return None
-    return os.path.join(path, max(steps)[1])
+    return storage.join(path, max(steps)[1])
 
 
 def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
                     ) -> Tuple[np.ndarray, Any, Any, Dict[str, Any]]:
-    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
-        manifest = json.load(f)
-    flat = np.load(os.path.join(ckpt_dir, "params.npz"))["flat"]
-    ema_path = os.path.join(ckpt_dir, "ema.npz")
-    ema = np.load(ema_path)["flat"] if os.path.exists(ema_path) else None
-    opt_flat = dict(np.load(os.path.join(ckpt_dir, "opt_state.npz")))
-    mstate_flat = dict(np.load(os.path.join(ckpt_dir, "model_state.npz")))
+    manifest = storage.read_json(storage.join(ckpt_dir, "manifest.json"))
+    flat = storage.load_npz(storage.join(ckpt_dir, "params.npz"))["flat"]
+    ema_path = storage.join(ckpt_dir, "ema.npz")
+    ema = (storage.load_npz(ema_path)["flat"]
+           if storage.exists(ema_path) else None)
+    opt_flat = storage.load_npz(storage.join(ckpt_dir, "opt_state.npz"))
+    mstate_flat = storage.load_npz(storage.join(ckpt_dir, "model_state.npz"))
     opt_state = _unflatten_like(opt_state_template, opt_flat)
     model_state = _unflatten_like(model_state_template, mstate_flat)
     return flat, opt_state, model_state, manifest["driver_state"], ema
 
 
 def _gc(path: str, keep_last: int):
-    entries = []
-    for name in os.listdir(path):
-        if name.startswith("ckpt-") and not name.endswith(".tmp"):
+    entries = _complete_steps(path)
+    for _, name in sorted(entries)[:-keep_last] if keep_last > 0 else []:
+        storage.remove_tree(storage.join(path, name), ignore_errors=True)
+    if entries and storage.is_remote(path):
+        # partial prefixes (crash mid-write: blobs, no manifest) are
+        # invisible to readers but still occupy the bucket; sweep any
+        # older than the newest complete step (a younger one may be a
+        # write in flight right now)
+        newest = max(entries)[0]
+        for name in storage.listdir(path):
+            if not name.startswith("ckpt-") or name.endswith(".tmp"):
+                continue
             try:
-                entries.append((int(name.split("-")[1]), name))
+                step = int(name.split("-")[1])
             except ValueError:
                 continue
-    for _, name in sorted(entries)[:-keep_last] if keep_last > 0 else []:
-        shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+            if step < newest and not storage.exists(
+                    storage.join(path, name, "manifest.json")):
+                storage.remove_tree(storage.join(path, name),
+                                    ignore_errors=True)
 
 
 import threading as _threading

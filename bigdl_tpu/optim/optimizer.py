@@ -171,9 +171,13 @@ class Optimizer:
 
     def set_checkpoint(self, path: str, trigger: Trigger,
                        async_write: bool = False) -> "Optimizer":
-        """``async_write=True`` snapshots to host at the trigger and runs
-        the npz serialization on a background thread (one in flight) —
-        the cheap-frequent-checkpoint posture for preemptible slices."""
+        """``path`` may be a local directory or a remote URI (``gs://…``
+        via the optional fsspec+gcsfs — the reference's
+        ``setCheckpoint(hdfs://…)`` analog); a preemptible TPU VM must
+        checkpoint off-VM to survive.  ``async_write=True`` snapshots to
+        host at the trigger and runs the npz serialization on a
+        background thread (one in flight) — the cheap-frequent-checkpoint
+        posture for preemptible slices."""
         self._ckpt_path = path
         self._ckpt_trigger = trigger
         self._ckpt_async = (ckpt.AsyncCheckpointer() if async_write

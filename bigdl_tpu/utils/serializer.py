@@ -11,15 +11,20 @@ TPU-native format: a directory with
   into jnp on load)
 
 Multi-host discipline: only process 0 writes; every process can read.
+
+``path`` may be local or a remote URI (``gs://…`` — the reference's
+``Module.saveModule`` takes an HDFS path the same way, ``File.scala``);
+remote writes order the manifest LAST so a partial upload is never
+mistaken for a saved model.
 """
 
-import json
-import os
 from typing import Any, Dict
 
 import numpy as np
 
 import jax
+
+from bigdl_tpu.utils import storage
 
 FORMAT_VERSION = 1
 
@@ -52,11 +57,11 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
 def save_model(path: str, model, variables: Dict[str, Any],
                overwrite: bool = True) -> None:
     """``Module.saveModule(path, overWrite)`` analog."""
-    if os.path.exists(os.path.join(path, "manifest.json")) and not overwrite:
+    if storage.exists(storage.join(path, "manifest.json")) and not overwrite:
         raise FileExistsError(f"{path} exists and overwrite=False")
     if jax.process_index() != 0:
         return
-    os.makedirs(path, exist_ok=True)
+    storage.makedirs(path)
     flat = _flatten(variables)
     manifest = {
         "format_version": FORMAT_VERSION,
@@ -65,10 +70,16 @@ def save_model(path: str, model, variables: Dict[str, Any],
         "tensors": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                     for k, v in flat.items()},
     }
-    with open(os.path.join(path, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
-    np.savez(os.path.join(path, "weights.npz"),
-             **{k: v for k, v in flat.items()})
+    # weights first, manifest last: remote stores have no atomic rename,
+    # so the manifest's presence is the completeness marker.  When
+    # overwriting, the OLD manifest goes first — it must not certify
+    # half-rewritten weights if this write crashes.
+    manifest_path = storage.join(path, "manifest.json")
+    if storage.is_remote(path) and storage.exists(manifest_path):
+        storage.remove_tree(manifest_path, ignore_errors=False)
+    with storage.open_file(storage.join(path, "weights.npz"), "wb") as f:
+        np.savez(f, **{k: v for k, v in flat.items()})
+    storage.write_json(manifest_path, manifest, indent=1)
 
 
 def load_model(path: str, model=None,
@@ -77,14 +88,12 @@ def load_model(path: str, model=None,
     pytree, e.g. from ``model.init``) is given, the result keeps its exact
     structure and shapes are validated; otherwise a nested-dict pytree is
     rebuilt from the flat paths."""
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
+    manifest = storage.read_json(storage.join(path, "manifest.json"))
     if manifest["format_version"] > FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format v{manifest['format_version']} is newer than "
             f"this library (v{FORMAT_VERSION})")
-    with np.load(os.path.join(path, "weights.npz")) as z:
-        flat = {k: z[k] for k in z.files}
+    flat = storage.load_npz(storage.join(path, "weights.npz"))
     if template is not None:
         return _unflatten_like(template, flat)
     # rebuild nested dicts from keystr paths like "['params']['block_0']['w']"

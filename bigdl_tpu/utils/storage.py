@@ -1,0 +1,181 @@
+"""Local-or-remote filesystem seam for checkpoints, models, and records.
+
+Reference analog (unverified — mount empty): ``dllib/utils/File.scala``,
+whose ``save``/``load`` accept a local path OR an HDFS URI, so
+``Optimizer.setCheckpoint`` works on cluster storage.  The TPU-native
+equivalent of HDFS is object storage (``gs://`` on a TPU VM, ``s3://``
+elsewhere): a preemption-safe checkpoint written only to the VM's local
+disk is a checkpoint you lose with the VM.
+
+Design: every path-taking function here dispatches on the URI scheme —
+plain paths (and ``file://``) use ``os``/``open`` directly with zero new
+dependencies; any other scheme routes through ``fsspec`` when installed
+(``gs://`` additionally needs ``gcsfs``, ``s3://`` needs ``s3fs``) and
+raises one actionable error when not.  ``memory://`` gives tests a real
+remote-semantics filesystem with no network.
+
+Remote "directories" follow object-store semantics: they exist only as
+key prefixes, creation is a no-op, and rename is copy+delete (object
+stores have no atomic rename — the checkpoint writer handles atomicity
+with a manifest-last write order instead; the manifest is written only
+after every blob it references, and readers treat a prefix without a
+manifest as not-a-checkpoint).
+"""
+
+import json
+import os
+import posixpath
+import shutil
+from typing import IO, List, Optional
+
+__all__ = [
+    "is_remote", "join", "basename", "open_file", "exists", "isdir",
+    "listdir", "makedirs", "remove_tree", "read_json", "write_json",
+    "load_npz",
+]
+
+
+def is_remote(path: str) -> bool:
+    """True for scheme'd URIs (``gs://…``, ``s3://…``, ``memory://…``)
+    other than ``file://``."""
+    if "://" not in path:
+        return False
+    return path.split("://", 1)[0] != "file"
+
+
+def _strip_file_scheme(path: str) -> str:
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+def _fs(path: str):
+    """The fsspec filesystem for a remote URI, with an actionable error
+    when the optional dependency is missing."""
+    try:
+        import fsspec
+    except ImportError as e:
+        raise ImportError(
+            f"path {path!r} needs the optional 'fsspec' dependency for "
+            "remote filesystems (pip install fsspec; plus gcsfs for gs:// "
+            "or s3fs for s3://). Local paths work without it.") from e
+    try:
+        fs, _ = fsspec.core.url_to_fs(path)
+    except (ImportError, ValueError) as e:
+        scheme = path.split("://", 1)[0]
+        extra = {"gs": "gcsfs", "gcs": "gcsfs", "s3": "s3fs"}.get(
+            scheme, f"an fsspec backend for {scheme}://")
+        raise ImportError(
+            f"fsspec has no handler for {path!r}; install {extra}") from e
+    return fs
+
+
+def _fs_path(path: str):
+    """(fs, path-without-scheme) — fsspec methods want the stripped form
+    for some backends but accept the full URI for most; use strip_protocol
+    which is backend-correct."""
+    fs = _fs(path)
+    return fs, fs._strip_protocol(path)
+
+
+def join(path: str, *parts: str) -> str:
+    if is_remote(path):
+        return posixpath.join(path, *parts)
+    return os.path.join(_strip_file_scheme(path), *parts)
+
+
+def basename(path: str) -> str:
+    if is_remote(path):
+        return posixpath.basename(path.rstrip("/"))
+    return os.path.basename(_strip_file_scheme(path))
+
+
+def open_file(path: str, mode: str = "rb") -> IO:
+    if is_remote(path):
+        fs, p = _fs_path(path)
+        return fs.open(p, mode)
+    return open(_strip_file_scheme(path), mode)
+
+
+def exists(path: str) -> bool:
+    if is_remote(path):
+        fs, p = _fs_path(path)
+        return fs.exists(p)
+    return os.path.exists(_strip_file_scheme(path))
+
+
+def isdir(path: str) -> bool:
+    if is_remote(path):
+        fs, p = _fs_path(path)
+        return fs.isdir(p)
+    return os.path.isdir(_strip_file_scheme(path))
+
+
+def listdir(path: str) -> List[str]:
+    """Child NAMES (not full paths); [] for a missing remote prefix (an
+    object-store 'directory' that holds nothing does not exist)."""
+    if is_remote(path):
+        fs, p = _fs_path(path)
+        try:
+            infos = fs.ls(p, detail=False)
+        except FileNotFoundError:
+            return []
+        base = p.rstrip("/")
+        out = []
+        for child in infos:
+            name = posixpath.basename(str(child).rstrip("/"))
+            if name and str(child).rstrip("/") != base:
+                out.append(name)
+        return out
+    return os.listdir(_strip_file_scheme(path))
+
+
+def makedirs(path: str) -> None:
+    """No-op on object stores (prefixes need no creation)."""
+    if is_remote(path):
+        return
+    os.makedirs(_strip_file_scheme(path), exist_ok=True)
+
+
+def remove_tree(path: str, ignore_errors: bool = True) -> None:
+    if is_remote(path):
+        fs, p = _fs_path(path)
+        try:
+            fs.rm(p, recursive=True)
+        except FileNotFoundError:
+            if not ignore_errors:
+                raise
+        except Exception:
+            if not ignore_errors:
+                raise
+        return
+    path = _strip_file_scheme(path)
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=ignore_errors)
+    elif os.path.exists(path):
+        try:
+            os.remove(path)
+        except OSError:
+            if not ignore_errors:
+                raise
+    elif not ignore_errors:
+        raise FileNotFoundError(path)
+
+
+def load_npz(path: str) -> dict:
+    """Load an npz into a plain dict, materializing every array BEFORE the
+    file closes — ``np.load`` over an fsspec file is lazy, and a leaked
+    lazy handle reads from a closed stream."""
+    import numpy as np
+
+    with open_file(path, "rb") as f:
+        with np.load(f) as z:
+            return {k: z[k] for k in z.files}
+
+
+def read_json(path: str):
+    with open_file(path, "r") as f:
+        return json.load(f)
+
+
+def write_json(path: str, obj, indent: Optional[int] = None) -> None:
+    with open_file(path, "w") as f:
+        json.dump(obj, f, indent=indent)

