@@ -19,6 +19,17 @@ test-serial:
 test-fast:
 	python -m pytest tests/ -q -x -m "not slow" -n auto
 
+# the contributor/judge loop (VERDICT r4 item 9): the ~10-file core path,
+# serial, budgeted <= 5 min warm on 1 core — covers tensor ops, layers,
+# optim, the sharded train step, records, serving, storage, and the
+# watcher invariant without the long tail of integration files.
+CORE_TESTS = tests/test_tensor.py tests/test_nn_layers.py \
+  tests/test_optim.py tests/test_distri_optimizer.py \
+  tests/test_parallel.py tests/test_records.py tests/test_serving.py \
+  tests/test_storage_remote.py tests/test_watcher_single.py
+test-core:
+	python -m pytest $(CORE_TESTS) -q
+
 bench:
 	python bench.py
 

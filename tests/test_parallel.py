@@ -324,6 +324,36 @@ def test_ulysses_grad_finite_and_head_constraint(seq_mesh):
         ulysses_attention_sharded(seq_mesh, bad, bad, bad)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_grads_match_ring_under_masking(seq_mesh, causal):
+    """The two sequence-parallel strategies must agree on GRADIENTS, not
+    just outputs, with the causal mask on (VERDICT r4 item 8) — an
+    all-to-all layout bug shows up in dq/dk/dv long before it corrupts a
+    forward pass at these sizes."""
+    from bigdl_tpu.parallel import ulysses_attention_sharded
+
+    rs = np.random.RandomState(13)
+    b, h, L, d = 2, 4, 32, 8
+    q = jnp.asarray(rs.randn(b, h, L, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, L, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, L, d), jnp.float32)
+
+    def loss_u(args):
+        out = ulysses_attention_sharded(seq_mesh, *args, causal=causal)
+        return jnp.sum(out ** 2)
+
+    def loss_r(args):
+        out = ring_attention_sharded(seq_mesh, *args, causal=causal)
+        return jnp.sum(out ** 2)
+
+    gu = jax.grad(loss_u)((q, k, v))
+    gr = jax.grad(loss_r)((q, k, v))
+    for a, bb, name in zip(gu, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=5e-5, atol=5e-5,
+            err_msg=f"d{name} ulysses vs ring (causal={causal})")
+
+
 @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
 def test_transformer_layer_seq_parallel_matches_plain(seq_mesh, strategy):
     """MultiHeadAttention(seq_parallel=...) inside a shard_map carrying the

@@ -174,12 +174,16 @@ def test_circular_pipeline_matches_sequential(pipe_mesh):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_circular_pipeline_grads_match_sequential(pipe_mesh):
+@pytest.mark.parametrize("k", [2, 3])
+def test_circular_pipeline_grads_match_sequential(pipe_mesh, k):
+    """k=3 (12 layers over 4 stages, device s owning {s, s+4, s+8}):
+    grads through three full ring traversals must still match the
+    sequential reference (VERDICT r4 item 8)."""
     from bigdl_tpu.parallel.pp import (pipeline_apply_circular,
                                        stack_stage_params_circular)
 
     rs = np.random.RandomState(3)
-    n_stages, k, d, B = 4, 2, 5, 8
+    n_stages, d, B = 4, 5, 8
     layers = _mk_stages(rs, n_stages * k, d)
     stacked = stack_stage_params_circular(layers, n_stages)
     x = jnp.asarray(rs.randn(B, d), jnp.float32)
