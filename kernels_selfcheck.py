@@ -157,22 +157,26 @@ def main(out_path):
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32))
 
-    record(
-        "flash_attention_fwd",
-        jax.jit(lambda: flash_attention(q, k, v, causal=True,
-                                        interpret=interpret)),
-        jax.jit(lambda: naive_attn(q, k, v)),
-        tol=2e-2,  # bf16 inputs
+    def record_flash_fwd(name, **blocks):
         # chain feeds output back as the query: same shape/dtype, data-
         # dependent across iterations so nothing folds or overlaps
-        kernel_chain=jax.jit(lambda: jax.lax.fori_loop(
-            0, CHAIN,
-            lambda i, qq: flash_attention(qq, k, v, causal=True,
-                                          interpret=interpret), q)),
-        naive_chain=jax.jit(lambda: jax.lax.fori_loop(
-            0, CHAIN,
-            lambda i, qq: naive_attn(qq, k, v).astype(q.dtype), q)),
-    )
+        record(
+            name,
+            jax.jit(lambda: flash_attention(q, k, v, causal=True,
+                                            interpret=interpret, **blocks)),
+            jax.jit(lambda: naive_attn(q, k, v)),
+            tol=2e-2,  # bf16 inputs
+            kernel_chain=jax.jit(lambda: jax.lax.fori_loop(
+                0, CHAIN,
+                lambda i, qq: flash_attention(qq, k, v, causal=True,
+                                              interpret=interpret,
+                                              **blocks), q)),
+            naive_chain=jax.jit(lambda: jax.lax.fori_loop(
+                0, CHAIN,
+                lambda i, qq: naive_attn(qq, k, v).astype(q.dtype), q)),
+        )
+
+    record_flash_fwd("flash_attention_fwd")
 
     def flash_loss(args):
         qq, kk, vv = args
@@ -285,9 +289,32 @@ def main(out_path):
         report["kernels"]["int8_matmul"]["ok"] = False
         report["kernels"]["int8_matmul"]["error"] = str(e)[:400]
 
-    report["all_ok"] = all(k.get("ok") for k in report["kernels"].values())
-    with open(out_path, "w") as f:
-        json.dump(report, f, indent=1)
+    # "probe_" entries are tiling experiments, not shipped configs — a
+    # failed probe is data (recorded), never a reason to drop the artifact
+    report["all_ok"] = all(
+        rec.get("ok") for name, rec in report["kernels"].items()
+        if not name.startswith("probe_"))
+
+    def _write():
+        with open(out_path + ".tmp2", "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(out_path + ".tmp2", out_path)
+
+    # write the shipped-config evidence BEFORE the optional tiling probe:
+    # a process-fatal probe failure (Mosaic abort, device wedge — not a
+    # Python exception) must never cost the five proven records.  chipup
+    # installs a parseable all_ok artifact even when our exit code is lost.
+    _write()
+
+    if not SMALL:
+        # tiling probe: a larger-block flash-fwd variant — decides
+        # empirically whether the 128x128 default leaves MXU pipelining
+        # on the table at long seq (VMEM at 256x512, d=128 is ~1 MB,
+        # far under the ~16 MB/core budget)
+        record_flash_fwd("probe_flash_attention_fwd_bq256_bk512",
+                         block_q=256, block_k=512)
+        _write()
+
     print(json.dumps({"all_ok": report["all_ok"], "out": out_path}))
     return 0 if report["all_ok"] else 1
 

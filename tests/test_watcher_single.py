@@ -38,7 +38,8 @@ def test_flock_excludes_second_instance(tmp_path):
     lock = str(tmp_path / "chipup.lock")
     attempts = str(tmp_path / "attempts.jsonl")
     env = dict(os.environ, CHIPUP_LOCK=lock, CHIPUP_ATTEMPTS=attempts,
-               CHIPUP_PROBE_TIMEOUT="1", CHIPUP_INTERVAL="60")
+               CHIPUP_PROBE_TIMEOUT="1", CHIPUP_INTERVAL="60",
+               CHIPUP_STRAY_SWEEP="0")  # tests must not kill real procs
     first = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "chipup.py")], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
