@@ -183,7 +183,34 @@ def main():
     }
     if mfu is not None and mfu > 1.0:
         out["suspect"] = True
-    print(json.dumps(out))
+
+    # headline first: the consumer parses the LAST stdout line, so if the
+    # optional A/B below is killed mid-run (timeout/OOM) this line is the
+    # row of record — the A/B can only enrich, never sink it
+    print(json.dumps(out), flush=True)
+
+    prior_flash = os.environ.get("BIGDL_TPU_FLASH")
+    if (on_tpu and not tiny and prior_flash != "0"
+            and os.environ.get("BENCH_LM_AB", "1") != "0"):
+        # flash-vs-XLA A/B at the winning batch: the MHA layers auto-
+        # select the Pallas kernel on TPU; BIGDL_TPU_FLASH=0 re-traces
+        # through XLA attention.  Records the honest comparison the
+        # kernel layer must win to stay the default (VERDICT r4 item 2).
+        # Skipped when the operator already demoted the kernel (the
+        # headline would itself be the XLA path — nothing to compare).
+        try:
+            os.environ["BIGDL_TPU_FLASH"] = "0"
+            tps_xla, st_xla = measure(b)
+            out["tokens_per_sec_chip_xla_attention"] = round(tps_xla, 1)
+            out["flash_vs_xla_speedup"] = round(tps / tps_xla, 3)
+        except Exception as e:
+            out["xla_attention_ab_error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            if prior_flash is None:
+                os.environ.pop("BIGDL_TPU_FLASH", None)
+            else:
+                os.environ["BIGDL_TPU_FLASH"] = prior_flash
+        print(json.dumps(out))
     return 0
 
 

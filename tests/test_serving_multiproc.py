@@ -120,7 +120,7 @@ def test_serving_subprocess_concurrent_clients(tmp_path):
         }
         print("SERVING_LATENCY " + json.dumps(artifact))
         if os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
-            with open(os.path.join(repo_root, "SERVING_r04.json"), "w") as f:
+            with open(os.path.join(repo_root, "SERVING_r05.json"), "w") as f:
                 json.dump(artifact, f, indent=1)
     finally:
         if proc.poll() is None:
