@@ -53,6 +53,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=768)
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--out", default="PROBE_r05.json",
+                    help="artifact path (chipup passes its redirected one)")
     args = ap.parse_args()
 
     from bigdl_tpu.models.resnet import resnet50
@@ -142,9 +144,9 @@ def main():
     print("full_step", json.dumps(rec), flush=True)
 
     # atomic: a timeout-kill mid-dump must not leave a truncated artifact
-    with open("PROBE_r05.json.tmp", "w") as f:
+    with open(args.out + ".tmp", "w") as f:
         json.dump(report, f, indent=1)
-    os.replace("PROBE_r05.json.tmp", "PROBE_r05.json")
+    os.replace(args.out + ".tmp", args.out)
     print(json.dumps({"ok": True}))
 
 

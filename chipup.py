@@ -43,16 +43,17 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 # env overrides exist so tests can exercise the lock/sequence machinery
-# without touching the session's real evidence trail
+# without touching the session's real evidence trail or artifacts
 ATTEMPTS = os.environ.get("CHIPUP_ATTEMPTS",
                           os.path.join(HERE, "BENCH_attempts.jsonl"))
 LOCK = os.environ.get("CHIPUP_LOCK", os.path.join(HERE, "chipup.lock"))
-BENCH = os.path.join(HERE, "BENCH_r05.json")
-LM = os.path.join(HERE, "BENCH_LM_r05.json")
-KERNELS = os.path.join(HERE, "KERNELS_r05.json")
-E2E = os.path.join(HERE, "BENCH_E2E_r05.json")
-PROBE = os.path.join(HERE, "PROBE_r05.json")
-PALLAS = os.path.join(HERE, "PALLAS_TPU_r05.json")
+_ART = os.environ.get("CHIPUP_ARTIFACT_DIR", HERE)
+BENCH = os.path.join(_ART, "BENCH_r05.json")
+LM = os.path.join(_ART, "BENCH_LM_r05.json")
+KERNELS = os.path.join(_ART, "KERNELS_r05.json")
+E2E = os.path.join(_ART, "BENCH_E2E_r05.json")
+PROBE = os.path.join(_ART, "PROBE_r05.json")
+PALLAS = os.path.join(_ART, "PALLAS_TPU_r05.json")
 
 INTERVAL = float(os.environ.get("CHIPUP_INTERVAL", "390"))
 PROBE_TIMEOUT = float(os.environ.get("CHIPUP_PROBE_TIMEOUT", "150"))
@@ -307,7 +308,8 @@ def _e2e_pass():
 
 
 def _probe_pass():
-    rc, out, err = _run([sys.executable, "bench_probe.py"], 1500)
+    rc, out, err = _run([sys.executable, "bench_probe.py", "--out", PROBE],
+                        1500)
     ok = rc == 0 and os.path.exists(PROBE)
     _log({"kind": "probe_breakdown", "ok": ok,
           **({} if ok else {"error": (err or out)[-300:]})})

@@ -1,0 +1,109 @@
+"""End-to-end CPU rehearsal of the chip-up capture sequence.
+
+The TPU window is scarce (17 minutes in round 4); the one thing that must
+not fail during it is the chipup.py pass plumbing.  These tests drive the
+real pass functions — real subprocesses, real artifact writes, real merge
+policy — with JAX forced to CPU (bench.py's '--worker tpu' degrades to
+the CPU smoke instead of hanging on axon init) and artifacts redirected
+to a tmp dir via CHIPUP_ARTIFACT_DIR.
+
+What they pin down:
+- the banking pass writes a flagged snapshot even when the row is
+  not-good (CPU smoke: mfu None) — flagged evidence beats none;
+- the merge policy then REFUSES to let a second not-good row replace
+  nothing-better, and lets a fabricated good row replace the flagged one;
+- the kernels pass installs the selfcheck artifact on exit 0;
+- the LM pass rejects tiny-smoke rows (a CPU smoke must never become
+  LM evidence).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+def _drive(tmp_path, src, extra_env=None, timeout=900):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               CHIPUP_ARTIFACT_DIR=str(tmp_path),
+               CHIPUP_ATTEMPTS=str(tmp_path / "attempts.jsonl"),
+               CHIPUP_LOCK=str(tmp_path / "lock"),
+               CHIPUP_STRAY_SWEEP="0",
+               **(extra_env or {}))
+    r = subprocess.run([sys.executable, "-c", src], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, r.stdout[-500:] + r.stderr[-500:]
+    return r
+
+
+def _trail(tmp_path):
+    p = tmp_path / "attempts.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(ln) for ln in p.read_text().splitlines() if ln]
+
+
+def test_banking_pass_and_merge_policy(tmp_path):
+    # 1. banking pass on CPU: row is not-good (mfu None) but with no
+    #    snapshot on disk it must still be written, flagged
+    _drive(tmp_path, "import chipup; print(chipup._bench_pass('bank'))",
+           extra_env={"BENCH_CPU_TIMEOUT": "600"})
+    snap_path = tmp_path / "BENCH_r05.json"
+    assert snap_path.exists()
+    row = json.loads(snap_path.read_text())
+    assert row.get("suspect") is True        # flagged, not silent
+    assert row.get("live") is True
+    assert row.get("value", 0) > 0
+    kinds = [e["kind"] for e in _trail(tmp_path)]
+    assert "bench" in kinds
+
+    # 2. a good row on disk must NOT be replaced by a later not-good row
+    good = dict(row)
+    good.update(mfu=0.42, suspect=False, value=12345.0, live=True)
+    snap_path.write_text(json.dumps(good))
+    _drive(tmp_path,
+           "import json, chipup; "
+           "bad = {'value': 1.0, 'live': True, 'suspect': True}; "
+           "print(chipup._merge_bench(bad))")
+    row2 = json.loads(snap_path.read_text())
+    assert row2["value"] == 12345.0, "not-good row replaced a good one"
+    assert any(e["kind"] == "bench_rejected" for e in _trail(tmp_path))
+
+    # 3. replace-not-ratchet: a good live row replaces even a BETTER good
+    #    row, and the replaced row's full contents land in the trail
+    _drive(tmp_path,
+           "import chipup; "
+           "newer = {'value': 999.0, 'mfu': 0.3, 'live': True}; "
+           "print(chipup._merge_bench(newer))")
+    row3 = json.loads(snap_path.read_text())
+    assert row3["value"] == 999.0
+    replaced = [e for e in _trail(tmp_path)
+                if e["kind"] == "bench_replaced_row"]
+    assert replaced and replaced[-1]["row"]["value"] == 12345.0
+
+
+def test_kernels_pass_installs_artifact(tmp_path):
+    _drive(tmp_path, "import chipup; print(chipup._kernels_pass())",
+           extra_env={"KERNELS_SMALL": "1", "KERNELS_REPEATS": "2"})
+    art = tmp_path / "KERNELS_r05.json"
+    assert art.exists()
+    report = json.loads(art.read_text())
+    assert report["all_ok"] is True
+    assert set(report["kernels"]) >= {"flash_attention_fwd", "int8_matmul"}
+    trail = _trail(tmp_path)
+    assert any(e["kind"] == "kernels" and e["ok"] for e in trail)
+
+
+def test_lm_pass_rejects_tiny_smoke(tmp_path):
+    _drive(tmp_path, "import chipup; print(chipup._lm_pass())",
+           extra_env={"BENCH_LM_TINY": "1"})
+    assert not (tmp_path / "BENCH_LM_r05.json").exists(), \
+        "a CPU tiny-smoke row must never become LM evidence"
+    assert any(e["kind"] == "bench_lm_rejected" for e in _trail(tmp_path))
