@@ -48,33 +48,109 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
-                    model_state, driver_state: Dict[str, Any],
-                    keep_last: int = 3, ema_flat=None) -> str:
-    """Write checkpoint dir ``<path>/ckpt-<step>``; returns the dir."""
-    if jax.process_index() != 0:
+def local_opt_shards(tree) -> Dict[str, np.ndarray]:
+    """Flatten a (device-resident, possibly ZeRO-sharded) optimizer-state
+    pytree into THIS process's contribution: for each 1-D sharded leaf,
+    the contiguous local slice plus its global offset (``<key>@offset``);
+    replicated leaves (scalars, non-elementwise state) are included whole.
+    The per-process cost is O(state/process_count) device→host copies —
+    no cross-host allgather, unlike :func:`~..train_step.host_fetch`."""
+    flat: Dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        is_sharded = (
+            isinstance(leaf, jax.Array) and leaf.ndim >= 1
+            and not leaf.is_fully_replicated)
+        if not is_sharded:
+            flat[key] = np.asarray(leaf)
+            continue
+        parts = {}
+        for s in leaf.addressable_shards:
+            start = s.index[0].start or 0
+            if start not in parts:  # replicas across model axes: keep one
+                parts[start] = np.asarray(s.data)
+        starts = sorted(parts)
+        pos = starts[0]
+        for st in starts:  # the local slices must tile contiguously
+            if st != pos:
+                raise ValueError(
+                    f"non-contiguous local shards for {key}: {starts}")
+            pos += len(parts[st])
+        flat[key] = np.concatenate([parts[s] for s in starts])
+        flat[key + "@offset"] = np.asarray(starts[0], np.int64)
+    return flat
+
+
+def save_checkpoint(path: str, step: int, *, flat_params=None,
+                    opt_state=None, model_state=None,
+                    driver_state: Optional[Dict[str, Any]] = None,
+                    keep_last: int = 3, ema_flat=None,
+                    opt_shards: Optional[Dict[str, np.ndarray]] = None,
+                    shard_index: int = 0, shard_count: int = 1,
+                    barrier=None, attempt: Optional[str] = None) -> str:
+    """Write checkpoint dir ``<path>/ckpt-<step>``; returns the dir.
+
+    Default (``opt_shards=None``): process 0 writes everything (the
+    optimizer state must already be gathered to host).
+
+    Sharded mode (``opt_shards`` from :func:`local_opt_shards`): EVERY
+    process calls this and writes only its own
+    ``opt_state.shard<k>-of-<n>.npz`` — the pod-scale posture: checkpoint
+    traffic per host is 1/n of the optimizer state and no DCN allgather
+    happens.  ``barrier`` (e.g. ``multihost_utils.sync_global_devices``)
+    runs after the shard writes so process 0's manifest — always written
+    LAST — certifies that every shard landed.  Requires a path visible to
+    all processes (``gs://…`` or shared/local-per-test filesystem).
+
+    ``attempt``: a token shared by all writers of ONE save (the Optimizer
+    broadcasts a uuid from process 0 on the main thread).  It lands in
+    the shard filenames and the manifest, so a manifest can never certify
+    a stale shard left by a previous crashed attempt at the same step —
+    the freshness guarantee barriers would otherwise provide, made safe
+    for the unbarriered async path.  ``None`` (unit tests, single
+    writer) falls back to presence-only certification."""
+    sharded = opt_shards is not None
+    if not sharded and jax.process_index() != 0:
         return ""
     d = storage.join(path, f"ckpt-{step}")
     remote = storage.is_remote(path)
-    # local: write into a tmp dir, rename atomically.  remote: write blobs
-    # straight under the final prefix, manifest LAST — a crash mid-write
-    # leaves a prefix without a manifest, which readers skip.
-    tmp = d if remote else d + ".tmp"
-    if remote and storage.exists(storage.join(d, "manifest.json")):
+    # local: write into a tmp dir, rename atomically.  remote (and the
+    # multi-writer sharded mode, where a cross-host rename is impossible):
+    # write blobs straight under the final prefix, manifest LAST — a crash
+    # mid-write leaves a prefix without a manifest, which readers skip.
+    tmp = d if (remote or sharded) else d + ".tmp"
+    if (remote or sharded) and shard_index == 0 \
+            and storage.exists(storage.join(d, "manifest.json")):
         # re-reaching a step (preemption loop, rerun into the same bucket):
-        # the old manifest must go FIRST, or a crash mid-rewrite leaves new
-        # blobs certified complete by the stale manifest
-        storage.remove_tree(d, ignore_errors=False)
+        # the old MANIFEST must go first, or a crash mid-rewrite leaves
+        # new blobs certified complete by the stale manifest.  Only the
+        # manifest is removed — in unbarriered (async) sharded mode other
+        # hosts may already be writing fresh shards into this prefix, and
+        # a whole-tree removal would race them; stale-attempt shard files
+        # are made harmless by the attempt token in the filename instead.
+        storage.remove_tree(storage.join(d, "manifest.json"),
+                            ignore_errors=False)
+    if sharded and barrier is not None:
+        barrier()  # nobody writes shards until the stale manifest is gone
     storage.makedirs(tmp)
 
     def _savez(name, **arrs):
         with storage.open_file(storage.join(tmp, name), "wb") as f:
             np.savez(f, **arrs)
 
+    if sharded:
+        _savez(_shard_name(shard_index, shard_count, attempt),
+               **opt_shards)
+        if barrier is not None:
+            barrier()  # manifest below must certify ALL shards
+        if shard_index != 0:
+            return d
     _savez("params.npz", flat=np.asarray(flat_params))
     if ema_flat is not None:
         _savez("ema.npz", flat=np.asarray(ema_flat))
-    _savez("opt_state.npz", **_flatten_with_paths(opt_state))
+    if not sharded:
+        _savez("opt_state.npz", **_flatten_with_paths(opt_state))
     _savez("model_state.npz", **_flatten_with_paths(model_state))
 
     def _jsonable(v):
@@ -85,9 +161,13 @@ def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
         return False
 
     manifest = {"step": step, "driver_state": {
-        k: v for k, v in driver_state.items() if _jsonable(v)}}
+        k: v for k, v in (driver_state or {}).items() if _jsonable(v)}}
+    if sharded:
+        manifest["opt_shards"] = shard_count
+        if attempt is not None:
+            manifest["opt_shards_attempt"] = attempt
     storage.write_json(storage.join(tmp, "manifest.json"), manifest)
-    if not remote:
+    if tmp != d:
         if os.path.exists(d):
             shutil.rmtree(d)
         os.rename(tmp, d)
@@ -96,10 +176,20 @@ def save_checkpoint(path: str, step: int, *, flat_params, opt_state,
     return d
 
 
-def _complete_steps(path: str):
+def _shard_name(i: int, n: int, attempt: Optional[str]) -> str:
+    tok = f".{attempt}" if attempt else ""
+    return f"opt_state.shard{i:05d}-of-{n:05d}{tok}.npz"
+
+
+def _complete_steps(path: str, validate_shards: bool = True):
     """(step, name) for every COMPLETE checkpoint under ``path`` — one
     whose manifest exists (remote writes order it last, so a prefix
-    without one is a partial write; local tmp dirs are excluded by name)."""
+    without one is a partial write; local tmp dirs are excluded by name).
+    Sharded checkpoints additionally need every shard file of the
+    manifest's attempt present: in async mode shard writers are
+    unbarriered, so the manifest alone cannot certify laggard shards.
+    ``validate_shards=False`` (GC's deletion scan) skips the manifest
+    read + shard probes — deleting an incomplete old dir is fine."""
     if not storage.isdir(path):
         return []
     steps = []
@@ -109,8 +199,25 @@ def _complete_steps(path: str):
                 step = int(name.split("-")[1])
             except ValueError:
                 continue
-            if storage.exists(storage.join(path, name, "manifest.json")):
-                steps.append((step, name))
+            mpath = storage.join(path, name, "manifest.json")
+            if not storage.exists(mpath):
+                continue
+            if validate_shards:
+                try:
+                    manifest = storage.read_json(mpath)
+                except Exception as e:
+                    # transient remote read error must be VISIBLE: the
+                    # checkpoint is skipped this scan, not silently lost
+                    log.warning("could not read %s (%s); skipping this "
+                                "checkpoint for now", mpath, e)
+                    continue
+                n = int(manifest.get("opt_shards") or 0)
+                tok = manifest.get("opt_shards_attempt")
+                if n and not all(storage.exists(storage.join(
+                        path, name, _shard_name(i, n, tok)))
+                        for i in range(n)):
+                    continue
+            steps.append((step, name))
     return steps
 
 
@@ -121,6 +228,35 @@ def latest_checkpoint(path: str) -> Optional[str]:
     return storage.join(path, max(steps)[1])
 
 
+def _reassemble_opt_shards(ckpt_dir: str, n: int, template,
+                           attempt: Optional[str] = None
+                           ) -> Dict[str, np.ndarray]:
+    """Merge ``opt_state.shard*-of-*.npz`` back into full flat arrays.
+
+    Works for ANY current process count (resharding is free: sharded
+    leaves are flat slices placed at their recorded offsets).  Only the
+    manifest's ``attempt``-token files are read — stale shards from a
+    crashed earlier attempt at the same step are invisible."""
+    full: Dict[str, np.ndarray] = {}
+    tpl_flat = _flatten_with_paths(template)
+    for i in range(n):
+        shard = storage.load_npz(storage.join(
+            ckpt_dir, _shard_name(i, n, attempt)))
+        for key, arr in shard.items():
+            if key.endswith("@offset"):
+                continue
+            off_key = key + "@offset"
+            if off_key not in shard:  # replicated leaf: any copy works
+                full.setdefault(key, arr)
+                continue
+            if key not in full:
+                full[key] = np.zeros(tpl_flat[key].shape,
+                                     tpl_flat[key].dtype)
+            off = int(shard[off_key])
+            full[key][off:off + len(arr)] = arr
+    return full
+
+
 def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
                     ) -> Tuple[np.ndarray, Any, Any, Dict[str, Any]]:
     manifest = storage.read_json(storage.join(ckpt_dir, "manifest.json"))
@@ -128,7 +264,13 @@ def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
     ema_path = storage.join(ckpt_dir, "ema.npz")
     ema = (storage.load_npz(ema_path)["flat"]
            if storage.exists(ema_path) else None)
-    opt_flat = storage.load_npz(storage.join(ckpt_dir, "opt_state.npz"))
+    n_shards = manifest.get("opt_shards")
+    if n_shards:
+        opt_flat = _reassemble_opt_shards(
+            ckpt_dir, int(n_shards), opt_state_template,
+            attempt=manifest.get("opt_shards_attempt"))
+    else:
+        opt_flat = storage.load_npz(storage.join(ckpt_dir, "opt_state.npz"))
     mstate_flat = storage.load_npz(storage.join(ckpt_dir, "model_state.npz"))
     opt_state = _unflatten_like(opt_state_template, opt_flat)
     model_state = _unflatten_like(model_state_template, mstate_flat)
@@ -136,14 +278,18 @@ def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
 
 
 def _gc(path: str, keep_last: int):
-    entries = _complete_steps(path)
+    # deletion candidates need only a manifest, not validated shards —
+    # and skipping validation keeps GC to one exists() per dir instead of
+    # a manifest read + n shard probes on every checkpoint save
+    entries = _complete_steps(path, validate_shards=False)
     for _, name in sorted(entries)[:-keep_last] if keep_last > 0 else []:
         storage.remove_tree(storage.join(path, name), ignore_errors=True)
-    if entries and storage.is_remote(path):
+    if entries:
         # partial prefixes (crash mid-write: blobs, no manifest) are
-        # invisible to readers but still occupy the bucket; sweep any
-        # older than the newest complete step (a younger one may be a
-        # write in flight right now)
+        # invisible to readers but still occupy storage — both on object
+        # stores and in local/shared sharded mode, where multi-writer
+        # dirs cannot use tmp+rename; sweep any older than the newest
+        # complete step (a younger one may be a write in flight)
         newest = max(entries)[0]
         for name in storage.listdir(path):
             if not name.startswith("ckpt-") or name.endswith(".tmp"):

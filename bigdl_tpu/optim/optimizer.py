@@ -125,6 +125,7 @@ class Optimizer:
         self.clip: Optional[GradientClipping] = None
         self._ckpt_path: Optional[str] = None
         self._ckpt_trigger: Optional[Trigger] = None
+        self._ckpt_sharded = "auto"
         self._ckpt_async = None
         self._val_trigger: Optional[Trigger] = None
         self._val_dataset: Optional[DataSet] = None
@@ -170,16 +171,27 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger,
-                       async_write: bool = False) -> "Optimizer":
+                       async_write: bool = False,
+                       sharded="auto") -> "Optimizer":
         """``path`` may be a local directory or a remote URI (``gs://…``
         via the optional fsspec+gcsfs — the reference's
         ``setCheckpoint(hdfs://…)`` analog); a preemptible TPU VM must
         checkpoint off-VM to survive.  ``async_write=True`` snapshots to
         host at the trigger and runs the npz serialization on a
         background thread (one in flight) — the cheap-frequent-checkpoint
-        posture for preemptible slices."""
+        posture for preemptible slices.
+
+        ``sharded``: ``"auto"`` (default) writes the ZeRO-1 optimizer
+        state as per-process shard files whenever the job is multi-host —
+        each host writes 1/n of the state with NO cross-host allgather
+        (the Orbax-style pod-scale posture; the path must be visible to
+        every process, e.g. ``gs://…``).  ``False`` forces the gathered
+        single-writer format; ``True`` forces sharding.  Loading
+        reassembles shards for ANY process count, so resharding a resumed
+        job is free."""
         self._ckpt_path = path
         self._ckpt_trigger = trigger
+        self._ckpt_sharded = sharded
         self._ckpt_async = (ckpt.AsyncCheckpointer() if async_write
                             else None)
         return self
@@ -486,29 +498,82 @@ class Optimizer:
         schedule = getattr(self.optim_method, "schedule", None)
         if schedule is not None and hasattr(schedule, "state_dict"):
             state["schedule_state"] = schedule.state_dict()
-        kw = dict(
-            flat_params=np.asarray(step_engine.flat_params),
-            opt_state=host_fetch(step_engine.opt_state),
-            model_state=host_fetch(step_engine.model_state),
-            driver_state=state)
-        if step_engine.ema_flat is not None:
-            kw["ema_flat"] = np.asarray(step_engine.ema_flat)
+        kw = self._ckpt_kwargs(step_engine, state,
+                               sync_barrier=self._ckpt_async is None)
         if self._ckpt_async is not None:
             self._ckpt_async.submit(self._ckpt_path,
                                     state["iteration"], **kw)
         else:
             ckpt.save_checkpoint(self._ckpt_path, state["iteration"], **kw)
 
+    def _ckpt_kwargs(self, step_engine, state, sync_barrier: bool):
+        """The save_checkpoint argument set: gathered single-writer by
+        default, per-process opt-state shards when sharded checkpointing
+        is active.  Shards are fetched to host EAGERLY (the async writer
+        must never touch live device state), and the cross-process
+        barrier is only used on the synchronous path — a barrier inside
+        the async writer thread could interleave with the training
+        step's own collectives and deadlock; the READER instead verifies
+        every shard file exists before trusting a sharded manifest."""
+        kw = dict(model_state=host_fetch(step_engine.model_state),
+                  driver_state=state)
+        sharded = self._ckpt_use_shards(step_engine)
+        # params/EMA are replicated: in sharded mode only process 0's copy
+        # is ever written, so the other (n-1) hosts skip the full-model
+        # device→host materialization entirely
+        if not sharded or jax.process_index() == 0:
+            kw["flat_params"] = np.asarray(step_engine.flat_params)
+            if step_engine.ema_flat is not None:
+                kw["ema_flat"] = np.asarray(step_engine.ema_flat)
+        if sharded:
+            kw["opt_shards"] = ckpt.local_opt_shards(step_engine.opt_state)
+            kw["shard_index"] = jax.process_index()
+            kw["shard_count"] = jax.process_count()
+            kw["attempt"] = self._ckpt_attempt_token(state["iteration"])
+            if sync_barrier and jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                it = state["iteration"]
+                kw["barrier"] = lambda: multihost_utils.sync_global_devices(
+                    f"bigdl-tpu-ckpt-{it}")
+        else:
+            kw["opt_state"] = host_fetch(step_engine.opt_state)
+        return kw
+
+    @staticmethod
+    def _ckpt_attempt_token(iteration: int) -> str:
+        """One uuid per SAVE, agreed by every process: generated on
+        process 0 and broadcast on the MAIN thread (a collective here is
+        deterministic program order; inside the async writer thread it
+        could interleave with the training step's collectives and
+        deadlock).  The token makes shard files attempt-unique so a
+        manifest can never certify a stale shard from a crashed earlier
+        attempt at the same step."""
+        import uuid
+
+        if jax.process_count() == 1:
+            return uuid.uuid4().hex[:8]
+        from jax.experimental import multihost_utils
+
+        tok = np.frombuffer(
+            uuid.uuid4().hex[:8].encode(), np.uint8).copy() \
+            if jax.process_index() == 0 else np.zeros(8, np.uint8)
+        tok = multihost_utils.broadcast_one_to_all(tok)
+        return bytes(np.asarray(tok)).decode()
+
+    def _ckpt_use_shards(self, step_engine) -> bool:
+        if not step_engine.optim.elementwise:
+            return False  # replicated opt state: nothing to shard
+        if self._ckpt_sharded == "auto":
+            return jax.process_count() > 1
+        return bool(self._ckpt_sharded)
+
     def _save_checkpoint_sync_last(self, step_engine, state):
-        kw = {}
-        if step_engine.ema_flat is not None:
-            kw["ema_flat"] = np.asarray(step_engine.ema_flat)
         ckpt.save_checkpoint(
             self._ckpt_path, state["iteration"],
-            flat_params=np.asarray(step_engine.flat_params),
-            opt_state=host_fetch(step_engine.opt_state),
-            model_state=host_fetch(step_engine.model_state),
-            driver_state=dict(state, loss=float(state["loss"])), **kw)
+            **self._ckpt_kwargs(
+                step_engine, dict(state, loss=float(state["loss"])),
+                sync_barrier=True))
 
     def _ckpt_drain(self, raise_error: bool = True):
         """Join any in-flight async write (resume and exit paths read
