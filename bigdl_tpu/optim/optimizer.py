@@ -292,7 +292,8 @@ class Optimizer:
                  f"{step_engine.shard_size:,}")
 
         state: Dict[str, Any] = {
-            "epoch": 1, "iteration": 0, "epoch_finished": False,
+            "epoch": 1, "iteration": 0, "epoch_batch": 0,
+            "epoch_finished": False,
             "loss": float("nan"), "score": float("-inf"),
         }
 
@@ -341,10 +342,22 @@ class Optimizer:
                 break
             state["epoch_finished"] = False
             epoch = state["epoch"]
+            # exactly-once mid-epoch resume: a checkpoint records how many
+            # batches of the current epoch were TRAINED (epoch_batch); the
+            # resumed epoch fast-forwards past them instead of replaying
+            # the epoch from batch 0.  The skip re-gathers (and discards)
+            # at most one epoch of input once per resume — bounded, and
+            # the batch plan is deterministic per (seed, epoch).
+            skip = int(state.pop("_resume_skip", 0) or 0)
+            state["epoch_batch"] = skip
             batch_iter = self.dataset.batches(
                 self.batch_size, shuffle=True, seed=self.seed, epoch=epoch,
                 process_id=jax.process_index(),
                 process_count=jax.process_count())
+            if skip:
+                import itertools
+
+                batch_iter = itertools.islice(batch_iter, skip, None)
             if self.host_prefetch:
                 # host-side lookahead: IO/augmentation runs a thread ahead
                 batch_iter = thread_prefetch(batch_iter,
@@ -356,7 +369,9 @@ class Optimizer:
                             step_engine.shard_batch(np.asarray(mb["target"]))),
                 size=self.prefetch)
             try:
+                ran_any = False
                 for mb in batch_iter:
+                    ran_any = True
                     loss = self._one_iteration(step_engine, state, mb)
                     state["loss"] = loss  # device array; float() when read
                     if self._should_log(state):
@@ -380,9 +395,15 @@ class Optimizer:
                         break
                 else:
                     # epoch boundary: fire epoch triggers while `epoch` still
-                    # names the epoch that just finished, then advance
-                    state["epoch_finished"] = True
-                    self._fire_triggers(step_engine, state)
+                    # names the epoch that just finished, then advance.
+                    # A resume whose skip consumed the WHOLE epoch (the
+                    # checkpoint landed on its last batch) advances without
+                    # re-firing — those boundary triggers already ran
+                    # before the crash, and a duplicate validation event
+                    # would double-feed plateau schedules.
+                    if ran_any or skip == 0:
+                        state["epoch_finished"] = True
+                        self._fire_triggers(step_engine, state)
                     state["epoch"] += 1
             except Exception as e:  # driver retry loop (§6.3)
                 # A failed train_step may have consumed donated buffers, so
@@ -426,6 +447,7 @@ class Optimizer:
         with Timer(self.metrics, "step_dispatch"):
             loss = step_engine.train_step_device(it, step_rng, x_dev, y_dev)
         state["iteration"] = it + 1
+        state["epoch_batch"] = state.get("epoch_batch", 0) + 1
         return loss
 
     def _should_log(self, state) -> bool:
@@ -639,6 +661,12 @@ class Optimizer:
         step_engine.model_state = put_sharded(model_state, step_engine._rep)
         state.update(driver)
         state["epoch_finished"] = False
+        # fast-forward the resumed epoch past the batches already trained —
+        # from the CHECKPOINT's counter, never the live state's: on the
+        # in-run retry path the live epoch_batch reflects rolled-back
+        # training (a pre-epoch_batch-era checkpoint must replay, not skip)
+        state["epoch_batch"] = int(driver.get("epoch_batch", 0) or 0)
+        state["_resume_skip"] = state["epoch_batch"]
         sched_state = state.pop("schedule_state", None)
         schedule = getattr(self.optim_method, "schedule", None)
         if sched_state is not None and schedule is not None \
