@@ -19,6 +19,8 @@ import numpy as np
 
 import jax
 
+from bigdl_tpu.utils import storage
+
 
 def _split_obj(obj, n: int) -> List[Any]:
     """Split a numpy array / dict of arrays / tuple / pandas DataFrame into n
@@ -142,6 +144,16 @@ def _expand(path: Union[str, Sequence[str]]) -> List[str]:
         for p in path:
             out.extend(_expand(p))
         return out
+    if storage.is_remote(path):
+        # gs://bucket/dir, gs://bucket/part-*.csv, … — the multihost
+        # input path on TPU VMs reads straight from object storage (the
+        # reference's HDFS-glob analog); pandas/numpy open the returned
+        # URIs through fsspec
+        if storage.isdir(path):
+            return [storage.join(path, n)
+                    for n in storage.list_files(path)]
+        matches = storage.glob(path)
+        return matches or [path]
     if os.path.isdir(path):
         return sorted(
             p for p in _glob.glob(os.path.join(path, "*"))

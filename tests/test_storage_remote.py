@@ -232,6 +232,37 @@ def test_records_remote_roundtrip(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# XShards multihost file reads over a remote URI
+
+
+def test_read_csv_remote_sharded():
+    import pandas as pd
+
+    from bigdl_tpu.data.shards import read_csv
+
+    root = _uri("csvs")
+    for i in range(4):
+        with storage.open_file(storage.join(root, f"part-{i}.csv"),
+                               "w") as f:
+            f.write("a,b\n")
+            for r in range(3):
+                f.write(f"{i},{r}\n")
+    # directory form + glob form, unsharded
+    xs = read_csv(root)
+    assert len(xs._shards) == 4
+    total = pd.concat(xs._shards)
+    assert len(total) == 12 and sorted(total["a"].unique()) == [0, 1, 2, 3]
+    xs2 = read_csv(storage.join(root, "part-*.csv"))
+    assert len(xs2._shards) == 4
+    # sharded: each simulated process owns its round-robin slice
+    own0 = read_csv(root, process_id=0, process_count=2)
+    own1 = read_csv(root, process_id=1, process_count=2)
+    a0 = sorted(pd.concat(own0._shards)["a"].unique())
+    a1 = sorted(pd.concat(own1._shards)["a"].unique())
+    assert a0 == [0, 2] and a1 == [1, 3]
+
+
+# ---------------------------------------------------------------------------
 # resume-from-URI through the real Optimizer loop
 
 
