@@ -208,7 +208,7 @@ def main():
         from bench_loader import measure_loader
 
         cores = os.cpu_count() or 1
-        threads = sorted({1, 2, 4, 8, cores} & set(range(1, cores + 1)))
+        threads = sorted(t for t in {1, 2, 4, 8, cores} if t <= cores)
         curve = {}
         for t in threads:
             r = measure_loader(batch=256, n_batches=2, threads=t)

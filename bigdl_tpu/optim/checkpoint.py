@@ -30,11 +30,17 @@ from bigdl_tpu.utils.log import get_logger
 log = get_logger("bigdl_tpu.checkpoint")
 
 
+def _path_key(path) -> str:
+    """One flat string per pytree path — the npz key convention shared by
+    every save/load/shard function in this module."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        flat[key] = np.asarray(leaf)
+        flat[_path_key(path)] = np.asarray(leaf)
     return flat
 
 
@@ -42,8 +48,7 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        arr = flat[key]
+        arr = flat[_path_key(path)]
         leaves.append(arr.astype(np.asarray(leaf).dtype).reshape(np.asarray(leaf).shape))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
@@ -57,8 +62,7 @@ def local_opt_shards(tree) -> Dict[str, np.ndarray]:
     no cross-host allgather, unlike :func:`~..train_step.host_fetch`."""
     flat: Dict[str, np.ndarray] = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
+        key = _path_key(path)
         is_sharded = (
             isinstance(leaf, jax.Array) and leaf.ndim >= 1
             and not leaf.is_fully_replicated)
