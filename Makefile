@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast bench dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience bench dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -14,8 +14,9 @@ test-serial:
 
 # the quick pre-commit loop: skips tests marked slow (multi-process
 # integration + minutes-scale compile-shape checks); CI's `make test`
-# still runs everything.  A persistent same-machine compile cache
-# (tests/conftest.py) makes repeat runs much faster than cold ones.
+# still runs everything.  (The persistent compile cache is OFF by
+# default — BIGDL_TPU_TEST_CACHE=1 to opt in; see tests/conftest.py for
+# the segfault caveat on this image's jax build.)
 test-fast:
 	python -m pytest tests/ -q -x -m "not slow" -n auto
 
@@ -29,6 +30,11 @@ CORE_TESTS = tests/test_tensor.py tests/test_nn_layers.py \
   tests/test_storage_remote.py tests/test_watcher_single.py
 test-core:
 	python -m pytest $(CORE_TESTS) -q
+
+# the fault-tolerance suite (docs/resilience.md): fault injection,
+# supervisor resume, elastic resume, GC-never-deletes-last-valid
+test-resilience:
+	python -m pytest tests/test_resilience.py tests/test_ckpt_sharded.py -q
 
 bench:
 	python bench.py

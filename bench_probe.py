@@ -40,13 +40,29 @@ def _flops(fn, *args):
 
 
 def _time(fn, args, steps):
+    # BIGDL_TPU_FAULTS plans fire here too (slow_host stragglers, injected
+    # step failures), so straggler/fault overhead is measurable on the
+    # same harness as clean step time (docs/resilience.md).  There is no
+    # recovery machinery in a raw timing loop, so RAISING faults are
+    # absorbed and counted (the faulted step still costs its dispatch) —
+    # the fault count rides on the returned mean via _time.faults_fired.
+    from bigdl_tpu.resilience import faults
+
     out = fn(*args)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
+        try:
+            faults.fire_step(i)
+        except faults.InjectedFault as e:
+            _time.faults_fired += 1
+            print(f"  [fault injected at step {i}: {e}]")
         out = fn(*args)
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / steps
+
+
+_time.faults_fired = 0
 
 
 def main():
@@ -143,6 +159,8 @@ def main():
     report["phases"]["full_step"] = rec
     print("full_step", json.dumps(rec), flush=True)
 
+    if _time.faults_fired:
+        report["faults_fired"] = _time.faults_fired
     # atomic: a timeout-kill mid-dump must not leave a truncated artifact
     with open(args.out + ".tmp", "w") as f:
         json.dump(report, f, indent=1)

@@ -63,12 +63,31 @@ def write_records(path: str, fields: Dict[str, np.ndarray]) -> None:
     storage.write_json(path + ".json", manifest)
 
 
+def _remote_pair_fingerprint(path: str):
+    """Fingerprints of the (data, sidecar) remote pair; None entries mean
+    the backend cannot stat (freshness then unverifiable — keep cache)."""
+    return {"data": storage.fingerprint(path),
+            "sidecar": storage.fingerprint(path + ".json")}
+
+
 def _ensure_local(path: str) -> str:
-    """Remote record URIs (``gs://…``) download once into a local cache —
-    the mmap/native read path needs random access a remote object can't
-    give.  Cache dir: ``$BIGDL_TPU_RECORD_CACHE`` (default under the
-    system tempdir); keyed by URI hash so distinct sources never collide.
-    Set ``BIGDL_TPU_RECORD_CACHE_REFRESH=1`` to force re-download."""
+    """Remote record URIs (``gs://…``) download into a local cache — the
+    mmap/native read path needs random access a remote object can't give.
+    Cache dir: ``$BIGDL_TPU_RECORD_CACHE`` (default under the system
+    tempdir); keyed by URI hash so distinct sources never collide.
+
+    Freshness: the remote pair's size/etag/mtime fingerprints are stored
+    beside the cache (``<local>.src.json``); a later call re-checks them
+    and re-fetches when the remote object changed (overwritten dataset) —
+    no manual ``BIGDL_TPU_RECORD_CACHE_REFRESH=1`` needed, though it still
+    forces a re-download.
+
+    Atomicity: data AND sidecar download to a tmp pair first, then land
+    via back-to-back ``os.replace`` (data first, fingerprint record last),
+    so a crash can never pair a stale data file with a newer sidecar —
+    the failure ADVICE r5 flagged in the old per-file loop.  Per-process
+    tmp names keep racing processes from truncating each other; whichever
+    replace lands last wins with a complete, matched pair."""
     if not storage.is_remote(path):
         return path
     import hashlib
@@ -81,24 +100,57 @@ def _ensure_local(path: str) -> str:
     os.makedirs(cache_root, exist_ok=True)
     key = hashlib.sha1(path.encode()).hexdigest()[:16]
     local = os.path.join(cache_root, key + "_" + storage.basename(path))
-    refresh = os.environ.get("BIGDL_TPU_RECORD_CACHE_REFRESH") == "1"
-    # sidecar-last write order means: if the remote sidecar exists, the
-    # data object is complete; download data first + sidecar last locally
-    # too, so a crashed download is re-fetched (no local sidecar)
-    for src, dst in ((path, local), (path + ".json", local + ".json")):
-        if refresh or not os.path.exists(dst):
-            # per-process tmp name: two processes racing on the same URI
-            # must not truncate each other's in-flight download; whichever
-            # os.replace lands last wins with a complete file
-            tmp = f"{dst}.part.{os.getpid()}"
-            try:
-                with storage.open_file(src, "rb") as fi, \
-                        open(tmp, "wb") as fo:
-                    shutil.copyfileobj(fi, fo, 1 << 20)
-                os.replace(tmp, dst)
-            finally:
-                if os.path.exists(tmp):
-                    os.remove(tmp)
+    meta = local + ".src.json"
+
+    need = os.environ.get("BIGDL_TPU_RECORD_CACHE_REFRESH") == "1" \
+        or not (os.path.exists(local) and os.path.exists(local + ".json"))
+    fp = None
+    if not need:
+        fp = _remote_pair_fingerprint(path)
+        try:
+            with open(meta) as f:
+                cached = json.load(f)
+        except (OSError, ValueError):
+            cached = None  # pre-fingerprint cache or torn write: re-verify
+        # either half changing invalidates the pair: a re-uploaded sidecar
+        # (metadata fix) without new data must refetch just the same
+        if cached is None or any(
+                fp[k] is not None and fp[k] != cached.get(k)
+                for k in ("data", "sidecar")):
+            need = True
+            if cached is not None:
+                from bigdl_tpu.utils.log import get_logger
+
+                get_logger("bigdl_tpu.records").info(
+                    "remote records changed under cache key %s; "
+                    "re-fetching %s", key, path)
+    if not need:
+        return local
+
+    # fingerprint BEFORE downloading: if the remote changes mid-download
+    # the recorded (older) fingerprint won't match next check and the
+    # pair re-fetches, instead of a newer fingerprint masking the skew
+    if fp is None:
+        fp = _remote_pair_fingerprint(path)
+    tmps = {}
+    try:
+        for src, dst in ((path, local), (path + ".json", local + ".json")):
+            tmp = tmps[dst] = f"{dst}.part.{os.getpid()}"
+            with storage.open_file(src, "rb") as fi, open(tmp, "wb") as fo:
+                shutil.copyfileobj(fi, fo, 1 << 20)
+        # both halves complete: land them back-to-back, data first; the
+        # fingerprint record lands LAST so a crash anywhere earlier just
+        # re-fetches next time
+        os.replace(tmps[local], local)
+        os.replace(tmps[local + ".json"], local + ".json")
+        tmp = f"{meta}.part.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(fp, f)
+        os.replace(tmp, meta)
+    finally:
+        for tmp in list(tmps.values()) + [f"{meta}.part.{os.getpid()}"]:
+            if os.path.exists(tmp):
+                os.remove(tmp)
     return local
 
 

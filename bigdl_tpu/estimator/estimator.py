@@ -223,7 +223,15 @@ class Estimator:
             validation_data=None,
             validation_methods: Sequence[ValidationMethod] = (),
             checkpoint_path: Optional[str] = None,
-            checkpoint_trigger: Optional[Trigger] = None) -> Dict[str, Any]:
+            checkpoint_trigger: Optional[Trigger] = None,
+            fault_tolerance=False) -> Dict[str, Any]:
+        """``fault_tolerance``: opt-in recovery for the whole fit — True
+        runs the training loop under a ``resilience.Supervisor`` with the
+        engine's FailurePolicy (pass a ``FailurePolicy`` to override):
+        failures that escape the driver's in-run retry are classified,
+        backed off per cause, and training re-enters from the newest
+        shard-complete checkpoint (``checkpoint_path`` strongly advised —
+        without one the supervisor can only restart from scratch)."""
         ds = _to_xy(data, batch_size)
         opt = Optimizer(self.model, ds, self.criterion,
                         batch_size=batch_size)
@@ -243,12 +251,29 @@ class Estimator:
             opt.set_checkpoint(checkpoint_path,
                                checkpoint_trigger or Trigger.every_epoch())
         t0 = time.time()
-        self._trained = opt.optimize()
+        if fault_tolerance:
+            from bigdl_tpu.resilience.retry import FailurePolicy
+            from bigdl_tpu.resilience.supervisor import Supervisor
+
+            policy = (fault_tolerance
+                      if isinstance(fault_tolerance, FailurePolicy) else None)
+            if checkpoint_path is None:
+                log.warning("fit(fault_tolerance=...) without "
+                            "checkpoint_path: recovery can only restart "
+                            "from scratch")
+            self._trained = Supervisor(opt, policy=policy).run()
+        else:
+            self._trained = opt.optimize()
         self._last_stats = {
             "train_time_s": time.time() - t0,
             "epochs": epochs,
             "num_samples": ds.size(),
         }
+        recov = opt.metrics.counter("recoveries_total")
+        if recov:
+            self._last_stats["recoveries_total"] = recov
+            self._last_stats["time_lost_to_recovery_s"] = \
+                opt.metrics.counter("time_lost_to_recovery_s")
         return self._last_stats
 
     # -- inference ----------------------------------------------------------

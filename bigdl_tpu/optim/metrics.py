@@ -19,10 +19,20 @@ class Metrics:
     def __init__(self):
         self.sums: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        # monotonic counters (recoveries_total, retries_by_cause.*,
+        # time_lost_to_recovery_s, ...): run-lifetime totals, so they
+        # survive the per-log-window reset() that clears the timers
+        self.counters: Dict[str, float] = defaultdict(float)
 
     def add(self, name: str, value: float):
         self.sums[name] += value
         self.counts[name] += 1
+
+    def inc(self, name: str, n: float = 1):
+        self.counters[name] += n
+
+    def counter(self, name: str) -> float:
+        return self.counters.get(name, 0.0)
 
     def mean(self, name: str) -> float:
         c = self.counts[name]
@@ -33,7 +43,9 @@ class Metrics:
         self.counts.clear()
 
     def summary(self) -> Dict[str, float]:
-        return {k: self.mean(k) for k in self.sums}
+        out = {k: self.mean(k) for k in self.sums}
+        out.update(self.counters)
+        return out
 
 
 class Timer:

@@ -28,6 +28,8 @@ from bigdl_tpu.optim.train_step import (
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.resilience.retry import classify
 from bigdl_tpu.runtime.engine import Engine
 from bigdl_tpu.utils.log import get_logger
 
@@ -147,6 +149,14 @@ class Optimizer:
         #                            (long-context; model attention must be
         #                            seq_parallel-aware)
         self.metrics = Metrics()
+        self.watchdog = None  # resilience.StepWatchdog (Supervisor installs
+        #                       one; set directly for standalone NaN/hang
+        #                       detection)
+        self.failure_policy = None  # per-Optimizer FailurePolicy override
+        #                             (Supervisor propagates its own here so
+        #                             the in-run retry loop honors the same
+        #                             per-cause bounds); None = engine's
+        self._final_state: Optional[Dict[str, Any]] = None
         self._last_val_iter = -1
         self._last_ckpt_iter = -1
         self._preempt_signals: tuple = ()
@@ -332,6 +342,7 @@ class Optimizer:
     def _optimize_loop(self, step_engine, state) -> TrainedModel:
         engine = Engine.get()
         retries = 0
+        retries_by_cause: Dict[Any, int] = {}
         max_retries = engine.config.failure_retry_times
         t_loop = time.perf_counter()
         while not self.end_when(state):
@@ -409,18 +420,39 @@ class Optimizer:
                 # A failed train_step may have consumed donated buffers, so
                 # recovery REQUIRES a checkpoint to restore from; the epoch
                 # restarts cleanly from the resumed driver state.
+                # latest_checkpoint accepts only SHARD-COMPLETE dirs, so a
+                # manifest orphaned by a crashed sharded write is never the
+                # resume point.
                 retries += 1
+                t_fail = time.perf_counter()
+                cause = classify(e)
+                policy = self.failure_policy \
+                    or engine.config.resolved_failure_policy()
+                cause_policy = policy.policy_for(cause)
+                n_cause = retries_by_cause[cause] = \
+                    retries_by_cause.get(cause, 0) + 1
                 # in-flight async write may BE the latest checkpoint
                 self._ckpt_drain(raise_error=False)
                 can_resume = (self._ckpt_path and
                               ckpt.latest_checkpoint(self._ckpt_path))
-                if retries > max_retries or not can_resume:
+                # bounded BOTH globally and per cause: a poisoned batch
+                # replays the identical plan, so its policy allows far
+                # fewer in-run retries than a storage blip — exhausting
+                # either bound escapes to the Supervisor (or the caller)
+                if retries > max_retries or not can_resume \
+                        or n_cause > cause_policy.max_retries:
                     raise
+                delay = cause_policy.backoff(n_cause)
                 log.warning(
-                    "iteration failed (%s); retry %d/%d from checkpoint",
-                    e, retries, max_retries)
-                time.sleep(engine.config.failure_retry_interval_s)
+                    "iteration failed (%s: %s); retry %d/%d from checkpoint "
+                    "[cause %s] in %.2fs", type(e).__name__, e, retries,
+                    max_retries, cause.value, delay)
+                time.sleep(delay)
                 self._try_resume(step_engine, state)
+                self.metrics.inc("recoveries_total")
+                self.metrics.inc(f"retries_by_cause.{cause.value}")
+                self.metrics.inc("time_lost_to_recovery_s",
+                                 time.perf_counter() - t_fail)
                 self._last_log = None  # don't count recovery in step time
 
         try:
@@ -435,11 +467,23 @@ class Optimizer:
             except Exception as e2:
                 log.error("synchronous checkpoint retry also failed: %s", e2)
         variables = step_engine.get_variables()
+        self._final_state = dict(state)  # observability: final step/epoch
         return TrainedModel(self.model, variables, step_engine)
+
+    @property
+    def final_state(self) -> Optional[Dict[str, Any]]:
+        """Driver state at the end of the last completed ``optimize()`` —
+        lets callers (tests, the Supervisor) verify e.g. that a faulted
+        run reached the same final iteration as a fault-free one."""
+        return self._final_state
 
     # ------------------------------------------------------------------
     def _one_iteration(self, step_engine, state, mb):
         it = state["iteration"]
+        faults.fire_step(it)  # injection: slow_host / process_kill /
+        #                       step_fail (no-op without a fault plan)
+        if self.watchdog is not None:
+            self.watchdog.step_started(it)
         if self._profiler is not None:
             self._profiler.step(it)
         step_rng = jax.random.fold_in(jax.random.PRNGKey(self.seed + 1), it)
@@ -462,6 +506,11 @@ class Optimizer:
         # the in-flight queue hides device latency.
         loss = float(state["loss"])
         state["loss"] = loss
+        if self.watchdog is not None:
+            # the float() above already forced the device sync, so the
+            # NaN-streak check costs nothing extra; raises PoisonedStepError
+            # into the retry loop after nan_patience bad observations
+            self.watchdog.observe_loss(it, loss)
         now = time.perf_counter()
         last = getattr(self, "_last_log", None)
         if last is not None and it > last[1]:
@@ -537,6 +586,11 @@ class Optimizer:
         the async writer thread could interleave with the training
         step's own collectives and deadlock; the READER instead verifies
         every shard file exists before trusting a sharded manifest."""
+        # the mid-epoch batch plan is keyed by process_count; recording it
+        # in every written driver_state lets an elastic resume detect the
+        # key changed (see _try_resume) — `state` is already a snapshot on
+        # both call paths, so mutating it here is safe
+        state["process_count"] = jax.process_count()
         kw = dict(model_state=host_fetch(step_engine.model_state),
                   driver_state=state)
         sharded = self._ckpt_use_shards(step_engine)
@@ -661,12 +715,42 @@ class Optimizer:
         step_engine.model_state = put_sharded(model_state, step_engine._rep)
         state.update(driver)
         state["epoch_finished"] = False
+        # rolled back: trigger bookkeeping beyond the resumed iteration is
+        # stale future state — without this reset, a checkpoint/validation
+        # trigger that FAILED at iteration N would never re-fire when the
+        # replay reaches N again (the run would end missing its last
+        # checkpoint).  The resumed iteration itself stays marked: the
+        # checkpoint being resumed from IS that iteration's firing.
+        it = int(driver.get("iteration", 0) or 0)
+        self._last_ckpt_iter = min(self._last_ckpt_iter, it)
+        self._last_val_iter = min(self._last_val_iter, it)
+        self._last_hist_iter = min(self._last_hist_iter, it)
         # fast-forward the resumed epoch past the batches already trained —
         # from the CHECKPOINT's counter, never the live state's: on the
         # in-run retry path the live epoch_batch reflects rolled-back
         # training (a pre-epoch_batch-era checkpoint must replay, not skip)
         state["epoch_batch"] = int(driver.get("epoch_batch", 0) or 0)
         state["_resume_skip"] = state["epoch_batch"]
+        # ELASTIC resume: sharded checkpoints load at any process count,
+        # but the per-process batch plan is keyed by (seed, epoch,
+        # process_id, process_count) — a skip computed under N processes
+        # does not line up with what was trained when resuming under M.
+        # Fall back to replaying the epoch from its start: batches are
+        # re-trained, never silently dropped.
+        saved_pc = driver.get("process_count")
+        state["process_count"] = jax.process_count()
+        if saved_pc is not None and int(saved_pc) != jax.process_count() \
+                and state["_resume_skip"]:
+            log.warning(
+                "elastic resume: checkpoint written at process_count=%d, "
+                "resuming at %d — the per-process batch plan differs, so "
+                "epoch %d REPLAYS from its start (%d mid-epoch batches "
+                "re-trained rather than silently dropped)",
+                int(saved_pc), jax.process_count(), state["epoch"],
+                state["_resume_skip"])
+            state["epoch_batch"] = 0
+            state["_resume_skip"] = 0
+            self.metrics.inc("elastic_resumes_total")
         sched_state = state.pop("schedule_state", None)
         schedule = getattr(self.optim_method, "schedule", None)
         if sched_state is not None and schedule is not None \

@@ -32,12 +32,8 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map  # jax >= 0.6
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
-from bigdl_tpu.runtime.mesh import AXIS_DATA, AXIS_DCN, AXIS_SEQ
+from bigdl_tpu.runtime.mesh import (AXIS_DATA, AXIS_DCN, AXIS_SEQ,
+                                    axis_size, shard_map)
 
 
 def as_inputs(x):
@@ -337,7 +333,7 @@ class ShardedParameterStep:
             if dcn_axis:
                 replica = replica + ndev * jax.lax.axis_index(dcn_axis)
             if seq_par:
-                replica = (replica * jax.lax.axis_size(AXIS_SEQ)
+                replica = (replica * axis_size(AXIS_SEQ)
                            + jax.lax.axis_index(AXIS_SEQ))
             dev_rng = jax.random.fold_in(rng, replica)
 
@@ -460,7 +456,6 @@ class ShardedParameterStep:
             in_specs=(P(), P(), opt_spec, P(), P(), P(), x_spec, y_spec,
                       P()),
             out_specs=(P(), P(), opt_spec, P(), P()),
-            check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
@@ -494,7 +489,7 @@ class ShardedParameterStep:
         mapped = shard_map(
             eval_shard, mesh=self.mesh,
             in_specs=(P(), P(), x_spec, y_spec, w_spec),
-            out_specs=P(), check_vma=False)
+            out_specs=P())
         return jax.jit(mapped)
 
     @property
@@ -623,7 +618,7 @@ class ShardedParameterStep:
                         _cache[key] = jax.jit(shard_map(
                             raw, mesh=mesh,
                             in_specs=(P(), P(), self._batch_specs(x)),
-                            out_specs=out_spec, check_vma=False))
+                            out_specs=out_spec))
                     return _cache[key](flat_p, mstate, x)
             else:
                 fwd = jax.jit(raw)

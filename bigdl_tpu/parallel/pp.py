@@ -29,10 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map  # jax >= 0.6
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.runtime.mesh import shard_map
 
 from bigdl_tpu.runtime.mesh import AXIS_PIPE
 
@@ -217,7 +214,7 @@ def pipeline_apply_circular(mesh: Mesh, stage_fn: Callable, stacked_params,
         fn, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
                                          stacked_params), P()),
-        out_specs=P(), check_vma=False)
+        out_specs=P())
     return unmicrobatch(mapped(stacked_params,
                                microbatch(x, num_microbatches)))
 
@@ -254,5 +251,5 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params, x,
         fn, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
                                          stacked_params), P()),
-        out_specs=P(), check_vma=False)
+        out_specs=P())
     return unmicrobatch(mapped(stacked_params, microbatch(x, num_microbatches)))

@@ -24,10 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map  # jax >= 0.6
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.runtime.mesh import shard_map
 
 from bigdl_tpu.parallel.pp import (microbatch, spmd_pipeline,
                                    spmd_pipeline_circular, unmicrobatch)
@@ -132,8 +129,7 @@ class PipelineTrainStep:
             shard, mesh=self.mesh,
             in_specs=(self._p_spec, self._opt_spec, P(), P(AXIS_DATA),
                       P(AXIS_DATA)),
-            out_specs=(self._p_spec, self._opt_spec, P()),
-            check_vma=False)
+            out_specs=(self._p_spec, self._opt_spec, P()))
         return jax.jit(mapped, donate_argnums=(0, 1))
 
     def train_step(self, step: int, x, y):

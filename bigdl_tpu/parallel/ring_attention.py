@@ -20,6 +20,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.runtime.mesh import axis_size
+
 
 NEG_INF = -1e30
 
@@ -57,7 +59,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     """
     b, h, c, d = q.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
-    n_blocks = jax.lax.axis_size(axis_name)
+    n_blocks = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
 
     q32 = q.astype(jnp.float32)
@@ -96,17 +98,13 @@ def seq_sharded_call(kernel, mesh, q, k, v, axis_name: str,
     (b, h, L, d) arrays over the mesh's ``axis_name`` (sequence dim) and
     run ``kernel(q, k, v, axis_name=..., causal=...)`` under shard_map.
     Used by both ring and Ulysses attention."""
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from bigdl_tpu.runtime.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         partial(kernel, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -1,0 +1,38 @@
+"""``bigdl_tpu.resilience`` — fault-tolerant training.
+
+Four layers (see ``docs/resilience.md`` for the failure model):
+
+- :mod:`.faults`    — deterministic fault injection (tests, bench_probe)
+- :mod:`.detector`  — heartbeats (phi-accrual) + step watchdog
+- :mod:`.retry`     — retry policies, failure classification, FailurePolicy
+- :mod:`.supervisor`— the optimize() retry loop; elastic resume guarantee
+
+``Supervisor``/``supervise`` import lazily: they pull in the optimizer and
+engine layers, which themselves import the leaf modules above — an eager
+import here would cycle.
+"""
+
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.resilience.detector import (Heartbeat, HeartbeatMonitor,
+                                           StepWatchdog)
+from bigdl_tpu.resilience.faults import (FaultInjector, FaultSpec,
+                                         InjectedFault)
+from bigdl_tpu.resilience.retry import (FailureCause, FailurePolicy,
+                                        PoisonedStepError, RetryPolicy,
+                                        TopologyChangedError, classify)
+
+__all__ = [
+    "faults", "FaultInjector", "FaultSpec", "InjectedFault",
+    "Heartbeat", "HeartbeatMonitor", "StepWatchdog",
+    "FailureCause", "FailurePolicy", "PoisonedStepError", "RetryPolicy",
+    "TopologyChangedError", "classify",
+    "Supervisor", "supervise",
+]
+
+
+def __getattr__(name):
+    if name in ("Supervisor", "supervise"):
+        from bigdl_tpu.resilience import supervisor as _sup
+
+        return getattr(_sup, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,245 @@
+"""Deterministic fault injection — the testable half of fault tolerance.
+
+Reference analog (unverified — mount empty): the reference exercises its
+driver retry loop (``bigdl.failure.retryTimes``) only against real executor
+loss; there is no first-class injection harness.  Here every recovery path
+must be exercisable on CPU under tier-1, so failures are INJECTED at named
+points with deterministic triggers: a run with the same fault plan fires the
+same faults at the same invocations, every time, on every process.
+
+Injection points (instrumented call sites in parentheses):
+
+- ``step_fail``             — raise inside the train iteration
+                              (``Optimizer._one_iteration``)
+- ``checkpoint_write_fail`` — raise mid-checkpoint, after blobs and BEFORE
+                              the manifest (``checkpoint.save_checkpoint``),
+                              leaving the partial prefix readers must skip
+- ``storage_io_fail``       — raise from the storage seam
+                              (``utils.storage.open_file``)
+- ``process_kill``          — ``os._exit`` (or raise, for in-process tests)
+                              from the train iteration
+- ``slow_host``             — sleep inside the train iteration (straggler)
+
+Triggers per spec: ``at_step`` (fires when the instrumented site passes that
+step), ``every`` (every Nth invocation), ``probability`` (deterministic
+pseudo-randomness: a hash of (seed, point, invocation count) — NOT a live
+RNG, so two runs of the same plan agree).  ``max_fires`` bounds total fires
+(defaults to 1 for ``at_step`` specs so a resumed run that replays the step
+does not die forever on it).
+
+Config: programmatic (``install([FaultSpec(...)])``) or env —
+
+    BIGDL_TPU_FAULTS="step_fail@5;checkpoint_write_fail:p=0.5;slow_host@3:delay=0.2"
+
+entries split on ``;``, each ``point[@step][:key=val[:key=val]...]`` with
+keys ``p`` (probability), ``every``, ``max`` (max_fires), ``delay``
+(seconds, slow_host), ``seed``, ``action`` (``raise``/``exit``/``sleep``).
+The env plan is read once, lazily, at the first instrumented call.
+"""
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.resilience")
+
+POINTS = ("step_fail", "checkpoint_write_fail", "storage_io_fail",
+          "process_kill", "slow_host")
+
+
+class InjectedFault(RuntimeError):
+    """Base of every injected failure; ``point`` names the injection site."""
+
+    def __init__(self, point: str, step=None, count: int = 0):
+        super().__init__(
+            f"injected fault {point!r}"
+            + (f" at step {step}" if step is not None else "")
+            + f" (invocation {count})")
+        self.point = point
+        self.step = step
+        self.count = count
+
+
+class InjectedStepFailure(InjectedFault):
+    pass
+
+
+class InjectedCheckpointWriteError(InjectedFault):
+    pass
+
+
+class InjectedStorageError(InjectedFault, OSError):
+    """Classified as transient storage by :func:`..retry.classify`."""
+
+
+class ProcessKilledError(InjectedFault):
+    """``process_kill`` in ``action="raise"`` mode (in-process tests)."""
+
+
+_EXC = {
+    "step_fail": InjectedStepFailure,
+    "checkpoint_write_fail": InjectedCheckpointWriteError,
+    "storage_io_fail": InjectedStorageError,
+    "process_kill": ProcessKilledError,
+    "slow_host": InjectedFault,
+}
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    at_step: Optional[int] = None
+    probability: float = 0.0
+    every: Optional[int] = None
+    max_fires: Optional[int] = None   # None: 1 when at_step set, else ∞
+    delay_s: float = 0.2              # slow_host sleep
+    action: Optional[str] = None      # raise | exit | sleep (point default)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r}; one of {POINTS}")
+        if self.action is None:
+            self.action = {"slow_host": "sleep",
+                           "process_kill": "exit"}.get(self.point, "raise")
+        if self.max_fires is None and self.at_step is not None:
+            self.max_fires = 1
+
+
+def _unit_hash(seed: int, point: str, count: int) -> float:
+    """Deterministic pseudo-uniform in [0, 1) — the probability trigger.
+    A hash, not an RNG stream: trigger decisions depend only on
+    (seed, point, invocation index), never on evaluation order."""
+    import hashlib
+
+    h = hashlib.blake2b(f"{seed}:{point}:{count}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "little") / 2.0 ** 64
+
+
+class FaultInjector:
+    """Evaluates a fault plan at instrumented points.  Records every fire in
+    ``events`` (``(point, step, invocation)``) so tests can assert on the
+    exact pattern."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self.specs = list(specs)
+        self._invocations: Dict[str, int] = {}
+        self._fires: Dict[int, int] = {}
+        self.events: List[Tuple[str, Optional[int], int]] = []
+
+    def fire(self, point: str, step: Optional[int] = None) -> None:
+        """Called by an instrumented site; raises/sleeps/exits per plan."""
+        count = self._invocations.get(point, 0)
+        self._invocations[point] = count + 1
+        for i, spec in enumerate(self.specs):
+            if spec.point != point:
+                continue
+            if not self._should_fire(i, spec, step, count):
+                continue
+            self._fires[i] = self._fires.get(i, 0) + 1
+            self.events.append((point, step, count))
+            log.warning("fault injection: firing %r (step=%s, invocation %d)",
+                        point, step, count)
+            if spec.action == "sleep":
+                time.sleep(spec.delay_s)
+            elif spec.action == "exit":
+                os._exit(113)
+            else:
+                raise _EXC[point](point, step=step, count=count)
+
+    def _should_fire(self, i: int, spec: FaultSpec,
+                     step: Optional[int], count: int) -> bool:
+        if spec.max_fires is not None \
+                and self._fires.get(i, 0) >= spec.max_fires:
+            return False
+        if spec.at_step is not None:
+            return step == spec.at_step
+        if spec.every is not None:
+            return (count + 1) % spec.every == 0
+        if spec.probability > 0.0:
+            return _unit_hash(spec.seed, spec.point, count) < spec.probability
+        return False
+
+
+# -- module-level plan (what the instrumented sites consult) ---------------
+
+_injector: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def install(specs) -> FaultInjector:
+    """Install a fault plan process-wide; returns the injector (its
+    ``events`` list is the test observability surface)."""
+    global _injector, _env_checked
+    if isinstance(specs, FaultInjector):
+        _injector = specs
+    else:
+        _injector = FaultInjector(list(specs))
+    _env_checked = True
+    return _injector
+
+
+def clear() -> None:
+    global _injector, _env_checked
+    _injector = None
+    _env_checked = True  # an explicit clear() also disables the env plan
+
+
+def get() -> Optional[FaultInjector]:
+    return _injector
+
+
+def fire(point: str, step: Optional[int] = None) -> None:
+    """The instrumented-site entry: near-zero cost when no plan is
+    installed (one None check after the lazy env probe)."""
+    global _injector, _env_checked
+    if _injector is None:
+        if _env_checked:
+            return
+        _env_checked = True
+        plan = os.environ.get("BIGDL_TPU_FAULTS")
+        if not plan:
+            return
+        _injector = FaultInjector(parse_plan(plan))
+    _injector.fire(point, step=step)
+
+
+def fire_step(step: int) -> None:
+    """All step-scoped points, in hazard order: a straggler is slow BEFORE
+    it fails, and a kill beats a clean exception."""
+    fire("slow_host", step=step)
+    fire("process_kill", step=step)
+    fire("step_fail", step=step)
+
+
+def parse_plan(text: str) -> List[FaultSpec]:
+    """Parse the ``BIGDL_TPU_FAULTS`` grammar (module docstring)."""
+    specs = []
+    for entry in filter(None, (e.strip() for e in text.split(";"))):
+        head, *opts = entry.split(":")
+        point, at = (head.split("@", 1) + [None])[:2]
+        kw = dict(point=point.strip(),
+                  at_step=int(at) if at is not None else None)
+        for opt in opts:
+            k, _, v = opt.partition("=")
+            k = k.strip()
+            if k == "p":
+                kw["probability"] = float(v)
+            elif k == "every":
+                kw["every"] = int(v)
+            elif k == "max":
+                kw["max_fires"] = int(v)
+            elif k == "delay":
+                kw["delay_s"] = float(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            elif k == "action":
+                kw["action"] = v.strip()
+            else:
+                raise ValueError(f"unknown fault option {k!r} in {entry!r}")
+        specs.append(FaultSpec(**kw))
+    return specs

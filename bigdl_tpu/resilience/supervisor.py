@@ -1,0 +1,202 @@
+"""Training supervisor — the ``DistriOptimizer.optimize()`` retry loop,
+rebuilt as a layer OVER the driver instead of a branch inside it.
+
+Reference analog (unverified — mount empty): ``DistriOptimizer.optimize()``
+catches a failed iteration, reloads the last checkpoint and retries up to
+``bigdl.failure.retryTimes`` ("BigDL 2.0", arXiv 2204.01715, names this
+transparent failure recovery as a Spark-control-plane headline).  The
+Optimizer here keeps its cheap IN-RUN retry (same process, device state
+restorable from checkpoint); the Supervisor adds what that loop cannot do:
+
+- survive failures that escape ``optimize()`` entirely (exhausted in-run
+  retries, failures during resume itself, process-level errors surfaced
+  by a restarted run),
+- classify the cause (:func:`~.retry.classify`) and apply a PER-CAUSE
+  retry policy — transient storage retries hard, a poisoned batch barely,
+  a topology change not at all (it resumes elastically instead),
+- re-enter ``optimize()`` from scratch, which REBUILDS the step engine and
+  reloads the newest FULLY-VALIDATED checkpoint (``latest_checkpoint``
+  accepts only shard-complete directories — a manifest alone certifies
+  nothing in async sharded mode),
+- account every recovery in ``Metrics`` counters: ``recoveries_total``,
+  ``retries_by_cause.<cause>``, ``time_lost_to_recovery_s``.
+
+Elastic resume: the driver records ``process_count`` in checkpoint driver
+state; ``Optimizer._try_resume`` detects a mismatch at load and falls back
+to replay-from-epoch-start with an explicit warning (the per-process batch
+plan is keyed by process_count, so a mid-epoch skip computed under N
+processes is meaningless under M).  The supervisor just guarantees the
+resume happens from a restorable checkpoint.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.resilience.detector import (Heartbeat, HeartbeatMonitor,
+                                           StepWatchdog)
+from bigdl_tpu.resilience.retry import (FailureCause, FailurePolicy,
+                                        classify)
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.resilience")
+
+
+class Supervisor:
+    """Wraps an :class:`~bigdl_tpu.optim.optimizer.Optimizer`; ``run()``
+    returns what ``optimize()`` would, surviving what it would not."""
+
+    def __init__(self, optimizer, policy: Optional[FailurePolicy] = None,
+                 sleep=time.sleep):
+        self.optimizer = optimizer
+        if policy is None:
+            from bigdl_tpu.runtime.engine import Engine
+
+            policy = Engine.get().config.resolved_failure_policy()
+        self.policy = policy
+        if getattr(optimizer, "failure_policy", None) is None:
+            # the driver's in-run retry loop must enforce the same
+            # per-cause bounds as the supervision loop around it
+            optimizer.failure_policy = policy
+        self.metrics = optimizer.metrics
+        self._sleep = sleep
+        self.restarts_total = 0
+        self._by_cause: Dict[FailureCause, int] = {}
+
+    # -- the supervision loop ----------------------------------------------
+    def run(self):
+        policy = self.policy
+        heartbeat = monitor_stop = None
+        if policy.heartbeat_dir:
+            heartbeat = Heartbeat(
+                policy.heartbeat_dir,
+                interval_s=policy.heartbeat_interval_s).start()
+            monitor_stop = self._start_peer_monitor(policy)
+        if getattr(self.optimizer, "watchdog", None) is None:
+            self.optimizer.watchdog = StepWatchdog(
+                step_timeout_s=policy.watchdog_step_timeout_s,
+                nan_patience=policy.nan_patience)
+        # the watchdog's hang half only works if something POLLS it; the
+        # driver thread is the one that may be wedged in XLA, so polling
+        # runs on the watchdog's own background thread
+        own_watchdog_thread = self.optimizer.watchdog._thread is None
+        if own_watchdog_thread:
+            self.optimizer.watchdog.start(
+                poll_interval_s=max(1.0, min(
+                    30.0, policy.watchdog_step_timeout_s / 4)))
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    return self.optimizer.optimize()
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    self._recover_or_raise(e, time.perf_counter() - t0)
+        finally:
+            if own_watchdog_thread:
+                self.optimizer.watchdog.stop()
+            if monitor_stop is not None:
+                monitor_stop.set()
+            if heartbeat is not None:
+                heartbeat.stop()
+
+    def _start_peer_monitor(self, policy) -> threading.Event:
+        """Background phi-accrual sweep over the peers' heartbeats: a peer
+        crossing ``heartbeat_phi_threshold`` is logged (once per episode)
+        and counted — the observability half of liveness; acting on it
+        (restart/elastic resume) belongs to the process manager."""
+        monitor = HeartbeatMonitor(policy.heartbeat_dir)
+        stop = threading.Event()
+        suspected = set()
+
+        def sweep():
+            while not stop.wait(policy.heartbeat_interval_s):
+                try:
+                    now_suspect = set(monitor.suspects(
+                        threshold=policy.heartbeat_phi_threshold))
+                except Exception as e:  # shared dir blip: retry next sweep
+                    log.warning("heartbeat sweep failed: %s", e)
+                    continue
+                for idx in sorted(now_suspect - suspected):
+                    log.error("peer process %d SUSPECTED dead "
+                              "(phi > %.1f)", idx,
+                              policy.heartbeat_phi_threshold)
+                    self.metrics.inc("peers_suspected_total")
+                for idx in sorted(suspected - now_suspect):
+                    log.info("peer process %d recovered", idx)
+                suspected.clear()
+                suspected.update(now_suspect)
+
+        threading.Thread(target=sweep, name="bigdl-tpu-hb-monitor",
+                         daemon=True).start()
+        return stop
+
+    def _recover_or_raise(self, exc: Exception, run_time_s: float) -> None:
+        """Account the failure; raise when the policy is exhausted or the
+        restart could not be made safe; otherwise sleep the backoff and
+        let the loop re-enter ``optimize()``."""
+        cause = classify(exc)
+        retry_policy = self.policy.policy_for(cause)
+        self.restarts_total += 1
+        attempt = self._by_cause[cause] = self._by_cause.get(cause, 0) + 1
+        if self.restarts_total > self.policy.max_restarts:
+            log.error("supervisor: restart budget exhausted (%d); giving up",
+                      self.policy.max_restarts)
+            raise exc
+        if attempt > retry_policy.max_retries \
+                and cause is not FailureCause.TOPOLOGY_CHANGE:
+            log.error("supervisor: %s retries exhausted (%d); giving up",
+                      cause.value, retry_policy.max_retries)
+            raise exc
+        t_rec = time.perf_counter()
+        if not self._restartable():
+            raise exc
+        self.metrics.inc("recoveries_total")
+        self.metrics.inc(f"retries_by_cause.{cause.value}")
+        delay = retry_policy.backoff(attempt)
+        log.warning(
+            "supervisor: run failed after %.1fs (%s: %s); restart %d/%d "
+            "[cause %s, attempt %d] in %.2fs",
+            run_time_s, type(exc).__name__, exc, self.restarts_total,
+            self.policy.max_restarts, cause.value, attempt, delay)
+        self._sleep(delay)
+        # only handler + backoff time counts as lost — most of the failed
+        # run's progress survives in checkpoints (the in-run retry path
+        # accounts the same way); the full run_time_s is in the log line
+        self.metrics.inc("time_lost_to_recovery_s",
+                         time.perf_counter() - t_rec)
+
+    def _restartable(self) -> bool:
+        """A restart is safe when a shard-complete checkpoint exists to
+        resume from, or the policy allows a from-scratch restart."""
+        from bigdl_tpu.optim import checkpoint as ckpt
+
+        opt = self.optimizer
+        path = getattr(opt, "_ckpt_path", None)
+        if path:
+            # an in-flight async write may BE the newest checkpoint
+            try:
+                opt._ckpt_drain(raise_error=False)
+            except Exception:  # pragma: no cover — drain is best-effort
+                pass
+            latest = ckpt.latest_checkpoint(path)
+            if latest is not None:
+                log.info("supervisor: will resume from %s "
+                         "(newest shard-complete checkpoint)", latest)
+                return True
+        if self.policy.restart_from_scratch:
+            log.warning("supervisor: no restorable checkpoint under %r; "
+                        "restarting from scratch", path)
+            return True
+        log.error("supervisor: no restorable checkpoint and "
+                  "restart_from_scratch is disabled")
+        return False
+
+
+def supervise(optimizer, policy: Optional[FailurePolicy] = None):
+    """One-call form: ``supervise(opt).optimize()``-equivalent —
+    ``supervise(opt)`` runs the optimizer under a Supervisor and returns
+    the TrainedModel."""
+    return Supervisor(optimizer, policy=policy).run()

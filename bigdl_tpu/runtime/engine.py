@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 
+from bigdl_tpu.resilience.retry import FailurePolicy
 from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
 from bigdl_tpu.utils.log import get_logger
 
@@ -37,9 +38,43 @@ class EngineConfig:
     # numerics
     compute_dtype: str = "bfloat16"  # matmul/conv compute dtype on TPU
     param_dtype: str = "float32"
-    # failure handling (reference: bigdl.failure.retryTimes ~ 5, unverified)
+    # failure handling (reference: bigdl.failure.retryTimes ~ 5, unverified).
+    # failure_retry_times/interval bound the driver's cheap IN-RUN retry;
+    # failure_policy is the full contract (per-cause retries, heartbeats,
+    # watchdog) enforced by resilience.Supervisor around optimize().
     failure_retry_times: int = 5
     failure_retry_interval_s: float = 10.0
+    failure_policy: Optional[FailurePolicy] = None
+
+    def resolved_failure_policy(self) -> FailurePolicy:
+        """The effective FailurePolicy: the explicit one, else defaults
+        seeded from the legacy retry knobs (so BIGDL_TPU_RETRY_TIMES
+        keeps meaning what it always did)."""
+        if self.failure_policy is not None:
+            return self.failure_policy
+        from bigdl_tpu.resilience.retry import (FailureCause, RetryPolicy)
+
+        # multiplier=1, no jitter, no cap: the legacy knob meant a FIXED
+        # sleep between retries — deriving an exponential-capped policy
+        # from it would silently change retry timing for existing
+        # configs (e.g. interval_s=120 would hit the 60s cap and retry
+        # twice as fast as configured)
+        legacy = RetryPolicy(
+            max_retries=self.failure_retry_times,
+            base_s=self.failure_retry_interval_s,
+            multiplier=1.0, jitter=0.0,
+            max_s=self.failure_retry_interval_s)
+        by_cause = {}
+        if (self.failure_retry_times, self.failure_retry_interval_s) \
+                != (5, 10.0):
+            # the operator TUNED the legacy knobs: they override the
+            # static per-cause storage defaults too — storage errors are
+            # the dominant real cause on this path, and a tuned 120s
+            # interval must not silently become a 0.5s exponential
+            by_cause[FailureCause.TRANSIENT_STORAGE] = legacy
+        return FailurePolicy(
+            max_restarts=self.failure_retry_times,
+            default_retry=legacy, by_cause=by_cause)
 
     @staticmethod
     def from_env() -> "EngineConfig":
@@ -50,6 +85,12 @@ class EngineConfig:
             cfg.process_id = int(os.environ.get("BIGDL_TPU_PROCESS_ID", "0"))
         if os.environ.get("BIGDL_TPU_RETRY_TIMES"):
             cfg.failure_retry_times = int(os.environ["BIGDL_TPU_RETRY_TIMES"])
+        if os.environ.get("BIGDL_TPU_HEARTBEAT_DIR"):
+            # shared-visibility dir (same requirement as sharded ckpts):
+            # enables peer liveness via resilience.detector heartbeats
+            cfg.failure_policy = cfg.resolved_failure_policy()
+            cfg.failure_policy.heartbeat_dir = \
+                os.environ["BIGDL_TPU_HEARTBEAT_DIR"]
         if os.environ.get("BIGDL_TPU_DCN_SLICES"):
             # force the cross-slice data-parallel degree where the runtime
             # exposes no slice topology (e.g. multi-host CPU, GKE multislice

@@ -34,10 +34,33 @@ class ServingConfig:
     batch_size: int = 32
     batch_timeout_s: float = 0.005
     queue_capacity: int = 4096
+    # graceful degradation: after this many CONSECUTIVE failed predict
+    # batches the server is 'degraded' — it serves from the last-good
+    # fallback model if one is set, and sheds new load otherwise
+    degraded_after_failures: int = 3
+    # half-open probing while degraded WITHOUT a fallback: one request per
+    # interval is admitted as a probe so a recovered model can clear
+    # degradation by itself (otherwise shedding is permanent: recovery
+    # only happens inside _process, which needs an admitted request)
+    degraded_probe_interval_s: float = 1.0
+
+
+class ServiceUnavailableError(RuntimeError):
+    """Raised by ``enqueue`` while the server is degraded with no
+    fallback model — fail fast at admission instead of queueing requests
+    into a replica that cannot answer them (load shedding)."""
 
 
 class ServingServer:
-    """queue -> dynamic batch -> jitted predict -> result table."""
+    """queue -> dynamic batch -> jitted predict -> result table.
+
+    Resilience posture (reference Cluster-Serving keeps serving while a
+    replica restarts): a streak of predict failures flips the server to
+    DEGRADED.  Degraded with a fallback model (``set_fallback_model`` —
+    typically the previous good version) keeps answering from it;
+    degraded without one sheds new load at ``enqueue`` so callers retry
+    another replica.  ``reload_model`` installs a restarted replica's
+    model and clears degradation."""
 
     def __init__(self, model: InferenceModel,
                  config: Optional[ServingConfig] = None):
@@ -49,7 +72,13 @@ class ServingServer:
         self._result_cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.stats = {"batches": 0, "requests": 0}
+        self._fallback_model: Optional[InferenceModel] = None
+        self._consecutive_failures = 0
+        self._last_probe_t = 0.0
+        self._probe_lock = threading.Lock()
+        self.degraded = False
+        self.stats = {"batches": 0, "requests": 0, "failed_batches": 0,
+                      "fallback_batches": 0, "shed_requests": 0}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -62,9 +91,45 @@ class ServingServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    # -- degradation control ------------------------------------------------
+    def set_fallback_model(self, model: InferenceModel) -> "ServingServer":
+        """Register the last-good model; while degraded, batches are served
+        from it instead of failing."""
+        self._fallback_model = model
+        return self
+
+    def reload_model(self, model: InferenceModel) -> None:
+        """Install a (restarted) replica's model; the old primary becomes
+        the fallback and degradation clears."""
+        self._fallback_model = self.model if not self.degraded \
+            else self._fallback_model
+        self.model = model
+        self._consecutive_failures = 0
+        if self.degraded:
+            log.info("serving: model reloaded; leaving degraded mode")
+        self.degraded = False
+
     # -- client side --------------------------------------------------------
     def enqueue(self, arr: np.ndarray, request_id: Optional[str] = None
                 ) -> str:
+        if self.degraded and self._fallback_model is None:
+            # half-open: admit one probe per interval so a recovered
+            # model can clear degradation; shed everything else —
+            # admission-time fast-fail beats letting the request rot in
+            # the queue until the client timeout
+            with self._probe_lock:  # check-then-set: exactly ONE probe
+                #                     per interval across client threads
+                now = time.time()
+                is_probe = (now - self._last_probe_t
+                            >= self.config.degraded_probe_interval_s)
+                if is_probe:
+                    self._last_probe_t = now
+                else:
+                    self.stats["shed_requests"] += 1
+            if not is_probe:
+                raise ServiceUnavailableError(
+                    "server degraded (predict failing) and no fallback "
+                    "model; shedding load — retry against another replica")
         rid = request_id or uuid.uuid4().hex
         self._in.put((rid, np.asarray(arr)))
         return rid
@@ -105,15 +170,45 @@ class ServingServer:
         sizes = [a.shape[0] if a.ndim > 1 else 1 for _, a in batch]
         arrs = [a if a.ndim > 1 else a[None] for _, a in batch]
         stacked = np.concatenate(arrs, axis=0)
+        use_fallback = self.degraded and self._fallback_model is not None
+        primary = self._fallback_model if use_fallback else self.model
+        out = None
         try:
-            out = self.model.predict(stacked)
-        except Exception as e:  # deliver the failure to every waiter
-            log.error("predict failed: %s", e)
-            with self._result_cv:
-                for rid in rids:
-                    self._results[rid] = e  # type: ignore[assignment]
-                self._result_cv.notify_all()
-            return
+            out = primary.predict(stacked)
+            self._consecutive_failures = 0
+            if not use_fallback and self.degraded:
+                log.info("serving: predict recovered; leaving degraded mode")
+                self.degraded = False
+        except Exception as e:
+            self._consecutive_failures += 1
+            self.stats["failed_batches"] += 1
+            if (not self.degraded and self._consecutive_failures
+                    >= self.config.degraded_after_failures):
+                self.degraded = True
+                log.error(
+                    "serving: %d consecutive predict failures — DEGRADED "
+                    "(%s)", self._consecutive_failures,
+                    "serving from fallback model"
+                    if self._fallback_model is not None
+                    else "no fallback: shedding new load")
+            if not use_fallback and self._fallback_model is not None:
+                # last-good model answers THIS batch too, not just the
+                # post-degradation ones — a waiter should not pay for the
+                # primary's death with an error when a fallback exists
+                try:
+                    out = self._fallback_model.predict(stacked)
+                    use_fallback = True
+                except Exception as e2:
+                    log.error("fallback predict also failed: %s", e2)
+            if out is None:
+                log.error("predict failed: %s", e)
+                with self._result_cv:
+                    for rid in rids:
+                        self._results[rid] = e  # type: ignore[assignment]
+                    self._result_cv.notify_all()
+                return
+        if use_fallback:
+            self.stats["fallback_batches"] += 1
         ofs = 0
         with self._result_cv:
             for rid, n in zip(rids, sizes):

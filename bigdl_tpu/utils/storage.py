@@ -28,10 +28,15 @@ import posixpath
 import shutil
 from typing import IO, List, Optional
 
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.storage")
+
 __all__ = [
     "is_remote", "join", "basename", "open_file", "exists", "isdir",
     "isfile", "listdir", "list_files", "makedirs", "remove_tree",
-    "read_json", "write_json", "load_npz", "glob",
+    "read_json", "write_json", "load_npz", "glob", "fingerprint",
 ]
 
 
@@ -89,6 +94,7 @@ def basename(path: str) -> str:
 
 
 def open_file(path: str, mode: str = "rb") -> IO:
+    faults.fire("storage_io_fail")  # the one seam every byte crosses
     if is_remote(path):
         fs, p = _fs_path(path)
         return fs.open(p, mode)
@@ -184,9 +190,15 @@ def remove_tree(path: str, ignore_errors: bool = True) -> None:
         except FileNotFoundError:
             if not ignore_errors:
                 raise
-        except Exception:
+        except Exception as e:
             if not ignore_errors:
                 raise
+            # swallowed by contract (GC must not kill training), but NOT
+            # silently: a sustained auth/permission failure here means
+            # checkpoint GC is a no-op and storage grows unboundedly
+            log.warning("remote remove_tree(%s) failed (%s: %s); "
+                        "continuing, but storage is NOT being reclaimed",
+                        path, type(e).__name__, e)
         return
     path = _strip_file_scheme(path)
     if os.path.isdir(path):
@@ -210,6 +222,27 @@ def load_npz(path: str) -> dict:
     with open_file(path, "rb") as f:
         with np.load(f) as z:
             return {k: z[k] for k in z.files}
+
+
+def fingerprint(path: str) -> Optional[dict]:
+    """Change-detection identity of a file: whichever of size/etag/mtime/
+    checksum the backend exposes (stringified — etags and mtimes differ in
+    type across backends).  None when the file is missing or the backend
+    cannot stat it; callers treat None as "cannot verify" (stale-allowed),
+    not as a failure."""
+    try:
+        if is_remote(path):
+            fs, p = _fs_path(path)
+            info = fs.info(p)
+            out = {k: str(info[k])
+                   for k in ("size", "etag", "ETag", "mtime", "checksum",
+                             "md5Hash", "LastModified")
+                   if info.get(k) is not None}
+            return out or None
+        st = os.stat(_strip_file_scheme(path))
+        return {"size": str(st.st_size), "mtime": str(st.st_mtime)}
+    except (OSError, ImportError, KeyError):
+        return None
 
 
 def read_json(path: str):

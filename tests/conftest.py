@@ -26,10 +26,14 @@ jax.config.update("jax_threefry_partitionable", True)
 jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compile cache: the suite spends most of its wall time
-# re-compiling the same tiny XLA programs run after run.  Same-machine
-# only (cross-machine AOT artifacts can trip XLA:CPU feature mismatch),
-# so it is NOT shared via CI caches; opt out with BIGDL_TPU_TEST_CACHE=0.
-if os.environ.get("BIGDL_TPU_TEST_CACHE", "1") not in ("0", "false"):
+# re-compiling the same tiny XLA programs run after run.  OPT-IN
+# (BIGDL_TPU_TEST_CACHE=1): on this image's jax build, deserializing a
+# cached XLA:CPU executable segfaults nondeterministically (~30-50% for
+# the donated shard_map train step — reproducible via
+# test_ema_checkpoints_and_survives_resume with the cache on), and one
+# segfault kills the whole pytest process.  A slow suite beats a
+# truncated one.
+if os.environ.get("BIGDL_TPU_TEST_CACHE", "0") in ("1", "true"):
     try:
         jax.config.update(
             "jax_compilation_cache_dir",

@@ -142,6 +142,131 @@ def test_local_opt_shards_single_process_mesh():
     assert "count@offset" not in flat
 
 
+def test_local_opt_shards_rejects_non_leading_axis_sharding():
+    """Same-start dedup treats equal leading offsets as replicas, which is
+    only sound for leading-axis (ZeRO) sharding — a trailing-axis layout
+    must fail loudly at SAVE time, not with a shape mismatch at load."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = Mesh(np.array(devs), ("data",))
+    arr = jax.device_put(
+        np.arange(2 * len(devs), dtype=np.float32).reshape(2, len(devs)),
+        NamedSharding(mesh, P(None, "data")))
+    with pytest.raises(ValueError, match="non-leading axis"):
+        local_opt_shards({"m": arr})
+
+
+# ---------------------------------------------------------------------------
+# GC: the keep set must count only checkpoints a reader would accept
+
+
+def _write_complete(root, step, **extra):
+    full = np.arange(12, dtype=np.float32)
+    save_checkpoint(root, step, opt_shards={
+        "momentum": full[6:], "momentum@offset": np.asarray(6),
+        "count": np.asarray(1, np.int32)}, shard_index=1, shard_count=2)
+    save_checkpoint(root, step, opt_shards={
+        "momentum": full[:6], "momentum@offset": np.asarray(0),
+        "count": np.asarray(1, np.int32)}, shard_index=0, shard_count=2,
+        flat_params=np.ones(3), model_state={}, driver_state={}, **extra)
+
+
+def _write_manifest_only(root, step, **extra):
+    """Manifest present, shard 1 of 2 missing — what a persistently
+    failing async shard writer leaves behind."""
+    full = np.arange(12, dtype=np.float32)
+    save_checkpoint(root, step, opt_shards={
+        "momentum": full[:6], "momentum@offset": np.asarray(0),
+        "count": np.asarray(1, np.int32)}, shard_index=0, shard_count=2,
+        flat_params=np.ones(3), model_state={}, driver_state={}, **extra)
+
+
+def test_gc_never_deletes_newest_shard_complete(tmp_path):
+    """ADVICE r5 medium: manifest-present-but-shard-incomplete dirs must
+    not count toward keep_last — with keep_last such dirs piling up, the
+    old GC deleted the only restorable checkpoint."""
+    root = str(tmp_path / "ck")
+    _write_complete(root, 2)
+    for step in (4, 6, 8):  # three incomplete dirs, keep_last=3
+        _write_manifest_only(root, step, keep_last=3)
+    # ckpt-2 is the ONLY restorable checkpoint: it must survive
+    assert latest_checkpoint(root).endswith("ckpt-2")
+    assert os.path.isdir(str(tmp_path / "ck" / "ckpt-2"))
+
+    # once a NEWER complete checkpoint exists, older garbage becomes
+    # collectable: complete-but-out-of-window dirs immediately, shard-
+    # incomplete dirs only after a GRACE scan (a single exists() blip on
+    # an object store must not delete a restorable checkpoint)
+    _write_complete(root, 10, keep_last=1)
+    assert latest_checkpoint(root).endswith("ckpt-10")
+    left = sorted(n for n in os.listdir(root) if n.startswith("ckpt-"))
+    assert left == ["ckpt-10", "ckpt-4", "ckpt-6", "ckpt-8"], left
+    # the second scan agrees they are incomplete -> deleted
+    _write_complete(root, 12, keep_last=1)
+    left = sorted(n for n in os.listdir(root) if n.startswith("ckpt-"))
+    assert left == ["ckpt-12"], left
+
+
+def test_gc_keeps_incomplete_dirs_newer_than_newest_valid(tmp_path):
+    """A shard-incomplete dir NEWER than the newest complete one may be a
+    write in flight (async shard writers are unbarriered): not garbage."""
+    root = str(tmp_path / "ck")
+    _write_complete(root, 2)
+    _write_manifest_only(root, 4, keep_last=1)
+    names = set(os.listdir(root))
+    assert {"ckpt-2", "ckpt-4"} <= names
+    # the laggard shard lands: ckpt-4 becomes the newest restorable
+    full = np.arange(12, dtype=np.float32)
+    save_checkpoint(root, 4, opt_shards={
+        "momentum": full[6:], "momentum@offset": np.asarray(6),
+        "count": np.asarray(1, np.int32)}, shard_index=1, shard_count=2)
+    assert latest_checkpoint(root).endswith("ckpt-4")
+
+
+def test_gc_spares_checkpoint_with_unreadable_manifest(tmp_path,
+                                                       monkeypatch):
+    """A transient manifest READ failure makes a checkpoint's completeness
+    unknown — readers skip it for now, but GC must not delete it: the blip
+    may be hiding the only restorable state."""
+    from bigdl_tpu.optim import checkpoint as ckpt_mod
+    from bigdl_tpu.utils import storage as storage_mod
+
+    root = str(tmp_path / "ck")
+    _write_complete(root, 2)
+
+    real_read = storage_mod.read_json
+
+    def flaky_read(path):
+        if "ckpt-2" in path:
+            raise OSError("transient storage blip")
+        return real_read(path)
+
+    monkeypatch.setattr(ckpt_mod.storage, "read_json", flaky_read)
+    # unreadable -> not offered to readers this scan...
+    assert latest_checkpoint(root) is None
+    # ...and a newer complete checkpoint + tight keep_last still must
+    # not GC the unreadable (possibly restorable) ckpt-2
+    _write_complete(root, 4, keep_last=1)
+    assert os.path.isdir(os.path.join(root, "ckpt-2"))
+    # blip clears: ckpt-2 is fully visible again
+    monkeypatch.setattr(ckpt_mod.storage, "read_json", real_read)
+    assert {n for n in os.listdir(root) if n.startswith("ckpt-")} == \
+        {"ckpt-2", "ckpt-4"}
+    assert latest_checkpoint(root).endswith("ckpt-4")
+
+
+def test_gc_deletes_nothing_without_any_complete_checkpoint(tmp_path):
+    root = str(tmp_path / "ck")
+    for step in (2, 4, 6, 8):
+        _write_manifest_only(root, step, keep_last=2)
+    assert latest_checkpoint(root) is None
+    names = sorted(n for n in os.listdir(root) if n.startswith("ckpt-"))
+    assert names == ["ckpt-2", "ckpt-4", "ckpt-6", "ckpt-8"], names
+
+
 # ---------------------------------------------------------------------------
 # integration tier: TRUE 2-process training with sharded="auto" + resume
 

@@ -10,10 +10,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.runtime.mesh import shard_map
 
 from bigdl_tpu.nn.attention import dot_product_attention
 from bigdl_tpu.parallel import ring_attention, tp_linear_pair
@@ -74,7 +71,7 @@ def test_tp_linear_pair_matches_dense():
         mesh=mesh,
         in_specs=(P(), P(None, AXIS_MODEL), P(AXIS_MODEL),
                   P(AXIS_MODEL, None), P()),
-        out_specs=P(), check_vma=False)
+        out_specs=P())
     out = fn(x, w1, b1, w2, b2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -377,7 +374,7 @@ def test_transformer_layer_seq_parallel_matches_plain(seq_mesh, strategy):
 
     spec = P(None, AXIS_SEQ, None)
     fn = shard_map(fwd_block, mesh=seq_mesh,
-                   in_specs=(P(), spec), out_specs=spec, check_vma=False)
+                   in_specs=(P(), spec), out_specs=spec)
     out = fn(variables["params"], x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -413,8 +410,7 @@ def test_transformer_layer_seq_parallel_trains(seq_mesh):
 
     spec = P(None, AXIS_SEQ, None)
     fn = shard_map(block_grad, mesh=seq_mesh,
-                   in_specs=(P(), spec, P()), out_specs=P(),
-                   check_vma=False)
+                   in_specs=(P(), spec, P()), out_specs=P())
     g = fn(variables["params"], x, jax.random.PRNGKey(1))
     flat = jnp.concatenate([jnp.ravel(l)
                             for l in jax.tree_util.tree_leaves(g)])
@@ -531,7 +527,7 @@ def test_positional_encoding_global_offsets(seq_mesh):
 
     spec = P(None, AXIS_SEQ, None)
     fn = shard_map(block, mesh=seq_mesh, in_specs=(spec,),
-                   out_specs=spec, check_vma=False)
+                   out_specs=spec)
     out = fn(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)

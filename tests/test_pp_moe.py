@@ -7,10 +7,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.runtime.mesh import shard_map
 
 from bigdl_tpu.parallel.moe import MoE, moe_apply_ep, moe_apply_local
 from bigdl_tpu.parallel.pp import (microbatch, pipeline_apply, spmd_pipeline,
@@ -144,7 +141,7 @@ def test_moe_ep_matches_local():
     pspec = {k: P(AXIS_EXPERT) if k != "wg" else P()
              for k in params}
     mapped = shard_map(fn, mesh=mesh, in_specs=(pspec, P()),
-                       out_specs=(P(), P()), check_vma=False)
+                       out_specs=(P(), P()))
     y_ep, aux_ep = mapped(params, x)
     np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
                                rtol=1e-4, atol=1e-4)

@@ -1,0 +1,576 @@
+"""``bigdl_tpu.resilience`` — fault injection, detection, retry policies,
+and the training supervisor.
+
+The load-bearing spec is ``test_faulted_run_matches_fault_free``: under
+``step_fail`` + intermittent ``checkpoint_write_fail`` injection a training
+run must reach the SAME final iteration as a fault-free run (recovering
+only through shard-complete checkpoints), with the recovery visible in
+``Metrics`` counters.  Everything else covers the layers that make that
+possible.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.resilience.detector import (Heartbeat, HeartbeatMonitor,
+                                           StepWatchdog)
+from bigdl_tpu.resilience.faults import (FaultInjector, FaultSpec,
+                                         InjectedStepFailure,
+                                         InjectedStorageError, parse_plan)
+from bigdl_tpu.resilience.retry import (FailureCause, FailurePolicy,
+                                        PoisonedStepError, RetryPolicy,
+                                        TopologyChangedError, classify)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+class _LogCapture:
+    """Collects records from a ``bigdl_tpu.*`` logger directly — the
+    package root has ``propagate=False``, so pytest's caplog (root-logger
+    handler) never sees them."""
+
+    def __init__(self, name):
+        import logging
+
+        self.records = []
+        self._logger = logging.getLogger(name)
+        self._handler = logging.Handler()
+        self._handler.emit = self.records.append
+
+    def __enter__(self):
+        self._logger.addHandler(self._handler)
+        return self
+
+    def __exit__(self, *a):
+        self._logger.removeHandler(self._handler)
+
+
+# ---------------------------------------------------------------------------
+# faults: deterministic injection
+
+
+def test_fault_plan_is_deterministic():
+    """Two injectors over the same plan fire at identical invocations —
+    the property every recovery test depends on."""
+    def pattern():
+        inj = FaultInjector([
+            FaultSpec("step_fail", probability=0.3, seed=7, max_fires=100),
+            FaultSpec("storage_io_fail", every=5),
+        ])
+        for i in range(100):
+            try:
+                inj.fire("step_fail", step=i)
+            except InjectedStepFailure:
+                pass
+            try:
+                inj.fire("storage_io_fail")
+            except InjectedStorageError:
+                pass
+        return inj.events
+
+    a, b = pattern(), pattern()
+    assert a == b
+    assert any(p == "step_fail" for p, _, _ in a)
+    # every=5 fires exactly on every 5th invocation
+    assert [c for p, _, c in a if p == "storage_io_fail"] == \
+        list(range(4, 100, 5))
+
+
+def test_fault_at_step_fires_once_by_default():
+    """at_step defaults to max_fires=1: a resumed run REPLAYS the step and
+    must not die on it forever."""
+    inj = FaultInjector([FaultSpec("step_fail", at_step=3)])
+    with pytest.raises(InjectedStepFailure):
+        inj.fire("step_fail", step=3)
+    inj.fire("step_fail", step=3)  # replay: no fire
+    assert len(inj.events) == 1
+
+
+def test_fault_env_plan_parsing():
+    specs = parse_plan(
+        "step_fail@5; checkpoint_write_fail:p=0.5:seed=2 ;"
+        "slow_host@3:delay=0.01;storage_io_fail:every=4:max=2")
+    by_point = {s.point: s for s in specs}
+    assert by_point["step_fail"].at_step == 5
+    assert by_point["step_fail"].max_fires == 1
+    assert by_point["checkpoint_write_fail"].probability == 0.5
+    assert by_point["checkpoint_write_fail"].seed == 2
+    assert by_point["slow_host"].delay_s == 0.01
+    assert by_point["slow_host"].action == "sleep"
+    assert by_point["storage_io_fail"].every == 4
+    assert by_point["storage_io_fail"].max_fires == 2
+    with pytest.raises(ValueError, match="unknown fault point"):
+        parse_plan("bogus_point@1")
+    with pytest.raises(ValueError, match="unknown fault option"):
+        parse_plan("step_fail:frequency=2")
+
+
+def test_storage_io_fault_reaches_storage_seam(tmp_path):
+    from bigdl_tpu.utils import storage
+
+    faults.install([FaultSpec("storage_io_fail", every=1, max_fires=1)])
+    with pytest.raises(InjectedStorageError):
+        storage.open_file(str(tmp_path / "x"), "wb")
+    # max_fires exhausted: the seam works again
+    with storage.open_file(str(tmp_path / "x"), "wb") as f:
+        f.write(b"ok")
+
+
+# ---------------------------------------------------------------------------
+# retry: backoff math + classification
+
+
+def test_backoff_exponential_capped_and_deterministic():
+    p = RetryPolicy(max_retries=10, base_s=1.0, multiplier=2.0,
+                    max_s=8.0, jitter=0.25, seed=5)
+    seq = [p.backoff(a) for a in range(1, 8)]
+    assert seq == [p.backoff(a) for a in range(1, 8)]  # deterministic
+    for a, v in enumerate(seq, start=1):
+        raw = min(8.0, 2.0 ** (a - 1))
+        assert raw * 0.75 <= v <= raw * 1.25
+    # capped: late attempts all sit at max_s (± jitter)
+    assert max(seq[4:]) <= 8.0 * 1.25
+    assert RetryPolicy(jitter=0.0, base_s=3.0).backoff(1) == 3.0
+
+
+def test_retry_call_retries_then_raises():
+    p = RetryPolicy(max_retries=2, base_s=0.0, jitter=0.0)
+    calls = []
+
+    def flaky(fail_times):
+        calls.append(1)
+        if len(calls) <= fail_times:
+            raise OSError("blip")
+        return "ok"
+
+    assert p.call(flaky, 2, sleep=lambda s: None) == "ok"
+    calls.clear()
+    with pytest.raises(OSError):
+        p.call(flaky, 99, sleep=lambda s: None)
+    assert len(calls) == 3  # initial + max_retries
+
+
+def test_classify_causes():
+    assert classify(OSError("x")) is FailureCause.TRANSIENT_STORAGE
+    assert classify(InjectedStorageError("storage_io_fail")) \
+        is FailureCause.TRANSIENT_STORAGE
+    assert classify(InjectedStepFailure("step_fail")) \
+        is FailureCause.STEP_FAILURE
+    assert classify(PoisonedStepError("nan")) is FailureCause.POISONED_BATCH
+    assert classify(RuntimeError("loss is NaN")) \
+        is FailureCause.POISONED_BATCH
+    assert classify(TopologyChangedError("2->3")) \
+        is FailureCause.TOPOLOGY_CHANGE
+    assert classify(faults.ProcessKilledError("process_kill")) \
+        is FailureCause.PROCESS_FAILURE
+    assert classify(ValueError("shape")) is FailureCause.UNKNOWN
+    # wrapped errors classify by the cause chain (e.g. AsyncCheckpointer's
+    # escalation RuntimeError around a storage error)
+    try:
+        raise RuntimeError("async checkpoint writes failed; escalating") \
+            from OSError("gcs 503")
+    except RuntimeError as wrapped:
+        assert classify(wrapped) is FailureCause.TRANSIENT_STORAGE
+
+
+def test_failure_policy_per_cause():
+    fp = FailurePolicy()
+    assert fp.policy_for(FailureCause.TRANSIENT_STORAGE).max_retries > \
+        fp.policy_for(FailureCause.POISONED_BATCH).max_retries
+    assert fp.policy_for(FailureCause.TOPOLOGY_CHANGE).max_retries == 0
+    custom = FailurePolicy(by_cause={
+        FailureCause.POISONED_BATCH: RetryPolicy(max_retries=9)})
+    assert custom.policy_for(FailureCause.POISONED_BATCH).max_retries == 9
+
+
+# ---------------------------------------------------------------------------
+# detector: heartbeats (phi-accrual) + watchdog — injected clocks, no sleeps
+
+
+def test_heartbeat_phi_accrual(tmp_path):
+    now = [100.0]
+    clock = lambda: now[0]  # noqa: E731
+    hb = Heartbeat(str(tmp_path), process_index=1, clock=clock)
+    mon = HeartbeatMonitor(str(tmp_path), clock=clock)
+    for _ in range(10):  # regular 1s beats
+        hb.beat()
+        mon.poll()
+        now[0] += 1.0
+    assert mon.phi(1) < 3.0        # just-on-time: low suspicion
+    assert mon.suspects(threshold=8.0) == []
+    now[0] += 60.0                 # silence: suspicion accrues
+    assert mon.phi(1) > 8.0
+    assert mon.suspects(threshold=8.0) == [1]
+    assert mon.phi(99) == float("inf")  # never seen
+
+
+def test_heartbeat_over_remote_storage():
+    """Heartbeats route through the utils.storage seam, so a gs://-style
+    shared bucket works exactly like a shared filesystem (memory:// gives
+    the remote semantics without a network)."""
+    pytest.importorskip("fsspec")
+    now = [100.0]
+    root = f"memory://hb{os.getpid()}/run"
+    hb = Heartbeat(root, process_index=3, clock=lambda: now[0])
+    mon = HeartbeatMonitor(root, clock=lambda: now[0])
+    for _ in range(5):
+        hb.beat()
+        mon.poll()
+        now[0] += 1.0
+    assert mon.phi(3) < 3.0
+    now[0] += 120.0
+    assert mon.suspects(threshold=8.0) == [3]
+
+
+def test_heartbeat_monitor_ignores_torn_files(tmp_path):
+    (tmp_path / "hb-00007.json").write_text("{not json")
+    mon = HeartbeatMonitor(str(tmp_path))
+    assert mon.poll() == {}
+
+
+def test_watchdog_nan_streak_raises_poisoned():
+    wd = StepWatchdog(nan_patience=3)
+    wd.observe_loss(0, 1.0)
+    wd.observe_loss(1, float("nan"))
+    wd.observe_loss(2, float("inf"))
+    with pytest.raises(PoisonedStepError):
+        wd.observe_loss(3, float("nan"))
+    wd.observe_loss(4, float("nan"))  # streak reset after raising
+    wd.observe_loss(5, 0.5)
+    wd.observe_loss(6, float("nan"))  # finite value also resets
+
+
+def test_watchdog_hang_detection():
+    now = [0.0]
+    wd = StepWatchdog(step_timeout_s=10.0, clock=lambda: now[0])
+    hangs = []
+    wd.on_hang = lambda step, dur: hangs.append((step, dur))
+    wd.step_started(4)
+    now[0] = 5.0
+    assert not wd.check()
+    now[0] = 11.0
+    assert wd.check() and hangs == [(4, 11.0)]
+    assert wd.check()              # still hung; on_hang fires once
+    assert len(hangs) == 1
+    wd.observe_loss(4, 1.0)        # completion clears the in-flight step
+    assert not wd.hung()
+
+
+# ---------------------------------------------------------------------------
+# training under injection — the acceptance spec
+
+
+def _linreg_optimizer(ckpt_dir, n_iters, seed=3):
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.data.dataset import ArrayDataSet
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 4).astype(np.float32)
+    y = x @ np.asarray([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    opt = (optim.Optimizer(nn.Linear(4, 1), ArrayDataSet(x, y),
+                           nn.MSECriterion(), batch_size=16, seed=seed)
+           .set_optim_method(optim.SGD(learning_rate=0.2))
+           .set_end_when(optim.Trigger.max_iteration(n_iters)))
+    opt.set_checkpoint(ckpt_dir, optim.Trigger.several_iteration(2))
+    opt.log_every = 100
+    return opt
+
+
+def _fast_engine(retry_times=3):
+    from bigdl_tpu.runtime.engine import EngineConfig, init_engine
+
+    init_engine(EngineConfig(failure_retry_times=retry_times,
+                             failure_retry_interval_s=0.01,
+                             failure_policy=FailurePolicy(
+                                 max_restarts=retry_times,
+                                 default_retry=RetryPolicy(
+                                     max_retries=retry_times, base_s=0.01,
+                                     max_s=0.05),
+                                 by_cause={c: RetryPolicy(
+                                     max_retries=retry_times, base_s=0.01,
+                                     max_s=0.05) for c in FailureCause})))
+
+
+def test_faulted_run_matches_fault_free(tmp_path):
+    """step_fail at step 5 + intermittent checkpoint_write_fail: the run
+    completes with the SAME final iteration and bit-identical weights as
+    the fault-free run, resuming only from complete checkpoints, and the
+    recovery shows up in Metrics counters."""
+    _fast_engine()
+    faults.clear()
+    opt_a = _linreg_optimizer(str(tmp_path / "ck_a"), 8)
+    trained_a = opt_a.optimize()
+
+    inj = faults.install([
+        FaultSpec("step_fail", at_step=5),
+        FaultSpec("checkpoint_write_fail", probability=0.5, seed=1,
+                  max_fires=2),
+    ])
+    opt_b = _linreg_optimizer(str(tmp_path / "ck_b"), 8)
+    trained_b = opt_b.optimize()
+
+    assert [p for p, _, _ in inj.events].count("step_fail") == 1
+    assert any(p == "checkpoint_write_fail" for p, _, _ in inj.events)
+    assert opt_a.final_state["iteration"] == 8
+    assert opt_b.final_state["iteration"] == 8
+    wa = np.asarray(trained_a.variables["params"]["weight"])
+    wb = np.asarray(trained_b.variables["params"]["weight"])
+    np.testing.assert_array_equal(wa, wb)
+    assert opt_b.metrics.counter("recoveries_total") >= 1
+    by_cause = {k: v for k, v in opt_b.metrics.counters.items()
+                if k.startswith("retries_by_cause.")}
+    assert sum(by_cause.values()) == opt_b.metrics.counter("recoveries_total")
+    assert opt_b.metrics.counter("time_lost_to_recovery_s") > 0
+    assert "recoveries_total" in opt_b.metrics.summary()
+    # the fault-free run recovered nothing
+    assert opt_a.metrics.counter("recoveries_total") == 0
+
+
+def test_supervisor_restarts_from_checkpoint(tmp_path):
+    """With in-run retries disabled (retry_times=0) a step failure escapes
+    optimize(); the Supervisor classifies it, restarts, and the restarted
+    run resumes from the newest complete checkpoint to the end."""
+    from bigdl_tpu.resilience.supervisor import Supervisor
+
+    _fast_engine(retry_times=0)
+    faults.install([FaultSpec("step_fail", at_step=5)])
+    opt = _linreg_optimizer(str(tmp_path / "ck"), 8)
+    policy = FailurePolicy(
+        max_restarts=2,
+        by_cause={FailureCause.STEP_FAILURE: RetryPolicy(
+            max_retries=2, base_s=0.0, jitter=0.0)})
+    sup = Supervisor(opt, policy=policy, sleep=lambda s: None)
+    trained = sup.run()
+    assert trained is not None
+    assert opt.final_state["iteration"] == 8
+    assert sup.restarts_total == 1
+    assert opt.metrics.counter("recoveries_total") == 1
+    assert opt.metrics.counter("retries_by_cause.step_failure") == 1
+    assert opt.watchdog is not None  # supervisor installed a watchdog
+
+
+def test_supervisor_exhausts_policy_and_raises(tmp_path):
+    from bigdl_tpu.resilience.supervisor import Supervisor
+
+    _fast_engine(retry_times=0)
+    faults.install([FaultSpec("step_fail", at_step=5, max_fires=100)])
+    opt = _linreg_optimizer(str(tmp_path / "ck"), 8)
+    policy = FailurePolicy(
+        max_restarts=2,
+        by_cause={FailureCause.STEP_FAILURE: RetryPolicy(
+            max_retries=99, base_s=0.0, jitter=0.0)})
+    with pytest.raises(InjectedStepFailure):
+        Supervisor(opt, policy=policy, sleep=lambda s: None).run()
+
+
+def test_elastic_resume_replays_epoch_on_process_count_change(tmp_path):
+    """A checkpoint recorded at a different process_count must NOT apply
+    its mid-epoch skip (the per-process batch plan changed): it replays
+    the epoch from its start with an explicit warning."""
+    from bigdl_tpu.optim import checkpoint as ckpt
+
+    _fast_engine()
+    faults.clear()
+    d = str(tmp_path / "ck")
+    opt1 = _linreg_optimizer(d, 6)
+    opt1.optimize()
+    latest = ckpt.latest_checkpoint(d)
+    manifest_path = os.path.join(latest, "manifest.json")
+    manifest = json.load(open(manifest_path))
+    assert manifest["driver_state"]["process_count"] == 1  # recorded
+    # forge a 2-process origin with a mid-epoch skip pending
+    manifest["driver_state"]["process_count"] = 2
+    manifest["driver_state"]["epoch_batch"] = 2
+    json.dump(manifest, open(manifest_path, "w"))
+
+    opt2 = _linreg_optimizer(d, 10)
+    with _LogCapture("bigdl_tpu.optim") as cap:
+        opt2.optimize()
+    assert opt2.final_state["iteration"] == 10
+    assert opt2.metrics.counter("elastic_resumes_total") == 1
+    assert any("elastic resume" in r.getMessage()
+               and "process_count=2" in r.getMessage()
+               for r in cap.records)
+
+    # same process_count: the skip applies, no elastic fallback
+    opt3 = _linreg_optimizer(d, 12)
+    opt3.optimize()
+    assert opt3.metrics.counter("elastic_resumes_total") == 0
+
+
+def test_estimator_fault_tolerance_knob(tmp_path):
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.estimator import Estimator
+
+    _fast_engine(retry_times=0)
+    faults.install([FaultSpec("step_fail", at_step=2)])
+    est = Estimator.from_module(
+        lambda cfg: nn.Sequential([nn.Linear(4, 8), nn.ReLU(),
+                                   nn.Linear(8, 1)]),
+        lambda cfg: optim.SGD(learning_rate=0.1),
+        lambda cfg: nn.MSECriterion())
+    rs = np.random.RandomState(1)
+    x = rs.rand(64, 4).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    stats = est.fit((x, y), epochs=2, batch_size=16,
+                    checkpoint_path=str(tmp_path / "ck"),
+                    fault_tolerance=FailurePolicy(
+                        max_restarts=2,
+                        by_cause={FailureCause.STEP_FAILURE: RetryPolicy(
+                            max_retries=2, base_s=0.0, jitter=0.0)}))
+    assert stats["recoveries_total"] == 1
+    assert est.predict(x).shape == (64, 1)
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint escalation + storage visibility
+
+
+def test_async_checkpointer_escalates_failure_streak(tmp_path):
+    from bigdl_tpu.optim.checkpoint import AsyncCheckpointer
+
+    faults.install([FaultSpec("checkpoint_write_fail", probability=1.0,
+                              max_fires=10)])
+    ac = AsyncCheckpointer(escalate_after=3)
+    kw = dict(flat_params=np.ones(3), opt_state={}, model_state={},
+              driver_state={})
+    for step in range(3):  # three swallowed failures
+        ac.submit(str(tmp_path / "ck"), step, **kw)
+    with pytest.raises(RuntimeError, match="escalating"):
+        ac.submit(str(tmp_path / "ck"), 3, **kw)
+    # a success resets the streak
+    faults.clear()
+    ac.submit(str(tmp_path / "ck"), 4, **kw)
+    ac.wait()
+    assert ac.consecutive_failures == 0
+
+
+def test_remove_tree_swallow_path_logs_warning(monkeypatch):
+    from bigdl_tpu.utils import storage
+
+    class FakeFS:
+        def rm(self, p, recursive=False):
+            raise PermissionError("403 forbidden")
+
+    monkeypatch.setattr(storage, "_fs_path",
+                        lambda path: (FakeFS(), path))
+    with _LogCapture("bigdl_tpu.storage") as cap:
+        storage.remove_tree("memory://bucket/ckpt-2", ignore_errors=True)
+    assert any("NOT being reclaimed" in r.getMessage()
+               for r in cap.records)
+    with pytest.raises(PermissionError):
+        storage.remove_tree("memory://bucket/ckpt-2", ignore_errors=False)
+
+
+# ---------------------------------------------------------------------------
+# records cache freshness (satellite): memory:// remote
+
+
+def test_records_cache_refetches_on_remote_change(tmp_path, monkeypatch):
+    pytest.importorskip("fsspec")
+    from bigdl_tpu.data.records import RecordDataSet, write_records
+
+    monkeypatch.setenv("BIGDL_TPU_RECORD_CACHE", str(tmp_path / "cache"))
+    uri = f"memory://recfresh{os.getpid()}/train.rec"
+    x1 = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y1 = np.arange(6, dtype=np.int32)
+    write_records(uri, {"x": x1, "y": y1})
+    ds = RecordDataSet(uri)
+    assert ds.size() == 6
+
+    # overwrite the remote object: a new RecordDataSet must see fresh data
+    x2 = np.ones((9, 2), np.float32)
+    y2 = np.zeros(9, np.int32)
+    write_records(uri, {"x": x2, "y": y2})
+    ds2 = RecordDataSet(uri)
+    assert ds2.size() == 9
+    mb = next(iter(ds2.batches(4, shuffle=False)))
+    np.testing.assert_array_equal(mb["input"], x2[:4])
+
+
+# ---------------------------------------------------------------------------
+# serving degradation
+
+
+class _FakeModel:
+    def __init__(self, fail=False, scale=1.0):
+        self.fail = fail
+        self.scale = scale
+
+    def predict(self, x):
+        if self.fail:
+            raise RuntimeError("replica down")
+        return np.asarray(x) * self.scale
+
+
+def test_serving_falls_back_to_last_good_model():
+    from bigdl_tpu.serving.server import ServingConfig, ServingServer
+
+    primary = _FakeModel(scale=2.0)
+    srv = ServingServer(primary, ServingConfig(
+        batch_timeout_s=0.001, degraded_after_failures=2))
+    srv.set_fallback_model(_FakeModel(scale=1.0))
+    srv.start()
+    try:
+        rid = srv.enqueue(np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(srv.query(rid, timeout=10), 2.0)
+        primary.fail = True
+        # failures answered by the fallback, then degraded mode
+        for _ in range(3):
+            rid = srv.enqueue(np.ones((1, 2), np.float32))
+            np.testing.assert_array_equal(srv.query(rid, timeout=10), 1.0)
+        assert srv.degraded
+        assert srv.stats["fallback_batches"] >= 3
+        # replica restarted: reload clears degradation
+        srv.reload_model(_FakeModel(scale=3.0))
+        rid = srv.enqueue(np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(srv.query(rid, timeout=10), 3.0)
+        assert not srv.degraded
+    finally:
+        srv.stop()
+
+
+def test_serving_sheds_load_when_degraded_without_fallback():
+    from bigdl_tpu.serving.server import (ServiceUnavailableError,
+                                          ServingConfig, ServingServer)
+
+    model = _FakeModel(fail=True)
+    srv = ServingServer(model, ServingConfig(
+        batch_timeout_s=0.001, degraded_after_failures=2,
+        degraded_probe_interval_s=30.0))
+    srv.start()
+    try:
+        # enqueue-then-query serializes the batches: two back-to-back
+        # enqueues can coalesce into ONE dynamic batch (= one failure),
+        # which would never reach degraded_after_failures=2
+        for _ in range(2):
+            rid = srv.enqueue(np.ones((1, 2), np.float32))
+            with pytest.raises(RuntimeError, match="replica down"):
+                srv.query(rid, timeout=10)
+        assert srv.degraded
+        # first post-degradation enqueue is the half-open PROBE (admitted,
+        # still failing); the next within the interval is shed
+        rid = srv.enqueue(np.ones((1, 2), np.float32))
+        with pytest.raises(RuntimeError, match="replica down"):
+            srv.query(rid, timeout=10)
+        with pytest.raises(ServiceUnavailableError):
+            srv.enqueue(np.ones((1, 2), np.float32))
+        assert srv.stats["shed_requests"] == 1
+
+        # the model recovers: the next probe clears degradation entirely
+        model.fail = False
+        srv._last_probe_t = 0.0  # force the probe window open (no sleeps)
+        rid = srv.enqueue(np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(srv.query(rid, timeout=10), 1.0)
+        assert not srv.degraded
+        srv.enqueue(np.ones((1, 2), np.float32))  # normal admission again
+    finally:
+        srv.stop()
