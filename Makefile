@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience bench dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-serving bench dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -35,6 +35,13 @@ test-core:
 # supervisor resume, elastic resume, GC-never-deletes-last-valid
 test-resilience:
 	python -m pytest tests/test_resilience.py tests/test_ckpt_sharded.py -q
+
+# the serving suite (docs/serving.md): engine + frontend + pool, including
+# the request-lifecycle chaos tests (worker kill, deadline expiry,
+# backpressure 429s, drain-vs-drop, breaker/hedge)
+test-serving:
+	python -m pytest tests/test_serving.py tests/test_serving_multiproc.py \
+	  tests/test_serving_chaos.py -q
 
 bench:
 	python bench.py

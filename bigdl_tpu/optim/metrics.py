@@ -10,6 +10,7 @@ time (input pipeline), dispatch time (python+transfer), device step time
 
 import json
 import os
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Optional
@@ -23,13 +24,19 @@ class Metrics:
         # time_lost_to_recovery_s, ...): run-lifetime totals, so they
         # survive the per-log-window reset() that clears the timers
         self.counters: Dict[str, float] = defaultdict(float)
+        # the global_metrics() registry is shared across threads (serving
+        # client/engine threads + the training driver); += on a dict
+        # entry is a read-modify-write that loses updates without this
+        self._lock = threading.Lock()
 
     def add(self, name: str, value: float):
-        self.sums[name] += value
-        self.counts[name] += 1
+        with self._lock:
+            self.sums[name] += value
+            self.counts[name] += 1
 
     def inc(self, name: str, n: float = 1):
-        self.counters[name] += n
+        with self._lock:
+            self.counters[name] += n
 
     def counter(self, name: str) -> float:
         return self.counters.get(name, 0.0)
@@ -46,6 +53,25 @@ class Metrics:
         out = {k: self.mean(k) for k in self.sums}
         out.update(self.counters)
         return out
+
+
+_GLOBAL: Optional[Metrics] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_metrics() -> Metrics:
+    """The process-wide default :class:`Metrics` registry.
+
+    Subsystems that are not handed an explicit registry (the serving
+    stack's ``serving.*`` lifecycle counters, notably) record here, so one
+    ``summary()`` — and one ``/health`` scrape — sees training recovery
+    counters and serving shed/expire/drain counters side by side."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = Metrics()
+    return _GLOBAL
 
 
 class Timer:

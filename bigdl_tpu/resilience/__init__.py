@@ -16,13 +16,15 @@ from bigdl_tpu.resilience import faults
 from bigdl_tpu.resilience.detector import (Heartbeat, HeartbeatMonitor,
                                            StepWatchdog)
 from bigdl_tpu.resilience.faults import (FaultInjector, FaultSpec,
-                                         InjectedFault)
+                                         InjectedFault,
+                                         InjectedPredictError)
 from bigdl_tpu.resilience.retry import (FailureCause, FailurePolicy,
                                         PoisonedStepError, RetryPolicy,
                                         TopologyChangedError, classify)
 
 __all__ = [
     "faults", "FaultInjector", "FaultSpec", "InjectedFault",
+    "InjectedPredictError",
     "Heartbeat", "HeartbeatMonitor", "StepWatchdog",
     "FailureCause", "FailurePolicy", "PoisonedStepError", "RetryPolicy",
     "TopologyChangedError", "classify",

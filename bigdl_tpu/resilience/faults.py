@@ -47,7 +47,16 @@ from bigdl_tpu.utils.log import get_logger
 log = get_logger("bigdl_tpu.resilience")
 
 POINTS = ("step_fail", "checkpoint_write_fail", "storage_io_fail",
-          "process_kill", "slow_host")
+          "process_kill", "slow_host",
+          # serving chaos seams (instrumented in ServingServer._process):
+          # - serving_predict_fail — raise in place of predict (a dying
+          #   model replica; feeds the degradation/breaker machinery)
+          # - serving_worker_kill  — os._exit mid-batch (a preempted pool
+          #   worker dying with requests in flight)
+          # - serving_slow_batch   — sleep before predict (a straggling
+          #   batch; drives deadline expiry downstream)
+          "serving_predict_fail", "serving_worker_kill",
+          "serving_slow_batch")
 
 
 class InjectedFault(RuntimeError):
@@ -79,12 +88,20 @@ class ProcessKilledError(InjectedFault):
     """``process_kill`` in ``action="raise"`` mode (in-process tests)."""
 
 
+class InjectedPredictError(InjectedFault):
+    """``serving_predict_fail`` — a replica's predict dying; the serving
+    degradation machinery must treat it exactly like a real model error."""
+
+
 _EXC = {
     "step_fail": InjectedStepFailure,
     "checkpoint_write_fail": InjectedCheckpointWriteError,
     "storage_io_fail": InjectedStorageError,
     "process_kill": ProcessKilledError,
     "slow_host": InjectedFault,
+    "serving_predict_fail": InjectedPredictError,
+    "serving_worker_kill": ProcessKilledError,
+    "serving_slow_batch": InjectedFault,
 }
 
 
@@ -105,7 +122,10 @@ class FaultSpec:
                 f"unknown fault point {self.point!r}; one of {POINTS}")
         if self.action is None:
             self.action = {"slow_host": "sleep",
-                           "process_kill": "exit"}.get(self.point, "raise")
+                           "serving_slow_batch": "sleep",
+                           "process_kill": "exit",
+                           "serving_worker_kill": "exit"}.get(
+                               self.point, "raise")
         if self.max_fires is None and self.at_step is not None:
             self.max_fires = 1
 

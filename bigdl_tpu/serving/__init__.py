@@ -13,7 +13,10 @@ XLA serializes device execution — but the thread-safe façade remains.
 """
 
 from bigdl_tpu.serving.inference_model import InferenceModel
-from bigdl_tpu.serving.server import ServingConfig, ServingServer
+from bigdl_tpu.serving.server import (DeadlineExceededError,
+                                      RequestDroppedError,
+                                      ServiceUnavailableError,
+                                      ServingConfig, ServingServer)
 from bigdl_tpu.serving.client import InputQueue, OutputQueue
 from bigdl_tpu.serving.http_frontend import HttpClient, HttpFrontend
 
@@ -23,4 +26,5 @@ from bigdl_tpu.serving.pool import ServingPool
 __all__ = [
     "Seq2SeqService", "InferenceModel", "ServingServer", "ServingConfig",
     "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient",
-    "ServingPool"]
+    "ServingPool", "ServiceUnavailableError", "DeadlineExceededError",
+    "RequestDroppedError"]

@@ -5,6 +5,14 @@ serving/client.py`` — enqueue ndarrays into Redis, poll results.  Here the
 transport is the in-process ``ServingServer`` (the Redis/Flink cluster
 plumbing is out of scope for the TPU core; the client API surface and
 semantics — ids, enqueue/query, timeout — match).
+
+Lifecycle semantics ride through unchanged: ``enqueue`` can shed
+(:class:`~bigdl_tpu.serving.server.ServiceUnavailableError` on a full
+queue or degraded server — it never blocks unboundedly) and accepts a
+per-request ``deadline_s``; ``query`` raises the request's recorded
+verdict (:class:`~bigdl_tpu.serving.server.DeadlineExceededError` when it
+expired in the queue, :class:`~bigdl_tpu.serving.server.
+RequestDroppedError` when the server stopped before processing it).
 """
 
 from typing import Optional
@@ -18,13 +26,18 @@ class InputQueue:
     def __init__(self, server: ServingServer):
         self._server = server
 
-    def enqueue(self, uri: Optional[str] = None, **kwargs) -> str:
-        """``InputQueue.enqueue(uri, t=ndarray)`` — returns the request id."""
+    def enqueue(self, uri: Optional[str] = None,
+                deadline_s: Optional[float] = None, **kwargs) -> str:
+        """``InputQueue.enqueue(uri, t=ndarray)`` — returns the request id.
+
+        ``deadline_s`` (relative) bounds how long the request may wait in
+        the queue before the engine drops it instead of predicting."""
         if len(kwargs) != 1:
             raise ValueError("enqueue expects exactly one named tensor, "
                              "e.g. enqueue('req-1', t=arr)")
         (arr,) = kwargs.values()
-        return self._server.enqueue(np.asarray(arr), request_id=uri)
+        return self._server.enqueue(np.asarray(arr), request_id=uri,
+                                    deadline_s=deadline_s)
 
 
 class OutputQueue:

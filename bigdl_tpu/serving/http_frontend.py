@@ -10,7 +10,14 @@ TPU-native: a stdlib ``ThreadingHTTPServer`` over the in-process
 dynamic-batches them onto the chip exactly as queue clients do.
 
     POST /predict   {"instances": [[...], ...]}  -> {"predictions": [...]}
-    GET  /health    -> {"status": "ok", "batches": N, "requests": M}
+    GET  /health    -> {"status": "ok", "batches": N, "requests": M, ...}
+
+Request lifecycle mapping (docs/serving.md): a per-request deadline rides
+in as ``"deadline_s"`` in the payload or an ``X-Deadline-S`` header and is
+stamped at admission; backpressure/degradation sheds surface as **429**
+with a ``Retry-After`` header (never an open-ended block), a deadline that
+expires in the queue is **504**, an oversized body is rejected with
+**413** before it is read, and other engine errors stay **500**.
 """
 
 import json
@@ -21,7 +28,10 @@ from urllib import request as _urlreq
 
 import numpy as np
 
-from bigdl_tpu.serving.server import ServingServer
+from bigdl_tpu.serving.json_http import reply_json
+from bigdl_tpu.serving.server import (DeadlineExceededError,
+                                      RequestDroppedError,
+                                      ServiceUnavailableError, ServingServer)
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving.http")
@@ -33,34 +43,58 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route to our logger, not stderr
         log.debug(fmt, *args)
 
-    def _json(self, code: int, payload: dict):
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    def _json(self, code: int, payload: dict,
+              headers: Optional[dict] = None):
+        reply_json(self, code, json.dumps(payload).encode(), headers)
 
     def do_GET(self):
         if self.path != "/health":
             return self._json(404, {"error": f"unknown path {self.path}"})
         srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
-        self._json(200, {"status": "ok", **srv.stats})
+        self._json(200, {"status": "degraded" if srv.degraded else "ok",
+                         "degraded": srv.degraded, **srv.stats})
 
     def do_POST(self):
         if self.path != "/predict":
             return self._json(404, {"error": f"unknown path {self.path}"})
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
         try:
             length = int(self.headers.get("Content-Length", "0"))
+            if length < 0:
+                raise ValueError(length)  # read(-1) would buffer to EOF
+        except ValueError:
+            return self._json(400, {"error": "bad Content-Length"})
+        if length > self.server.max_body_bytes:  # type: ignore[attr-defined]
+            # reject BEFORE reading: one malformed client must not make
+            # the worker buffer an arbitrarily large body
+            return self._json(413, {
+                "error": f"request body {length} bytes exceeds limit "
+                         f"{self.server.max_body_bytes}"})  # type: ignore[attr-defined]
+        deadline_s: Optional[float] = None
+        try:
             payload = json.loads(self.rfile.read(length) or b"{}")
             instances = np.asarray(payload["instances"], np.float32)
+            hdr = self.headers.get("X-Deadline-S")
+            raw = payload.get("deadline_s", hdr) \
+                if isinstance(payload, dict) else hdr
+            if raw is not None:
+                deadline_s = float(raw)
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers valid-JSON non-object bodies ([1,2,3], 42)
             return self._json(400, {"error": f"bad request: {e}"})
-        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
         try:
-            rid = srv.enqueue(instances)
+            rid = srv.enqueue(instances, deadline_s=deadline_s)
+        except ServiceUnavailableError as e:
+            # backpressure / degradation / draining: shed with a retry
+            # hint so the client (or the pool proxy) goes elsewhere
+            return self._json(429, {"error": str(e)},
+                              {"Retry-After": str(e.retry_after)})
+        try:
             result = srv.query(rid, timeout=self.server.predict_timeout)
+        except DeadlineExceededError as e:
+            return self._json(504, {"error": str(e), "expired": True})
+        except RequestDroppedError as e:
+            return self._json(503, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
             return self._json(500, {"error": str(e)})
         self._json(200, {"predictions": np.asarray(result).tolist()})
@@ -70,11 +104,13 @@ class HttpFrontend:
     """Serve a ServingServer over HTTP (threaded stdlib server)."""
 
     def __init__(self, serving: ServingServer, host: str = "127.0.0.1",
-                 port: int = 0, predict_timeout: float = 30.0):
+                 port: int = 0, predict_timeout: float = 30.0,
+                 max_body_bytes: int = 64 * 1024 * 1024):
         self.serving = serving
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.serving = serving  # type: ignore[attr-defined]
         self._httpd.predict_timeout = predict_timeout  # type: ignore[attr-defined]
+        self._httpd.max_body_bytes = max_body_bytes  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -103,9 +139,12 @@ class HttpClient:
         self.url = url.rstrip("/")
         self.timeout = timeout
 
-    def predict(self, instances) -> np.ndarray:
-        body = json.dumps(
-            {"instances": np.asarray(instances).tolist()}).encode()
+    def predict(self, instances,
+                deadline_s: Optional[float] = None) -> np.ndarray:
+        payload = {"instances": np.asarray(instances).tolist()}
+        if deadline_s is not None:
+            payload["deadline_s"] = deadline_s
+        body = json.dumps(payload).encode()
         req = _urlreq.Request(self.url + "/predict", data=body,
                               headers={"Content-Type": "application/json"})
         with _urlreq.urlopen(req, timeout=self.timeout) as resp:

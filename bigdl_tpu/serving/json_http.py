@@ -14,22 +14,37 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 
+def reply_json(handler: BaseHTTPRequestHandler, code: int, body: bytes,
+               headers: Optional[Dict[str, str]] = None) -> None:
+    """Write one JSON response, tolerating a client that already hung up
+    (its own timeout) — the abandoned-request case must not traceback.
+    Shared by every serving HTTP surface (frontend, pool proxy, this
+    scaffolding) so the write path cannot drift between copies."""
+    try:
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+
+
 class JsonHTTPServer:
     def __init__(self, routes: Dict[str, Callable[[dict], dict]],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_body_bytes: int = 64 * 1024 * 1024):
         server_routes = dict(routes)
+        body_limit = max_body_bytes
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
 
             def _json(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                reply_json(self, code, json.dumps(payload).encode())
 
             def do_POST(self):
                 try:
@@ -37,7 +52,19 @@ class JsonHTTPServer:
                     if fn is None:
                         self._json(404, {"error": f"no route {self.path}"})
                         return
-                    n = int(self.headers.get("Content-Length", 0))
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        if n < 0:       # read(-1) would buffer to EOF
+                            raise ValueError(n)
+                    except ValueError:
+                        self._json(400, {"error": "bad Content-Length"})
+                        return
+                    if n > body_limit:
+                        # bound BEFORE reading: a malformed client must
+                        # not make this process buffer an arbitrary body
+                        self._json(413, {"error": f"request body {n} "
+                                         f"bytes exceeds {body_limit}"})
+                        return
                     req = json.loads(self.rfile.read(n) or b"{}")
                     self._json(200, fn(req))
                 except KeyError as e:

@@ -19,6 +19,16 @@ returning an :class:`~bigdl_tpu.serving.inference_model.InferenceModel` —
 workers import it in their own interpreter (the model never crosses the
 process boundary, exactly the reference's model-per-task-manager
 posture).
+
+Routing hardening (docs/serving.md): each worker sits behind a per-worker
+CIRCUIT BREAKER — consecutive connection-level failures open it, an open
+breaker is skipped without burning a connect timeout per request, and
+after a cooldown a single half-open probe decides whether it closes.
+Worker-side backpressure (429/503) routes to the next worker instead of
+bouncing the client.  ``hedge_after_s`` optionally duplicates an
+idempotent predict onto a second worker when the first is slow (bounded:
+one hedge, first answer wins).  ``stop()`` drains workers before killing
+them — each worker finishes its queued requests within the drain budget.
 """
 
 import json
@@ -28,15 +38,17 @@ import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import List, Optional, Tuple
 from urllib import request as _urlreq
 
+from bigdl_tpu.serving.json_http import reply_json
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving.pool")
 
 
-def _worker_main(loader: str, batch_size: int, queue_capacity: int) -> None:
+def _worker_main(loader: str, batch_size: int, queue_capacity: int,
+                 drain_timeout_s: float = 5.0) -> None:
     """Entry point inside a worker subprocess."""
     import importlib
 
@@ -55,27 +67,100 @@ def _worker_main(loader: str, batch_size: int, queue_capacity: int) -> None:
     fe = HttpFrontend(srv, port=0).start()
     print(f"WORKER_URL={fe.url}", flush=True)
     sys.stdin.readline()           # parent closes stdin to stop us
+    # drain-before-kill: finish queued requests (new ones are shed with
+    # 429 by the draining server) before the frontend socket goes away
+    srv.stop(drain=True, timeout=drain_timeout_s)
     fe.stop()
-    srv.stop()
+
+
+class _Breaker:
+    """Per-worker circuit breaker over CONNECTION-level failures.
+
+    closed -> (fail_threshold consecutive failures) -> open ->
+    (cooldown_s elapses) -> half-open: exactly one probe request is
+    admitted; its success closes the breaker, its failure re-opens.
+    Application-level errors (worker answered 4xx/5xx) count as success —
+    the worker is alive and routable.
+
+    ``try_acquire`` (mutating — reserves the half-open probe slot) is
+    called only at the moment a request is actually about to be sent;
+    candidate listing must stay side-effect-free, otherwise a worker
+    listed-but-never-contacted would burn its probe and wedge half-open
+    forever with nothing ever feeding record_success/failure."""
+
+    def __init__(self, fail_threshold: int = 3, cooldown_s: float = 2.0):
+        self.fail_threshold = fail_threshold
+        self.cooldown_s = cooldown_s
+        self.state = "closed"
+        self.failures = 0
+        self.trips = 0
+        self._opened_t = 0.0
+        self._lock = threading.Lock()
+
+    def try_acquire(self) -> bool:
+        """Admission for one real attempt (mutating).  Open past the
+        cooldown flips to half-open and admits THIS caller as the probe;
+        half-open admits nobody else until the probe reports back."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if time.time() - self._opened_t >= self.cooldown_s:
+                    self.state = "half-open"
+                    return True
+                return False
+            return False  # half-open: a probe is already in flight
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self.state = "closed"
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if (self.state == "half-open"
+                    or self.failures >= self.fail_threshold):
+                if self.state != "open":
+                    self.trips += 1
+                self.state = "open"
+                self._opened_t = time.time()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.state = "closed"
+            self.failures = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state, "failures": self.failures,
+                    "trips": self.trips}
 
 
 class _Worker:
     def __init__(self, loader: str, batch_size: int, queue_capacity: int,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0,
+                 drain_timeout_s: float = 5.0):
         self.loader = loader
         self.batch_size = batch_size
         self.queue_capacity = queue_capacity
         self.env = env
+        self.drain_timeout_s = drain_timeout_s
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
+        self.breaker = _Breaker(breaker_threshold, breaker_cooldown_s)
 
     def spawn(self, timeout: float = 120.0) -> None:
         env = dict(os.environ, **(self.env or {}))
+        self.url = None  # a corpse's url must never leak into routing/health
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "bigdl_tpu.serving.pool", "--worker",
              "--loader", self.loader, "--batch-size",
              str(self.batch_size), "--queue-capacity",
-             str(self.queue_capacity)],
+             str(self.queue_capacity), "--drain-timeout",
+             str(self.drain_timeout_s)],
             env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             text=True)
         # readline blocks with no deadline, so read on a helper thread: a
@@ -99,6 +184,7 @@ class _Worker:
         t.join(timeout)
         if found:
             self.url = found[0]
+            self.breaker.reset()  # fresh process, fresh record
             return
         if self.proc.poll() is None:
             self.proc.kill()
@@ -108,15 +194,35 @@ class _Worker:
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
 
-    def stop(self) -> None:
-        if self.proc is None:
-            return
-        if self.proc.poll() is None:
+    def routable(self) -> bool:
+        """Listing-time check — deliberately breaker-blind (and so
+        side-effect-free): the breaker gates at attempt time via
+        ``try_acquire``, where a skip costs nothing."""
+        return self.alive() and self.url is not None
+
+    def request_stop(self) -> None:
+        """Begin drain-before-kill: closing stdin asks the worker to
+        finish its queued requests (bounded by its drain budget) and
+        exit."""
+        if self.proc is not None and self.proc.poll() is None:
             try:
                 self.proc.stdin.close()
-                self.proc.wait(timeout=10)
-            except Exception:
+            except Exception:  # noqa: BLE001 — already half-dead
                 self.proc.kill()
+
+    def join_stop(self) -> None:
+        """Wait out the drain budget; only a worker that overruns it is
+        killed."""
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            self.proc.wait(timeout=self.drain_timeout_s + 10)
+        except Exception:
+            self.proc.kill()
+
+    def stop(self) -> None:
+        self.request_stop()
+        self.join_stop()
 
 
 class _ProxyHandler(BaseHTTPRequestHandler):
@@ -129,49 +235,153 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         req = _urlreq.Request(url, data=body, method=method, headers={
             "Content-Type": "application/json"})
         with _urlreq.urlopen(req, timeout=self.server.predict_timeout) as r:
-            return r.status, r.read()
+            return r.status, r.read(), dict(r.headers)
 
-    def _reply(self, code: int, body: bytes):
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    def _reply(self, code: int, body: bytes,
+               headers: Optional[dict] = None):
+        reply_json(self, code, body, headers)
 
-    def do_POST(self):
+    def _attempt(self, worker: "_Worker", body: bytes
+                 ) -> Tuple[str, int, bytes]:
+        """One forward to one worker, with breaker accounting.  Returns
+        ('relay', code, body) for an answer that must go to the client,
+        ('busy', ...) for worker-side backpressure (try the next worker),
+        ('skip', ...) when the breaker refuses admission (open, or a
+        probe already in flight), or raises on a connection-level failure
+        (breaker already fed)."""
         import urllib.error
 
+        if not worker.breaker.try_acquire():
+            return ("skip", 0, b"")
+        url = worker.url
+        try:
+            code, out, _ = self._forward("POST", url + self.path, body)
+            worker.breaker.record_success()
+            return ("relay", code, out)
+        except urllib.error.HTTPError as e:
+            # the worker is ALIVE and answered: its breaker stays closed.
+            # 429/503 are backpressure/draining — route around, the next
+            # worker may have queue room; other codes (400 bad payload /
+            # 500 model error) relay as the worker's verdict
+            worker.breaker.record_success()
+            payload = e.read()
+            if e.code in (429, 503):
+                return ("busy", e.code, payload)
+            return ("relay", e.code, payload)
+        except Exception:
+            worker.breaker.record_failure()
+            raise
+
+    def do_POST(self):
         pool: "ServingPool" = self.server.pool
-        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length < 0:
+                raise ValueError(length)  # read(-1) would buffer to EOF
+        except ValueError:
+            return self._reply(400, b'{"error": "bad Content-Length"}')
+        if length > pool.max_body_bytes:
+            pool._count("rejected_oversize")
+            return self._reply(413, json.dumps(
+                {"error": f"request body {length} bytes exceeds limit "
+                          f"{pool.max_body_bytes}"}).encode())
         body = self.rfile.read(length)
-        # try each worker once, starting at the round-robin cursor: a DEAD
-        # worker (connection-level failure) is skipped instead of failing
-        # the request; the supervisor thread notices the corpse and
-        # respawns it independently
-        last_err = None
-        for url in pool._next_urls():
+        # breaker-aware routing, starting at the round-robin cursor: dead
+        # or breaker-open workers are skipped without burning a connect
+        # timeout; worker-side 429/503 routes to the next worker; the
+        # supervisor respawns corpses independently
+        last_err: Optional[BaseException] = None
+        busy: Optional[Tuple[int, bytes]] = None
+        candidates = pool._next_workers()
+        tried = set()  # a hedge backup that actually saw this request
+        #                must not get the same body again next iteration
+        #                (duplicate predict work)
+        for i, w in enumerate(candidates):
+            if id(w) in tried:
+                continue
+            tried.add(id(w))
             try:
-                code, out = self._forward("POST", url + self.path, body)
-                return self._reply(code, out)
-            except urllib.error.HTTPError as e:
-                # the worker is ALIVE and answered (400 bad payload / 500
-                # model error): relay its verdict, do NOT retry elsewhere
-                return self._reply(e.code, e.read())
+                if (pool.hedge_after_s is not None
+                        and i + 1 < len(candidates)):
+                    verdict, code, out = self._attempt_hedged(
+                        w, candidates[i + 1], body, pool, tried)
+                else:
+                    verdict, code, out = self._attempt(w, body)
             except Exception as e:  # noqa: BLE001 — worker down mid-request
                 last_err = e
+                continue
+            if verdict == "skip":
+                continue
+            if verdict == "busy":
+                busy = (code, out)
+                continue
+            return self._reply(code, out)
+        if busy is not None:
+            # every routable worker is shedding: relay the backpressure
+            # verdict (with its Retry-After) instead of inventing a 503
+            pool._count("proxy_busy")
+            return self._reply(busy[0], busy[1],
+                               {"Retry-After": str(pool.retry_after_s)})
+        pool._count("proxy_unavailable")
         self._reply(503, json.dumps(
-            {"error": f"no serving worker available: {last_err}"}).encode())
+            {"error": f"no serving worker available: {last_err}"}).encode(),
+            {"Retry-After": str(pool.retry_after_s)})
+
+    def _attempt_hedged(self, primary: "_Worker", backup: "_Worker",
+                        body: bytes, pool: "ServingPool", tried: set
+                        ) -> Tuple[str, int, bytes]:
+        """Bounded hedge for idempotent predicts: fire the primary, and if
+        it has not answered within ``hedge_after_s`` also fire ONE backup;
+        the first answer wins (the loser's response is discarded — predict
+        is pure, so duplicated work is wasted chip time, not corruption).
+        The backup joins ``tried`` only when the hedge actually fires — a
+        fast primary verdict must leave it available to the routing
+        loop."""
+        import queue as _queue
+
+        results: "_queue.Queue" = _queue.Queue()
+
+        def run(worker):
+            try:
+                results.put(("ok", self._attempt(worker, body)))
+            except Exception as e:  # noqa: BLE001 — breaker already fed
+                results.put(("err", e))
+
+        threading.Thread(target=run, args=(primary,), daemon=True).start()
+        try:
+            kind, payload = results.get(timeout=pool.hedge_after_s)
+        except _queue.Empty:
+            pool._count("hedged_requests")
+            tried.add(id(backup))
+            threading.Thread(target=run, args=(backup,), daemon=True).start()
+            kind, payload = results.get()  # first of the two to answer
+            if kind == "err" or payload[0] == "skip":
+                # give the straggler a chance before giving up on the pair
+                try:
+                    kind2, payload2 = results.get(
+                        timeout=self.server.predict_timeout)
+                    if kind2 == "ok" and payload2[0] != "skip":
+                        kind, payload = kind2, payload2
+                except _queue.Empty:
+                    pass
+        if kind == "ok":
+            return payload
+        raise payload
 
     def do_GET(self):
         pool: "ServingPool" = self.server.pool
         if self.path != "/health":
             return self._reply(404, b'{"error": "unknown path"}')
-        agg = {"status": "ok", "workers": []}
+        agg = {"status": "ok", "restarts": pool.restarts,
+               "pool": dict(pool.stats), "workers": []}
         for w in pool.workers:
-            one = {"url": w.url, "alive": w.alive()}
-            if w.alive():
+            # url reflects the CURRENT process: spawn() clears it before
+            # launching, so a corpse's old endpoint never shows up here
+            one = {"url": w.url, "alive": w.alive(),
+                   "breaker": w.breaker.snapshot()}
+            if w.alive() and w.url:
                 try:
-                    _, out = self._forward("GET", w.url + "/health", None)
+                    _, out, _ = self._forward("GET", w.url + "/health", None)
                     one.update(json.loads(out))
                 except Exception as e:  # noqa: BLE001
                     one["error"] = str(e)
@@ -180,23 +390,38 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                               for w in agg["workers"])
         agg["batches"] = sum(int(w.get("batches", 0))
                              for w in agg["workers"])
+        if not any(w["alive"] for w in agg["workers"]):
+            agg["status"] = "unavailable"
         self._reply(200, json.dumps(agg).encode())
 
 
 class ServingPool:
     """N process-isolated serving workers behind one round-robin proxy
-    with liveness supervision (dead workers are respawned)."""
+    with liveness supervision (dead workers are respawned), per-worker
+    circuit breakers, and drain-before-kill shutdown."""
 
     def __init__(self, loader: str, workers: int = 2, batch_size: int = 32,
                  queue_capacity: int = 4096, host: str = "127.0.0.1",
                  port: int = 0, predict_timeout: float = 30.0,
                  worker_env: Optional[dict] = None,
-                 supervise_interval_s: float = 1.0):
+                 supervise_interval_s: float = 1.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0,
+                 hedge_after_s: Optional[float] = None,
+                 drain_timeout_s: float = 5.0,
+                 max_body_bytes: int = 64 * 1024 * 1024,
+                 retry_after_s: float = 1.0):
         self.loader = loader
         self.n = workers
         self.batch_size = batch_size
         self.queue_capacity = queue_capacity
         self.worker_env = worker_env
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.hedge_after_s = hedge_after_s
+        self.drain_timeout_s = drain_timeout_s
+        self.max_body_bytes = max_body_bytes
+        self.retry_after_s = retry_after_s
         self.workers: List[_Worker] = []
         self._rr = 0
         self._rr_lock = threading.Lock()
@@ -208,25 +433,39 @@ class ServingPool:
         self.host, self.port = self._httpd.server_address[:2]
         self._threads: List[threading.Thread] = []
         self.restarts = 0
+        self._stats_lock = threading.Lock()
+        self.stats = {"hedged_requests": 0, "proxy_busy": 0,
+                      "proxy_unavailable": 0, "rejected_oversize": 0}
+
+    def _count(self, name: str, n: int = 1) -> None:
+        # proxy handler threads count concurrently; += is not atomic
+        with self._stats_lock:
+            self.stats[name] += n
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
     # -- routing ------------------------------------------------------------
-    def _next_urls(self) -> List[str]:
+    def _next_workers(self) -> List[_Worker]:
+        """Routable workers (alive, registered url, breaker admits) in
+        round-robin order starting at the cursor."""
         with self._rr_lock:
             self._rr += 1
             start = self._rr
         ordered = [self.workers[(start + i) % len(self.workers)]
                    for i in range(len(self.workers))]
-        return [w.url for w in ordered if w.alive() and w.url]
+        return [w for w in ordered if w.routable()]
+
+    def _next_urls(self) -> List[str]:
+        return [w.url for w in self._next_workers()]
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingPool":
         for _ in range(self.n):
             w = _Worker(self.loader, self.batch_size, self.queue_capacity,
-                        self.worker_env)
+                        self.worker_env, self.breaker_threshold,
+                        self.breaker_cooldown_s, self.drain_timeout_s)
             w.spawn()
             self.workers.append(w)
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
@@ -243,6 +482,8 @@ class ServingPool:
             for w in self.workers:
                 if not w.alive() and not self._stop.is_set():
                     log.warning("serving worker %s died; respawning", w.url)
+                    w.url = None  # stale endpoint: not routable, not
+                    #               reported by /health as the corpse's
                     try:
                         w.spawn()
                         self.restarts += 1
@@ -251,11 +492,18 @@ class ServingPool:
             self._stop.wait(self._supervise_interval)
 
     def stop(self) -> None:
+        """Shut down: close the proxy to new requests, then drain each
+        worker (stdin close -> worker finishes queued requests within its
+        drain budget) before any kill."""
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        # start every worker's drain first, THEN wait: one shared drain
+        # window instead of O(workers * budget) sequential shutdowns
         for w in self.workers:
-            w.stop()
+            w.request_stop()
+        for w in self.workers:
+            w.join_stop()
 
 
 def _main() -> None:
@@ -266,11 +514,13 @@ def _main() -> None:
     ap.add_argument("--loader", required=True)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--queue-capacity", type=int, default=4096)
+    ap.add_argument("--drain-timeout", type=float, default=5.0)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--port", type=int, default=8000)
     args = ap.parse_args()
     if args.worker:
-        _worker_main(args.loader, args.batch_size, args.queue_capacity)
+        _worker_main(args.loader, args.batch_size, args.queue_capacity,
+                     args.drain_timeout)
         return
     pool = ServingPool(args.loader, workers=args.workers,
                        batch_size=args.batch_size,

@@ -10,17 +10,31 @@ TPU-native: the transport is an in-process (or file-backed) queue pair —
 Redis/Flink are cluster plumbing, not capability — while the batching loop,
 backpressure and at-least-once result delivery semantics match.  A
 dispatcher thread owns the chip; client threads only enqueue.
+
+Request lifecycle (docs/serving.md has the state machine): every request
+carries an admission time and an absolute deadline from ``enqueue`` through
+the queue into the batch loop.  Admission fails fast — a full queue sheds
+(``ServiceUnavailableError``, never an unbounded block), a degraded server
+sheds (half-open probing excepted) — and the batch loop drops expired
+requests BEFORE predict so a slow model never spends chip time answering a
+client that already gave up.  Completed results live in a TTL'd table so an
+abandoned ``query`` cannot leak entries forever, and shutdown is explicit:
+``drain()`` finishes queued work, plain ``stop()`` fails it with
+``RequestDroppedError`` — queued requests are never silently discarded.
 """
 
+import math
 import queue
 import threading
 import time
 import uuid
-from dataclasses import dataclass, field
-from typing import Any, Dict, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
 
 import numpy as np
 
+from bigdl_tpu.optim.metrics import Metrics, global_metrics
+from bigdl_tpu.resilience import faults
 from bigdl_tpu.serving.inference_model import InferenceModel
 from bigdl_tpu.utils.log import get_logger
 
@@ -43,12 +57,66 @@ class ServingConfig:
     # degradation by itself (otherwise shedding is permanent: recovery
     # only happens inside _process, which needs an admitted request)
     degraded_probe_interval_s: float = 1.0
+    # -- request lifecycle --------------------------------------------------
+    # deadline stamped at admission when the caller passes none; None means
+    # requests never expire (the pre-lifecycle behavior)
+    default_deadline_s: Optional[float] = None
+    # how long enqueue may wait on a FULL queue before shedding; 0 sheds
+    # immediately.  Bounded by construction — there is no blocking mode
+    enqueue_block_s: float = 0.0
+    # Retry-After hint attached to sheds (HTTP 429 surfaces it verbatim)
+    retry_after_s: float = 1.0
+    # completed-but-never-queried results are GC'd after this long; the
+    # sweep runs on the engine thread between batches
+    result_ttl_s: float = 60.0
+    result_gc_interval_s: float = 1.0
+    # default budget for stop(drain=True) / drain()
+    drain_timeout_s: float = 10.0
 
 
 class ServiceUnavailableError(RuntimeError):
-    """Raised by ``enqueue`` while the server is degraded with no
-    fallback model — fail fast at admission instead of queueing requests
-    into a replica that cannot answer them (load shedding)."""
+    """Raised by ``enqueue`` when the server cannot accept the request —
+    degraded with no fallback model, queue full (backpressure), draining,
+    or stopped — so callers fail fast at admission and retry another
+    replica instead of queueing into one that cannot answer.
+    ``retry_after`` is the backoff hint (HTTP 429 ``Retry-After``)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class DeadlineExceededError(TimeoutError):
+    """Delivered to ``query`` when the request's deadline passed while it
+    waited in the queue — the batch loop dropped it before predict."""
+
+    def __init__(self, rid: str, waited_s: float):
+        super().__init__(
+            f"request {rid} expired after {waited_s:.3f}s in queue "
+            "(deadline passed before predict)")
+        self.rid = rid
+        self.waited_s = waited_s
+
+
+class RequestDroppedError(RuntimeError):
+    """Delivered to ``query`` for requests still queued when the server
+    stopped without (or past) a drain — an explicit verdict, never a
+    silent drop."""
+
+    def __init__(self, rid: str):
+        super().__init__(f"request {rid} dropped: server stopped before it "
+                         "was processed")
+        self.rid = rid
+
+
+@dataclass
+class _Request:
+    """One queued request: payload + lifecycle timestamps (absolute)."""
+
+    rid: str
+    arr: np.ndarray
+    admit_t: float
+    deadline_t: float  # math.inf when the request never expires
 
 
 class ServingServer:
@@ -60,25 +128,46 @@ class ServingServer:
     typically the previous good version) keeps answering from it;
     degraded without one sheds new load at ``enqueue`` so callers retry
     another replica.  ``reload_model`` installs a restarted replica's
-    model and clears degradation."""
+    model and clears degradation.
+
+    Every lifecycle event (shed, expiry, drain, drop, GC) lands in
+    ``stats`` and — namespaced ``serving.*`` — in the process
+    :class:`~bigdl_tpu.optim.metrics.Metrics` registry, so ``/health``
+    and training-side metric consumers see the same counters."""
 
     def __init__(self, model: InferenceModel,
-                 config: Optional[ServingConfig] = None):
+                 config: Optional[ServingConfig] = None,
+                 metrics: Optional[Metrics] = None):
         self.model = model
         self.config = config or ServingConfig()
-        self._in: "queue.Queue[Tuple[str, np.ndarray]]" = queue.Queue(
+        self.metrics = metrics or global_metrics()
+        self._in: "queue.Queue[_Request]" = queue.Queue(
             self.config.queue_capacity)
-        self._results: Dict[str, np.ndarray] = {}
+        self._results: Dict[str, Any] = {}
+        self._result_expiry: Dict[str, float] = {}
         self._result_cv = threading.Condition()
+        self._last_gc_t = 0.0
         self._stop = threading.Event()
+        self._draining = False
+        self._busy = False  # engine thread is inside _process
         self._thread: Optional[threading.Thread] = None
         self._fallback_model: Optional[InferenceModel] = None
         self._consecutive_failures = 0
         self._last_probe_t = 0.0
         self._probe_lock = threading.Lock()
         self.degraded = False
+        self._stats_lock = threading.Lock()
         self.stats = {"batches": 0, "requests": 0, "failed_batches": 0,
-                      "fallback_batches": 0, "shed_requests": 0}
+                      "fallback_batches": 0, "shed_requests": 0,
+                      "expired_requests": 0, "drained_requests": 0,
+                      "dropped_requests": 0, "results_gc": 0}
+
+    def _count(self, name: str, n: int = 1) -> None:
+        # client threads and the engine thread both count; += on a dict
+        # entry is not atomic, and tests assert exact counter values
+        with self._stats_lock:
+            self.stats[name] += n
+            self.metrics.inc(f"serving.{name}", n)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -86,10 +175,64 @@ class ServingServer:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def drain(self, timeout: Optional[float] = None) -> Dict[str, int]:
+        """Graceful shutdown: stop admitting, let the engine finish queued
+        and in-flight work within ``timeout``, then stop.  Requests still
+        queued when the budget runs out get an explicit
+        :class:`RequestDroppedError`.  Returns ``{"drained": n, "dropped":
+        m}`` for the caller's log line."""
+        timeout = self.config.drain_timeout_s if timeout is None else timeout
+        self._draining = True
+        t_end = time.time() + timeout
+        drained_from = self.stats["requests"]
+        while time.time() < t_end:
+            if self._in.empty() and not self._busy:
+                break
+            time.sleep(0.005)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(timeout, 5))
+        dropped = self._fail_queued()
+        drained = self.stats["requests"] - drained_from
+        self._count("drained_requests", drained)
+        if dropped:
+            log.warning("serving drain: budget exhausted, %d queued "
+                        "requests dropped with explicit errors", dropped)
+        return {"drained": drained, "dropped": dropped}
+
+    def stop(self, drain: bool = False,
+             timeout: Optional[float] = None) -> None:
+        """Stop the engine.  ``drain=True`` finishes queued work first
+        (see :meth:`drain`); otherwise queued requests are failed
+        explicitly with :class:`RequestDroppedError` — never silently
+        discarded."""
+        if drain:
+            self.drain(timeout)
+            return
+        self._draining = True
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self._fail_queued()
+
+    def _fail_queued(self) -> int:
+        """Deliver RequestDroppedError to everything still queued."""
+        dropped = 0
+        now = time.time()
+        with self._result_cv:
+            while True:
+                try:
+                    req = self._in.get_nowait()
+                except queue.Empty:
+                    break
+                self._results[req.rid] = RequestDroppedError(req.rid)
+                self._result_expiry[req.rid] = now + self.config.result_ttl_s
+                dropped += 1
+            if dropped:
+                self._result_cv.notify_all()
+        if dropped:
+            self._count("dropped_requests", dropped)
+        return dropped
 
     # -- degradation control ------------------------------------------------
     def set_fallback_model(self, model: InferenceModel) -> "ServingServer":
@@ -110,8 +253,20 @@ class ServingServer:
         self.degraded = False
 
     # -- client side --------------------------------------------------------
-    def enqueue(self, arr: np.ndarray, request_id: Optional[str] = None
-                ) -> str:
+    def enqueue(self, arr: np.ndarray, request_id: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> str:
+        """Admit one request.  Never blocks beyond
+        ``config.enqueue_block_s``: a full queue, a draining/stopped
+        server, or degradation without fallback all raise
+        :class:`ServiceUnavailableError` at admission (counted as
+        ``shed_requests``).  ``deadline_s`` is relative to now; it
+        defaults to ``config.default_deadline_s`` (None = no expiry)."""
+        cfg = self.config
+        if self._draining or self._stop.is_set():
+            self._count("shed_requests")
+            raise ServiceUnavailableError(
+                "server is draining/stopped; retry against another replica",
+                retry_after=cfg.retry_after_s)
         if self.degraded and self._fallback_model is None:
             # half-open: admit one probe per interval so a recovered
             # model can clear degradation; shed everything else —
@@ -121,17 +276,37 @@ class ServingServer:
                 #                     per interval across client threads
                 now = time.time()
                 is_probe = (now - self._last_probe_t
-                            >= self.config.degraded_probe_interval_s)
+                            >= cfg.degraded_probe_interval_s)
                 if is_probe:
                     self._last_probe_t = now
                 else:
-                    self.stats["shed_requests"] += 1
+                    self._count("shed_requests")
             if not is_probe:
                 raise ServiceUnavailableError(
                     "server degraded (predict failing) and no fallback "
-                    "model; shedding load — retry against another replica")
+                    "model; shedding load — retry against another replica",
+                    retry_after=cfg.retry_after_s)
         rid = request_id or uuid.uuid4().hex
-        self._in.put((rid, np.asarray(arr)))
+        now = time.time()
+        if deadline_s is None:
+            deadline_s = cfg.default_deadline_s
+        deadline_t = now + deadline_s if deadline_s is not None else math.inf
+        req = _Request(rid, np.asarray(arr), now, deadline_t)
+        try:
+            if cfg.enqueue_block_s > 0:
+                self._in.put(req, timeout=cfg.enqueue_block_s)
+            else:
+                self._in.put_nowait(req)
+        except queue.Full:
+            self._count("shed_requests")
+            raise ServiceUnavailableError(
+                f"request queue full ({cfg.queue_capacity}); shedding load "
+                "— retry after backoff", retry_after=cfg.retry_after_s)
+        if self._stop.is_set():
+            # raced stop(): the engine may already be gone and _fail_queued
+            # past — sweep again so THIS request still gets an explicit
+            # verdict (either the engine processed it or it is now failed)
+            self._fail_queued()
         return rid
 
     def query(self, request_id: str, timeout: float = 30.0) -> np.ndarray:
@@ -143,6 +318,7 @@ class ServingServer:
                     raise TimeoutError(f"result {request_id} not ready")
                 self._result_cv.wait(remaining)
             res = self._results.pop(request_id)
+            self._result_expiry.pop(request_id, None)
         if isinstance(res, Exception):
             raise res
         return res
@@ -151,6 +327,7 @@ class ServingServer:
     def _run(self) -> None:
         cfg = self.config
         while not self._stop.is_set():
+            self._gc_results()
             batch = []
             try:
                 batch.append(self._in.get(timeout=0.05))
@@ -163,17 +340,75 @@ class ServingServer:
                     batch.append(self._in.get_nowait())
                 except queue.Empty:
                     time.sleep(0.0005)
-            self._process(batch)
+            batch = self._expire(batch)
+            if not batch:
+                continue
+            self._busy = True
+            try:
+                self._process(batch)
+            except Exception as e:  # noqa: BLE001 — engine must outlive
+                # any single batch: a concatenate error (shape-mismatched
+                # co-batched requests) or a raise-mode injected fault
+                # outside _process's own predict handler would otherwise
+                # kill the dispatcher thread and zombify the server
+                log.error("serving batch failed outside predict: %s", e)
+                self._count("failed_batches")
+                self._publish([r.rid for r in batch],
+                              [1] * len(batch), None, error=e)
+            finally:
+                self._busy = False
+
+    def _gc_results(self) -> None:
+        """TTL sweep over the result table: a client that abandoned its
+        ``query`` (timeout, disconnect) must not leak its entry forever."""
+        now = time.time()
+        if now - self._last_gc_t < self.config.result_gc_interval_s:
+            return
+        self._last_gc_t = now
+        with self._result_cv:
+            stale = [rid for rid, t in self._result_expiry.items()
+                     if t <= now]
+            for rid in stale:
+                self._results.pop(rid, None)
+                self._result_expiry.pop(rid, None)
+        if stale:
+            self._count("results_gc", len(stale))
+            log.info("serving: GC'd %d abandoned results", len(stale))
+
+    def _expire(self, batch) -> list:
+        """Drop requests whose deadline passed while queued — BEFORE
+        predict, so expired work never reaches the chip.  Each gets an
+        explicit DeadlineExceededError result."""
+        now = time.time()
+        live, expired = [], []
+        for req in batch:
+            (expired if req.deadline_t <= now else live).append(req)
+        if expired:
+            ttl = now + self.config.result_ttl_s
+            with self._result_cv:
+                for req in expired:
+                    self._results[req.rid] = DeadlineExceededError(
+                        req.rid, now - req.admit_t)
+                    self._result_expiry[req.rid] = ttl
+                self._result_cv.notify_all()
+            self._count("expired_requests", len(expired))
+        return live
 
     def _process(self, batch) -> None:
-        rids = [r for r, _ in batch]
-        sizes = [a.shape[0] if a.ndim > 1 else 1 for _, a in batch]
-        arrs = [a if a.ndim > 1 else a[None] for _, a in batch]
+        rids = [r.rid for r in batch]
+        sizes = [r.arr.shape[0] if r.arr.ndim > 1 else 1 for r in batch]
+        arrs = [r.arr if r.arr.ndim > 1 else r.arr[None] for r in batch]
         stacked = np.concatenate(arrs, axis=0)
+        # chaos seams (docs/serving.md): a slow batch delays the loop so
+        # queued requests expire; a worker kill takes the process down
+        # mid-request (the pool's breaker/supervisor must absorb it)
+        faults.fire("serving_slow_batch")
+        faults.fire("serving_worker_kill")
         use_fallback = self.degraded and self._fallback_model is not None
         primary = self._fallback_model if use_fallback else self.model
         out = None
         try:
+            faults.fire("serving_predict_fail")
             out = primary.predict(stacked)
             self._consecutive_failures = 0
             if not use_fallback and self.degraded:
@@ -181,7 +416,7 @@ class ServingServer:
                 self.degraded = False
         except Exception as e:
             self._consecutive_failures += 1
-            self.stats["failed_batches"] += 1
+            self._count("failed_batches")
             if (not self.degraded and self._consecutive_failures
                     >= self.config.degraded_after_failures):
                 self.degraded = True
@@ -202,18 +437,24 @@ class ServingServer:
                     log.error("fallback predict also failed: %s", e2)
             if out is None:
                 log.error("predict failed: %s", e)
-                with self._result_cv:
-                    for rid in rids:
-                        self._results[rid] = e  # type: ignore[assignment]
-                    self._result_cv.notify_all()
+                self._publish(rids, sizes, None, error=e)
                 return
         if use_fallback:
-            self.stats["fallback_batches"] += 1
+            self._count("fallback_batches")
+        self._publish(rids, sizes, out)
+        self._count("batches")
+        self._count("requests", len(batch))
+
+    def _publish(self, rids, sizes, out, error: Optional[Exception] = None
+                 ) -> None:
+        ttl = time.time() + self.config.result_ttl_s
         ofs = 0
         with self._result_cv:
             for rid, n in zip(rids, sizes):
-                self._results[rid] = out[ofs:ofs + n]
-                ofs += n
+                if error is not None:
+                    self._results[rid] = error
+                else:
+                    self._results[rid] = out[ofs:ofs + n]
+                    ofs += n
+                self._result_expiry[rid] = ttl
             self._result_cv.notify_all()
-        self.stats["batches"] += 1
-        self.stats["requests"] += len(batch)
