@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-serving bench dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-serving test-obs bench dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -42,6 +42,12 @@ test-resilience:
 test-serving:
 	python -m pytest tests/test_serving.py tests/test_serving_multiproc.py \
 	  tests/test_serving_chaos.py -q
+
+# the observability suite (docs/observability.md): span tracer + chrome
+# export, Prometheus exposition, latency histograms, flight recorder
+# under injected faults, TFRecord framing, profile_dir wiring
+test-obs:
+	python -m pytest tests/test_obs.py -q
 
 bench:
 	python bench.py

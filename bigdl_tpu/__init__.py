@@ -14,6 +14,8 @@ empty so all reference citations are upstream-layout paths marked unverified):
 - Orca Estimator (python/orca)  ->  ``bigdl_tpu.estimator``
 - Chronos time series (python/chronos)  ->  ``bigdl_tpu.forecast``
 - Cluster Serving (scala/serving)  ->  ``bigdl_tpu.serving``
+- Metrics/TrainSummary operational surface  ->  ``bigdl_tpu.obs`` (spans,
+  Prometheus export, latency percentiles, crash flight recorder)
 
 The compute path is pure JAX (jit/pjit/shard_map/pallas); the host-side runtime
 (data prefetch, serving queue) has a native C++ core under ``csrc/``.

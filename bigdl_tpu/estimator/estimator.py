@@ -224,17 +224,25 @@ class Estimator:
             validation_methods: Sequence[ValidationMethod] = (),
             checkpoint_path: Optional[str] = None,
             checkpoint_trigger: Optional[Trigger] = None,
-            fault_tolerance=False) -> Dict[str, Any]:
+            fault_tolerance=False,
+            profile_dir: Optional[str] = None) -> Dict[str, Any]:
         """``fault_tolerance``: opt-in recovery for the whole fit — True
         runs the training loop under a ``resilience.Supervisor`` with the
         engine's FailurePolicy (pass a ``FailurePolicy`` to override):
         failures that escape the driver's in-run retry are classified,
         backed off per cause, and training re-enters from the newest
         shard-complete checkpoint (``checkpoint_path`` strongly advised —
-        without one the supervisor can only restart from scratch)."""
+        without one the supervisor can only restart from scratch).
+
+        ``profile_dir``: capture a jax.profiler trace over a warm window
+        of iterations into this directory (``EngineConfig.profile_dir``
+        sets it fleet-wide); the profiler is closed when the fit ends,
+        even mid-window."""
         ds = _to_xy(data, batch_size)
         opt = Optimizer(self.model, ds, self.criterion,
                         batch_size=batch_size)
+        if profile_dir is not None:
+            opt.set_profile(profile_dir)
         if getattr(self, "_initial_variables", None) is not None:
             opt.set_initial_variables(self._initial_variables)
         opt.set_optim_method(self.optim_method)

@@ -1,0 +1,25 @@
+"""Observability — spans, Prometheus export, latency histograms, flight
+recorder (docs/observability.md).
+
+The layer every other subsystem reports through:
+
+- :mod:`.trace`  — span tracer (Chrome-trace/Perfetto JSON) correlating a
+  serving request or training step across subsystems
+- :mod:`.export` — Prometheus text-format exporter over ``Metrics``
+  (``GET /metrics`` on serving; :class:`MetricsServer` for training jobs)
+- :mod:`.hist`   — bounded log-bucketed histograms (p50/p95/p99)
+- :mod:`.flight` — fixed-size ring of notable events, dumped as JSONL on
+  crash or SIGTERM
+"""
+
+from bigdl_tpu.obs import flight, trace
+from bigdl_tpu.obs.export import (MetricsServer, render_prometheus,
+                                  sanitize_metric_name)
+from bigdl_tpu.obs.flight import FlightRecorder
+from bigdl_tpu.obs.hist import LogHistogram
+from bigdl_tpu.obs.trace import Span, Tracer
+
+__all__ = [
+    "trace", "flight", "Tracer", "Span", "FlightRecorder", "LogHistogram",
+    "MetricsServer", "render_prometheus", "sanitize_metric_name",
+]

@@ -1,0 +1,150 @@
+"""Prometheus text-format export over the ``Metrics`` registry.
+
+Reference analog (unverified — mount empty): the reference visualizes
+training via TrainSummary/TensorBoard; operational scraping (the thing a
+fleet actually alerts on) has no analog there.  This module renders any
+:class:`~bigdl_tpu.optim.metrics.Metrics` registry — by default the
+process-wide one that training, resilience, and serving all feed — in the
+Prometheus text exposition format (version 0.0.4):
+
+- monotonic ``counters``        -> ``# TYPE n counter`` single lines
+- timer ``sums``/``counts``     -> ``# TYPE n summary`` ``n_sum``/``n_count``
+- log-bucketed histograms       -> ``# TYPE n histogram`` cumulative
+                                   ``n_bucket{le="..."}`` lines + ``+Inf``
+                                   + ``n_sum``/``n_count``
+
+Metric names are sanitized to the Prometheus grammar
+(``[a-zA-Z_:][a-zA-Z0-9_:]*``) — the registry's dotted names
+(``serving.shed_requests``) become underscored
+(``serving_shed_requests``).
+
+Serving exposes this at ``GET /metrics`` on the ``HttpFrontend`` and the
+pool proxy; training jobs (no HTTP surface of their own) start a
+standalone :class:`MetricsServer`.
+"""
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.obs")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Map an arbitrary registry key onto the Prometheus metric-name
+    grammar: invalid characters become ``_``; a leading digit gets a ``_``
+    prefix."""
+    out = _INVALID.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def render_prometheus(metrics=None) -> str:
+    """One scrape: the full registry in text exposition format.  With no
+    argument, renders the process-wide registry — the union every
+    subsystem's counters mirror into."""
+    if metrics is None:
+        from bigdl_tpu.optim.metrics import global_metrics
+
+        metrics = global_metrics()
+    snap = metrics.snapshot()
+    lines = []
+    for name in sorted(snap["counters"]):
+        n = sanitize_metric_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap["sums"]):
+        n = sanitize_metric_name(name)
+        lines.append(f"# TYPE {n} summary")
+        lines.append(f"{n}_sum {_fmt(snap['sums'][name])}")
+        lines.append(f"{n}_count {snap['counts'].get(name, 0)}")
+    for name in sorted(snap["hists"]):
+        h = snap["hists"][name]
+        n = sanitize_metric_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        acc = 0
+        for bound, count in zip(h["bounds"], h["counts"]):
+            acc += count
+            lines.append(f'{n}_bucket{{le="{_fmt(bound)}"}} {acc}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {h["n"]}')
+        lines.append(f"{n}_sum {_fmt(h['sum'])}")
+        lines.append(f"{n}_count {h['n']}")
+    return "\n".join(lines) + "\n"
+
+
+def reply_metrics(handler: BaseHTTPRequestHandler, metrics=None) -> None:
+    """Write one ``/metrics`` response on a stdlib handler — shared by the
+    serving frontend, the pool proxy, and :class:`MetricsServer` so the
+    exposition surface cannot drift between them."""
+    try:
+        body = render_prometheus(metrics).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", CONTENT_TYPE)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+        pass  # scraper hung up; never kill the serving handler thread
+
+
+class MetricsServer:
+    """Standalone ``GET /metrics`` endpoint for jobs with no HTTP surface
+    of their own (training drivers).  ``port=0`` picks a free port —
+    ``url`` is the scrape target."""
+
+    def __init__(self, metrics=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.metrics = metrics
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            server_version = "bigdl-tpu-metrics/1"
+
+            def log_message(self, fmt, *args):
+                log.debug(fmt, *args)
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                reply_metrics(self, outer.metrics)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("metrics server listening on %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,104 @@
+"""Bounded log-bucketed latency histogram.
+
+Reference analog (unverified — mount empty): the reference's per-iteration
+``Metrics`` breakdown reports only means; serving SLOs live in the tail, so
+``/metrics`` must expose p50/p95/p99 without unbounded per-sample storage.
+
+One histogram is a fixed array of counts over exponentially-growing buckets
+(bucket ``i`` covers ``[base*growth^(i-1), base*growth^i)``): O(1) observe,
+O(buckets) percentile, bounded memory regardless of request volume.  With
+the defaults (0.1ms base, x2 growth, 40 buckets) the range spans 0.1ms to
+~15 hours with <=2x relative error — the Prometheus-native trade, and the
+exporter emits these buckets verbatim as ``_bucket{le=...}`` lines.
+
+NOT internally locked: the owner (``optim.metrics.Metrics``) already
+serializes access under its registry lock; locking twice per observe on the
+serving hot path would be pure overhead.
+"""
+
+import math
+from typing import Dict, List, Sequence
+
+_DEFAULT_BASE = 1e-4
+_DEFAULT_GROWTH = 2.0
+_DEFAULT_BUCKETS = 40
+
+
+class LogHistogram:
+    """Fixed-size log-bucketed histogram of non-negative samples."""
+
+    __slots__ = ("base", "growth", "counts", "n", "sum", "min", "max",
+                 "_log_growth")
+
+    def __init__(self, base: float = _DEFAULT_BASE,
+                 growth: float = _DEFAULT_GROWTH,
+                 n_buckets: int = _DEFAULT_BUCKETS):
+        if base <= 0 or growth <= 1:
+            raise ValueError(f"need base > 0, growth > 1; got {base}, {growth}")
+        self.base = base
+        self.growth = growth
+        self._log_growth = math.log(growth)
+        # counts[0] covers [0, base); counts[-1] is the overflow bucket
+        self.counts: List[int] = [0] * (n_buckets + 2)
+        self.n = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def _bucket(self, v: float) -> int:
+        if v < self.base:
+            return 0
+        i = 1 + int(math.log(v / self.base) / self._log_growth)
+        return min(i, len(self.counts) - 1)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if v != v or v < 0:
+            # a negative/NaN "latency" is a clock bug upstream; clamping to
+            # the underflow bucket beats corrupting every percentile after
+            v = 0.0
+        if v == math.inf:
+            # slower-than-measurable (timeout sentinel): the OVERFLOW
+            # bucket — recording it as fastest would invert every
+            # percentile.  sum stays finite so the mean survives
+            self.counts[-1] += 1
+            self.n += 1
+            self.max = math.inf
+            return
+        self.counts[self._bucket(v)] += 1
+        self.n += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def upper_bounds(self) -> List[float]:
+        """Inclusive upper bound of each bucket except the +Inf overflow."""
+        return [self.base * self.growth ** i
+                for i in range(len(self.counts) - 1)]
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100]): the upper bound of
+        the bucket holding the q-th sample, clamped to the observed max so
+        a single slow request doesn't report a bound 2x above reality."""
+        if self.n == 0:
+            return 0.0
+        rank = max(1, math.ceil(self.n * q / 100.0))
+        acc = 0
+        bounds = self.upper_bounds()
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= rank:
+                bound = bounds[i] if i < len(bounds) else self.max
+                return min(bound, self.max)
+        return self.max
+
+    def quantiles(self, qs: Sequence[float] = (50, 95, 99)
+                  ) -> Dict[str, float]:
+        return {f"p{g:g}": self.percentile(g) for g in qs}
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time copy for exporters (taken under the owner's lock)."""
+        return {"counts": list(self.counts), "bounds": self.upper_bounds(),
+                "n": self.n, "sum": self.sum,
+                "min": self.min if self.n else 0.0,
+                "max": self.max if self.n else 0.0}

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from bigdl_tpu.obs import trace
 from bigdl_tpu.resilience import faults
 from bigdl_tpu.resilience.retry import RetryPolicy
 from bigdl_tpu.utils import storage
@@ -137,71 +138,72 @@ def save_checkpoint(path: str, step: int, *, flat_params=None,
     sharded = opt_shards is not None
     if not sharded and jax.process_index() != 0:
         return ""
-    d = storage.join(path, f"ckpt-{step}")
-    remote = storage.is_remote(path)
-    # local: write into a tmp dir, rename atomically.  remote (and the
-    # multi-writer sharded mode, where a cross-host rename is impossible):
-    # write blobs straight under the final prefix, manifest LAST — a crash
-    # mid-write leaves a prefix without a manifest, which readers skip.
-    tmp = d if (remote or sharded) else d + ".tmp"
-    if (remote or sharded) and shard_index == 0 \
-            and storage.exists(storage.join(d, "manifest.json")):
-        # re-reaching a step (preemption loop, rerun into the same bucket):
-        # the old MANIFEST must go first, or a crash mid-rewrite leaves
-        # new blobs certified complete by the stale manifest.  Only the
-        # manifest is removed — in unbarriered (async) sharded mode other
-        # hosts may already be writing fresh shards into this prefix, and
-        # a whole-tree removal would race them; stale-attempt shard files
-        # are made harmless by the attempt token in the filename instead.
-        storage.remove_tree(storage.join(d, "manifest.json"),
-                            ignore_errors=False)
-    if sharded and barrier is not None:
-        barrier()  # nobody writes shards until the stale manifest is gone
-    storage.makedirs(tmp)
+    with trace.span("checkpoint/save", step=step, sharded=sharded):
+        d = storage.join(path, f"ckpt-{step}")
+        remote = storage.is_remote(path)
+        # local: write into a tmp dir, rename atomically.  remote (and the
+        # multi-writer sharded mode, where a cross-host rename is impossible):
+        # write blobs straight under the final prefix, manifest LAST — a crash
+        # mid-write leaves a prefix without a manifest, which readers skip.
+        tmp = d if (remote or sharded) else d + ".tmp"
+        if (remote or sharded) and shard_index == 0 \
+                and storage.exists(storage.join(d, "manifest.json")):
+            # re-reaching a step (preemption loop, rerun into the same bucket):
+            # the old MANIFEST must go first, or a crash mid-rewrite leaves
+            # new blobs certified complete by the stale manifest.  Only the
+            # manifest is removed — in unbarriered (async) sharded mode other
+            # hosts may already be writing fresh shards into this prefix, and
+            # a whole-tree removal would race them; stale-attempt shard files
+            # are made harmless by the attempt token in the filename instead.
+            storage.remove_tree(storage.join(d, "manifest.json"),
+                                ignore_errors=False)
+        if sharded and barrier is not None:
+            barrier()  # nobody writes shards until the stale manifest is gone
+        storage.makedirs(tmp)
 
-    def _savez(name, **arrs):
-        with storage.open_file(storage.join(tmp, name), "wb") as f:
-            np.savez(f, **arrs)
+        def _savez(name, **arrs):
+            with storage.open_file(storage.join(tmp, name), "wb") as f:
+                np.savez(f, **arrs)
 
-    if sharded:
-        _savez(_shard_name(shard_index, shard_count, attempt),
-               **opt_shards)
-        if barrier is not None:
-            barrier()  # manifest below must certify ALL shards
-        if shard_index != 0:
-            return d
-    _savez("params.npz", flat=np.asarray(flat_params))
-    if ema_flat is not None:
-        _savez("ema.npz", flat=np.asarray(ema_flat))
-    if not sharded:
-        _savez("opt_state.npz", **_flatten_with_paths(opt_state))
-    _savez("model_state.npz", **_flatten_with_paths(model_state))
+        if sharded:
+            _savez(_shard_name(shard_index, shard_count, attempt),
+                   **opt_shards)
+            if barrier is not None:
+                barrier()  # manifest below must certify ALL shards
+            if shard_index != 0:
+                return d
+        _savez("params.npz", flat=np.asarray(flat_params))
+        if ema_flat is not None:
+            _savez("ema.npz", flat=np.asarray(ema_flat))
+        if not sharded:
+            _savez("opt_state.npz", **_flatten_with_paths(opt_state))
+        _savez("model_state.npz", **_flatten_with_paths(model_state))
 
-    def _jsonable(v):
-        if isinstance(v, (int, float, str, bool)) or v is None:
-            return True
-        if isinstance(v, dict):  # nested scalar dicts (e.g. schedule_state)
-            return all(_jsonable(x) for x in v.values())
-        return False
+        def _jsonable(v):
+            if isinstance(v, (int, float, str, bool)) or v is None:
+                return True
+            if isinstance(v, dict):  # nested scalar dicts (e.g. schedule_state)
+                return all(_jsonable(x) for x in v.values())
+            return False
 
-    manifest = {"step": step, "driver_state": {
-        k: v for k, v in (driver_state or {}).items() if _jsonable(v)}}
-    if sharded:
-        manifest["opt_shards"] = shard_count
-        if attempt is not None:
-            manifest["opt_shards_attempt"] = attempt
-    # injection point sits AFTER the blobs and BEFORE the manifest — the
-    # worst crash position: a partial prefix (or local .tmp dir) that
-    # readers and GC must treat as not-a-checkpoint
-    faults.fire("checkpoint_write_fail", step=step)
-    storage.write_json(storage.join(tmp, "manifest.json"), manifest)
-    if tmp != d:
-        if os.path.exists(d):
-            shutil.rmtree(d)
-        os.rename(tmp, d)
-    _gc(path, keep_last)
-    log.info("checkpoint saved: %s", d)
-    return d
+        manifest = {"step": step, "driver_state": {
+            k: v for k, v in (driver_state or {}).items() if _jsonable(v)}}
+        if sharded:
+            manifest["opt_shards"] = shard_count
+            if attempt is not None:
+                manifest["opt_shards_attempt"] = attempt
+        # injection point sits AFTER the blobs and BEFORE the manifest — the
+        # worst crash position: a partial prefix (or local .tmp dir) that
+        # readers and GC must treat as not-a-checkpoint
+        faults.fire("checkpoint_write_fail", step=step)
+        storage.write_json(storage.join(tmp, "manifest.json"), manifest)
+        if tmp != d:
+            if os.path.exists(d):
+                shutil.rmtree(d)
+            os.rename(tmp, d)
+        _gc(path, keep_last)
+        log.info("checkpoint saved: %s", d)
+        return d
 
 
 def _shard_name(i: int, n: int, attempt: Optional[str]) -> str:
@@ -300,22 +302,23 @@ def _reassemble_opt_shards(ckpt_dir: str, n: int, template,
 
 def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template
                     ) -> Tuple[np.ndarray, Any, Any, Dict[str, Any]]:
-    manifest = storage.read_json(storage.join(ckpt_dir, "manifest.json"))
-    flat = storage.load_npz(storage.join(ckpt_dir, "params.npz"))["flat"]
-    ema_path = storage.join(ckpt_dir, "ema.npz")
-    ema = (storage.load_npz(ema_path)["flat"]
-           if storage.exists(ema_path) else None)
-    n_shards = manifest.get("opt_shards")
-    if n_shards:
-        opt_flat = _reassemble_opt_shards(
-            ckpt_dir, int(n_shards), opt_state_template,
-            attempt=manifest.get("opt_shards_attempt"))
-    else:
-        opt_flat = storage.load_npz(storage.join(ckpt_dir, "opt_state.npz"))
-    mstate_flat = storage.load_npz(storage.join(ckpt_dir, "model_state.npz"))
-    opt_state = _unflatten_like(opt_state_template, opt_flat)
-    model_state = _unflatten_like(model_state_template, mstate_flat)
-    return flat, opt_state, model_state, manifest["driver_state"], ema
+    with trace.span("checkpoint/restore", ckpt_dir=ckpt_dir):
+        manifest = storage.read_json(storage.join(ckpt_dir, "manifest.json"))
+        flat = storage.load_npz(storage.join(ckpt_dir, "params.npz"))["flat"]
+        ema_path = storage.join(ckpt_dir, "ema.npz")
+        ema = (storage.load_npz(ema_path)["flat"]
+               if storage.exists(ema_path) else None)
+        n_shards = manifest.get("opt_shards")
+        if n_shards:
+            opt_flat = _reassemble_opt_shards(
+                ckpt_dir, int(n_shards), opt_state_template,
+                attempt=manifest.get("opt_shards_attempt"))
+        else:
+            opt_flat = storage.load_npz(storage.join(ckpt_dir, "opt_state.npz"))
+        mstate_flat = storage.load_npz(storage.join(ckpt_dir, "model_state.npz"))
+        opt_state = _unflatten_like(opt_state_template, opt_flat)
+        model_state = _unflatten_like(model_state_template, mstate_flat)
+        return flat, opt_state, model_state, manifest["driver_state"], ema
 
 
 # GC grace bookkeeping: shard-incomplete dirs observed by a previous scan

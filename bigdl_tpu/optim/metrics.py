@@ -15,6 +15,8 @@ import time
 from collections import defaultdict
 from typing import Dict, Optional
 
+from bigdl_tpu.obs.hist import LogHistogram
+
 
 class Metrics:
     def __init__(self):
@@ -24,9 +26,15 @@ class Metrics:
         # time_lost_to_recovery_s, ...): run-lifetime totals, so they
         # survive the per-log-window reset() that clears the timers
         self.counters: Dict[str, float] = defaultdict(float)
+        # latency/step-time distributions: bounded log-bucketed histograms
+        # (obs.hist), run-lifetime like counters — /metrics exports their
+        # p50/p95/p99 and Prometheus bucket lines
+        self.hists: Dict[str, LogHistogram] = {}
         # the global_metrics() registry is shared across threads (serving
         # client/engine threads + the training driver); += on a dict
-        # entry is a read-modify-write that loses updates without this
+        # entry is a read-modify-write that loses updates without this.
+        # READS hold it too: defaultdict indexing on a miss mutates, and
+        # an unlocked .items() iteration races concurrent inserts
         self._lock = threading.Lock()
 
     def add(self, name: str, value: float):
@@ -37,22 +45,66 @@ class Metrics:
     def inc(self, name: str, n: float = 1):
         with self._lock:
             self.counters[name] += n
+        self._mirror("inc", name, n)
+
+    def observe(self, name: str, value: float):
+        """One sample into the named histogram (created on first use)."""
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = LogHistogram()
+            h.observe(value)
+        self._mirror("observe", name, value)
+
+    def _mirror(self, op: str, name: str, v: float) -> None:
+        # run-lifetime signals (counters, histograms) recorded on a
+        # per-component registry ALSO land in the process-wide one, so a
+        # single /metrics scrape sees training, resilience, and serving
+        # side by side without every subsystem sharing one instance.
+        # Created eagerly: a counter incremented before the first scrape
+        # must not be missing from it
+        g = global_metrics()
+        if g is not self:
+            getattr(g, op)(name, v)
 
     def counter(self, name: str) -> float:
-        return self.counters.get(name, 0.0)
+        with self._lock:
+            return self.counters.get(name, 0.0)
 
     def mean(self, name: str) -> float:
-        c = self.counts[name]
-        return self.sums[name] / c if c else 0.0
+        with self._lock:
+            c = self.counts.get(name, 0)
+            return self.sums.get(name, 0.0) / c if c else 0.0
+
+    def percentile(self, name: str, q: float) -> float:
+        with self._lock:
+            h = self.hists.get(name)
+            return h.percentile(q) if h is not None else 0.0
 
     def reset(self):
-        self.sums.clear()
-        self.counts.clear()
+        with self._lock:
+            self.sums.clear()
+            self.counts.clear()
 
     def summary(self) -> Dict[str, float]:
-        out = {k: self.mean(k) for k in self.sums}
-        out.update(self.counters)
+        with self._lock:
+            out = {k: (self.sums[k] / self.counts[k]
+                       if self.counts.get(k) else 0.0) for k in self.sums}
+            out.update(self.counters)
+            for k, h in self.hists.items():
+                for q, v in h.quantiles().items():
+                    out[f"{k}.{q}"] = v
+                out[f"{k}.count"] = h.n
         return out
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Consistent point-in-time copy of the whole registry — the
+        exporter (obs.export) renders from this, never from live dicts."""
+        with self._lock:
+            return {"sums": dict(self.sums), "counts": dict(self.counts),
+                    "counters": dict(self.counters),
+                    "hists": {k: h.snapshot()
+                              for k, h in self.hists.items()}}
 
 
 _GLOBAL: Optional[Metrics] = None
@@ -97,6 +149,7 @@ class SummaryWriter:
         os.makedirs(log_dir, exist_ok=True)
         self.path = os.path.join(log_dir, f"{name}.jsonl")
         self._f = open(self.path, "a")
+        self._closed = False
         self._tb = None
         if tensorboard:
             from bigdl_tpu.utils.tbwriter import TensorBoardWriter
@@ -127,9 +180,23 @@ class SummaryWriter:
         return out
 
     def close(self):
+        """Close BOTH sinks — the jsonl file and the TensorBoard event
+        writer (whose buffered tail events would otherwise be lost).
+        Idempotent: the context-manager exit and an explicit close may
+        both run."""
+        if self._closed:
+            return
+        self._closed = True
         self._f.close()
         if self._tb is not None:
             self._tb.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *a) -> bool:
+        self.close()
+        return False
 
 
 def TrainSummary(log_dir: str, app_name: str) -> SummaryWriter:

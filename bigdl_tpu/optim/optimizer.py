@@ -20,6 +20,7 @@ import numpy as np
 
 from bigdl_tpu.data.dataset import DataSet
 from bigdl_tpu.data.prefetch import prefetch_to_device, thread_prefetch
+from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.optim import checkpoint as ckpt
 from bigdl_tpu.optim.metrics import Metrics, SummaryWriter, Timer
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
@@ -272,6 +273,12 @@ class Optimizer:
         engine = Engine.get()
         mesh = engine.mesh
         rng = jax.random.PRNGKey(self.seed)
+        if self._profiler is None \
+                and getattr(engine.config, "profile_dir", None):
+            # EngineConfig.profile_dir / BIGDL_TPU_PROFILE_DIR: trace a warm
+            # window without touching the builder; the finally below
+            # guarantees close() even when training ends inside the window
+            self.set_profile(engine.config.profile_dir)
 
         # init params from one sample batch
         sample = next(iter(self.dataset.batches(
@@ -379,6 +386,9 @@ class Optimizer:
                 lambda mb: (step_engine.shard_batch(mb["input"]),
                             step_engine.shard_batch(np.asarray(mb["target"]))),
                 size=self.prefetch)
+            # observability: time each fetch out of the prefetch pipeline —
+            # waiting HERE means the run is input-bound, not device-bound
+            batch_iter = self._traced_data(batch_iter)
             try:
                 ran_any = False
                 for mb in batch_iter:
@@ -447,8 +457,13 @@ class Optimizer:
                     "iteration failed (%s: %s); retry %d/%d from checkpoint "
                     "[cause %s] in %.2fs", type(e).__name__, e, retries,
                     max_retries, cause.value, delay)
+                flight.record("train_in_run_retry", cause=cause.value,
+                              retry=retries, iteration=state["iteration"],
+                              error=f"{type(e).__name__}: {e}")
                 time.sleep(delay)
-                self._try_resume(step_engine, state)
+                with trace.span("resilience/in_run_resume",
+                                cause=cause.value, retry=retries):
+                    self._try_resume(step_engine, state)
                 self.metrics.inc("recoveries_total")
                 self.metrics.inc(f"retries_by_cause.{cause.value}")
                 self.metrics.inc("time_lost_to_recovery_s",
@@ -478,18 +493,34 @@ class Optimizer:
         return self._final_state
 
     # ------------------------------------------------------------------
+    def _traced_data(self, batch_iter):
+        """The data phase under a span + timer: each ``next()`` on the
+        prefetch pipeline is host time the device spends idle."""
+        it = iter(batch_iter)
+        while True:
+            with trace.span("train/data"), Timer(self.metrics, "data_time"):
+                try:
+                    mb = next(it)
+                except StopIteration:
+                    return
+            yield mb
+
     def _one_iteration(self, step_engine, state, mb):
         it = state["iteration"]
-        faults.fire_step(it)  # injection: slow_host / process_kill /
-        #                       step_fail (no-op without a fault plan)
-        if self.watchdog is not None:
-            self.watchdog.step_started(it)
-        if self._profiler is not None:
-            self._profiler.step(it)
-        step_rng = jax.random.fold_in(jax.random.PRNGKey(self.seed + 1), it)
-        x_dev, y_dev = mb
-        with Timer(self.metrics, "step_dispatch"):
-            loss = step_engine.train_step_device(it, step_rng, x_dev, y_dev)
+        with trace.span("train/step", step=it):
+            faults.fire_step(it)  # injection: slow_host / process_kill /
+            #                       step_fail (no-op without a fault plan)
+            if self.watchdog is not None:
+                self.watchdog.step_started(it)
+            if self._profiler is not None:
+                self._profiler.step(it)
+            step_rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.seed + 1), it)
+            x_dev, y_dev = mb
+            with trace.span("train/dispatch", step=it), \
+                    Timer(self.metrics, "step_dispatch"):
+                loss = step_engine.train_step_device(
+                    it, step_rng, x_dev, y_dev)
         state["iteration"] = it + 1
         state["epoch_batch"] = state.get("epoch_batch", 0) + 1
         return loss
@@ -504,7 +535,8 @@ class Optimizer:
         # wall-clock window between log points measures real step time —
         # not async dispatch time, which flatters when log_every > 1 and
         # the in-flight queue hides device latency.
-        loss = float(state["loss"])
+        with trace.span("train/device_sync", step=it):
+            loss = float(state["loss"])
         state["loss"] = loss
         if self.watchdog is not None:
             # the float() above already forced the device sync, so the
@@ -518,6 +550,12 @@ class Optimizer:
         else:  # first window: includes compile; dispatch mean is the best proxy
             dt = self.metrics.mean("step_dispatch")
         self._last_log = (now, it)
+        # step wall time into the run-lifetime histogram: exact per-step
+        # at log_every=1 (the default); a coarser log cadence records the
+        # WINDOW MEAN once per window, which smooths tails — measuring a
+        # true per-step time would require blocking every dispatch
+        if dt > 0:
+            self.metrics.observe("train.step_time_s", dt)
         self.metrics.reset()  # rolling window: throughput reflects recent steps
         lr = float(np.asarray(self.optim_method.get_learning_rate(it - 1)))
         throughput = self.batch_size / max(dt, 1e-9)

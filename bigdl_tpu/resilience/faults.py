@@ -42,6 +42,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from bigdl_tpu.obs import flight
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.resilience")
@@ -164,9 +165,16 @@ class FaultInjector:
             self.events.append((point, step, count))
             log.warning("fault injection: firing %r (step=%s, invocation %d)",
                         point, step, count)
+            # the postmortem must show the fault BEFORE its consequences
+            flight.record("fault_injected", point=point, step=step,
+                          invocation=count, action=spec.action)
             if spec.action == "sleep":
                 time.sleep(spec.delay_s)
             elif spec.action == "exit":
+                # os._exit bypasses excepthook/atexit/signal handlers, so
+                # an armed flight recorder must dump HERE or the fault
+                # event dies with the process
+                flight.dump_if_installed(f"injected {point} (exit)")
                 os._exit(113)
             else:
                 raise _EXC[point](point, step=step, count=count)

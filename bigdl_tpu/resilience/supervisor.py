@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.resilience import faults
 from bigdl_tpu.resilience.detector import (Heartbeat, HeartbeatMonitor,
                                            StepWatchdog)
@@ -124,6 +125,7 @@ class Supervisor:
                               "(phi > %.1f)", idx,
                               policy.heartbeat_phi_threshold)
                     self.metrics.inc("peers_suspected_total")
+                    flight.record("peer_suspected", process=idx)
                 for idx in sorted(suspected - now_suspect):
                     log.info("peer process %d recovered", idx)
                 suspected.clear()
@@ -151,17 +153,23 @@ class Supervisor:
                       cause.value, retry_policy.max_retries)
             raise exc
         t_rec = time.perf_counter()
-        if not self._restartable():
-            raise exc
-        self.metrics.inc("recoveries_total")
-        self.metrics.inc(f"retries_by_cause.{cause.value}")
-        delay = retry_policy.backoff(attempt)
-        log.warning(
-            "supervisor: run failed after %.1fs (%s: %s); restart %d/%d "
-            "[cause %s, attempt %d] in %.2fs",
-            run_time_s, type(exc).__name__, exc, self.restarts_total,
-            self.policy.max_restarts, cause.value, attempt, delay)
-        self._sleep(delay)
+        with trace.span("resilience/recover", cause=cause.value,
+                        attempt=attempt):
+            if not self._restartable():
+                raise exc
+            self.metrics.inc("recoveries_total")
+            self.metrics.inc(f"retries_by_cause.{cause.value}")
+            flight.record(
+                "supervisor_restart", cause=cause.value, attempt=attempt,
+                restarts_total=self.restarts_total, run_time_s=run_time_s,
+                error=f"{type(exc).__name__}: {exc}")
+            delay = retry_policy.backoff(attempt)
+            log.warning(
+                "supervisor: run failed after %.1fs (%s: %s); restart %d/%d "
+                "[cause %s, attempt %d] in %.2fs",
+                run_time_s, type(exc).__name__, exc, self.restarts_total,
+                self.policy.max_restarts, cause.value, attempt, delay)
+            self._sleep(delay)
         # only handler + backoff time counts as lost — most of the failed
         # run's progress survives in checkpoints (the in-run retry path
         # accounts the same way); the full run_time_s is in the log line

@@ -45,6 +45,15 @@ class EngineConfig:
     failure_retry_times: int = 5
     failure_retry_interval_s: float = 10.0
     failure_policy: Optional[FailurePolicy] = None
+    # observability (docs/observability.md): profile_dir arms the
+    # IterationProfiler over a warm window of every optimize() run;
+    # metrics_port starts a standalone Prometheus /metrics endpoint for
+    # jobs with no HTTP surface of their own (0 picks a free port).
+    # metrics_host defaults loopback — a fleet scraper needs "0.0.0.0"
+    # (set it deliberately: /metrics is unauthenticated)
+    profile_dir: Optional[str] = None
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
 
     def resolved_failure_policy(self) -> FailurePolicy:
         """The effective FailurePolicy: the explicit one, else defaults
@@ -91,6 +100,12 @@ class EngineConfig:
             cfg.failure_policy = cfg.resolved_failure_policy()
             cfg.failure_policy.heartbeat_dir = \
                 os.environ["BIGDL_TPU_HEARTBEAT_DIR"]
+        if os.environ.get("BIGDL_TPU_PROFILE_DIR"):
+            cfg.profile_dir = os.environ["BIGDL_TPU_PROFILE_DIR"]
+        if os.environ.get("BIGDL_TPU_METRICS_PORT"):
+            cfg.metrics_port = int(os.environ["BIGDL_TPU_METRICS_PORT"])
+        if os.environ.get("BIGDL_TPU_METRICS_HOST"):
+            cfg.metrics_host = os.environ["BIGDL_TPU_METRICS_HOST"]
         if os.environ.get("BIGDL_TPU_DCN_SLICES"):
             # force the cross-slice data-parallel degree where the runtime
             # exposes no slice topology (e.g. multi-host CPU, GKE multislice
@@ -128,6 +143,22 @@ class Engine:
             )
             Engine._distributed_initialized = True
         self.mesh = build_mesh(config.mesh)
+        self.metrics_server = None
+        if config.metrics_port is not None:
+            # training jobs have no serving frontend to hang /metrics on;
+            # the engine owns the scrape endpoint instead.  A bind failure
+            # (port in use — a second job on the host, a pool worker that
+            # inherited the env) degrades observability, never compute
+            from bigdl_tpu.obs.export import MetricsServer
+
+            try:
+                self.metrics_server = MetricsServer(
+                    host=config.metrics_host,
+                    port=config.metrics_port).start()
+            except OSError as e:
+                log.error("metrics server failed to bind %s:%s (%s); "
+                          "continuing WITHOUT a /metrics endpoint",
+                          config.metrics_host, config.metrics_port, e)
         log.info(
             "Engine initialized: %d devices (%s), %d processes, mesh %s",
             jax.device_count(),
@@ -145,6 +176,9 @@ class Engine:
 
     @classmethod
     def reset(cls) -> None:
+        if cls._instance is not None \
+                and cls._instance.metrics_server is not None:
+            cls._instance.metrics_server.stop()
         cls._instance = None
 
     @property

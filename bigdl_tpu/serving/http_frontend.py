@@ -11,6 +11,7 @@ dynamic-batches them onto the chip exactly as queue clients do.
 
     POST /predict   {"instances": [[...], ...]}  -> {"predictions": [...]}
     GET  /health    -> {"status": "ok", "batches": N, "requests": M, ...}
+    GET  /metrics   -> Prometheus text exposition (docs/observability.md)
 
 Request lifecycle mapping (docs/serving.md): a per-request deadline rides
 in as ``"deadline_s"`` in the payload or an ``X-Deadline-S`` header and is
@@ -18,9 +19,16 @@ stamped at admission; backpressure/degradation sheds surface as **429**
 with a ``Retry-After`` header (never an open-ended block), a deadline that
 expires in the queue is **504**, an oversized body is rejected with
 **413** before it is read, and other engine errors stay **500**.
+
+Observability (docs/observability.md): a caller-supplied ``X-Request-Id``
+header (or ``"request_id"`` in the payload) becomes the engine request id,
+so one id names the request across the proxy, this frontend, and the
+engine's enqueue→batch→predict→publish spans; the id — supplied or
+generated — is echoed back as ``X-Request-Id`` on every predict response.
 """
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -28,6 +36,8 @@ from urllib import request as _urlreq
 
 import numpy as np
 
+from bigdl_tpu.obs import trace
+from bigdl_tpu.obs.export import reply_metrics
 from bigdl_tpu.serving.json_http import reply_json
 from bigdl_tpu.serving.server import (DeadlineExceededError,
                                       RequestDroppedError,
@@ -35,6 +45,12 @@ from bigdl_tpu.serving.server import (DeadlineExceededError,
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving.http")
+
+# caller-supplied request ids are echoed into the X-Request-Id RESPONSE
+# header; constrain them to header-safe token characters (a JSON payload
+# string could otherwise smuggle CRLF — response splitting).  Checked
+# with fullmatch: '$' would still accept a trailing bare newline
+REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._:\-]{1,128}")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -48,9 +64,13 @@ class _Handler(BaseHTTPRequestHandler):
         reply_json(self, code, json.dumps(payload).encode(), headers)
 
     def do_GET(self):
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        if self.path == "/metrics":
+            # Prometheus scrape: the server's registry (the process-wide
+            # one by default — serving AND training/resilience counters)
+            return reply_metrics(self, srv.metrics)
         if self.path != "/health":
             return self._json(404, {"error": f"unknown path {self.path}"})
-        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
         self._json(200, {"status": "degraded" if srv.degraded else "ok",
                          "degraded": srv.degraded, **srv.stats})
 
@@ -71,6 +91,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "error": f"request body {length} bytes exceeds limit "
                          f"{self.server.max_body_bytes}"})  # type: ignore[attr-defined]
         deadline_s: Optional[float] = None
+        req_id: Optional[str] = None
         try:
             payload = json.loads(self.rfile.read(length) or b"{}")
             instances = np.asarray(payload["instances"], np.float32)
@@ -79,25 +100,49 @@ class _Handler(BaseHTTPRequestHandler):
                 if isinstance(payload, dict) else hdr
             if raw is not None:
                 deadline_s = float(raw)
+            # request correlation: header wins, payload key is the
+            # no-custom-headers fallback; absent both, enqueue generates
+            req_id = self.headers.get("X-Request-Id") \
+                or payload.get("request_id")
+            if req_id is not None:
+                req_id = str(req_id)
+                if not REQUEST_ID_RE.fullmatch(req_id):
+                    return self._json(400, {
+                        "error": "bad request id: must match "
+                                 "[A-Za-z0-9._:-]{1,128}"})
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers valid-JSON non-object bodies ([1,2,3], 42)
             return self._json(400, {"error": f"bad request: {e}"})
-        try:
-            rid = srv.enqueue(instances, deadline_s=deadline_s)
-        except ServiceUnavailableError as e:
-            # backpressure / degradation / draining: shed with a retry
-            # hint so the client (or the pool proxy) goes elsewhere
-            return self._json(429, {"error": str(e)},
-                              {"Retry-After": str(e.retry_after)})
-        try:
-            result = srv.query(rid, timeout=self.server.predict_timeout)
-        except DeadlineExceededError as e:
-            return self._json(504, {"error": str(e), "expired": True})
-        except RequestDroppedError as e:
-            return self._json(503, {"error": str(e)})
-        except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
-            return self._json(500, {"error": str(e)})
-        self._json(200, {"predictions": np.asarray(result).tolist()})
+        with trace.span("serving/http_request") as sp:
+            try:
+                rid = srv.enqueue(instances, request_id=req_id,
+                                  deadline_s=deadline_s)
+            except ValueError as e:
+                # duplicate in-flight X-Request-Id: usually a client retry
+                # racing its first attempt — 409 + Retry-After marks it
+                # RETRYABLE (the first attempt resolves within its
+                # deadline), never a permanent 400
+                return self._json(
+                    409, {"error": str(e), "duplicate": True},
+                    {"Retry-After": str(srv.config.retry_after_s)})
+            except ServiceUnavailableError as e:
+                # backpressure / degradation / draining: shed with a retry
+                # hint so the client (or the pool proxy) goes elsewhere
+                return self._json(429, {"error": str(e)},
+                                  {"Retry-After": str(e.retry_after)})
+            sp.set_attribute("request_id", rid)
+            rid_hdr = {"X-Request-Id": rid}
+            try:
+                result = srv.query(rid, timeout=self.server.predict_timeout)
+            except DeadlineExceededError as e:
+                return self._json(504, {"error": str(e), "expired": True},
+                                  rid_hdr)
+            except RequestDroppedError as e:
+                return self._json(503, {"error": str(e)}, rid_hdr)
+            except Exception as e:  # noqa: BLE001 — surface as 500, keep serving
+                return self._json(500, {"error": str(e)}, rid_hdr)
+            self._json(200, {"predictions": np.asarray(result).tolist()},
+                       rid_hdr)
 
 
 class HttpFrontend:
@@ -139,17 +184,26 @@ class HttpClient:
         self.url = url.rstrip("/")
         self.timeout = timeout
 
-    def predict(self, instances,
-                deadline_s: Optional[float] = None) -> np.ndarray:
+    def predict(self, instances, deadline_s: Optional[float] = None,
+                request_id: Optional[str] = None) -> np.ndarray:
         payload = {"instances": np.asarray(instances).tolist()}
         if deadline_s is not None:
             payload["deadline_s"] = deadline_s
         body = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
         req = _urlreq.Request(self.url + "/predict", data=body,
-                              headers={"Content-Type": "application/json"})
+                              headers=headers)
         with _urlreq.urlopen(req, timeout=self.timeout) as resp:
             out = json.loads(resp.read())
         return np.asarray(out["predictions"], np.float32)
+
+    def metrics(self) -> str:
+        """One raw Prometheus text scrape of ``GET /metrics``."""
+        with _urlreq.urlopen(self.url + "/metrics",
+                             timeout=self.timeout) as resp:
+            return resp.read().decode()
 
     def health(self) -> dict:
         with _urlreq.urlopen(self.url + "/health",

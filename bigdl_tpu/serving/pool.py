@@ -37,10 +37,15 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 from urllib import request as _urlreq
 
+from bigdl_tpu.obs import flight, trace
+from bigdl_tpu.obs.export import reply_metrics
+from bigdl_tpu.optim.metrics import global_metrics
+from bigdl_tpu.serving.http_frontend import REQUEST_ID_RE
 from bigdl_tpu.serving.json_http import reply_json
 from bigdl_tpu.utils.log import get_logger
 
@@ -88,14 +93,24 @@ class _Breaker:
     listed-but-never-contacted would burn its probe and wedge half-open
     forever with nothing ever feeding record_success/failure."""
 
-    def __init__(self, fail_threshold: int = 3, cooldown_s: float = 2.0):
+    def __init__(self, fail_threshold: int = 3, cooldown_s: float = 2.0,
+                 name: str = "worker"):
         self.fail_threshold = fail_threshold
         self.cooldown_s = cooldown_s
+        self.name = name
         self.state = "closed"
         self.failures = 0
         self.trips = 0
         self._opened_t = 0.0
         self._lock = threading.Lock()
+
+    def _transition(self, new: str, **data) -> None:
+        """State change + its flight-recorder event (postmortems must show
+        the breaker's trip/probe/close sequence around a worker death)."""
+        if new != self.state:
+            flight.record("breaker_" + new.replace("-", "_"),
+                          breaker=self.name, **data)
+        self.state = new
 
     def try_acquire(self) -> bool:
         """Admission for one real attempt (mutating).  Open past the
@@ -106,7 +121,7 @@ class _Breaker:
                 return True
             if self.state == "open":
                 if time.time() - self._opened_t >= self.cooldown_s:
-                    self.state = "half-open"
+                    self._transition("half-open")
                     return True
                 return False
             return False  # half-open: a probe is already in flight
@@ -114,7 +129,7 @@ class _Breaker:
     def record_success(self) -> None:
         with self._lock:
             self.failures = 0
-            self.state = "closed"
+            self._transition("closed")
 
     def record_failure(self) -> None:
         with self._lock:
@@ -123,12 +138,13 @@ class _Breaker:
                     or self.failures >= self.fail_threshold):
                 if self.state != "open":
                     self.trips += 1
-                self.state = "open"
+                self._transition("open", failures=self.failures,
+                                 trips=self.trips)
                 self._opened_t = time.time()
 
     def reset(self) -> None:
         with self._lock:
-            self.state = "closed"
+            self._transition("closed", via="respawn")
             self.failures = 0
 
     def snapshot(self) -> dict:
@@ -142,15 +158,18 @@ class _Worker:
                  env: Optional[dict] = None,
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 2.0,
-                 drain_timeout_s: float = 5.0):
+                 drain_timeout_s: float = 5.0,
+                 name: str = "worker"):
         self.loader = loader
         self.batch_size = batch_size
         self.queue_capacity = queue_capacity
         self.env = env
         self.drain_timeout_s = drain_timeout_s
+        self.name = name
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
-        self.breaker = _Breaker(breaker_threshold, breaker_cooldown_s)
+        self.breaker = _Breaker(breaker_threshold, breaker_cooldown_s,
+                                name=name)
 
     def spawn(self, timeout: float = 120.0) -> None:
         env = dict(os.environ, **(self.env or {}))
@@ -232,8 +251,19 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         log.debug(fmt, *args)
 
     def _forward(self, method: str, url: str, body: Optional[bytes]):
-        req = _urlreq.Request(url, data=body, method=method, headers={
-            "Content-Type": "application/json"})
+        headers = {"Content-Type": "application/json"}
+        rid = getattr(self, "_rid", None)
+        if rid is not None:
+            # one id names the request across proxy, worker frontend, and
+            # engine spans — retries and hedges reuse it, so a trace shows
+            # every worker that saw this request
+            headers["X-Request-Id"] = rid
+        deadline = getattr(self, "_deadline_hdr", None)
+        if deadline is not None:
+            # the client's header-form deadline must reach the worker or
+            # its request outlives itself in a backed-up queue
+            headers["X-Deadline-S"] = deadline
+        req = _urlreq.Request(url, data=body, method=method, headers=headers)
         with _urlreq.urlopen(req, timeout=self.server.predict_timeout) as r:
             return r.status, r.read(), dict(r.headers)
 
@@ -286,46 +316,74 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                 {"error": f"request body {length} bytes exceeds limit "
                           f"{pool.max_body_bytes}"}).encode())
         body = self.rfile.read(length)
+        # assign the correlation id AT THE EDGE (caller's wins — header,
+        # else the documented "request_id" payload fallback): every
+        # retry/hedge below forwards the same X-Request-Id, so the worker
+        # spans of one request share one id end to end (and the worker's
+        # header-wins precedence cannot discard a payload-supplied id)
+        rid = self.headers.get("X-Request-Id")
+        if rid is None and b'"request_id"' in body:
+            # the substring probe keeps the common no-id case from paying
+            # a full JSON decode of the instances array at the proxy
+            try:
+                payload = json.loads(body)
+                if isinstance(payload, dict) \
+                        and payload.get("request_id") is not None:
+                    rid = str(payload["request_id"])
+            except (ValueError, json.JSONDecodeError):
+                pass  # malformed body: the worker's 400 is the verdict
+        if rid is not None and not REQUEST_ID_RE.fullmatch(rid):
+            # the id is echoed into a response header: same guard as the
+            # worker frontend, enforced at the edge too
+            return self._reply(400, json.dumps(
+                {"error": "bad request id: must match "
+                          "[A-Za-z0-9._:-]{1,128}"}).encode())
+        self._rid = rid or uuid.uuid4().hex
+        self._deadline_hdr = self.headers.get("X-Deadline-S")
+        rid_hdr = {"X-Request-Id": self._rid}
         # breaker-aware routing, starting at the round-robin cursor: dead
         # or breaker-open workers are skipped without burning a connect
         # timeout; worker-side 429/503 routes to the next worker; the
         # supervisor respawns corpses independently
-        last_err: Optional[BaseException] = None
-        busy: Optional[Tuple[int, bytes]] = None
-        candidates = pool._next_workers()
-        tried = set()  # a hedge backup that actually saw this request
-        #                must not get the same body again next iteration
-        #                (duplicate predict work)
-        for i, w in enumerate(candidates):
-            if id(w) in tried:
-                continue
-            tried.add(id(w))
-            try:
-                if (pool.hedge_after_s is not None
-                        and i + 1 < len(candidates)):
-                    verdict, code, out = self._attempt_hedged(
-                        w, candidates[i + 1], body, pool, tried)
-                else:
-                    verdict, code, out = self._attempt(w, body)
-            except Exception as e:  # noqa: BLE001 — worker down mid-request
-                last_err = e
-                continue
-            if verdict == "skip":
-                continue
-            if verdict == "busy":
-                busy = (code, out)
-                continue
-            return self._reply(code, out)
-        if busy is not None:
-            # every routable worker is shedding: relay the backpressure
-            # verdict (with its Retry-After) instead of inventing a 503
-            pool._count("proxy_busy")
-            return self._reply(busy[0], busy[1],
-                               {"Retry-After": str(pool.retry_after_s)})
-        pool._count("proxy_unavailable")
-        self._reply(503, json.dumps(
-            {"error": f"no serving worker available: {last_err}"}).encode(),
-            {"Retry-After": str(pool.retry_after_s)})
+        with trace.span("serving/proxy_request", request_id=self._rid):
+            last_err: Optional[BaseException] = None
+            busy: Optional[Tuple[int, bytes]] = None
+            candidates = pool._next_workers()
+            tried = set()  # a hedge backup that actually saw this request
+            #                must not get the same body again next iteration
+            #                (duplicate predict work)
+            for i, w in enumerate(candidates):
+                if id(w) in tried:
+                    continue
+                tried.add(id(w))
+                try:
+                    if (pool.hedge_after_s is not None
+                            and i + 1 < len(candidates)):
+                        verdict, code, out = self._attempt_hedged(
+                            w, candidates[i + 1], body, pool, tried)
+                    else:
+                        verdict, code, out = self._attempt(w, body)
+                except Exception as e:  # noqa: BLE001 — worker down mid-request
+                    last_err = e
+                    continue
+                if verdict == "skip":
+                    continue
+                if verdict == "busy":
+                    busy = (code, out)
+                    continue
+                return self._reply(code, out, rid_hdr)
+            if busy is not None:
+                # every routable worker is shedding: relay the backpressure
+                # verdict (with its Retry-After) instead of inventing a 503
+                pool._count("proxy_busy")
+                return self._reply(
+                    busy[0], busy[1],
+                    {"Retry-After": str(pool.retry_after_s), **rid_hdr})
+            pool._count("proxy_unavailable")
+            self._reply(503, json.dumps(
+                {"error": f"no serving worker available: {last_err}"}
+                ).encode(),
+                {"Retry-After": str(pool.retry_after_s), **rid_hdr})
 
     def _attempt_hedged(self, primary: "_Worker", backup: "_Worker",
                         body: bytes, pool: "ServingPool", tried: set
@@ -370,6 +428,14 @@ class _ProxyHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         pool: "ServingPool" = self.server.pool
+        # handler instances persist per keep-alive CONNECTION: a prior
+        # POST's correlation id/deadline must not ride along on probes
+        self._rid = None
+        self._deadline_hdr = None
+        if self.path == "/metrics":
+            # proxy-process registry (serving_pool.* counters); each
+            # worker additionally serves its own /metrics on its frontend
+            return reply_metrics(self)
         if self.path != "/health":
             return self._reply(404, b'{"error": "unknown path"}')
         agg = {"status": "ok", "restarts": pool.restarts,
@@ -441,6 +507,9 @@ class ServingPool:
         # proxy handler threads count concurrently; += is not atomic
         with self._stats_lock:
             self.stats[name] += n
+        # namespaced into the process registry so the proxy's /metrics
+        # scrape exposes them in Prometheus form
+        global_metrics().inc(f"serving_pool.{name}", n)
 
     @property
     def url(self) -> str:
@@ -462,10 +531,11 @@ class ServingPool:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingPool":
-        for _ in range(self.n):
+        for i in range(self.n):
             w = _Worker(self.loader, self.batch_size, self.queue_capacity,
                         self.worker_env, self.breaker_threshold,
-                        self.breaker_cooldown_s, self.drain_timeout_s)
+                        self.breaker_cooldown_s, self.drain_timeout_s,
+                        name=f"worker-{i}")
             w.spawn()
             self.workers.append(w)
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
@@ -482,6 +552,7 @@ class ServingPool:
             for w in self.workers:
                 if not w.alive() and not self._stop.is_set():
                     log.warning("serving worker %s died; respawning", w.url)
+                    flight.record("worker_died", worker=w.name, url=w.url)
                     w.url = None  # stale endpoint: not routable, not
                     #               reported by /health as the corpse's
                     try:

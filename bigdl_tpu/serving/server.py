@@ -33,7 +33,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from bigdl_tpu.optim.metrics import Metrics, global_metrics
+from bigdl_tpu.obs import flight, trace
+from bigdl_tpu.optim.metrics import Metrics, Timer, global_metrics
 from bigdl_tpu.resilience import faults
 from bigdl_tpu.serving.inference_model import InferenceModel
 from bigdl_tpu.utils.log import get_logger
@@ -145,6 +146,10 @@ class ServingServer:
             self.config.queue_capacity)
         self._results: Dict[str, Any] = {}
         self._result_expiry: Dict[str, float] = {}
+        # rids admitted but not yet published — with caller-supplied ids
+        # (X-Request-Id) a duplicate of an IN-FLIGHT id must be rejected
+        # at admission, or two waiters would race one _results slot
+        self._pending: set = set()
         self._result_cv = threading.Condition()
         self._last_gc_t = 0.0
         self._stop = threading.Event()
@@ -227,11 +232,13 @@ class ServingServer:
                     break
                 self._results[req.rid] = RequestDroppedError(req.rid)
                 self._result_expiry[req.rid] = now + self.config.result_ttl_s
+                self._pending.discard(req.rid)
                 dropped += 1
             if dropped:
                 self._result_cv.notify_all()
         if dropped:
             self._count("dropped_requests", dropped)
+            flight.record("serving_requests_dropped", count=dropped)
         return dropped
 
     # -- degradation control ------------------------------------------------
@@ -250,6 +257,7 @@ class ServingServer:
         self._consecutive_failures = 0
         if self.degraded:
             log.info("serving: model reloaded; leaving degraded mode")
+            flight.record("serving_recovered", via="reload_model")
         self.degraded = False
 
     # -- client side --------------------------------------------------------
@@ -292,12 +300,30 @@ class ServingServer:
             deadline_s = cfg.default_deadline_s
         deadline_t = now + deadline_s if deadline_s is not None else math.inf
         req = _Request(rid, np.asarray(arr), now, deadline_t)
+        with self._result_cv:
+            if rid in self._pending:
+                # still in flight: two waiters must not race one result
+                # slot — retryable conflict (HTTP 409 upstream); resolves
+                # as soon as the first attempt publishes
+                raise ValueError(
+                    f"request id {rid!r} is already in flight; "
+                    "request ids must be unique per outstanding request")
+            # completed but never fetched (first waiter gone, or an id
+            # deliberately reused with a NEW payload): discard the stale
+            # verdict and recompute — adopting it would silently answer
+            # the new payload with the old prediction
+            self._results.pop(rid, None)
+            self._result_expiry.pop(rid, None)
+            self._pending.add(rid)
         try:
-            if cfg.enqueue_block_s > 0:
-                self._in.put(req, timeout=cfg.enqueue_block_s)
-            else:
-                self._in.put_nowait(req)
+            with trace.span("serving/enqueue", request_id=rid):
+                if cfg.enqueue_block_s > 0:
+                    self._in.put(req, timeout=cfg.enqueue_block_s)
+                else:
+                    self._in.put_nowait(req)
         except queue.Full:
+            with self._result_cv:
+                self._pending.discard(rid)
             self._count("shed_requests")
             raise ServiceUnavailableError(
                 f"request queue full ({cfg.queue_capacity}); shedding load "
@@ -390,11 +416,24 @@ class ServingServer:
                     self._results[req.rid] = DeadlineExceededError(
                         req.rid, now - req.admit_t)
                     self._result_expiry[req.rid] = ttl
+                    self._pending.discard(req.rid)
                 self._result_cv.notify_all()
             self._count("expired_requests", len(expired))
+            flight.record("serving_deadline_drop", count=len(expired),
+                          request_ids=[r.rid for r in expired])
         return live
 
     def _process(self, batch) -> None:
+        # attrs (the O(batch) rid join, specifically) are built only when
+        # a tracer is installed — tracing off must stay a None check
+        tr = trace.active()
+        if tr is None:
+            return self._process_traced(batch, None)
+        with tr.span("serving/batch", batch_size=len(batch),
+                     request_ids=",".join(r.rid for r in batch)):
+            self._process_traced(batch, tr)
+
+    def _process_traced(self, batch, tr) -> None:
         rids = [r.rid for r in batch]
         sizes = [r.arr.shape[0] if r.arr.ndim > 1 else 1 for r in batch]
         arrs = [r.arr if r.arr.ndim > 1 else r.arr[None] for r in batch]
@@ -408,12 +447,17 @@ class ServingServer:
         primary = self._fallback_model if use_fallback else self.model
         out = None
         try:
-            faults.fire("serving_predict_fail")
-            out = primary.predict(stacked)
+            pred_span = trace.NULL_SPAN if tr is None else tr.span(
+                "serving/predict", batch_size=len(batch),
+                request_ids=",".join(rids))
+            with pred_span, Timer(self.metrics, "serving.predict_s"):
+                faults.fire("serving_predict_fail")
+                out = primary.predict(stacked)
             self._consecutive_failures = 0
             if not use_fallback and self.degraded:
                 log.info("serving: predict recovered; leaving degraded mode")
                 self.degraded = False
+                flight.record("serving_recovered", via="predict_success")
         except Exception as e:
             self._consecutive_failures += 1
             self._count("failed_batches")
@@ -426,6 +470,11 @@ class ServingServer:
                     "serving from fallback model"
                     if self._fallback_model is not None
                     else "no fallback: shedding new load")
+                flight.record(
+                    "serving_degraded",
+                    consecutive_failures=self._consecutive_failures,
+                    fallback=self._fallback_model is not None,
+                    error=str(e))
             if not use_fallback and self._fallback_model is not None:
                 # last-good model answers THIS batch too, not just the
                 # post-degradation ones — a waiter should not pay for the
@@ -442,6 +491,11 @@ class ServingServer:
         if use_fallback:
             self._count("fallback_batches")
         self._publish(rids, sizes, out)
+        now = time.time()
+        for r in batch:
+            # admission→publish latency; the p50/p95/p99 surface /metrics
+            # exports as a Prometheus histogram
+            self.metrics.observe("serving.latency_s", now - r.admit_t)
         self._count("batches")
         self._count("requests", len(batch))
 
@@ -449,7 +503,11 @@ class ServingServer:
                  ) -> None:
         ttl = time.time() + self.config.result_ttl_s
         ofs = 0
-        with self._result_cv:
+        tr = trace.active()
+        pub_span = trace.NULL_SPAN if tr is None else tr.span(
+            "serving/publish", request_ids=",".join(rids),
+            error=error is not None)
+        with pub_span, self._result_cv:
             for rid, n in zip(rids, sizes):
                 if error is not None:
                     self._results[rid] = error
@@ -457,4 +515,5 @@ class ServingServer:
                     self._results[rid] = out[ofs:ofs + n]
                     ofs += n
                 self._result_expiry[rid] = ttl
+                self._pending.discard(rid)
             self._result_cv.notify_all()

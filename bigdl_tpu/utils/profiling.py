@@ -60,12 +60,24 @@ class IterationProfiler:
                      self.start_iter, self.stop_iter - 1, self.log_dir)
 
     def close(self) -> None:
+        """Stop a trace the window left open (training ended inside it);
+        idempotent — the driver's finally and an explicit close may both
+        run."""
         if self._active:
             import jax
 
             jax.profiler.stop_trace()
             self._active = False
             self.done = True
+            log.info("profiler trace (window truncated by end of training) "
+                     "written to %s", self.log_dir)
+
+    def __enter__(self) -> "IterationProfiler":
+        return self
+
+    def __exit__(self, *a) -> bool:
+        self.close()
+        return False
 
 
 def annotate(name: str):

@@ -161,7 +161,9 @@ class TensorBoardWriter:
                             summary=_histogram_summary(tag, values, bins)))
 
     def close(self):
-        self._f.close()
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
 
 
 def read_scalars(path: str):
