@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-serving test-obs bench dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-serving test-obs test-data bench dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -48,6 +48,12 @@ test-serving:
 # under injected faults, TFRecord framing, profile_dir wiring
 test-obs:
 	python -m pytest tests/test_obs.py -q
+
+# the input-pipeline suite (docs/data.md): streaming stage parallelism,
+# ring safety, worker-count determinism, crash propagation, record IO
+test-data:
+	python -m pytest tests/test_pipeline_stream.py tests/test_records.py \
+	  tests/test_native_vision.py -q
 
 bench:
 	python bench.py

@@ -7,16 +7,103 @@ pipeline (``native/bigdl_tpu_io.cpp``) running the ResNet-50 training
 transform — bilinear resize 256 → crop 224 → hflip → normalize — on
 batch-768 geometry, plus the pure-python fallback for comparison.
 
+Since PR 4 it also measures the END-TO-END path the optimizer actually
+runs (docs/data.md): record read → decode/augment → batch-assemble, both
+serial (the stages in one thread, the pre-PR-4 posture) and through the
+stage-parallel streaming pipeline (``data/pipeline.py``: mmap gather on a
+read thread, the fused native transform fanned over decode workers into
+buffer-ring slots).  ``pipeline_img_per_sec`` vs ``serial_e2e_img_per_sec``
+is the PR's headline; per-stage ``data.*`` counters/gauges land in the
+process-wide registry exactly as a ``/metrics`` scrape would see them.
+
 ``loader_img_per_sec`` must exceed the device-resident throughput claim in
 ``BENCH_r*.json`` for the headline number to be sustainable host-fed; the
 bench.py TPU worker embeds a short version of this measurement next to its
-throughput fields.
+throughput fields.  ``--smoke`` runs a seconds-scale geometry and fails
+loudly on any pipeline error — the CI guard against silent loader
+regressions.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def measure_pipeline(batch: int = 768, n_records: int = 1536,
+                     epochs: int = 2, src_hw: int = 300, out_hw: int = 224,
+                     workers=None, threads=None, seed: int = 0):
+    """End-to-end read→decode→assemble throughput over a real record file:
+    serial stages vs the streaming pipeline, same geometry and plan."""
+    import os
+    import tempfile
+
+    from bigdl_tpu.data.records import write_records
+    from bigdl_tpu.data.vision import AugmentedRecordImages
+    from bigdl_tpu.optim.metrics import global_metrics
+
+    rs = np.random.RandomState(seed)
+    mean = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+    std = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+    out = {"e2e_batch": batch, "e2e_records": n_records, "src_hw": src_hw}
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "bench_imgs.btrec")
+        # distinct random source images; labels ride along like training
+        xs = rs.randint(0, 255, (n_records, src_hw, src_hw, 3), np.uint8)
+        ys = rs.randint(0, 1000, n_records).astype(np.int32)
+        write_records(p, {"image": xs, "label": ys})
+        del xs
+
+        def make_ds():
+            return AugmentedRecordImages(
+                p, (out_hw, out_hw), mean, std, resize_hw=(256, 256),
+                random_crop=True, random_flip=True, num_threads=threads)
+
+        # serial: every stage in the caller's thread (pre-PR-4 posture)
+        ds = make_ds()
+        n_img = 0
+        list(ds.batches(batch, shuffle=True, seed=seed, epoch=0))  # warm
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            for mb in ds.batches(batch, shuffle=True, seed=seed, epoch=e):
+                n_img += len(mb["input"])
+        dt = time.perf_counter() - t0
+        out["serial_e2e_img_per_sec"] = round(n_img / dt, 1)
+        ds.close()
+
+        # pipelined: stage-parallel with ring assembly
+        ds = make_ds()
+        rates = {}
+        n_img = 0
+        for mb in ds.stream_batches(batch, shuffle=True, seed=seed,
+                                    epoch=0, workers=workers):
+            pass  # warm
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            sp = ds.stream_batches(batch, shuffle=True, seed=seed, epoch=e,
+                                   workers=workers,
+                                   metrics=global_metrics())
+            for mb in sp:
+                n_img += len(mb["input"])
+            rates = sp.stage_rates() or rates
+        dt = time.perf_counter() - t0
+        out["pipeline_img_per_sec"] = round(n_img / dt, 1)
+        out["pipeline_stage_rates"] = {
+            k: round(v, 2) for k, v in rates.items()}
+        ds.close()
+
+    snap = global_metrics().snapshot()
+    out["pipeline_metrics"] = {
+        **{k: round(v, 1) for k, v in snap["counters"].items()
+           if k.startswith("data.")},
+        **{k: v for k, v in snap["gauges"].items()
+           if k.startswith("data.")},
+    }
+    if out["serial_e2e_img_per_sec"] > 0:
+        out["pipeline_vs_serial"] = round(
+            out["pipeline_img_per_sec"] / out["serial_e2e_img_per_sec"], 2)
+    return out
 
 
 def measure_loader(batch: int = 768, n_batches: int = 4,
@@ -147,11 +234,35 @@ def measure_loader(batch: int = 768, n_batches: int = 4,
     return out
 
 
+def smoke() -> int:
+    """Seconds-scale pipeline sanity for CI: tiny geometry through both
+    the serial and streaming end-to-end paths, hard-failing on crashes,
+    hangs (the CI step timeout), and silently empty runs.  It is a
+    BREAKAGE gate, not a perf gate — at smoke geometry stage-threading
+    overhead dominates, so throughput ratios are meaningless here; the
+    per-round full-geometry run (``BENCH_loader_r*.json``) is where
+    regressions in img/s show up.  Returns a process exit code."""
+    r = measure_pipeline(batch=64, n_records=256, epochs=1, src_hw=64,
+                         out_hw=48, workers=2)
+    r["metric"] = "loader_pipeline_smoke"
+    ok = (r.get("pipeline_img_per_sec", 0) > 0
+          and r.get("serial_e2e_img_per_sec", 0) > 0
+          and r.get("pipeline_metrics", {}).get("data.read_batches", 0) > 0)
+    r["smoke_ok"] = ok
+    print(json.dumps(r))
+    return 0 if ok else 1
+
+
 def main():
+    if "--smoke" in sys.argv:
+        raise SystemExit(smoke())
     r = measure_loader()
+    r.update(measure_pipeline())
     r.update({
         "metric": "resnet50_loader_throughput",
-        "value": r.get("loader_img_per_sec", r["python_ref_img_per_sec"]),
+        "value": r.get("pipeline_img_per_sec",
+                       r.get("loader_img_per_sec",
+                             r["python_ref_img_per_sec"])),
         "unit": "images/sec/host",
         "vs_baseline": None,
     })

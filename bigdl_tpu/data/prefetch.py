@@ -25,12 +25,24 @@ def prefetch_to_device(batches: Iterable[T], put: Callable[[T], D],
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
     queue = collections.deque()
-    for b in batches:
-        queue.append(put(b))
-        if len(queue) >= size:
+    done = False
+    try:
+        for b in batches:
+            queue.append(put(b))
+            if len(queue) >= size:
+                yield queue.popleft()
+        while queue:
             yield queue.popleft()
-    while queue:
-        yield queue.popleft()
+        done = True
+    finally:
+        # mirror thread_prefetch: an abandoned consumer (preemption break,
+        # end_when mid-epoch, exception in the training loop) must close
+        # the upstream producer (a StreamingPipeline's stage threads, a
+        # RecordReader's mmap) instead of leaking it per abandoned epoch
+        if not done:
+            close = getattr(batches, "close", None)
+            if close is not None:
+                close()
 
 
 def thread_prefetch(batches: Iterable[T], depth: int = 2) -> Iterator[T]:

@@ -201,6 +201,8 @@ class RecordDataSet(DataSet):
                 f"manifest n={n} rb={rb}, header n={h_n} rb={h_rb}")
 
         self._reader = None
+        self._slot_cache: Dict = {}    # ring buffers reused across epochs
+        self._staging_cache: Dict = {}
         if nat.available():
             self._reader = nat.RecordReader(path, pipeline=pipeline)
         else:  # pure-numpy fallback: memmap over the record region
@@ -225,6 +227,13 @@ class RecordDataSet(DataSet):
         if self._reader is not None:
             return self._reader.gather(sel)
         return np.asarray(self._mm[sel])
+
+    def _gather_into(self, sel: np.ndarray, out: np.ndarray) -> np.ndarray:
+        """Gather into a preallocated staging buffer (the streaming read
+        stage's no-allocation path)."""
+        if self._reader is not None:
+            return self._reader.gather(sel, out=out)
+        return np.take(self._mm, sel, axis=0, out=out)
 
     def _decode(self, raw: np.ndarray, name: str) -> np.ndarray:
         fld = next(f for f in self._fields if f["name"] == name)
@@ -252,6 +261,114 @@ class RecordDataSet(DataSet):
                 w[:n_real] = 1.0
                 mb["weight"] = w
             yield mb
+
+    def stream_batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                       drop_last=True, process_id=0, process_count=1,
+                       workers=None, parts_per_batch=None,
+                       raw_depth=None, ring_depth=None, metrics=None):
+        """Stage-parallel variant of :meth:`batches` (docs/data.md): the
+        mmap gather runs on a read thread into per-slot staging buffers, a
+        worker pool decodes fields into a preallocated buffer ring, and
+        batches come out strictly in plan order — byte-identical to
+        :meth:`batches` for any worker count.  Yields
+        :class:`~bigdl_tpu.data.pipeline.RingBatch` (slot views; the
+        optimizer's dispatch stage releases slots after the device copy).
+
+        ``raw_depth``/``ring_depth`` default to
+        :func:`~bigdl_tpu.data.pipeline.autotune_depths` over stage rates
+        probed on the first batch.  Ring/staging buffers are cached on the
+        dataset and reused across epochs (no per-epoch reallocation), so
+        at most one stream from a given dataset may be live at a time —
+        the optimizer's one-epoch-at-a-time loop satisfies this."""
+        from bigdl_tpu.data.pipeline import (
+            StreamingPipeline, autotune_depths, cached_slots,
+            fill_pad_weights,
+        )
+
+        per_host = batch_size // max(process_count, 1)
+        rb = int(self.manifest["record_bytes"])
+        used = (list(self.feature)
+                if isinstance(self.feature, (list, tuple))
+                else [self.feature])
+        out_fields = used + ([self.label] if self.label is not None else [])
+        spec = {}
+        for name in out_fields:
+            fld = next(f for f in self._fields if f["name"] == name)
+            spec["f:" + name] = (tuple([per_host] + fld["shape"]),
+                                 np.dtype(fld["dtype"]))
+        spec["weight"] = ((per_host,), np.float32)
+
+        plan = ((np.asarray(sel, np.int64), n_real)
+                for sel, n_real in batch_index_plan(
+                    self.size(), batch_size, shuffle=shuffle, seed=seed,
+                    epoch=epoch, drop_last=drop_last, process_id=process_id,
+                    process_count=process_count))
+
+        workers_eff = workers or max(1, min(4, (os.cpu_count() or 2)))
+        if raw_depth is None or ring_depth is None:
+            # probe stage rates on one real batch (read = gather, decode =
+            # field split+copy), then size the queues from the ratio; the
+            # measurement is cached per geometry so only the FIRST epoch
+            # pays for it
+            tune_key = (per_host, workers_eff, parts_per_batch)
+            tuned = self._staging_cache.get(("tuned", tune_key))
+            if tuned is None:
+                import time as _time
+
+                probe_sel = np.arange(min(per_host, self.size()),
+                                      dtype=np.int64)
+                t0 = _time.perf_counter()
+                raw = self._gather(probe_sel)
+                t_read = max(_time.perf_counter() - t0, 1e-9)
+                t0 = _time.perf_counter()
+                for name in out_fields:
+                    self._decode(raw, name)
+                t_dec = max(_time.perf_counter() - t0, 1e-9)
+                tuned = autotune_depths(1.0 / t_read, 1.0 / t_dec,
+                                        workers_eff,
+                                        parts_per_batch=parts_per_batch)
+                self._staging_cache[("tuned", tune_key)] = tuned
+            raw_depth = raw_depth or tuned["raw_depth"]
+            ring_depth = ring_depth or tuned["ring_depth"]
+        slots = cached_slots(self._slot_cache, spec, ring_depth)
+        staging = self._staging_cache
+
+        def fetch(item, slot):
+            sel, _ = item
+            buf = staging.get(slot)
+            if buf is None or len(buf) != len(sel):
+                buf = staging[slot] = np.empty((len(sel), rb), np.uint8)
+            return self._gather_into(sel, buf)
+
+        offsets = self._offsets
+
+        def decode(item, raw, buffers, lo, hi, slot):
+            sel, n_real = item
+            for name in out_fields:
+                off, nbytes = offsets[name]
+                dst = buffers["f:" + name][lo:hi]
+                np.copyto(dst.view(np.uint8).reshape(hi - lo, nbytes),
+                          raw[lo:hi, off:off + nbytes])
+            fill_pad_weights(buffers["weight"], n_real, lo, hi)
+            return {"n": len(sel), "n_real": n_real}
+
+        def finalize(buffers, meta):
+            if isinstance(self.feature, (list, tuple)):
+                x = tuple(buffers["f:" + f] for f in self.feature)
+            else:
+                x = buffers["f:" + self.feature]
+            fields = {"input": x}
+            if self.label is not None:
+                fields["target"] = buffers["f:" + self.label]
+            if meta["n_real"] < meta["n"]:
+                fields["weight"] = buffers["weight"]
+            return fields
+
+        return StreamingPipeline(
+            plan, fetch, decode, spec, rows=per_host, workers=workers_eff,
+            parts_per_batch=parts_per_batch, raw_depth=raw_depth,
+            ring_depth=ring_depth, slots=slots, finalize=finalize,
+            metrics=metrics)
 
     def steps_per_epoch(self, batch_size: int, process_count: int = 1,
                         drop_last: bool = True) -> int:

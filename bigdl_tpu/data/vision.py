@@ -16,12 +16,15 @@ NHWC float32 batch handed to the device.
 
 import os
 import math
+import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from bigdl_tpu import native
-from bigdl_tpu.data.dataset import MiniBatch
+from bigdl_tpu.data.dataset import (
+    DataSet, MiniBatch, batch_index_plan,
+)
 from bigdl_tpu.data.transformer import Transformer
 
 
@@ -299,3 +302,360 @@ class ImageFrameToBatches:
             target = (np.asarray(labels)
                       if all(l is not None for l in labels) else None)
             yield MiniBatch(input=batch, target=target)
+
+
+# ---------------------------------------------------------------------------
+# Streaming vision inputs (docs/data.md): record-stored images and encoded
+# JPEGs through the stage-parallel pipeline
+# ---------------------------------------------------------------------------
+
+def _batch_geometry(rng, n, out_hw, resize_hw, random_crop, random_flip):
+    """Per-image crops/flips for one batch, drawn in PLAN order — the
+    stream and serial paths share this, so epochs are byte-identical for 1
+    or N decode workers (and for ``batches`` vs ``stream_batches``)."""
+    oh, ow = out_hw
+    rh, rw = resize_hw if resize_hw is not None else (oh, ow)
+    if random_crop:
+        crops = [(int(rng.integers(0, max(1, rh - oh + 1))),
+                  int(rng.integers(0, max(1, rw - ow + 1))))
+                 for _ in range(n)]
+    else:
+        crops = [(max(0, (rh - oh) // 2), max(0, (rw - ow) // 2))] * n
+    flips = (rng.random(n) < 0.5) if random_flip else None
+    return crops, flips
+
+
+class _ThreadLocalPipes:
+    """One single-threaded native ``BatchPipeline`` per decode worker —
+    the worker pool provides the parallelism, each native call keeps the
+    GIL released for its sub-range."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._all: List[object] = []
+        self._lock = threading.Lock()
+
+    def get(self):
+        pipe = getattr(self._tls, "pipe", None)
+        if pipe is None:
+            pipe = self._tls.pipe = native.BatchPipeline(num_threads=1)
+            with self._lock:
+                self._all.append(pipe)
+        return pipe
+
+    def close(self):
+        with self._lock:
+            pipes, self._all = self._all, []
+        for p in pipes:
+            p.close()
+
+
+class AugmentedRecordImages(DataSet):
+    """The ImageNet-style training input: uint8 images in a record file,
+    augmented (resize → crop → flip → normalize) at batch-assembly time.
+
+    ``batches()`` runs the stages serially in the caller's thread (the
+    pre-PR-4 posture, kept for comparison and for ``host_prefetch=0``);
+    ``stream_batches()`` runs them stage-parallel — mmap gather on a read
+    thread, the fused native transform fanned over decode workers writing
+    straight into buffer-ring slots — and is what the optimizer uses by
+    default.  Both draw augmentation geometry from the same plan-order
+    RNG, so they produce identical epochs."""
+
+    def __init__(self, records, out_hw: Tuple[int, int], mean, std,
+                 field: Optional[str] = None,
+                 resize_hw: Optional[Tuple[int, int]] = None,
+                 random_crop: bool = False, random_flip: bool = False,
+                 num_threads: Optional[int] = None):
+        from bigdl_tpu.data.records import RecordDataSet
+
+        if isinstance(records, str):
+            records = RecordDataSet(records)
+        self.records = records
+        self.field = field or (
+            records.feature if isinstance(records.feature, str)
+            else records.feature[0])
+        fld = next(f for f in records._fields if f["name"] == self.field)
+        if len(fld["shape"]) != 3 or np.dtype(fld["dtype"]) != np.uint8:
+            raise ValueError(
+                f"field {self.field!r} is {fld['dtype']}{fld['shape']}, "
+                "need uint8 HWC images")
+        self.src_hw = tuple(fld["shape"][:2])
+        self.channels = int(fld["shape"][2])
+        self.out_hw = tuple(out_hw)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.resize_hw = tuple(resize_hw) if resize_hw is not None else None
+        self.random_crop = random_crop
+        self.random_flip = random_flip
+        self.num_threads = num_threads
+        self._serial_pipe = None
+        self._slot_cache: dict = {}
+        # direct view over the record region: the streaming decode reads
+        # source pixels straight from the page cache — no gather memcpy,
+        # no staging buffer (the read stage just plans; the OS does the IO
+        # on the decode workers' first touch, still off the driver thread)
+        n = int(self.records.manifest["n_records"])
+        rb = int(self.records.manifest["record_bytes"])
+        self._mm = np.memmap(self.records.path, np.uint8, "r", offset=24,
+                             shape=(n, rb))
+
+    def size(self) -> int:
+        return self.records.size()
+
+    def steps_per_epoch(self, batch_size: int, process_count: int = 1,
+                        drop_last: bool = True) -> int:
+        return self.records.steps_per_epoch(batch_size, process_count,
+                                            drop_last)
+
+    def close(self):
+        self.records.close()
+        self._mm = None  # drop the record-region mapping (fd + pages)
+        if self._serial_pipe is not None:
+            self._serial_pipe.close()
+            self._serial_pipe = None
+
+    # -- shared plumbing ---------------------------------------------------
+    def _image_views(self, raw: np.ndarray, lo: int, hi: int):
+        off, nbytes = self.records._offsets[self.field]
+        h, w = self.src_hw
+        return [raw[i, off:off + nbytes]
+                .view(np.uint8).reshape(h, w, self.channels)
+                for i in range(lo, hi)]
+
+    def _label_into(self, raw, lo, hi, dst):
+        label = self.records.label
+        if label is None:
+            return
+        off, nbytes = self.records._offsets[label]
+        np.copyto(dst[lo:hi].view(np.uint8).reshape(hi - lo, nbytes),
+                  raw[lo:hi, off:off + nbytes])
+
+    def _plan(self, batch_size, shuffle, seed, epoch, drop_last,
+              process_id, process_count):
+        rng = np.random.default_rng((seed, epoch))
+        for sel, n_real in batch_index_plan(
+                self.size(), batch_size, shuffle=shuffle, seed=seed,
+                epoch=epoch, drop_last=drop_last, process_id=process_id,
+                process_count=process_count):
+            crops, flips = _batch_geometry(
+                rng, len(sel), self.out_hw, self.resize_hw,
+                self.random_crop, self.random_flip)
+            yield (np.asarray(sel, np.int64), n_real, crops, flips)
+
+    def _label_spec(self):
+        label = self.records.label
+        if label is None:
+            return None
+        fld = next(f for f in self.records._fields if f["name"] == label)
+        return np.dtype(fld["dtype"]), list(fld["shape"])
+
+    # -- serial path -------------------------------------------------------
+    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                drop_last=True, process_id=0, process_count=1):
+        if self._serial_pipe is None:
+            self._serial_pipe = native.BatchPipeline(self.num_threads)
+        pipe = self._serial_pipe
+        per_host = None
+        for sel, n_real, crops, flips in self._plan(
+                batch_size, shuffle, seed, epoch, drop_last, process_id,
+                process_count):
+            per_host = len(sel)
+            raw = self.records._gather(sel)
+            images = self._image_views(raw, 0, per_host)
+            batch = pipe.process_batch(
+                images, self.out_hw, self.mean, self.std,
+                resize_hw=self.resize_hw, crops=crops,
+                flips=None if flips is None else list(flips))
+            mb = MiniBatch(input=batch)
+            lspec = self._label_spec()
+            if lspec is not None:
+                dt, shape = lspec
+                y = np.empty([per_host] + shape, dt)
+                self._label_into(raw, 0, per_host, y)
+                mb["target"] = y
+            if n_real < per_host:
+                w = np.zeros(per_host, np.float32)
+                w[:n_real] = 1.0
+                mb["weight"] = w
+            yield mb
+
+    # -- streaming path ----------------------------------------------------
+    def stream_batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                       drop_last=True, process_id=0, process_count=1,
+                       workers=None, parts_per_batch=None,
+                       raw_depth=None, ring_depth=None, metrics=None):
+        from bigdl_tpu.data.pipeline import (
+            StreamingPipeline, autotune_depths, cached_slots,
+            fill_pad_weights,
+        )
+
+        per_host = batch_size // max(process_count, 1)
+        oh, ow = self.out_hw
+        spec = {"input": ((per_host, oh, ow, self.channels), np.float32),
+                "weight": ((per_host,), np.float32)}
+        lspec = self._label_spec()
+        if lspec is not None:
+            dt, shape = lspec
+            spec["target"] = (tuple([per_host] + shape), dt)
+
+        workers_eff = workers or max(1, min(8, (os.cpu_count() or 2)))
+        if raw_depth is None or ring_depth is None:
+            tuned = autotune_depths(0, 0, workers_eff,
+                                    parts_per_batch=parts_per_batch)
+            raw_depth = raw_depth or tuned["raw_depth"]
+            ring_depth = ring_depth or tuned["ring_depth"]
+        slots = cached_slots(self._slot_cache, spec, ring_depth)
+        pipes = _ThreadLocalPipes()
+        mm = self._mm
+        img_off, img_nbytes = self.records._offsets[self.field]
+        h, w_, c = self.src_hw + (self.channels,)
+
+        def fetch(item, slot):
+            return None  # decode reads the mapped records directly
+
+        def decode(item, raw, buffers, lo, hi, slot):
+            sel, n_real, crops, flips = item
+            images = [mm[int(i)][img_off:img_off + img_nbytes]
+                      .reshape(h, w_, c) for i in sel[lo:hi]]
+            pipes.get().process_batch(
+                images, self.out_hw, self.mean, self.std,
+                resize_hw=self.resize_hw, crops=crops[lo:hi],
+                flips=None if flips is None else list(flips[lo:hi]),
+                out=buffers["input"][lo:hi])
+            if "target" in buffers:
+                loff, lnbytes = self.records._offsets[self.records.label]
+                dst = buffers["target"][lo:hi]
+                dstv = dst.view(np.uint8).reshape(hi - lo, lnbytes)
+                for j, i in enumerate(sel[lo:hi]):
+                    dstv[j] = mm[int(i)][loff:loff + lnbytes]
+            fill_pad_weights(buffers["weight"], n_real, lo, hi)
+            return {"n": len(sel), "n_real": n_real}
+
+        def finalize(buffers, meta):
+            fields = {"input": buffers["input"]}
+            if "target" in buffers:
+                fields["target"] = buffers["target"]
+            if meta["n_real"] < meta["n"]:
+                fields["weight"] = buffers["weight"]
+            return fields
+
+        plan = self._plan(batch_size, shuffle, seed, epoch, drop_last,
+                          process_id, process_count)
+        return StreamingPipeline(
+            plan, fetch, decode, spec, rows=per_host, workers=workers_eff,
+            parts_per_batch=parts_per_batch, raw_depth=raw_depth,
+            ring_depth=ring_depth, slots=slots, finalize=finalize,
+            on_close=pipes.close, metrics=metrics)
+
+
+def stream_jpeg_batches(sources, batch_size, out_hw, mean, std, *,
+                        labels=None, resize_hw=None, random_crop=False,
+                        random_flip=False, shuffle=False, seed=0, epoch=0,
+                        drop_last=True, workers=None, parts_per_batch=None,
+                        use_processes: object = "auto",
+                        ring_depth=None, raw_depth=None, metrics=None):
+    """Stream encoded JPEGs (file paths or ``bytes``) through the
+    stage-parallel pipeline: file reads on the read thread, decode+augment
+    fanned over workers — ``BatchPipeline.decode_batch`` sub-batches in
+    parallel when the native libjpeg path is available, a shared-memory
+    multiprocess PIL pool otherwise (``use_processes`` True/False/"auto").
+    Yields :class:`~bigdl_tpu.data.pipeline.RingBatch` with ``input`` (and
+    ``target`` when ``labels`` is given)."""
+    from bigdl_tpu.data.pipeline import (
+        SharedMemoryDecodePool, StreamingPipeline, autotune_depths,
+        fill_pad_weights,
+    )
+    from bigdl_tpu.native import lib as nat
+
+    sources = list(sources)
+    n = len(sources)
+    labels = None if labels is None else np.asarray(labels)
+    if labels is not None and len(labels) != n:
+        raise ValueError(f"{len(labels)} labels for {n} images")
+    if resize_hw is None:
+        # decode dims are unknown before decode: crop geometry needs the
+        # deterministic post-resize frame
+        raise ValueError("stream_jpeg_batches requires resize_hw "
+                         "(crop geometry is planned before decode)")
+    per_host = batch_size
+    oh, ow = out_hw
+    if use_processes == "auto":
+        use_processes = not (nat.available() and nat.jpeg_available())
+
+    workers_eff = workers or max(1, min(4, (os.cpu_count() or 2)))
+    if ring_depth is None or raw_depth is None:
+        tuned = autotune_depths(0, 0, workers_eff)
+        ring_depth = ring_depth or tuned["ring_depth"]
+        raw_depth = raw_depth or tuned["raw_depth"]
+
+    pool = None
+    slots = None
+    if use_processes:
+        pool = SharedMemoryDecodePool(per_host, out_hw, depth=ring_depth,
+                                      workers=workers_eff)
+        slots = [dict(s, weight=np.empty((per_host,), np.float32))
+                 for s in pool.ring_slots(("input",))]
+    spec = {"input": ((per_host, oh, ow, 3), np.float32),
+            "weight": ((per_host,), np.float32)}
+
+    rng = np.random.default_rng((seed, epoch))
+
+    def plan_gen():
+        for sel, n_real in batch_index_plan(
+                n, batch_size, shuffle=shuffle, seed=seed, epoch=epoch,
+                drop_last=drop_last):
+            crops, flips = _batch_geometry(
+                rng, len(sel), out_hw, resize_hw, random_crop, random_flip)
+            yield (sel, n_real, crops, flips)
+
+    def fetch(item, slot):
+        sel = item[0]
+        out = []
+        for i in sel:
+            s = sources[i]
+            if isinstance(s, (bytes, bytearray)):
+                out.append(bytes(s))
+            else:
+                with open(s, "rb") as f:
+                    out.append(f.read())
+        return out
+
+    pipes = _ThreadLocalPipes()
+
+    def decode(item, raw, buffers, lo, hi, slot):
+        sel, n_real, crops, flips = item
+        sub_flips = None if flips is None else list(flips[lo:hi])
+        if pool is not None:
+            pool.submit_rows(slot, lo, raw[lo:hi], mean, std,
+                             resize_hw=resize_hw, crops=crops[lo:hi],
+                             flips=sub_flips)
+        else:
+            pipes.get().decode_batch(
+                raw[lo:hi], out_hw, mean, std, resize_hw=resize_hw,
+                crops=crops[lo:hi], flips=sub_flips,
+                out=buffers["input"][lo:hi])
+        fill_pad_weights(buffers["weight"], n_real, lo, hi)
+        meta = {"n": len(sel), "n_real": n_real}
+        if labels is not None and lo == 0:
+            meta["target"] = labels[np.asarray(sel)]
+        return meta
+
+    def finalize(buffers, meta):
+        fields = {"input": buffers["input"]}
+        if "target" in meta:
+            fields["target"] = meta["target"]
+        if meta["n_real"] < meta["n"]:
+            fields["weight"] = buffers["weight"]
+        return fields
+
+    def on_close():
+        pipes.close()
+        if pool is not None:
+            pool.close()
+
+    return StreamingPipeline(
+        plan_gen(), fetch, decode, spec, rows=per_host, workers=workers_eff,
+        parts_per_batch=parts_per_batch, raw_depth=raw_depth,
+        ring_depth=ring_depth, slots=slots, finalize=finalize,
+        on_close=on_close, metrics=metrics)

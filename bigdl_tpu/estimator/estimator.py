@@ -241,6 +241,13 @@ class Estimator:
         ds = _to_xy(data, batch_size)
         opt = Optimizer(self.model, ds, self.criterion,
                         batch_size=batch_size)
+        # input-pipeline knobs ride the creator config (docs/data.md):
+        # host_prefetch (producer lookahead; 0 = inline) and streaming
+        # (stage-parallel batch path for datasets that support it)
+        if "host_prefetch" in self.config:
+            opt.host_prefetch = int(self.config["host_prefetch"])
+        if "streaming" in self.config:
+            opt.streaming = bool(self.config["streaming"])
         if profile_dir is not None:
             opt.set_profile(profile_dir)
         if getattr(self, "_initial_variables", None) is not None:

@@ -87,7 +87,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(u8p), i64p, i32p,
         ctypes.c_int, ctypes.c_int, f32p, f32p, f32p, i32p]
     lib.btio_version.restype = ctypes.c_int
-    if lib.btio_version() != 3:
+    if lib.btio_version() != 4:
         return None
     return lib
 
@@ -218,12 +218,27 @@ class BatchPipeline:
         except Exception:
             pass
 
+    @staticmethod
+    def _out_buffer(out, n, oh, ow, c) -> np.ndarray:
+        """Validate a caller-provided output buffer (a ring slot — the
+        no-per-batch-allocation path of data/pipeline.py) or allocate one."""
+        if out is None:
+            return np.empty((n, oh, ow, c), np.float32)
+        if out.shape != (n, oh, ow, c) or out.dtype != np.float32 \
+                or not out.flags.c_contiguous:
+            raise ValueError(
+                f"out buffer must be C-contiguous float32 {(n, oh, ow, c)}, "
+                f"got {out.dtype} {out.shape}")
+        return out
+
     def process_batch(self, images, out_hw, mean, std, resize_hw=None,
-                      crops=None, flips=None) -> np.ndarray:
+                      crops=None, flips=None, out=None) -> np.ndarray:
         """images: list of uint8 HWC arrays (same channel count).
         out_hw: (oh, ow) final size.  resize_hw: per-image or single (rh, rw)
         intermediate resize (None = no resize).  crops: per-image (cy, cx)
         offsets (None = 0,0).  flips: per-image bool (None = no flip).
+        out: optional preallocated (n, oh, ow, c) float32 destination
+        (a reusable ring slot); allocated fresh when None.
         Returns (n, oh, ow, c) float32, normalized."""
         n = len(images)
         oh, ow = out_hw
@@ -233,7 +248,7 @@ class BatchPipeline:
         images = [np.ascontiguousarray(im, np.uint8) for im in images]
 
         if self._pipe is not None:
-            out = np.empty((n, oh, ow, c), np.float32)
+            out = self._out_buffer(out, n, oh, ow, c)
             srcs = (ctypes.POINTER(ctypes.c_uint8) * n)(
                 *[_u8p(im) for im in images])
             dims = np.empty((n, 2), np.int32)
@@ -260,7 +275,7 @@ class BatchPipeline:
             return out
 
         # fallback: sequential numpy
-        out = np.empty((n, oh, ow, c), np.float32)
+        out = self._out_buffer(out, n, oh, ow, c)
         for i, im in enumerate(images):
             cur = im
             if resize_hw is not None:
@@ -277,19 +292,20 @@ class BatchPipeline:
         return out
 
     def decode_batch(self, encoded, out_hw, mean, std, resize_hw=None,
-                     crops=None, flips=None) -> np.ndarray:
+                     crops=None, flips=None, out=None) -> np.ndarray:
         """JPEG decode + transform, fully in C++ worker threads.
 
         ``encoded``: list of ``bytes`` (JPEG).  Remaining args as in
-        ``process_batch``.  Returns (n, oh, ow, 3) float32.  Falls back to
-        PIL + ``process_batch`` when the native lib lacks libjpeg.
+        ``process_batch`` (including the ``out=`` ring-slot destination).
+        Returns (n, oh, ow, 3) float32.  Falls back to PIL +
+        ``process_batch`` when the native lib lacks libjpeg.
         Raises ValueError naming the failing index on a corrupt image."""
         n = len(encoded)
         oh, ow = out_hw
         if self._pipe is None or not jpeg_available():
             return self.process_batch([decode_jpeg(e) for e in encoded],
                                       out_hw, mean, std, resize_hw=resize_hw,
-                                      crops=crops, flips=flips)
+                                      crops=crops, flips=flips, out=out)
         mean = np.ascontiguousarray(mean, np.float32)
         std = np.ascontiguousarray(std, np.float32)
         bufs = [np.frombuffer(e, np.uint8) for e in encoded]
@@ -306,7 +322,7 @@ class BatchPipeline:
                 geom[i, 2], geom[i, 3] = crops[i]
             if flips is not None:
                 geom[i, 4] = int(bool(flips[i]))
-        out = np.empty((n, oh, ow, 3), np.float32)
+        out = self._out_buffer(out, n, oh, ow, 3)
         status = np.empty((n,), np.int32)
         self._lib.btio_decode_batch(
             self._pipe, n, srcs,
@@ -367,10 +383,18 @@ class RecordReader:
     def record_bytes(self) -> int:
         return int(self._lib.btio_records_bytes(self._h))
 
-    def gather(self, idx: np.ndarray) -> np.ndarray:
-        """(n,) int64 indices -> (n, record_bytes) uint8."""
+    def gather(self, idx: np.ndarray, out=None) -> np.ndarray:
+        """(n,) int64 indices -> (n, record_bytes) uint8.  ``out``: optional
+        preallocated destination (a reusable read-stage buffer)."""
         idx = np.ascontiguousarray(idx, np.int64)
-        out = np.empty((len(idx), self.record_bytes()), np.uint8)
+        shape = (len(idx), self.record_bytes())
+        if out is None:
+            out = np.empty(shape, np.uint8)
+        elif out.shape != shape or out.dtype != np.uint8 \
+                or not out.flags.c_contiguous:
+            raise ValueError(
+                f"out buffer must be C-contiguous uint8 {shape}, got "
+                f"{out.dtype} {out.shape}")
         self._lib.btio_records_gather(
             self._h, self._pipe._pipe if self._pipe is not None else None,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(idx),

@@ -69,6 +69,12 @@ def render_prometheus(metrics=None) -> str:
         n = sanitize_metric_name(name)
         lines.append(f"# TYPE {n} counter")
         lines.append(f"{n} {_fmt(snap['counters'][name])}")
+    # gauges: point-in-time levels (queue depths, ring occupancy);
+    # .get() tolerates snapshots from pre-gauge Metrics objects
+    for name in sorted(snap.get("gauges", {})):
+        n = sanitize_metric_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(snap['gauges'][name])}")
     for name in sorted(snap["sums"]):
         n = sanitize_metric_name(name)
         lines.append(f"# TYPE {n} summary")

@@ -30,6 +30,9 @@ class Metrics:
         # (obs.hist), run-lifetime like counters — /metrics exports their
         # p50/p95/p99 and Prometheus bucket lines
         self.hists: Dict[str, LogHistogram] = {}
+        # point-in-time levels (queue depths, ring occupancy): last-write-
+        # wins, exported as Prometheus gauges
+        self.gauges: Dict[str, float] = {}
         # the global_metrics() registry is shared across threads (serving
         # client/engine threads + the training driver); += on a dict
         # entry is a read-modify-write that loses updates without this.
@@ -46,6 +49,13 @@ class Metrics:
         with self._lock:
             self.counters[name] += n
         self._mirror("inc", name, n)
+
+    def gauge(self, name: str, value: float):
+        """Set a point-in-time level (queue depth, buffer-ring occupancy);
+        the scrape sees the latest value."""
+        with self._lock:
+            self.gauges[name] = float(value)
+        self._mirror("gauge", name, value)
 
     def observe(self, name: str, value: float):
         """One sample into the named histogram (created on first use)."""
@@ -91,6 +101,7 @@ class Metrics:
             out = {k: (self.sums[k] / self.counts[k]
                        if self.counts.get(k) else 0.0) for k in self.sums}
             out.update(self.counters)
+            out.update(self.gauges)
             for k, h in self.hists.items():
                 for q, v in h.quantiles().items():
                     out[f"{k}.{q}"] = v
@@ -103,6 +114,7 @@ class Metrics:
         with self._lock:
             return {"sums": dict(self.sums), "counts": dict(self.counts),
                     "counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
                     "hists": {k: h.snapshot()
                               for k, h in self.hists.items()}}
 

@@ -19,7 +19,7 @@ import jax
 import numpy as np
 
 from bigdl_tpu.data.dataset import DataSet
-from bigdl_tpu.data.prefetch import prefetch_to_device, thread_prefetch
+from bigdl_tpu.data.prefetch import thread_prefetch
 from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.optim import checkpoint as ckpt
 from bigdl_tpu.optim.metrics import Metrics, SummaryWriter, Timer
@@ -138,7 +138,16 @@ class Optimizer:
         self._val_summary: Optional[SummaryWriter] = None
         self.log_every = 1
         self.prefetch = 2  # device-transfer lookahead depth (1 = no overlap)
-        self.host_prefetch = 0  # host-side producer lookahead (0 = inline)
+        self.host_prefetch = 2  # host-side producer lookahead (batches the
+        #                         IO/decode producer runs ahead of dispatch).
+        #                         0 = inline production — only right when
+        #                         the producer is trivially cheap (in-RAM
+        #                         arrays on a starved host); an IO/decode-
+        #                         bound producer MUST run ahead or the
+        #                         device idles every step (docs/data.md)
+        self.streaming = True  # stage-parallel input pipeline when the
+        #                        dataset supports it (stream_batches);
+        #                        host_prefetch=0 forces inline production
         self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
         self.remat_policy = None  # None|'nothing'|'dots' (keep MXU outputs)
@@ -368,24 +377,7 @@ class Optimizer:
             # the batch plan is deterministic per (seed, epoch).
             skip = int(state.pop("_resume_skip", 0) or 0)
             state["epoch_batch"] = skip
-            batch_iter = self.dataset.batches(
-                self.batch_size, shuffle=True, seed=self.seed, epoch=epoch,
-                process_id=jax.process_index(),
-                process_count=jax.process_count())
-            if skip:
-                import itertools
-
-                batch_iter = itertools.islice(batch_iter, skip, None)
-            if self.host_prefetch:
-                # host-side lookahead: IO/augmentation runs a thread ahead
-                batch_iter = thread_prefetch(batch_iter,
-                                             depth=self.host_prefetch)
-            # double-buffer host→device DMA behind the running step
-            batch_iter = prefetch_to_device(
-                batch_iter,
-                lambda mb: (step_engine.shard_batch(mb["input"]),
-                            step_engine.shard_batch(np.asarray(mb["target"]))),
-                size=self.prefetch)
+            batch_iter = self._epoch_batch_iter(step_engine, epoch, skip)
             # observability: time each fetch out of the prefetch pipeline —
             # waiting HERE means the run is input-bound, not device-bound
             batch_iter = self._traced_data(batch_iter)
@@ -493,16 +485,74 @@ class Optimizer:
         return self._final_state
 
     # ------------------------------------------------------------------
+    def _epoch_batch_iter(self, step_engine, epoch, skip):
+        """One epoch's device-ready batch iterator — the streaming input
+        pipeline (docs/data.md) when the dataset supports it, the classic
+        thread-prefetch path otherwise, both behind the device-dispatch
+        lookahead.  ``host_prefetch=0`` forces fully inline production."""
+        from bigdl_tpu.data.pipeline import dispatch_to_device
+
+        engine = Engine.get()
+        kw = dict(shuffle=True, seed=self.seed, epoch=epoch,
+                  process_id=jax.process_index(),
+                  process_count=jax.process_count())
+        stream = (self.streaming and self.host_prefetch > 0
+                  and hasattr(self.dataset, "stream_batches"))
+        if stream:
+            # stage-parallel read→decode→assemble into the buffer ring;
+            # the pipeline's own threads ARE the host lookahead
+            batch_iter = self.dataset.stream_batches(
+                self.batch_size,
+                workers=getattr(engine.config, "data_workers", None),
+                metrics=self.metrics, **kw)
+        else:
+            batch_iter = self.dataset.batches(self.batch_size, **kw)
+        if skip:
+            import itertools
+
+            # a bare islice has no close(): abandoning a RESUMED epoch
+            # (preemption, end_when, driver retry) must still shut the
+            # underlying pipeline's stage threads down, so wrap in a
+            # generator whose close propagates
+            def _skipped(inner=batch_iter, n=skip):
+                try:
+                    yield from itertools.islice(inner, n, None)
+                finally:
+                    close = getattr(inner, "close", None)
+                    if close is not None:
+                        close()
+
+            batch_iter = _skipped()
+        if self.host_prefetch and not stream:
+            # host-side lookahead: IO/augmentation runs a thread ahead.
+            # (Never stacked on the streaming path: buffering RingBatches
+            # in a queue would let their slots be recycled under the
+            # consumer; the ring provides the lookahead there.)
+            batch_iter = thread_prefetch(batch_iter,
+                                         depth=self.host_prefetch)
+        # dispatch lookahead: host→device DMA double-buffers behind the
+        # running step; ring slots release only after their transfer lands
+        return dispatch_to_device(
+            batch_iter,
+            lambda mb: (step_engine.shard_batch(mb["input"]),
+                        step_engine.shard_batch(np.asarray(mb["target"]))),
+            size=self.prefetch)
+
     def _traced_data(self, batch_iter):
         """The data phase under a span + timer: each ``next()`` on the
-        prefetch pipeline is host time the device spends idle."""
+        prefetch pipeline is host time the device spends idle.  Waits land
+        in the ``train.data_wait_s`` histogram — the /metrics signal that a
+        run is input-bound rather than device-bound."""
         it = iter(batch_iter)
         while True:
             with trace.span("train/data"), Timer(self.metrics, "data_time"):
+                t0 = time.perf_counter()
                 try:
                     mb = next(it)
                 except StopIteration:
                     return
+                self.metrics.observe("train.data_wait_s",
+                                     time.perf_counter() - t0)
             yield mb
 
     def _one_iteration(self, step_engine, state, mb):

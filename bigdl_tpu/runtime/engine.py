@@ -54,6 +54,10 @@ class EngineConfig:
     profile_dir: Optional[str] = None
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
+    # input pipeline (docs/data.md): decode-worker pool width for the
+    # streaming batch path; None = one per host core (capped in the
+    # adapters).  BIGDL_TPU_DATA_WORKERS overrides fleet-wide.
+    data_workers: Optional[int] = None
 
     def resolved_failure_policy(self) -> FailurePolicy:
         """The effective FailurePolicy: the explicit one, else defaults
@@ -106,6 +110,8 @@ class EngineConfig:
             cfg.metrics_port = int(os.environ["BIGDL_TPU_METRICS_PORT"])
         if os.environ.get("BIGDL_TPU_METRICS_HOST"):
             cfg.metrics_host = os.environ["BIGDL_TPU_METRICS_HOST"]
+        if os.environ.get("BIGDL_TPU_DATA_WORKERS"):
+            cfg.data_workers = int(os.environ["BIGDL_TPU_DATA_WORKERS"])
         if os.environ.get("BIGDL_TPU_DCN_SLICES"):
             # force the cross-slice data-parallel degree where the runtime
             # exposes no slice topology (e.g. multi-host CPU, GKE multislice

@@ -271,31 +271,88 @@ struct ImageJob {
   int oh, ow;
 };
 
+// The fused pixel loop, parameterized on channel count so the c==3 hot
+// case (every vision path) compiles with the inner loop unrolled; the
+// always_inline + literal-3 call below makes gcc clone and constant-fold
+// it rather than branch on c per channel.  (extern "C++": templates cannot
+// take the file's C linkage.)
+extern "C++" {
+template <int C>
+__attribute__((always_inline)) static inline void fused_rows(
+    const ImageJob& j, float ry, const float* scale, const float* shift,
+    const int32_t* xo0, const int32_t* xo1, const float* xw, int c) {
+  for (int y = 0; y < j.oh; ++y) {
+    const float fy = (j.cy + y) * ry;
+    const int y0 = (int)fy;
+    const int y1 = std::min(y0 + 1, j.sh - 1);
+    const float wy = fy - y0;
+    const uint8_t* row0 = j.src + (size_t)y0 * j.sw * c;
+    const uint8_t* row1 = j.src + (size_t)y1 * j.sw * c;
+    float* q = j.dst + (size_t)y * j.ow * c;
+    for (int x = 0; x < j.ow; ++x) {
+      const float wx = xw[x];
+      const uint8_t* p00 = row0 + xo0[x];
+      const uint8_t* p01 = row0 + xo1[x];
+      const uint8_t* p10 = row1 + xo0[x];
+      const uint8_t* p11 = row1 + xo1[x];
+      float* o = q + (size_t)x * c;
+      const int kc = C > 0 ? C : c;
+      for (int k = 0; k < kc; ++k) {
+        const float top = p00[k] + (p01[k] - p00[k]) * wx;
+        const float bot = p10[k] + (p11[k] - p10[k]) * wx;
+        const uint8_t v = (uint8_t)std::lround(top + (bot - top) * wy);
+        o[k] = v * scale[k] + shift[k];
+      }
+    }
+  }
+}
+
+static void fused_pass(const ImageJob& j, float ry, const float* scale,
+                       const float* shift, const int32_t* xo0,
+                       const int32_t* xo1, const float* xw) {
+  if (j.c == 3) {
+    fused_rows<3>(j, ry, scale, shift, xo0, xo1, xw, 3);
+  } else {
+    fused_rows<0>(j, ry, scale, shift, xo0, xo1, xw, j.c);
+  }
+}
+}  // extern "C++"
+
+// Fused single pass: for each OUTPUT pixel, bilinear-sample the source at
+// the position the staged resize->crop->flip chain would have read, round
+// through uint8 (so results stay byte-identical to the staged ops the
+// fallbacks and parity tests compute), and write the normalized float32
+// straight into the batch slot.  Versus the staged path this computes only
+// the crop window's share of the resize (a 224-crop of a 256-resize skips
+// 23% of the samples), elides the crop memcpy, the flip copy+swap, and the
+// separate normalize read/write, and allocates no intermediate buffers —
+// the per-image cost that made decode+augment the pipeline's slow stage.
 static void run_image_job(const ImageJob j) {
-  std::vector<uint8_t> buf1, buf2;
-  const uint8_t* cur = j.src;
-  int h = j.sh, w = j.sw;
-  if (j.rh > 0 && (j.rh != h || j.rw != w)) {
-    buf1.resize((size_t)j.rh * j.rw * j.c);
-    btio_resize_bilinear_u8(cur, h, w, j.c, buf1.data(), j.rh, j.rw);
-    cur = buf1.data();
-    h = j.rh;
-    w = j.rw;
+  const int rh = (j.rh > 0) ? j.rh : j.sh;  // dims entering the crop stage
+  const int rw = (j.rh > 0) ? j.rw : j.sw;
+  const float ry = rh > 1 ? (float)(j.sh - 1) / (rh - 1) : 0.f;
+  const float rx = rw > 1 ? (float)(j.sw - 1) / (rw - 1) : 0.f;
+  std::vector<float> scale(j.c), shift(j.c);
+  for (int k = 0; k < j.c; ++k) {
+    const float inv = 1.f / j.stdv[k];
+    scale[k] = inv / 255.f;
+    shift[k] = -j.mean[k] * inv;
   }
-  if (j.oh != h || j.ow != w || j.cy != 0 || j.cx != 0) {
-    buf2.resize((size_t)j.oh * j.ow * j.c);
-    btio_crop_u8(cur, h, w, j.c, j.cy, j.cx, buf2.data(), j.oh, j.ow);
-    cur = buf2.data();
-    h = j.oh;
-    w = j.ow;
+  // per-column sample table (source offsets + weight), computed once per
+  // image instead of once per pixel; flip runs AFTER crop in the staged
+  // chain, so output column x reads resized column cx + (ow-1-x)
+  std::vector<int32_t> xo0(j.ow), xo1(j.ow);
+  std::vector<float> xw(j.ow);
+  for (int x = 0; x < j.ow; ++x) {
+    const int sx = j.cx + (j.flip ? (j.ow - 1 - x) : x);
+    const float fx = sx * rx;
+    const int x0 = (int)fx;
+    xo0[x] = x0 * j.c;
+    xo1[x] = std::min(x0 + 1, j.sw - 1) * j.c;
+    xw[x] = fx - x0;
   }
-  std::vector<uint8_t> flipped;
-  if (j.flip) {
-    flipped.assign(cur, cur + (size_t)h * w * j.c);
-    btio_hflip_u8(flipped.data(), h, w, j.c);
-    cur = flipped.data();
-  }
-  btio_normalize_f32(cur, h, w, j.c, j.mean, j.stdv, j.dst);
+  fused_pass(j, ry, scale.data(), shift.data(), xo0.data(), xo1.data(),
+             xw.data());
 }
 
 // Submit a whole batch of image jobs described by parallel arrays, then wait.
@@ -502,6 +559,6 @@ void btio_records_close(void* h) {
   delete rf;
 }
 
-int btio_version() { return 3; }
+int btio_version() { return 4; }
 
 }  // extern "C"
