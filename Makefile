@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-serving test-obs test-data bench dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-serving test-obs test-data test-bundle bench bench-dispatch dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -55,8 +55,19 @@ test-data:
 	python -m pytest tests/test_pipeline_stream.py tests/test_records.py \
 	  tests/test_native_vision.py -q
 
+# fused multi-step execution (docs/performance.md): K-vs-1 byte-identical
+# trajectories (incl. remainder bundles + on/off-grid resume), poisoned-
+# bundle rewind, trigger-edge clamping, auto-K, /metrics lines
+test-bundle:
+	python -m pytest tests/test_step_bundle.py -q
+
 bench:
 	python bench.py
+
+# dispatch-gap microbench (small-model geometry); --smoke is the CI gate
+# that fails when the K=8 host-overhead reduction drops below 3x
+bench-dispatch:
+	python bench.py --dispatch
 
 dryrun:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -403,6 +403,100 @@ def _run_bench(platform: str) -> dict:
     return out
 
 
+def _run_dispatch_bench(steps: int = 512, ks=(1, 2, 4, 8, 32)) -> dict:
+    """Dispatch-gap microbench (docs/performance.md §Step bundling): on a
+    small-model geometry (step ≤ 10 ms) the per-step cost is dominated by
+    HOST work — rebuilding args, re-entering Python, issuing one XLA
+    dispatch per step.  Fused multi-step execution amortizes that over K
+    steps; this measures per-step wall/dispatch time at several K on the
+    default backend and reports the host-overhead reduction.
+
+    ``host_overhead_per_step(K) = wall_per_step(K) − wall_per_step(K_max)``
+    — the deepest bundle is the amortized asymptote (device compute plus
+    irreducible per-bundle cost), so the difference isolates what the host
+    adds per step at shallower K.  The ``--smoke`` CI gate fails when the
+    K=8 reduction drops below 3x (a bundling regression)."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # axon quirk: the plugin ignores the env var (tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import Sequential
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec())
+    rs = np.random.RandomState(0)
+    batch, d_in, classes = 64, 32, 8
+    x = rs.randn(batch, d_in).astype(np.float32)
+    y = rs.randint(0, classes, batch).astype(np.int32)
+
+    def build():
+        model = Sequential([nn.Linear(d_in, 64), nn.ReLU(),
+                            nn.Linear(64, classes)])
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+        step = ShardedParameterStep(model, nn.CrossEntropyCriterion(),
+                                    SGD(learning_rate=0.1), mesh, variables)
+        step.set_step_seed(1)
+        return step
+
+    wall = {}
+    dispatch = {}
+    for k in ks:
+        step = build()  # fresh engine per K: donation chains stay disjoint
+        xs = [step.shard_batch(x)] * k
+        ys = [step.shard_batch(y)] * k
+        lv, _ = step.train_bundle_device(0, xs, ys)  # warmup: compile
+        jax.block_until_ready(lv)
+        n, disp = 0, 0.0
+        t0 = time.perf_counter()
+        while n < steps:
+            td = time.perf_counter()
+            lv, _ = step.train_bundle_device(n, xs, ys)
+            disp += time.perf_counter() - td
+            n += k
+        jax.block_until_ready(lv)
+        wall[k] = (time.perf_counter() - t0) / n
+        dispatch[k] = disp / n
+    asym = wall[max(ks)]
+    overhead = {k: max(wall[k] - asym, 0.0) for k in ks}
+    eps = 1e-9
+    reduction = overhead.get(1, 0.0) / max(overhead.get(8, 0.0), eps)
+    return {
+        "metric": "train_dispatch_overhead_reduction",
+        "value": round(reduction, 2),
+        "unit": "x (per-step host overhead, K=1 vs K=8)",
+        "live": True,
+        "steps": steps,
+        "geometry": {"model": f"mlp {d_in}-64-{classes}", "batch": batch,
+                     "n_devices": jax.device_count(),
+                     "platform": jax.devices()[0].platform},
+        "per_step_wall_us": {str(k): round(wall[k] * 1e6, 1) for k in ks},
+        "per_step_dispatch_us": {str(k): round(dispatch[k] * 1e6, 1)
+                                 for k in ks},
+        "asymptote_wall_us": round(asym * 1e6, 1),
+        "host_overhead_per_step_us": {str(k): round(overhead[k] * 1e6, 1)
+                                      for k in ks},
+    }
+
+
+def _dispatch_main(smoke: bool):
+    steps = int(os.environ.get("BENCH_DISPATCH_STEPS",
+                               "256" if smoke else "512"))
+    row = _run_dispatch_bench(steps=steps,
+                              ks=(1, 8, 32) if smoke else (1, 2, 4, 8, 32))
+    if smoke and row["value"] < 3.0:
+        row["error"] = (f"bundling regression: K=8 host-overhead reduction "
+                        f"{row['value']}x < 3x gate")
+        print(json.dumps(row))
+        sys.exit(1)
+    print(json.dumps(row))
+
+
 def _worker(platform: str):
     print(json.dumps(_run_bench(platform)))
 
@@ -519,5 +613,9 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         _worker(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] in ("--dispatch", "--smoke"):
+        # dispatch-gap microbench; --smoke is the CI bundling-regression
+        # gate (exit 1 when the K=8 host-overhead reduction < 3x)
+        _dispatch_main(smoke=sys.argv[1] == "--smoke")
     else:
         main()

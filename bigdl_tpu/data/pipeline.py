@@ -494,6 +494,42 @@ class StreamingPipeline:
             self._on_close()
 
 
+def bundle_batches(batches: Iterable,
+                   span: Callable[[], int]) -> Iterator[List[Any]]:
+    """Group a device-ready batch iterator into bundles for fused
+    multi-step dispatch (docs/performance.md): each pull asks ``span()``
+    how many steps the next bundle may cover (the driver clamps spans to
+    trigger edges and the per-epoch bundle grid) and yields up to that
+    many batches — fewer at the epoch tail, which becomes the remainder
+    bundle.
+
+    Ring economics: the batches come out of :func:`dispatch_to_device`,
+    which released each ring slot the moment its host→device transfer
+    landed (or detached on the CPU backend) — so lending K slots to one
+    bundle needs no extra ring depth and no host-side super-batch copy;
+    the K per-batch device arrays are stacked per-device INSIDE the
+    bundled program (``ShardedParameterStep.train_bundle_device``)."""
+    it = iter(batches)
+    try:
+        while True:
+            group: List[Any] = []
+            for _ in range(max(1, int(span()))):
+                try:
+                    group.append(next(it))
+                except StopIteration:
+                    break
+            if not group:
+                return
+            yield group
+    finally:
+        # an abandoned consumer (end_when mid-epoch, preemption,
+        # exception in the training loop) must still shut the upstream
+        # pipeline's stage threads down
+        close = getattr(batches, "close", None)
+        if close is not None:
+            close()
+
+
 def dispatch_to_device(batches: Iterable, put: Callable[[Any], Any],
                        size: int = 2) -> Iterator:
     """Device-feed stage: dispatch each batch onto the local devices

@@ -20,7 +20,7 @@ from bigdl_tpu.data.shards import XShards
 from bigdl_tpu.optim.optim_method import OptimMethod
 from bigdl_tpu.optim.optimizer import Optimizer, TrainedModel
 from bigdl_tpu.optim.trigger import Trigger
-from bigdl_tpu.optim.validation import ValidationMethod
+from bigdl_tpu.optim.validation import StatsAccumulator, ValidationMethod
 from bigdl_tpu.runtime.engine import Engine, EngineConfig, init_engine
 from bigdl_tpu.utils.log import get_logger
 
@@ -248,6 +248,12 @@ class Estimator:
             opt.host_prefetch = int(self.config["host_prefetch"])
         if "streaming" in self.config:
             opt.streaming = bool(self.config["streaming"])
+        if "steps_per_call" in self.config:
+            # fused multi-step execution (docs/performance.md): K train
+            # steps per XLA program, or "auto" to size K from measured
+            # dispatch-vs-step time
+            spc = self.config["steps_per_call"]
+            opt.steps_per_call = spc if spc == "auto" else int(spc)
         if profile_dir is not None:
             opt.set_profile(profile_dir)
         if getattr(self, "_initial_variables", None) is not None:
@@ -358,10 +364,10 @@ class Estimator:
         fwd = self._loaded_forward()
         v = self._loaded_variables
         methods = list(methods)
-        totals = [(0.0, 0.0)] * len(methods)
         # every process walks ALL batches (params are replicated, there is
-        # no cross-process psum on this host-accumulation path — sharding
-        # the data here would silently give per-host partial metrics)
+        # no cross-process psum on this path — sharding the data here
+        # would silently give per-host partial metrics).
+        acc = StatsAccumulator()
         for mb in ds.batches(batch_size, shuffle=False, drop_last=False):
             x = mb["input"]
             n_rows = as_inputs(x)[0].shape[0]
@@ -369,10 +375,9 @@ class Estimator:
             if w is None:
                 w = np.ones((n_rows,), np.float32)
             out = fwd(v.get("params", {}), v.get("state", {}), x)
-            stats = [m.batch_stats(out, np.asarray(mb["target"]), w)
-                     for m in methods]
-            totals = [(a + float(s), b + float(c))
-                      for (a, b), (s, c) in zip(totals, stats)]
+            acc.add([m.batch_stats(out, np.asarray(mb["target"]), w)
+                     for m in methods])
+        totals = acc.fetch() or [(0.0, 0.0)] * len(methods)
         res = [m.fold(s, c) for m, (s, c) in zip(methods, totals)]
         return {r.name: r.result for r in res}
 

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from bigdl_tpu.data import pipeline as pipeline_mod
 from bigdl_tpu.data.dataset import DataSet
 from bigdl_tpu.data.prefetch import thread_prefetch
 from bigdl_tpu.obs import flight, trace
@@ -158,6 +159,14 @@ class Optimizer:
         self.seq_parallel = False  # shard dim 1 over the mesh "seq" axis
         #                            (long-context; model attention must be
         #                            seq_parallel-aware)
+        self.steps_per_call = None  # fused multi-step execution (docs/
+        #                             performance.md): compile K train
+        #                             steps as ONE XLA program so the host
+        #                             re-enters Python once per bundle, not
+        #                             once per step.  int K, "auto" (pick K
+        #                             from measured dispatch-vs-step time
+        #                             after the first log window), or None
+        #                             = inherit EngineConfig.steps_per_call
         self.metrics = Metrics()
         self.watchdog = None  # resilience.StepWatchdog (Supervisor installs
         #                       one; set directly for standalone NaN/hang
@@ -174,6 +183,13 @@ class Optimizer:
         self._profiler = None
         self._summary_triggers: Dict[str, Trigger] = {}
         self._last_hist_iter = -1
+        # bundle runtime state (resolved per optimize() run)
+        self._bundle_k = 1
+        self._bundle_auto = False
+        self._bundle_picked = False
+        self._pending_losses: List = []  # [(first_step, loss_vec, gnorm_vec)]
+        self._last_dispatch_end: Optional[float] = None
+        self._inflight = 0
 
     # ---- builder API (reference names, snake_case) -----------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -316,6 +332,21 @@ class Optimizer:
         log.info("model has %s parameters; mesh data axis = %d; ZeRO shard = %s",
                  f"{n_params:,}", step_engine.ndev,
                  f"{step_engine.shard_size:,}")
+        # fused multi-step execution: per-step PRNG derives on device from
+        # the step counter (no host PRNGKey/fold_in per step, even at K=1)
+        step_engine.set_step_seed(self.seed + 1)
+        spc = self.steps_per_call
+        if spc is None:
+            spc = getattr(engine.config, "steps_per_call", 1) or 1
+        self._bundle_auto = spc == "auto"
+        if isinstance(spc, str) and not self._bundle_auto:
+            raise ValueError(
+                f"steps_per_call {spc!r}: an int >= 1 or 'auto'")
+        self._bundle_k = 1 if self._bundle_auto else max(1, int(spc))
+        self._bundle_picked = False
+        self._pending_losses = []
+        self._last_dispatch_end = None
+        self._inflight = 0
 
         state: Dict[str, Any] = {
             "epoch": 1, "iteration": 0, "epoch_batch": 0,
@@ -381,13 +412,19 @@ class Optimizer:
             # observability: time each fetch out of the prefetch pipeline —
             # waiting HERE means the run is input-bound, not device-bound
             batch_iter = self._traced_data(batch_iter)
+            # fused multi-step execution: the pipeline lends up to
+            # steps_per_call device batches per pull; the span callback
+            # clamps each bundle to the per-epoch grid and to trigger
+            # edges, and the epoch tail arrives as a remainder bundle
+            bundles = pipeline_mod.bundle_batches(
+                batch_iter, lambda: self._bundle_span(state))
             try:
                 ran_any = False
-                for mb in batch_iter:
+                for mbs in bundles:
                     ran_any = True
-                    loss = self._one_iteration(step_engine, state, mb)
-                    state["loss"] = loss  # device array; float() when read
-                    if self._should_log(state):
+                    prev_it = state["iteration"]
+                    self._one_bundle(step_engine, state, mbs)
+                    if self._should_log(prev_it, state["iteration"]):
                         self._log_progress(state, t_loop)
                     t_trig = time.perf_counter()
                     self._fire_triggers(step_engine, state)
@@ -427,6 +464,12 @@ class Optimizer:
                 # resume point.
                 retries += 1
                 t_fail = time.perf_counter()
+                # dispatched-but-unfetched bundle results are part of the
+                # rolled-back step chain; drop them so the next log window
+                # never feeds pre-failure losses to the watchdog
+                self._pending_losses = []
+                self._inflight = 0
+                self._last_dispatch_end = None
                 cause = classify(e)
                 policy = self.failure_policy \
                     or engine.config.resolved_failure_policy()
@@ -555,44 +598,108 @@ class Optimizer:
                                      time.perf_counter() - t0)
             yield mb
 
-    def _one_iteration(self, step_engine, state, mb):
+    def _bundle_span(self, state) -> int:
+        """How many steps the NEXT bundle may span.  Bundle edges live on
+        the per-epoch grid (epoch_batch multiples of K) so a mid-epoch
+        resume re-aligns to the boundaries an uninterrupted run used, and
+        iteration-structured triggers (``Trigger.boundary`` hints) shorten
+        a bundle so their firing step lands exactly on a bundle edge —
+        ``several_iteration(4)`` still checkpoints at iteration 4 under
+        ``steps_per_call=8``.  Triggers without iteration structure
+        (loss/score/plateau) quantize to bundle granularity."""
+        k = self._bundle_k
+        if k <= 1:
+            return 1
+        span = k - state.get("epoch_batch", 0) % k
         it = state["iteration"]
-        with trace.span("train/step", step=it):
-            faults.fire_step(it)  # injection: slow_host / process_kill /
-            #                       step_fail (no-op without a fault plan)
-            if self.watchdog is not None:
-                self.watchdog.step_started(it)
-            if self._profiler is not None:
-                self._profiler.step(it)
-            step_rng = jax.random.fold_in(
-                jax.random.PRNGKey(self.seed + 1), it)
-            x_dev, y_dev = mb
-            with trace.span("train/dispatch", step=it), \
-                    Timer(self.metrics, "step_dispatch"):
-                loss = step_engine.train_step_device(
-                    it, step_rng, x_dev, y_dev)
-        state["iteration"] = it + 1
-        state["epoch_batch"] = state.get("epoch_batch", 0) + 1
-        return loss
+        for t in (self.end_when, self._val_trigger, self._ckpt_trigger,
+                  self._summary_triggers.get("Parameters")):
+            b = getattr(t, "boundary", None) if t is not None else None
+            if b is None:
+                continue
+            edge = b(it)
+            if edge is not None and 0 < edge < span:
+                span = edge
+        return span
 
-    def _should_log(self, state) -> bool:
-        return state["iteration"] % self.log_every == 0
+    def _one_bundle(self, step_engine, state, mbs):
+        """Dispatch ``len(mbs)`` consecutive steps as ONE XLA program.
+        Fault injection fires host-side for every step in the range (the
+        host only regains control at bundle edges); per-step losses come
+        back as a device vector fetched lazily at the next log point."""
+        it0 = state["iteration"]
+        k = len(mbs)
+        now = time.perf_counter()
+        if self._last_dispatch_end is not None:
+            # host time since the previous dispatch returned — the
+            # per-step overhead bundling amortizes (÷ bundle size)
+            self.metrics.observe("train.dispatch_gap_s",
+                                 now - self._last_dispatch_end)
+        with trace.span("train/bundle", step=it0, size=k):
+            faults.fire_bundle(it0, k)  # slow_host / process_kill /
+            #                             step_fail per step in the range
+            if self.watchdog is not None:
+                self.watchdog.step_started(it0)
+            for j in range(k):
+                with trace.span("train/step", step=it0 + j):
+                    if self._profiler is not None:
+                        self._profiler.step(it0 + j)
+            xs = [mb[0] for mb in mbs]
+            ys = [mb[1] for mb in mbs]
+            with trace.span("train/dispatch", step=it0, size=k):
+                t0 = time.perf_counter()
+                losses, gnorms = step_engine.train_bundle_device(
+                    it0, xs, ys)
+                # per-step normalized so the mean stays comparable
+                # across bundle sizes (the auto-K pick reads it)
+                self.metrics.add("step_dispatch",
+                                 (time.perf_counter() - t0) / k)
+        self._last_dispatch_end = time.perf_counter()
+        self._pending_losses.append((it0, losses, gnorms))
+        self._inflight += k
+        self.metrics.gauge("train.steps_in_flight", self._inflight)
+        self.metrics.gauge("train.bundle_size", k)
+        state["loss"] = losses[-1]  # device scalar; float() when read
+        state["iteration"] = it0 + k
+        state["epoch_batch"] = state.get("epoch_batch", 0) + k
+
+    def _should_log(self, prev_it: int, it: int) -> bool:
+        # a log point is any multiple of log_every inside (prev_it, it] —
+        # bundles quantize the cadence up to their edges
+        return it // self.log_every > prev_it // self.log_every
 
     def _log_progress(self, state, t_loop):
         it = state["iteration"]
-        # fetching the loss VALUE blocks until the step chain has actually
-        # executed (it is data-dependent on every dispatched step), so the
-        # wall-clock window between log points measures real step time —
-        # not async dispatch time, which flatters when log_every > 1 and
-        # the in-flight queue hides device latency.
+        # fetching the loss VALUES blocks until the step chain has actually
+        # executed (they are data-dependent on every dispatched bundle), so
+        # the wall-clock window between log points measures real step
+        # time — not async dispatch time, which flatters when the in-flight
+        # queue hides device latency.
         with trace.span("train/device_sync", step=it):
+            pending, self._pending_losses = self._pending_losses, []
+            fetched = jax.device_get([(lv, gv) for _, lv, gv in pending])
             loss = float(state["loss"])
         state["loss"] = loss
+        self._inflight = 0
+        self.metrics.gauge("train.steps_in_flight", 0)
+        # per-step granularity survives bundling: every bundle returned a
+        # length-K loss/grad-norm vector — record the full curves first,
+        # then feed the NaN watchdog (which may raise PoisonedStepError
+        # into the retry loop after nan_patience bad observations; the
+        # fetch above already forced the sync, so none of this costs an
+        # extra transfer)
+        for (it0, _, _), (lv, gv) in zip(pending, fetched):
+            lv, gv = np.ravel(lv), np.ravel(gv)
+            for j in range(len(lv)):
+                self.metrics.observe("train.grad_norm", float(gv[j]))
+                if self._train_summary:
+                    self._train_summary.add_scalar(
+                        "loss", float(lv[j]), it0 + j + 1)
         if self.watchdog is not None:
-            # the float() above already forced the device sync, so the
-            # NaN-streak check costs nothing extra; raises PoisonedStepError
-            # into the retry loop after nan_patience bad observations
-            self.watchdog.observe_loss(it, loss)
+            for (it0, _, _), (lv, _) in zip(pending, fetched):
+                lv = np.ravel(lv)
+                for j in range(len(lv)):
+                    self.watchdog.observe_loss(it0 + j, float(lv[j]))
         now = time.perf_counter()
         last = getattr(self, "_last_log", None)
         if last is not None and it > last[1]:
@@ -606,6 +713,9 @@ class Optimizer:
         # true per-step time would require blocking every dispatch
         if dt > 0:
             self.metrics.observe("train.step_time_s", dt)
+        if (self._bundle_auto and not self._bundle_picked
+                and last is not None and it > last[1] and dt > 0):
+            self._pick_bundle_size(dt)
         self.metrics.reset()  # rolling window: throughput reflects recent steps
         lr = float(np.asarray(self.optim_method.get_learning_rate(it - 1)))
         throughput = self.batch_size / max(dt, 1e-9)
@@ -613,9 +723,27 @@ class Optimizer:
             "Epoch %d Iteration %d: loss %.4f, lr %.5g, ~%.0f records/s",
             state["epoch"], it, loss, lr, throughput)
         if self._train_summary:
-            self._train_summary.add_scalar("loss", loss, it)
             self._train_summary.add_scalar("lr", lr, it)
             self._train_summary.add_scalar("throughput", throughput, it)
+
+    def _pick_bundle_size(self, step_time_s: float) -> None:
+        """``steps_per_call="auto"``: after the first full log window
+        (compile excluded), compare the measured per-step host dispatch
+        time against step wall time and pick K so dispatch amortizes to
+        ~2% of wall — small fast steps get deep bundles, big slow steps
+        stay at K=1 where bundling only delays triggers."""
+        self._bundle_picked = True
+        disp = self.metrics.mean("step_dispatch")
+        ratio = disp / step_time_s if step_time_s > 0 else 0.0
+        k = 1 if ratio < 0.02 else int(min(32, max(2, np.ceil(ratio / 0.02))))
+        if k != self._bundle_k:
+            log.info(
+                "steps_per_call=auto: per-step dispatch %.3f ms vs step "
+                "%.3f ms (%.0f%%) -> bundling %d steps per XLA call",
+                disp * 1e3, step_time_s * 1e3, 100 * ratio, k)
+            flight.record("bundle_auto_pick", k=k, dispatch_s=disp,
+                          step_s=step_time_s)
+        self._bundle_k = k
 
     def _fire_triggers(self, step_engine, state):
         # each concern fires at most once per iteration (an iteration-count
@@ -634,11 +762,15 @@ class Optimizer:
                 and self._last_hist_iter != it):
             self._last_hist_iter = it
             variables = step_engine.get_variables()
+            # ONE batched device→host fetch of the whole params tree — a
+            # per-leaf np.asarray would block on a separate transfer per
+            # parameter (hundreds of round-trips on a real model)
+            host_params = jax.device_get(variables["params"])
             for path, leaf in jax.tree_util.tree_flatten_with_path(
-                    variables["params"])[0]:
+                    host_params)[0]:
                 tag = "Parameters/" + "/".join(
                     str(getattr(k, "key", k)) for k in path)
-                self._train_summary.add_histogram(tag, np.asarray(leaf), it)
+                self._train_summary.add_histogram(tag, leaf, it)
 
     def _save_checkpoint_once(self, step_engine, state):
         """Checkpoint unless this iteration was already checkpointed (the

@@ -32,6 +32,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.optim.validation import StatsAccumulator
 from bigdl_tpu.runtime.mesh import (AXIS_DATA, AXIS_DCN, AXIS_SEQ,
                                     axis_size, shard_map)
 
@@ -282,6 +283,10 @@ class ShardedParameterStep:
         # the jitted step is built lazily on the first batch
         self._train = None if self.seq_parallel else self._build_train()
         self._eval_cache: Dict[Any, Callable] = {}
+        # fused multi-step programs, one per distinct bundle size (the
+        # driver's remainder bundles compile once per K' and are reused)
+        self._bundle_cache: Dict[Any, Callable] = {}
+        self._base_key = None  # set_step_seed: device-resident PRNG root
 
     # ------------------------------------------------------------------
     def _leaf_spec(self, a) -> P:
@@ -306,7 +311,13 @@ class ShardedParameterStep:
         return jax.tree_util.tree_map(self._leaf_spec, tree)
 
     # ------------------------------------------------------------------
-    def _build_train(self, x_ex=None, y_ex=None):
+    def _make_step_shard(self, want_gnorm: bool = False):
+        """The single-step body shared by the classic one-step program and
+        the K-step bundle: (flat_p, ema, opt_state, mstate, step, rng, x,
+        y, mask) -> (new_flat, new_ema, new_opt, new_mstate, loss, gnorm).
+        ``want_gnorm`` adds the global mean-gradient L2 norm (one extra
+        scalar psum on the elementwise path); without it the slot is a
+        constant 0 so the classic program's collectives are unchanged."""
         model, criterion, optim = self.model, self.criterion, self.optim
         unravel, n_real = self.unravel, self.n_real
         ndev, shard_size = self.ndev, self.shard_size
@@ -408,6 +419,9 @@ class ShardedParameterStep:
                     # hop carries half the bytes (FP16CompressedTensor role)
                     g_slice = jax.lax.psum(g_slice, dcn_axis)
                 g_slice = g_slice.astype(jnp.float32) / n_replicas
+                gnorm = (jnp.sqrt(jax.lax.psum(
+                    jnp.sum(g_slice * g_slice), AXIS_DATA))
+                    if want_gnorm else jnp.asarray(0.0, jnp.float32))
                 g_slice = _clip_slice(g_slice, clip, AXIS_DATA)
                 rank = jax.lax.axis_index(AXIS_DATA)
                 p_slice = jax.lax.dynamic_slice(
@@ -424,6 +438,11 @@ class ShardedParameterStep:
                 grads = unravel(flat_g_f32[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, batch_axes), grads)
+                if want_gnorm:
+                    fg_n, _ = ravel_pytree(grads)
+                    gnorm = jnp.linalg.norm(fg_n)
+                else:
+                    gnorm = jnp.asarray(0.0, jnp.float32)
                 if clip is not None and clip.l2_norm is not None:
                     fg, _ = ravel_pytree(grads)
                     norm = jnp.linalg.norm(fg)
@@ -443,19 +462,87 @@ class ShardedParameterStep:
                 new_mstate)
             new_ema = (ema_decay * ema + (1.0 - ema_decay) * new_flat
                        if ema_decay else ema)
-            return new_flat, new_ema, new_opt, new_mstate, loss
+            return new_flat, new_ema, new_opt, new_mstate, loss, gnorm
 
-        opt_spec = (P(AXIS_DATA) if elementwise else P())
-        if seq_par:
+        return step_shard
+
+    def _train_specs(self, x_ex=None, y_ex=None):
+        """(opt_spec, x_spec, y_spec) for the train programs — seq_parallel
+        specs depend on leaf ranks, so they need example batches."""
+        opt_spec = (P(AXIS_DATA) if self.optim.elementwise else P())
+        if self.seq_parallel:
             x_spec = self._batch_specs(x_ex)
             y_spec = self._batch_specs(y_ex)
         else:
             x_spec = y_spec = P(self._batch_axes)
+        return opt_spec, x_spec, y_spec
+
+    def _build_train(self, x_ex=None, y_ex=None):
+        core = self._make_step_shard(want_gnorm=False)
+
+        def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y,
+                       mask):
+            return core(flat_p, ema, opt_state, mstate, step, rng, x, y,
+                        mask)[:5]
+
+        opt_spec, x_spec, y_spec = self._train_specs(x_ex, y_ex)
         mapped = shard_map(
             step_shard, mesh=self.mesh,
             in_specs=(P(), P(), opt_spec, P(), P(), P(), x_spec, y_spec,
                       P()),
             out_specs=(P(), P(), opt_spec, P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+
+    def _build_bundle(self, n_steps: int, x_ex=None, y_ex=None):
+        """K consecutive training steps as ONE jitted XLA program: a
+        ``lax.scan`` whose body is exactly the single-step shard function,
+        loop-carrying (params, EMA, opt-state, model-state, step counter)
+        with donation across the whole bundle.  Per-step PRNG derives from
+        the ON-DEVICE step counter (``fold_in(base_key, step)``) and the LR
+        schedule evaluates on device inside each update, so the host does
+        zero per-step work between bundle edges.  Returns length-K loss and
+        grad-norm vectors so per-step granularity (NaN-streak detection,
+        loss curves) survives bundling.
+
+        The K input batches arrive as a K-tuple of ordinary per-batch
+        device arrays (each sharded exactly like the single-step program's
+        batch) and are stacked PER DEVICE inside the shard: the scan xs is
+        assembled from local shards, so no host-side super-batch copy and
+        no resharding collective ever happens."""
+        core = self._make_step_shard(want_gnorm=True)
+
+        def bundle_shard(flat_p, ema, opt_state, mstate, step0, base_key,
+                         xs, ys, mask):
+            x_stack = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *xs)
+            y_stack = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *ys)
+
+            def body(carry, xy):
+                fp, em, op, ms, step = carry
+                x_k, y_k = xy
+                rng = jax.random.fold_in(base_key, step)
+                nf, ne, no, nm, loss, gnorm = core(
+                    fp, em, op, ms, step, rng, x_k, y_k, mask)
+                return (nf, ne, no, nm, step + 1), (loss, gnorm)
+
+            (flat_p, ema, opt_state, mstate, _), (losses, gnorms) = \
+                jax.lax.scan(body,
+                             (flat_p, ema, opt_state, mstate, step0),
+                             (x_stack, y_stack))
+            return flat_p, ema, opt_state, mstate, losses, gnorms
+
+        opt_spec, x_spec, y_spec = self._train_specs(x_ex, y_ex)
+        xs_spec = (tuple(x_spec for _ in range(n_steps))
+                   if self.seq_parallel else x_spec)
+        ys_spec = (tuple(y_spec for _ in range(n_steps))
+                   if self.seq_parallel else y_spec)
+        mapped = shard_map(
+            bundle_shard, mesh=self.mesh,
+            in_specs=(P(), P(), opt_spec, P(), P(), P(), xs_spec, ys_spec,
+                      P()),
+            out_specs=(P(), P(), opt_spec, P(), P(), P()),
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
@@ -549,11 +636,65 @@ class ShardedParameterStep:
             self._ema_dummy = new_ema
         return loss
 
+    # -- fused multi-step execution (docs/performance.md) ---------------
+    def set_step_seed(self, seed: int) -> None:
+        """Place the per-run PRNG root on device ONCE; every bundled step
+        derives its key inside the jitted program from the on-device step
+        counter, so no host-side ``PRNGKey``/``fold_in`` runs per step.
+        (put_sharded: a bare device_put of a replicated array broadcasts
+        under multi-controller, which multi-host CPU meshes cannot do.)"""
+        self._base_key = put_sharded(
+            np.asarray(jax.random.PRNGKey(seed)), self._rep)
+
+    def train_bundle_device(self, step0: int, xs, ys, base_key=None):
+        """Run ``len(xs)`` consecutive training steps as ONE dispatched XLA
+        program over already-sharded device batches.  Returns
+        ``(losses, grad_norms)`` — length-K device vectors, one entry per
+        step, fetched lazily by the caller.
+
+        Numerics are identical for every bundle size: the scan body is the
+        same per-step HLO, per-step PRNG is ``fold_in(base_key, step)`` of
+        the global step counter, and batches keep their identities — so a
+        K=4 trajectory is byte-identical to K=1 (tests/test_step_bundle)."""
+        k = len(xs)
+        if k == 0 or len(ys) != k:
+            raise ValueError(f"bundle needs matching non-empty batch "
+                             f"lists, got {k} inputs / {len(ys)} targets")
+        if base_key is None:
+            base_key = self._base_key
+            if base_key is None:
+                raise ValueError(
+                    "train_bundle_device needs set_step_seed() first "
+                    "(or an explicit base_key)")
+        key = k
+        if self.seq_parallel:
+            # baked in_specs depend on leaf ranks
+            key = (k, tuple(jnp.ndim(a) for a in
+                            jax.tree_util.tree_leaves((xs[0], ys[0]))))
+        fn = self._bundle_cache.get(key)
+        if fn is None:
+            fn = self._bundle_cache[key] = self._build_bundle(
+                k, xs[0], ys[0])
+        ema_in = self.ema_flat if self.ema_flat is not None \
+            else self._ema_dummy
+        mask_in = (self._mask_flat if self._mask_flat is not None
+                   else jnp.asarray(1.0, jnp.float32))
+        (self.flat_params, new_ema, self.opt_state, self.model_state,
+         losses, gnorms) = fn(
+            self.flat_params, ema_in, self.opt_state, self.model_state,
+            jnp.asarray(step0, jnp.int32), base_key,
+            tuple(xs), tuple(ys), mask_in)
+        if self.ema_flat is not None:
+            self.ema_flat = new_ema
+        else:
+            self._ema_dummy = new_ema
+        return losses, gnorms
+
     def evaluate(self, methods, batches) -> list:
         # cache key must be the method *instances* (two Loss() objects with
         # different criteria are different programs); holding them in the
         # cache keeps ids stable
-        totals = None
+        acc = StatsAccumulator()
         for mb in batches:
             x = mb["input"]
             n_rows = as_inputs(x)[0].shape[0]
@@ -570,15 +711,11 @@ class ShardedParameterStep:
                 self._eval_cache[key] = (tuple(methods), self._build_eval(
                     tuple(methods), x, mb["target"], w))
             _, fn = self._eval_cache[key]
-            stats = fn(self.flat_params, self.model_state,
+            acc.add(fn(self.flat_params, self.model_state,
                        self.shard_batch(x),
                        self.shard_batch(mb["target"]),
-                       self.shard_batch(w))
-            stats = [(float(s), float(c)) for s, c in stats]
-            if totals is None:
-                totals = stats
-            else:
-                totals = [(a + s, b + c) for (a, b), (s, c) in zip(totals, stats)]
+                       self.shard_batch(w)))
+        totals = acc.fetch()
         return [m.fold(s, c) for m, (s, c) in zip(methods, totals or [])]
 
     # ------------------------------------------------------------------

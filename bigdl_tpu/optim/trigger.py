@@ -4,15 +4,26 @@ Reference analog (unverified — mount empty): ``dllib/optim/Trigger.scala`` —
 ``everyEpoch``, ``severalIteration``, ``maxEpoch``, ``maxIteration``,
 ``maxScore``, ``minLoss``, ``and``/``or``.  Evaluated host-side on the driver
 state dict (epoch, iteration ["neval"], loss, score, epoch_finished).
+
+Step bundling (docs/performance.md): with ``steps_per_call > 1`` the driver
+only regains control at bundle boundaries, so triggers are EVALUATED at
+bundle edges.  Iteration-structured triggers expose a ``boundary`` hint —
+``boundary(iteration) -> steps until the next firing edge (or None)`` — and
+the driver SHORTENS a bundle so that edge lands exactly on a bundle
+boundary: ``several_iteration(4)`` still checkpoints at iteration 4 under
+``steps_per_call=8``.  Triggers without iteration structure (loss/score/
+plateau) quantize to bundle granularity.
 """
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 
 class Trigger:
-    def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger"):
+    def __init__(self, fn: Callable[[Dict], bool], desc: str = "trigger",
+                 boundary: Optional[Callable[[int], Optional[int]]] = None):
         self.fn = fn
         self.desc = desc
+        self.boundary = boundary
 
     def __call__(self, state: Dict) -> bool:
         return bool(self.fn(state))
@@ -29,7 +40,8 @@ class Trigger:
     @staticmethod
     def several_iteration(n: int) -> "Trigger":
         return Trigger(lambda s: s["iteration"] > 0 and s["iteration"] % n == 0,
-                       f"several_iteration({n})")
+                       f"several_iteration({n})",
+                       boundary=lambda it: n - it % n)
 
     @staticmethod
     def max_epoch(n: int) -> "Trigger":
@@ -39,7 +51,8 @@ class Trigger:
 
     @staticmethod
     def max_iteration(n: int) -> "Trigger":
-        return Trigger(lambda s: s["iteration"] >= n, f"max_iteration({n})")
+        return Trigger(lambda s: s["iteration"] >= n, f"max_iteration({n})",
+                       boundary=lambda it: n - it if it < n else None)
 
     @staticmethod
     def min_loss(v: float) -> "Trigger":
@@ -102,9 +115,25 @@ class Trigger:
         return Trigger(fn, f"plateau({monitor}, patience={patience})")
 
     @staticmethod
+    def _child_boundary(triggers):
+        """Earliest iteration edge of any child — shortening a bundle more
+        than strictly needed is always safe (it only adds an extra host
+        visit), missing an edge is not."""
+        def boundary(it):
+            edges = [b(it) for b in
+                     (getattr(t, "boundary", None) for t in triggers)
+                     if b is not None]
+            edges = [e for e in edges if e is not None and e > 0]
+            return min(edges) if edges else None
+
+        return boundary
+
+    @staticmethod
     def and_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: all(t(s) for t in triggers), "and")
+        return Trigger(lambda s: all(t(s) for t in triggers), "and",
+                       boundary=Trigger._child_boundary(triggers))
 
     @staticmethod
     def or_(*triggers: "Trigger") -> "Trigger":
-        return Trigger(lambda s: any(t(s) for t in triggers), "or")
+        return Trigger(lambda s: any(t(s) for t in triggers), "or",
+                       boundary=Trigger._child_boundary(triggers))

@@ -4,7 +4,9 @@ Reference analog (unverified — mount empty): ``dllib/optim/ValidationMethod.
 scala`` — ``Top1Accuracy``, ``Top5Accuracy``, ``Loss``, ``MAE``, ``TreeNN...``
 returning ``ValidationResult``s that fold with ``+``.  TPU-native: each method
 maps (output, target) -> (sum, count) inside the jitted eval step; sums are
-``psum``-reduced over the mesh, folded across batches on the host.
+``psum``-reduced over the mesh and accumulated across batches ON DEVICE
+(async scalar adds) — one device→host sync per validation run, never a
+blocking float per batch (``ShardedParameterStep.evaluate``).
 """
 
 from typing import Optional, Tuple
@@ -42,6 +44,29 @@ class ValidationMethod:
 
     def fold(self, sum_, count) -> ValidationResult:
         return ValidationResult(sum_, count, self.name)
+
+
+class StatsAccumulator:
+    """Accumulates per-method ``(sum, count)`` pairs ON DEVICE across
+    batches (tiny async adds); ``fetch()`` syncs once per validation run.
+    Forcing a host float per batch would serialize the whole run on
+    device→host transfers."""
+
+    def __init__(self):
+        self.totals = None
+
+    def add(self, stats) -> None:
+        if self.totals is None:
+            self.totals = [(s, c) for s, c in stats]
+        else:
+            self.totals = [(a + s, b + c)
+                           for (a, b), (s, c) in zip(self.totals, stats)]
+
+    def fetch(self) -> Optional[list]:
+        """One ``jax.device_get`` of everything; ``None`` if no batches."""
+        if self.totals is None:
+            return None
+        return [(float(s), float(c)) for s, c in jax.device_get(self.totals)]
 
 
 def _w(weight, batch: int):

@@ -10,7 +10,9 @@ same faults at the same invocations, every time, on every process.
 Injection points (instrumented call sites in parentheses):
 
 - ``step_fail``             — raise inside the train iteration
-                              (``Optimizer._one_iteration``)
+                              (``Optimizer._one_bundle``; with step
+                              bundling every step of the bundle's range is
+                              evaluated at the bundle edge)
 - ``checkpoint_write_fail`` — raise mid-checkpoint, after blobs and BEFORE
                               the manifest (``checkpoint.save_checkpoint``),
                               leaving the partial prefix readers must skip
@@ -242,6 +244,19 @@ def fire_step(step: int) -> None:
     fire("slow_host", step=step)
     fire("process_kill", step=step)
     fire("step_fail", step=step)
+
+
+def fire_bundle(step: int, n_steps: int = 1) -> None:
+    """Step-scoped points for a K-step bundle dispatched as ONE XLA
+    program (``Optimizer._one_bundle``): the host only regains control at
+    bundle edges, so every step in ``[step, step + n_steps)`` is evaluated
+    here, before the bundle dispatches — an ``at_step`` plan keeps firing
+    at its exact step regardless of bundling, and the whole bundle rewinds
+    to its start on recovery."""
+    if _injector is None and _env_checked:
+        return  # keep the no-plan path one branch, not n_steps calls
+    for s in range(step, step + n_steps):
+        fire_step(s)
 
 
 def parse_plan(text: str) -> List[FaultSpec]:

@@ -13,7 +13,7 @@ parallelism is XLA replication over the mesh.
 import dataclasses
 import os
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Union
 
 import jax
 
@@ -58,6 +58,15 @@ class EngineConfig:
     # streaming batch path; None = one per host core (capped in the
     # adapters).  BIGDL_TPU_DATA_WORKERS overrides fleet-wide.
     data_workers: Optional[int] = None
+    # fused multi-step execution (docs/performance.md): compile K train
+    # steps as ONE XLA program — the host re-enters Python once per
+    # bundle, killing per-step dispatch overhead on small/fast models.
+    # int K >= 1, or "auto" (the driver picks K from measured
+    # dispatch-vs-step time after its first log window).
+    # BIGDL_TPU_STEPS_PER_CALL overrides fleet-wide; the Optimizer's
+    # steps_per_call attribute / Estimator "steps_per_call" config key
+    # override per run.
+    steps_per_call: Union[int, str] = 1
 
     def resolved_failure_policy(self) -> FailurePolicy:
         """The effective FailurePolicy: the explicit one, else defaults
@@ -112,6 +121,9 @@ class EngineConfig:
             cfg.metrics_host = os.environ["BIGDL_TPU_METRICS_HOST"]
         if os.environ.get("BIGDL_TPU_DATA_WORKERS"):
             cfg.data_workers = int(os.environ["BIGDL_TPU_DATA_WORKERS"])
+        if os.environ.get("BIGDL_TPU_STEPS_PER_CALL"):
+            raw = os.environ["BIGDL_TPU_STEPS_PER_CALL"].strip().lower()
+            cfg.steps_per_call = "auto" if raw == "auto" else int(raw)
         if os.environ.get("BIGDL_TPU_DCN_SLICES"):
             # force the cross-slice data-parallel degree where the runtime
             # exposes no slice topology (e.g. multi-host CPU, GKE multislice

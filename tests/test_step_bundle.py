@@ -1,0 +1,275 @@
+"""Fused multi-step execution (docs/performance.md): K training steps
+compiled as one ``lax.scan`` XLA program.
+
+The load-bearing invariant: bundle size is a PURE dispatch-granularity
+knob — ``steps_per_call=K`` must produce a byte-identical loss trajectory
+to ``steps_per_call=1`` from the same seed, including the remainder bundle
+at an epoch tail, mid-epoch resume on and off the bundle grid, and
+trigger-edge-clamped partial bundles.  The per-step PRNG derives from the
+on-device step counter (``fold_in(base_key, step)``), so bundling can
+never change what a step computes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.data import ArrayDataSet
+from bigdl_tpu.optim import checkpoint as ckpt_mod
+from bigdl_tpu.runtime.engine import Engine
+
+
+def synthetic(n=320, d=12, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d) * 3
+    y = rng.randint(0, classes, size=n)
+    x = centers[y] + rng.randn(n, d)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def mlp(d=12, classes=3):
+    return nn.Sequential([
+        nn.Linear(d, 32), nn.ReLU(), nn.Dropout(0.1),
+        nn.Linear(32, classes), nn.LogSoftMax(),
+    ])
+
+
+def run_driver(tmp_path, tag, steps_per_call, end_when, dataset=None,
+               ckpt_dir=None, ckpt_trigger=None, seed=11, watchdog=None,
+               batch_size=32):
+    """One driver run; returns the Optimizer (its summary dir holds the
+    per-step loss curve)."""
+    Engine.reset()
+    x, y = synthetic()
+    ds = dataset if dataset is not None else ArrayDataSet(x, y)
+    opt = optim.Optimizer(mlp(), ds, nn.ClassNLLCriterion(),
+                          batch_size=batch_size, seed=seed)
+    opt.steps_per_call = steps_per_call
+    opt.set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(end_when)
+    opt.set_train_summary(str(tmp_path / tag))
+    if ckpt_dir is not None:
+        opt.set_checkpoint(ckpt_dir,
+                           ckpt_trigger or optim.Trigger.every_epoch())
+    if watchdog is not None:
+        opt.watchdog = watchdog
+    opt.optimize()
+    return opt
+
+
+def loss_curve(opt):
+    return opt._train_summary.read_scalar("loss")
+
+
+class TestBundleParity:
+    def test_k4_byte_identical_to_k1_including_remainder(self, tmp_path):
+        """320 samples / batch 32 = 10 steps per epoch: K=4 bundles as
+        4+4+2 — the epoch tail is a remainder bundle — over 2 epochs.
+        The per-step loss curves must be EXACTLY equal (same floats),
+        not merely close."""
+        end = optim.Trigger.max_epoch(2)
+        k1 = run_driver(tmp_path, "k1", 1, end)
+        k4 = run_driver(tmp_path, "k4", 4, end)
+        c1, c4 = loss_curve(k1), loss_curve(k4)
+        assert len(c1) == 20 and c1 == c4
+        # the remainder bundle really happened: 10 % 4 != 0
+        assert k1.final_state["iteration"] == 20
+
+    def test_trigger_edges_clamp_bundles_exactly(self, tmp_path):
+        """Iteration-structured triggers land on their exact step under
+        bundling: several_iteration(6) checkpoints at 6 and 12 with K=4
+        (6 is OFF the 4-grid), and max_iteration(14) stops at exactly
+        14 — no overshoot to a bundle edge."""
+        d = str(tmp_path / "ck")
+        opt = run_driver(tmp_path, "clamped", 4,
+                         optim.Trigger.max_iteration(14), ckpt_dir=d,
+                         ckpt_trigger=optim.Trigger.several_iteration(6))
+        assert opt.final_state["iteration"] == 14
+        names = sorted(p for p in os.listdir(d) if p.startswith("ckpt-"))
+        assert names == ["ckpt-12", "ckpt-6"]
+        # and the clamped run is still byte-identical to K=1
+        ref = run_driver(tmp_path, "clamped-ref", 1,
+                         optim.Trigger.max_iteration(14))
+        assert loss_curve(opt) == loss_curve(ref)
+
+    @pytest.mark.parametrize("ckpt_every", [4, 6])
+    def test_mid_epoch_resume_on_and_off_grid(self, tmp_path, ckpt_every):
+        """Resume from a mid-epoch checkpoint that sits ON the bundle grid
+        (every 4) and OFF it (every 6): the first post-resume bundle
+        shortens to re-align, and the resumed trajectory is byte-identical
+        to both an uninterrupted K=4 run and the K=1 reference."""
+        ref = run_driver(tmp_path, f"ref{ckpt_every}", 1,
+                         optim.Trigger.max_iteration(16))
+        d = str(tmp_path / f"ck{ckpt_every}")
+        run_driver(tmp_path, f"a{ckpt_every}", 4,
+                   optim.Trigger.max_iteration(ckpt_every + 1), ckpt_dir=d,
+                   ckpt_trigger=optim.Trigger.several_iteration(ckpt_every))
+        latest = ckpt_mod.latest_checkpoint(d)
+        assert latest.endswith(f"ckpt-{ckpt_every}")
+        resumed = run_driver(tmp_path, f"b{ckpt_every}", 4,
+                             optim.Trigger.max_iteration(16), ckpt_dir=d,
+                             ckpt_trigger=optim.Trigger.several_iteration(
+                                 ckpt_every))
+        assert resumed.final_state["iteration"] == 16
+        got = dict(loss_curve(resumed))
+        want = dict(loss_curve(ref))
+        for step in range(ckpt_every + 1, 17):
+            assert got[step] == want[step], (step, got[step], want[step])
+
+    def test_remainder_programs_cached_per_size(self):
+        """Partial bundles compile once per distinct K' and are reused —
+        the bundle cache holds one program per size, not one per call."""
+        Engine.reset()
+        x, y = synthetic()
+        o = optim.Optimizer(mlp(), ArrayDataSet(x, y),
+                            nn.ClassNLLCriterion(), batch_size=32, seed=11)
+        o.steps_per_call = 4
+        o.log_every = 100
+        o.set_end_when(optim.Trigger.max_epoch(3))
+        trained = o.optimize()
+        # 10 steps/epoch at K=4 -> bundle sizes 4 and the 2-step epoch tail
+        assert set(trained._engine._bundle_cache.keys()) == {4, 2}
+
+
+class _PoisonOnce(ArrayDataSet):
+    """NaN-poisons one batch of epoch 1 the first time it is served —
+    the poisoned-batch (not infrastructure) failure mode."""
+
+    fired = False
+    poison_index = 5
+
+    def batches(self, *a, **kw):
+        for i, mb in enumerate(super().batches(*a, **kw)):
+            if (kw.get("epoch") == 1 and i == self.poison_index
+                    and not _PoisonOnce.fired):
+                _PoisonOnce.fired = True
+                mb = dict(mb, input=np.full_like(mb["input"], np.nan))
+            yield mb
+
+
+class TestBundleRecovery:
+    def test_poisoned_bundle_rewinds_to_bundle_start_snapshot(
+            self, tmp_path):
+        """A NaN inside bundle [4, 8) trips the watchdog at the bundle's
+        sync point; the retry loop restores from the bundle-START
+        checkpoint (ckpt-4 — checkpoints quantize to bundle edges) and
+        replays.  The recovered trajectory matches the clean K=1 run
+        everywhere except the single poisoned serving."""
+        from bigdl_tpu.resilience.detector import StepWatchdog
+
+        Engine.reset()
+        Engine.get().config.failure_retry_interval_s = 0.05
+        x, y = synthetic()
+        _PoisonOnce.fired = False
+        d = str(tmp_path / "ck")
+        opt = run_driver(
+            tmp_path, "poisoned", 4, optim.Trigger.max_iteration(12),
+            dataset=_PoisonOnce(x, y), ckpt_dir=d,
+            ckpt_trigger=optim.Trigger.several_iteration(4),
+            watchdog=StepWatchdog(nan_patience=1))
+        assert _PoisonOnce.fired
+        assert opt.metrics.counter("recoveries_total") == 1
+        assert opt.metrics.counter("retries_by_cause.poisoned_batch") == 1
+        assert opt.final_state["iteration"] == 12
+        # post-rewind steps replay from the bundle-start snapshot: the
+        # tail of the curve is byte-identical to a clean K=1 run
+        ref = run_driver(tmp_path, "poisoned-ref", 1,
+                         optim.Trigger.max_iteration(12))
+        got, want = dict(loss_curve(opt)), dict(loss_curve(ref))
+        for step in range(9, 13):
+            assert got[step] == want[step]
+
+    def test_fault_injection_fires_inside_bundle_range(self, tmp_path):
+        """``step_fail@5`` fires at step 5 even though the host only sees
+        bundle edges 0/4/8 — fire_bundle walks the step range — and the
+        driver recovers from the last bundle-edge checkpoint."""
+        from bigdl_tpu.resilience import faults
+
+        Engine.reset()
+        Engine.get().config.failure_retry_interval_s = 0.05
+        inj = faults.install(faults.parse_plan("step_fail@5"))
+        try:
+            d = str(tmp_path / "ck")
+            opt = run_driver(
+                tmp_path, "inject", 4, optim.Trigger.max_iteration(12),
+                ckpt_dir=d,
+                ckpt_trigger=optim.Trigger.several_iteration(4))
+        finally:
+            faults.clear()
+        assert ("step_fail", 5, 5) in inj.events
+        assert opt.metrics.counter("recoveries_total") == 1
+        assert opt.final_state["iteration"] == 12
+
+
+class TestBundleKnobsAndObs:
+    def test_env_and_config_wiring(self, monkeypatch):
+        from bigdl_tpu.runtime.engine import EngineConfig
+
+        monkeypatch.setenv("BIGDL_TPU_STEPS_PER_CALL", "8")
+        assert EngineConfig.from_env().steps_per_call == 8
+        monkeypatch.setenv("BIGDL_TPU_STEPS_PER_CALL", "auto")
+        assert EngineConfig.from_env().steps_per_call == "auto"
+        with pytest.raises(ValueError):
+            monkeypatch.setenv("BIGDL_TPU_STEPS_PER_CALL", "fast")
+            EngineConfig.from_env()
+
+    def test_estimator_config_key(self, tmp_path):
+        from bigdl_tpu.estimator import Estimator
+        from bigdl_tpu.optim.optim_method import SGD
+
+        x, y = synthetic(n=128)
+        est = Estimator.from_module(
+            lambda cfg: mlp(),
+            lambda cfg: SGD(learning_rate=0.1),
+            lambda cfg: nn.ClassNLLCriterion(),
+            config={"steps_per_call": 4})
+        stats = est.fit((x, y), epochs=2, batch_size=32)
+        assert stats["epochs"] == 2
+        res = est.evaluate((x, y), [optim.Top1Accuracy()], batch_size=32)
+        assert res["Top1Accuracy"] > 0.6
+
+    def test_auto_mode_picks_after_first_window(self, tmp_path):
+        opt = run_driver(tmp_path, "auto", "auto",
+                         optim.Trigger.max_epoch(3))
+        assert opt._bundle_picked
+        assert 1 <= opt._bundle_k <= 32
+        assert opt.final_state["iteration"] == 30
+
+    def test_invalid_steps_per_call_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            run_driver(tmp_path, "bad", "fast", optim.Trigger.max_epoch(1))
+
+    def test_bundle_metrics_reach_prometheus(self, tmp_path):
+        """train.dispatch_gap_s histogram + bundle-size / in-flight gauges
+        land in the registry and render as /metrics lines."""
+        from bigdl_tpu.obs.export import render_prometheus
+
+        opt = run_driver(tmp_path, "metrics", 4, optim.Trigger.max_epoch(2))
+        summ = opt.metrics.summary()
+        assert summ.get("train.dispatch_gap_s.count", 0) > 0
+        assert summ.get("train.bundle_size") == 2  # epoch-tail remainder
+        assert "train.steps_in_flight" in summ
+        text = render_prometheus(opt.metrics)
+        assert "train_dispatch_gap_s_bucket" in text
+        assert "train_bundle_size" in text
+        assert "train_grad_norm_bucket" in text
+
+    def test_watchdog_sees_every_step_of_a_bundle(self, tmp_path):
+        """Per-step granularity survives bundling: the watchdog observes
+        one loss per STEP, in order, not one per bundle."""
+        from bigdl_tpu.resilience.detector import StepWatchdog
+
+        seen = []
+
+        class Spy(StepWatchdog):
+            def observe_loss(self, step, loss):
+                seen.append(step)
+                super().observe_loss(step, loss)
+
+        run_driver(tmp_path, "spy", 4, optim.Trigger.max_iteration(10),
+                   watchdog=Spy(nan_patience=3))
+        assert seen == list(range(10))
