@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-serving test-obs test-data test-bundle bench bench-dispatch dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-serving test-obs test-data test-bundle bench bench-dispatch bench-watch dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -44,10 +44,20 @@ test-serving:
 	  tests/test_serving_chaos.py -q
 
 # the observability suite (docs/observability.md): span tracer + chrome
-# export, Prometheus exposition, latency histograms, flight recorder
-# under injected faults, TFRecord framing, profile_dir wiring
+# export, Prometheus exposition (+HELP lines, scrape-under-mutation),
+# latency histograms, flight recorder under injected faults, TFRecord
+# framing, profile_dir wiring, step-time attribution, live MFU/collective
+# gauges, recompile sentinel, perf-regression sentinel
 test-obs:
-	python -m pytest tests/test_obs.py -q
+	python -m pytest tests/test_obs.py tests/test_perf_attr.py -q
+
+# read-only perf-regression sentinel over the committed bench trajectory
+# (docs/performance.md §Regression sentinel).  NOT a watcher: it never
+# writes artifacts — chipup.py stays the single evidence writer.
+# `make bench-watch` proves the gate on synthetic rows (the CI step);
+# `python -m bigdl_tpu.obs.sentinel fresh.json` checks a real capture.
+bench-watch:
+	python -m bigdl_tpu.obs.sentinel --smoke
 
 # the input-pipeline suite (docs/data.md): streaming stage parallelism,
 # ring safety, worker-count determinism, crash propagation, record IO

@@ -38,20 +38,6 @@ import numpy as np
 # img/s/chip order of magnitude.
 BASELINE_IMG_PER_SEC_PER_CHIP = 1000.0
 
-# bf16 matmul peak FLOP/s by TPU generation (public spec sheets), keyed by
-# substrings of jax Device.device_kind. Used only for the MFU denominator.
-_PEAK_BF16 = [
-    ("v6", 918e12),          # Trillium / v6e
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),     # v5e reports device_kind "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v5", 459e12),
-    ("v4 lite", 138e12),     # v4i
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
 # Analytic fallback: ResNet-50 @224 forward ~4.09 GMACs => ~8.2 GFLOPs;
 # training (fwd + input-grad + weight-grad) ~3x forward.
 _RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.09e9
@@ -68,11 +54,12 @@ def is_good_row(row) -> bool:
 
 
 def _peak_flops(device_kind: str):
-    kind = (device_kind or "").lower()
-    for key, peak in _PEAK_BF16:
-        if key in kind:
-            return peak
-    return None
+    """Per-chip bf16 peak — delegates to the obs cost model's table so the
+    bench denominator and the live ``train.mfu`` gauge can never disagree.
+    (bench_lm.py imports this wrapper.)"""
+    from bigdl_tpu.obs.cost import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def _compiled_flops(step, step_args):

@@ -469,6 +469,13 @@ class StreamingPipeline:
                 # ring; release() is idempotent for the ones that did
                 mb.release()
                 seq += 1
+                if self._metrics is not None and seq % 8 == 0:
+                    # live per-stage throughput next to the queue-depth
+                    # gauges: a scrape can see WHICH stage caps the
+                    # pipeline (the attribution layer's data component
+                    # says the run is input-bound; these say why)
+                    for rk, rv in self.stage_rates().items():
+                        self._gauge(f"rate.{rk}", rv)
         finally:
             self.close()
 

@@ -10,9 +10,21 @@ The layer every other subsystem reports through:
 - :mod:`.hist`   — bounded log-bucketed histograms (p50/p95/p99)
 - :mod:`.flight` — fixed-size ring of notable events, dumped as JSONL on
   crash or SIGTERM
+- :mod:`.attr`   — per-step wall-time attribution, the recompilation
+  sentinel, and cross-host straggler stats
+- :mod:`.cost`   — analytic FLOPs/bytes cost model + device peak table
+  (the live ``train.mfu`` gauge)
+- :mod:`.sentinel` — read-only perf-regression gate over the committed
+  bench trajectory (``python -m bigdl_tpu.obs.sentinel``)
 """
 
-from bigdl_tpu.obs import flight, trace
+# NOTE: obs.sentinel is deliberately NOT imported here — it is the
+# `python -m bigdl_tpu.obs.sentinel` CLI, and an eager package import
+# would trip runpy's double-import warning on every invocation
+from bigdl_tpu.obs import attr, cost, flight, trace
+from bigdl_tpu.obs.attr import (RecompileSentinel, StepAttribution,
+                                expected_compile, recompile_sentinel)
+from bigdl_tpu.obs.cost import CostReport, forward_costs, peak_flops
 from bigdl_tpu.obs.export import (MetricsServer, render_prometheus,
                                   sanitize_metric_name)
 from bigdl_tpu.obs.flight import FlightRecorder
@@ -20,6 +32,9 @@ from bigdl_tpu.obs.hist import LogHistogram
 from bigdl_tpu.obs.trace import Span, Tracer
 
 __all__ = [
-    "trace", "flight", "Tracer", "Span", "FlightRecorder", "LogHistogram",
-    "MetricsServer", "render_prometheus", "sanitize_metric_name",
+    "trace", "flight", "attr", "cost", "Tracer", "Span",
+    "FlightRecorder", "LogHistogram", "MetricsServer", "render_prometheus",
+    "sanitize_metric_name", "StepAttribution", "RecompileSentinel",
+    "recompile_sentinel", "expected_compile", "CostReport", "forward_costs",
+    "peak_flops",
 ]

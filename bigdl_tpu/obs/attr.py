@@ -1,0 +1,261 @@
+"""Step-time attribution — every training run becomes an explained run.
+
+Reference analog (unverified — mount empty): ``dllib/optim/Metrics.scala``
+logged per-iteration "computing time average / get weights average / put
+gradient" splits; under XLA the iteration is one fused program, so the
+meaningful decomposition is host-side, assembled from the driver's existing
+``train/step|dispatch|data`` spans plus the bundle-edge device sync:
+
+- **data**     — host time blocked on the input pipeline (device idle,
+  input-bound; the ``train.data_wait_s`` samples)
+- **dispatch** — host time issuing the jitted bundle (python + transfer
+  argument plumbing)
+- **overhead** — trigger work at bundle edges: validation, checkpoint
+  writes, parameter histograms, callbacks
+- **device**   — the residual: device compute the host waited out at the
+  log-point sync (plus any untracked host time — kept honest by the
+  residual construction, the four components sum to the window wall by
+  definition)
+
+Per-step values land in ``train.attr.*_s`` histograms on ``/metrics``; the
+run total is the end-of-run "where did the time go" table
+(:meth:`StepAttribution.table`).
+
+This module also owns two run-health sentinels:
+
+- :class:`RecompileSentinel` — counts XLA cache misses mid-run via
+  ``jax.monitoring`` backend-compile events; a compile that fires after
+  the run went steady and outside an :func:`expected_compile` region is
+  an *unexpected recompile* (shape drift, cache invalidation) — counted
+  and flight-recorded.
+- :func:`host_step_time_stats` — cross-process aggregation for
+  multi-process meshes: allgathers each host's window step time and
+  yields max/min/skew (straggler detection).
+"""
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.obs import flight
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.obs")
+
+COMPONENTS = ("data", "dispatch", "device", "overhead")
+
+
+class StepAttribution:
+    """Accumulates per-window wall-time decompositions and exports them as
+    ``train.attr.*`` histograms plus an end-of-run table."""
+
+    def __init__(self, metrics=None):
+        if metrics is None:
+            from bigdl_tpu.optim.metrics import global_metrics
+
+            metrics = global_metrics()
+        self.metrics = metrics
+        self.steps = 0
+        self.wall_s = 0.0
+        self.totals: Dict[str, float] = {c: 0.0 for c in COMPONENTS}
+        self.windows = 0
+
+    def window(self, steps: int, wall_s: float, data_s: float,
+               dispatch_s: float, overhead_s: float) -> Dict[str, float]:
+        """Record one log window of ``steps`` steps.  ``device`` is the
+        residual (wall minus the tracked host components), clamped at 0 —
+        so the components always sum back to the window wall (to within
+        the clamp, which only engages when host timers overlap)."""
+        if steps <= 0 or wall_s <= 0:
+            return {}
+        comps = {
+            "data": max(data_s, 0.0),
+            "dispatch": max(dispatch_s, 0.0),
+            "overhead": max(overhead_s, 0.0),
+        }
+        comps["device"] = max(wall_s - sum(comps.values()), 0.0)
+        self.steps += steps
+        self.wall_s += wall_s
+        self.windows += 1
+        for name, v in comps.items():
+            self.totals[name] += v
+            # per-step values: comparable across log cadences and bundle
+            # sizes, like train.step_time_s
+            self.metrics.observe(f"train.attr.{name}_s", v / steps)
+        return comps
+
+    def report(self) -> Dict[str, Any]:
+        """Run totals + fractions — the machine-readable table."""
+        out: Dict[str, Any] = {
+            "steps": self.steps, "wall_s": self.wall_s,
+            "windows": self.windows, "components": {},
+        }
+        for name in COMPONENTS:
+            t = self.totals[name]
+            out["components"][name] = {
+                "total_s": t,
+                "per_step_s": t / self.steps if self.steps else 0.0,
+                "fraction": t / self.wall_s if self.wall_s else 0.0,
+            }
+        return out
+
+    def table(self) -> str:
+        """The end-of-run "where did the time go" table (logged by the
+        driver; first window includes compile, which lands in device)."""
+        rep = self.report()
+        lines = [
+            f"step-time attribution over {rep['steps']} steps "
+            f"({rep['wall_s']:.3f}s wall):",
+            f"  {'component':<10} {'total_s':>10} {'per_step_ms':>12} "
+            f"{'fraction':>9}",
+        ]
+        for name in COMPONENTS:
+            c = rep["components"][name]
+            lines.append(
+                f"  {name:<10} {c['total_s']:>10.3f} "
+                f"{c['per_step_s'] * 1e3:>12.3f} {c['fraction']:>8.1%}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# recompilation sentinel
+# ---------------------------------------------------------------------------
+
+_expected = threading.local()
+
+
+def _expected_depth() -> int:
+    return getattr(_expected, "depth", 0)
+
+
+@contextmanager
+def expected_compile():
+    """Mark the calling thread's region as an EXPECTED compile site (a new
+    bundle size, a fresh eval program, a plateau LR rebake) so the
+    recompile sentinel doesn't flag it."""
+    _expected.depth = _expected_depth() + 1
+    try:
+        yield
+    finally:
+        _expected.depth = _expected_depth() - 1
+
+
+class RecompileSentinel:
+    """Counts XLA backend compiles via ``jax.monitoring`` events.
+
+    Every compile increments ``train.xla_compiles_total`` and lands in the
+    ``train.compile_time_s`` histogram.  After :meth:`mark_steady` (the
+    driver calls it once warmup compiles are done), a compile outside an
+    :func:`expected_compile` region additionally increments
+    ``train.unexpected_recompiles_total`` and records an
+    ``unexpected_recompile`` flight event — the mid-run cache-miss signal
+    (shape drift, donation breakage, cache eviction) that silently
+    multiplies step time."""
+
+    EVENT = "/jax/core/compile/backend_compile_duration"
+
+    def __init__(self):
+        self._steady = False
+        self._step: Optional[int] = None
+        self._registered = False
+
+    # listener plumbing -----------------------------------------------------
+    def install(self) -> "RecompileSentinel":
+        """Register the jax.monitoring listener once per process (jax has
+        no unregister; the listener is a no-op-cheap counter)."""
+        if self._registered:
+            return self
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(self._on_event)
+        self._registered = True
+        return self
+
+    def _on_event(self, name: str, duration_s: float, **kw) -> None:
+        if name != self.EVENT:
+            return
+        try:
+            from bigdl_tpu.optim.metrics import global_metrics
+
+            m = global_metrics()
+            m.inc("train.xla_compiles_total")
+            m.observe("train.compile_time_s", float(duration_s))
+            if self._steady and _expected_depth() == 0:
+                m.inc("train.unexpected_recompiles_total")
+                flight.record("unexpected_recompile",
+                              duration_s=float(duration_s),
+                              step=self._step)
+                log.warning(
+                    "unexpected XLA recompile mid-run (%.3fs, step %s): "
+                    "input shapes drifted or the compile cache was "
+                    "invalidated", duration_s, self._step)
+        except Exception:  # a metrics bug must never sink a compile
+            pass
+
+    # driver hooks ----------------------------------------------------------
+    def mark_steady(self, step: Optional[int] = None) -> None:
+        """Warmup is over: from here every unannounced compile is a cache
+        miss worth flagging."""
+        self._steady = True
+        self._step = step
+
+    def note_step(self, step: int) -> None:
+        self._step = step
+
+    def mark_warmup(self) -> None:
+        """Back to warmup (run ended / new run starting): compiles are
+        expected again."""
+        self._steady = False
+        self._step = None
+
+    @property
+    def steady(self) -> bool:
+        return self._steady
+
+
+_sentinel: Optional[RecompileSentinel] = None
+_sentinel_lock = threading.Lock()
+
+
+def recompile_sentinel() -> RecompileSentinel:
+    """The process-wide sentinel, listener installed on first use."""
+    global _sentinel
+    if _sentinel is None:
+        with _sentinel_lock:
+            if _sentinel is None:
+                _sentinel = RecompileSentinel().install()
+    return _sentinel
+
+
+# ---------------------------------------------------------------------------
+# cross-process aggregation (straggler skew)
+# ---------------------------------------------------------------------------
+
+def step_time_stats(values) -> Dict[str, float]:
+    """max/min/skew/mean over per-host step times (pure; unit-testable
+    without a multi-process mesh)."""
+    vals = np.ravel(np.asarray(values, np.float64))
+    if vals.size == 0:
+        return {}
+    return {"max": float(vals.max()), "min": float(vals.min()),
+            "skew": float(vals.max() - vals.min()),
+            "mean": float(vals.mean()), "n_hosts": int(vals.size)}
+
+
+def host_step_time_stats(step_time_s: float) -> Optional[Dict[str, float]]:
+    """Allgather this host's window step time and reduce to straggler
+    stats.  Multi-process only (None on a single process); every process
+    must call at the same cadence (the driver's deterministic log points
+    guarantee it).  The caller exports the result as the
+    ``train.step_time_{max,min,skew}_s`` gauges."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(
+        np.asarray([step_time_s], np.float64))
+    return step_time_stats(vals)

@@ -26,7 +26,7 @@ standalone :class:`MetricsServer`.
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 
 from bigdl_tpu.utils.log import get_logger
 
@@ -55,35 +55,112 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+# help strings for the framework's own metric families, emitted as
+# ``# HELP`` lines when the registry carries no explicit describe();
+# keyed by the registry's dotted names
+DEFAULT_HELP = {
+    "train.step_time_s": "step wall time (window mean at coarse log "
+                         "cadence)",
+    "train.data_wait_s": "host time blocked on the input pipeline per "
+                         "fetch (input-bound signal)",
+    "train.attr.data_s": "per-step attributed time: input-pipeline wait",
+    "train.attr.dispatch_s": "per-step attributed time: host dispatch of "
+                             "the jitted step",
+    "train.attr.device_s": "per-step attributed time: device compute "
+                           "(residual at the log-point sync)",
+    "train.attr.overhead_s": "per-step attributed time: trigger work "
+                             "(validation/checkpoint/callbacks)",
+    "train.mfu": "live model-flop utilization (analytic cost model over "
+                 "the device-kind bf16 peak)",
+    "train.flops_per_step": "analytic training FLOPs of one global step "
+                            "(3x forward)",
+    "train.achieved_flops_per_chip": "achieved FLOP/s per chip over the "
+                                     "last log window",
+    "train.collective_ici_bytes_per_step": "per-step ICI collective bytes "
+                                           "of the gradient sync",
+    "train.collective_dcn_bytes_per_step": "per-step cross-slice (DCN) "
+                                           "collective bytes",
+    "train.collective_ici_bytes_total": "run-lifetime ICI collective "
+                                        "bytes moved by training steps",
+    "train.collective_dcn_bytes_total": "run-lifetime DCN collective "
+                                        "bytes moved by training steps",
+    "train.xla_compiles_total": "XLA backend compiles observed in this "
+                                "process",
+    "train.compile_time_s": "XLA backend compile durations",
+    "train.unexpected_recompiles_total": "compiles after the run went "
+                                         "steady (mid-run cache misses)",
+    "train.step_time_skew_s": "max-min step time across hosts (straggler "
+                              "skew)",
+    "train.step_time_max_s": "slowest host's window step time",
+    "train.step_time_min_s": "fastest host's window step time",
+    "serving.latency_s": "admission-to-publish latency per request",
+}
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def render_prometheus(metrics=None) -> str:
     """One scrape: the full registry in text exposition format.  With no
     argument, renders the process-wide registry — the union every
-    subsystem's counters mirror into."""
+    subsystem's counters mirror into.
+
+    ``# HELP`` rides next to ``# TYPE`` (registry ``describe()`` strings
+    first, the framework catalog as fallback), and a family's header is
+    emitted at most ONCE per scrape — two dotted names that sanitize to
+    the same family must not re-declare it.  The colliding LATER name's
+    samples are dropped too: duplicate name+labels series make the whole
+    scrape unparseable to a real Prometheus, which is strictly worse than
+    losing the shadowed series."""
     if metrics is None:
         from bigdl_tpu.optim.metrics import global_metrics
 
         metrics = global_metrics()
     snap = metrics.snapshot()
+    helps = dict(DEFAULT_HELP)
+    helps.update(snap.get("helps", {}))
     lines = []
+    emitted = set()
+    owner: Dict[str, str] = {}  # family -> raw name that claimed it
+
+    def header(raw_name: str, n: str, typ: str) -> bool:
+        """Declare family ``n`` once; False when ``raw_name`` lost the
+        family to an earlier colliding name (caller skips its samples)."""
+        if owner.setdefault(n, raw_name) != raw_name:
+            return False
+        if n in emitted:
+            return True  # family already declared this scrape
+        emitted.add(n)
+        h = helps.get(raw_name) or helps.get(n)
+        if h:
+            lines.append(f"# HELP {n} {_escape_help(h)}")
+        lines.append(f"# TYPE {n} {typ}")
+        return True
+
     for name in sorted(snap["counters"]):
         n = sanitize_metric_name(name)
-        lines.append(f"# TYPE {n} counter")
+        if not header(name, n, "counter"):
+            continue
         lines.append(f"{n} {_fmt(snap['counters'][name])}")
     # gauges: point-in-time levels (queue depths, ring occupancy);
     # .get() tolerates snapshots from pre-gauge Metrics objects
     for name in sorted(snap.get("gauges", {})):
         n = sanitize_metric_name(name)
-        lines.append(f"# TYPE {n} gauge")
+        if not header(name, n, "gauge"):
+            continue
         lines.append(f"{n} {_fmt(snap['gauges'][name])}")
     for name in sorted(snap["sums"]):
         n = sanitize_metric_name(name)
-        lines.append(f"# TYPE {n} summary")
+        if not header(name, n, "summary"):
+            continue
         lines.append(f"{n}_sum {_fmt(snap['sums'][name])}")
         lines.append(f"{n}_count {snap['counts'].get(name, 0)}")
     for name in sorted(snap["hists"]):
         h = snap["hists"][name]
         n = sanitize_metric_name(name)
-        lines.append(f"# TYPE {n} histogram")
+        if not header(name, n, "histogram"):
+            continue
         acc = 0
         for bound, count in zip(h["bounds"], h["counts"]):
             acc += count

@@ -82,6 +82,10 @@ class FlightRecorder:
                      "reason": reason, "pid": os.getpid(),
                      "events": len(events),
                      "events_total": self.events_total}) + "\n")
+                snap = self._metrics_snapshot(
+                    blocking=not reason.startswith("signal"))
+                if snap is not None:
+                    f.write(json.dumps(snap, default=str) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, default=str) + "\n")
             self._dumped = True
@@ -90,6 +94,26 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001 — see docstring
             log.error("flight recorder dump failed: %s", e)
         return path
+
+    @staticmethod
+    def _metrics_snapshot(blocking: bool = True) -> Optional[Dict[str, Any]]:
+        """Final metric state (process-wide counters + gauges) for the
+        dump, so a post-mortem carries how far the job got — not just the
+        event ring.  Never raises (dump runs in crash handlers); signal
+        paths pass ``blocking=False`` because the handler may have
+        interrupted the frame holding the registry's non-reentrant lock —
+        a blocking acquire there would hang the dump forever."""
+        try:
+            from bigdl_tpu.optim.metrics import global_metrics
+
+            snap = global_metrics().snapshot(blocking=blocking)
+            if snap is None:  # lock held by the interrupted frame
+                return None
+            return {"t": time.time(), "kind": "metrics_snapshot",
+                    "counters": snap.get("counters", {}),
+                    "gauges": snap.get("gauges", {})}
+        except Exception:  # noqa: BLE001 — see dump() docstring
+            return None
 
     def install(self, path: Optional[str] = None, signals=None) -> None:
         """Arm the crash/preemption dump: chain a ``sys.excepthook`` that

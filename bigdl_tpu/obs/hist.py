@@ -79,9 +79,14 @@ class LogHistogram:
     def percentile(self, q: float) -> float:
         """Approximate q-th percentile (q in [0, 100]): the upper bound of
         the bucket holding the q-th sample, clamped to the observed max so
-        a single slow request doesn't report a bound 2x above reality."""
+        a single slow request doesn't report a bound 2x above reality.
+
+        Empty histogram: NaN — "no data" must be distinguishable from "a
+        0.0s latency" (0.0 once fed a dashboard a phantom perfect p99);
+        a single observation reports that observation (its bucket bound
+        clamped to the observed max == the sample itself)."""
         if self.n == 0:
-            return 0.0
+            return float("nan")
         rank = max(1, math.ceil(self.n * q / 100.0))
         acc = 0
         bounds = self.upper_bounds()

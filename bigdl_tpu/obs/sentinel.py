@@ -1,0 +1,290 @@
+"""Perf-regression sentinel — fresh bench JSON vs the committed trajectory.
+
+The repo commits one perf artifact per round (``BENCH_r01..r05``,
+``BENCH_loader_r06``, ``BENCH_dispatch_r07``, ``SERVING_r04/r05``); until
+now nothing *compared* a fresh measurement against that trajectory — a 20%
+throughput regression would land silently as next round's artifact.  This
+module is the gate: it normalizes every committed artifact into
+``(family, value, direction)`` rows, takes the best good committed value
+per family as the baseline, and flags a fresh row that regresses more than
+``threshold`` (default 10%).
+
+READ-ONLY by design: the sentinel never writes bench artifacts or touches
+``BENCH_attempts.jsonl`` — ``chipup.py`` remains the repo's single
+evidence writer (the test_watcher_single invariant; this is why the
+historical ``bench_watch.py`` entry point stays retired and the CLI lives
+at ``python -m bigdl_tpu.obs.sentinel`` / ``make bench-watch`` instead).
+
+CLI::
+
+    python -m bigdl_tpu.obs.sentinel fresh.json [...]   # exit 1 on regression
+    python -m bigdl_tpu.obs.sentinel --smoke            # prove the gate works
+                                                        # on synthetic rows
+
+``--smoke`` synthesizes a 20% regressed row and an unregressed row from
+the committed history and exits non-zero unless the sentinel flags exactly
+the regressed one — the CI step that proves the gate, machine-independent.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+HIGHER = "higher"
+LOWER = "lower"
+
+DEFAULT_THRESHOLD = 0.10
+
+# committed artifact families: (glob, extractor).  An extractor maps one
+# artifact dict onto zero or more normalized rows.
+_ARTIFACT_GLOBS = (
+    "BENCH_r[0-9]*.json",
+    "BENCH_dispatch_r[0-9]*.json",
+    "BENCH_loader_r[0-9]*.json",
+    "SERVING_r[0-9]*.json",
+)
+
+# lower-is-better families (latencies); everything else is higher-better
+_LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms"})
+
+
+@dataclass
+class Row:
+    family: str
+    value: float
+    direction: str
+    source: str
+
+
+@dataclass
+class Verdict:
+    family: str
+    fresh: float
+    baseline: float
+    baseline_source: str
+    direction: str
+    ratio: float            # fresh / baseline
+    regressed: bool
+    threshold: float
+
+    def asdict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+def _good(row: Dict[str, Any]) -> bool:
+    """A trustworthy committed row: parsed, no error, not flagged
+    suspect.  Replayed (live=False) rows still count — they are real
+    measurements preserved across a flaky tunnel."""
+    return (isinstance(row, dict) and "error" not in row
+            and not row.get("suspect"))
+
+
+def _unwrap(doc: Any) -> Optional[Dict[str, Any]]:
+    """Round artifacts are re-wrapped as {n, cmd, rc, tail, parsed} by the
+    round driver — unwrap to the measurement row."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc and not doc.get("metric"):
+        doc = doc["parsed"]
+    return doc if isinstance(doc, dict) else None
+
+
+def normalize(doc: Any, source: str) -> List[Row]:
+    """One artifact dict -> normalized rows (empty when not trustworthy)."""
+    row = _unwrap(doc)
+    if row is None or not _good(row):
+        return []
+    out: List[Row] = []
+
+    def add(family: str, value: Any, direction: str = HIGHER) -> None:
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        if v > 0:
+            out.append(Row(family, v, direction, source))
+
+    if "metric" in row:  # bench.py / bench-dispatch rows carry their name
+        add(str(row["metric"]), row.get("value"))
+    if "pipeline_img_per_sec" in row:
+        add("loader_pipeline_img_per_sec", row["pipeline_img_per_sec"])
+    if "loader_img_per_sec" in row:
+        add("loader_img_per_sec", row["loader_img_per_sec"])
+    if "throughput_rps" in row:
+        add("serving_throughput_rps", row["throughput_rps"])
+        add("serving_p50_ms", row.get("p50_ms"), LOWER)
+        add("serving_p99_ms", row.get("p99_ms"), LOWER)
+    return out
+
+
+def load_history(root: Optional[str] = None) -> Dict[str, List[Row]]:
+    """All committed artifact rows, grouped by family."""
+    root = root or os.getcwd()
+    history: Dict[str, List[Row]] = {}
+    for pattern in _ARTIFACT_GLOBS:
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            for row in normalize(doc, os.path.basename(path)):
+                history.setdefault(row.family, []).append(row)
+    return history
+
+
+def baseline_for(family: str, history: Dict[str, List[Row]]
+                 ) -> Optional[Row]:
+    """The committed value to beat: best good row of the family (max for
+    higher-better, min for lower-better) — a fresh number must not
+    regress >threshold from the trajectory's best."""
+    rows = history.get(family)
+    if not rows:
+        return None
+    best = (max if rows[0].direction == HIGHER else min)(
+        rows, key=lambda r: r.value)
+    return best
+
+
+def check_row(row: Row, history: Dict[str, List[Row]],
+              threshold: float = DEFAULT_THRESHOLD) -> Optional[Verdict]:
+    """Compare one fresh row against the committed trajectory.  None when
+    the family has no committed history (nothing to regress from)."""
+    base = baseline_for(row.family, history)
+    if base is None:
+        return None
+    ratio = row.value / base.value
+    if row.direction == HIGHER:
+        regressed = ratio < 1.0 - threshold
+    else:
+        regressed = ratio > 1.0 + threshold
+    return Verdict(family=row.family, fresh=row.value, baseline=base.value,
+                   baseline_source=base.source, direction=row.direction,
+                   ratio=round(ratio, 4), regressed=regressed,
+                   threshold=threshold)
+
+
+def check(fresh: Any, history: Dict[str, List[Row]],
+          threshold: float = DEFAULT_THRESHOLD,
+          source: str = "fresh") -> List[Verdict]:
+    """Normalize a fresh artifact dict and check every family it carries."""
+    out = []
+    for row in normalize(fresh, source):
+        v = check_row(row, history, threshold)
+        if v is not None:
+            out.append(v)
+    return out
+
+
+def _load_fresh(path: str) -> Optional[Dict[str, Any]]:
+    """A fresh artifact: a JSON file, or bench stdout whose LAST line is
+    the JSON row (the bench.py contract)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
+        try:
+            doc = json.loads(line)
+            if isinstance(doc, dict):
+                return doc
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def _smoke(history: Dict[str, List[Row]], threshold: float) -> int:
+    """Prove the gate on synthetic rows: a 20% regression must be flagged,
+    an on-trajectory row must pass.  Exit 0 only when both hold."""
+    if not history:
+        print(json.dumps({"smoke": "fail",
+                          "reason": "no committed artifacts found"}))
+        return 1
+    failures = []
+    for family, rows in sorted(history.items()):
+        base = baseline_for(family, history)
+        drop = 0.8 if base.direction == HIGHER else 1.25
+        regressed_row = Row(family, base.value * drop, base.direction,
+                            "synthetic-regressed")
+        ok_row = Row(family, base.value, base.direction, "synthetic-ok")
+        v_bad = check_row(regressed_row, history, threshold)
+        v_ok = check_row(ok_row, history, threshold)
+        if not (v_bad and v_bad.regressed):
+            failures.append(f"{family}: synthetic 20% regression NOT flagged")
+        if v_ok and v_ok.regressed:
+            failures.append(f"{family}: on-trajectory value falsely flagged")
+    verdict = {"smoke": "ok" if not failures else "fail",
+               "families": len(history), "threshold": threshold,
+               "failures": failures}
+    print(json.dumps(verdict))
+    return 0 if not failures else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bigdl_tpu.obs.sentinel",
+        description="read-only perf-regression sentinel over committed "
+                    "bench artifacts (docs/performance.md §Regression "
+                    "sentinel)")
+    ap.add_argument("fresh", nargs="*",
+                    help="fresh artifact JSON files (bench.py stdout ok)")
+    ap.add_argument("--root", default=None,
+                    help="repo root holding the committed artifacts "
+                         "(default: cwd)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative regression that fails (default 0.10)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="prove the gate on synthetic regressed rows")
+    args = ap.parse_args(argv)
+
+    # default root: the repo checkout this package sits in, falling back
+    # to cwd when the package is installed outside a checkout
+    repo = args.root or _find_repo_root() or os.getcwd()
+    history = load_history(repo)
+
+    if args.smoke:
+        return _smoke(history, args.threshold)
+    if not args.fresh:
+        ap.error("need fresh artifact files (or --smoke)")
+    rc = 0
+    for path in args.fresh:
+        doc = _load_fresh(path)
+        if doc is None:
+            print(json.dumps({"file": path, "error": "unparseable"}))
+            rc = 1
+            continue
+        verdicts = check(doc, history, args.threshold,
+                         source=os.path.basename(path))
+        if not verdicts:
+            print(json.dumps({"file": path, "checked": 0,
+                              "note": "no family overlaps the committed "
+                                      "trajectory"}))
+            continue
+        for v in verdicts:
+            print(json.dumps(dict(v.asdict(), file=path)))
+            if v.regressed:
+                rc = 1
+    return rc
+
+
+def _find_repo_root() -> Optional[str]:
+    """Walk up from this file looking for committed BENCH artifacts."""
+    d = os.path.dirname(os.path.abspath(__file__))
+    for _ in range(6):
+        if glob.glob(os.path.join(d, "BENCH_r[0-9]*.json")):
+            return d
+        d = os.path.dirname(d)
+    return None
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,9 @@ class Metrics:
         # point-in-time levels (queue depths, ring occupancy): last-write-
         # wins, exported as Prometheus gauges
         self.gauges: Dict[str, float] = {}
+        # optional per-metric help strings (describe()); the exporter
+        # renders them as `# HELP` lines next to `# TYPE`
+        self.helps: Dict[str, str] = {}
         # the global_metrics() registry is shared across threads (serving
         # client/engine threads + the training driver); += on a dict
         # entry is a read-modify-write that loses updates without this.
@@ -77,9 +80,25 @@ class Metrics:
         if g is not self:
             getattr(g, op)(name, v)
 
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach a Prometheus ``# HELP`` string to a metric name (applies
+        whatever kind the name turns out to be; mirrored like the metric
+        itself so the process-wide scrape carries it too)."""
+        with self._lock:
+            self.helps[name] = str(help_text)
+        g = global_metrics()
+        if g is not self:
+            g.describe(name, help_text)
+
     def counter(self, name: str) -> float:
         with self._lock:
             return self.counters.get(name, 0.0)
+
+    def total(self, name: str) -> float:
+        """Sum of a per-window timer (``add``) since the last ``reset`` —
+        the attribution layer reads window totals, not means."""
+        with self._lock:
+            return self.sums.get(name, 0.0)
 
     def mean(self, name: str) -> float:
         with self._lock:
@@ -108,15 +127,25 @@ class Metrics:
                 out[f"{k}.count"] = h.n
         return out
 
-    def snapshot(self) -> Dict[str, dict]:
+    def snapshot(self, blocking: bool = True) -> Optional[Dict[str, dict]]:
         """Consistent point-in-time copy of the whole registry — the
-        exporter (obs.export) renders from this, never from live dicts."""
-        with self._lock:
+        exporter (obs.export) renders from this, never from live dicts.
+
+        ``blocking=False`` is for signal handlers (the flight recorder's
+        SIGTERM dump): the handler may have interrupted the very frame
+        that holds this non-reentrant lock, so waiting would deadlock —
+        return None instead and let the caller skip the snapshot."""
+        if not self._lock.acquire(blocking=blocking):
+            return None
+        try:
             return {"sums": dict(self.sums), "counts": dict(self.counts),
                     "counters": dict(self.counters),
                     "gauges": dict(self.gauges),
+                    "helps": dict(self.helps),
                     "hists": {k: h.snapshot()
                               for k, h in self.hists.items()}}
+        finally:
+            self._lock.release()
 
 
 _GLOBAL: Optional[Metrics] = None

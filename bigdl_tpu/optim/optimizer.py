@@ -21,6 +21,8 @@ import numpy as np
 from bigdl_tpu.data import pipeline as pipeline_mod
 from bigdl_tpu.data.dataset import DataSet
 from bigdl_tpu.data.prefetch import thread_prefetch
+from bigdl_tpu.obs import attr as obs_attr
+from bigdl_tpu.obs import cost as obs_cost
 from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.optim import checkpoint as ckpt
 from bigdl_tpu.optim.metrics import Metrics, SummaryWriter, Timer
@@ -190,6 +192,19 @@ class Optimizer:
         self._pending_losses: List = []  # [(first_step, loss_vec, gnorm_vec)]
         self._last_dispatch_end: Optional[float] = None
         self._inflight = 0
+        # perf attribution (docs/observability.md §Step-time attribution):
+        # per-window wall-time decomposition + live MFU/collective-bytes
+        # accounting, resolved per optimize() run
+        self.attribution: Optional[obs_attr.StepAttribution] = None
+        self._attr_t0: Optional[float] = None
+        self._attr_prev_it = 0
+        self._attr_dispatch = 0.0
+        self._attr_overhead = 0.0
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+        self._ici_bytes_step = 0.0
+        self._dcn_bytes_step = 0.0
+        self._recompile: Optional[obs_attr.RecompileSentinel] = None
 
     # ---- builder API (reference names, snake_case) -----------------------
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -335,6 +350,7 @@ class Optimizer:
         # fused multi-step execution: per-step PRNG derives on device from
         # the step counter (no host PRNGKey/fold_in per step, even at K=1)
         step_engine.set_step_seed(self.seed + 1)
+        self._arm_perf_accounting(engine, step_engine, init_vars, init_args)
         spc = self.steps_per_call
         if spc is None:
             spc = getattr(engine.config, "steps_per_call", 1) or 1
@@ -378,6 +394,9 @@ class Optimizer:
         try:
             return self._optimize_loop(step_engine, state)
         finally:
+            if self._recompile is not None:
+                # a later run's warmup compiles must not be flagged
+                self._recompile.mark_warmup()
             if self._profiler is not None:
                 self._profiler.close()
             if old_handlers:
@@ -386,12 +405,50 @@ class Optimizer:
                 for s, h in old_handlers:
                     _signal.signal(s, h)
 
+    def _arm_perf_accounting(self, engine, step_engine, init_vars,
+                             init_args) -> None:
+        """Resolve the run's performance-attribution state: the analytic
+        FLOPs/step (live MFU numerator), the device peak (denominator),
+        the collective-bytes ledger, the attribution accumulator, and the
+        recompilation sentinel.  Best-effort — a cost-model failure
+        degrades observability, never training."""
+        self.attribution = obs_attr.StepAttribution(self.metrics)
+        self._attr_t0 = None
+        self._attr_dispatch = 0.0
+        self._attr_overhead = 0.0
+        self._recompile = obs_attr.recompile_sentinel()
+        self._recompile.mark_warmup()
+        self._flops_per_step = None
+        try:
+            # shape-capturing walk under eval_shape: no compute, no
+            # compile; FLOPs scale linearly from the batch-1 sample to the
+            # global batch (the _per_host_batch contract: batch_size IS
+            # the global batch)
+            self._flops_per_step = obs_cost.train_step_flops(
+                self.model, init_vars, init_args, self.batch_size)
+            self.metrics.gauge("train.flops_per_step", self._flops_per_step)
+        except Exception as e:  # pragma: no cover — exotic custom modules
+            log.debug("analytic cost model unavailable (%s); no live MFU "
+                      "gauge this run", e)
+        self._peak_flops = obs_cost.peak_flops(
+            jax.devices()[0].device_kind,
+            getattr(engine.config, "peak_flops", None))
+        led = obs_cost.collective_ledger(step_engine)
+        self._ici_bytes_step = led["ici_bytes_per_step"]
+        self._dcn_bytes_step = led["dcn_bytes_per_step"]
+        self.metrics.gauge("train.collective_ici_bytes_per_step",
+                           self._ici_bytes_step)
+        self.metrics.gauge("train.collective_dcn_bytes_per_step",
+                           self._dcn_bytes_step)
+
     def _optimize_loop(self, step_engine, state) -> TrainedModel:
         engine = Engine.get()
         retries = 0
         retries_by_cause: Dict[Any, int] = {}
         max_retries = engine.config.failure_retry_times
         t_loop = time.perf_counter()
+        self._attr_t0 = t_loop
+        self._attr_prev_it = state["iteration"]
         while not self.end_when(state):
             if self._preempted:
                 # signal landed during epoch-boundary work (validation,
@@ -428,13 +485,14 @@ class Optimizer:
                         self._log_progress(state, t_loop)
                     t_trig = time.perf_counter()
                     self._fire_triggers(step_engine, state)
+                    trig_dt = time.perf_counter() - t_trig
+                    # attribution: trigger work is the "overhead" component
+                    self._attr_overhead += trig_dt
                     # trigger work (validation/checkpoint/histograms) is not
                     # step time: shift the log window start past it
                     if getattr(self, "_last_log", None) is not None:
-                        self._last_log = (
-                            self._last_log[0]
-                            + (time.perf_counter() - t_trig),
-                            self._last_log[1])
+                        self._last_log = (self._last_log[0] + trig_dt,
+                                          self._last_log[1])
                     if self._preempted:
                         log.warning(
                             "preemption signal received: checkpointing at "
@@ -504,7 +562,21 @@ class Optimizer:
                 self.metrics.inc("time_lost_to_recovery_s",
                                  time.perf_counter() - t_fail)
                 self._last_log = None  # don't count recovery in step time
+                # recovery is not attributable step time either: restart
+                # the attribution window at the resumed iteration, and
+                # clear the per-window timers (data_time et al.) with it —
+                # pre-failure data waits in a post-recovery window would
+                # over-attribute input time against the restarted wall
+                self.metrics.reset()
+                self._attr_t0 = time.perf_counter()
+                self._attr_prev_it = state["iteration"]
+                self._attr_dispatch = 0.0
+                self._attr_overhead = 0.0
 
+        if self._recompile is not None:
+            # the step loop is over: run-tail work (final checkpoint,
+            # get_variables' unravel ops) compiles legitimately
+            self._recompile.mark_warmup()
         try:
             self._ckpt_drain()
         except Exception as e:
@@ -518,6 +590,10 @@ class Optimizer:
                 log.error("synchronous checkpoint retry also failed: %s", e2)
         variables = step_engine.get_variables()
         self._final_state = dict(state)  # observability: final step/epoch
+        if self.attribution is not None and self.attribution.steps:
+            # the end-of-run "where did the time go" table; also available
+            # programmatically via Optimizer.attribution.report()
+            log.info("%s", self.attribution.table())
         return TrainedModel(self.model, variables, step_engine)
 
     @property
@@ -650,11 +726,22 @@ class Optimizer:
                 t0 = time.perf_counter()
                 losses, gnorms = step_engine.train_bundle_device(
                     it0, xs, ys)
+                disp_dt = time.perf_counter() - t0
                 # per-step normalized so the mean stays comparable
                 # across bundle sizes (the auto-K pick reads it)
-                self.metrics.add("step_dispatch",
-                                 (time.perf_counter() - t0) / k)
+                self.metrics.add("step_dispatch", disp_dt / k)
+                self._attr_dispatch += disp_dt
         self._last_dispatch_end = time.perf_counter()
+        if self._recompile is not None:
+            self._recompile.note_step(it0 + k)
+        # collective-bytes ledger: every dispatched step moves the same
+        # sync traffic (the layout is static for the run)
+        if self._ici_bytes_step:
+            self.metrics.inc("train.collective_ici_bytes_total",
+                             self._ici_bytes_step * k)
+        if self._dcn_bytes_step:
+            self.metrics.inc("train.collective_dcn_bytes_total",
+                             self._dcn_bytes_step * k)
         self._pending_losses.append((it0, losses, gnorms))
         self._inflight += k
         self.metrics.gauge("train.steps_in_flight", self._inflight)
@@ -702,7 +789,8 @@ class Optimizer:
                     self.watchdog.observe_loss(it0 + j, float(lv[j]))
         now = time.perf_counter()
         last = getattr(self, "_last_log", None)
-        if last is not None and it > last[1]:
+        dt_is_wall = last is not None and it > last[1]
+        if dt_is_wall:
             dt = (now - last[0]) / (it - last[1])
         else:  # first window: includes compile; dispatch mean is the best proxy
             dt = self.metrics.mean("step_dispatch")
@@ -713,9 +801,10 @@ class Optimizer:
         # true per-step time would require blocking every dispatch
         if dt > 0:
             self.metrics.observe("train.step_time_s", dt)
-        if (self._bundle_auto and not self._bundle_picked
-                and last is not None and it > last[1] and dt > 0):
+        if self._bundle_auto and not self._bundle_picked \
+                and dt_is_wall and dt > 0:
             self._pick_bundle_size(dt)
+        self._account_window(it, now, dt, dt_is_wall)
         self.metrics.reset()  # rolling window: throughput reflects recent steps
         lr = float(np.asarray(self.optim_method.get_learning_rate(it - 1)))
         throughput = self.batch_size / max(dt, 1e-9)
@@ -725,6 +814,56 @@ class Optimizer:
         if self._train_summary:
             self._train_summary.add_scalar("lr", lr, it)
             self._train_summary.add_scalar("throughput", throughput, it)
+
+    def _account_window(self, it: int, now: float, dt: float,
+                        dt_is_wall: bool) -> None:
+        """Close one attribution window at a log point: decompose the
+        window's wall time into data/dispatch/device/overhead, export the
+        live MFU gauge, and (multi-process) the straggler-skew gauges.
+        Reads the per-window timers BEFORE the caller's metrics.reset().
+        ``dt_is_wall=False`` marks the dispatch-mean proxy windows (first
+        window, first after recovery): a proxy dt is ~1000x the true wall
+        off on real hardware, so MFU/straggler gauges skip those — the
+        warmup is symmetric across hosts, so the allgather stays matched."""
+        steps_w = it - self._attr_prev_it
+        t0 = self._attr_t0
+        if steps_w > 0 and t0 is not None and self.attribution is not None:
+            self.attribution.window(
+                steps_w, now - t0,
+                data_s=self.metrics.total("data_time"),
+                dispatch_s=self._attr_dispatch,
+                overhead_s=self._attr_overhead)
+        self._attr_t0 = now
+        self._attr_prev_it = it
+        self._attr_dispatch = 0.0
+        self._attr_overhead = 0.0
+        if self._recompile is not None and self.attribution is not None \
+                and self.attribution.windows >= 2 \
+                and not self._recompile.steady:
+            # warmup is over after TWO full windows: the first holds the
+            # train-program compile, the second flushes the log-point's
+            # own eager-op compiles (LR schedule math, summary plumbing).
+            # New bundle-size/eval programs announce themselves via
+            # expected_compile in the step engine, so from here anything
+            # else is a mid-run cache miss
+            self._recompile.mark_steady(it)
+        if dt_is_wall and dt > 0 and self._flops_per_step:
+            achieved = self._flops_per_step / dt / jax.device_count()
+            self.metrics.gauge("train.achieved_flops_per_chip", achieved)
+            m = obs_cost.mfu(self._flops_per_step, dt, jax.device_count(),
+                             self._peak_flops)
+            if m is not None:
+                self.metrics.gauge("train.mfu", m)
+        if dt_is_wall and dt > 0 and jax.process_count() > 1:
+            try:
+                stats = obs_attr.host_step_time_stats(dt)
+            except Exception as e:  # pragma: no cover — backend quirks
+                log.debug("straggler allgather failed: %s", e)
+                stats = None
+            if stats:
+                self.metrics.gauge("train.step_time_max_s", stats["max"])
+                self.metrics.gauge("train.step_time_min_s", stats["min"])
+                self.metrics.gauge("train.step_time_skew_s", stats["skew"])
 
     def _pick_bundle_size(self, step_time_s: float) -> None:
         """``steps_per_call="auto"``: after the first full log window

@@ -23,6 +23,7 @@ Weight Update in Data-Parallel Training" for why this is the native XLA form.
 """
 
 import functools
+from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -32,6 +33,7 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.obs.attr import expected_compile
 from bigdl_tpu.optim.validation import StatsAccumulator
 from bigdl_tpu.runtime.mesh import (AXIS_DATA, AXIS_DCN, AXIS_SEQ,
                                     axis_size, shard_map)
@@ -583,7 +585,11 @@ class ShardedParameterStep:
     def collective_bytes_per_step(self) -> int:
         """Per-step ICI traffic of the ZeRO-1 cycle: psum_scatter of the
         flat gradient (f32, or bf16 with ``bf16_grads``) + all_gather of
-        the updated flat f32 params."""
+        the updated flat f32 params.  Zero on a single-device axis — a
+        size-1 psum_scatter/all_gather moves no bytes (matches
+        ``gspmd.collective_bytes_for_specs`` for the same topology)."""
+        if self.ndev <= 1:
+            return 0
         grad_bytes = self.n_pad * (2 if self.bf16_grads else 4)
         return grad_bytes + self.n_pad * 4
 
@@ -672,18 +678,23 @@ class ShardedParameterStep:
             key = (k, tuple(jnp.ndim(a) for a in
                             jax.tree_util.tree_leaves((xs[0], ys[0]))))
         fn = self._bundle_cache.get(key)
-        if fn is None:
+        new_program = fn is None
+        if new_program:
             fn = self._bundle_cache[key] = self._build_bundle(
                 k, xs[0], ys[0])
         ema_in = self.ema_flat if self.ema_flat is not None \
             else self._ema_dummy
         mask_in = (self._mask_flat if self._mask_flat is not None
                    else jnp.asarray(1.0, jnp.float32))
-        (self.flat_params, new_ema, self.opt_state, self.model_state,
-         losses, gnorms) = fn(
-            self.flat_params, ema_in, self.opt_state, self.model_state,
-            jnp.asarray(step0, jnp.int32), base_key,
-            tuple(xs), tuple(ys), mask_in)
+        # a first-seen bundle size (epoch-tail remainder, trigger-clamped
+        # span) legitimately compiles mid-run: announce it so the
+        # recompilation sentinel only flags true cache misses
+        with expected_compile() if new_program else nullcontext():
+            (self.flat_params, new_ema, self.opt_state, self.model_state,
+             losses, gnorms) = fn(
+                self.flat_params, ema_in, self.opt_state, self.model_state,
+                jnp.asarray(step0, jnp.int32), base_key,
+                tuple(xs), tuple(ys), mask_in)
         if self.ema_flat is not None:
             self.ema_flat = new_ema
         else:
@@ -706,15 +717,19 @@ class ShardedParameterStep:
             ranks = tuple(np.ndim(a) for a in
                           jax.tree_util.tree_leaves((x, mb["target"], w)))
             key = (tuple(id(m) for m in methods), ranks)
-            if key not in self._eval_cache:
+            new_program = key not in self._eval_cache
+            if new_program:
                 # built on the first batch: seq_parallel specs need ranks
                 self._eval_cache[key] = (tuple(methods), self._build_eval(
                     tuple(methods), x, mb["target"], w))
             _, fn = self._eval_cache[key]
-            acc.add(fn(self.flat_params, self.model_state,
-                       self.shard_batch(x),
-                       self.shard_batch(mb["target"]),
-                       self.shard_batch(w)))
+            # a first validation pass mid-run compiles its eval program —
+            # expected, not an XLA cache miss
+            with expected_compile() if new_program else nullcontext():
+                acc.add(fn(self.flat_params, self.model_state,
+                           self.shard_batch(x),
+                           self.shard_batch(mb["target"]),
+                           self.shard_batch(w)))
         totals = acc.fetch()
         return [m.fold(s, c) for m, (s, c) in zip(methods, totals or [])]
 

@@ -54,6 +54,13 @@ class EngineConfig:
     profile_dir: Optional[str] = None
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
+    # per-chip peak FLOP/s pin for the live train.mfu gauge
+    # (docs/performance.md): needed when device_kind is missing from the
+    # obs.cost table (new hardware, CPU test meshes).
+    # BIGDL_TPU_PEAK_FLOPS overrides fleet-wide — resolved at call time
+    # by obs.cost.peak_flops(), the env var's single owner, so it is NOT
+    # parsed into this field by from_env().
+    peak_flops: Optional[float] = None
     # input pipeline (docs/data.md): decode-worker pool width for the
     # streaming batch path; None = one per host core (capped in the
     # adapters).  BIGDL_TPU_DATA_WORKERS overrides fleet-wide.

@@ -166,6 +166,16 @@ class ServingServer:
                       "fallback_batches": 0, "shed_requests": 0,
                       "expired_requests": 0, "drained_requests": 0,
                       "dropped_requests": 0, "results_gc": 0}
+        # /metrics HELP lines for the lifecycle counters a fleet alerts on
+        # (obs.export renders describe() strings next to # TYPE)
+        self.metrics.describe("serving.shed_requests",
+                              "requests rejected at admission "
+                              "(backpressure/degraded/draining)")
+        self.metrics.describe("serving.expired_requests",
+                              "requests dropped before predict: deadline "
+                              "already expired")
+        self.metrics.describe("serving.predict_s",
+                              "model predict wall time per batch")
 
     def _count(self, name: str, n: int = 1) -> None:
         # client threads and the engine thread both count; += on a dict

@@ -64,6 +64,29 @@ def test_log_histogram_percentiles_bounded_error():
     assert h.percentile(100) == pytest.approx(h.max)
 
 
+def test_log_histogram_empty_percentile_is_nan():
+    """No data must be distinguishable from a 0.0s latency: every
+    percentile of an empty histogram is NaN, not 0 and not a bucket
+    bound."""
+    h = LogHistogram()
+    for q in (0, 50, 99, 100):
+        assert np.isnan(h.percentile(q)), q
+    assert all(np.isnan(v) for v in h.quantiles().values())
+    # snapshot of an empty histogram still renders (min/max report 0)
+    snap = h.snapshot()
+    assert snap["n"] == 0 and snap["min"] == 0.0 and snap["max"] == 0.0
+
+
+def test_log_histogram_single_observation():
+    """One sample: every percentile reports that sample (its bucket's
+    upper bound clamps to the observed max == the sample)."""
+    h = LogHistogram()
+    h.observe(0.037)
+    for q in (1, 50, 99, 100):
+        assert h.percentile(q) == pytest.approx(0.037), q
+    assert h.quantiles()["p50"] == pytest.approx(0.037)
+
+
 def test_log_histogram_overflow_and_bad_samples():
     h = LogHistogram(base=1e-4, growth=2.0, n_buckets=4)
     h.observe(1e9)      # beyond the last bound: overflow bucket
@@ -150,7 +173,8 @@ def test_sanitize_metric_name():
 
 
 _LINE = re.compile(
-    r"^(?:# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:counter|summary|histogram)"
+    r"^(?:# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:counter|gauge|summary|histogram)"
+    r"|# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S.*"
     r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{le=\"[^\"]+\"\})? "
     r"(?:[0-9.eE+-]+|\+Inf|NaN))$")
 
@@ -177,6 +201,101 @@ def test_render_prometheus_text_format_parses():
     assert counts == sorted(counts), "bucket counts must be cumulative"
     assert buckets[-1][0] == "+Inf" and counts[-1] == 4
     assert "serving_latency_s_count 4" in text
+
+
+def test_render_prometheus_help_lines_and_type_once():
+    """# HELP rides next to # TYPE (describe() strings win over the
+    framework catalog), and a family header is emitted at most once per
+    scrape even when two dotted names sanitize to the same family."""
+    m = Metrics()
+    m.inc("obs_help.requests_total", 1)
+    m.describe("obs_help.requests_total", "requests seen by the test")
+    for v in (0.001, 0.4):
+        m.observe("serving.latency_s", v)  # catalog help, no describe()
+    # two names that collide after sanitization: the family header must
+    # not be re-declared for the second one
+    m.gauge("collide.name", 1.0)
+    m.gauge("collide_name", 2.0)
+    text = render_prometheus(m)
+    assert "# HELP obs_help_requests_total requests seen by the test" \
+        in text
+    assert "# HELP serving_latency_s " in text
+    help_then_type = text.index("# HELP obs_help_requests_total")
+    assert text.index("# TYPE obs_help_requests_total counter") \
+        > help_then_type
+    assert text.count("# TYPE collide_name gauge") == 1
+    # ... and the losing name's SAMPLE is dropped too: two series with
+    # identical name+labels would fail the whole scrape at a real
+    # Prometheus, which is worse than losing the shadowed series
+    samples = [l for l in text.splitlines()
+               if l.startswith("collide_name ")]
+    assert samples == ["collide_name 1.0"]
+    # every line still parses
+    for line in text.strip().split("\n"):
+        assert _LINE.match(line), f"unparseable exposition line: {line!r}"
+
+
+def test_render_prometheus_new_perf_gauge_lines_parse():
+    """The attribution/MFU/collective families render as valid exposition
+    a Prometheus scraper accepts."""
+    m = Metrics()
+    m.gauge("train.mfu", 0.187)
+    m.gauge("train.flops_per_step", 3.2e12)
+    m.gauge("train.collective_ici_bytes_per_step", 204e6)
+    m.inc("train.collective_ici_bytes_total", 204e6 * 10)
+    for v in (0.01, 0.02):
+        m.observe("train.attr.device_s", v)
+    text = render_prometheus(m)
+    for line in text.strip().split("\n"):
+        assert _LINE.match(line), f"unparseable exposition line: {line!r}"
+    assert "# TYPE train_mfu gauge" in text
+    assert re.search(r"^train_mfu 0\.187$", text, re.M)
+    assert "# HELP train_mfu " in text
+    assert "# TYPE train_attr_device_s histogram" in text
+    assert 'train_attr_device_s_bucket{le="+Inf"} 2' in text
+    assert re.search(r"^train_collective_ici_bytes_total 2040000000\.0$",
+                     text, re.M)
+
+
+def test_metrics_server_concurrent_scrape_with_mutation():
+    """Scrapes race registry mutation: every scrape must parse (snapshot
+    consistency under the lock) and a counter must never move backwards
+    between successive scrapes."""
+    m = Metrics()
+    srv = MetricsServer(m).start()
+    stop = threading.Event()
+    errors = []
+
+    def mutate(i):
+        n = 0
+        while not stop.is_set():
+            m.inc("scrape_race.counter_total")
+            m.gauge(f"scrape_race.gauge_{i}", n)
+            m.observe("scrape_race.hist_s", 0.001 * (n % 7 + 1))
+            m.add("scrape_race.timer", 0.001)
+            n += 1
+
+    threads = [threading.Thread(target=mutate, args=(i,)) for i in range(3)]
+    [t.start() for t in threads]
+    try:
+        last = -1.0
+        for _ in range(20):
+            with urlreq.urlopen(srv.url, timeout=10) as resp:
+                text = resp.read().decode()
+            for line in text.strip().split("\n"):
+                assert _LINE.match(line), \
+                    f"unparseable line under mutation: {line!r}"
+            got = re.search(r"^scrape_race_counter_total ([0-9.eE+]+)$",
+                            text, re.M)
+            if got:
+                v = float(got.group(1))
+                assert v >= last, "counter moved backwards between scrapes"
+                last = v
+        assert last > 0, "mutators never landed a counter"
+    finally:
+        stop.set()
+        [t.join(10) for t in threads]
+        srv.stop()
 
 
 def test_metrics_server_scrape():
@@ -251,7 +370,25 @@ def test_flight_recorder_ring_is_bounded(tmp_path):
     lines = [json.loads(x) for x in open(path)]
     assert lines[0]["kind"] == "flight_dump"
     assert lines[0]["events"] == 8 and lines[0]["events_total"] == 20
-    assert [x["i"] for x in lines[1:]] == list(range(12, 20))
+    evts = [x for x in lines if x["kind"] == "evt"]
+    assert [x["i"] for x in evts] == list(range(12, 20))
+
+
+def test_flight_dump_carries_metrics_snapshot(tmp_path):
+    """The dump includes final metric state (counters + gauges), so a
+    post-mortem shows how far the job got — not just the event ring."""
+    global_metrics().inc("obs_test.flight_counter_total", 7)
+    global_metrics().gauge("obs_test.flight_gauge", 3.5)
+    rec = FlightRecorder(capacity=4)
+    rec.record("evt", i=1)
+    path = rec.dump(str(tmp_path / "fl2.jsonl"))
+    lines = [json.loads(x) for x in open(path)]
+    snap = next(x for x in lines if x["kind"] == "metrics_snapshot")
+    assert snap["counters"]["obs_test.flight_counter_total"] >= 7
+    assert snap["gauges"]["obs_test.flight_gauge"] == 3.5
+    # snapshot rides between the header and the event ring
+    assert lines[0]["kind"] == "flight_dump"
+    assert [x for x in lines if x["kind"] == "evt"]
 
 
 def test_flight_recorder_signal_dump(tmp_path):
