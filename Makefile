@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-serving test-obs test-data test-bundle bench bench-dispatch bench-watch dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-obs test-data test-bundle bench bench-dispatch bench-watch dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -35,6 +35,16 @@ test-core:
 # supervisor resume, elastic resume, GC-never-deletes-last-valid
 test-resilience:
 	python -m pytest tests/test_resilience.py tests/test_ckpt_sharded.py -q
+
+# pod-scale coordinated fault tolerance (docs/resilience.md §Multi-host
+# recovery): membership views + leader failover, partition heal, gang
+# abort/rendezvous, peer-shard restore parity vs checkpoint restore,
+# preemption propagation + SIGTERM step-exact resume, elastic re-sharded
+# mid-epoch resume, checkpoint mirror retry.  The true 2-process
+# kill/rejoin drill is a `slow` mark (add -m 'slow or not slow' locally)
+test-cluster:
+	python -m pytest tests/test_cluster.py tests/test_resume_exact.py -q \
+	  -m "not slow"
 
 # the serving suite (docs/serving.md): engine + frontend + pool, including
 # the request-lifecycle chaos tests (worker kill, deadline expiry,

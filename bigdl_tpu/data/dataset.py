@@ -85,6 +85,18 @@ def _per_host_batch(batch_size: int, process_count: int) -> int:
     return batch_size // process_count
 
 
+def _epoch_permutation(n: int, shuffle: bool, seed: int,
+                       epoch: int) -> np.ndarray:
+    """The shared global permutation of one (seed, epoch) — every host
+    derives the same one, which is what makes both the normal stride plan
+    and the elastic re-shard plan reconstructible without communication."""
+    idx = np.arange(n)
+    if shuffle:
+        rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2 ** 31))
+        rng.shuffle(idx)
+    return idx
+
+
 def batch_index_plan(n: int, batch_size: int, *, shuffle=True, seed=0,
                      epoch=0, drop_last=True, process_id=0, process_count=1):
     """Yield ``(sel, n_real)`` index batches with the framework's sharding
@@ -93,14 +105,52 @@ def batch_index_plan(n: int, batch_size: int, *, shuffle=True, seed=0,
     (so every process dispatches the same number of collective-bearing
     steps), short tails cyclic-padded to the static batch size with
     ``n_real`` marking how many rows are genuine."""
-    idx = np.arange(n)
-    if shuffle:
-        rng = np.random.RandomState((seed * 1_000_003 + epoch) % (2 ** 31))
-        rng.shuffle(idx)
+    idx = _epoch_permutation(n, shuffle, seed, epoch)
     local = idx[process_id::process_count]
     per_host = _per_host_batch(batch_size, process_count)
     min_local = n // process_count
     max_local = min_local + (1 if n % process_count else 0)
+    n_batches = (min_local // per_host if drop_last
+                 else math.ceil(max_local / per_host))
+    filler = local if len(local) else idx[:1]
+    for b in range(n_batches):
+        sel = local[b * per_host:(b + 1) * per_host]
+        n_real = len(sel)
+        if n_real < per_host:
+            sel = np.concatenate([sel, np.resize(filler, per_host - n_real)])
+        yield sel, n_real
+
+
+def resharded_batch_index_plan(n: int, batch_size: int, *,
+                               trained_batches: int,
+                               old_process_count: int, shuffle=True,
+                               seed=0, epoch=0, drop_last=True,
+                               process_id=0, process_count=1):
+    """The elastic mid-epoch resume plan (docs/distributed_training.md):
+    after a ``process_count`` change, finish the epoch on its REMAINING
+    examples instead of replaying it from the start.
+
+    The old plan's coverage is a pure function of (seed, epoch,
+    old_process_count): each old process trained the first
+    ``trained_batches * per_host_old`` entries of its stride slice of the
+    shared permutation.  Those permutation positions are excluded; the
+    remainder keeps permutation order and re-strides over the NEW process
+    set with the same global-batch contract (step count from global
+    sizes, cyclic-padded tails).  Every remaining example is yielded
+    exactly once across processes — shrink/grow loses nothing beyond the
+    sub-global-batch tail that ``drop_last`` always drops."""
+    idx = _epoch_permutation(n, shuffle, seed, epoch)
+    old_per_host = _per_host_batch(batch_size, old_process_count)
+    take = max(0, int(trained_batches)) * old_per_host
+    done = np.zeros(n, bool)  # over PERMUTATION POSITIONS
+    for p in range(old_process_count):
+        done[np.arange(p, n, old_process_count)[:take]] = True
+    remaining = idx[~done]
+    local = remaining[process_id::process_count]
+    per_host = _per_host_batch(batch_size, process_count)
+    n_rem = len(remaining)
+    min_local = n_rem // process_count
+    max_local = min_local + (1 if n_rem % process_count else 0)
     n_batches = (min_local // per_host if drop_last
                  else math.ceil(max_local / per_host))
     filler = local if len(local) else idx[:1]
@@ -148,12 +198,10 @@ class ArrayDataSet(DataSet):
         chain = fn if prev is None else (lambda x: fn(prev(x)))
         return ArrayDataSet(self.data, self.labels, chain)
 
-    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
-                drop_last=True, process_id=0, process_count=1):
-        for sel, n_real in batch_index_plan(
-                self.size(), batch_size, shuffle=shuffle, seed=seed,
-                epoch=epoch, drop_last=drop_last, process_id=process_id,
-                process_count=process_count):
+    def _emit(self, plan):
+        """Assemble MiniBatches from an index plan of ``(sel, n_real)``
+        pairs — shared by the normal and resharded epoch paths."""
+        for sel, n_real in plan:
             x = (tuple(a[sel] for a in self.data) if self.multi
                  else self.data[sel])
             if self.transform is not None:
@@ -167,6 +215,27 @@ class ArrayDataSet(DataSet):
                 w[:n_real] = 1.0
                 mb["weight"] = w
             yield mb
+
+    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                drop_last=True, process_id=0, process_count=1):
+        return self._emit(batch_index_plan(
+            self.size(), batch_size, shuffle=shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, process_id=process_id,
+            process_count=process_count))
+
+    def resharded_batches(self, batch_size, *, trained_batches,
+                          old_process_count, shuffle=True, seed=0, epoch=0,
+                          drop_last=True, process_id=0, process_count=1):
+        """Finish an epoch interrupted under a DIFFERENT process count:
+        batches over the epoch's remaining examples, re-strided over the
+        new process set (:func:`resharded_batch_index_plan`).  The driver
+        uses this for elastic mid-epoch resume; datasets without the
+        method fall back to replay-from-epoch-start."""
+        return self._emit(resharded_batch_index_plan(
+            self.size(), batch_size, trained_batches=trained_batches,
+            old_process_count=old_process_count, shuffle=shuffle,
+            seed=seed, epoch=epoch, drop_last=drop_last,
+            process_id=process_id, process_count=process_count))
 
     def steps_per_epoch(self, batch_size: int, process_count: int = 1,
                         drop_last: bool = True) -> int:

@@ -94,6 +94,17 @@ DEFAULT_HELP = {
     "train.step_time_max_s": "slowest host's window step time",
     "train.step_time_min_s": "fastest host's window step time",
     "serving.latency_s": "admission-to-publish latency per request",
+    # cluster control plane (docs/resilience.md §Multi-host recovery)
+    "cluster.view_epoch": "current membership view epoch",
+    "cluster.members": "live members in the current view",
+    "cluster.leader": "leader rank of the current view (lowest live)",
+    "cluster.mttr_s": "gang recovery wall time, detection to resumed",
+    "cluster.recoveries_total": "coordinated recoveries completed",
+    "cluster.recovery_bytes_total": "bytes restored across recoveries",
+    "cluster.publish_bytes_total": "peer-shard store bytes published",
+    "cluster.aborts_total": "gang abort flags posted by this process",
+    "cluster.preempt_notices_total": "cluster-wide preemption notices "
+                                     "posted or propagated",
 }
 
 

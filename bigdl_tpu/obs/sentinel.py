@@ -46,10 +46,16 @@ _ARTIFACT_GLOBS = (
     "BENCH_dispatch_r[0-9]*.json",
     "BENCH_loader_r[0-9]*.json",
     "SERVING_r[0-9]*.json",
+    # cluster recovery drills (docs/resilience.md §Multi-host recovery):
+    # MTTR and restore traffic gate like the latency families — a
+    # recovery that got 10% slower or 10% heavier is a regression
+    "CLUSTER_r[0-9]*.json",
 )
 
-# lower-is-better families (latencies); everything else is higher-better
-_LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms"})
+# lower-is-better families (latencies, recovery time/traffic);
+# everything else is higher-better
+_LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms",
+                           "cluster_mttr_s", "cluster_recovery_bytes"})
 
 
 @dataclass
@@ -118,6 +124,9 @@ def normalize(doc: Any, source: str) -> List[Row]:
         add("serving_throughput_rps", row["throughput_rps"])
         add("serving_p50_ms", row.get("p50_ms"), LOWER)
         add("serving_p99_ms", row.get("p99_ms"), LOWER)
+    if "mttr_s" in row:  # CLUSTER_r*.json recovery drills
+        add("cluster_mttr_s", row["mttr_s"], LOWER)
+        add("cluster_recovery_bytes", row.get("recovery_bytes"), LOWER)
     return out
 
 

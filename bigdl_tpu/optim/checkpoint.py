@@ -61,6 +61,21 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def jsonable_state(driver_state: Optional[Dict[str, Any]]
+                   ) -> Dict[str, Any]:
+    """The JSON-safe subset of a driver-state dict (scalars and nested
+    scalar dicts, e.g. ``schedule_state``) — what a manifest or a
+    peer-shard meta record may carry."""
+    def ok(v):
+        if isinstance(v, (int, float, str, bool)) or v is None:
+            return True
+        if isinstance(v, dict):
+            return all(ok(x) for x in v.values())
+        return False
+
+    return {k: v for k, v in (driver_state or {}).items() if ok(v)}
+
+
 def local_opt_shards(tree) -> Dict[str, np.ndarray]:
     """Flatten a (device-resident, possibly ZeRO-sharded) optimizer-state
     pytree into THIS process's contribution: for each 1-D sharded leaf,
@@ -113,7 +128,8 @@ def save_checkpoint(path: str, step: int, *, flat_params=None,
                     keep_last: int = 3, ema_flat=None,
                     opt_shards: Optional[Dict[str, np.ndarray]] = None,
                     shard_index: int = 0, shard_count: int = 1,
-                    barrier=None, attempt: Optional[str] = None) -> str:
+                    barrier=None, attempt: Optional[str] = None,
+                    mirror: Optional[str] = None) -> str:
     """Write checkpoint dir ``<path>/ckpt-<step>``; returns the dir.
 
     Default (``opt_shards=None``): process 0 writes everything (the
@@ -179,15 +195,8 @@ def save_checkpoint(path: str, step: int, *, flat_params=None,
             _savez("opt_state.npz", **_flatten_with_paths(opt_state))
         _savez("model_state.npz", **_flatten_with_paths(model_state))
 
-        def _jsonable(v):
-            if isinstance(v, (int, float, str, bool)) or v is None:
-                return True
-            if isinstance(v, dict):  # nested scalar dicts (e.g. schedule_state)
-                return all(_jsonable(x) for x in v.values())
-            return False
-
-        manifest = {"step": step, "driver_state": {
-            k: v for k, v in (driver_state or {}).items() if _jsonable(v)}}
+        manifest = {"step": step,
+                    "driver_state": jsonable_state(driver_state)}
         if sharded:
             manifest["opt_shards"] = shard_count
             if attempt is not None:
@@ -202,6 +211,29 @@ def save_checkpoint(path: str, step: int, *, flat_params=None,
                 shutil.rmtree(d)
             os.rename(tmp, d)
         _gc(path, keep_last)
+        if mirror:
+            # the off-cluster copy (docs/resilience.md): bounded
+            # retry-with-backoff per blob, manifest mirrored last.  Runs
+            # on the manifest writer only (shard_index!=0 returned above);
+            # in unbarriered async sharded mode a laggard shard may be
+            # missing from the mirror — harmless, because readers validate
+            # shard completeness and skip the mirrored dir until a later
+            # mirror completes it.  A mirror that fails even after retries
+            # degrades to a warning: the primary checkpoint is intact.
+            try:
+                n = storage.mirror_tree(
+                    d, storage.join(mirror, f"ckpt-{step}"))
+                log.info("checkpoint mirrored to %s (%d bytes)",
+                         storage.join(mirror, f"ckpt-{step}"), n)
+                # the mirror root is bounded like the primary — without
+                # this, a long frequent-checkpoint run accumulates every
+                # checkpoint ever taken in the remote bucket
+                _gc(mirror, keep_last)
+            except Exception as e:
+                log.warning(
+                    "checkpoint mirror to %r FAILED after retries (%s: "
+                    "%s); the primary checkpoint at %s is intact",
+                    mirror, type(e).__name__, e, d)
         log.info("checkpoint saved: %s", d)
         return d
 
@@ -271,20 +303,17 @@ def latest_checkpoint(path: str) -> Optional[str]:
     return storage.join(path, max(steps)[1])
 
 
-def _reassemble_opt_shards(ckpt_dir: str, n: int, template,
-                           attempt: Optional[str] = None
-                           ) -> Dict[str, np.ndarray]:
-    """Merge ``opt_state.shard*-of-*.npz`` back into full flat arrays.
-
-    Works for ANY current process count (resharding is free: sharded
-    leaves are flat slices placed at their recorded offsets).  Only the
-    manifest's ``attempt``-token files are read — stale shards from a
-    crashed earlier attempt at the same step are invisible."""
+def merge_flat_shards(shard_dicts, template) -> Dict[str, np.ndarray]:
+    """Merge per-process :func:`local_opt_shards` dicts back into full
+    flat arrays: offset-keyed slices land at their recorded positions,
+    replicated leaves pass through (any copy works).  Works for ANY
+    current process count — resharding a resumed job is free.  Shared by
+    the checkpoint reader and the cluster peer-shard store
+    (``resilience.cluster``), which transports the same shard format over
+    its control channel."""
     full: Dict[str, np.ndarray] = {}
     tpl_flat = _flatten_with_paths(template)
-    for i in range(n):
-        shard = storage.load_npz(storage.join(
-            ckpt_dir, _shard_name(i, n, attempt)))
+    for shard in shard_dicts:
         for key, arr in shard.items():
             if key.endswith("@offset"):
                 continue
@@ -298,6 +327,17 @@ def _reassemble_opt_shards(ckpt_dir: str, n: int, template,
             off = int(shard[off_key])
             full[key][off:off + len(arr)] = arr
     return full
+
+
+def _reassemble_opt_shards(ckpt_dir: str, n: int, template,
+                           attempt: Optional[str] = None
+                           ) -> Dict[str, np.ndarray]:
+    """Merge ``opt_state.shard*-of-*.npz`` back into full flat arrays.
+    Only the manifest's ``attempt``-token files are read — stale shards
+    from a crashed earlier attempt at the same step are invisible."""
+    return merge_flat_shards(
+        (storage.load_npz(storage.join(ckpt_dir, _shard_name(i, n, attempt)))
+         for i in range(n)), template)
 
 
 def load_checkpoint(ckpt_dir: str, *, opt_state_template, model_state_template

@@ -132,6 +132,7 @@ class Optimizer:
         self._ckpt_path: Optional[str] = None
         self._ckpt_trigger: Optional[Trigger] = None
         self._ckpt_sharded = "auto"
+        self._ckpt_mirror = None
         self._ckpt_async = None
         self._val_trigger: Optional[Trigger] = None
         self._val_dataset: Optional[DataSet] = None
@@ -173,6 +174,12 @@ class Optimizer:
         self.watchdog = None  # resilience.StepWatchdog (Supervisor installs
         #                       one; set directly for standalone NaN/hang
         #                       detection)
+        self.cluster = None  # resilience.ClusterCoordinator (the Supervisor
+        #                      installs one when FailurePolicy.cluster_dir is
+        #                      set; set_cluster attaches one directly).  The
+        #                      driver calls its bundle-edge hook, publishes
+        #                      peer-shard state at checkpoints, and prefers
+        #                      peer-shard restore in _try_resume
         self.failure_policy = None  # per-Optimizer FailurePolicy override
         #                             (Supervisor propagates its own here so
         #                             the in-run retry loop honors the same
@@ -223,7 +230,8 @@ class Optimizer:
 
     def set_checkpoint(self, path: str, trigger: Trigger,
                        async_write: bool = False,
-                       sharded="auto") -> "Optimizer":
+                       sharded="auto",
+                       mirror: Optional[str] = None) -> "Optimizer":
         """``path`` may be a local directory or a remote URI (``gs://…``
         via the optional fsspec+gcsfs — the reference's
         ``setCheckpoint(hdfs://…)`` analog); a preemptible TPU VM must
@@ -231,6 +239,12 @@ class Optimizer:
         host at the trigger and runs the npz serialization on a
         background thread (one in flight) — the cheap-frequent-checkpoint
         posture for preemptible slices.
+
+        ``mirror``: a second (typically remote) checkpoint root every
+        completed save is copied to with bounded retry-with-backoff
+        (``storage.mirror_tree``) — the off-cluster copy that survives
+        the whole pod being reclaimed.  Mirror failures degrade to a
+        warning after retries; the primary save already landed.
 
         ``sharded``: ``"auto"`` (default) writes the ZeRO-1 optimizer
         state as per-process shard files whenever the job is multi-host —
@@ -243,6 +257,7 @@ class Optimizer:
         self._ckpt_path = path
         self._ckpt_trigger = trigger
         self._ckpt_sharded = sharded
+        self._ckpt_mirror = mirror
         self._ckpt_async = (ckpt.AsyncCheckpointer() if async_write
                             else None)
         return self
@@ -296,6 +311,16 @@ class Optimizer:
         from bigdl_tpu.utils.profiling import IterationProfiler
 
         self._profiler = IterationProfiler(log_dir, start_iter, num_iters)
+        return self
+
+    def set_cluster(self, coordinator) -> "Optimizer":
+        """Attach a :class:`~bigdl_tpu.resilience.cluster.
+        ClusterCoordinator` (docs/resilience.md §Multi-host recovery):
+        membership/abort checks at every bundle edge, peer-shard
+        publishes alongside every checkpoint, and peer-shard-first
+        restore.  The Supervisor attaches one automatically when
+        ``FailurePolicy.cluster_dir`` is set."""
+        self.cluster = coordinator
         return self
 
     def set_preemption_checkpoint(self, *signals) -> "Optimizer":
@@ -453,6 +478,8 @@ class Optimizer:
             if self._preempted:
                 # signal landed during epoch-boundary work (validation,
                 # triggers) — still honour the save-before-stop contract
+                if self.cluster is not None:
+                    self.cluster.notify_preemption()
                 self._save_checkpoint_once(step_engine, state)
                 break
             state["epoch_finished"] = False
@@ -463,9 +490,18 @@ class Optimizer:
             # the epoch from batch 0.  The skip re-gathers (and discards)
             # at most one epoch of input once per resume — bounded, and
             # the batch plan is deterministic per (seed, epoch).
+            # An ELASTIC resume (process_count changed) arrives as a
+            # _resume_reshard marker instead: the epoch's remaining
+            # examples are re-sharded over the NEW process set
+            # (docs/distributed_training.md) — epoch_batch keeps counting
+            # GLOBAL steps, which are invariant across process counts.
             skip = int(state.pop("_resume_skip", 0) or 0)
-            state["epoch_batch"] = skip
-            batch_iter = self._epoch_batch_iter(step_engine, epoch, skip)
+            reshard = state.pop("_resume_reshard", None)
+            state["epoch_batch"] = (int(reshard["trained"])
+                                    + int(reshard.get("skip", 0) or 0)) \
+                if reshard else skip
+            batch_iter = self._epoch_batch_iter(step_engine, epoch, skip,
+                                                reshard=reshard)
             # observability: time each fetch out of the prefetch pipeline —
             # waiting HERE means the run is input-bound, not device-bound
             batch_iter = self._traced_data(batch_iter)
@@ -493,10 +529,26 @@ class Optimizer:
                     if getattr(self, "_last_log", None) is not None:
                         self._last_log = (self._last_log[0] + trig_dt,
                                           self._last_log[1])
+                    if self.cluster is not None \
+                            and self.cluster.preempt_pending \
+                            and not self._preempted:
+                        # a PEER host was preempted: the notice propagates
+                        # as our own preemption so the whole gang takes
+                        # the just-in-time checkpoint, not just the
+                        # signalled host
+                        log.warning(
+                            "cluster preemption notice received: treating "
+                            "as local preemption")
+                        self._preempted = True
                     if self._preempted:
                         log.warning(
                             "preemption signal received: checkpointing at "
                             "iteration %d and stopping", state["iteration"])
+                        if self.cluster is not None:
+                            # local SIGTERM → cluster-wide notice (the
+                            # handler itself must not touch storage from
+                            # signal context; this bundle edge may)
+                            self.cluster.notify_preemption()
                         self._save_checkpoint_once(step_engine, state)
                         break
                     if self.end_when(state):
@@ -509,10 +561,14 @@ class Optimizer:
                     # re-firing — those boundary triggers already ran
                     # before the crash, and a duplicate validation event
                     # would double-feed plateau schedules.
-                    if ran_any or skip == 0:
+                    if ran_any or skip == 0 or reshard is not None:
                         state["epoch_finished"] = True
                         self._fire_triggers(step_engine, state)
                     state["epoch"] += 1
+                    # a resharded epoch's plan dies with the epoch: later
+                    # epochs use the normal (seed, epoch, process_count)
+                    # plan, and later checkpoints must not carry the marker
+                    state.pop("reshard_origin", None)
             except Exception as e:  # driver retry loop (§6.3)
                 # A failed train_step may have consumed donated buffers, so
                 # recovery REQUIRES a checkpoint to restore from; the epoch
@@ -554,6 +610,13 @@ class Optimizer:
                               retry=retries, iteration=state["iteration"],
                               error=f"{type(e).__name__}: {e}")
                 time.sleep(delay)
+                if self.cluster is not None:
+                    # coordinated rewind: this process is about to restore
+                    # an earlier step, so the GANG must restore with it —
+                    # post the abort (peers exit their collectives at the
+                    # next bundle edge), rendezvous on the next view, and
+                    # only then resume together
+                    self.cluster.gang_recover(cause.value)
                 with trace.span("resilience/in_run_resume",
                                 cause=cause.value, retry=retries):
                     self._try_resume(step_engine, state)
@@ -561,6 +624,10 @@ class Optimizer:
                 self.metrics.inc(f"retries_by_cause.{cause.value}")
                 self.metrics.inc("time_lost_to_recovery_s",
                                  time.perf_counter() - t_fail)
+                if self.cluster is not None:
+                    # MTTR: failure catch → restored-and-ready wall time
+                    self.cluster.note_recovered(
+                        time.perf_counter() - t_fail)
                 self._last_log = None  # don't count recovery in step time
                 # recovery is not attributable step time either: restart
                 # the attribution window at the resumed iteration, and
@@ -604,17 +671,45 @@ class Optimizer:
         return self._final_state
 
     # ------------------------------------------------------------------
-    def _epoch_batch_iter(self, step_engine, epoch, skip):
+    def _epoch_batch_iter(self, step_engine, epoch, skip, reshard=None):
         """One epoch's device-ready batch iterator — the streaming input
         pipeline (docs/data.md) when the dataset supports it, the classic
         thread-prefetch path otherwise, both behind the device-dispatch
-        lookahead.  ``host_prefetch=0`` forces fully inline production."""
+        lookahead.  ``host_prefetch=0`` forces fully inline production.
+
+        ``reshard`` (an elastic mid-epoch resume marker from
+        ``_try_resume``: ``{"process_count": old, "trained": k, "skip":
+        extra}``) switches THIS epoch to the re-sharded remainder plan —
+        the examples the old process set already trained are excluded and
+        the rest re-stride over the new process set
+        (``DataSet.resharded_batches``); later epochs revert to the
+        normal plan."""
         from bigdl_tpu.data.pipeline import dispatch_to_device
 
         engine = Engine.get()
         kw = dict(shuffle=True, seed=self.seed, epoch=epoch,
                   process_id=jax.process_index(),
                   process_count=jax.process_count())
+        if reshard is not None:
+            # not streamed: the remainder plan is a one-epoch special case
+            # and the in-RAM index path costs nothing extra
+            batch_iter = self.dataset.resharded_batches(
+                self.batch_size, trained_batches=int(reshard["trained"]),
+                old_process_count=int(reshard["process_count"]), **kw)
+            skip = int(reshard.get("skip", 0) or 0)
+            if skip:
+                import itertools
+
+                batch_iter = itertools.islice(batch_iter, skip, None)
+            if self.host_prefetch:
+                batch_iter = thread_prefetch(batch_iter,
+                                             depth=self.host_prefetch)
+            return dispatch_to_device(
+                batch_iter,
+                lambda mb: (step_engine.shard_batch(mb["input"]),
+                            step_engine.shard_batch(
+                                np.asarray(mb["target"]))),
+                size=self.prefetch)
         stream = (self.streaming and self.host_prefetch > 0
                   and hasattr(self.dataset, "stream_batches"))
         if stream:
@@ -686,6 +781,13 @@ class Optimizer:
         k = self._bundle_k
         if k <= 1:
             return 1
+        if self._preempted or (self.cluster is not None
+                               and self.cluster.preempt_pending):
+            # a preemption is pending: the signal can only be honoured at
+            # a bundle edge, so the NEXT bundle shrinks to one step and
+            # the just-in-time checkpoint lands ~1 step after the signal
+            # instead of up to K steps later
+            return 1
         span = k - state.get("epoch_batch", 0) % k
         it = state["iteration"]
         for t in (self.end_when, self._val_trigger, self._ckpt_trigger,
@@ -712,6 +814,11 @@ class Optimizer:
             self.metrics.observe("train.dispatch_gap_s",
                                  now - self._last_dispatch_end)
         with trace.span("train/bundle", step=it0, size=k):
+            if self.cluster is not None:
+                # cluster hazards first (peer abort flags, propagated
+                # preemption notices, injected host loss) — a gang-level
+                # condition must win over a local per-step fault
+                self.cluster.on_step(it0, k)
             faults.fire_bundle(it0, k)  # slow_host / process_kill /
             #                             step_fail per step in the range
             if self.watchdog is not None:
@@ -914,6 +1021,12 @@ class Optimizer:
     def _save_checkpoint_once(self, step_engine, state):
         """Checkpoint unless this iteration was already checkpointed (the
         trigger may have fired just before a preemption break)."""
+        if self._ckpt_path is None:
+            # a cluster-propagated preemption can reach a run that never
+            # called set_checkpoint; stopping cleanly is all it can do
+            log.warning("preemption stop without set_checkpoint: no "
+                        "just-in-time checkpoint to take")
+            return
         if self._last_ckpt_iter != state["iteration"]:
             self._last_ckpt_iter = state["iteration"]
             self._save_checkpoint(step_engine, state)
@@ -935,6 +1048,17 @@ class Optimizer:
                                     state["iteration"], **kw)
         else:
             ckpt.save_checkpoint(self._ckpt_path, state["iteration"], **kw)
+        if self.cluster is not None:
+            # peer-shard publish rides the checkpoint trigger: each host
+            # pushes its ZeRO-1 shard (leader adds the replicated params)
+            # onto the control channel, so a rejoining process can restore
+            # from its buddies without touching the checkpoint bucket.
+            # Best-effort — a failed publish degrades the recovery ladder
+            # (checkpoint rung still holds), never training
+            try:
+                self.cluster.publish_state(step_engine, state)
+            except Exception as e:
+                log.warning("peer-shard publish failed: %s", e)
 
     def _ckpt_kwargs(self, step_engine, state, sync_barrier: bool):
         """The save_checkpoint argument set: gathered single-writer by
@@ -952,6 +1076,8 @@ class Optimizer:
         state["process_count"] = jax.process_count()
         kw = dict(model_state=host_fetch(step_engine.model_state),
                   driver_state=state)
+        if self._ckpt_mirror:
+            kw["mirror"] = self._ckpt_mirror
         sharded = self._ckpt_use_shards(step_engine)
         # params/EMA are replicated: in sharded mode only process 0's copy
         # is ever written, so the other (n-1) hosts skip the full-model
@@ -1052,13 +1178,66 @@ class Optimizer:
                     step_engine._train = step_engine._build_train()
 
     def _try_resume(self, step_engine, state):
-        latest = ckpt.latest_checkpoint(self._ckpt_path)
-        if latest is None:
-            return
-        flat, opt_state, model_state, driver, ema = ckpt.load_checkpoint(
-            latest,
-            opt_state_template=step_engine.opt_template,
-            model_state_template=step_engine.model_state_template)
+        """Restore device + driver state from the best available source —
+        the recovery LADDER (docs/resilience.md §Multi-host recovery):
+
+        1. **peer-shard store** (cluster attached, complete step at least
+           as new as the newest checkpoint): replicated params + the
+           ZeRO-1 optimizer shards the peers published on the control
+           channel — bit-identical to a checkpoint restore of the same
+           step, without touching the checkpoint bucket;
+        2. **newest shard-complete checkpoint**;
+        3. elastic tail: a ``process_count`` change mid-epoch re-shards
+           the epoch's remaining examples over the new process set
+           (``DataSet.resharded_batches``), falling back to
+           replay-from-epoch-start only when the dataset cannot reshard
+           or the process set changed twice in one epoch."""
+        from bigdl_tpu.utils import storage as _storage
+
+        latest = ckpt.latest_checkpoint(self._ckpt_path) \
+            if self._ckpt_path else None
+        ckpt_step = None
+        if latest is not None:
+            try:
+                ckpt_step = int(_storage.basename(latest).split("-")[1])
+            except (ValueError, IndexError):
+                ckpt_step = None
+        loaded = path_used = None
+        if self.cluster is not None:
+            peer_step = self.cluster.store.latest_complete_step()
+            if peer_step is not None and (ckpt_step is None
+                                          or peer_step >= ckpt_step):
+                try:
+                    loaded = self.cluster.load_peer_state(
+                        peer_step, step_engine.opt_template,
+                        step_engine.model_state_template)
+                    path_used = "peer_shard"
+                except Exception as e:
+                    log.warning(
+                        "peer-shard restore of step %d failed (%s: %s); "
+                        "falling back to the checkpoint rung", peer_step,
+                        type(e).__name__, e)
+        if loaded is None:
+            if latest is None:
+                return
+            loaded = ckpt.load_checkpoint(
+                latest,
+                opt_state_template=step_engine.opt_template,
+                model_state_template=step_engine.model_state_template)
+            path_used = "checkpoint"
+        flat, opt_state, model_state, driver, ema = loaded
+        if self.cluster is not None:
+            n_bytes = int(
+                np.asarray(flat).nbytes
+                + sum(np.asarray(a).nbytes for a in
+                      jax.tree_util.tree_leaves(opt_state))
+                + sum(np.asarray(a).nbytes for a in
+                      jax.tree_util.tree_leaves(model_state)))
+            self.metrics.inc(f"cluster.recovery_by_path.{path_used}")
+            self.metrics.inc("cluster.recovery_bytes_total", n_bytes)
+            flight.record("cluster_restore", path=path_used,
+                          step=int(driver.get("iteration", 0) or 0),
+                          bytes=n_bytes)
         step_engine.flat_params = put_sharded(
             jax.numpy.asarray(flat), step_engine._rep)
         if step_engine.ema_flat is not None:
@@ -1094,22 +1273,69 @@ class Optimizer:
         # but the per-process batch plan is keyed by (seed, epoch,
         # process_id, process_count) — a skip computed under N processes
         # does not line up with what was trained when resuming under M.
-        # Fall back to replaying the epoch from its start: batches are
-        # re-trained, never silently dropped.
+        # When the dataset supports it, the epoch's REMAINING examples are
+        # re-sharded deterministically over the new process set (the old
+        # plan's trained prefix is reconstructible from (seed, epoch), so
+        # shrink/grow loses nothing beyond the post-checkpoint steps);
+        # replay-from-epoch-start survives only as the fallback for
+        # datasets that cannot reshard or a twice-changed process set.
         saved_pc = driver.get("process_count")
         state["process_count"] = jax.process_count()
-        if saved_pc is not None and int(saved_pc) != jax.process_count() \
-                and state["_resume_skip"]:
+        origin = driver.get("reshard_origin")
+        pc_changed = (saved_pc is not None
+                      and int(saved_pc) != jax.process_count())
+        can_reshard = hasattr(self.dataset, "resharded_batches")
+
+        def _replay_epoch(why: str) -> None:
             log.warning(
-                "elastic resume: checkpoint written at process_count=%d, "
-                "resuming at %d — the per-process batch plan differs, so "
-                "epoch %d REPLAYS from its start (%d mid-epoch batches "
-                "re-trained rather than silently dropped)",
-                int(saved_pc), jax.process_count(), state["epoch"],
-                state["_resume_skip"])
+                "elastic resume: checkpoint written at process_count=%s, "
+                "resuming at %d — %s, so epoch %d REPLAYS from its start "
+                "(%d mid-epoch batches re-trained rather than silently "
+                "dropped)", saved_pc, jax.process_count(), why,
+                state["epoch"], state["_resume_skip"])
             state["epoch_batch"] = 0
             state["_resume_skip"] = 0
+            state.pop("reshard_origin", None)
             self.metrics.inc("elastic_resumes_total")
+
+        if origin is not None and state["_resume_skip"]:
+            # resuming INTO an epoch that already runs on a re-sharded
+            # plan: rebuild the same remainder plan and skip the batches
+            # of it trained since the reshard point
+            if pc_changed or not can_reshard:
+                _replay_epoch("the process set changed again mid-epoch")
+            else:
+                base = int(origin["trained"])
+                state["_resume_reshard"] = {
+                    "process_count": int(origin["process_count"]),
+                    "trained": base,
+                    "skip": max(0, state["epoch_batch"] - base)}
+                state["_resume_skip"] = 0
+        elif pc_changed and state["_resume_skip"]:
+            if can_reshard:
+                log.warning(
+                    "elastic resume: checkpoint written at "
+                    "process_count=%d, resuming at %d — epoch %d continues "
+                    "on a re-sharded batch plan (the %d already-trained "
+                    "global batches are excluded; nothing replays, nothing "
+                    "is dropped)", int(saved_pc), jax.process_count(),
+                    state["epoch"], state["epoch_batch"])
+                state["_resume_reshard"] = {
+                    "process_count": int(saved_pc),
+                    "trained": state["epoch_batch"], "skip": 0}
+                state["reshard_origin"] = {
+                    "process_count": int(saved_pc),
+                    "trained": state["epoch_batch"]}
+                state["_resume_skip"] = 0
+                self.metrics.inc("elastic_resumes_total")
+                self.metrics.inc("elastic_resharded_total")
+                flight.record("elastic_reshard", epoch=state["epoch"],
+                              old_pc=int(saved_pc),
+                              new_pc=jax.process_count(),
+                              trained=state["epoch_batch"])
+            else:
+                _replay_epoch("the per-process batch plan differs and "
+                              "this dataset cannot reshard mid-epoch")
         sched_state = state.pop("schedule_state", None)
         schedule = getattr(self.optim_method, "schedule", None)
         if sched_state is not None and schedule is not None \
@@ -1117,7 +1343,9 @@ class Optimizer:
             schedule.load_state_dict(sched_state)
             # the restored factor must be baked into the compiled step
             step_engine._train = step_engine._build_train()
-        log.info("resumed from %s (iteration %d, epoch %d)", latest,
+        log.info("resumed via %s from %s (iteration %d, epoch %d)",
+                 path_used, latest if path_used == "checkpoint"
+                 else "peer-shard store",
                  state["iteration"], state["epoch"])
 
 

@@ -122,6 +122,7 @@ class HeartbeatMonitor:
         #                             history must not make phi explode
         self._clock = clock
         self._last: Dict[int, float] = {}
+        self._steps: Dict[int, int] = {}
         self._intervals: Dict[int, deque] = {}
 
     def poll(self) -> Dict[int, float]:
@@ -150,6 +151,7 @@ class HeartbeatMonitor:
                     idx, deque(maxlen=self.window)).append(t - prev)
             if prev is None or t > prev:
                 self._last[idx] = t
+                self._steps[idx] = int(rec.get("step", 0) or 0)
         return seen
 
     def phi(self, process_index: int, now: Optional[float] = None) -> float:
@@ -180,6 +182,21 @@ class HeartbeatMonitor:
         self.poll()
         return sorted(i for i in self._last
                       if self.phi(i, now=now) > threshold)
+
+    def alive(self, threshold: float = 8.0,
+              now: Optional[float] = None) -> List[int]:
+        """The complement of ``suspects``: every ever-seen peer whose phi
+        is at or under ``threshold`` (poll first) — the live set a
+        membership sweep turns into a view (``resilience.cluster``)."""
+        self.poll()
+        return sorted(i for i in self._last
+                      if self.phi(i, now=now) <= threshold)
+
+    def peer_step(self, process_index: int) -> Optional[int]:
+        """The training step the peer last reported in its beat — lets a
+        monitor (or a postmortem) see not just THAT a peer is alive but
+        where its driver loop is."""
+        return self._steps.get(process_index)
 
 
 class StepWatchdog:

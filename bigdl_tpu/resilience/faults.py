@@ -59,7 +59,22 @@ POINTS = ("step_fail", "checkpoint_write_fail", "storage_io_fail",
           # - serving_slow_batch   — sleep before predict (a straggling
           #   batch; drives deadline expiry downstream)
           "serving_predict_fail", "serving_worker_kill",
-          "serving_slow_batch")
+          "serving_slow_batch",
+          # cluster chaos seams (instrumented in resilience.cluster /
+          # resilience.membership) — the single-process simulation of
+          # pod-scale failures, so gang recovery is tier-1 testable:
+          # - cluster_host_loss     — raise HostLostError at a bundle edge
+          #   (a peer host died mid-collective; survivors must gang-abort)
+          # - cluster_partition     — while firing, a membership sweep
+          #   sees no peer heartbeats (network partition; heals when the
+          #   spec's max_fires is exhausted)
+          # - cluster_slow_peer     — sleep before this host's own beat
+          #   (a straggler whose beats arrive late, driving peer phi up)
+          # - cluster_preempt_notice — acts as a received cluster-wide
+          #   preemption notice (maintenance event on SOME host; every
+          #   member must take the just-in-time checkpoint)
+          "cluster_host_loss", "cluster_partition", "cluster_slow_peer",
+          "cluster_preempt_notice")
 
 
 class InjectedFault(RuntimeError):
@@ -96,6 +111,25 @@ class InjectedPredictError(InjectedFault):
     degradation machinery must treat it exactly like a real model error."""
 
 
+class HostLostError(InjectedFault):
+    """``cluster_host_loss`` — a peer host vanished under the gang.  The
+    real-world analog is a collective that times out because one
+    participant died; survivors must abort the collective, rendezvous on
+    a new membership view, and restore together (resilience.cluster)."""
+
+
+class PartitionError(InjectedFault):
+    """``cluster_partition`` in ``action="raise"`` mode; the default
+    instrumentation (membership sweep) catches it and simulates the
+    partition instead of propagating."""
+
+
+class PreemptNoticeFault(InjectedFault):
+    """``cluster_preempt_notice`` — caught by the instrumented site
+    (ClusterCoordinator) and turned into a cluster-wide preemption
+    event, never propagated as an error."""
+
+
 _EXC = {
     "step_fail": InjectedStepFailure,
     "checkpoint_write_fail": InjectedCheckpointWriteError,
@@ -105,6 +139,10 @@ _EXC = {
     "serving_predict_fail": InjectedPredictError,
     "serving_worker_kill": ProcessKilledError,
     "serving_slow_batch": InjectedFault,
+    "cluster_host_loss": HostLostError,
+    "cluster_partition": PartitionError,
+    "cluster_slow_peer": InjectedFault,
+    "cluster_preempt_notice": PreemptNoticeFault,
 }
 
 
@@ -126,6 +164,7 @@ class FaultSpec:
         if self.action is None:
             self.action = {"slow_host": "sleep",
                            "serving_slow_batch": "sleep",
+                           "cluster_slow_peer": "sleep",
                            "process_kill": "exit",
                            "serving_worker_kill": "exit"}.get(
                                self.point, "raise")

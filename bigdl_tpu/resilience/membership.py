@@ -1,0 +1,220 @@
+"""Cluster membership — epoch-numbered views over a shared control channel.
+
+Reference analog (unverified — mount empty): the reference's cluster
+membership IS Spark's: the driver tracks executor liveness and reschedules
+work, and "BigDL 2.0" (arXiv 2204.01715) presents transparent failure
+recovery as a property inherited from that control plane.  The TPU
+multi-controller world has no driver, so membership must be peer-agreed
+state.  This module is the agreement substrate: a **view board** over a
+shared directory (local, or ``gs://…`` through the ``utils.storage`` seam —
+the same visibility requirement sharded checkpoints and heartbeats already
+impose).
+
+The protocol is deliberately primitive — files, not Paxos:
+
+- A **view** is an epoch-numbered membership snapshot
+  (:class:`MembershipView`): the sorted live process indices, the leader
+  (always the LOWEST live rank — deterministic, no election rounds), the
+  publishing step, and a reason.  The leader writes ``view-<epoch>.json``;
+  everyone else adopts the highest epoch they can read.  Two processes
+  that disagree about who leads (a partition) may both publish the same
+  epoch; last-write-wins, and the disagreement is transient because the
+  leader rule is a pure function of the live set.
+- An **abort flag** (``abort-<epoch>.json``) is scoped to the view it
+  aborts: any member may post it, every member's next step-edge check sees
+  it, and it dies with the epoch — recovery publishes a new view, so a
+  stale flag can never re-abort a recovered gang.
+- A **preemption notice** (``preempt-<epoch>-r<rank>.json``) propagates a
+  local SIGTERM cluster-wide, also epoch-scoped: the signalled host posts
+  it, every other host treats it as its own preemption and takes the
+  just-in-time checkpoint too (a maintenance event that takes one host of
+  a gang takes the GANG).
+- A **rendezvous ack** (``ack-<epoch>-r<rank>.json``) is the barrier
+  primitive gang recovery uses: survivors ack the new view's epoch and
+  wait until every member of that view has acked before re-entering
+  training together.
+
+Everything routes through ``utils.storage``, so ``memory://`` gives tests
+real remote semantics with no network and no sleeps (clocks are
+injectable one layer up, in :mod:`.cluster`).
+"""
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.utils import storage
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.resilience")
+
+
+@dataclass(frozen=True)
+class MembershipView:
+    """One epoch of agreed membership.  ``leader`` is redundant with
+    ``min(members)`` but recorded so a postmortem dump is self-contained;
+    ``topology`` carries the publisher's device-topology fingerprint
+    (``runtime.mesh.mesh_fingerprint``) so a rejoining process on
+    different hardware is detectable before it wedges a collective."""
+
+    epoch: int
+    members: Tuple[int, ...]
+    leader: int
+    step: int = 0
+    reason: str = "initial"
+    preempt: bool = False
+    topology: str = ""
+    published_by: int = -1
+    time: float = 0.0
+
+    def to_dict(self) -> Dict:
+        d = asdict(self)
+        d["members"] = list(self.members)
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict) -> "MembershipView":
+        return MembershipView(
+            epoch=int(d["epoch"]), members=tuple(int(m) for m in d["members"]),
+            leader=int(d["leader"]), step=int(d.get("step", 0)),
+            reason=str(d.get("reason", "")), preempt=bool(d.get("preempt")),
+            topology=str(d.get("topology", "")),
+            published_by=int(d.get("published_by", -1)),
+            time=float(d.get("time", 0.0)))
+
+
+def _view_name(epoch: int) -> str:
+    return f"view-{epoch:06d}.json"
+
+
+def _abort_name(epoch: int) -> str:
+    return f"abort-{epoch:06d}.json"
+
+
+def _preempt_name(epoch: int, rank: int) -> str:
+    return f"preempt-{epoch:06d}-r{rank:05d}.json"
+
+
+def _ack_name(epoch: int, rank: int) -> str:
+    return f"ack-{epoch:06d}-r{rank:05d}.json"
+
+
+class MembershipBoard:
+    """The shared-directory view board.  Every method is a small number of
+    storage calls (one listing, or one read/write) — callers own the
+    cadence (the coordinator polls at bundle edges and heartbeat sweeps,
+    never per training step)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        storage.makedirs(directory)
+
+    # -- views --------------------------------------------------------------
+    def publish(self, view: MembershipView) -> None:
+        storage.write_json(
+            storage.join(self.directory, _view_name(view.epoch)),
+            view.to_dict())
+
+    def current(self) -> Optional[MembershipView]:
+        """The highest-epoch readable view; a torn/unreadable file is
+        skipped (the previous epoch still governs) rather than crashing
+        the sweep."""
+        best = None
+        for name in self._names():
+            if not (name.startswith("view-") and name.endswith(".json")):
+                continue
+            try:
+                epoch = int(name[len("view-"):-len(".json")])
+            except ValueError:
+                continue
+            if best is not None and epoch <= best.epoch:
+                continue
+            try:
+                view = MembershipView.from_dict(storage.read_json(
+                    storage.join(self.directory, name)))
+            except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                continue
+            best = view
+        return best
+
+    # -- abort flags --------------------------------------------------------
+    def post_abort(self, epoch: int, rank: int, reason: str,
+                   step: Optional[int] = None) -> None:
+        path = storage.join(self.directory, _abort_name(epoch))
+        if storage.exists(path):
+            return  # first abort wins; a second poster changes nothing
+        storage.write_json(path, {"epoch": epoch, "rank": rank,
+                                  "reason": reason, "step": step})
+
+    def abort_posted(self, epoch: int) -> Optional[Dict]:
+        path = storage.join(self.directory, _abort_name(epoch))
+        try:
+            if not storage.exists(path):
+                return None
+            return storage.read_json(path)
+        except (OSError, ValueError, json.JSONDecodeError):
+            return None  # torn write: the next check sees the final file
+
+    # -- preemption notices -------------------------------------------------
+    def post_preempt(self, epoch: int, rank: int) -> None:
+        path = storage.join(self.directory, _preempt_name(epoch, rank))
+        if not storage.exists(path):
+            storage.write_json(path, {"epoch": epoch, "rank": rank})
+
+    def preempt_posted(self, epoch: int) -> List[int]:
+        """Ranks that posted a preemption notice under this epoch."""
+        prefix = f"preempt-{epoch:06d}-r"
+        out = []
+        for name in self._names():
+            if name.startswith(prefix) and name.endswith(".json"):
+                try:
+                    out.append(int(name[len(prefix):-len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # -- rendezvous acks ----------------------------------------------------
+    def ack(self, epoch: int, rank: int) -> None:
+        storage.write_json(
+            storage.join(self.directory, _ack_name(epoch, rank)),
+            {"epoch": epoch, "rank": rank})
+
+    def acks(self, epoch: int) -> List[int]:
+        prefix = f"ack-{epoch:06d}-r"
+        out = []
+        for name in self._names():
+            if name.startswith(prefix) and name.endswith(".json"):
+                try:
+                    out.append(int(name[len(prefix):-len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # ------------------------------------------------------------------
+    def gc(self, current_epoch: int, keep_epochs: int = 4) -> None:
+        """Drop view/abort/preempt/ack files more than ``keep_epochs``
+        behind the current epoch — the leader calls this after each
+        publish so a long-running gang's control dir stays bounded.  A
+        few historical views are kept for postmortems; nothing current
+        is ever touched."""
+        cutoff = current_epoch - keep_epochs
+        if cutoff <= 0:
+            return
+        for name in self._names():
+            stem = name.split("-", 1)
+            if stem[0] not in ("view", "abort", "preempt", "ack") \
+                    or len(stem) != 2 or not name.endswith(".json"):
+                continue
+            try:
+                epoch = int(stem[1].split("-")[0].split(".")[0])
+            except ValueError:
+                continue
+            if epoch < cutoff:
+                storage.remove_tree(storage.join(self.directory, name),
+                                    ignore_errors=True)
+
+    def _names(self) -> List[str]:
+        try:
+            return storage.listdir(self.directory)
+        except (OSError, ImportError):
+            return []

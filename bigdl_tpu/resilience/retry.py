@@ -30,6 +30,11 @@ class FailureCause(Enum):
     TOPOLOGY_CHANGE = "topology_change"
     PROCESS_FAILURE = "process_failure"
     STEP_FAILURE = "step_failure"
+    # a PEER host died (or posted the gang abort flag): the local process
+    # is healthy, the gang is not — recovery is coordinated (abort →
+    # rendezvous on a new membership view → restore together), never an
+    # independent local retry (resilience.cluster)
+    HOST_LOST = "host_lost"
     UNKNOWN = "unknown"
 
 
@@ -67,6 +72,15 @@ def _classify_one(exc: BaseException) -> FailureCause:
 
     if isinstance(exc, faults.ProcessKilledError):
         return FailureCause.PROCESS_FAILURE
+    if isinstance(exc, faults.HostLostError):
+        return FailureCause.HOST_LOST
+    try:  # lazy: cluster imports this module
+        from bigdl_tpu.resilience.cluster import GangAbortedError
+    except ImportError:  # pragma: no cover — partial install
+        pass
+    else:
+        if isinstance(exc, GangAbortedError):
+            return FailureCause.HOST_LOST
     if isinstance(exc, (faults.InjectedStorageError,
                         faults.InjectedCheckpointWriteError)):
         return FailureCause.TRANSIENT_STORAGE
@@ -132,12 +146,16 @@ class RetryPolicy:
 
 
 # fast-exponential for storage blips; nearly-no-retry for poisoned batches
-# (replaying the same plan poisons again); none for topology changes
+# (replaying the same plan poisons again); none for topology changes; a
+# few patient retries for a lost host (the gang rendezvous + peer-shard
+# restore between attempts is the actual recovery work)
 _DEFAULT_BY_CAUSE: Dict[FailureCause, RetryPolicy] = {
     FailureCause.TRANSIENT_STORAGE: RetryPolicy(
         max_retries=8, base_s=0.5, max_s=30.0),
     FailureCause.POISONED_BATCH: RetryPolicy(max_retries=1, base_s=0.0),
     FailureCause.TOPOLOGY_CHANGE: RetryPolicy(max_retries=0),
+    FailureCause.HOST_LOST: RetryPolicy(max_retries=4, base_s=0.5,
+                                        max_s=30.0),
 }
 
 
@@ -163,6 +181,14 @@ class FailurePolicy:
     nan_patience: int = 3
     # recovery
     restart_from_scratch: bool = True  # no valid checkpoint: restart vs give up
+    # cluster control plane (docs/resilience.md §Multi-host recovery):
+    # setting cluster_dir makes the Supervisor run a ClusterCoordinator —
+    # membership views + gang recovery + peer-shard restore over that
+    # shared directory.  Supersedes heartbeat_dir (the coordinator beats
+    # and monitors itself; a separate observe-only monitor would double-
+    # count suspicions).  BIGDL_TPU_CLUSTER_DIR sets it fleet-wide.
+    cluster_dir: Optional[str] = None
+    cluster_rendezvous_timeout_s: float = 120.0
 
     def policy_for(self, cause: FailureCause) -> RetryPolicy:
         if cause in self.by_cause:

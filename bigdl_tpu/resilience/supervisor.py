@@ -69,7 +69,28 @@ class Supervisor:
     def run(self):
         policy = self.policy
         heartbeat = monitor_stop = None
-        if policy.heartbeat_dir:
+        own_cluster = False
+        if policy.cluster_dir \
+                and getattr(self.optimizer, "cluster", None) is None:
+            # the full control plane (docs/resilience.md §Multi-host
+            # recovery): membership views + gang recovery + peer-shard
+            # restore.  The coordinator beats and monitors itself, so the
+            # plain heartbeat_dir path below is skipped — running both
+            # would double-count every suspicion episode.
+            from bigdl_tpu.resilience.cluster import (ClusterConfig,
+                                                      ClusterCoordinator)
+
+            coord = ClusterCoordinator(ClusterConfig(
+                directory=policy.cluster_dir,
+                heartbeat_interval_s=policy.heartbeat_interval_s,
+                phi_threshold=policy.heartbeat_phi_threshold,
+                rendezvous_timeout_s=policy.cluster_rendezvous_timeout_s),
+                metrics=self.metrics)
+            coord.start(background=True)
+            self.optimizer.cluster = coord
+            own_cluster = True
+        if policy.heartbeat_dir \
+                and getattr(self.optimizer, "cluster", None) is None:
             heartbeat = Heartbeat(
                 policy.heartbeat_dir,
                 interval_s=policy.heartbeat_interval_s).start()
@@ -102,6 +123,9 @@ class Supervisor:
                 monitor_stop.set()
             if heartbeat is not None:
                 heartbeat.stop()
+            if own_cluster:
+                self.optimizer.cluster.stop()
+                self.optimizer.cluster = None
 
     def _start_peer_monitor(self, policy) -> threading.Event:
         """Background phi-accrual sweep over the peers' heartbeats: a peer
@@ -170,6 +194,14 @@ class Supervisor:
                 run_time_s, type(exc).__name__, exc, self.restarts_total,
                 self.policy.max_restarts, cause.value, attempt, delay)
             self._sleep(delay)
+            coord = getattr(self.optimizer, "cluster", None)
+            if coord is not None:
+                # a restart that escaped optimize() rewinds this process's
+                # device state, so the whole gang must rewind WITH it:
+                # abort the current view's collectives, rendezvous on the
+                # next view, and only then re-enter optimize() together
+                coord.gang_recover(cause.value)
+                coord.note_recovered(time.perf_counter() - t_rec)
         # only handler + backoff time counts as lost — most of the failed
         # run's progress survives in checkpoints (the in-run retry path
         # accounts the same way); the full run_time_s is in the log line

@@ -120,6 +120,15 @@ class EngineConfig:
             cfg.failure_policy = cfg.resolved_failure_policy()
             cfg.failure_policy.heartbeat_dir = \
                 os.environ["BIGDL_TPU_HEARTBEAT_DIR"]
+        if os.environ.get("BIGDL_TPU_CLUSTER_DIR"):
+            # the full cluster control plane (docs/resilience.md
+            # §Multi-host recovery): membership views, gang recovery, and
+            # peer-shard restore over this shared directory — the
+            # Supervisor builds a ClusterCoordinator from it
+            cfg.failure_policy = cfg.failure_policy \
+                or cfg.resolved_failure_policy()
+            cfg.failure_policy.cluster_dir = \
+                os.environ["BIGDL_TPU_CLUSTER_DIR"]
         if os.environ.get("BIGDL_TPU_PROFILE_DIR"):
             cfg.profile_dir = os.environ["BIGDL_TPU_PROFILE_DIR"]
         if os.environ.get("BIGDL_TPU_METRICS_PORT"):

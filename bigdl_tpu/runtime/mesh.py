@@ -167,6 +167,22 @@ def build_mesh(
     return Mesh(dev_array, order)
 
 
+def mesh_fingerprint(mesh: Optional[Mesh] = None) -> str:
+    """Stable identity of the device topology a process is running on —
+    device count, platform/kind, and (when a mesh is given) the logical
+    axis sizes.  Membership views (``resilience.membership``) carry the
+    publisher's fingerprint so a replacement process brought up on
+    DIFFERENT hardware (fewer chips, another generation) is rejected at
+    rendezvous instead of wedging the first collective it joins."""
+    devices = list(mesh.devices.flat) if mesh is not None else jax.devices()
+    d0 = devices[0]
+    parts = [str(len(devices)), getattr(d0, "platform", "?"),
+             getattr(d0, "device_kind", "?")]
+    if mesh is not None:
+        parts.append("x".join(f"{a}={n}" for a, n in mesh.shape.items()))
+    return ":".join(parts)
+
+
 def data_axis_size(mesh: Mesh) -> int:
     return mesh.shape[AXIS_DATA]
 

@@ -37,6 +37,7 @@ __all__ = [
     "is_remote", "join", "basename", "open_file", "exists", "isdir",
     "isfile", "listdir", "list_files", "makedirs", "remove_tree",
     "read_json", "write_json", "load_npz", "glob", "fingerprint",
+    "mirror_tree",
 ]
 
 
@@ -243,6 +244,76 @@ def fingerprint(path: str) -> Optional[dict]:
         return {"size": str(st.st_size), "mtime": str(st.st_mtime)}
     except (OSError, ImportError, KeyError):
         return None
+
+
+def mirror_tree(src: str, dst: str, policy=None, metrics=None,
+                sleep=None) -> int:
+    """Copy every file under ``src`` (recursively) to ``dst`` — the remote
+    checkpoint mirror: the off-cluster copy that survives the whole pod
+    (and its shared filesystem) being reclaimed.  Returns bytes copied.
+
+    Each file upload runs under a BOUNDED retry-with-backoff (default: 3
+    retries, 0.2s exponential base) instead of a single attempt — object
+    stores blip, and a mirror that silently lost one blob is worse than
+    none.  Every retry is accounted under the standard
+    ``retries_by_cause.transient_storage`` counter so mirror flakiness
+    shows up in /metrics next to every other storage retry.  Exhausted
+    retries raise: the CALLER decides whether a missing mirror is fatal
+    (the checkpoint writer logs and keeps the intact primary).
+
+    Any ``manifest.json`` is copied LAST within the whole tree, preserving
+    the checkpoint writer's manifest-last ordering — a crash mid-mirror
+    leaves a prefix readers treat as not-a-checkpoint."""
+    import time as _time
+
+    if policy is None:
+        from bigdl_tpu.resilience.retry import RetryPolicy
+
+        policy = RetryPolicy(max_retries=3, base_s=0.2, max_s=5.0)
+    if metrics is None:
+        from bigdl_tpu.optim.metrics import global_metrics
+
+        metrics = global_metrics()
+    sleep = sleep or _time.sleep
+
+    def walk(rel: str):
+        base = join(src, rel) if rel else src
+        for name in listdir(base):
+            p = f"{rel}/{name}" if rel else name
+            if isdir(join(src, p)):
+                yield from walk(p)
+            else:
+                yield p
+
+    files = sorted(walk(""),
+                   key=lambda p: (p.split("/")[-1] == "manifest.json", p))
+    makedirs(dst)
+    total = 0
+    for p in files:
+        target = join(dst, p)
+        d = target.rsplit("/", 1)[0] if "/" in p else dst
+        makedirs(d)
+        attempt = 0
+        while True:
+            try:
+                with open_file(join(src, p), "rb") as f:
+                    data = f.read()
+                with open_file(target, "wb") as g:
+                    g.write(data)
+                total += len(data)
+                break
+            except Exception as e:
+                attempt += 1
+                if attempt > policy.max_retries:
+                    raise
+                metrics.inc("retries_by_cause.transient_storage")
+                delay = policy.backoff(attempt)
+                log.warning(
+                    "mirror %s -> %s failed (%s: %s); retry %d/%d in %.2fs",
+                    p, dst, type(e).__name__, e, attempt,
+                    policy.max_retries, delay)
+                sleep(delay)
+    return total
 
 
 def read_json(path: str):

@@ -371,10 +371,12 @@ def test_supervisor_exhausts_policy_and_raises(tmp_path):
         Supervisor(opt, policy=policy, sleep=lambda s: None).run()
 
 
-def test_elastic_resume_replays_epoch_on_process_count_change(tmp_path):
+def test_elastic_resume_reshards_epoch_on_process_count_change(tmp_path):
     """A checkpoint recorded at a different process_count must NOT apply
-    its mid-epoch skip (the per-process batch plan changed): it replays
-    the epoch from its start with an explicit warning."""
+    its mid-epoch skip verbatim (the per-process batch plan changed):
+    the epoch continues on a RE-SHARDED plan over its remaining examples
+    — nothing replays, nothing is dropped (docs/distributed_training.md
+    §Elastic resume)."""
     from bigdl_tpu.optim import checkpoint as ckpt
 
     _fast_engine()
@@ -396,14 +398,57 @@ def test_elastic_resume_replays_epoch_on_process_count_change(tmp_path):
         opt2.optimize()
     assert opt2.final_state["iteration"] == 10
     assert opt2.metrics.counter("elastic_resumes_total") == 1
+    assert opt2.metrics.counter("elastic_resharded_total") == 1
     assert any("elastic resume" in r.getMessage()
                and "process_count=2" in r.getMessage()
+               and "re-sharded" in r.getMessage()
                for r in cap.records)
 
     # same process_count: the skip applies, no elastic fallback
     opt3 = _linreg_optimizer(d, 12)
     opt3.optimize()
     assert opt3.metrics.counter("elastic_resumes_total") == 0
+
+
+def test_elastic_resume_replays_epoch_when_dataset_cannot_reshard(
+        tmp_path):
+    """Datasets without ``resharded_batches`` keep the conservative
+    fallback: the epoch replays from its start with an explicit warning
+    — batches re-trained, never silently dropped."""
+    from bigdl_tpu.data.dataset import DataSet
+    from bigdl_tpu.optim import checkpoint as ckpt
+
+    class _NoReshard(DataSet):
+        def __init__(self, inner):
+            self._inner = inner
+
+        def size(self):
+            return self._inner.size()
+
+        def batches(self, *a, **kw):
+            return self._inner.batches(*a, **kw)
+
+    _fast_engine()
+    faults.clear()
+    d = str(tmp_path / "ck")
+    opt1 = _linreg_optimizer(d, 6)
+    opt1.optimize()
+    manifest_path = os.path.join(ckpt.latest_checkpoint(d),
+                                 "manifest.json")
+    manifest = json.load(open(manifest_path))
+    manifest["driver_state"]["process_count"] = 2
+    manifest["driver_state"]["epoch_batch"] = 2
+    json.dump(manifest, open(manifest_path, "w"))
+
+    opt2 = _linreg_optimizer(d, 10)
+    opt2.dataset = _NoReshard(opt2.dataset)
+    with _LogCapture("bigdl_tpu.optim") as cap:
+        opt2.optimize()
+    assert opt2.final_state["iteration"] == 10
+    assert opt2.metrics.counter("elastic_resumes_total") == 1
+    assert opt2.metrics.counter("elastic_resharded_total") == 0
+    assert any("REPLAYS from its start" in r.getMessage()
+               for r in cap.records)
 
 
 def test_estimator_fault_tolerance_knob(tmp_path):
