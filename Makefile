@@ -48,10 +48,13 @@ test-cluster:
 
 # the serving suite (docs/serving.md): engine + frontend + pool, including
 # the request-lifecycle chaos tests (worker kill, deadline expiry,
-# backpressure 429s, drain-vs-drop, breaker/hedge)
+# backpressure 429s, drain-vs-drop, breaker/hedge) and the continuous-
+# batching/registry/autoscaler suite (fixed-vs-continuous parity,
+# deadline-aware ordering, multi-tenant SLO metrics, keep-alive reuse,
+# pool autoscale up/down)
 test-serving:
 	python -m pytest tests/test_serving.py tests/test_serving_multiproc.py \
-	  tests/test_serving_chaos.py -q
+	  tests/test_serving_chaos.py tests/test_serving_continuous.py -q
 
 # the observability suite (docs/observability.md): span tracer + chrome
 # export, Prometheus exposition (+HELP lines, scrape-under-mutation),
@@ -98,6 +101,12 @@ bench-scaling:
 
 bench-loader:
 	python bench_loader.py
+
+# sustained-load serving bench (docs/serving.md §Continuous batching):
+# subprocess server + keep-alive load clients, reports rps/p50/p99/
+# occupancy + the zero-recompile mixed-size sweep; --smoke is the CI gate
+bench-serving:
+	python bench_serving.py
 
 # session-long TPU evidence orchestrator (single instance via flock;
 # BENCH_attempts.jsonl evidence trail)

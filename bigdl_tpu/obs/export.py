@@ -94,6 +94,19 @@ DEFAULT_HELP = {
     "train.step_time_max_s": "slowest host's window step time",
     "train.step_time_min_s": "fastest host's window step time",
     "serving.latency_s": "admission-to-publish latency per request",
+    "serving.queue_wait_s": "admission-to-predict queue wait per request "
+                            "(the wait half of the tail decomposition)",
+    "serving.batch_occupancy": "cumulative avg batch fill / batch_size "
+                               "(continuous batching health)",
+    "serving.queue_depth": "requests queued across all model heaps",
+    "serving.backlog": "admitted requests not yet in predict (heaps + "
+                       "handoff slot) — the autoscaling pressure signal",
+    "serving_pool.workers": "serving pool size (autoscaler-managed)",
+    "serving_pool.conn_reuse": "proxy forwards served over a reused "
+                               "keep-alive worker connection",
+    "serving_pool.scale_up": "autoscaler worker additions",
+    "serving_pool.scale_down": "autoscaler worker removals (drained "
+                               "before exit)",
     # cluster control plane (docs/resilience.md §Multi-host recovery)
     "cluster.view_epoch": "current membership view epoch",
     "cluster.members": "live members in the current view",

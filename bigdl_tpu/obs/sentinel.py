@@ -30,6 +30,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -121,9 +122,21 @@ def normalize(doc: Any, source: str) -> List[Row]:
     if "loader_img_per_sec" in row:
         add("loader_img_per_sec", row["loader_img_per_sec"])
     if "throughput_rps" in row:
-        add("serving_throughput_rps", row["throughput_rps"])
-        add("serving_p50_ms", row.get("p50_ms"), LOWER)
-        add("serving_p99_ms", row.get("p99_ms"), LOWER)
+        # captures from different load geometries are not comparable: a
+        # saturated 32-client p50 includes queue wait a light 8-client
+        # probe never pays.  A "geometry" tag scopes the serving families
+        # to same-geometry baselines (both directions); legacy untagged
+        # rows (r04/r05) keep the plain names and gate each other.
+        geo = re.sub(r"[^A-Za-z0-9]+", "_",
+                     str(row.get("geometry") or "")).strip("_")
+        sfx = f"_{geo}" if geo else ""
+        add(f"serving_throughput_rps{sfx}", row["throughput_rps"])
+        add(f"serving_p50_ms{sfx}", row.get("p50_ms"), LOWER)
+        add(f"serving_p99_ms{sfx}", row.get("p99_ms"), LOWER)
+        # batching health: continuous assembly must keep batches FULL —
+        # occupancy sliding back toward per-request predicts is the
+        # regression the r05->r08 rebuild exists to prevent
+        add(f"serving_avg_batch_size{sfx}", row.get("avg_batch_size"))
     if "mttr_s" in row:  # CLUSTER_r*.json recovery drills
         add("cluster_mttr_s", row["mttr_s"], LOWER)
         add("cluster_recovery_bytes", row.get("recovery_bytes"), LOWER)

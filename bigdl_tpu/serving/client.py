@@ -27,17 +27,20 @@ class InputQueue:
         self._server = server
 
     def enqueue(self, uri: Optional[str] = None,
-                deadline_s: Optional[float] = None, **kwargs) -> str:
+                deadline_s: Optional[float] = None,
+                model: Optional[str] = None, **kwargs) -> str:
         """``InputQueue.enqueue(uri, t=ndarray)`` — returns the request id.
 
         ``deadline_s`` (relative) bounds how long the request may wait in
-        the queue before the engine drops it instead of predicting."""
+        the queue before the engine drops it instead of predicting;
+        ``model`` names the registered tenant (default tenant when
+        None)."""
         if len(kwargs) != 1:
             raise ValueError("enqueue expects exactly one named tensor, "
                              "e.g. enqueue('req-1', t=arr)")
         (arr,) = kwargs.values()
         return self._server.enqueue(np.asarray(arr), request_id=uri,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s, model=model)
 
 
 class OutputQueue:

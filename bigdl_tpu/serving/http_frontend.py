@@ -6,11 +6,17 @@ frontend (``scala/serving/.../http/``, akka/netty — SURVEY.md §3.4 row
 streaming engine.
 
 TPU-native: a stdlib ``ThreadingHTTPServer`` over the in-process
-``ServingServer`` queue — requests POST JSON, the dispatcher thread
-dynamic-batches them onto the chip exactly as queue clients do.
+``ServingServer`` queue — requests POST JSON, the engine batches them
+onto the chip exactly as queue clients do.  Connections are HTTP/1.1
+keep-alive: the pool proxy (and any client that holds its connection)
+skips the per-request TCP setup.
 
-    POST /predict   {"instances": [[...], ...]}  -> {"predictions": [...]}
-    GET  /health    -> {"status": "ok", "batches": N, "requests": M, ...}
+    POST /predict   {"instances": [[...], ...],
+                     "model": "name"?}           -> {"predictions": [...]}
+    GET  /health    -> {"status": "ok", "batches": N, "requests": M,
+                        "queue_depth": d, "backlog": b, "p50_ms": ..,
+                        "p99_ms": .., "occupancy": .., "models": {...}, ...}
+    GET  /models    -> the model registry (multi-tenant serving)
     GET  /metrics   -> Prometheus text exposition (docs/observability.md)
 
 Request lifecycle mapping (docs/serving.md): a per-request deadline rides
@@ -18,7 +24,9 @@ in as ``"deadline_s"`` in the payload or an ``X-Deadline-S`` header and is
 stamped at admission; backpressure/degradation sheds surface as **429**
 with a ``Retry-After`` header (never an open-ended block), a deadline that
 expires in the queue is **504**, an oversized body is rejected with
-**413** before it is read, and other engine errors stay **500**.
+**413** before it is read, an unknown model is **404**, and other engine
+errors stay **500**.  The target model rides in as ``"model"`` in the
+payload or an ``X-Model`` header (absent: the default tenant).
 
 Observability (docs/observability.md): a caller-supplied ``X-Request-Id``
 header (or ``"request_id"`` in the payload) becomes the engine request id,
@@ -39,7 +47,7 @@ import numpy as np
 from bigdl_tpu.obs import trace
 from bigdl_tpu.obs.export import reply_metrics
 from bigdl_tpu.serving.json_http import reply_json
-from bigdl_tpu.serving.server import (DeadlineExceededError,
+from bigdl_tpu.serving.server import (DeadlineExceededError, MODEL_NAME_RE,
                                       RequestDroppedError,
                                       ServiceUnavailableError, ServingServer)
 from bigdl_tpu.utils.log import get_logger
@@ -55,6 +63,10 @@ REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._:\-]{1,128}")
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "bigdl-tpu-serving/1"
+    # keep-alive: the proxy's per-worker connection reuse (and any
+    # persistent client) needs 1.1 — every reply path here sets
+    # Content-Length, which 1.1 requires
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # route to our logger, not stderr
         log.debug(fmt, *args)
@@ -69,10 +81,28 @@ class _Handler(BaseHTTPRequestHandler):
             # Prometheus scrape: the server's registry (the process-wide
             # one by default — serving AND training/resilience counters)
             return reply_metrics(self, srv.metrics)
+        if self.path == "/models":
+            return self._json(200, {"models": srv.models()})
         if self.path != "/health":
             return self._json(404, {"error": f"unknown path {self.path}"})
-        self._json(200, {"status": "degraded" if srv.degraded else "ok",
-                         "degraded": srv.degraded, **srv.stats})
+        stats = dict(srv.stats)
+        batches = stats.get("batches", 0)
+        # wait-vs-predict tail decomposition + queue pressure: the pool
+        # autoscaler's scaling signals, one GET away
+        self._json(200, {
+            "status": "degraded" if srv.degraded else "ok",
+            "degraded": srv.degraded,
+            "queue_depth": srv._in.qsize(),
+            "backlog": srv.backlog(),
+            "p50_ms": round(
+                srv.metrics.percentile("serving.latency_s", 0.50) * 1e3, 3),
+            "p99_ms": round(
+                srv.metrics.percentile("serving.latency_s", 0.99) * 1e3, 3),
+            "occupancy": round(
+                stats.get("requests", 0) / batches
+                / max(srv.config.batch_size, 1), 4) if batches else 0.0,
+            "models": srv.models(),
+            **stats})
 
     def do_POST(self):
         if self.path != "/predict":
@@ -83,15 +113,20 @@ class _Handler(BaseHTTPRequestHandler):
             if length < 0:
                 raise ValueError(length)  # read(-1) would buffer to EOF
         except ValueError:
+            self.close_connection = True  # unread body poisons keep-alive
             return self._json(400, {"error": "bad Content-Length"})
         if length > self.server.max_body_bytes:  # type: ignore[attr-defined]
             # reject BEFORE reading: one malformed client must not make
-            # the worker buffer an arbitrarily large body
+            # the worker buffer an arbitrarily large body.  The unread
+            # body makes this connection unusable for a next request —
+            # close it instead of letting 1.1 keep-alive misparse
+            self.close_connection = True
             return self._json(413, {
                 "error": f"request body {length} bytes exceeds limit "
                          f"{self.server.max_body_bytes}"})  # type: ignore[attr-defined]
         deadline_s: Optional[float] = None
         req_id: Optional[str] = None
+        model: Optional[str] = None
         try:
             payload = json.loads(self.rfile.read(length) or b"{}")
             instances = np.asarray(payload["instances"], np.float32)
@@ -110,13 +145,26 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._json(400, {
                         "error": "bad request id: must match "
                                  "[A-Za-z0-9._:-]{1,128}"})
+            # multi-tenant routing: payload key wins (it travels with the
+            # body through the pool proxy), X-Model header as fallback
+            model = payload.get("model") or self.headers.get("X-Model")
+            if model is not None:
+                model = str(model)
+                if not MODEL_NAME_RE.fullmatch(model):
+                    return self._json(400, {
+                        "error": "bad model name: must match "
+                                 "[A-Za-z0-9._-]{1,64}"})
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             # TypeError covers valid-JSON non-object bodies ([1,2,3], 42)
             return self._json(400, {"error": f"bad request: {e}"})
         with trace.span("serving/http_request") as sp:
             try:
                 rid = srv.enqueue(instances, request_id=req_id,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, model=model)
+            except KeyError as e:
+                # unknown model: a registry miss is the caller naming a
+                # tenant this worker does not serve
+                return self._json(404, {"error": str(e)})
             except ValueError as e:
                 # duplicate in-flight X-Request-Id: usually a client retry
                 # racing its first attempt — 409 + Retry-After marks it
@@ -178,26 +226,81 @@ class HttpFrontend:
 
 
 class HttpClient:
-    """Tiny client for the frontend (reference python http client analog)."""
+    """Tiny client for the frontend (reference python http client analog).
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    ``keep_alive=True`` holds ONE persistent HTTP/1.1 connection (retried
+    once on a stale keep-alive socket) — the sustained-load path; not
+    thread-safe in that mode, give each client thread its own instance."""
+
+    def __init__(self, url: str, timeout: float = 30.0,
+                 keep_alive: bool = False):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self._keep_alive = keep_alive
+        self._conn = None
 
     def predict(self, instances, deadline_s: Optional[float] = None,
-                request_id: Optional[str] = None) -> np.ndarray:
+                request_id: Optional[str] = None,
+                model: Optional[str] = None) -> np.ndarray:
         payload = {"instances": np.asarray(instances).tolist()}
         if deadline_s is not None:
             payload["deadline_s"] = deadline_s
+        if model is not None:
+            payload["model"] = model
         body = json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
             headers["X-Request-Id"] = request_id
-        req = _urlreq.Request(self.url + "/predict", data=body,
-                              headers=headers)
-        with _urlreq.urlopen(req, timeout=self.timeout) as resp:
-            out = json.loads(resp.read())
+        if self._keep_alive:
+            status, data = self._request_keep_alive("POST", "/predict",
+                                                    body, headers)
+            if status != 200:
+                raise RuntimeError(
+                    f"predict failed: HTTP {status}: {data[:200]!r}")
+            out = json.loads(data)
+        else:
+            req = _urlreq.Request(self.url + "/predict", data=body,
+                                  headers=headers)
+            with _urlreq.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
         return np.asarray(out["predictions"], np.float32)
+
+    def _request_keep_alive(self, method: str, path: str,
+                            body: Optional[bytes], headers: dict):
+        import http.client
+
+        for attempt in (0, 1):
+            fresh = self._conn is None
+            if fresh:
+                host, _, port = self.url.split("//", 1)[1].partition(":")
+                self._conn = http.client.HTTPConnection(
+                    host, int(port or 80), timeout=self.timeout)
+            try:
+                self._conn.request(method, path, body=body, headers=headers)
+                resp = self._conn.getresponse()
+                data = resp.read()
+            except Exception:
+                self.close()
+                if fresh or attempt:
+                    raise
+                continue  # stale keep-alive socket: retry on a fresh one
+            if resp.will_close:
+                self.close()
+            return resp.status, data
+        raise RuntimeError("unreachable")
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+            self._conn = None
+
+    def models(self) -> dict:
+        with _urlreq.urlopen(self.url + "/models",
+                             timeout=self.timeout) as resp:
+            return json.loads(resp.read())["models"]
 
     def metrics(self) -> str:
         """One raw Prometheus text scrape of ``GET /metrics``."""

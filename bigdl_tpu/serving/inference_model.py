@@ -80,6 +80,18 @@ class InferenceModel:
         x = np.asarray(x)
         if self._custom is not None:
             return np.asarray(self._custom(x))
+        cap = self.buckets[-1]
+        if x.shape[0] > cap:
+            # chunk instead of running an unpadded tail shape: the set of
+            # compiled programs stays CLOSED (one per bucket), so a burst
+            # bigger than the largest bucket cannot trigger a fresh XLA
+            # compile mid-traffic (the recompile-sentinel guarantee)
+            return np.concatenate(
+                [self._predict_bucketed(x[i:i + cap])
+                 for i in range(0, x.shape[0], cap)], axis=0)
+        return self._predict_bucketed(x)
+
+    def _predict_bucketed(self, x: np.ndarray) -> np.ndarray:
         n = x.shape[0]
         b = _bucket(n, self.buckets)
         if n < b:  # pad to the bucket so XLA reuses the compiled program
@@ -87,3 +99,23 @@ class InferenceModel:
             x = np.concatenate([x, pad], axis=0)
         out = self._jit(self._params, self._state, x)
         return np.asarray(out)[:n]
+
+    def warmup(self, sample: np.ndarray) -> "InferenceModel":
+        """Compile every bucket's program BEFORE traffic: one predict per
+        bucket from ``sample`` (a single example, with or without a batch
+        dim), inside an :func:`~bigdl_tpu.obs.attr.expected_compile`
+        region so the recompile sentinel stays quiet.  After this, a
+        mixed-size request sweep runs with zero XLA compiles."""
+        if self._custom is not None:
+            return self
+        from bigdl_tpu.obs.attr import expected_compile
+
+        row = np.asarray(sample)
+        if row.ndim >= 2:
+            row = row[:1]
+        else:
+            row = row[None]
+        with expected_compile():
+            for b in self.buckets:
+                self._predict_bucketed(np.repeat(row, b, axis=0))
+        return self

@@ -5,7 +5,7 @@ Reference analog (unverified — mount empty): Cluster Serving's Flink job
 the single engine loop: process isolation (a poisoned model copy cannot
 take the frontend down), horizontal scale-out (N task managers), and
 supervision (Flink restarts failed tasks).  The TPU-native equivalent is
-this pool: N worker subprocesses — each running the dynamic-batch
+this pool: N worker subprocesses — each running the continuous-batching
 ``ServingServer`` + ``HttpFrontend`` on its own port, each able to own
 its own device — behind one round-robin HTTP proxy that health-checks
 and RESTARTS dead workers.
@@ -16,9 +16,9 @@ and RESTARTS dead workers.
 
 ``loader`` is a ``module:function`` spec resolving to a zero-arg callable
 returning an :class:`~bigdl_tpu.serving.inference_model.InferenceModel` —
-workers import it in their own interpreter (the model never crosses the
-process boundary, exactly the reference's model-per-task-manager
-posture).
+or a ``{name: model}`` dict for multi-tenant workers — imported in each
+worker's own interpreter (the model never crosses the process boundary,
+exactly the reference's model-per-task-manager posture).
 
 Routing hardening (docs/serving.md): each worker sits behind a per-worker
 CIRCUIT BREAKER — consecutive connection-level failures open it, an open
@@ -29,8 +29,20 @@ bouncing the client.  ``hedge_after_s`` optionally duplicates an
 idempotent predict onto a second worker when the first is slow (bounded:
 one hedge, first answer wins).  ``stop()`` drains workers before killing
 them — each worker finishes its queued requests within the drain budget.
+Forwards ride per-worker KEEP-ALIVE connections (``conn_reuse`` counts
+the hits) instead of paying a TCP handshake per request.
+
+Autoscaling (docs/serving.md §Autoscaling): with ``max_workers`` above
+``min_workers``, a metrics thread watches the signals the workers already
+export on ``/health`` — queue depth and the latency histogram — and
+grows/shrinks the pool between the bounds — asymmetric on purpose: one
+over-threshold pressure tick spawns a worker (queued users are waiting;
+the cooldown rate-limits repeats), while shrinking demands sustained
+idle (never while a breaker is open, always drain-before-kill, never
+below ``min_workers``).
 """
 
+import http.client
 import json
 import os
 import subprocess
@@ -39,8 +51,7 @@ import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional, Tuple
-from urllib import request as _urlreq
+from typing import Dict, List, Optional, Tuple
 
 from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.obs.export import reply_metrics
@@ -67,8 +78,14 @@ def _worker_main(loader: str, batch_size: int, queue_capacity: int,
     from bigdl_tpu.serving.http_frontend import HttpFrontend
     from bigdl_tpu.serving.server import ServingConfig, ServingServer
 
-    srv = ServingServer(fn(), ServingConfig(
-        batch_size=batch_size, queue_capacity=queue_capacity)).start()
+    cfg = ServingConfig(batch_size=batch_size, queue_capacity=queue_capacity)
+    loaded = fn()
+    if isinstance(loaded, dict):
+        # multi-tenant worker: every model in the registry shares this
+        # process's engine under weighted admission
+        srv = ServingServer(models=loaded, config=cfg).start()
+    else:
+        srv = ServingServer(loaded, cfg).start()
     fe = HttpFrontend(srv, port=0).start()
     print(f"WORKER_URL={fe.url}", flush=True)
     sys.stdin.readline()           # parent closes stdin to stop us
@@ -151,6 +168,91 @@ class _Breaker:
         with self._lock:
             return {"state": self.state, "failures": self.failures,
                     "trips": self.trips}
+
+
+class _ConnPool:
+    """Per-worker-url keep-alive HTTP connections (satellite of the
+    continuous-batching PR: the proxy used to pay a fresh TCP handshake
+    per forwarded request).  ``acquire`` hands back an idle connection
+    when one exists (``reused=True`` — the caller counts the hit) or
+    opens a fresh one; ``release`` parks it for the next forward, bounded
+    per url so a burst cannot hoard sockets."""
+
+    def __init__(self, timeout: float, depth: int = 16):
+        self._timeout = timeout
+        self._depth = depth
+        self._idle: Dict[str, List[http.client.HTTPConnection]] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _host_port(url: str) -> Tuple[str, int]:
+        host, _, port = url.split("//", 1)[1].partition(":")
+        return host, int(port or 80)
+
+    def acquire(self, url: str
+                ) -> Tuple[http.client.HTTPConnection, bool]:
+        with self._lock:
+            stack = self._idle.get(url)
+            if stack:
+                return stack.pop(), True
+        host, port = self._host_port(url)
+        return http.client.HTTPConnection(host, port,
+                                          timeout=self._timeout), False
+
+    def release(self, url: str, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            stack = self._idle.setdefault(url, [])
+            if len(stack) < self._depth:
+                stack.append(conn)
+                return
+        conn.close()
+
+    def request(self, url: str, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[dict] = None,
+                on_reuse=None) -> Tuple[int, bytes, dict]:
+        """One request over a pooled connection: acquire, send, read,
+        park (or close when the peer said so).  A reused socket that
+        turns out stale gets ONE fresh-connection retry.  ``on_reuse``
+        fires when the answering attempt rode a parked socket (the
+        proxy's ``conn_reuse`` stat).  The single implementation behind
+        forwards and health probes — the retry/release protocol must not
+        fork."""
+        for attempt in (0, 1):
+            conn, reused = self.acquire(url)
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+            except Exception:
+                conn.close()
+                if reused and attempt == 0:
+                    continue  # stale keep-alive socket: one fresh retry
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                self.release(url, conn)
+            if reused and on_reuse is not None:
+                on_reuse()
+            return resp.status, data, dict(resp.headers)
+        raise RuntimeError("unreachable")
+
+    def clear(self, url: Optional[str] = None) -> None:
+        """Drop idle connections (for one url, or all) — a respawned or
+        removed worker's sockets must not linger."""
+        with self._lock:
+            if url is None:
+                stacks = list(self._idle.values())
+                self._idle.clear()
+            else:
+                stacks = [self._idle.pop(url, [])]
+        for stack in stacks:
+            for conn in stack:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
 
 
 class _Worker:
@@ -246,11 +348,18 @@ class _Worker:
 
 class _ProxyHandler(BaseHTTPRequestHandler):
     server_version = "bigdl-tpu-serving-pool/1"
+    protocol_version = "HTTP/1.1"  # clients keep-alive into the proxy too
 
     def log_message(self, fmt, *args):
         log.debug(fmt, *args)
 
-    def _forward(self, method: str, url: str, body: Optional[bytes]):
+    def _forward(self, method: str, base: str, path: str,
+                 body: Optional[bytes]):
+        """One upstream request over the per-worker keep-alive pool.  A
+        reused connection that fails before any response (the worker
+        idle-closed it) is retried ONCE on a fresh connection — safe even
+        for POST because predict is idempotent (the hedging premise)."""
+        pool: "ServingPool" = self.server.pool
         headers = {"Content-Type": "application/json"}
         rid = getattr(self, "_rid", None)
         if rid is not None:
@@ -263,9 +372,14 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             # the client's header-form deadline must reach the worker or
             # its request outlives itself in a backed-up queue
             headers["X-Deadline-S"] = deadline
-        req = _urlreq.Request(url, data=body, method=method, headers=headers)
-        with _urlreq.urlopen(req, timeout=self.server.predict_timeout) as r:
-            return r.status, r.read(), dict(r.headers)
+        model = getattr(self, "_model_hdr", None)
+        if model is not None:
+            # header-form tenant routing: dropping it would silently
+            # serve the default tenant's answer with a 200
+            headers["X-Model"] = model
+        return pool.conns.request(
+            base, method, path, body=body, headers=headers,
+            on_reuse=lambda: pool._count("conn_reuse"))
 
     def _reply(self, code: int, body: bytes,
                headers: Optional[dict] = None):
@@ -279,28 +393,22 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         ('skip', ...) when the breaker refuses admission (open, or a
         probe already in flight), or raises on a connection-level failure
         (breaker already fed)."""
-        import urllib.error
-
         if not worker.breaker.try_acquire():
             return ("skip", 0, b"")
-        url = worker.url
         try:
-            code, out, _ = self._forward("POST", url + self.path, body)
-            worker.breaker.record_success()
-            return ("relay", code, out)
-        except urllib.error.HTTPError as e:
-            # the worker is ALIVE and answered: its breaker stays closed.
-            # 429/503 are backpressure/draining — route around, the next
-            # worker may have queue room; other codes (400 bad payload /
-            # 500 model error) relay as the worker's verdict
-            worker.breaker.record_success()
-            payload = e.read()
-            if e.code in (429, 503):
-                return ("busy", e.code, payload)
-            return ("relay", e.code, payload)
+            code, out, _ = self._forward("POST", worker.url, self.path,
+                                         body)
         except Exception:
             worker.breaker.record_failure()
             raise
+        # the worker is ALIVE and answered: its breaker stays closed.
+        # 429/503 are backpressure/draining — route around, the next
+        # worker may have queue room; other codes (400 bad payload /
+        # 500 model error) relay as the worker's verdict
+        worker.breaker.record_success()
+        if code in (429, 503):
+            return ("busy", code, out)
+        return ("relay", code, out)
 
     def do_POST(self):
         pool: "ServingPool" = self.server.pool
@@ -309,9 +417,11 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             if length < 0:
                 raise ValueError(length)  # read(-1) would buffer to EOF
         except ValueError:
+            self.close_connection = True  # unread body poisons keep-alive
             return self._reply(400, b'{"error": "bad Content-Length"}')
         if length > pool.max_body_bytes:
             pool._count("rejected_oversize")
+            self.close_connection = True
             return self._reply(413, json.dumps(
                 {"error": f"request body {length} bytes exceeds limit "
                           f"{pool.max_body_bytes}"}).encode())
@@ -340,6 +450,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                           "[A-Za-z0-9._:-]{1,128}"}).encode())
         self._rid = rid or uuid.uuid4().hex
         self._deadline_hdr = self.headers.get("X-Deadline-S")
+        self._model_hdr = self.headers.get("X-Model")
         rid_hdr = {"X-Request-Id": self._rid}
         # breaker-aware routing, starting at the round-robin cursor: dead
         # or breaker-open workers are skipped without burning a connect
@@ -429,25 +540,38 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         pool: "ServingPool" = self.server.pool
         # handler instances persist per keep-alive CONNECTION: a prior
-        # POST's correlation id/deadline must not ride along on probes
+        # POST's correlation id/deadline/model must not ride along on
+        # probes
         self._rid = None
         self._deadline_hdr = None
+        self._model_hdr = None
         if self.path == "/metrics":
             # proxy-process registry (serving_pool.* counters); each
             # worker additionally serves its own /metrics on its frontend
             return reply_metrics(self)
+        if self.path == "/models":
+            # the registry lives in the workers; relay the first answer
+            for w in pool._next_workers():
+                try:
+                    code, out, _ = self._forward("GET", w.url, "/models",
+                                                 None)
+                    return self._reply(code, out)
+                except Exception:  # noqa: BLE001 — try the next worker
+                    continue
+            return self._reply(503, b'{"error": "no worker available"}')
         if self.path != "/health":
             return self._reply(404, b'{"error": "unknown path"}')
         agg = {"status": "ok", "restarts": pool.restarts,
-               "pool": dict(pool.stats), "workers": []}
-        for w in pool.workers:
+               "pool": dict(pool.stats),
+               "autoscale": pool.autoscale_snapshot(), "workers": []}
+        for w in pool.worker_list():
             # url reflects the CURRENT process: spawn() clears it before
             # launching, so a corpse's old endpoint never shows up here
-            one = {"url": w.url, "alive": w.alive(),
+            one = {"name": w.name, "url": w.url, "alive": w.alive(),
                    "breaker": w.breaker.snapshot()}
             if w.alive() and w.url:
                 try:
-                    _, out, _ = self._forward("GET", w.url + "/health", None)
+                    _, out, _ = self._forward("GET", w.url, "/health", None)
                     one.update(json.loads(out))
                 except Exception as e:  # noqa: BLE001
                     one["error"] = str(e)
@@ -464,7 +588,9 @@ class _ProxyHandler(BaseHTTPRequestHandler):
 class ServingPool:
     """N process-isolated serving workers behind one round-robin proxy
     with liveness supervision (dead workers are respawned), per-worker
-    circuit breakers, and drain-before-kill shutdown."""
+    circuit breakers, drain-before-kill shutdown, keep-alive forwarding,
+    and optional metrics-driven autoscaling between ``min_workers`` and
+    ``max_workers``."""
 
     def __init__(self, loader: str, workers: int = 2, batch_size: int = 32,
                  queue_capacity: int = 4096, host: str = "127.0.0.1",
@@ -476,7 +602,13 @@ class ServingPool:
                  hedge_after_s: Optional[float] = None,
                  drain_timeout_s: float = 5.0,
                  max_body_bytes: int = 64 * 1024 * 1024,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 autoscale_interval_s: float = 2.0,
+                 scale_up_queue_depth: Optional[float] = None,
+                 scale_down_after: int = 3,
+                 scale_cooldown_s: float = 5.0):
         self.loader = loader
         self.n = workers
         self.batch_size = batch_size
@@ -488,11 +620,31 @@ class ServingPool:
         self.drain_timeout_s = drain_timeout_s
         self.max_body_bytes = max_body_bytes
         self.retry_after_s = retry_after_s
+        # autoscaling bounds: [min_workers, max_workers] around the
+        # initial size; equal bounds (the default) disable the scaler
+        self.min_workers = min(workers, min_workers
+                               if min_workers is not None else workers)
+        self.max_workers = max(workers, max_workers
+                               if max_workers is not None else workers)
+        self.autoscale_interval_s = autoscale_interval_s
+        # pressure threshold: average queued requests per routable worker
+        # that triggers a scale-up; default half a batch — the queue is
+        # persistently outrunning one assembly window
+        self.scale_up_queue_depth = (scale_up_queue_depth
+                                     if scale_up_queue_depth is not None
+                                     else max(1.0, batch_size / 2))
+        self.scale_down_after = scale_down_after
+        self.scale_cooldown_s = scale_cooldown_s
+        self._idle_ticks = 0
+        self._last_scale_t = 0.0
         self.workers: List[_Worker] = []
+        self._workers_lock = threading.Lock()
+        self._worker_seq = 0
         self._rr = 0
         self._rr_lock = threading.Lock()
         self._stop = threading.Event()
         self._supervise_interval = supervise_interval_s
+        self.conns = _ConnPool(predict_timeout)
         self._httpd = ThreadingHTTPServer((host, port), _ProxyHandler)
         self._httpd.pool = self  # type: ignore[attr-defined]
         self._httpd.predict_timeout = predict_timeout  # type: ignore[attr-defined]
@@ -501,7 +653,8 @@ class ServingPool:
         self.restarts = 0
         self._stats_lock = threading.Lock()
         self.stats = {"hedged_requests": 0, "proxy_busy": 0,
-                      "proxy_unavailable": 0, "rejected_oversize": 0}
+                      "proxy_unavailable": 0, "rejected_oversize": 0,
+                      "conn_reuse": 0, "scale_up": 0, "scale_down": 0}
 
     def _count(self, name: str, n: int = 1) -> None:
         # proxy handler threads count concurrently; += is not atomic
@@ -515,44 +668,67 @@ class ServingPool:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def worker_list(self) -> List[_Worker]:
+        """Point-in-time copy — the autoscaler mutates ``workers``."""
+        with self._workers_lock:
+            return list(self.workers)
+
     # -- routing ------------------------------------------------------------
     def _next_workers(self) -> List[_Worker]:
         """Routable workers (alive, registered url, breaker admits) in
         round-robin order starting at the cursor."""
+        workers = self.worker_list()
+        if not workers:
+            return []
         with self._rr_lock:
             self._rr += 1
             start = self._rr
-        ordered = [self.workers[(start + i) % len(self.workers)]
-                   for i in range(len(self.workers))]
+        ordered = [workers[(start + i) % len(workers)]
+                   for i in range(len(workers))]
         return [w for w in ordered if w.routable()]
 
     def _next_urls(self) -> List[str]:
         return [w.url for w in self._next_workers()]
 
     # -- lifecycle ----------------------------------------------------------
+    def _new_worker(self) -> _Worker:
+        with self._workers_lock:
+            name = f"worker-{self._worker_seq}"
+            self._worker_seq += 1
+        return _Worker(self.loader, self.batch_size, self.queue_capacity,
+                       self.worker_env, self.breaker_threshold,
+                       self.breaker_cooldown_s, self.drain_timeout_s,
+                       name=name)
+
     def start(self) -> "ServingPool":
-        for i in range(self.n):
-            w = _Worker(self.loader, self.batch_size, self.queue_capacity,
-                        self.worker_env, self.breaker_threshold,
-                        self.breaker_cooldown_s, self.drain_timeout_s,
-                        name=f"worker-{i}")
+        for _ in range(self.n):
+            w = self._new_worker()
             w.spawn()
-            self.workers.append(w)
+            with self._workers_lock:
+                self.workers.append(w)
+        self._gauge_workers()
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
         s = threading.Thread(target=self._supervise, daemon=True)
         s.start()
         self._threads = [t, s]
-        log.info("serving pool: %d workers behind %s", self.n, self.url)
+        if self.max_workers > self.min_workers:
+            a = threading.Thread(target=self._autoscale_run, daemon=True)
+            a.start()
+            self._threads.append(a)
+        log.info("serving pool: %d workers behind %s (autoscale %d..%d)",
+                 self.n, self.url, self.min_workers, self.max_workers)
         return self
 
     def _supervise(self) -> None:
         """Flink-style task supervision: respawn dead workers."""
         while not self._stop.is_set():
-            for w in self.workers:
+            for w in self.worker_list():
                 if not w.alive() and not self._stop.is_set():
                     log.warning("serving worker %s died; respawning", w.url)
                     flight.record("worker_died", worker=w.name, url=w.url)
+                    if w.url:
+                        self.conns.clear(w.url)  # the corpse's sockets
                     w.url = None  # stale endpoint: not routable, not
                     #               reported by /health as the corpse's
                     try:
@@ -562,6 +738,148 @@ class ServingPool:
                         log.error("respawn failed: %s", e)
             self._stop.wait(self._supervise_interval)
 
+    # -- autoscaling --------------------------------------------------------
+    def _worker_health(self, w: _Worker) -> Optional[dict]:
+        """One /health probe over the keep-alive pool; None when the
+        worker cannot answer (the supervisor's problem, not ours)."""
+        if not w.routable():
+            return None
+        try:
+            _, data, _ = self.conns.request(w.url, "GET", "/health")
+            return json.loads(data)
+        except Exception:  # noqa: BLE001 — dead socket or non-JSON body
+            return None
+
+    def pool_pressure(self) -> dict:
+        """The autoscaler's input, from signals the workers already
+        export: queue depth and latency percentiles via ``/health``
+        (which reads the same gauges/histograms ``/metrics`` scrapes)."""
+        depths, p99s = [], []
+        breaker_open = False
+        for w in self.worker_list():
+            breaker_open |= w.breaker.snapshot()["state"] != "closed"
+            h = self._worker_health(w)
+            if h is None:
+                continue
+            # backlog (heaps + assembled-but-unpredicted) is the honest
+            # pressure number — the continuous engine's handoff slot
+            # absorbs a queue_depth's worth of waiting work
+            depths.append(float(h.get("backlog", h.get("queue_depth", 0))))
+            p99s.append(float(h.get("p99_ms", 0.0)))
+        return {
+            "routable": len(depths),
+            "avg_queue_depth": sum(depths) / len(depths) if depths else 0.0,
+            "max_p99_ms": max(p99s) if p99s else 0.0,
+            "breaker_open": breaker_open,
+        }
+
+    @staticmethod
+    def autoscale_decision(n_workers: int, min_workers: int,
+                           max_workers: int, avg_queue_depth: float,
+                           up_depth: float, idle_ticks: int,
+                           down_after: int, breaker_open: bool,
+                           since_last_scale_s: float,
+                           cooldown_s: float) -> str:
+        """Pure scaling policy (unit-testable without subprocesses),
+        asymmetric on purpose: 'up' on a single over-threshold pressure
+        tick below the max bound (queued users are waiting NOW; the
+        cooldown rate-limits repeats), 'down' only after ``down_after``
+        consecutive idle ticks above the min bound — never while a
+        breaker is open (a sick worker's load is about to redistribute;
+        shrinking now would double the shock), never inside the cooldown
+        window after the previous action."""
+        if since_last_scale_s < cooldown_s:
+            return "hold"
+        if avg_queue_depth >= up_depth and n_workers < max_workers:
+            return "up"
+        if (avg_queue_depth < 0.5 and idle_ticks >= down_after
+                and n_workers > min_workers and not breaker_open):
+            return "down"
+        return "hold"
+
+    def autoscale_snapshot(self) -> dict:
+        return {"min": self.min_workers, "max": self.max_workers,
+                "workers": len(self.worker_list()),
+                "enabled": self.max_workers > self.min_workers,
+                "up_depth": self.scale_up_queue_depth,
+                "idle_ticks": self._idle_ticks}
+
+    def _gauge_workers(self) -> None:
+        global_metrics().gauge("serving_pool.workers",
+                               len(self.worker_list()))
+
+    def _autoscale_run(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.autoscale_interval_s)
+            if self._stop.is_set():
+                return
+            try:
+                self._autoscale_tick()
+            except Exception as e:  # noqa: BLE001 — scaler must outlive a tick
+                log.error("autoscale tick failed: %s", e)
+
+    def _autoscale_tick(self) -> None:
+        p = self.pool_pressure()
+        if p["routable"] == 0:
+            return  # nothing measurable; supervision owns dead workers
+        self._idle_ticks = (self._idle_ticks + 1
+                            if p["avg_queue_depth"] < 0.5 else 0)
+        decision = self.autoscale_decision(
+            len(self.worker_list()), self.min_workers, self.max_workers,
+            p["avg_queue_depth"], self.scale_up_queue_depth,
+            self._idle_ticks, self.scale_down_after, p["breaker_open"],
+            time.time() - self._last_scale_t, self.scale_cooldown_s)
+        if decision == "up":
+            self._scale_up(p)
+        elif decision == "down":
+            self._scale_down(p)
+
+    def _scale_up(self, pressure: dict) -> None:
+        w = self._new_worker()
+        try:
+            w.spawn()
+        except Exception as e:  # noqa: BLE001 — retried next tick
+            log.error("scale-up spawn failed: %s", e)
+            return
+        with self._workers_lock:
+            self.workers.append(w)
+        self._last_scale_t = time.time()
+        self._count("scale_up")
+        self._gauge_workers()
+        flight.record("pool_scale_up", worker=w.name,
+                      workers=len(self.worker_list()), **pressure)
+        log.info("autoscale: +%s (avg queue depth %.1f >= %.1f) -> %d "
+                 "workers", w.name, pressure["avg_queue_depth"],
+                 self.scale_up_queue_depth, len(self.worker_list()))
+
+    def _scale_down(self, pressure: dict) -> None:
+        # newest healthy worker leaves; removal from the routing list
+        # comes FIRST, then the drain (stdin close -> the worker finishes
+        # its queued requests within its budget) — PR 2's drain semantics
+        with self._workers_lock:
+            victim = next((w for w in reversed(self.workers)
+                           if w.alive()
+                           and w.breaker.snapshot()["state"] == "closed"),
+                          None)
+            if victim is None or len(self.workers) <= self.min_workers:
+                return
+            self.workers.remove(victim)
+        self._last_scale_t = time.time()
+        self._idle_ticks = 0
+        # the action is visible (victim out of the routing list) NOW —
+        # count/gauge/flight before the drain, so no reader ever sees a
+        # shrunken pool with a zero scale_down count
+        self._count("scale_down")
+        self._gauge_workers()
+        flight.record("pool_scale_down", worker=victim.name,
+                      workers=len(self.worker_list()), **pressure)
+        log.info("autoscale: -%s (idle) -> %d workers", victim.name,
+                 len(self.worker_list()))
+        victim.request_stop()
+        victim.join_stop()
+        if victim.url:
+            self.conns.clear(victim.url)
+
     def stop(self) -> None:
         """Shut down: close the proxy to new requests, then drain each
         worker (stdin close -> worker finishes queued requests within its
@@ -569,12 +887,14 @@ class ServingPool:
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        workers = self.worker_list()
         # start every worker's drain first, THEN wait: one shared drain
         # window instead of O(workers * budget) sequential shutdowns
-        for w in self.workers:
+        for w in workers:
             w.request_stop()
-        for w in self.workers:
+        for w in workers:
             w.join_stop()
+        self.conns.clear()
 
 
 def _main() -> None:
@@ -587,6 +907,8 @@ def _main() -> None:
     ap.add_argument("--queue-capacity", type=int, default=4096)
     ap.add_argument("--drain-timeout", type=float, default=5.0)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--min-workers", type=int, default=None)
+    ap.add_argument("--max-workers", type=int, default=None)
     ap.add_argument("--port", type=int, default=8000)
     args = ap.parse_args()
     if args.worker:
@@ -596,6 +918,8 @@ def _main() -> None:
     pool = ServingPool(args.loader, workers=args.workers,
                        batch_size=args.batch_size,
                        queue_capacity=args.queue_capacity,
+                       min_workers=args.min_workers,
+                       max_workers=args.max_workers,
                        port=args.port).start()
     print(f"POOL_URL={pool.url}", flush=True)
     try:

@@ -8,28 +8,47 @@ request id.
 
 TPU-native: the transport is an in-process (or file-backed) queue pair —
 Redis/Flink are cluster plumbing, not capability — while the batching loop,
-backpressure and at-least-once result delivery semantics match.  A
-dispatcher thread owns the chip; client threads only enqueue.
+backpressure and at-least-once result delivery semantics match.  Client
+threads only enqueue; the engine owns the chip.
+
+Continuous batching (docs/serving.md §Continuous batching): the engine is
+TWO threads with a double-buffered handoff.  The *assembler* builds the
+next batch — popping per-model heaps in deadline order, so a near-expiry
+request jumps the window — WHILE the *predict* thread runs the current
+one; assembly time hides under predict time instead of stalling behind
+it, which is what turns the fixed-window loop's 21× p99/p50 tail ratio
+into throughput.  Wakeup is event-driven (one condition variable fed by
+``enqueue``): no polling loop, no idle CPU burn, no 50 ms of avoidable
+sparse-traffic latency.  The legacy fixed-window loop survives behind
+``ServingConfig(continuous=False)`` as the parity reference.
+
+Multi-tenancy: a model registry (``register_model``) gives every model its
+own bounded admission heap, weighted stride scheduling across tenants
+sharing the one predict engine, per-tenant degradation/fallback, and
+per-tenant ``serving.tenant.<name>.*`` latency/queue metrics — one
+``/metrics`` scrape shows every tenant's SLO.
 
 Request lifecycle (docs/serving.md has the state machine): every request
-carries an admission time and an absolute deadline from ``enqueue`` through
-the queue into the batch loop.  Admission fails fast — a full queue sheds
-(``ServiceUnavailableError``, never an unbounded block), a degraded server
-sheds (half-open probing excepted) — and the batch loop drops expired
-requests BEFORE predict so a slow model never spends chip time answering a
-client that already gave up.  Completed results live in a TTL'd table so an
-abandoned ``query`` cannot leak entries forever, and shutdown is explicit:
-``drain()`` finishes queued work, plain ``stop()`` fails it with
-``RequestDroppedError`` — queued requests are never silently discarded.
+carries an admission time and an absolute deadline from ``enqueue``
+through the queue into the batch loop.  Admission fails fast — a full
+queue sheds (``ServiceUnavailableError``, never an unbounded block), a
+degraded tenant sheds (half-open probing excepted) — and the engine drops
+expired requests BEFORE predict so a slow model never spends chip time
+answering a client that already gave up.  Completed results live in a
+TTL'd table so an abandoned ``query`` cannot leak entries forever, and
+shutdown is explicit: ``drain()`` finishes queued work, plain ``stop()``
+fails it with ``RequestDroppedError`` — queued requests are never
+silently discarded.
 """
 
+import heapq
 import math
-import queue
+import re
 import threading
 import time
 import uuid
-from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -41,6 +60,12 @@ from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving")
 
+# registered model names become metric names and URL/JSON tokens; keep
+# them to the same header-safe grammar as request ids
+MODEL_NAME_RE = re.compile(r"[A-Za-z0-9._\-]{1,64}")
+
+DEFAULT_MODEL = "default"
+
 
 @dataclass
 class ServingConfig:
@@ -49,8 +74,15 @@ class ServingConfig:
     batch_size: int = 32
     batch_timeout_s: float = 0.005
     queue_capacity: int = 4096
+    # the engine mode: continuous (assembler builds the next batch while
+    # predict runs the current one) vs the legacy fixed-window loop kept
+    # as the parity/regression reference
+    continuous: bool = True
+    # cap on stacked rows per predict batch; None derives it from the
+    # model's largest batch bucket so one batch is one compiled program
+    max_batch_rows: Optional[int] = None
     # graceful degradation: after this many CONSECUTIVE failed predict
-    # batches the server is 'degraded' — it serves from the last-good
+    # batches a TENANT is 'degraded' — it serves from its last-good
     # fallback model if one is set, and sheds new load otherwise
     degraded_after_failures: int = 3
     # half-open probing while degraded WITHOUT a fallback: one request per
@@ -118,32 +150,80 @@ class _Request:
     arr: np.ndarray
     admit_t: float
     deadline_t: float  # math.inf when the request never expires
+    model: str = DEFAULT_MODEL
+    seq: int = 0       # admission order — the deadline-heap tiebreak
+
+    @property
+    def rows(self) -> int:
+        return self.arr.shape[0] if self.arr.ndim > 1 else 1
+
+
+@dataclass
+class _Tenant:
+    """One registered model: its admission heap + scheduling and
+    degradation state.  Heap entries are ``(deadline_t, seq, req)`` so
+    near-expiry requests sort first and no-deadline requests stay FIFO."""
+
+    name: str
+    model: Any
+    weight: float = 1.0
+    fallback: Optional[Any] = None
+    heap: List = field(default_factory=list)
+    # stride-scheduling position: the assembler serves the tenant with the
+    # lowest pass value; serving k requests advances it by k/weight, so
+    # long-run service is proportional to weight
+    pass_value: float = 0.0
+    degraded: bool = False
+    consecutive_failures: int = 0
+    last_probe_t: float = 0.0
+
+    def rows_cap(self, cfg: ServingConfig) -> Optional[int]:
+        if cfg.max_batch_rows is not None:
+            return cfg.max_batch_rows
+        buckets = getattr(self.model, "buckets", None)
+        return max(buckets) if buckets else None
+
+
+class _QueueView:
+    """Read-only queue facade: ``qsize``/``empty`` over the per-tenant
+    heaps, so callers (and tests) that watched the old ``queue.Queue``
+    keep one stable surface."""
+
+    def __init__(self, srv: "ServingServer"):
+        self._srv = srv
+
+    def qsize(self) -> int:
+        with self._srv._work_cv:
+            return sum(len(t.heap) for t in self._srv._tenants.values())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
 
 
 class ServingServer:
-    """queue -> dynamic batch -> jitted predict -> result table.
+    """per-model heaps -> continuous batch assembly -> jitted predict ->
+    result table.
 
     Resilience posture (reference Cluster-Serving keeps serving while a
-    replica restarts): a streak of predict failures flips the server to
+    replica restarts): a streak of predict failures flips a tenant to
     DEGRADED.  Degraded with a fallback model (``set_fallback_model`` —
     typically the previous good version) keeps answering from it;
-    degraded without one sheds new load at ``enqueue`` so callers retry
-    another replica.  ``reload_model`` installs a restarted replica's
-    model and clears degradation.
+    degraded without one sheds that tenant's new load at ``enqueue`` so
+    callers retry another replica — other tenants are unaffected.
+    ``reload_model`` installs a restarted replica's model and clears
+    degradation.
 
     Every lifecycle event (shed, expiry, drain, drop, GC) lands in
     ``stats`` and — namespaced ``serving.*`` — in the process
     :class:`~bigdl_tpu.optim.metrics.Metrics` registry, so ``/health``
     and training-side metric consumers see the same counters."""
 
-    def __init__(self, model: InferenceModel,
+    def __init__(self, model: Optional[InferenceModel] = None,
                  config: Optional[ServingConfig] = None,
-                 metrics: Optional[Metrics] = None):
-        self.model = model
+                 metrics: Optional[Metrics] = None,
+                 models: Optional[Dict[str, Any]] = None):
         self.config = config or ServingConfig()
         self.metrics = metrics or global_metrics()
-        self._in: "queue.Queue[_Request]" = queue.Queue(
-            self.config.queue_capacity)
         self._results: Dict[str, Any] = {}
         self._result_expiry: Dict[str, float] = {}
         # rids admitted but not yet published — with caller-supplied ids
@@ -154,13 +234,31 @@ class ServingServer:
         self._last_gc_t = 0.0
         self._stop = threading.Event()
         self._draining = False
-        self._busy = False  # engine thread is inside _process
-        self._thread: Optional[threading.Thread] = None
-        self._fallback_model: Optional[InferenceModel] = None
-        self._consecutive_failures = 0
-        self._last_probe_t = 0.0
+        self._busy = False  # engine is expiring/predicting a batch
+        self._threads: List[threading.Thread] = []
         self._probe_lock = threading.Lock()
-        self.degraded = False
+        # -- work board: tenant heaps + the double-buffered handoff slot.
+        # ONE condition carries every engine wakeup: enqueue (new work),
+        # batch handoff (slot filled), predict going idle (slot free),
+        # heap pops (queue room for bounded enqueue waiters), stop.
+        self._work_cv = threading.Condition()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._slot: Optional[List[_Request]] = None
+        self._predict_waiting = False
+        self._assembling_n = 0   # requests popped into a batch being built
+        self._seq_n = 0
+        self._predict_ema_s = 0.01  # urgency horizon for deadline jumps
+        self._in = _QueueView(self)
+        if models:
+            for name, m in models.items():
+                self.register_model(name, m)
+            self._default_name = DEFAULT_MODEL if DEFAULT_MODEL in models \
+                else next(iter(models))
+        elif model is not None:
+            self.register_model(DEFAULT_MODEL, model)
+            self._default_name = DEFAULT_MODEL
+        else:
+            raise ValueError("need a model (or models={name: model, ...})")
         self._stats_lock = threading.Lock()
         self.stats = {"batches": 0, "requests": 0, "failed_batches": 0,
                       "fallback_batches": 0, "shed_requests": 0,
@@ -176,6 +274,115 @@ class ServingServer:
                               "already expired")
         self.metrics.describe("serving.predict_s",
                               "model predict wall time per batch")
+        self.metrics.describe("serving.queue_wait_s",
+                              "admission-to-predict queue wait per request "
+                              "(latency_s minus this is predict+publish)")
+        self.metrics.describe("serving.batch_occupancy",
+                              "cumulative avg batch fill / batch_size")
+        self.metrics.describe("serving.queue_depth",
+                              "requests queued across all model heaps")
+
+    # -- model registry -----------------------------------------------------
+    def register_model(self, name: str, model: Any,
+                       weight: float = 1.0) -> "ServingServer":
+        """Add a tenant: its own bounded queue and SLO accounting, sharing
+        this engine's predict loop under weighted admission."""
+        if not MODEL_NAME_RE.fullmatch(name):
+            raise ValueError(f"bad model name {name!r}: must match "
+                             "[A-Za-z0-9._-]{1,64}")
+        if weight <= 0:
+            raise ValueError(f"model weight must be > 0, got {weight}")
+        with self._work_cv:
+            if name in self._tenants:
+                raise ValueError(f"model {name!r} already registered; use "
+                                 "reload_model to replace it")
+            t = _Tenant(name, model, float(weight))
+            # join the stride rotation at the current frontier: a new
+            # tenant must not replay the service its peers already used
+            if self._tenants:
+                t.pass_value = max(x.pass_value
+                                   for x in self._tenants.values())
+            self._tenants[name] = t
+        self.metrics.describe(f"serving.tenant.{name}.latency_s",
+                              f"model {name}: admission-to-publish latency")
+        return self
+
+    def unregister_model(self, name: str) -> None:
+        """Remove a tenant; its queued requests get an explicit
+        :class:`RequestDroppedError` — never a silent drop."""
+        if name == self._default_name:
+            raise ValueError(f"cannot unregister the default model {name!r}")
+        with self._work_cv:
+            t = self._tenants.pop(name, None)
+            reqs = [r for _, _, r in t.heap] if t else []
+            self._work_cv.notify_all()
+        if reqs:
+            self._deliver_dropped(reqs)
+
+    def models(self) -> Dict[str, dict]:
+        """Registry snapshot for ``GET /models`` and the autoscaler."""
+        with self._work_cv:
+            return {t.name: {"weight": t.weight, "degraded": t.degraded,
+                             "queue_depth": len(t.heap),
+                             "default": t.name == self._default_name,
+                             "fallback": t.fallback is not None}
+                    for t in self._tenants.values()}
+
+    def backlog(self) -> int:
+        """Admitted requests not yet in predict: tenant heaps + the
+        assembled handoff slot + a batch mid-assembly.  THE autoscaling
+        pressure signal — the heaps alone go quiet once the double
+        buffer absorbs a backlog (``_QueueView.qsize`` stays heap-only:
+        it is the bounded-admission capacity the enqueue path enforces)."""
+        with self._work_cv:
+            return (sum(len(t.heap) for t in self._tenants.values())
+                    + (len(self._slot) if self._slot else 0)
+                    + self._assembling_n)
+
+    def _default(self) -> _Tenant:
+        return self._tenants[self._default_name]
+
+    # single-model compatibility surface: the pre-registry API (and the
+    # chaos suite) reads/writes these on the server itself
+    @property
+    def model(self):
+        return self._default().model
+
+    @model.setter
+    def model(self, m) -> None:
+        self._default().model = m
+
+    @property
+    def degraded(self) -> bool:
+        return self._default().degraded
+
+    @degraded.setter
+    def degraded(self, v: bool) -> None:
+        self._default().degraded = v
+
+    @property
+    def _fallback_model(self):
+        return self._default().fallback
+
+    @_fallback_model.setter
+    def _fallback_model(self, m) -> None:
+        self._default().fallback = m
+
+    @property
+    def _last_probe_t(self) -> float:
+        return self._default().last_probe_t
+
+    @_last_probe_t.setter
+    def _last_probe_t(self, t: float) -> None:
+        self._default().last_probe_t = t
+
+    @property
+    def _consecutive_failures(self) -> int:
+        return self._default().consecutive_failures
+
+    @_consecutive_failures.setter
+    def _consecutive_failures(self, n: int) -> None:
+        self._default().consecutive_failures = n
 
     def _count(self, name: str, n: int = 1) -> None:
         # client threads and the engine thread both count; += on a dict
@@ -186,9 +393,28 @@ class ServingServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingServer":
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        if self.config.continuous:
+            self._threads = [
+                threading.Thread(target=self._assemble_run, daemon=True,
+                                 name="serving-assembler"),
+                threading.Thread(target=self._predict_run, daemon=True,
+                                 name="serving-predict"),
+            ]
+        else:
+            self._threads = [threading.Thread(target=self._run_fixed,
+                                              daemon=True,
+                                              name="serving-engine")]
+        for t in self._threads:
+            t.start()
         return self
+
+    def _work_pending(self) -> bool:
+        """Anything still owed an answer: queued, being assembled, parked
+        in the handoff slot, or in predict."""
+        with self._work_cv:
+            return (self._busy or self._slot is not None
+                    or self._assembling_n > 0
+                    or any(t.heap for t in self._tenants.values()))
 
     def drain(self, timeout: Optional[float] = None) -> Dict[str, int]:
         """Graceful shutdown: stop admitting, let the engine finish queued
@@ -201,12 +427,10 @@ class ServingServer:
         t_end = time.time() + timeout
         drained_from = self.stats["requests"]
         while time.time() < t_end:
-            if self._in.empty() and not self._busy:
+            if not self._work_pending():
                 break
             time.sleep(0.005)
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=max(timeout, 5))
+        self._shutdown_threads(join_timeout=max(timeout, 5))
         dropped = self._fail_queued()
         drained = self.stats["requests"] - drained_from
         self._count("drained_requests", drained)
@@ -225,59 +449,75 @@ class ServingServer:
             self.drain(timeout)
             return
         self._draining = True
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self._shutdown_threads(join_timeout=5)
         self._fail_queued()
 
-    def _fail_queued(self) -> int:
-        """Deliver RequestDroppedError to everything still queued."""
-        dropped = 0
+    def _shutdown_threads(self, join_timeout: float) -> None:
+        self._stop.set()
+        with self._work_cv:
+            self._work_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=join_timeout)
+
+    def _deliver_dropped(self, reqs: List[_Request]) -> int:
         now = time.time()
         with self._result_cv:
-            while True:
-                try:
-                    req = self._in.get_nowait()
-                except queue.Empty:
-                    break
+            for req in reqs:
                 self._results[req.rid] = RequestDroppedError(req.rid)
                 self._result_expiry[req.rid] = now + self.config.result_ttl_s
                 self._pending.discard(req.rid)
-                dropped += 1
-            if dropped:
+            if reqs:
                 self._result_cv.notify_all()
-        if dropped:
-            self._count("dropped_requests", dropped)
-            flight.record("serving_requests_dropped", count=dropped)
-        return dropped
+        if reqs:
+            self._count("dropped_requests", len(reqs))
+            flight.record("serving_requests_dropped", count=len(reqs))
+        return len(reqs)
+
+    def _fail_queued(self) -> int:
+        """Deliver RequestDroppedError to everything still queued —
+        including a batch parked in the handoff slot."""
+        with self._work_cv:
+            reqs: List[_Request] = []
+            for t in self._tenants.values():
+                reqs.extend(r for _, _, r in t.heap)
+                t.heap.clear()
+            if self._slot is not None:
+                reqs.extend(self._slot)
+                self._slot = None
+            self._work_cv.notify_all()
+        return self._deliver_dropped(reqs)
 
     # -- degradation control ------------------------------------------------
-    def set_fallback_model(self, model: InferenceModel) -> "ServingServer":
-        """Register the last-good model; while degraded, batches are served
-        from it instead of failing."""
-        self._fallback_model = model
+    def set_fallback_model(self, model: Any,
+                           name: Optional[str] = None) -> "ServingServer":
+        """Register the last-good model; while degraded, that tenant's
+        batches are served from it instead of failing."""
+        self._tenants[name or self._default_name].fallback = model
         return self
 
-    def reload_model(self, model: InferenceModel) -> None:
+    def reload_model(self, model: Any, name: Optional[str] = None) -> None:
         """Install a (restarted) replica's model; the old primary becomes
         the fallback and degradation clears."""
-        self._fallback_model = self.model if not self.degraded \
-            else self._fallback_model
-        self.model = model
-        self._consecutive_failures = 0
-        if self.degraded:
-            log.info("serving: model reloaded; leaving degraded mode")
-            flight.record("serving_recovered", via="reload_model")
-        self.degraded = False
+        t = self._tenants[name or self._default_name]
+        t.fallback = t.model if not t.degraded else t.fallback
+        t.model = model
+        t.consecutive_failures = 0
+        if t.degraded:
+            log.info("serving: model %s reloaded; leaving degraded mode",
+                     t.name)
+            flight.record("serving_recovered", via="reload_model",
+                          model=t.name)
+        t.degraded = False
 
     # -- client side --------------------------------------------------------
     def enqueue(self, arr: np.ndarray, request_id: Optional[str] = None,
-                deadline_s: Optional[float] = None) -> str:
-        """Admit one request.  Never blocks beyond
-        ``config.enqueue_block_s``: a full queue, a draining/stopped
-        server, or degradation without fallback all raise
-        :class:`ServiceUnavailableError` at admission (counted as
-        ``shed_requests``).  ``deadline_s`` is relative to now; it
+                deadline_s: Optional[float] = None,
+                model: Optional[str] = None) -> str:
+        """Admit one request for ``model`` (default tenant when None).
+        Never blocks beyond ``config.enqueue_block_s``: a full queue, a
+        draining/stopped server, or tenant degradation without fallback
+        all raise :class:`ServiceUnavailableError` at admission (counted
+        as ``shed_requests``).  ``deadline_s`` is relative to now; it
         defaults to ``config.default_deadline_s`` (None = no expiry)."""
         cfg = self.config
         if self._draining or self._stop.is_set():
@@ -285,7 +525,13 @@ class ServingServer:
             raise ServiceUnavailableError(
                 "server is draining/stopped; retry against another replica",
                 retry_after=cfg.retry_after_s)
-        if self.degraded and self._fallback_model is None:
+        name = model or self._default_name
+        tenant = self._tenants.get(name)
+        if tenant is None:
+            raise KeyError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._tenants)}")
+        if tenant.degraded and tenant.fallback is None:
             # half-open: admit one probe per interval so a recovered
             # model can clear degradation; shed everything else —
             # admission-time fast-fail beats letting the request rot in
@@ -293,23 +539,23 @@ class ServingServer:
             with self._probe_lock:  # check-then-set: exactly ONE probe
                 #                     per interval across client threads
                 now = time.time()
-                is_probe = (now - self._last_probe_t
+                is_probe = (now - tenant.last_probe_t
                             >= cfg.degraded_probe_interval_s)
                 if is_probe:
-                    self._last_probe_t = now
+                    tenant.last_probe_t = now
                 else:
                     self._count("shed_requests")
             if not is_probe:
                 raise ServiceUnavailableError(
-                    "server degraded (predict failing) and no fallback "
-                    "model; shedding load — retry against another replica",
-                    retry_after=cfg.retry_after_s)
+                    f"model {name} degraded (predict failing) and no "
+                    "fallback; shedding load — retry against another "
+                    "replica", retry_after=cfg.retry_after_s)
         rid = request_id or uuid.uuid4().hex
         now = time.time()
         if deadline_s is None:
             deadline_s = cfg.default_deadline_s
         deadline_t = now + deadline_s if deadline_s is not None else math.inf
-        req = _Request(rid, np.asarray(arr), now, deadline_t)
+        req = _Request(rid, np.asarray(arr), now, deadline_t, model=name)
         with self._result_cv:
             if rid in self._pending:
                 # still in flight: two waiters must not race one result
@@ -325,13 +571,9 @@ class ServingServer:
             self._results.pop(rid, None)
             self._result_expiry.pop(rid, None)
             self._pending.add(rid)
-        try:
-            with trace.span("serving/enqueue", request_id=rid):
-                if cfg.enqueue_block_s > 0:
-                    self._in.put(req, timeout=cfg.enqueue_block_s)
-                else:
-                    self._in.put_nowait(req)
-        except queue.Full:
+        with trace.span("serving/enqueue", request_id=rid, model=name):
+            admitted = self._admit(tenant, req)
+        if not admitted:
             with self._result_cv:
                 self._pending.discard(rid)
             self._count("shed_requests")
@@ -344,6 +586,23 @@ class ServingServer:
             # verdict (either the engine processed it or it is now failed)
             self._fail_queued()
         return rid
+
+    def _admit(self, tenant: _Tenant, req: _Request) -> bool:
+        """Push into the tenant heap, bounded by ``queue_capacity``; waits
+        at most ``enqueue_block_s`` for room (0 = immediate verdict).
+        The push notifies the assembler — THE event-driven wakeup."""
+        cfg = self.config
+        t_end = time.time() + cfg.enqueue_block_s
+        with self._work_cv:
+            while len(tenant.heap) >= cfg.queue_capacity:
+                remaining = t_end - time.time()
+                if remaining <= 0 or self._stop.is_set() or self._draining:
+                    return False
+                self._work_cv.wait(remaining)
+            req.seq = self._seq_n = self._seq_n + 1
+            heapq.heappush(tenant.heap, (req.deadline_t, req.seq, req))
+            self._work_cv.notify_all()
+        return True
 
     def query(self, request_id: str, timeout: float = 30.0) -> np.ndarray:
         deadline = time.time() + timeout
@@ -359,40 +618,182 @@ class ServingServer:
             raise res
         return res
 
-    # -- engine loop --------------------------------------------------------
-    def _run(self) -> None:
+    # -- engine: continuous batching ----------------------------------------
+    def _pick_tenant(self, now: float) -> Optional[_Tenant]:
+        """Weighted, deadline-aware admission (caller holds ``_work_cv``):
+        a tenant whose head request is about to expire jumps the weighted
+        rotation (earliest deadline first); otherwise stride scheduling —
+        lowest pass value — shares the engine by weight."""
+        ts = [t for t in self._tenants.values() if t.heap]
+        if not ts:
+            return None
+        horizon = now + self.config.batch_timeout_s \
+            + 2 * self._predict_ema_s
+        urgent = [t for t in ts if t.heap[0][0] <= horizon]
+        if urgent:
+            return min(urgent, key=lambda t: t.heap[0][0])
+        return min(ts, key=lambda t: (t.pass_value, t.name))
+
+    def _assemble_run(self) -> None:
+        """Assembler half of the engine: builds the NEXT batch while the
+        predict thread runs the current one, handing off through the
+        single-slot buffer.  Exactly one batch ahead: more buffering would
+        defeat deadline ordering and inflate effective queue depth."""
+        cv = self._work_cv
+        while True:
+            with cv:
+                while not self._stop.is_set() and (
+                        self._slot is not None
+                        or not any(t.heap
+                                   for t in self._tenants.values())):
+                    cv.wait()
+                if self._stop.is_set():
+                    return
+                tenant = self._pick_tenant(time.time())
+                batch = self._fill_batch(tenant)
+                self._assembling_n = 0
+                if batch is None:   # stopped mid-fill; requests back home
+                    return
+                tenant.pass_value += len(batch) / tenant.weight
+                self._slot = batch
+                cv.notify_all()
+
+    def _fill_batch(self, tenant: _Tenant) -> Optional[List[_Request]]:
+        """Build one batch from ``tenant``'s heap (caller holds
+        ``_work_cv``; waits release it).  Pops in deadline order; caps at
+        ``batch_size`` requests and the model's largest bucket in rows so
+        one batch maps onto one compiled program.  While predict is busy
+        it keeps accumulating — assembly hides under predict — and once
+        predict is waiting it holds the ``batch_timeout_s`` window open
+        for stragglers, cut short when a batched deadline would not
+        survive the wait."""
         cfg = self.config
+        cv = self._work_cv
+        rows_cap = tenant.rows_cap(cfg)
+        batch: List[_Request] = []
+        rows = 0
+        t_first = time.time()
+        while True:
+            if self._stop.is_set():
+                # push the partial batch back for _fail_queued's sweep
+                for req in batch:
+                    heapq.heappush(tenant.heap,
+                                   (req.deadline_t, req.seq, req))
+                return None
+            popped = False
+            while tenant.heap and len(batch) < cfg.batch_size:
+                r = tenant.heap[0][2].rows
+                if batch and rows_cap is not None and rows + r > rows_cap:
+                    break
+                _, _, req = heapq.heappop(tenant.heap)
+                batch.append(req)
+                rows += r
+                popped = True
+            if popped:
+                self._assembling_n = len(batch)
+                cv.notify_all()   # queue room for bounded-enqueue waiters
+            if len(batch) >= cfg.batch_size:
+                return batch
+            if (tenant.heap and rows_cap is not None
+                    and rows + tenant.heap[0][2].rows > rows_cap):
+                return batch      # row bucket full
+            now = time.time()
+            if not self._predict_waiting:
+                # predict is busy: keep the window open and accumulate;
+                # woken by enqueue or by predict going idle
+                cv.wait(0.05)
+                continue
+            remaining = cfg.batch_timeout_s - (now - t_first)
+            if remaining <= 0:
+                return batch
+            urgent_t = min(r.deadline_t for r in batch)
+            if urgent_t <= now + remaining:
+                return batch      # near-expiry request jumps the window
+            cv.wait(remaining)
+
+    def _predict_run(self) -> None:
+        """Predict half of the engine: takes batches from the handoff
+        slot, expires what died in queue, runs predict, publishes.  Idle
+        waits double as the result-table GC tick."""
+        cv = self._work_cv
+        while True:
+            batch = None
+            with cv:
+                if self._stop.is_set():
+                    return
+                if self._slot is None:
+                    self._predict_waiting = True
+                    cv.notify_all()   # assembler: window may close now
+                    cv.wait(self.config.result_gc_interval_s)
+                if self._slot is not None:
+                    batch = self._slot
+                    self._slot = None
+                    self._predict_waiting = False
+                    self._busy = True   # set under the lock: drain's
+                    #                     work-pending probe must never
+                    #                     catch the gap between slot and
+                    #                     busy
+                    cv.notify_all()
+            self._gc_results()
+            if batch is None:
+                continue
+            try:
+                batch = self._expire(batch)
+                if batch:
+                    self._process_guarded(batch)
+            finally:
+                self._busy = False
+
+    # -- engine: legacy fixed-window loop (parity reference) -----------------
+    def _run_fixed(self) -> None:
+        """The pre-continuous engine: fill a window, then block on predict
+        before touching the queue again.  Kept behind
+        ``ServingConfig(continuous=False)`` as the batching-parity and
+        perf A/B reference."""
+        cfg = self.config
+        cv = self._work_cv
         while not self._stop.is_set():
             self._gc_results()
-            batch = []
-            try:
-                batch.append(self._in.get(timeout=0.05))
-            except queue.Empty:
-                continue
+            with cv:
+                tenant = self._pick_tenant(time.time())
+                if tenant is None:
+                    cv.wait(0.05)
+                    tenant = self._pick_tenant(time.time())
+                    if tenant is None:
+                        continue
+                _, _, first = heapq.heappop(tenant.heap)
+                batch = [first]
+                cv.notify_all()
             t0 = time.time()
             while (len(batch) < cfg.batch_size
                    and time.time() - t0 < cfg.batch_timeout_s):
-                try:
-                    batch.append(self._in.get_nowait())
-                except queue.Empty:
-                    time.sleep(0.0005)
+                with cv:
+                    if tenant.heap:
+                        batch.append(heapq.heappop(tenant.heap)[2])
+                        cv.notify_all()
+                        continue
+                time.sleep(0.0005)
             batch = self._expire(batch)
             if not batch:
                 continue
             self._busy = True
             try:
-                self._process(batch)
-            except Exception as e:  # noqa: BLE001 — engine must outlive
-                # any single batch: a concatenate error (shape-mismatched
-                # co-batched requests) or a raise-mode injected fault
-                # outside _process's own predict handler would otherwise
-                # kill the dispatcher thread and zombify the server
-                log.error("serving batch failed outside predict: %s", e)
-                self._count("failed_batches")
-                self._publish([r.rid for r in batch],
-                              [1] * len(batch), None, error=e)
+                self._process_guarded(batch)
             finally:
                 self._busy = False
+
+    def _process_guarded(self, batch: List[_Request]) -> None:
+        try:
+            self._process(batch)
+        except Exception as e:  # noqa: BLE001 — engine must outlive
+            # any single batch: a concatenate error (shape-mismatched
+            # co-batched requests) or a raise-mode injected fault
+            # outside _process's own predict handler would otherwise
+            # kill the engine thread and zombify the server
+            log.error("serving batch failed outside predict: %s", e)
+            self._count("failed_batches")
+            self._publish([r.rid for r in batch],
+                          [1] * len(batch), None, error=e)
 
     def _gc_results(self) -> None:
         """TTL sweep over the result table: a client that abandoned its
@@ -429,6 +830,11 @@ class ServingServer:
                     self._pending.discard(req.rid)
                 self._result_cv.notify_all()
             self._count("expired_requests", len(expired))
+            # batches are single-tenant (_fill_batch pops one heap), so
+            # one inc attributes the whole drop — the per-tenant SLO
+            # surface must say WHOSE deadlines are expiring
+            self.metrics.inc(f"serving.tenant.{expired[0].model}.expired",
+                             len(expired))
             flight.record("serving_deadline_drop", count=len(expired),
                           request_ids=[r.rid for r in expired])
         return live
@@ -436,61 +842,75 @@ class ServingServer:
     def _process(self, batch) -> None:
         # attrs (the O(batch) rid join, specifically) are built only when
         # a tracer is installed — tracing off must stay a None check
+        tenant = self._tenants[batch[0].model]
         tr = trace.active()
         if tr is None:
-            return self._process_traced(batch, None)
+            return self._process_traced(batch, tenant, None)
         with tr.span("serving/batch", batch_size=len(batch),
+                     model=tenant.name,
                      request_ids=",".join(r.rid for r in batch)):
-            self._process_traced(batch, tr)
+            self._process_traced(batch, tenant, tr)
 
-    def _process_traced(self, batch, tr) -> None:
+    def _process_traced(self, batch, tenant: _Tenant, tr) -> None:
+        cfg = self.config
         rids = [r.rid for r in batch]
-        sizes = [r.arr.shape[0] if r.arr.ndim > 1 else 1 for r in batch]
+        sizes = [r.rows for r in batch]
         arrs = [r.arr if r.arr.ndim > 1 else r.arr[None] for r in batch]
         stacked = np.concatenate(arrs, axis=0)
+        t_predict = time.time()
+        for r in batch:
+            # admission→predict-start wait: the tail's wait-vs-predict
+            # decomposition (mirrors the train-side attribution model)
+            wait = t_predict - r.admit_t
+            self.metrics.observe("serving.queue_wait_s", wait)
+            self.metrics.observe(
+                f"serving.tenant.{tenant.name}.queue_wait_s", wait)
         # chaos seams (docs/serving.md): a slow batch delays the loop so
         # queued requests expire; a worker kill takes the process down
         # mid-request (the pool's breaker/supervisor must absorb it)
         faults.fire("serving_slow_batch")
         faults.fire("serving_worker_kill")
-        use_fallback = self.degraded and self._fallback_model is not None
-        primary = self._fallback_model if use_fallback else self.model
+        use_fallback = tenant.degraded and tenant.fallback is not None
+        primary = tenant.fallback if use_fallback else tenant.model
         out = None
         try:
             pred_span = trace.NULL_SPAN if tr is None else tr.span(
                 "serving/predict", batch_size=len(batch),
-                request_ids=",".join(rids))
+                model=tenant.name, request_ids=",".join(rids))
             with pred_span, Timer(self.metrics, "serving.predict_s"):
                 faults.fire("serving_predict_fail")
                 out = primary.predict(stacked)
-            self._consecutive_failures = 0
-            if not use_fallback and self.degraded:
-                log.info("serving: predict recovered; leaving degraded mode")
-                self.degraded = False
-                flight.record("serving_recovered", via="predict_success")
+            tenant.consecutive_failures = 0
+            if not use_fallback and tenant.degraded:
+                log.info("serving: predict recovered; %s leaving degraded "
+                         "mode", tenant.name)
+                tenant.degraded = False
+                flight.record("serving_recovered", via="predict_success",
+                              model=tenant.name)
         except Exception as e:
-            self._consecutive_failures += 1
+            tenant.consecutive_failures += 1
             self._count("failed_batches")
-            if (not self.degraded and self._consecutive_failures
-                    >= self.config.degraded_after_failures):
-                self.degraded = True
+            if (not tenant.degraded and tenant.consecutive_failures
+                    >= cfg.degraded_after_failures):
+                tenant.degraded = True
                 log.error(
-                    "serving: %d consecutive predict failures — DEGRADED "
-                    "(%s)", self._consecutive_failures,
+                    "serving: %d consecutive predict failures — model %s "
+                    "DEGRADED (%s)", tenant.consecutive_failures,
+                    tenant.name,
                     "serving from fallback model"
-                    if self._fallback_model is not None
+                    if tenant.fallback is not None
                     else "no fallback: shedding new load")
                 flight.record(
-                    "serving_degraded",
-                    consecutive_failures=self._consecutive_failures,
-                    fallback=self._fallback_model is not None,
+                    "serving_degraded", model=tenant.name,
+                    consecutive_failures=tenant.consecutive_failures,
+                    fallback=tenant.fallback is not None,
                     error=str(e))
-            if not use_fallback and self._fallback_model is not None:
+            if not use_fallback and tenant.fallback is not None:
                 # last-good model answers THIS batch too, not just the
                 # post-degradation ones — a waiter should not pay for the
                 # primary's death with an error when a fallback exists
                 try:
-                    out = self._fallback_model.predict(stacked)
+                    out = tenant.fallback.predict(stacked)
                     use_fallback = True
                 except Exception as e2:
                     log.error("fallback predict also failed: %s", e2)
@@ -502,12 +922,30 @@ class ServingServer:
             self._count("fallback_batches")
         self._publish(rids, sizes, out)
         now = time.time()
+        # EMA of predict wall time: the assembler's deadline-urgency
+        # horizon (how long a queued request is likely to wait)
+        self._predict_ema_s = (0.8 * self._predict_ema_s
+                               + 0.2 * (now - t_predict))
         for r in batch:
             # admission→publish latency; the p50/p95/p99 surface /metrics
-            # exports as a Prometheus histogram
-            self.metrics.observe("serving.latency_s", now - r.admit_t)
+            # exports as a Prometheus histogram — per tenant too, so one
+            # scrape shows every model's SLO
+            lat = now - r.admit_t
+            self.metrics.observe("serving.latency_s", lat)
+            self.metrics.observe(
+                f"serving.tenant.{tenant.name}.latency_s", lat)
         self._count("batches")
         self._count("requests", len(batch))
+        self.metrics.inc(f"serving.tenant.{tenant.name}.requests",
+                         len(batch))
+        with self._stats_lock:
+            occ = (self.stats["requests"] / self.stats["batches"]
+                   / max(cfg.batch_size, 1))
+        self.metrics.gauge("serving.batch_occupancy", occ)
+        self.metrics.gauge("serving.queue_depth", self._in.qsize())
+        self.metrics.gauge("serving.backlog", self.backlog())
+        self.metrics.gauge(f"serving.tenant.{tenant.name}.queue_depth",
+                           len(tenant.heap))
 
     def _publish(self, rids, sizes, out, error: Optional[Exception] = None
                  ) -> None:

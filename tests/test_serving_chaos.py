@@ -65,11 +65,19 @@ def test_deadline_expires_in_queue_before_predict():
                                       batch_timeout_s=0.0)).start()
     try:
         r1 = srv.enqueue(np.ones((1, 2), np.float32))      # occupies engine
+        for _ in range(1000):      # r1 must be IN predict before r2
+            if calls:              # arrives, or r2 jumps it (deadline-
+                break              # aware ordering) and gets answered
+            time.sleep(0.002)
+        assert calls, "r1 never reached predict"
         r2 = srv.enqueue(np.ones((1, 2), np.float32), deadline_s=0.05)
         with pytest.raises(DeadlineExceededError):
             srv.query(r2, timeout=10)
         srv.query(r1, timeout=10)                          # unaffected
         assert srv.stats["expired_requests"] == 1
+        # the per-tenant SLO surface says WHOSE deadline expired
+        from bigdl_tpu.optim.metrics import global_metrics
+        assert global_metrics().counter("serving.tenant.default.expired") >= 1
         # the expired request never reached the chip
         assert sum(calls) == 1, calls
     finally:
@@ -91,7 +99,10 @@ def test_default_deadline_from_config():
 
 def test_deadline_expiry_under_injected_slow_batch():
     """serving_slow_batch makes every batch a straggler; a short-deadline
-    request behind one expires, a no-deadline request survives."""
+    request behind an IN-FLIGHT straggler expires, a no-deadline request
+    survives.  (The in-flight wait matters: a short-deadline request that
+    is merely *queued* jumps the window under deadline-aware ordering and
+    would be answered in time.)"""
     faults.install([FaultSpec("serving_slow_batch", every=1, delay_s=0.15,
                               max_fires=4)])
     srv = ServingServer(InferenceModel(predict_fn=_echo),
@@ -99,6 +110,7 @@ def test_deadline_expiry_under_injected_slow_batch():
                                       batch_timeout_s=0.0)).start()
     try:
         r1 = srv.enqueue(np.ones((1, 2), np.float32))
+        time.sleep(0.05)   # r1's straggler batch is now in predict
         r2 = srv.enqueue(np.ones((1, 2), np.float32), deadline_s=0.05)
         r3 = srv.enqueue(np.ones((1, 2), np.float32))
         np.testing.assert_array_equal(srv.query(r1, timeout=10), 2.0)
