@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-obs test-data test-bundle bench bench-dispatch bench-watch dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-obs test-data test-bundle test-kernels bench bench-dispatch bench-watch dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -63,6 +63,13 @@ test-serving:
 # gauges, recompile sentinel, perf-regression sentinel
 test-obs:
 	python -m pytest tests/test_obs.py tests/test_perf_attr.py -q
+
+# the Pallas kernel suite (docs/performance.md §Pallas kernels /
+# §Kernel autotuning / §Block-sparse FFN): kernel-vs-oracle parity in
+# interpret mode, block-sparse matmul + pruning schedule, autotune
+# cache determinism + explicit-kwarg precedence, gradient checks
+test-kernels:
+	python -m pytest tests/test_ops_pallas.py -q
 
 # read-only perf-regression sentinel over the committed bench trajectory
 # (docs/performance.md §Regression sentinel).  NOT a watcher: it never

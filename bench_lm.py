@@ -18,6 +18,7 @@ testable without the chip (BENCH_LM_TINY=1 forces it).
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -46,6 +47,106 @@ def _analytic_flops_per_token(n_layers, d, seq, vocab):
     the conservative (undercounting) convention, so MFU is a floor."""
     per_layer = 2 * (12 * d * d) + 2 * seq * d
     return 3 * (n_layers * per_layer + 2 * d * vocab)
+
+
+def _sparse_ab(b, tiny, n_chips, mesh, crit, rng, V, S, L, D, H, fpt,
+               peak):
+    """``--sparse``: dense-FFN control vs block-sparse FFN under the
+    BLaST schedule, same data/seed/steps.  Prune events rebuild the step
+    engine (the mask is static per compiled program) under
+    ``expected_compile`` so the recompile sentinel stays quiet; the Adam
+    state resets at each event (documented bench simplification — the
+    schedule has a handful of events, not one per step)."""
+    import jax
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.obs.attr import expected_compile
+    from bigdl_tpu.ops.block_sparse import (BlockPruningSchedule,
+                                            prune_model_to_sparsity)
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+
+    target = float(os.environ.get("BENCH_LM_SPARSITY", "0.5"))
+    block = (16, 16) if tiny else (64, 64)
+    warmup, ramp, tail = (2, 4, 3) if tiny else (10, 20, 10)
+    n_events = 2 if tiny else 4
+    total = warmup + ramp + tail
+    sched = BlockPruningSchedule(target, warmup_steps=warmup,
+                                 ramp_steps=ramp, n_events=n_events)
+
+    B = b * n_chips
+    ids = jax.block_until_ready(jax.jit(
+        lambda k: jax.random.randint(k, (B, S), 0, V))(rng))
+    tgt = jax.block_until_ready(jax.jit(
+        lambda k: jax.random.randint(k, (B, S), 0, V))(
+            jax.random.fold_in(rng, 1)))
+
+    def run(mdl, schedule):
+        variables = mdl.init(rng, jnp.asarray(ids[:1]))
+        prune_at = set(schedule.prune_steps()) if schedule else set()
+
+        def build(vars_):
+            step = ShardedParameterStep(mdl, crit,
+                                        Adam(learning_rate=1e-4), mesh,
+                                        vars_)
+            return step, step.shard_batch(ids), step.shard_batch(tgt)
+
+        step, x_dev, y_dev = build(variables)
+        trajectory = []  # (sparsity, loss) at each level's last step
+        cur_sp = 0.0
+        t0 = None
+        loss = None
+        for i in range(total):
+            if i in prune_at:
+                trajectory.append((cur_sp, float(np.asarray(loss))))
+                cur_sp = schedule.sparsity_at(i)
+                v = step.get_variables()
+                prune_model_to_sparsity(
+                    mdl, v, cur_sp,
+                    sample_inputs=(jnp.asarray(ids[:1]),))
+                with expected_compile():
+                    step, x_dev, y_dev = build(v)
+            loss = step.train_step_device(i, rng, x_dev, y_dev)
+            if i == total - tail:  # steady-sparsity timing window
+                float(np.asarray(loss))  # sync before the clock starts
+                t0 = time.perf_counter()
+        final = float(np.asarray(loss))
+        dt = (time.perf_counter() - t0) / (tail - 1) if tail > 1 else 0.0
+        trajectory.append((cur_sp, final))
+        assert np.isfinite(final), final
+        tps = B * S / dt / n_chips if dt > 0 else None
+        return tps, final, trajectory
+
+    dense_model = Transformer(vocab_size=V, hidden_size=D, num_heads=H,
+                              ffn_size=4 * D, num_layers=L, dropout=0.0,
+                              mode="lm")
+    sparse_model = Transformer(vocab_size=V, hidden_size=D, num_heads=H,
+                               ffn_size=4 * D, num_layers=L, dropout=0.0,
+                               mode="lm", ffn_sparsity=target,
+                               sparse_block=block)
+    tps_d, loss_d, _ = run(dense_model, None)
+    tps_s, loss_s, traj = run(sparse_model, sched)
+    rec = {
+        "ffn_sparsity": target,
+        "sparse_block": list(block),
+        "schedule": {"warmup_steps": warmup, "ramp_steps": ramp,
+                     "n_events": n_events, "steps": total},
+        "tokens_per_sec_chip_dense": round(tps_d, 1) if tps_d else None,
+        "tokens_per_sec_chip_sparse": round(tps_s, 1) if tps_s else None,
+        # same tokens, same dense-equivalent FLOPs/token: the
+        # dense-equivalent MFU ratio IS the throughput ratio
+        "mfu_vs_dense": round(tps_s / tps_d, 3) if tps_s and tps_d
+        else None,
+        "loss_dense": round(loss_d, 5),
+        "loss_sparse": round(loss_s, 5),
+        "loss_vs_sparsity": [{"sparsity": round(sp, 4),
+                              "loss": round(l, 5)}
+                             for sp, l in traj],
+    }
+    if peak and tps_d and tps_s:
+        rec["mfu_dense"] = round(tps_d * fpt / peak, 4)
+        rec["mfu_sparse_dense_equiv"] = round(tps_s * fpt / peak, 4)
+    return rec
 
 
 def main():
@@ -188,6 +289,21 @@ def main():
     # optional A/B below is killed mid-run (timeout/OOM) this line is the
     # row of record — the A/B can only enrich, never sink it
     print(json.dumps(out), flush=True)
+
+    if "--sparse" in sys.argv:
+        # block-sparse FFN A/B (docs/performance.md §Block-sparse FFN):
+        # dense control vs BLaST schedule (dense warmup -> magnitude
+        # block pruning to target sparsity), SAME data/seed/step count.
+        # Reports MFU-vs-dense at the final sparsity plus the
+        # loss-vs-sparsity trajectory.  Runs on the CPU tiny smoke too —
+        # the interpret-mode kernel is the same code path Mosaic compiles.
+        try:
+            out["sparse"] = _sparse_ab(
+                b, tiny, n_chips, mesh, crit, rng, V, S, L, D, H,
+                fpt, peak)
+        except Exception as e:  # noqa: BLE001 — enrich, never sink
+            out["sparse_error"] = f"{type(e).__name__}: {e}"[:300]
+        print(json.dumps(out), flush=True)
 
     prior_flash = os.environ.get("BIGDL_TPU_FLASH")
     if (on_tpu and not tiny and prior_flash != "0"

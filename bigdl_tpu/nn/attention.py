@@ -189,13 +189,33 @@ class MultiHeadAttention(Module):
 
 
 class PositionwiseFFN(Module):
-    """The transformer FFN (two Linears + activation)."""
+    """The transformer FFN (two Linears + activation).
+
+    ``ffn_sparsity > 0`` swaps both Linears for
+    :class:`~bigdl_tpu.ops.block_sparse.BlockSparseLinear` (BLaST-style,
+    docs/performance.md §Block-sparse FFN): they start DENSE (all-ones
+    mask — identical math and speed through warmup) until a pruning event
+    (``ops.block_sparse.prune_model_to_sparsity`` /
+    ``BlockPruningSchedule``) carves the weight into ``sparse_block``
+    tiles, after which the forward skips pruned blocks on the MXU."""
 
     def __init__(self, hidden_size: int, ffn_size: int, activation="gelu",
-                 dropout: float = 0.0, name=None):
+                 dropout: float = 0.0, ffn_sparsity: float = 0.0,
+                 sparse_block=(64, 64), name=None):
         super().__init__(name)
-        self.l1 = Linear(hidden_size, ffn_size)
-        self.l2 = Linear(ffn_size, hidden_size)
+        self.ffn_sparsity = float(ffn_sparsity)
+        if ffn_sparsity > 0.0:
+            from bigdl_tpu.ops.block_sparse import BlockSparseLinear
+
+            self.l1 = BlockSparseLinear(hidden_size, ffn_size,
+                                        block_shape=sparse_block,
+                                        target_sparsity=ffn_sparsity)
+            self.l2 = BlockSparseLinear(ffn_size, hidden_size,
+                                        block_shape=sparse_block,
+                                        target_sparsity=ffn_sparsity)
+        else:
+            self.l1 = Linear(hidden_size, ffn_size)
+            self.l2 = Linear(ffn_size, hidden_size)
         self.act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
         self.dropout = Dropout(dropout)
 
@@ -224,7 +244,9 @@ class TransformerLayer(Module):
 
     def __init__(self, hidden_size: int, num_heads: int, ffn_size: int = 0,
                  dropout: float = 0.1, causal: bool = False,
-                 seq_parallel: Optional[str] = None, name=None):
+                 seq_parallel: Optional[str] = None,
+                 ffn_sparsity: float = 0.0, sparse_block=(64, 64),
+                 name=None):
         super().__init__(name)
         # seq-parallel kernels don't support attention-weight dropout;
         # keep the residual/FFN dropout and drop only the attn one so the
@@ -235,7 +257,9 @@ class TransformerLayer(Module):
             attn_dropout=0.0 if seq_parallel else dropout,
             causal=causal, seq_parallel=seq_parallel)
         self.ffn = PositionwiseFFN(hidden_size, ffn_size or 4 * hidden_size,
-                                   dropout=dropout)
+                                   dropout=dropout,
+                                   ffn_sparsity=ffn_sparsity,
+                                   sparse_block=sparse_block)
         self.ln1 = LayerNorm(hidden_size)
         self.ln2 = LayerNorm(hidden_size)
         self.dropout = Dropout(dropout)
@@ -311,14 +335,17 @@ class TransformerDecoderLayer(Module):
     ``nn/Transformer.scala``'s translation mode."""
 
     def __init__(self, hidden_size: int, num_heads: int, ffn_size: int = 0,
-                 dropout: float = 0.1, name=None):
+                 dropout: float = 0.1, ffn_sparsity: float = 0.0,
+                 sparse_block=(64, 64), name=None):
         super().__init__(name)
         self.self_attn = MultiHeadAttention(hidden_size, num_heads,
                                             attn_dropout=dropout, causal=True)
         self.cross_attn = MultiHeadAttention(hidden_size, num_heads,
                                              attn_dropout=dropout)
         self.ffn = PositionwiseFFN(hidden_size, ffn_size or 4 * hidden_size,
-                                   dropout=dropout)
+                                   dropout=dropout,
+                                   ffn_sparsity=ffn_sparsity,
+                                   sparse_block=sparse_block)
         self.ln1 = LayerNorm(hidden_size)
         self.ln2 = LayerNorm(hidden_size)
         self.ln3 = LayerNorm(hidden_size)
@@ -365,21 +392,26 @@ class Transformer(Module):
 
     def __init__(self, vocab_size: int, hidden_size: int, num_heads: int,
                  ffn_size: int = 0, num_layers: int = 2,
-                 dropout: float = 0.1, mode: str = "translation", name=None):
+                 dropout: float = 0.1, mode: str = "translation",
+                 ffn_sparsity: float = 0.0, sparse_block=(64, 64),
+                 name=None):
         super().__init__(name)
         if mode not in ("translation", "lm"):
             raise ValueError(f"mode {mode!r}: translation | lm")
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.mode = mode
+        self.ffn_sparsity = float(ffn_sparsity)
         self.dropout = Dropout(dropout)
         mk = (lambda causal=False: TransformerLayer(
-            hidden_size, num_heads, ffn_size, dropout, causal=causal))
+            hidden_size, num_heads, ffn_size, dropout, causal=causal,
+            ffn_sparsity=ffn_sparsity, sparse_block=sparse_block))
         self.encoder = [mk() for _ in range(num_layers)] \
             if mode == "translation" else []
         if mode == "translation":
             self.decoder = [TransformerDecoderLayer(
-                hidden_size, num_heads, ffn_size, dropout)
+                hidden_size, num_heads, ffn_size, dropout,
+                ffn_sparsity=ffn_sparsity, sparse_block=sparse_block)
                 for _ in range(num_layers)]
         else:
             self.decoder = [mk(causal=True) for _ in range(num_layers)]

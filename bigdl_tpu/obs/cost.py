@@ -87,6 +87,14 @@ class LayerCost:
     flops: float          # forward model-flops (2 x MACs for matmul family)
     param_bytes: int
     out_elems: int
+    # effective (executed) flops — differs from ``flops`` only for sparse
+    # layers, where ``flops`` stays the DENSE-EQUIVALENT count and this
+    # counts only the nonzero-block work the chip actually does
+    eff_flops: float = -1.0
+
+    def __post_init__(self):
+        if self.eff_flops < 0:
+            self.eff_flops = self.flops
 
 
 @dataclass
@@ -98,8 +106,16 @@ class CostReport:
 
     @property
     def flops(self) -> float:
-        """Total forward model-flops for the traced batch."""
+        """Total forward model-flops for the traced batch
+        (dense-equivalent: sparsity does NOT shrink this number)."""
         return float(sum(l.flops for l in self.layers))
+
+    @property
+    def eff_flops(self) -> float:
+        """Executed forward flops: nonzero-block work only.  Equal to
+        ``flops`` for dense models; under block sparsity this is the
+        honest MFU numerator (``flops`` would inflate it)."""
+        return float(sum(l.eff_flops for l in self.layers))
 
     @property
     def param_bytes(self) -> int:
@@ -107,6 +123,15 @@ class CostReport:
 
     def train_flops(self) -> float:
         return TRAIN_FLOPS_MULTIPLIER * self.flops
+
+    def train_eff_flops(self) -> float:
+        """Executed training flops: per layer, forward and the input
+        gradient run at EFFECTIVE cost (the block-sparse kernel skips
+        pruned blocks in both) but the weight gradient is a dense matmul
+        masked on the way out (``ops.block_sparse._bsmm_bwd``) — so the
+        honest count is ``2·eff + 1·dense`` per layer, which collapses to
+        the standard 3x for dense layers (eff == flops)."""
+        return float(sum(2.0 * l.eff_flops + l.flops for l in self.layers))
 
     def per_sample_flops(self) -> float:
         return self.flops / max(self.batch, 1)
@@ -274,10 +299,22 @@ def forward_costs(model, variables: Dict[str, Any], *sample_inputs,
     for mod, ins, outs, params in records:
         flops = _layer_flops(mod, ins, outs, params)
         out_e = sum(_elems(s) for s in outs)
+        # block-sparse layers: ``flops`` stays dense-equivalent (the
+        # matmul-family formula above); the EFFECTIVE count scales by the
+        # mask's nonzero-block density — so train.mfu vs
+        # train.effective_mfu make sparsity's utilization cost visible
+        # instead of silently inflating one number
+        eff = flops
+        if type(mod).__name__ == "BlockSparseLinear":
+            try:
+                eff = flops * float(mod.density())
+            except Exception:  # pragma: no cover — unbuilt module
+                pass
         report.layers.append(LayerCost(
             name=getattr(mod, "name", type(mod).__name__),
             kind=type(mod).__name__, flops=flops,
-            param_bytes=_param_bytes(params), out_elems=out_e))
+            param_bytes=_param_bytes(params), out_elems=out_e,
+            eff_flops=eff))
     return report
 
 
@@ -288,6 +325,19 @@ def train_step_flops(model, variables: Dict[str, Any], sample_inputs,
     linear in the batch dim; sequence lengths come from the sample)."""
     rep = forward_costs(model, variables, *sample_inputs)
     return rep.train_flops() / max(rep.batch, 1) * batch_size
+
+
+def train_step_flops_detail(model, variables: Dict[str, Any],
+                            sample_inputs,
+                            batch_size: int) -> Dict[str, float]:
+    """Like :func:`train_step_flops` but reports BOTH conventions:
+    ``dense`` (dense-equivalent, sparsity-blind — the legacy
+    ``train.flops_per_step``/``train.mfu`` numerator) and ``effective``
+    (nonzero-block work only — the ``train.effective_mfu`` numerator)."""
+    rep = forward_costs(model, variables, *sample_inputs)
+    scale = batch_size / max(rep.batch, 1)
+    return {"dense": rep.train_flops() * scale,
+            "effective": rep.train_eff_flops() * scale}
 
 
 def mfu(flops_per_step: float, step_time_s: float, n_devices: int,

@@ -71,9 +71,23 @@ DEFAULT_HELP = {
     "train.attr.overhead_s": "per-step attributed time: trigger work "
                              "(validation/checkpoint/callbacks)",
     "train.mfu": "live model-flop utilization (analytic cost model over "
-                 "the device-kind bf16 peak)",
+                 "the device-kind bf16 peak); DENSE-EQUIVALENT under "
+                 "block sparsity — see train.effective_mfu",
+    "train.effective_mfu": "live MFU counting only executed "
+                           "(nonzero-block) FLOPs — the honest chip "
+                           "utilization under block-sparse layers; "
+                           "equals train.mfu for dense models",
     "train.flops_per_step": "analytic training FLOPs of one global step "
                             "(3x forward)",
+    "train.effective_flops_per_step": "analytic training FLOPs of one "
+                                      "global step counting only "
+                                      "nonzero-block (executed) work",
+    "ops.autotune_trials": "kernel-autotuner timing trials executed in "
+                           "this process",
+    "ops.autotune_cache_hits": "kernel tile lookups answered from the "
+                               "autotune cache",
+    "ops.autotune_cache_misses": "kernel tile lookups that fell back to "
+                                 "hand-picked defaults (no cache entry)",
     "train.achieved_flops_per_chip": "achieved FLOP/s per chip over the "
                                      "last log window",
     "train.collective_ici_bytes_per_step": "per-step ICI collective bytes "

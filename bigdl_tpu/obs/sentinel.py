@@ -51,6 +51,12 @@ _ARTIFACT_GLOBS = (
     # MTTR and restore traffic gate like the latency families — a
     # recovery that got 10% slower or 10% heavier is a regression
     "CLUSTER_r[0-9]*.json",
+    # per-kernel Pallas selfcheck rounds (kernels_selfcheck.py): each
+    # kernel's speedup-vs-XLA gates higher-better so a kernel regression
+    # fails `make bench-watch` like every other family; parity_ok rows
+    # only — a broken kernel is caught by the selfcheck exit code, not
+    # misread as a perf row
+    "KERNELS_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic);
@@ -140,6 +146,19 @@ def normalize(doc: Any, source: str) -> List[Row]:
     if "mttr_s" in row:  # CLUSTER_r*.json recovery drills
         add("cluster_mttr_s", row["mttr_s"], LOWER)
         add("cluster_recovery_bytes", row.get("recovery_bytes"), LOWER)
+    if "kernels" in row and isinstance(row["kernels"], dict):
+        # KERNELS_r*.json: one speedup family per kernel.  Only
+        # parity-clean, non-probe rows gate (probe_ entries are tiling
+        # experiments, never shipped configs); amortized speedup is
+        # preferred when present (single-dispatch numbers are tunnel-
+        # latency bound on this fleet)
+        for name, rec in sorted(row["kernels"].items()):
+            if name.startswith("probe_") or not isinstance(rec, dict):
+                continue
+            if not rec.get("parity_ok"):
+                continue
+            add(f"kernel_speedup_{name}",
+                rec.get("speedup_amortized", rec.get("speedup")))
     return out
 
 

@@ -23,9 +23,18 @@ from bigdl_tpu.ops.quantized import (abs_max_scales, dequantize_int8,
                                      int8_matmul, quantize_int8,
                                      quantized_linear)
 from bigdl_tpu.ops.fused import fused_layernorm
+# block_sparse last: it reaches into nn/ (Module base), whose own
+# quantized layer imports bigdl_tpu.ops.quantized — already in
+# sys.modules by this point, so the cycle never bites
+from bigdl_tpu.ops.block_sparse import (BlockPruningSchedule,
+                                        BlockSparseLinear,
+                                        block_sparse_matmul,
+                                        prune_model_to_sparsity)
 
 __all__ = [
     "on_tpu", "default_interpret", "flash_attention",
     "abs_max_scales", "quantize_int8", "dequantize_int8", "int8_matmul",
     "quantized_linear", "fused_layernorm",
+    "block_sparse_matmul", "BlockSparseLinear", "BlockPruningSchedule",
+    "prune_model_to_sparsity",
 ]

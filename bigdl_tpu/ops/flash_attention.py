@@ -185,33 +185,70 @@ def _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal, block_k=128):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, block_k_bwd,
+           interpret):
     out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                   block_k_bwd, interpret):
     out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                           interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, block_k_bwd,
+                   interpret, res, g):
     q, k, v, out, lse = res
     return _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal,
-                          block_k=block_k)
+                          block_k=block_k_bwd)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
-                    sm_scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: Optional[bool] = None):
-    """Fused blockwise attention.  q, k, v: (batch, heads, seq, head_dim)."""
+                    sm_scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
+                    interpret: Optional[bool] = None):
+    """Fused blockwise attention.  q, k, v: (batch, heads, seq, head_dim).
+
+    ``block_*=None`` consults the autotune cache for this device/shape
+    bucket and falls back to the hand-picked 128 defaults
+    (docs/performance.md §Kernel autotuning); explicit kwargs always win.
+    ``block_k_bwd`` tiles the backward k/v scan independently of the
+    forward."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
-                  int(block_k), interpret)
+    from bigdl_tpu.ops import autotune
+
+    key = autotune.attention_key(q.shape, k.shape[2], q.dtype)
+    # online mode tunes on a cache miss, but only on EAGER calls —
+    # inside a jit trace the args are tracers and we must not run timing
+    # trials mid-trace
+    shape = (tuple(q.shape) + (q.dtype.name,)
+             if autotune.is_concrete(q, k, v) else None)
+    fwd = autotune.resolve("flash_attention_fwd", key,
+                           explicit={"block_q": block_q,
+                                     "block_k": block_k},
+                           online_shape=shape)
+    if block_k_bwd is None:
+        if block_k is not None:
+            # an explicit forward block_k also pins the backward (the
+            # legacy single-knob contract) — no bwd lookup, no online
+            # tuning run whose winner would be discarded
+            block_k_bwd = block_k
+        else:
+            # cache/defaults only — no online_shape: a forward-only eager
+            # call must not pay a jax.grad tuning sweep for a backward it
+            # may never run (the offline CLI tunes flash_attention_bwd)
+            block_k_bwd = autotune.resolve("flash_attention_bwd",
+                                           key)["block_k"]
+    return _flash(q, k, v, float(sm_scale), bool(causal),
+                  int(fwd["block_q"]), int(fwd["block_k"]),
+                  int(block_k_bwd), interpret)

@@ -31,11 +31,25 @@ def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps, d):
 
 
 def fused_layernorm(x, gamma, beta, *, eps: float = 1e-5,
-                    block_rows: int = 256,
+                    block_rows: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Single-pass LayerNorm over the last axis.  Differentiable: backward
-    is the closed-form LayerNorm VJP evaluated with jnp (XLA fuses it)."""
-    return _fused_ln(x, gamma, beta, eps, block_rows, interpret)
+    is the closed-form LayerNorm VJP evaluated with jnp (XLA fuses it).
+
+    ``block_rows=None`` consults the autotune cache (default 256);
+    an explicit value always wins."""
+    if block_rows is None:
+        from bigdl_tpu.ops import autotune
+
+        rows = 1
+        for d in x.shape[:-1]:
+            rows *= int(d)
+        key = autotune.rows_key(rows, x.shape[-1], x.dtype)
+        shape = ((rows, int(x.shape[-1]), x.dtype.name)
+                 if autotune.is_concrete(x) else None)
+        block_rows = autotune.resolve("fused_layernorm", key,
+                                      online_shape=shape)["block_rows"]
+    return _fused_ln(x, gamma, beta, eps, int(block_rows), interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -63,14 +63,17 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
 
 def jsonable_state(driver_state: Optional[Dict[str, Any]]
                    ) -> Dict[str, Any]:
-    """The JSON-safe subset of a driver-state dict (scalars and nested
-    scalar dicts, e.g. ``schedule_state``) — what a manifest or a
-    peer-shard meta record may carry."""
+    """The JSON-safe subset of a driver-state dict (scalars, nested
+    scalar dicts e.g. ``schedule_state``, and nested lists e.g. the
+    block-sparse FFN masks) — what a manifest or a peer-shard meta record
+    may carry."""
     def ok(v):
         if isinstance(v, (int, float, str, bool)) or v is None:
             return True
         if isinstance(v, dict):
             return all(ok(x) for x in v.values())
+        if isinstance(v, (list, tuple)):
+            return all(ok(x) for x in v)
         return False
 
     return {k: v for k, v in (driver_state or {}).items() if ok(v)}

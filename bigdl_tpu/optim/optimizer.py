@@ -208,6 +208,7 @@ class Optimizer:
         self._attr_dispatch = 0.0
         self._attr_overhead = 0.0
         self._flops_per_step: Optional[float] = None
+        self._eff_flops_per_step: Optional[float] = None
         self._peak_flops: Optional[float] = None
         self._ici_bytes_step = 0.0
         self._dcn_bytes_step = 0.0
@@ -444,14 +445,22 @@ class Optimizer:
         self._recompile = obs_attr.recompile_sentinel()
         self._recompile.mark_warmup()
         self._flops_per_step = None
+        self._eff_flops_per_step = None
+        # kept for _refresh_cost_model: a block-sparse mask restore at
+        # resume changes effective FLOPs after this first pass ran
+        self._cost_model_args = (init_vars, init_args)
         try:
             # shape-capturing walk under eval_shape: no compute, no
             # compile; FLOPs scale linearly from the batch-1 sample to the
             # global batch (the _per_host_batch contract: batch_size IS
             # the global batch)
-            self._flops_per_step = obs_cost.train_step_flops(
+            detail = obs_cost.train_step_flops_detail(
                 self.model, init_vars, init_args, self.batch_size)
+            self._flops_per_step = detail["dense"]
+            self._eff_flops_per_step = detail["effective"]
             self.metrics.gauge("train.flops_per_step", self._flops_per_step)
+            self.metrics.gauge("train.effective_flops_per_step",
+                               self._eff_flops_per_step)
         except Exception as e:  # pragma: no cover — exotic custom modules
             log.debug("analytic cost model unavailable (%s); no live MFU "
                       "gauge this run", e)
@@ -961,6 +970,14 @@ class Optimizer:
                              self._peak_flops)
             if m is not None:
                 self.metrics.gauge("train.mfu", m)
+            # effective MFU: nonzero-block work only — under block
+            # sparsity train.mfu is the dense-equivalent view and THIS is
+            # the honest chip utilization; for dense models they are equal
+            if self._eff_flops_per_step:
+                em = obs_cost.mfu(self._eff_flops_per_step, dt,
+                                  jax.device_count(), self._peak_flops)
+                if em is not None:
+                    self.metrics.gauge("train.effective_mfu", em)
         if dt_is_wall and dt > 0 and jax.process_count() > 1:
             try:
                 stats = obs_attr.host_step_time_stats(dt)
@@ -1060,6 +1077,25 @@ class Optimizer:
             except Exception as e:
                 log.warning("peer-shard publish failed: %s", e)
 
+    def _refresh_cost_model(self) -> None:
+        """Recompute the live-MFU numerators after a host-side model
+        structure change (block-sparse masks restored at resume) — the
+        first _arm_perf_accounting pass ran before the masks existed."""
+        init_vars, init_args = getattr(self, "_cost_model_args",
+                                       (None, None))
+        if init_vars is None:
+            return
+        try:
+            detail = obs_cost.train_step_flops_detail(
+                self.model, init_vars, init_args, self.batch_size)
+            self._flops_per_step = detail["dense"]
+            self._eff_flops_per_step = detail["effective"]
+            self.metrics.gauge("train.flops_per_step", self._flops_per_step)
+            self.metrics.gauge("train.effective_flops_per_step",
+                               self._eff_flops_per_step)
+        except Exception as e:  # pragma: no cover — cost model optional
+            log.debug("cost-model refresh failed (%s)", e)
+
     def _ckpt_kwargs(self, step_engine, state, sync_barrier: bool):
         """The save_checkpoint argument set: gathered single-writer by
         default, per-process opt-state shards when sharded checkpointing
@@ -1074,6 +1110,14 @@ class Optimizer:
         # key changed (see _try_resume) — `state` is already a snapshot on
         # both call paths, so mutating it here is safe
         state["process_count"] = jax.process_count()
+        # block-sparse FFN masks are host MODULE state, not params — ride
+        # the driver_state so a restarted process resumes the same
+        # sparsity pattern instead of silently training dense again
+        from bigdl_tpu.ops.block_sparse import collect_masks
+
+        sparse_masks = collect_masks(self.model)
+        if sparse_masks:
+            state["block_sparse_masks"] = sparse_masks
         kw = dict(model_state=host_fetch(step_engine.model_state),
                   driver_state=state)
         if self._ckpt_mirror:
@@ -1252,6 +1296,24 @@ class Optimizer:
         step_engine.opt_state = put_sharded(opt_state, opt_sh)
         step_engine.model_state = put_sharded(model_state, step_engine._rep)
         state.update(driver)
+        saved_masks = state.pop("block_sparse_masks", None)
+        if saved_masks:
+            # restore the checkpoint's sparsity pattern; if it differs
+            # from the live modules' masks (fresh process: all-ones), the
+            # engine's compiled programs traced the WRONG pattern — the
+            # mask is a trace-time constant jit cannot see — so drop them
+            # and retrace on the next step
+            from bigdl_tpu.ops.block_sparse import (apply_masks,
+                                                    collect_masks)
+
+            before = collect_masks(self.model)
+            n = apply_masks(self.model, saved_masks)
+            if n and collect_masks(self.model) != before:
+                step_engine.rebuild_programs()
+                self._refresh_cost_model()
+                log.info("restored block-sparse masks for %d modules; "
+                         "programs retrace", n)
+                flight.record("block_sparse_masks_restored", modules=n)
         state["epoch_finished"] = False
         # rolled back: trigger bookkeeping beyond the resumed iteration is
         # stale future state — without this reset, a checkpoint/validation

@@ -734,6 +734,19 @@ class ShardedParameterStep:
         return [m.fold(s, c) for m, (s, c) in zip(methods, totals or [])]
 
     # ------------------------------------------------------------------
+    def rebuild_programs(self) -> None:
+        """Drop every compiled program so the next call re-traces the
+        model.  Needed after HOST-side model structure changes jit cannot
+        see in its input avals — e.g. a block-sparse FFN mask restored
+        from a checkpoint or changed by a pruning event: the mask is a
+        trace-time constant, so a stale program would keep computing with
+        the old sparsity pattern."""
+        self._train = None if self.seq_parallel else self._build_train()
+        self._eval_cache.clear()
+        self._bundle_cache.clear()
+        if hasattr(self, "_predict_jit"):
+            self._predict_jit = None
+
     def get_variables(self, ema: bool = False) -> Dict[str, Any]:
         src = self.ema_flat if (ema and self.ema_flat is not None) \
             else self.flat_params

@@ -74,6 +74,15 @@ class EngineConfig:
     # steps_per_call attribute / Estimator "steps_per_call" config key
     # override per run.
     steps_per_call: Union[int, str] = 1
+    # kernel tile autotuning (docs/performance.md §Kernel autotuning):
+    # "off" = hand-picked defaults only, "cache" = consult the on-disk
+    # winner cache (default; never measures), "online" = measure-and-
+    # cache on a miss (EAGER kernel calls only — jitted paths rely on
+    # the offline CLI `python -m bigdl_tpu.ops.autotune`).
+    # BIGDL_TPU_AUTOTUNE overrides fleet-wide — resolved at call time by
+    # ops.autotune.autotune_mode(), the env var's single owner, so it is
+    # NOT parsed into this field by from_env().
+    kernel_autotune: str = "cache"
 
     def resolved_failure_policy(self) -> FailurePolicy:
         """The effective FailurePolicy: the explicit one, else defaults

@@ -37,15 +37,46 @@ import jax
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
+# engage the persistent compile cache explicitly (same dir the benches
+# set): the 16-19s Mosaic compile per kernel (KERNELS_r04 compile_s)
+# must only be paid on the FIRST run per (kernel, tiles) — belt-and-
+# braces over the env var in case jax was imported before it was set
+_COMPILE_CACHE_DIR = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+try:
+    jax.config.update("jax_compilation_cache_dir", _COMPILE_CACHE_DIR)
+except Exception:  # noqa: BLE001 — older jax spelling; env var still rules
+    pass
+
 import jax.numpy as jnp
 
+from bigdl_tpu.ops import autotune as _autotune
+from bigdl_tpu.ops.block_sparse import block_sparse_matmul, expand_mask
 from bigdl_tpu.ops.flash_attention import flash_attention
 from bigdl_tpu.ops.fused import fused_layernorm
 from bigdl_tpu.ops.quantized import dequantize_int8, int8_matmul, quantize_int8
 
+# baseline rows must measure the HAND-PICKED defaults: pin them
+# explicitly so the kernels' call-time autotune-cache resolution — which
+# tuned_timings itself populates — can never leak tuned tiles into the
+# "default tiles" baseline (kernel_ms vs kernel_ms_tuned stays a real
+# comparison on every run, not just the first)
+DFLT = {name: dict(spec.defaults)
+        for name, spec in _autotune.REGISTRY.items()}
+
 REPEATS = int(os.environ.get("KERNELS_REPEATS", "20"))
 # KERNELS_SMALL=1: tiny shapes + 2 repeats for CPU/interpret harness checks
 SMALL = os.environ.get("KERNELS_SMALL", "0") == "1"
+# trial budget for the tuned-vs-default evidence (KERNELS_TUNE=0 reads
+# the cache without measuring)
+TUNE_TRIALS = int(os.environ.get("KERNELS_TUNE_TRIALS", "8"))
+
+
+def _cache_snapshot():
+    """Names in the persistent compile cache (empty when disabled)."""
+    try:
+        return set(os.listdir(_COMPILE_CACHE_DIR))
+    except (OSError, TypeError):
+        return set()
 
 
 def _median_ms(fn, repeats=REPEATS):
@@ -102,9 +133,16 @@ def main(out_path):
                naive_chain=None):
         rec = {"tol": tol}
         try:
+            cache_before = _cache_snapshot()
             t0 = time.perf_counter()
             k_out = jax.block_until_ready(kernel_fn())
             rec["compile_s"] = round(time.perf_counter() - t0, 2)
+            # a warm persistent cache writes nothing new for this program;
+            # a cold one does — the per-row proof the 16-19s compile tax
+            # is only paid once per (kernel, tiles)
+            rec["compile_cached"] = bool(
+                _COMPILE_CACHE_DIR and os.path.isdir(_COMPILE_CACHE_DIR)
+                and not (_cache_snapshot() - cache_before))
             n_out = jax.block_until_ready(naive_fn())
             rec["parity"] = _rel_err(k_out, n_out)
             rec["parity_ok"] = rec["parity"] <= tol
@@ -142,6 +180,39 @@ def main(out_path):
         status = "ok" if rec.get("ok") else "FAIL"
         print(f"[{status}] {name}: {json.dumps(rec)[:300]}", flush=True)
 
+    def tuned_timings(name, reg_name, shape_key, make_fn):
+        """Tuned-vs-default evidence for one recorded row: run (or read)
+        the autotuner for this kernel/shape, then re-time the kernel with
+        the winning tiles under the SAME protocol as ``kernel_ms``.  The
+        tuner measures the defaults itself and returns them unless beaten,
+        so ``tuned`` can equal the default — it can not regress.  Real
+        device only (interpret timing is meaningless) and strictly
+        additive: a tuning failure never sinks a passing parity row."""
+        rec = report["kernels"].get(name)
+        if interpret is not None or rec is None or not rec.get("ok"):
+            return
+        try:
+            from bigdl_tpu.ops import autotune
+
+            key = autotune.canonical_key(reg_name, shape_key)
+            if os.environ.get("KERNELS_TUNE", "1") != "0":
+                entry = autotune.tune(reg_name, shape_key, key=key,
+                                      n_trials=TUNE_TRIALS,
+                                      repeats=max(3, REPEATS // 4))
+            else:
+                entry = autotune.get_cache().get(key)
+            if not entry:
+                return
+            tiles = entry["tiles"]
+            rec["tiles_tuned"] = tiles
+            rec["kernel_ms_tuned"] = round(_median_ms(make_fn(tiles)), 3)
+            rec["tuner"] = {k: entry.get(k) for k in
+                            ("best_ms", "default_ms", "winner", "trials")}
+            rec["tuned_not_slower"] = (
+                float(entry["best_ms"]) <= float(entry["default_ms"]))
+        except Exception as e:  # noqa: BLE001 — additive evidence only
+            rec["tune_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
     # --- flash attention, bf16 realistic shape (batch 4, 8 heads, 2k x 128)
     B, H, S, D = (1, 2, 256, 64) if SMALL else (4, 8, 2048, 128)
     q = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
@@ -176,12 +247,20 @@ def main(out_path):
                 lambda i, qq: naive_attn(qq, k, v).astype(q.dtype), q)),
         )
 
-    record_flash_fwd("flash_attention_fwd")
+    record_flash_fwd("flash_attention_fwd", **DFLT["flash_attention_fwd"])
+    _flash_shape = (B, H, S, D, "bfloat16")
+    tuned_timings(
+        "flash_attention_fwd", "flash_attention_fwd", _flash_shape,
+        lambda tiles: jax.jit(lambda: flash_attention(
+            q, k, v, causal=True, interpret=interpret,
+            block_q=tiles["block_q"], block_k=tiles["block_k"])))
 
     def flash_loss(args):
         qq, kk, vv = args
-        return flash_attention(qq, kk, vv, causal=True,
-                               interpret=interpret).astype(jnp.float32).sum()
+        return flash_attention(
+            qq, kk, vv, causal=True, interpret=interpret,
+            block_k_bwd=DFLT["flash_attention_bwd"]["block_k"],
+            **DFLT["flash_attention_fwd"]).astype(jnp.float32).sum()
 
     def naive_loss(args):
         qq, kk, vv = args
@@ -201,6 +280,18 @@ def main(out_path):
             q)),
     )
 
+    def _flash_bwd_tuned(tiles):
+        def loss(args):
+            qq, kk, vv = args
+            return flash_attention(
+                qq, kk, vv, causal=True, interpret=interpret,
+                block_k_bwd=tiles["block_k"]).astype(jnp.float32).sum()
+
+        return jax.jit(lambda: jax.grad(loss)((q, k, v)))
+
+    tuned_timings("flash_attention_bwd", "flash_attention_bwd",
+                  _flash_shape, _flash_bwd_tuned)
+
     # --- fused layernorm, transformer-activation shape
     rows, cols = (512, 256) if SMALL else (8192, 1024)
     x = jnp.asarray(rs.randn(rows, cols), jnp.float32)
@@ -214,17 +305,28 @@ def main(out_path):
 
     record(
         "fused_layernorm_fwd",
-        jax.jit(lambda: fused_layernorm(x, g, b, interpret=interpret)),
+        jax.jit(lambda: fused_layernorm(
+            x, g, b, interpret=interpret,
+            block_rows=DFLT["fused_layernorm"]["block_rows"])),
         jax.jit(lambda: naive_ln(x)),
         tol=1e-4,
         kernel_chain=jax.jit(lambda: jax.lax.fori_loop(
             0, CHAIN,
-            lambda i, xx: fused_layernorm(xx, g, b, interpret=interpret), x)),
+            lambda i, xx: fused_layernorm(
+                xx, g, b, interpret=interpret,
+                block_rows=DFLT["fused_layernorm"]["block_rows"]), x)),
         naive_chain=jax.jit(lambda: jax.lax.fori_loop(
             0, CHAIN, lambda i, xx: naive_ln(xx), x)),
     )
+    _ln_shape = (rows, cols, "float32")
+    tuned_timings(
+        "fused_layernorm_fwd", "fused_layernorm", _ln_shape,
+        lambda tiles: jax.jit(lambda: fused_layernorm(
+            x, g, b, interpret=interpret,
+            block_rows=tiles["block_rows"])))
     _ln_grad_k = lambda xx: jax.grad(lambda z: fused_layernorm(
-        z, g, b, interpret=interpret).sum())(xx)
+        z, g, b, interpret=interpret,
+        block_rows=DFLT["fused_layernorm"]["block_rows"]).sum())(xx)
     _ln_grad_n = lambda xx: jax.grad(lambda z: naive_ln(z).sum())(xx)
     record(
         "fused_layernorm_bwd",
@@ -254,14 +356,14 @@ def main(out_path):
 
     record(
         "int8_matmul",
-        jax.jit(lambda: int8_matmul(a_q, w_q)
-                if interpret is None else
-                int8_matmul(a_q, w_q, interpret=interpret)),
+        jax.jit(lambda: int8_matmul(a_q, w_q, interpret=interpret,
+                            **DFLT["int8_matmul"])),
         jax.jit(lambda: dequantize_int8(a_q, a_s, 1) @
                 dequantize_int8(w_q, w_s, 0)),
         kernel_chain=jax.jit(lambda: jax.lax.fori_loop(
-            0, CHAIN, lambda i, aq: _requant(
-                int8_matmul(aq, w_q, interpret=interpret)), a_q)),
+            0, CHAIN, lambda i, aq: _requant(int8_matmul(
+                aq, w_q, interpret=interpret,
+                **DFLT["int8_matmul"])), a_q)),
         naive_chain=jax.jit(lambda: jax.lax.fori_loop(
             0, CHAIN, lambda i, aq: _requant(
                 dequantize_int8(aq, a_s, 1) @ dequantize_int8(w_q, w_s, 0)),
@@ -276,8 +378,8 @@ def main(out_path):
     # The fp32 dequantized matmul above is only the *timing* baseline — its
     # own accumulation rounding (~1e-3 over K=2048) is not our error.
     try:
-        acc = np.asarray(int8_matmul(a_q, w_q, interpret=interpret),
-                         np.int64)
+        acc = np.asarray(int8_matmul(a_q, w_q, interpret=interpret,
+                             **DFLT["int8_matmul"]), np.int64)
         exact = np.asarray(a_q, np.int64) @ np.asarray(w_q, np.int64)
         rec = report["kernels"]["int8_matmul"]
         rec["parity"] = float(np.max(np.abs(acc - exact)))
@@ -288,6 +390,61 @@ def main(out_path):
     except Exception as e:
         report["kernels"]["int8_matmul"]["ok"] = False
         report["kernels"]["int8_matmul"]["error"] = str(e)[:400]
+
+    tuned_timings(
+        "int8_matmul", "int8_matmul", (m, kk_, n),
+        lambda tiles: jax.jit(lambda: int8_matmul(
+            a_q, w_q, interpret=interpret, block_m=tiles["block_m"],
+            block_n=tiles["block_n"], block_k=tiles["block_k"])))
+
+    # --- block-sparse FFN pair (BLaST path, docs/performance.md
+    # §Block-sparse FFN): x @ (W1 ⊙ mask) then @ (W2 ⊙ mask) at 50% block
+    # density vs the dense-masked XLA matmuls a user would write.  The
+    # pair keeps input/output shapes equal so the chain stays
+    # data-dependent like the other kernels.
+    M_, K_ = (128, 128) if SMALL else (4096, 768)
+    F_ = 2 * K_ if SMALL else 4 * K_
+    BK = BN = 32 if SMALL else 64
+    xs = jnp.asarray(rs.randn(M_, K_), jnp.bfloat16)
+    w1 = jnp.asarray(rs.randn(K_, F_), jnp.bfloat16)
+    w2 = jnp.asarray(rs.randn(F_, K_), jnp.bfloat16)
+    m1 = rs.rand(K_ // BK, F_ // BN) < 0.5
+    m2 = rs.rand(F_ // BK, K_ // BN) < 0.5
+    m1[0, :] = True  # no empty output columns in the bench masks
+    m2[0, :] = True
+    em1 = jnp.asarray(expand_mask(m1, K_, F_, BK, BN), jnp.bfloat16)
+    em2 = jnp.asarray(expand_mask(m2, F_, K_, BK, BN), jnp.bfloat16)
+
+    def bs_pair(xx, block_m=DFLT["block_sparse_matmul"]["block_m"]):
+        h = block_sparse_matmul(xx, w1, m1, block_k=BK, block_n=BN,
+                                block_m=block_m, interpret=interpret)
+        return block_sparse_matmul(h.astype(xx.dtype), w2, m2, block_k=BK,
+                                   block_n=BN, block_m=block_m,
+                                   interpret=interpret).astype(xx.dtype)
+
+    def naive_pair(xx):
+        h = jnp.matmul(xx, w1 * em1, preferred_element_type=jnp.float32)
+        return jnp.matmul(h.astype(xx.dtype), w2 * em2,
+                          preferred_element_type=jnp.float32).astype(
+                              xx.dtype)
+
+    record(
+        "block_sparse_matmul",
+        jax.jit(lambda: bs_pair(xs)),
+        jax.jit(lambda: naive_pair(xs)),
+        tol=2e-2,  # bf16 inputs
+        kernel_chain=jax.jit(lambda: jax.lax.fori_loop(
+            0, CHAIN, lambda i, xx: bs_pair(xx), xs)),
+        naive_chain=jax.jit(lambda: jax.lax.fori_loop(
+            0, CHAIN, lambda i, xx: naive_pair(xx), xs)),
+    )
+    report["kernels"]["block_sparse_matmul"]["block_density"] = round(
+        float(m1.mean() + m2.mean()) / 2, 3)
+    tuned_timings(
+        "block_sparse_matmul", "block_sparse_matmul",
+        (M_, K_, F_, BK, BN, "bfloat16"),
+        lambda tiles: jax.jit(
+            lambda: bs_pair(xs, block_m=tiles["block_m"])))
 
     # "probe_" entries are tiling experiments, not shipped configs — a
     # failed probe is data (recorded), never a reason to drop the artifact

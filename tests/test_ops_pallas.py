@@ -739,3 +739,471 @@ class TestWeightOnly:
                                          precision="int8_wo")
         out = np.asarray(tm(x))
         assert out.shape == np.asarray(y_ref).shape
+
+
+class TestBlockSparse:
+    """Block-sparse matmul (BLaST FFN path, docs/performance.md
+    §Block-sparse FFN) — parity vs a dense-masked jnp reference in
+    interpret mode, the exact code path Mosaic compiles on TPU."""
+
+    def _mask(self, rng, nkb, nnb, density=0.6):
+        m = rng.random((nkb, nnb)) < density
+        m[0, 0] = True  # never a fully-empty mask
+        return m
+
+    @pytest.mark.parametrize("shape", [(32, 64, 48), (37, 64, 48),
+                                       (16, 96, 32)])
+    def test_matmul_parity_vs_dense_masked(self, shape):
+        from bigdl_tpu.ops.block_sparse import (block_sparse_matmul,
+                                                expand_mask)
+
+        rng = np.random.default_rng(0)
+        m, k, n = shape
+        bk, bn = 16, 16
+        x = _rand(rng, m, k)
+        w = _rand(rng, k, n)
+        mask = self._mask(rng, -(-k // bk), -(-n // bn))
+        out = block_sparse_matmul(x, w, mask, block_k=bk, block_n=bn,
+                                  interpret=True)
+        ref = np.asarray(x) @ (np.asarray(w)
+                               * expand_mask(mask, k, n, bk, bn))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_empty_output_column_yields_zeros(self):
+        from bigdl_tpu.ops.block_sparse import block_sparse_matmul
+
+        rng = np.random.default_rng(1)
+        x = _rand(rng, 16, 32)
+        w = _rand(rng, 32, 32)
+        mask = np.ones((2, 2), bool)
+        mask[:, 1] = False  # second output block-column fully pruned
+        out = np.asarray(block_sparse_matmul(x, w, mask, block_k=16,
+                                             block_n=16, interpret=True))
+        assert np.all(out[:, 16:] == 0.0)
+        ref = np.asarray(x) @ np.asarray(w)
+        np.testing.assert_allclose(out[:, :16], ref[:, :16], rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_gradients_match_dense_masked(self):
+        from bigdl_tpu.ops.block_sparse import (block_sparse_matmul,
+                                                expand_mask)
+
+        rng = np.random.default_rng(2)
+        k, n = 48, 32
+        bk, bn = 16, 16
+        x = _rand(rng, 8, k)
+        w = _rand(rng, k, n)
+        mask = self._mask(rng, 3, 2)
+        em = jnp.asarray(expand_mask(mask, k, n, bk, bn), jnp.float32)
+
+        def loss_sparse(x, w):
+            y = block_sparse_matmul(x, w, mask, block_k=bk, block_n=bn,
+                                    interpret=True)
+            return jnp.sum(y ** 2)
+
+        def loss_ref(x, w):
+            return jnp.sum((x @ (w * em)) ** 2)
+
+        g1 = jax.grad(loss_sparse, argnums=(0, 1))(x, w)
+        g2 = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+        # the weight grad is masked: pruned blocks receive exactly zero
+        dw = np.asarray(g1[1])
+        assert np.all(dw[np.asarray(em) == 0.0] == 0.0)
+
+    def test_traced_mask_rejected(self):
+        from bigdl_tpu.ops.block_sparse import block_sparse_matmul
+
+        x = jnp.ones((8, 16))
+        w = jnp.ones((16, 16))
+
+        def f(m):
+            return block_sparse_matmul(x, w, m, block_k=16, block_n=16,
+                                       interpret=True)
+
+        with pytest.raises(TypeError, match="concrete"):
+            jax.jit(f)(jnp.ones((1, 1), bool))
+
+    def test_linear_module_prune_and_parity(self):
+        from bigdl_tpu.ops.block_sparse import (BlockSparseLinear,
+                                                expand_mask)
+
+        rng = np.random.default_rng(3)
+        x = _rand(rng, 9, 64)
+        lin = BlockSparseLinear(64, 48, block_shape=(16, 16),
+                                target_sparsity=0.5)
+        v = lin.init(jax.random.PRNGKey(0), x)
+        y_dense, _ = lin.apply(v, x)
+        # dense warmup: all-ones mask == plain Linear math
+        w = np.asarray(v["params"]["weight"])
+        b = np.asarray(v["params"]["bias"])
+        np.testing.assert_allclose(np.asarray(y_dense), x @ w + b,
+                                   rtol=1e-4, atol=1e-4)
+        ach = lin.prune_to(v["params"], 0.5)
+        assert ach == pytest.approx(0.5)
+        y_sparse, _ = lin.apply(v, x)
+        em = expand_mask(lin.mask, 64, 48, 16, 16)
+        np.testing.assert_allclose(np.asarray(y_sparse),
+                                   x @ (w * em) + b, rtol=1e-3, atol=1e-3)
+        # magnitude pruning keeps the heavy blocks: surviving block L1
+        # mass >= any pruned block's
+        scores = np.abs(w).reshape(4, 16, 3, 16).sum(axis=(1, 3))
+        assert scores[lin.mask].min() >= scores[~lin.mask].max()
+
+    def test_prune_is_monotone_no_resurrection(self):
+        from bigdl_tpu.ops.block_sparse import BlockSparseLinear
+
+        rng = np.random.default_rng(4)
+        x = _rand(rng, 4, 64)
+        lin = BlockSparseLinear(64, 64, block_shape=(16, 16))
+        v = lin.init(jax.random.PRNGKey(1), x)
+        lin.prune_to(v["params"], 0.25)
+        kept_25 = lin.mask.copy()
+        lin.prune_to(v["params"], 0.5)
+        # every survivor of the deeper prune survived the shallow one
+        assert np.all(kept_25[lin.mask])
+        # and pruning shallower afterwards never resurrects
+        lin.prune_to(v["params"], 0.25)
+        assert lin.sparsity() == pytest.approx(0.5)
+
+    def test_transformer_ffn_sparsity_end_to_end(self):
+        from bigdl_tpu.nn.attention import Transformer
+        from bigdl_tpu.ops.block_sparse import (iter_sparse_modules,
+                                                prune_model_to_sparsity)
+
+        model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                            ffn_size=64, num_layers=2, dropout=0.0,
+                            mode="lm", ffn_sparsity=0.5,
+                            sparse_block=(16, 16))
+        ids = jnp.asarray(np.arange(24).reshape(2, 12) % 64)
+        v = model.init(jax.random.PRNGKey(0), ids)
+        y_dense, _ = model.apply(v, ids)
+        # exact capture-based binding (sample_inputs): one real forward
+        # records which params dict each sparse module receives
+        achieved = prune_model_to_sparsity(model, v, 0.5,
+                                           sample_inputs=(ids,))
+        # both FFN linears of both layers pruned
+        assert len(achieved) == 4
+        assert all(s == pytest.approx(0.5) for s in achieved.values())
+        for _, mod in iter_sparse_modules(model):
+            assert mod.density() == pytest.approx(0.5)
+        y_sparse, _ = model.apply(v, ids)
+        assert np.all(np.isfinite(np.asarray(y_sparse)))
+        assert not np.allclose(np.asarray(y_sparse), np.asarray(y_dense))
+        # training still differentiates through the sparse kernels
+        g = jax.grad(lambda p: model.forward(
+            p, {}, ids)[0].sum())(v["params"])
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves and all(np.all(np.isfinite(np.asarray(l)))
+                              for l in leaves)
+
+    def test_pruning_schedule_monotone(self):
+        from bigdl_tpu.ops.block_sparse import BlockPruningSchedule
+
+        sch = BlockPruningSchedule(0.75, warmup_steps=10, ramp_steps=40,
+                                   n_events=4)
+        vals = [sch.sparsity_at(s) for s in range(80)]
+        assert all(b >= a for a, b in zip(vals, vals[1:]))
+        assert all(v == 0.0 for v in vals[:10])       # dense warmup
+        assert vals[-1] == pytest.approx(0.75)        # reaches target
+        steps = sch.prune_steps()
+        assert steps and len(steps) <= 4
+        # prune_steps are exactly where sparsity_at increases
+        for s in steps:
+            assert sch.sparsity_at(s) > sch.sparsity_at(s - 1)
+        # degenerate schedules
+        assert BlockPruningSchedule(0.0, 0, 0).prune_steps() == []
+        assert BlockPruningSchedule(0.5, 5, 0).sparsity_at(5) == 0.5
+
+    def test_mask_collect_apply_roundtrip(self):
+        from bigdl_tpu.nn.attention import Transformer
+        from bigdl_tpu.ops.block_sparse import (apply_masks, collect_masks,
+                                                prune_model_to_sparsity)
+
+        mk = lambda: Transformer(vocab_size=32, hidden_size=16,
+                                 num_heads=2, ffn_size=32, num_layers=1,
+                                 dropout=0.0, mode="lm", ffn_sparsity=0.5,
+                                 sparse_block=(16, 16))
+        model = mk()
+        ids = jnp.asarray(np.arange(8).reshape(1, 8) % 32)
+        v = model.init(jax.random.PRNGKey(0), ids)
+        prune_model_to_sparsity(model, v, 0.5)
+        masks = collect_masks(model)
+        fresh = mk()
+        fresh.init(jax.random.PRNGKey(0), ids)
+        assert apply_masks(fresh, masks) == len(masks) > 0
+        y1, _ = model.apply(v, ids)
+        y2, _ = fresh.apply(v, ids)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+class TestAutotune:
+    """Kernel tile autotuner (docs/performance.md §Kernel autotuning):
+    cache determinism, explicit-kwarg precedence, never-slower-than-
+    default."""
+
+    @pytest.fixture()
+    def at(self, tmp_path, monkeypatch):
+        from bigdl_tpu.ops import autotune
+
+        monkeypatch.setenv("BIGDL_TPU_AUTOTUNE_CACHE", str(tmp_path))
+        monkeypatch.delenv("BIGDL_TPU_AUTOTUNE", raising=False)
+        autotune.reset_cache()
+        yield autotune
+        autotune.reset_cache()
+
+    def _fake_measure(self, monkeypatch, at, fn):
+        calls = {"n": 0}
+
+        def fake(thunk, repeats=0):
+            calls["n"] += 1
+            return fn()
+
+        monkeypatch.setattr(at, "_measure_ms", fake)
+        return calls
+
+    def test_cache_hit_determinism_second_lookup_zero_trials(
+            self, at, monkeypatch):
+        # deterministic fake timing: the 4th measured config is fastest
+        seen = []
+
+        def fake(thunk, repeats=0):
+            seen.append(1)
+            return 0.5 if len(seen) == 4 else 1.0 + 0.1 * len(seen)
+
+        monkeypatch.setattr(at, "_measure_ms", fake)
+        shape = (512, 256, "float32")
+        entry = at.tune("fused_layernorm", shape, n_trials=8)
+        assert entry["trials"] > 0
+        # tune keys through the SAME bucketed key the kernel computes at
+        # call time — an offline winner must be exactly what
+        # fused_layernorm(block_rows=None) looks up
+        key = at.canonical_key("fused_layernorm", shape)
+        assert key == at.full_key("fused_layernorm",
+                                  at.rows_key(512, 256, "float32"))
+        assert at.get_cache().get(key)["tiles"] == entry["tiles"]
+        # a "second process": fresh in-memory handle over the same dir,
+        # online mode armed — the disk hit must answer with ZERO timing
+        # trials and the identical tiles
+        at.reset_cache()
+        monkeypatch.setenv("BIGDL_TPU_AUTOTUNE", "online")
+        n_before = len(seen)
+        got = at.resolve("fused_layernorm", at.rows_key(512, 256,
+                                                        "float32"),
+                         online_shape=shape)
+        assert len(seen) == n_before
+        assert got == entry["tiles"]
+
+    def test_explicit_kwarg_beats_cache(self, at):
+        key_shape = "r512_c256_float32"
+        at.get_cache().put(
+            at.full_key("fused_layernorm", key_shape),
+            {"tiles": {"block_rows": 1024}, "best_ms": 1.0,
+             "default_ms": 2.0, "trials": 5, "winner": "searched"})
+        # cache wins over the registry default...
+        auto = at.resolve("fused_layernorm", key_shape)
+        assert auto["block_rows"] == 1024
+        # ...but an explicit kwarg beats the cache
+        expl = at.resolve("fused_layernorm", key_shape,
+                          explicit={"block_rows": 64})
+        assert expl["block_rows"] == 64
+        # and None means "not passed", not "explicit"
+        expl2 = at.resolve("fused_layernorm", key_shape,
+                           explicit={"block_rows": None})
+        assert expl2["block_rows"] == 1024
+
+    def test_mode_off_ignores_cache(self, at, monkeypatch):
+        key_shape = "r512_c256_float32"
+        at.get_cache().put(
+            at.full_key("fused_layernorm", key_shape),
+            {"tiles": {"block_rows": 1024}, "best_ms": 1.0,
+             "default_ms": 2.0, "trials": 5, "winner": "searched"})
+        monkeypatch.setenv("BIGDL_TPU_AUTOTUNE", "off")
+        assert at.resolve("fused_layernorm",
+                          key_shape)["block_rows"] == 256  # the default
+
+    def test_tuner_never_slower_than_default(self, at, monkeypatch):
+        # every candidate measures SLOWER than the default -> the tuner
+        # must hand back the hand-picked defaults
+        def fake_slow(thunk, repeats=0):
+            fake_slow.n = getattr(fake_slow, "n", 0) + 1
+            return 1.0 if fake_slow.n == 1 else 5.0  # first call = default
+
+        monkeypatch.setattr(at, "_measure_ms", fake_slow)
+        entry = at.tune("fused_layernorm", (512, 256, "float32"),
+                        n_trials=6)
+        assert entry["winner"] == "default"
+        assert entry["tiles"] == {"block_rows": 256}
+        assert entry["best_ms"] <= entry["default_ms"]
+
+    def test_garbage_cache_entry_falls_back_to_defaults(self, at):
+        key_shape = "r512_c256_float32"
+        at.get_cache().put(
+            at.full_key("fused_layernorm", key_shape),
+            {"tiles": {"block_rows": "boom"}, "best_ms": 1.0,
+             "default_ms": 2.0, "trials": 1, "winner": "searched"})
+        assert at.resolve("fused_layernorm",
+                          key_shape)["block_rows"] == 256
+
+    def test_kernels_consult_resolution_without_breaking_parity(
+            self, at):
+        """flash_attention/fused_layernorm with auto tiles (None) match
+        their explicit-tile outputs — the resolution layer changes tile
+        choice, never math."""
+        from bigdl_tpu.ops import flash_attention, fused_layernorm
+
+        rng = np.random.default_rng(0)
+        q = _rand(rng, 1, 2, 24, 8)
+        a = flash_attention(q, q, q, causal=True, interpret=True)
+        b = flash_attention(q, q, q, causal=True, block_q=8, block_k=8,
+                            interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+        x = _rand(rng, 9, 17)
+        g2 = _rand(rng, 17)
+        b2 = _rand(rng, 17)
+        y_auto = fused_layernorm(x, g2, b2, interpret=True)
+        y_expl = fused_layernorm(x, g2, b2, block_rows=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(y_auto), np.asarray(y_expl),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestBlockSparseServing:
+    """The pruned FFN serves through InferenceModel unchanged: the mask
+    is a compile-time constant of the jitted forward."""
+
+    def test_inference_model_serves_pruned_transformer(self):
+        from bigdl_tpu.nn.attention import Transformer
+        from bigdl_tpu.ops.block_sparse import prune_model_to_sparsity
+        from bigdl_tpu.serving.inference_model import InferenceModel
+
+        model = Transformer(vocab_size=32, hidden_size=16, num_heads=2,
+                            ffn_size=32, num_layers=1, dropout=0.0,
+                            mode="lm", ffn_sparsity=0.5,
+                            sparse_block=(16, 16))
+        ids = np.arange(16).reshape(2, 8).astype(np.int32) % 32
+        v = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+        prune_model_to_sparsity(model, v, 0.5)
+        ref, _ = model.apply(v, jnp.asarray(ids))
+        im = InferenceModel(model, v, batch_buckets=(2,))
+        out = im.predict(ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestAutotuneOnline:
+    def test_online_mode_tunes_on_first_eager_call_only(
+            self, tmp_path, monkeypatch):
+        """BIGDL_TPU_AUTOTUNE=online: the first EAGER kernel call at a
+        new shape bucket runs trials and caches; the second call (and any
+        jitted call) runs zero trials."""
+        from bigdl_tpu.ops import autotune, fused_layernorm
+
+        monkeypatch.setenv("BIGDL_TPU_AUTOTUNE_CACHE", str(tmp_path))
+        monkeypatch.setenv("BIGDL_TPU_AUTOTUNE", "online")
+        autotune.reset_cache()
+        calls = {"n": 0}
+
+        def fake(thunk, repeats=0):
+            calls["n"] += 1
+            return float(calls["n"])  # first measured (the default) wins
+
+        monkeypatch.setattr(autotune, "_measure_ms", fake)
+        rng = np.random.default_rng(0)
+        x = _rand(rng, 32, 16)
+        g = _rand(rng, 16)
+        b = _rand(rng, 16)
+        y = fused_layernorm(x, g, b, interpret=True)
+        assert calls["n"] > 0  # tuned on the miss
+        n_after = calls["n"]
+        y2 = fused_layernorm(x, g, b, interpret=True)
+        assert calls["n"] == n_after  # cache hit: zero further trials
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2))
+        # jitted call: tracers -> no tuning, cache consulted silently
+        jax.jit(lambda x: fused_layernorm(x, g, b, interpret=True))(x)
+        assert calls["n"] == n_after
+        autotune.reset_cache()
+
+
+class TestPruneBinding:
+    def test_capture_binding_survives_same_shaped_dense_linear(self):
+        """A dense Linear with the SAME (in, out) ahead of the sparse
+        layer must not be mistaken for it: capture-based binding prunes
+        by the SPARSE layer's own weights."""
+        from bigdl_tpu.nn.layers import Linear, ReLU
+        from bigdl_tpu.nn.module import Sequential
+        from bigdl_tpu.ops.block_sparse import (BlockSparseLinear,
+                                                prune_model_to_sparsity)
+
+        rng = np.random.default_rng(7)
+        model = Sequential([Linear(32, 32), ReLU(),
+                            BlockSparseLinear(32, 32, block_shape=(16, 16))])
+        x = _rand(rng, 4, 32)
+        v = model.init(jax.random.PRNGKey(0), x)
+        # make the sparse layer's block magnitudes unambiguous: one block
+        # overwhelmingly heavy
+        key = model._key(2)
+        w = np.asarray(v["params"][key]["weight"]).copy()
+        w[:16, :16] = 100.0
+        v["params"][key]["weight"] = jnp.asarray(w)
+        achieved = prune_model_to_sparsity(model, v, 0.75,
+                                           sample_inputs=(x,))
+        assert list(achieved.values()) == [0.75]
+        (_, mod), = [pm for pm in __import__(
+            "bigdl_tpu.ops.block_sparse",
+            fromlist=["iter_sparse_modules"]).iter_sparse_modules(model)]
+        assert mod.mask[0, 0] and mod.mask.sum() == 1  # the heavy block
+
+
+class TestSparseMaskResume:
+    def test_masks_ride_checkpoint_and_restore_on_resume(self, tmp_path):
+        """A pruned FFN's masks are host module state: they ride the
+        checkpoint driver_state, and a FRESH process (dense all-ones
+        modules) resuming from that checkpoint gets them back — without
+        this, a preempted sparse run silently resumes dense."""
+        from bigdl_tpu import nn, optim
+        from bigdl_tpu.data.dataset import ArrayDataSet
+        from bigdl_tpu.ops.block_sparse import (BlockSparseLinear,
+                                                collect_masks,
+                                                prune_model_to_sparsity)
+        from bigdl_tpu.runtime.engine import Engine, init_engine
+
+        Engine.reset()
+        init_engine(data=1)
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 32).astype(np.float32)
+        y = (x.sum(1) > 16).astype(np.int32)
+
+        def mk():
+            return nn.Sequential([
+                BlockSparseLinear(32, 32, block_shape=(16, 16),
+                                  target_sparsity=0.5),
+                nn.ReLU(), nn.Linear(32, 2)])
+
+        def run(model, epochs):
+            opt = optim.Optimizer(model, ArrayDataSet(x, y),
+                                  nn.CrossEntropyCriterion(),
+                                  batch_size=32)
+            opt.set_optim_method(optim.Adam(learning_rate=1e-3))
+            opt.set_end_when(optim.Trigger.max_epoch(epochs))
+            opt.set_checkpoint(str(tmp_path),
+                               optim.Trigger.several_iteration(2))
+            opt.log_every = 10000
+            return opt.optimize()
+
+        m1 = mk()
+        v = m1.init(jax.random.PRNGKey(0), x[:1])
+        prune_model_to_sparsity(m1, v, 0.5, sample_inputs=(x[:1],))
+        masks1 = collect_masks(m1)
+        assert any(not np.asarray(m).all() for m in masks1.values())
+        run(m1, 1)
+
+        # "fresh process": new modules (all-ones masks), same ckpt dir
+        m2 = mk()
+        trained = run(m2, 2)  # resumes epoch 1's checkpoint, trains on
+        assert collect_masks(m2) == masks1
+        out, _ = m2.apply(trained.variables, x)
+        assert np.all(np.isfinite(np.asarray(out)))

@@ -382,3 +382,80 @@ def test_sentinel_cli_fails_on_regressed_fresh_file(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["regressed"] is True
+
+
+# ---------------------------------------------------------------------------
+# block-sparse cost model + KERNELS sentinel family (ISSUE 10 satellites)
+# ---------------------------------------------------------------------------
+
+def test_cost_model_block_sparse_dense_vs_effective():
+    """A pruned BlockSparseLinear reports dense-equivalent flops in
+    ``flops`` and density-scaled flops in ``eff_flops`` — so train.mfu
+    (dense-equivalent) can't silently inflate: train.effective_mfu sits
+    next to it."""
+    import jax
+
+    from bigdl_tpu.ops.block_sparse import BlockSparseLinear
+
+    lin = BlockSparseLinear(64, 64, block_shape=(16, 16))
+    x = np.zeros((8, 64), np.float32)
+    v = lin.init(jax.random.PRNGKey(0), x[:1])
+    dense = 2.0 * 8 * 64 * 64
+    rep = obs_cost.forward_costs(lin, v, x)
+    assert rep.flops == pytest.approx(dense)
+    assert rep.eff_flops == pytest.approx(dense)  # unpruned: equal
+    lin.prune_to(v["params"], 0.5)
+    rep2 = obs_cost.forward_costs(lin, v, x)
+    assert rep2.flops == pytest.approx(dense)          # dense-equivalent
+    assert rep2.eff_flops == pytest.approx(dense * 0.5)  # executed work
+    detail = obs_cost.train_step_flops_detail(lin, v, (x[:1],), 8)
+    assert detail["dense"] == pytest.approx(3 * dense)
+    # training effective = fwd(eff) + dx(eff) + dw(DENSE — the weight
+    # grad is a dense matmul masked on the way out): 2·0.5 + 1 = 2.0
+    assert detail["effective"] == pytest.approx(dense * 2.0)
+
+
+def test_sentinel_kernels_family_normalize_and_gate():
+    """KERNELS_r*.json rows gate: per-kernel speedup (higher-better),
+    parity_ok rows only, probe_ rows never."""
+    doc = {"device_kind": "TPU v5 lite", "all_ok": True, "kernels": {
+        "flash_attention_fwd": {"parity_ok": True, "speedup": 1.2,
+                                "speedup_amortized": 1.5},
+        "fused_layernorm_fwd": {"parity_ok": True, "speedup": 1.0},
+        "broken_kernel": {"parity_ok": False, "speedup": 9.9},
+        "probe_flash_bq256": {"parity_ok": True, "speedup": 3.0},
+    }}
+    rows = {r.family: r for r in obs_sentinel.normalize(doc, "t.json")}
+    assert rows["kernel_speedup_flash_attention_fwd"].value == 1.5  # amortized preferred
+    assert rows["kernel_speedup_fused_layernorm_fwd"].value == 1.0
+    assert "kernel_speedup_broken_kernel" not in rows
+    assert not any("probe" in f for f in rows)
+    assert all(r.direction == obs_sentinel.HIGHER for r in rows.values())
+
+
+def test_sentinel_kernels_family_in_committed_history_and_gates():
+    """The committed KERNELS_r04 rows are in the history, and a 20%
+    kernel-speedup regression fails like every other family (the
+    `make bench-watch` contract)."""
+    history = obs_sentinel.load_history(REPO)
+    fam = "kernel_speedup_flash_attention_fwd"
+    assert fam in history
+    base = obs_sentinel.baseline_for(fam, history)
+    assert base.source.startswith("KERNELS_r")
+    fresh = {"kernels": {"flash_attention_fwd": {
+        "parity_ok": True, "speedup": base.value * 0.8}}}
+    verdicts = obs_sentinel.check(fresh, history)
+    by_family = {v.family: v for v in verdicts}
+    assert by_family[fam].regressed
+    ok = obs_sentinel.check({"kernels": {"flash_attention_fwd": {
+        "parity_ok": True, "speedup": base.value}}}, history)
+    assert not ok[0].regressed
+
+
+def test_export_help_covers_new_gauges():
+    from bigdl_tpu.obs.export import DEFAULT_HELP
+
+    for name in ("train.effective_mfu", "train.effective_flops_per_step",
+                 "ops.autotune_trials", "ops.autotune_cache_hits",
+                 "ops.autotune_cache_misses"):
+        assert name in DEFAULT_HELP and DEFAULT_HELP[name]
