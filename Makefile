@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-obs test-data test-bundle test-kernels bench bench-dispatch bench-watch dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-obs test-data test-bundle test-kernels test-collectives bench bench-dispatch bench-watch bench-gradcomm dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -91,6 +91,14 @@ test-data:
 test-bundle:
 	python -m pytest tests/test_step_bundle.py -q
 
+# quantized + overlapped gradient collectives (docs/parallelism.md
+# §Gradient compression & bucketed overlap): blockwise-int8 primitives
+# vs the f32 oracle, int8-vs-fp32 loss parity on a 2-device CPU mesh,
+# bucketed==monolithic trajectories, honest wire-dtype ledger,
+# bf16_grads deprecation shim, overlap audit, MULTICHIP sentinel rows
+test-collectives:
+	python -m pytest tests/test_grad_comm.py -q
+
 bench:
 	python bench.py
 
@@ -105,6 +113,14 @@ dryrun:
 # strong-scaling + loader-throughput artifacts (committed per round)
 bench-scaling:
 	python bench_scaling.py
+
+# gradient-compression A/B (docs/parallelism.md §Gradient compression):
+# analytic wire ledger fp32/bf16/int8 on the MULTICHIP_LARGE geometry +
+# measured loss parity and overlap efficiency; exits non-zero when the
+# int8 reduction drops below 3x or parity breaks — the
+# MULTICHIP_GRADCOMM_r*.json artifact source
+bench-gradcomm:
+	python bench_scaling.py --grad-comm
 
 bench-loader:
 	python bench_loader.py

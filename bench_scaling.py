@@ -14,6 +14,12 @@ chip), so this harness measures what CAN be measured without a slice:
 - the analytic per-step collective traffic of the dp step (psum_scatter +
   all_gather of the flat parameter vector), for sanity-checking against a
   real profile.
+- ``--grad-comm``: the gradient-compression A/B (docs/parallelism.md
+  §Gradient compression): prices the MULTICHIP_LARGE dp_resnet50
+  geometry (dcn_data=2 x data=4) with the analytic wire-dtype ledger for
+  fp32/bf16/int8, then MEASURES int8-vs-fp32 loss parity and bucketed
+  overlap efficiency with real train steps of the small bench model —
+  the MULTICHIP_GRADCOMM_r*.json artifact the regression sentinel gates.
 
 The real-slice protocol (what to run on a v5e pod and what to record) is
 documented in docs/performance.md §"Scaling protocol".
@@ -60,12 +66,15 @@ def main_real(args):
     y = rs.randint(0, classes, (local,)).astype(np.int32)
     rng = jax.random.PRNGKey(0)
     variables = model.init(rng, jnp.asarray(x[:1]))
+    # compressed reduce-scatter pays off once the data axis crosses
+    # hosts (DCN-bound); over a single slice's ICI f32 is free
+    wire = args.wire
+    if wire == "auto":
+        wire = "bf16" if jax.process_count() > 1 else "fp32"
     step = ShardedParameterStep(
         model, CrossEntropyCriterion(),
         SGD(learning_rate=0.1, momentum=0.9), mesh, variables,
-        # bf16 reduce-scatter pays off once the data axis crosses hosts
-        # (DCN-bound); over a single slice's ICI f32 is free
-        bf16_grads=jax.process_count() > 1)
+        grad_comm=wire)
     xd, yd = step.shard_batch(x), step.shard_batch(y)
     float(np.asarray(step.train_step_device(0, rng, xd, yd)))  # compile
     t0 = time.perf_counter()
@@ -87,7 +96,10 @@ def main_real(args):
             "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
             "global_batch": global_batch,
             "step_time_ms": round(dt * 1e3, 2),
+            "grad_comm": wire,
             "ici_bytes_per_step": step.collective_bytes_per_step,
+            "grad_sync_ici_bytes_per_step":
+                step.grad_sync_ici_bytes_per_step,
             "dcn_bytes_per_step": step.dcn_bytes_per_step,
             "final_loss": round(final, 4),
         }))
@@ -133,9 +145,14 @@ def main():
             loss = step.train_step_device(i + 1, rng, xd, yd)
         float(np.asarray(loss))
         dt = (time.perf_counter() - t0) / steps
-        coll_bytes = step.collective_bytes_per_step
-        per_mesh[str(n)] = {"step_time_ms": round(dt * 1e3, 2),
-                            "collective_bytes_per_step": coll_bytes}
+        per_mesh[str(n)] = {
+            "step_time_ms": round(dt * 1e3, 2),
+            "collective_bytes_per_step": step.collective_bytes_per_step,
+            # the compressible vs fixed halves of the wire (ledger view)
+            "grad_sync_bytes_per_step": step.grad_sync_ici_bytes_per_step,
+            "param_sync_bytes_per_step":
+                step.param_sync_ici_bytes_per_step,
+        }
 
     t1 = per_mesh["1"]["step_time_ms"]
     speedup = {n: round(t1 / v["step_time_ms"], 3)
@@ -161,19 +178,140 @@ def main():
     }))
 
 
+def main_grad_comm(args):
+    """Gradient-compression A/B — ONE JSON line, the
+    MULTICHIP_GRADCOMM_r*.json artifact.
+
+    Part 1 (analytic, machine-independent): the wire-dtype ledger of the
+    MULTICHIP_LARGE dp_resnet50_multislice geometry (dcn_data=2, data=4)
+    for fp32/bf16/int8 — the int8-vs-fp32 gradient-sync byte reduction
+    is the sentinel-gated headline (acceptance: >= 3x).
+
+    Part 2 (measured on the 8-virtual-device CPU mesh): the small bench
+    model trained the same number of steps under ``grad_comm="fp32"``
+    and ``"int8"`` from one seed (loss parity), plus the bucketed-
+    overlap audit (exposed collective time vs total)."""
+    from bigdl_tpu.runtime.engine import force_cpu_devices
+
+    import jax
+
+    force_cpu_devices(8)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.models.resnet import resnet50, resnet_cifar
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import ShardedParameterStep
+    from bigdl_tpu.parallel import collectives
+    from bigdl_tpu.runtime.mesh import MeshSpec, build_mesh
+
+    # -- analytic ledger on the MULTICHIP_LARGE geometry (no devices) --
+    r50 = resnet50(classes=1000)
+    shapes = jax.eval_shape(
+        lambda r, x: r50.init(r, x), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, 224, 224, 3), jnp.float32))
+    n_params = int(sum(int(np.prod(s.shape)) for s in
+                       jax.tree_util.tree_leaves(shapes["params"])))
+    ledgers = {m: collectives.layout_ledger(
+        n_params, ndev=4, dcn=2, mode=m, bucket_bytes=args.bucket_bytes)
+        for m in ("fp32", "bf16", "int8")}
+    grad_totals = {m: (led["grad_sync_ici_bytes_per_step"]
+                       + led["grad_sync_dcn_bytes_per_step"])
+                   for m, led in ledgers.items()}
+    reduction = grad_totals["fp32"] / grad_totals["int8"]
+
+    # -- measured parity + overlap on the small bench model ------------
+    global_batch, steps = 32, args.steps
+    rs = np.random.RandomState(0)
+    x = rs.rand(global_batch, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 10, (global_batch,)).astype(np.int32)
+    mesh = build_mesh(MeshSpec(data=4, dcn_data=2))  # both hops live
+    rng = jax.random.PRNGKey(0)
+
+    def run(mode):
+        model = resnet_cifar(depth=8, classes=10)
+        variables = model.init(rng, jnp.asarray(x[:1]))
+        step = ShardedParameterStep(
+            model, CrossEntropyCriterion(),
+            SGD(learning_rate=0.1, momentum=0.9), mesh, variables,
+            grad_comm=mode, comm_bucket_bytes=args.small_bucket_bytes)
+        xd, yd = step.shard_batch(x), step.shard_batch(y)
+        loss = None
+        for i in range(steps):
+            loss = step.train_step_device(i, rng, xd, yd)
+        return float(np.asarray(loss)), step, (xd, yd)
+
+    loss_f, _, _ = run("fp32")
+    loss_q, step_q, (xd, yd) = run("int8")
+    delta = abs(loss_q - loss_f)
+    overlap = step_q.measure_overlap(xd, yd, steps=5)
+
+    parity_tol = max(0.05 * abs(loss_f), 0.02)
+    print(json.dumps({
+        "metric": "multichip_grad_bytes_reduction",
+        "value": round(reduction, 3),
+        "unit": "x_fewer_grad_sync_bytes_int8_vs_fp32",
+        "vs_baseline": None,
+        "model": "resnet50",
+        "n_params": n_params,
+        "mesh": {"dcn_data": 2, "data": 4},
+        "grad_bytes_reduction_vs_fp32": round(reduction, 3),
+        "grad_sync_ici_bytes_per_step":
+            ledgers["int8"]["grad_sync_ici_bytes_per_step"],
+        "grad_sync_dcn_bytes_per_step":
+            ledgers["int8"]["grad_sync_dcn_bytes_per_step"],
+        "ledger": ledgers,
+        "loss_parity": {"model": "resnet_cifar8", "steps": steps,
+                        "global_batch": global_batch,
+                        "fp32": round(loss_f, 4),
+                        "int8": round(loss_q, 4),
+                        "abs_delta": round(delta, 4),
+                        "tolerance": round(parity_tol, 4)},
+        "overlap": {k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in overlap.items()},
+        "ok": bool(reduction >= 3.0 and delta <= parity_tol),
+    }))
+    return 0 if (reduction >= 3.0 and delta <= parity_tol) else 1
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--real", action="store_true",
                     help="measure the REAL device mesh (launch via "
                          "`bigdl-tpu run bench_scaling.py -- --real`)")
+    ap.add_argument("--grad-comm", action="store_true",
+                    help="gradient-compression A/B: analytic wire ledger "
+                         "(fp32/bf16/int8) on the MULTICHIP_LARGE "
+                         "geometry + measured loss parity and overlap "
+                         "efficiency (MULTICHIP_GRADCOMM artifact)")
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet_cifar"])
+    ap.add_argument("--wire", default="auto",
+                    choices=["auto", "fp32", "bf16", "int8"],
+                    help="--real gradient wire format (auto: bf16 across "
+                         "hosts, fp32 within a slice)")
     ap.add_argument("--per-device-batch", type=int, default=96)
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="measured steps (default: 20 for --real, 8 for "
+                         "--grad-comm)")
+    ap.add_argument("--bucket-bytes", type=int, default=4 << 20,
+                    help="--grad-comm ledger bucket size (flat-gradient "
+                         "bytes per collective)")
+    ap.add_argument("--small-bucket-bytes", type=int, default=32768,
+                    help="--grad-comm measured-model bucket size (small "
+                         "enough to exercise >1 bucket)")
     cli_args = ap.parse_args()
+    if cli_args.steps is None:
+        cli_args.steps = 8 if cli_args.grad_comm else 20
     if cli_args.steps < 1:
         ap.error("--steps must be >= 1")
     if cli_args.real:
         main_real(cli_args)
+    elif cli_args.grad_comm:
+        import sys
+
+        sys.exit(main_grad_comm(cli_args))
     else:
         main()

@@ -254,6 +254,14 @@ class Estimator:
             # dispatch-vs-step time
             spc = self.config["steps_per_call"]
             opt.steps_per_call = spc if spc == "auto" else int(spc)
+        if "grad_comm" in self.config:
+            # gradient-sync wire format (docs/parallelism.md §Gradient
+            # compression): "fp32" | "bf16" | "int8"
+            opt.grad_comm = str(self.config["grad_comm"])
+        if "comm_bucket_bytes" in self.config:
+            # bucketed gradient sync: max flat-gradient bytes per
+            # collective, so communication overlaps neighbouring compute
+            opt.comm_bucket_bytes = int(self.config["comm_bucket_bytes"])
         if profile_dir is not None:
             opt.set_profile(profile_dir)
         if getattr(self, "_initial_variables", None) is not None:

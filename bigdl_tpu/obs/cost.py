@@ -350,16 +350,38 @@ def mfu(flops_per_step: float, step_time_s: float, n_devices: int,
     return achieved / peak
 
 
-def collective_ledger(step_engine) -> Dict[str, float]:
+def collective_ledger(step_engine) -> Dict[str, Any]:
     """Per-step collective-bytes ledger of a
     :class:`~bigdl_tpu.optim.train_step.ShardedParameterStep` — what
     MULTICHIP_LARGE measures offline, derived from the parameter layout
-    and sync strategy (ZeRO-1 psum_scatter + all_gather; hierarchical DCN
-    hop when the mesh is multislice)."""
+    and sync strategy (ZeRO-1 reduce-scatter + all_gather; hierarchical
+    DCN hop when the mesh is multislice).
+
+    Bytes are counted in the ACTUAL wire dtype of the configured
+    ``grad_comm`` mode — bf16 payloads at 2 B/elem, int8 payloads at
+    1 B/elem PLUS the f32 per-block quantization scales and block
+    padding (``parallel.collectives`` estimators) — so before/after
+    compression comparisons are honest.  ``grad_ici`` / ``param_ici``
+    split the ICI total into the gradient scatter (compressible) and the
+    f32 param gather (not compressed)."""
+    mode = getattr(step_engine, "grad_comm",
+                   "bf16" if getattr(step_engine, "bf16_grads", False)
+                   else "fp32")
+    grad_ici = float(getattr(step_engine, "grad_sync_ici_bytes_per_step",
+                             step_engine.collective_bytes_per_step))
+    param_ici = float(getattr(step_engine, "param_sync_ici_bytes_per_step",
+                              0))
+    from bigdl_tpu.parallel.collectives import wire_itemsize
+
     return {
         "ici_bytes_per_step": float(step_engine.collective_bytes_per_step),
         "dcn_bytes_per_step": float(step_engine.dcn_bytes_per_step),
+        "grad_ici_bytes_per_step": grad_ici,
+        "param_ici_bytes_per_step": param_ici,
         "n_data_replicas": float(step_engine.n_data_replicas),
-        "grad_dtype_bytes": 2.0 if step_engine.bf16_grads else 4.0,
+        "grad_comm": mode,
+        # legacy key: payload bytes per gradient element on the wire
+        "grad_dtype_bytes": wire_itemsize(mode),
+        "comm_buckets": float(getattr(step_engine, "comm_buckets", 1)),
         "n_params_padded": float(step_engine.n_pad),
     }

@@ -91,9 +91,27 @@ DEFAULT_HELP = {
     "train.achieved_flops_per_chip": "achieved FLOP/s per chip over the "
                                      "last log window",
     "train.collective_ici_bytes_per_step": "per-step ICI collective bytes "
-                                           "of the gradient sync",
+                                           "of the ZeRO-1 cycle in the "
+                                           "actual wire dtype (grad_comm "
+                                           "payload + quantization scales "
+                                           "+ f32 param gather)",
     "train.collective_dcn_bytes_per_step": "per-step cross-slice (DCN) "
-                                           "collective bytes",
+                                           "collective bytes in the "
+                                           "actual wire dtype",
+    "train.collective_grad_ici_bytes_per_step":
+        "per-step ICI bytes of the GRADIENT reduce-scatter alone (the "
+        "compressible half; int8 counts payload + per-block scales)",
+    "train.collective_param_ici_bytes_per_step":
+        "per-step ICI bytes of the f32 updated-param all_gather",
+    "train.grad_comm_buckets": "gradient-sync buckets per step (1 = "
+                               "monolithic transfer)",
+    "train.comm_overlap_efficiency": "fraction of gradient-sync "
+                                     "collective time hidden under "
+                                     "compute (startup audit; 1.0 = "
+                                     "fully overlapped)",
+    "train.comm_exposed_collective_s": "per-step collective time NOT "
+                                       "hidden under compute (startup "
+                                       "audit)",
     "train.collective_ici_bytes_total": "run-lifetime ICI collective "
                                         "bytes moved by training steps",
     "train.collective_dcn_bytes_total": "run-lifetime DCN collective "

@@ -57,12 +57,26 @@ _ARTIFACT_GLOBS = (
     # only — a broken kernel is caught by the selfcheck exit code, not
     # misread as a perf row
     "KERNELS_r[0-9]*.json",
+    # the MULTICHIP family: per-step collective bytes of the ZeRO-1
+    # cycle.  The ledger is analytic (pure layout math, machine-
+    # independent), so bytes gate exactly — a change that silently
+    # re-inflates the wire fails the sentinel.  MULTICHIP_LARGE rounds
+    # carry the measured dp_resnet50_multislice cycle; the GRADCOMM
+    # rounds (bench_scaling --grad-comm) additionally carry the
+    # int8-vs-fp32 gradient-bytes reduction (higher-better — the
+    # compression must keep paying)
+    "MULTICHIP_LARGE_r[0-9]*.json",
+    "MULTICHIP_GRADCOMM_r[0-9]*.json",
 )
 
-# lower-is-better families (latencies, recovery time/traffic);
-# everything else is higher-better
+# lower-is-better families (latencies, recovery time/traffic, collective
+# bytes); everything else is higher-better
 _LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms",
-                           "cluster_mttr_s", "cluster_recovery_bytes"})
+                           "cluster_mttr_s", "cluster_recovery_bytes",
+                           "multichip_ici_bytes_per_step",
+                           "multichip_dcn_bytes_per_step",
+                           "multichip_grad_sync_ici_bytes_per_step",
+                           "multichip_grad_sync_dcn_bytes_per_step"})
 
 
 @dataclass
@@ -146,6 +160,27 @@ def normalize(doc: Any, source: str) -> List[Row]:
     if "mttr_s" in row:  # CLUSTER_r*.json recovery drills
         add("cluster_mttr_s", row["mttr_s"], LOWER)
         add("cluster_recovery_bytes", row.get("recovery_bytes"), LOWER)
+    if "grad_bytes_reduction_vs_fp32" in row:
+        # MULTICHIP_GRADCOMM rounds (bench_scaling --grad-comm): the
+        # int8-vs-fp32 compression ratio rides the generic "metric" row
+        # above (higher-better — the wire must stay shrunk); the shipped
+        # mode's absolute gradient bytes gate lower-better here.  All
+        # are analytic ledger values — machine-independent, so exact
+        add("multichip_grad_sync_ici_bytes_per_step",
+            row.get("grad_sync_ici_bytes_per_step"), LOWER)
+        add("multichip_grad_sync_dcn_bytes_per_step",
+            row.get("grad_sync_dcn_bytes_per_step"), LOWER)
+    if isinstance(row.get("modes"), dict):
+        # MULTICHIP_LARGE rounds: the measured dp_resnet50_multislice
+        # ZeRO-1 cycle's per-step collective bytes (fp32 baseline ~204 MB
+        # ICI + 51 MB DCN in r05) — a fresh round whose bytes regress
+        # >threshold above the best committed value fails the gate
+        m = row["modes"].get("dp_resnet50_multislice")
+        if isinstance(m, dict):
+            add("multichip_ici_bytes_per_step",
+                m.get("ici_collective_bytes_per_step"), LOWER)
+            add("multichip_dcn_bytes_per_step",
+                m.get("dcn_collective_bytes_per_step"), LOWER)
     if "kernels" in row and isinstance(row["kernels"], dict):
         # KERNELS_r*.json: one speedup family per kernel.  Only
         # parity-clean, non-probe rows gate (probe_ entries are tiling

@@ -37,6 +37,34 @@ def dequantize_int8(q, scales, axis: int = 0) -> jnp.ndarray:
     return q.astype(jnp.float32) * jnp.expand_dims(scales, axis)
 
 
+def quantize_blockwise(x, block: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blockwise symmetric int8 quantization along the LAST dimension.
+
+    ``x`` is ``(..., L)`` with ``L % block == 0``; every length-``block``
+    run gets its own abs-max scale, so one outlier only costs its own
+    block's mantissa (the EQuARX-style gradient-compression granularity —
+    PAPERS.md arXiv 2506.17615).  Returns ``(q int8 (..., L), scales f32
+    (..., L // block))``.  Pure jnp — safe inside jit/shard_map."""
+    lead, L = x.shape[:-1], x.shape[-1]
+    if L % block != 0:
+        raise ValueError(f"last dim {L} not a multiple of block {block}")
+    xb = x.reshape(*lead, L // block, block)
+    scales = abs_max_scales(xb, axis=-1)
+    q = jnp.clip(jnp.round(xb / scales[..., None]), -127, 127)
+    return q.astype(jnp.int8).reshape(*lead, L), scales.astype(jnp.float32)
+
+
+def dequantize_blockwise(q, scales) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blockwise`: ``q (..., L)`` int8 +
+    ``scales (..., L // block)`` → f32 ``(..., L)``.  The block size is
+    implied by the shapes."""
+    lead, L = q.shape[:-1], q.shape[-1]
+    nb = scales.shape[-1]
+    block = L // nb
+    xb = q.astype(jnp.float32).reshape(*lead, nb, block)
+    return (xb * scales[..., None]).reshape(*lead, L)
+
+
 def _int8_mm_kernel(x_ref, w_ref, o_ref):
     # x: (bm, bk) int8, w: (bk, bn) int8 → o: (bm, bn) int32; the K grid
     # dimension is innermost (sequential on-core), so the output block stays

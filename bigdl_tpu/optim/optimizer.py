@@ -12,7 +12,9 @@ only shards host batches, dispatches the jitted step, and evaluates triggers.
 Loss stays on-device between logs so iterations pipeline.
 """
 
+import os
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -152,7 +154,18 @@ class Optimizer:
         self.streaming = True  # stage-parallel input pipeline when the
         #                        dataset supports it (stream_batches);
         #                        host_prefetch=0 forces inline production
-        self.bf16_grads = False  # bf16 reduce-scatter (DCN-bound data axes)
+        self.bf16_grads = False  # DEPRECATED: grad_comm = "bf16" spelling
+        self.grad_comm = None  # gradient-sync wire format (docs/
+        #                        parallelism.md §Gradient compression):
+        #                        "fp32" | "bf16" | "int8" (blockwise-
+        #                        quantized, ~4x fewer gradient bytes);
+        #                        None = inherit EngineConfig.grad_comm
+        self.comm_bucket_bytes = None  # max flat-gradient bytes per
+        #                                collective (bucketed overlap);
+        #                                None = EngineConfig's, which
+        #                                defaults to one monolithic sync
+        self.quant_block = None  # int8 scale granularity (elements per
+        #                          f32 scale); None = collectives default
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
         self.remat_policy = None  # None|'nothing'|'dots' (keep MXU outputs)
         self.trainable_mask = None  # bool pytree over params (LoRA/freeze)
@@ -334,6 +347,26 @@ class Optimizer:
         self._preempt_signals = signals or (_signal.SIGTERM,)
         return self
 
+    def _resolved_grad_comm(self, config) -> str:
+        """The run's gradient-sync wire format: the explicit
+        ``grad_comm`` attribute, else the deprecated ``bf16_grads=True``
+        spelling (warned, mapped to "bf16"), else the engine default."""
+        if self.grad_comm is not None:
+            mode = str(self.grad_comm).strip().lower()
+            if self.bf16_grads and mode != "bf16":
+                warnings.warn(
+                    "both grad_comm and the deprecated bf16_grads are "
+                    f"set; grad_comm={mode!r} wins",
+                    DeprecationWarning, stacklevel=2)
+            return mode
+        if self.bf16_grads:
+            warnings.warn(
+                "Optimizer.bf16_grads is deprecated: set "
+                "grad_comm='bf16' (docs/parallelism.md §Gradient "
+                "compression)", DeprecationWarning, stacklevel=2)
+            return "bf16"
+        return getattr(config, "grad_comm", "fp32") or "fp32"
+
     # ---- the driver loop --------------------------------------------------
     def optimize(self) -> TrainedModel:
         engine = Engine.get()
@@ -362,13 +395,21 @@ class Optimizer:
             if has_frozen(self.model):
                 self.trainable_mask = trainable_mask_for(
                     self.model, init_vars["params"])
+        step_kw = dict(
+            grad_comm=self._resolved_grad_comm(engine.config),
+            comm_bucket_bytes=(self.comm_bucket_bytes
+                               if self.comm_bucket_bytes is not None
+                               else getattr(engine.config,
+                                            "comm_bucket_bytes", None)))
+        if self.quant_block is not None:
+            step_kw["quant_block"] = int(self.quant_block)
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
-            clip=self.clip, bf16_grads=self.bf16_grads, remat=self.remat,
+            clip=self.clip, remat=self.remat,
             remat_policy=self.remat_policy,
             trainable_mask=self.trainable_mask,
             accum_steps=self.accum_steps, ema_decay=self.ema_decay,
-            seq_parallel=self.seq_parallel)
+            seq_parallel=self.seq_parallel, **step_kw)
         n_params = step_engine.n_real
         log.info("model has %s parameters; mesh data axis = %d; ZeRO shard = %s",
                  f"{n_params:,}", step_engine.ndev,
@@ -377,6 +418,23 @@ class Optimizer:
         # the step counter (no host PRNGKey/fold_in per step, even at K=1)
         step_engine.set_step_seed(self.seed + 1)
         self._arm_perf_accounting(engine, step_engine, init_vars, init_args)
+        if os.environ.get("BIGDL_TPU_MEASURE_OVERLAP", "0") in ("1",
+                                                                "true"):
+            # opt-in startup audit (two extra compiles): how much of the
+            # gradient-sync collective time hides under compute — the
+            # live counterpart of bench_scaling --grad-comm
+            try:
+                ov = step_engine.measure_overlap(
+                    step_engine.shard_batch(sample["input"]),
+                    step_engine.shard_batch(
+                        np.asarray(sample["target"])))
+                self.metrics.gauge("train.comm_overlap_efficiency",
+                                   ov["overlap_efficiency"])
+                self.metrics.gauge("train.comm_exposed_collective_s",
+                                   ov["exposed_collective_s"])
+                flight.record("comm_overlap_audit", **ov)
+            except Exception as e:  # pragma: no cover — exotic meshes
+                log.warning("overlap audit failed (%s); skipped", e)
         spc = self.steps_per_call
         if spc is None:
             spc = getattr(engine.config, "steps_per_call", 1) or 1
@@ -474,6 +532,14 @@ class Optimizer:
                            self._ici_bytes_step)
         self.metrics.gauge("train.collective_dcn_bytes_per_step",
                            self._dcn_bytes_step)
+        # compression view: the gradient scatter (wire dtype + scales,
+        # the compressible half) vs the f32 param gather, and the bucket
+        # count the overlap scheduler works with
+        self.metrics.gauge("train.collective_grad_ici_bytes_per_step",
+                           led["grad_ici_bytes_per_step"])
+        self.metrics.gauge("train.collective_param_ici_bytes_per_step",
+                           led["param_ici_bytes_per_step"])
+        self.metrics.gauge("train.grad_comm_buckets", led["comm_buckets"])
 
     def _optimize_loop(self, step_engine, state) -> TrainedModel:
         engine = Engine.get()

@@ -16,13 +16,18 @@ XLA program over the mesh's "data" axis —
     --all_gather--> new flat params               (next-iteration getWeights)
 
 so the BlockManager/netty transport becomes ICI collectives and the two Spark
-stages per iteration become zero host round-trips.  FP16 gradient compression
-(``FP16CompressedTensor``) is unnecessary over ICI (bf16-grad option covers
-the DCN-bound case).  See PAPERS.md "Automatic Cross-Replica Sharding of
-Weight Update in Data-Parallel Training" for why this is the native XLA form.
+stages per iteration become zero host round-trips.  Gradient compression
+(``FP16CompressedTensor``) maps to the ``grad_comm`` wire-format knob:
+``"bf16"`` halves the gradient bytes, ``"int8"`` blockwise-quantizes them
+(EQuARX recipe — int8 payload + per-block scales, summed in a widened f32
+accumulator; see ``parallel/collectives.py``), and ``comm_bucket_bytes``
+splits the sync into buckets XLA can overlap with neighbouring compute.
+See PAPERS.md "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" for why this is the native XLA form.
 """
 
 import functools
+import warnings
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -35,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.obs.attr import expected_compile
 from bigdl_tpu.optim.validation import StatsAccumulator
+from bigdl_tpu.parallel import collectives
 from bigdl_tpu.runtime.mesh import (AXIS_DATA, AXIS_DCN, AXIS_SEQ,
                                     axis_size, shard_map)
 
@@ -53,20 +59,6 @@ class GradientClipping:
     constant_min: Optional[float] = None
     constant_max: Optional[float] = None
     l2_norm: Optional[float] = None
-
-
-def _clip_slice(g_slice, clip: Optional[GradientClipping], axis: str):
-    if clip is None:
-        return g_slice
-    if clip.constant_min is not None or clip.constant_max is not None:
-        g_slice = jnp.clip(g_slice, clip.constant_min, clip.constant_max)
-    if clip.l2_norm is not None:
-        # global norm over the full (sharded) gradient vector
-        sq = jax.lax.psum(jnp.sum(g_slice.astype(jnp.float32) ** 2), axis)
-        norm = jnp.sqrt(sq)
-        scale = jnp.minimum(1.0, clip.l2_norm / (norm + 1e-12))
-        g_slice = g_slice * scale
-    return g_slice
 
 
 def host_fetch(tree):
@@ -146,11 +138,38 @@ class ShardedParameterStep:
                  bf16_grads: bool = False, remat: bool = False,
                  remat_policy: Optional[str] = None,
                  accum_steps: int = 1, ema_decay: float = 0.0,
-                 seq_parallel: bool = False, trainable_mask=None):
-        """``bf16_grads``: reduce-scatter the gradient vector in bfloat16 —
-        halves the per-step collective bytes (the FP16CompressedTensor
-        analog; worthwhile when the data axis spans DCN, unnecessary over
-        ICI).  The optimizer update still runs on the f32 master params.
+                 seq_parallel: bool = False, trainable_mask=None,
+                 grad_comm: Optional[str] = None,
+                 comm_bucket_bytes: Optional[int] = None,
+                 quant_block: int = collectives.DEFAULT_QUANT_BLOCK):
+        """``grad_comm``: wire format of the gradient sync
+        (docs/parallelism.md §Gradient compression) —
+
+        - ``"fp32"`` (default): full-precision reduce-scatter, the
+          original cycle.
+        - ``"bf16"``: bfloat16 reduce-scatter — halves the gradient's
+          collective bytes (the FP16CompressedTensor analog).
+        - ``"int8"``: blockwise-quantized reduce-scatter (EQuARX recipe):
+          int8 payload + one f32 scale per ``quant_block`` elements over
+          an ``all_to_all``, summed in a widened f32 accumulator — ~4x
+          fewer gradient bytes on ICI and DCN.  The optimizer update
+          always runs on the f32 master params; a single-device data
+          axis skips quantization entirely (no wire, no rounding).
+
+        ``bf16_grads``: DEPRECATED spelling of ``grad_comm="bf16"``;
+        still accepted (with a warning) so existing configs keep working.
+
+        ``comm_bucket_bytes``: split the gradient sync into buckets of at
+        most this many flat-gradient bytes, one collective per bucket
+        dispatched as its slice of the backward's gradient is consumed —
+        bucket *k*'s optimizer update and param gather depend only on
+        bucket *k*'s reduce-scatter, the dependence structure XLA's
+        latency-hiding scheduler needs to overlap communication with
+        neighbouring buckets' compute.  ``None`` keeps one monolithic
+        transfer; shard ownership (and therefore optimizer-state layout
+        and checkpoints) is identical for every bucket size.
+
+        The optimizer update still runs on the f32 master params.
 
         ``remat``: wrap the forward in ``jax.checkpoint`` so the backward
         recomputes activations instead of storing them — trades FLOPs for
@@ -181,7 +200,28 @@ class ShardedParameterStep:
         self.optim = optim_method
         self.mesh = mesh
         self.clip = clip
-        self.bf16_grads = bf16_grads
+        if grad_comm is not None:
+            # same normalization as BIGDL_TPU_GRAD_COMM: every entry
+            # point (env / Optimizer attr / Estimator config) accepts
+            # the same spellings
+            grad_comm = str(grad_comm).strip().lower()
+        if bf16_grads:
+            warnings.warn(
+                "bf16_grads is deprecated: use grad_comm='bf16' "
+                "(docs/parallelism.md §Gradient compression)",
+                DeprecationWarning, stacklevel=2)
+            if grad_comm is None:
+                grad_comm = "bf16"
+        if grad_comm is None:
+            grad_comm = "fp32"
+        if grad_comm not in collectives.GRAD_COMM_MODES:
+            raise ValueError(f"grad_comm {grad_comm!r}: one of "
+                             f"{collectives.GRAD_COMM_MODES}")
+        self.grad_comm = grad_comm
+        # legacy readers (benches, old ledgers): True exactly for bf16 wire
+        self.bf16_grads = grad_comm == "bf16"
+        self.quant_block = int(quant_block)
+        self.comm_bucket_bytes = comm_bucket_bytes
         self.remat = remat
         # selective rematerialization: keep the MXU outputs (matmul/conv
         # results — expensive to recompute, cheap to store) and recompute
@@ -225,6 +265,13 @@ class ShardedParameterStep:
         self.n_real = flat.shape[0]
         self.n_pad = -(-self.n_real // self.ndev) * self.ndev
         self.shard_size = self.n_pad // self.ndev
+        # gradient-sync bucket table: contiguous column ranges of the
+        # (ndev, shard_size) gradient view — one collective per bucket,
+        # ownership identical to the monolithic layout for any bucketing
+        self._bucket_cols = collectives.bucket_columns(
+            self.shard_size, self.ndev, comm_bucket_bytes,
+            collectives.wire_itemsize(self.grad_comm),
+            self.quant_block if self.grad_comm == "int8" else None)
 
         # partial-training mask (LoRA / linear probe / freezing): a pytree
         # matching params with bool leaves (per-leaf scalars, e.g.
@@ -270,6 +317,22 @@ class ShardedParameterStep:
                                           self._rep))
         if self.optim.elementwise:
             opt_state = self.optim.init_state(jnp.zeros((self.n_pad,), flat.dtype))
+            if len(self._bucket_cols) > 1:
+                # per-bucket updates slice every state leaf like the
+                # param slice; a leaf that is NOT per-element (scalar
+                # running stats, oddly-shaped extras) would be fed whole
+                # to every bucket and silently diverge from the
+                # monolithic trajectory — fail loudly instead
+                bad = [tuple(jnp.shape(l)) for l in
+                       jax.tree_util.tree_leaves(opt_state)
+                       if tuple(jnp.shape(l)) != (self.n_pad,)]
+                if bad:
+                    raise ValueError(
+                        "comm_bucket_bytes requires per-element "
+                        "optimizer state (every leaf shaped "
+                        f"({self.n_pad},)); {type(self.optim).__name__} "
+                        f"has leaves shaped {bad} — use "
+                        "comm_bucket_bytes=None with this OptimMethod")
             self.opt_state = jax.device_put(opt_state, self._sharded_vec)
         else:
             opt_state = self.optim.init_state(init_variables["params"])
@@ -313,19 +376,30 @@ class ShardedParameterStep:
         return jax.tree_util.tree_map(self._leaf_spec, tree)
 
     # ------------------------------------------------------------------
-    def _make_step_shard(self, want_gnorm: bool = False):
+    def _make_step_shard(self, want_gnorm: bool = False, comm: bool = True):
         """The single-step body shared by the classic one-step program and
         the K-step bundle: (flat_p, ema, opt_state, mstate, step, rng, x,
         y, mask) -> (new_flat, new_ema, new_opt, new_mstate, loss, gnorm).
         ``want_gnorm`` adds the global mean-gradient L2 norm (one extra
         scalar psum on the elementwise path); without it the slot is a
-        constant 0 so the classic program's collectives are unchanged."""
+        constant 0 so the classic program's collectives are unchanged.
+        ``comm=False`` builds the compute-only overlap-audit variant:
+        the gradient scatter / param gather are replaced by same-shaped
+        local ops (WRONG numerics; model fwd/bwd and update FLOPs are
+        preserved, but the wire codec — int8 quantize/dequantize, bf16
+        casts — is elided with the collectives, so the audit attributes
+        codec cost to the collective side, matching the comm-only
+        probe's denominator) so :meth:`measure_overlap` can time the
+        step without its collectives."""
         model, criterion, optim = self.model, self.criterion, self.optim
         unravel, n_real = self.unravel, self.n_real
         ndev, shard_size = self.ndev, self.shard_size
         clip = self.clip
         elementwise = optim.elementwise
-        bf16_grads, remat = self.bf16_grads, self.remat
+        remat = self.remat
+        grad_comm, quant_block = self.grad_comm, self.quant_block
+        bucket_cols = tuple(self._bucket_cols)
+        dcn = self.dcn
         remat_policy = self.remat_policy
         accum = max(1, self.accum_steps)
         ema_decay = self.ema_decay
@@ -401,43 +475,109 @@ class ShardedParameterStep:
             flat_g = jnp.pad(flat_g, (0, flat_p.shape[0] - n_real))
             # frozen entries: zero gradient (keeps optimizer moments clean)
             flat_g = flat_g * mask.astype(flat_g.dtype)
-            # the layerwise path re-trees from the PRE-cast vector so
-            # bf16_grads (an elementwise reduce-scatter bandwidth knob)
-            # never costs it mantissa
-            flat_g_f32 = flat_g
-            if bf16_grads:
-                flat_g = flat_g.astype(jnp.bfloat16)
 
             if elementwise:
-                # reduce-scatter (mean) -> sharded update -> all-gather:
-                # exactly AllReduceParameter's put/aggregate/send cycle.
+                # bucketed reduce-scatter (mean) -> sharded update ->
+                # all-gather: exactly AllReduceParameter's
+                # put/aggregate/send cycle, one collective per bucket so
+                # XLA can overlap a bucket's update/gather with its
+                # neighbours' scatter (docs/parallelism.md §Gradient
+                # compression & bucketed overlap).  Wire format per
+                # grad_comm: f32 / bf16 psum_scatter, or blockwise-int8
+                # all_to_all summed in a widened f32 accumulator.
                 # Multislice: scatter rides ICI first, then only the
-                # 1/ndev slice is psum'd across DCN; every slice computes
-                # the identical update, so no parameter bytes cross DCN.
-                g_slice = jax.lax.psum_scatter(
-                    flat_g, AXIS_DATA, scatter_dimension=0, tiled=True)
-                if dcn_axis:
-                    # still in the gradient dtype: with bf16_grads the DCN
-                    # hop carries half the bytes (FP16CompressedTensor role)
-                    g_slice = jax.lax.psum(g_slice, dcn_axis)
-                g_slice = g_slice.astype(jnp.float32) / n_replicas
-                gnorm = (jnp.sqrt(jax.lax.psum(
-                    jnp.sum(g_slice * g_slice), AXIS_DATA))
-                    if want_gnorm else jnp.asarray(0.0, jnp.float32))
-                g_slice = _clip_slice(g_slice, clip, AXIS_DATA)
+                # 1/ndev slice crosses DCN (quantized again under int8);
+                # every slice computes the identical update, so no
+                # parameter bytes cross DCN.
                 rank = jax.lax.axis_index(AXIS_DATA)
-                p_slice = jax.lax.dynamic_slice(
-                    flat_p, (rank * shard_size,), (shard_size,))
-                new_p_slice, new_opt = optim.update(
-                    step, g_slice, p_slice, opt_state)
-                new_flat = jax.lax.all_gather(
-                    new_p_slice, AXIS_DATA, tiled=True)
+                g2d = (flat_g.reshape(ndev, shard_size) if ndev > 1
+                       else None)
+                slices = []
+                for c0, c1 in bucket_cols:
+                    if ndev > 1 and comm:
+                        sb = collectives.reduce_scatter_wire(
+                            g2d[:, c0:c1], AXIS_DATA, grad_comm,
+                            block=quant_block)
+                    elif ndev > 1:  # comm=False overlap probe: local chunk
+                        sb = jax.lax.dynamic_slice(
+                            flat_g, (rank * shard_size + c0,), (c1 - c0,))
+                    else:
+                        # single-rank data axis: no wire, no quantization
+                        sb = flat_g[c0:c1]
+                    if dcn_axis and comm:
+                        # still in the gradient dtype: with bf16 the DCN
+                        # hop carries half the bytes; int8 runs the
+                        # two-phase quantized exchange
+                        sb = collectives.psum_wire(
+                            sb, dcn_axis, dcn, grad_comm,
+                            block=quant_block)
+                    slices.append(sb.astype(jnp.float32) / n_replicas)
+                sq_local = sum(jnp.sum(sb * sb) for sb in slices)
+                gnorm = (jnp.sqrt(jax.lax.psum(sq_local, AXIS_DATA))
+                         if want_gnorm else jnp.asarray(0.0, jnp.float32))
+                if clip is not None:
+                    if (clip.constant_min is not None
+                            or clip.constant_max is not None):
+                        slices = [jnp.clip(sb, clip.constant_min,
+                                           clip.constant_max)
+                                  for sb in slices]
+                    if clip.l2_norm is not None:
+                        # global norm over the full (sharded) gradient
+                        sq = jax.lax.psum(
+                            sum(jnp.sum(sb * sb) for sb in slices),
+                            AXIS_DATA)
+                        scale = jnp.minimum(
+                            1.0, clip.l2_norm / (jnp.sqrt(sq) + 1e-12))
+                        slices = [sb * scale for sb in slices]
+
+                def slice_state(leaf, c0, wb):
+                    a = jnp.asarray(leaf)
+                    if a.ndim >= 1 and a.shape[0] == shard_size:
+                        return jax.lax.dynamic_slice_in_dim(a, c0, wb, 0)
+                    return a
+
+                new_parts, opt_parts = [], []
+                for (c0, c1), sb in zip(bucket_cols, slices):
+                    wb = c1 - c0
+                    p_b = jax.lax.dynamic_slice(
+                        flat_p, (rank * shard_size + c0,), (wb,))
+                    o_b = (opt_state if len(bucket_cols) == 1 else
+                           jax.tree_util.tree_map(
+                               lambda l, c=c0, w=wb: slice_state(l, c, w),
+                               opt_state))
+                    np_b, no_b = optim.update(step, sb, p_b, o_b)
+                    if ndev > 1 and comm:
+                        np_b = jax.lax.all_gather(
+                            np_b, AXIS_DATA, tiled=True)
+                    elif ndev > 1:  # comm=False probe: same-shape local op
+                        np_b = jnp.tile(np_b, ndev)
+                    new_parts.append(np_b.reshape(max(ndev, 1), wb))
+                    opt_parts.append(no_b)
+                # bucket b's gather returns columns [c0,c1) of every
+                # rank's chunk; concat along columns rebuilds the
+                # monolithic (ndev, shard_size) layout
+                new_flat = jnp.concatenate(new_parts, axis=1).reshape(-1)
+                if len(opt_parts) == 1:
+                    new_opt = opt_parts[0]
+                else:
+                    def join_state(*parts):
+                        a0 = jnp.asarray(parts[0])
+                        if a0.ndim >= 1 and sum(
+                                jnp.shape(p)[0] for p in parts) \
+                                == shard_size:
+                            return jnp.concatenate(parts, axis=0)
+                        return parts[-1]  # unsliced leaf: buckets agree
+
+                    new_opt = jax.tree_util.tree_map(
+                        join_state, *opt_parts)
             else:
                 # layerwise methods (LARS): plain psum allreduce + replicated
-                # update (matches the reference's treatment pre-slice-sharding)
-                # re-tree the flat (masked) gradient so the trainable_mask
-                # reaches this path's optimizer update too
-                grads = unravel(flat_g_f32[:n_real].astype(jnp.float32))
+                # update (matches the reference's treatment pre-slice-
+                # sharding); grad_comm is an elementwise-cycle knob, so
+                # this path always syncs full precision.  Re-tree the flat
+                # (masked) gradient so the trainable_mask reaches this
+                # path's optimizer update too
+                grads = unravel(flat_g[:n_real].astype(jnp.float32))
                 grads = jax.tree_util.tree_map(
                     lambda g: jax.lax.pmean(g, batch_axes), grads)
                 if want_gnorm:
@@ -479,8 +619,9 @@ class ShardedParameterStep:
             x_spec = y_spec = P(self._batch_axes)
         return opt_spec, x_spec, y_spec
 
-    def _build_train(self, x_ex=None, y_ex=None):
-        core = self._make_step_shard(want_gnorm=False)
+    def _build_train(self, x_ex=None, y_ex=None, donate: bool = True,
+                     comm: bool = True):
+        core = self._make_step_shard(want_gnorm=False, comm=comm)
 
         def step_shard(flat_p, ema, opt_state, mstate, step, rng, x, y,
                        mask):
@@ -494,6 +635,8 @@ class ShardedParameterStep:
                       P()),
             out_specs=(P(), P(), opt_spec, P(), P()),
         )
+        if not donate:  # overlap-audit probes must not consume live state
+            return jax.jit(mapped)
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
     def _build_bundle(self, n_steps: int, x_ex=None, y_ex=None):
@@ -582,16 +725,39 @@ class ShardedParameterStep:
         return jax.jit(mapped)
 
     @property
-    def collective_bytes_per_step(self) -> int:
-        """Per-step ICI traffic of the ZeRO-1 cycle: psum_scatter of the
-        flat gradient (f32, or bf16 with ``bf16_grads``) + all_gather of
-        the updated flat f32 params.  Zero on a single-device axis — a
-        size-1 psum_scatter/all_gather moves no bytes (matches
-        ``gspmd.collective_bytes_for_specs`` for the same topology)."""
+    def comm_buckets(self) -> int:
+        """Number of gradient-sync buckets (1 = monolithic transfer)."""
+        return len(self._bucket_cols)
+
+    @property
+    def grad_sync_ici_bytes_per_step(self) -> int:
+        """Per-step ICI wire bytes of the GRADIENT reduce-scatter, in the
+        actual wire dtype: f32/bf16 payload, or int8 payload + f32
+        per-block scales (block padding included) under
+        ``grad_comm="int8"`` — the honest before/after meter for
+        compression work (``parallel.collectives`` estimators are the
+        source of truth)."""
         if self.ndev <= 1:
             return 0
-        grad_bytes = self.n_pad * (2 if self.bf16_grads else 4)
-        return grad_bytes + self.n_pad * 4
+        return sum(collectives.rs_wire_bytes(
+            c1 - c0, self.ndev, self.grad_comm, self.quant_block)
+            for c0, c1 in self._bucket_cols)
+
+    @property
+    def param_sync_ici_bytes_per_step(self) -> int:
+        """Per-step ICI wire bytes of the updated-param all_gather —
+        always f32 (master params stay full precision on the wire)."""
+        return self.n_pad * 4 if self.ndev > 1 else 0
+
+    @property
+    def collective_bytes_per_step(self) -> int:
+        """Per-step ICI traffic of the ZeRO-1 cycle: the gradient
+        reduce-scatter (wire dtype per ``grad_comm``, scales included) +
+        all_gather of the updated flat f32 params.  Zero on a
+        single-device axis — a size-1 collective moves no bytes (matches
+        ``gspmd.collective_bytes_for_specs`` for the same topology)."""
+        return (self.grad_sync_ici_bytes_per_step
+                + self.param_sync_ici_bytes_per_step)
 
     @property
     def n_data_replicas(self) -> int:
@@ -602,10 +768,113 @@ class ShardedParameterStep:
     def dcn_bytes_per_step(self) -> int:
         """Per-step CROSS-SLICE (DCN) traffic: the hierarchical allreduce
         moves only the 1/ndev gradient slice over DCN (psum ~ 2x slice
-        bytes); parameters never cross slices."""
+        bytes, in the ``grad_comm`` wire dtype — int8 counts payload +
+        scales for both quantized phases); parameters never cross
+        slices."""
         if self.dcn <= 1:
             return 0
-        return 2 * self.shard_size * (2 if self.bf16_grads else 4)
+        return sum(collectives.psum_wire_bytes(
+            c1 - c0, self.dcn, self.grad_comm, self.quant_block)
+            for c0, c1 in self._bucket_cols)
+
+    # -- overlap audit (docs/performance.md §Gradient-comm modes) -------
+    def _build_comm_probe(self):
+        """Comm-only program: ONLY the bucketed gradient reduce-scatter
+        (+ DCN hop) and the bucketed param all_gather, on same-shaped
+        vectors — what :meth:`measure_overlap` times as 'total collective
+        time'."""
+        ndev, shard_size, dcn = self.ndev, self.shard_size, self.dcn
+        dcn_axis = self._dcn_axis
+        grad_comm, block = self.grad_comm, self.quant_block
+        cols = tuple(self._bucket_cols)
+        batch_axes = self._batch_axes
+
+        def comm_shard(flat_g, flat_p):
+            rank = jax.lax.axis_index(AXIS_DATA)
+            acc = jnp.asarray(0.0, jnp.float32)
+            g2d = flat_g.reshape(ndev, shard_size) if ndev > 1 else None
+            for c0, c1 in cols:
+                wb = c1 - c0
+                if ndev > 1:
+                    # the SAME wire dispatch the step body uses — the
+                    # audit must time exactly the step's collectives
+                    sb = collectives.reduce_scatter_wire(
+                        g2d[:, c0:c1], AXIS_DATA, grad_comm, block=block)
+                else:
+                    sb = flat_g[c0:c1]
+                if dcn_axis:
+                    sb = collectives.psum_wire(sb, dcn_axis, dcn,
+                                               grad_comm, block=block)
+                acc = acc + jnp.sum(sb.astype(jnp.float32))
+                p_b = jax.lax.dynamic_slice(
+                    flat_p, (rank * shard_size + c0,), (wb,))
+                if ndev > 1:
+                    p_b = jax.lax.all_gather(p_b, AXIS_DATA, tiled=True)
+                acc = acc + jnp.sum(p_b)
+            # replicate the scalar so the out_spec holds on every rank
+            return jax.lax.pmean(acc, batch_axes)
+
+        mapped = shard_map(comm_shard, mesh=self.mesh,
+                           in_specs=(P(), P()), out_specs=P())
+        return jax.jit(mapped)
+
+    def measure_overlap(self, x_dev, y_dev, *, steps: int = 5,
+                        rng=None) -> Dict[str, float]:
+        """One-shot overlap audit: how much of the gradient-sync
+        collective time does the step structure hide under compute?
+
+        Times three programs on the SAME shapes — the real train step, a
+        compute-only variant (collectives replaced by same-shaped local
+        ops), and a comm-only probe (just the bucketed scatter/gather
+        cycle) — and reports::
+
+            exposed_collective_s = max(0, step_s - compute_s)
+            overlap_efficiency   = 1 - exposed / collective_s   (in [0,1])
+
+        Builds two extra non-donating XLA programs, so this is a
+        bench/audit call (``bench_scaling --grad-comm``,
+        ``BIGDL_TPU_MEASURE_OVERLAP=1``), not a hot-path one.  Training
+        state is read, never consumed."""
+        import time as _time
+
+        if self.seq_parallel:
+            raise NotImplementedError(
+                "overlap audit under seq_parallel: use bench_scaling on "
+                "a data-parallel mesh")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        ema_in = self.ema_flat if self.ema_flat is not None \
+            else self._ema_dummy
+        mask_in = (self._mask_flat if self._mask_flat is not None
+                   else jnp.asarray(1.0, jnp.float32))
+        full = self._build_train(donate=False)
+        nocomm = self._build_train(donate=False, comm=False)
+        probe = self._build_comm_probe()
+        args = (self.flat_params, ema_in, self.opt_state,
+                self.model_state, jnp.asarray(0, jnp.int32), rng,
+                x_dev, y_dev, mask_in)
+
+        def timed(fn, *a):
+            jax.block_until_ready(fn(*a))  # compile + warm
+            ts = []
+            for _ in range(max(1, steps)):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(fn(*a))
+                ts.append(_time.perf_counter() - t0)
+            return float(np.median(ts))
+
+        with expected_compile():
+            t_full = timed(full, *args)
+            t_nocomm = timed(nocomm, *args)
+            t_comm = timed(probe, self.flat_params, self.flat_params)
+        exposed = max(0.0, t_full - t_nocomm)
+        eff = (min(1.0, max(0.0, 1.0 - exposed / t_comm))
+               if t_comm > 0 else 0.0)
+        return {"step_s": t_full, "compute_s": t_nocomm,
+                "collective_s": t_comm, "exposed_collective_s": exposed,
+                "overlap_efficiency": eff,
+                "comm_buckets": float(len(self._bucket_cols)),
+                "grad_comm": self.grad_comm}
 
     # ------------------------------------------------------------------
     def shard_batch(self, arr):

@@ -74,6 +74,19 @@ class EngineConfig:
     # steps_per_call attribute / Estimator "steps_per_call" config key
     # override per run.
     steps_per_call: Union[int, str] = 1
+    # gradient-sync wire format (docs/parallelism.md §Gradient
+    # compression): "fp32" (full precision), "bf16" (half the gradient
+    # bytes), "int8" (blockwise-quantized — int8 payload + per-block
+    # scales, ~4x fewer gradient bytes on ICI and DCN).  The Optimizer's
+    # grad_comm attribute / Estimator "grad_comm" config key override per
+    # run; BIGDL_TPU_GRAD_COMM overrides fleet-wide.
+    grad_comm: str = "fp32"
+    # gradient-sync bucketing (docs/parallelism.md): max flat-gradient
+    # bytes per collective — smaller buckets give XLA's latency-hiding
+    # scheduler independent scatter/update/gather chains to overlap;
+    # None = one monolithic transfer.  BIGDL_TPU_COMM_BUCKET_BYTES
+    # overrides fleet-wide.
+    comm_bucket_bytes: Optional[int] = None
     # kernel tile autotuning (docs/performance.md §Kernel autotuning):
     # "off" = hand-picked defaults only, "cache" = consult the on-disk
     # winner cache (default; never measures), "online" = measure-and-
@@ -146,6 +159,11 @@ class EngineConfig:
             cfg.metrics_host = os.environ["BIGDL_TPU_METRICS_HOST"]
         if os.environ.get("BIGDL_TPU_DATA_WORKERS"):
             cfg.data_workers = int(os.environ["BIGDL_TPU_DATA_WORKERS"])
+        if os.environ.get("BIGDL_TPU_GRAD_COMM"):
+            cfg.grad_comm = os.environ["BIGDL_TPU_GRAD_COMM"].strip().lower()
+        if os.environ.get("BIGDL_TPU_COMM_BUCKET_BYTES"):
+            cfg.comm_bucket_bytes = int(
+                os.environ["BIGDL_TPU_COMM_BUCKET_BYTES"])
         if os.environ.get("BIGDL_TPU_STEPS_PER_CALL"):
             raw = os.environ["BIGDL_TPU_STEPS_PER_CALL"].strip().lower()
             cfg.steps_per_call = "auto" if raw == "auto" else int(raw)
