@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-obs test-data test-bundle test-kernels test-collectives bench bench-dispatch bench-watch bench-gradcomm dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-data test-bundle test-kernels test-collectives bench bench-dispatch bench-watch bench-gradcomm bench-decode dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -55,6 +55,14 @@ test-cluster:
 test-serving:
 	python -m pytest tests/test_serving.py tests/test_serving_multiproc.py \
 	  tests/test_serving_chaos.py tests/test_serving_continuous.py -q
+
+# token-level decode serving (docs/serving.md §Autoregressive decode):
+# continuous-vs-one-scan byte parity (greedy + seeded sample, mid-flight
+# insertion), page-aliasing-free slot reuse, zero-recompile sweep,
+# streaming chunk framing, prefill-never-stalls-decode scheduling,
+# per-token deadline enforcement, paged flash-decode kernel parity
+test-decode:
+	python -m pytest tests/test_decode_engine.py -q
 
 # the observability suite (docs/observability.md): span tracer + chrome
 # export, Prometheus exposition (+HELP lines, scrape-under-mutation),
@@ -130,6 +138,13 @@ bench-loader:
 # occupancy + the zero-recompile mixed-size sweep; --smoke is the CI gate
 bench-serving:
 	python bench_serving.py
+
+# token-level decode bench (docs/serving.md §Autoregressive decode):
+# streaming keep-alive clients over a mixed prompt/output-length
+# geometry; continuous vs whole-batch-restart A/B (>= 2x gated);
+# the DECODE_r*.json artifact source
+bench-decode:
+	python bench_serving.py --decode
 
 # session-long TPU evidence orchestrator (single instance via flock;
 # BENCH_attempts.jsonl evidence trail)

@@ -19,12 +19,28 @@ Output: one JSON row on the last stdout line (the sentinel's
 ``p99_ms`` / ``avg_batch_size`` — the families the perf-regression
 sentinel gates against the committed SERVING_r* trajectory.
 
+The ``--decode`` mode is the DECODE_r*.json evidence source
+(docs/serving.md §Autoregressive decode): a subprocess LM server runs
+the token-level continuous decode engine, keep-alive STREAMING clients
+drive a sustained mixed prompt/output-length geometry, and the run
+reports aggregate + per-user tokens/s, time-to-first-token, and
+inter-token p99.  The A/B baseline is the SAME engine with
+``continuous=False`` — whole-batch-restart admission (every slot must
+free before the next wave starts), which is exactly what the one-scan
+whole-batch decode serving amounted to; ``speedup_vs_static`` is the
+continuous engine's tokens/s over that baseline and is sentinel-gated
+(≥2x on the committed geometry).  The sustained mixed-length load
+doubles as the recompile sweep: the run fails unless the server saw
+ZERO unexpected XLA recompiles.
+
 CLI::
 
     python bench_serving.py                  # full sustained-load run
     python bench_serving.py --fixed          # legacy-engine A/B
     python bench_serving.py --smoke          # CI gate: correctness +
                                              # batching + zero recompiles
+    python bench_serving.py --decode         # token-level decode bench
+    python bench_serving.py --decode --smoke # CI gate for the decode path
     python bench_serving.py --out SERVING_r08.json
 """
 
@@ -303,7 +319,300 @@ def _smoke() -> int:
     return 0 if not failures else 1
 
 
+# ---------------------------------------------------------------------------
+# token-level decode bench (--decode): the DECODE_r*.json evidence source
+# ---------------------------------------------------------------------------
+
+# tiny LM geometry: vocab 64, hidden 32, 2 heads, 2 layers; slot pool 8,
+# 8-token pages, 64-token cap.  Continuous vs whole-batch-restart rides
+# the SAME engine code behind DecodeConfig(continuous=).
+DECODE_SERVER = textwrap.dedent("""
+    import json
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.obs.attr import recompile_sentinel
+    from bigdl_tpu.optim.metrics import global_metrics
+    from bigdl_tpu.serving import (DecodeConfig, InferenceModel,
+                                   ServingConfig, ServingServer)
+    from bigdl_tpu.serving.http_frontend import HttpFrontend
+
+    sent = recompile_sentinel().install()
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        num_layers=2, dropout=0.0, mode="lm")
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.arange(8, dtype=np.int32)[None])
+    im = InferenceModel(model, variables, decode=DecodeConfig(
+        slots=%(slots)d, page_size=8, pages_per_slot=16, prompt_chunk=8,
+        max_new_tokens=120, eos_id=1, continuous=%(continuous)s))
+    im.decode_engine.warmup()
+    srv = ServingServer(im, ServingConfig(batch_size=8)).start()
+    fe = HttpFrontend(srv, port=0).start()
+    sent.mark_steady()
+    print(f"URL={fe.url}", flush=True)
+    sys.stdin.readline()
+    fe.stop(); srv.stop(); im.decode_engine.stop()
+    m = global_metrics()
+    print("RECOMPILES="
+          + str(int(m.counter('train.unexpected_recompiles_total'))),
+          flush=True)
+    st = im.decode_engine.stats
+    print("STATS=%%d,%%d" %% (st['steps'], st['completed']), flush=True)
+""")
+
+
+class _DecodeServer(_Server):
+    def __init__(self, continuous: bool, slots: int = 8):
+        code = DECODE_SERVER % {"continuous": repr(continuous),
+                                "slots": slots}
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       p for p in [REPO, os.environ.get("PYTHONPATH")]
+                       if p))
+        env.pop("XLA_FLAGS", None)
+        self.proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                                     stdin=subprocess.PIPE,
+                                     stdout=subprocess.PIPE, text=True)
+        self.ref = None
+        self.url = None
+        deadline = time.time() + 240
+        while time.time() < deadline and self.url is None:
+            line = self.proc.stdout.readline().strip()
+            if line.startswith("URL="):
+                self.url = line[4:]
+            elif not line and self.proc.poll() is not None:
+                raise RuntimeError("decode bench server died on startup")
+        if self.url is None:
+            self.proc.kill()
+            raise RuntimeError("decode bench server never printed its URL")
+        host, _, port = self.url.split("//", 1)[1].partition(":")
+        self.host, self.port = host, int(port)
+
+
+def _decode_request_mix(rs):
+    """One request of the mixed geometry: short prompts, short-heavy
+    output lengths (85%) with a long tail (15% near the horizon) — the
+    production chat regime, and the one where slot recycling beats
+    whole-batch restarts hardest (a wave pays the longest member's
+    horizon; the mean request is an order of magnitude shorter)."""
+    plen = int(rs.randint(4, 17))
+    max_new = int(rs.randint(96, 121) if rs.rand() < 0.15
+                  else rs.randint(4, 10))
+    prompt = rs.randint(2, 64, (plen,)).tolist()
+    return prompt, max_new
+
+
+def _stream_generate(host, port, conn, body, timeout=60.0):
+    """One streaming /generate on a persistent keep-alive connection.
+    Returns (conn, t_first_token, token_times, n_tokens)."""
+    import http.client as _hc
+
+    for attempt in (0, 1):
+        if conn is None:
+            conn = _hc.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("POST", "/generate", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+        except Exception:
+            conn.close()
+            conn = None
+            if attempt:
+                raise
+            continue
+        if resp.status != 200:
+            raise RuntimeError(f"HTTP {resp.status}: "
+                               f"{resp.read()[:200]!r}")
+        t_first = None
+        times = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            # the bench measures the SERVER; keep client-side JSON work
+            # out of the per-token loop (it competes for the same CPU)
+            if line.startswith(b'{"token"'):
+                times.append(time.time())
+                if t_first is None:
+                    t_first = times[-1]
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            event = json.loads(line)
+            if event.get("done") or "error" in event:
+                if "error" in event:
+                    raise RuntimeError(f"generate error: {event}")
+                break
+        resp.read()   # drain the terminal chunk so the conn is reusable
+        return conn, t_first, times
+    raise RuntimeError("unreachable")
+
+
+def _decode_client_threads(host: str, port: int, clients: int,
+                           duration_s: float, seed0: int):
+    """The thread-level load loop (one process's worth of clients).
+    Token RATE accounting is windowed: only tokens that arrived inside
+    the ``duration_s`` window count, so in-flight stragglers drained
+    after the deadline neither inflate nor dilute tokens/s.  Latency
+    samples (TTFT, inter-token gaps) keep every completed request."""
+    ttfts, gaps, errors = [], [], []
+    in_window = [0]
+    lock = threading.Lock()
+    start_t = time.time()
+    stop_t = start_t + duration_s
+
+    def client(ci):
+        rs = np.random.RandomState(seed0 + ci)
+        conn = None
+        try:
+            while time.time() < stop_t:
+                prompt, max_new = _decode_request_mix(rs)
+                body = json.dumps({"tokens": prompt,
+                                   "max_new_tokens": max_new,
+                                   "stream": True}).encode()
+                t0 = time.time()
+                conn, t_first, times = _stream_generate(
+                    host, port, conn, body)
+                with lock:
+                    if t_first is not None:
+                        ttfts.append(t_first - t0)
+                    gaps.extend(b - a for a, b in zip(times, times[1:]))
+                    in_window[0] += sum(1 for t in times if t <= stop_t)
+        except Exception as e:  # noqa: BLE001 — reported by caller
+            errors.append(e)
+        finally:
+            if conn is not None:
+                conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 180)
+    return ttfts, gaps, in_window[0], errors
+
+
+def _decode_worker_main(argv) -> int:
+    """``--decode-worker host port threads duration seed`` — one load
+    PROCESS.  The aggregate token rate of the continuous engine exceeds
+    what one Python process's GIL can consume, so the parent fans the
+    client threads out over several of these."""
+    host, port, threads, duration, seed = (
+        argv[0], int(argv[1]), int(argv[2]), float(argv[3]), int(argv[4]))
+    ttfts, gaps, tokens, errors = _decode_client_threads(
+        host, port, threads, duration, seed)
+    print(json.dumps({"ttfts": ttfts, "gaps": gaps, "tokens": tokens,
+                      "errors": [str(e) for e in errors[:3]]}))
+    return 0
+
+
+def _decode_load(server, clients: int, duration_s: float):
+    """Streaming keep-alive load from several worker PROCESSES (a
+    single client process saturates its GIL before the server
+    saturates) posting mixed-geometry generate requests."""
+    procs = max(1, min(4, clients // 8))
+    per = clients // procs
+    env = dict(os.environ)
+    workers = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--decode-worker",
+         server.host, str(server.port), str(per), str(duration_s),
+         str(1000 + 100 * i)],
+        stdout=subprocess.PIPE, text=True, env=env)
+        for i in range(procs)]
+    ttfts, gaps, errors = [], [], []
+    tokens = 0
+    for w in workers:
+        out, _ = w.communicate(timeout=duration_s + 240)
+        row = json.loads(out.strip().splitlines()[-1])
+        ttfts.extend(row["ttfts"])
+        gaps.extend(row["gaps"])
+        tokens += row["tokens"]
+        errors.extend(row["errors"])
+    # in-window tokens over the nominal window (every worker measures
+    # its own); wall returned for the artifact row only
+    return ttfts, gaps, [tokens], duration_s, errors
+
+
+def _pct(xs, q):
+    if not xs:
+        return 0.0
+    xs = np.sort(np.asarray(xs))
+    return float(xs[int(q * (xs.size - 1))])
+
+
+def run_decode_bench(continuous: bool, clients: int,
+                     duration_s: float) -> dict:
+    server = _DecodeServer(continuous=continuous)
+    try:
+        # warm phase outside the window: handler threads + client conns
+        _decode_load(server, clients, min(0.6, duration_s))
+        ttfts, gaps, counts, wall, errors = _decode_load(
+            server, clients, duration_s)
+        if errors:
+            raise RuntimeError(f"{len(errors)} client errors: {errors[0]}")
+    finally:
+        info = server.finish()
+    tokens = int(sum(counts))
+    return {
+        "engine": "continuous" if continuous else "static_batch_restart",
+        "geometry": f"decode_s8_c{clients}",
+        "concurrent_clients": clients,
+        "duration_s": round(wall, 2),
+        "requests": len(ttfts),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / wall, 1),
+        "tokens_per_s_user": round(tokens / wall / clients, 2),
+        "ttft_ms_p50": round(_pct(ttfts, 0.50) * 1e3, 2),
+        "ttft_ms_p99": round(_pct(ttfts, 0.99) * 1e3, 2),
+        "inter_token_p99_ms": round(_pct(gaps, 0.99) * 1e3, 2),
+        "engine_steps": info["batches"],      # STATS first field
+        "completed_requests": info["requests"],
+        "unexpected_recompiles": info.get("unexpected_recompiles", -1),
+        "streaming_clients": True,
+    }
+
+
+def run_decode(clients: int, duration_s: float, out=None,
+               smoke: bool = False) -> int:
+    """Both arms on the same geometry; the continuous row (plus the
+    baseline's tokens/s and the speedup) is the committed artifact."""
+    cont = run_decode_bench(True, clients, duration_s)
+    static = run_decode_bench(False, clients, duration_s)
+    speedup = (round(cont["tokens_per_s"] / static["tokens_per_s"], 2)
+               if static["tokens_per_s"] else 0.0)
+    row = dict(cont, static_tokens_per_s=static["tokens_per_s"],
+               static_ttft_ms_p99=static["ttft_ms_p99"],
+               speedup_vs_static=speedup)
+    failures = []
+    for arm in (cont, static):
+        if arm["tokens"] <= 0:
+            failures.append(f"{arm['engine']}: no tokens generated")
+        if arm["unexpected_recompiles"] != 0:
+            failures.append(
+                f"{arm['engine']}: {arm['unexpected_recompiles']} "
+                "unexpected XLA recompiles under the mixed-length load")
+    if not smoke and speedup < 2.0:
+        failures.append(f"continuous tokens/s only {speedup}x the "
+                        "whole-batch-restart baseline (< 2x)")
+    if out:
+        with open(out, "w") as f:
+            json.dump(row, f, indent=1)
+    print(json.dumps(row))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--decode-worker":
+        return _decode_worker_main(argv[1:])
     ap = argparse.ArgumentParser(
         description="sustained-load serving bench (docs/serving.md)")
     ap.add_argument("--clients", type=int, default=32)
@@ -313,9 +622,21 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: correctness + batching + zero "
                          "unexpected recompiles on both engines")
+    ap.add_argument("--decode", action="store_true",
+                    help="token-level decode bench: continuous vs "
+                         "whole-batch-restart, streaming clients")
     ap.add_argument("--out", default=None,
                     help="also write the artifact JSON here")
     args = ap.parse_args(argv)
+    if args.decode:
+        clients = args.clients
+        if args.smoke:
+            return run_decode(clients=4, duration_s=1.5, smoke=True)
+        out = args.out
+        if out is None and os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
+            out = os.path.join(REPO, "DECODE_r01.json")
+        return run_decode(clients=clients, duration_s=args.duration,
+                          out=out)
     if args.smoke:
         return _smoke()
     row = run_bench(not args.fixed, args.clients, args.duration)

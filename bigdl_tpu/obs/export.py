@@ -133,6 +133,32 @@ DEFAULT_HELP = {
     "serving.queue_depth": "requests queued across all model heaps",
     "serving.backlog": "admitted requests not yet in predict (heaps + "
                        "handoff slot) — the autoscaling pressure signal",
+    # token-level decode serving (docs/serving.md §Autoregressive decode)
+    "serving.decode.tokens_per_s": "generated tokens/s over the recent "
+                                   "decode-step window",
+    "serving.decode.ttft_s": "time to first token per generate request "
+                             "(admission -> first token out)",
+    "serving.decode.inter_token_s": "gap between consecutive streamed "
+                                    "tokens of one sequence",
+    "serving.decode.step_s": "one decode model step (all active slots, "
+                             "one token each)",
+    "serving.decode.prefill_s": "one prompt prefill chunk through the "
+                                "prefill program",
+    "serving.decode.slot_occupancy": "occupied decode slots / slot pool "
+                                     "size",
+    "serving.decode.page_utilization": "allocated KV-cache pages / page "
+                                       "pool size",
+    "serving.decode.queue_depth": "generate requests queued for a free "
+                                  "slot (deadline-heap ordered)",
+    "serving.decode.tokens_total": "generated tokens, engine lifetime",
+    "serving.decode.requests": "generate requests admitted into slots",
+    "serving.decode.completed": "generate requests finished (eos or "
+                                "length)",
+    "serving.decode.expired": "generate requests dropped by per-token "
+                              "deadline enforcement (queued or "
+                              "mid-decode)",
+    "serving.decode.steps": "decode model steps executed",
+    "serving.decode.prefill_chunks": "prompt prefill chunks executed",
     "serving_pool.workers": "serving pool size (autoscaler-managed)",
     "serving_pool.conn_reuse": "proxy forwards served over a reused "
                                "keep-alive worker connection",

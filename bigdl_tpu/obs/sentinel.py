@@ -47,6 +47,10 @@ _ARTIFACT_GLOBS = (
     "BENCH_dispatch_r[0-9]*.json",
     "BENCH_loader_r[0-9]*.json",
     "SERVING_r[0-9]*.json",
+    # token-level decode serving rounds (bench_serving --decode):
+    # aggregate tokens/s and the continuous-vs-static speedup gate
+    # higher-better; TTFT and inter-token tails gate lower-better
+    "DECODE_r[0-9]*.json",
     # cluster recovery drills (docs/resilience.md §Multi-host recovery):
     # MTTR and restore traffic gate like the latency families — a
     # recovery that got 10% slower or 10% heavier is a regression
@@ -72,6 +76,8 @@ _ARTIFACT_GLOBS = (
 # lower-is-better families (latencies, recovery time/traffic, collective
 # bytes); everything else is higher-better
 _LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms",
+                           "decode_ttft_ms_p50", "decode_ttft_ms_p99",
+                           "decode_inter_token_p99_ms",
                            "cluster_mttr_s", "cluster_recovery_bytes",
                            "multichip_ici_bytes_per_step",
                            "multichip_dcn_bytes_per_step",
@@ -157,6 +163,23 @@ def normalize(doc: Any, source: str) -> List[Row]:
         # occupancy sliding back toward per-request predicts is the
         # regression the r05->r08 rebuild exists to prevent
         add(f"serving_avg_batch_size{sfx}", row.get("avg_batch_size"))
+    if "tokens_per_s" in row:
+        # DECODE_r*.json (bench_serving --decode): sustained-generation
+        # geometry.  Same geometry-scoping rule as the SERVING family —
+        # a saturated decode p99 is not comparable across client counts
+        geo = re.sub(r"[^A-Za-z0-9]+", "_",
+                     str(row.get("geometry") or "")).strip("_")
+        sfx = f"_{geo}" if geo else ""
+        add(f"decode_tokens_per_s{sfx}", row["tokens_per_s"])
+        add(f"decode_tokens_per_s_user{sfx}", row.get("tokens_per_s_user"))
+        add(f"decode_ttft_ms_p50{sfx}", row.get("ttft_ms_p50"), LOWER)
+        add(f"decode_ttft_ms_p99{sfx}", row.get("ttft_ms_p99"), LOWER)
+        add(f"decode_inter_token_p99_ms{sfx}",
+            row.get("inter_token_p99_ms"), LOWER)
+        # the reason this engine exists: continuous decode must keep
+        # beating the whole-batch-restart baseline
+        add(f"decode_speedup_vs_static{sfx}",
+            row.get("speedup_vs_static"))
     if "mttr_s" in row:  # CLUSTER_r*.json recovery drills
         add("cluster_mttr_s", row["mttr_s"], LOWER)
         add("cluster_recovery_bytes", row.get("recovery_bytes"), LOWER)

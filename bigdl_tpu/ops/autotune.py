@@ -272,6 +272,31 @@ def _flash_bwd_builder(shape_key):
     return make
 
 
+def _flash_decode_builder(shape_key):
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.flash_attention import paged_decode_attention
+
+    S, h, page, d, nb, dtype = shape_key
+    rs = np.random.RandomState(0)
+    P = S * nb
+    q = jnp.asarray(rs.randn(S, h, d), dtype)
+    kp = jnp.asarray(rs.randn(P, h, page, d), dtype)
+    vp = jnp.asarray(rs.randn(P, h, page, d), dtype)
+    pt = jnp.asarray(rs.permutation(P)[: S * nb].reshape(S, nb), jnp.int32)
+    lengths = jnp.asarray(rs.randint(0, nb * page, (S,)), jnp.int32)
+
+    def make(cfg):
+        if h % cfg["block_h"] != 0:
+            raise ValueError(f"block_h {cfg['block_h']} does not divide "
+                             f"heads {h}")
+        return jax.jit(lambda: paged_decode_attention(
+            q, kp, vp, pt, lengths, block_h=cfg["block_h"]))
+
+    return make
+
+
 def _ln_builder(shape_key):
     import jax
     import jax.numpy as jnp
@@ -356,6 +381,17 @@ REGISTRY: Dict[str, KernelSpec] = {
             "small": (1, 2, 256, 64, "bfloat16"),
             "lm_2k": (4, 8, 2048, 128, "bfloat16"),
         }),
+    "flash_attention_decode": KernelSpec(
+        name="flash_attention_decode",
+        space={"block_h": hp_mod.choice([1, 2, 4, 8])},
+        defaults={"block_h": 4},
+        builder=_flash_decode_builder,
+        key_fn=lambda sk: decode_attention_key(sk[0], sk[1], sk[2],
+                                               sk[3], sk[4], sk[5]),
+        bench_shapes={
+            "small": (8, 4, 8, 32, 4, "float32"),
+            "serve_8x8": (16, 8, 16, 64, 8, "bfloat16"),
+        }),
     "fused_layernorm": KernelSpec(
         name="fused_layernorm",
         space={"block_rows": hp_mod.choice([64, 128, 256, 512, 1024])},
@@ -412,6 +448,12 @@ def attention_key(q_shape, kv_len: int, dtype) -> str:
     b, h, s, d = q_shape
     return (f"bh{_pow2_bucket(b * h)}_q{_pow2_bucket(s)}"
             f"_k{_pow2_bucket(kv_len)}_d{d}_{_dtype_name(dtype)}")
+
+
+def decode_attention_key(slots: int, heads: int, page: int, hd: int,
+                         n_blocks: int, dtype) -> str:
+    return (f"s{_pow2_bucket(slots)}_h{heads}_p{page}_d{hd}"
+            f"_nb{_pow2_bucket(n_blocks)}_{_dtype_name(dtype)}")
 
 
 def rows_key(rows: int, cols: int, dtype) -> str:

@@ -210,6 +210,123 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, block_k_bwd,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale, page_size):
+    """Single-query attention over one slot's paged KV cache.  Grid
+    (slots, head-blocks, page-blocks); the page dimension is innermost
+    and walks the slot's page table via the scalar-prefetched index map
+    — only the slot's own pages are ever touched, so HBM traffic scales
+    with the sequence's true length, not the pool size."""
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    num_pb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # positions [j*page, (j+1)*page) attend when <= the slot's length
+    @pl.when(j * page_size <= len_ref[s])
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # (bh, d)
+        k = k_ref[0].astype(jnp.float32)                 # (bh, page, d)
+        v = v_ref[0].astype(jnp.float32)
+        # VPU-friendly batched dot: broadcast-multiply-reduce keeps the
+        # per-head contraction off the (batched-dot-averse) MXU path
+        sc = jnp.sum(q[:, None, :] * k, axis=-1)         # (bh, page)
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1)
+        sc = jnp.where(pos <= len_ref[s], sc, _NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.sum(
+            p[:, :, None] * v, axis=1)
+
+    @pl.when(j == num_pb - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           sm_scale: Optional[float] = None,
+                           block_h: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """Query-length-1 decode-step attention over a paged KV cache — the
+    serving-side sibling of :func:`flash_attention` (docs/serving.md
+    §Autoregressive decode).
+
+    ``q``: (slots, heads, head_dim) — one query per sequence slot.
+    ``k_pages``/``v_pages``: (num_pages, heads, page_size, head_dim) —
+    the page pool ONE layer's cache lives in.  ``page_table``: (slots,
+    n_blocks) int32 — each slot's ordered page list (entries past the
+    allocated count may be stale; they are masked by ``lengths``).
+    ``lengths``: (slots,) int32 — the highest valid cache position per
+    slot, INCLUSIVE (the current token's K/V must already be written).
+
+    ``block_h`` tiles the head dimension per program (must divide
+    heads); ``None`` consults the autotune cache under the
+    ``flash_attention_decode`` registry entry and falls back to the
+    largest of {1,2,4,8} that divides ``heads``."""
+    S, h, d = q.shape
+    P, hk, page, dk = k_pages.shape
+    assert (h, d) == (hk, dk), (q.shape, k_pages.shape)
+    nb = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    from bigdl_tpu.ops import autotune
+
+    if block_h is None:
+        key = autotune.decode_attention_key(S, h, page, d, nb,
+                                            q.dtype)
+        shape = ((S, h, page, d, nb, q.dtype.name)
+                 if autotune.is_concrete(q, k_pages, v_pages) else None)
+        bh = int(autotune.resolve("flash_attention_decode", key,
+                                  online_shape=shape)["block_h"])
+        if h % bh != 0:  # cached winner from another head count
+            bh = max(c for c in (1, 2, 4, 8) if h % c == 0)
+    else:
+        bh = int(block_h)
+        if h % bh != 0:
+            raise ValueError(f"block_h {bh} must divide heads {h}")
+
+    kernel = functools.partial(_decode_kernel, sm_scale=float(sm_scale),
+                               page_size=page)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, h // bh, nb),
+        in_specs=[
+            pl.BlockSpec((1, bh, d), lambda s, hb, j, pt, ln: (s, hb, 0)),
+            pl.BlockSpec((1, bh, page, d),
+                         lambda s, hb, j, pt, ln: (pt[s, j], hb, 0, 0)),
+            pl.BlockSpec((1, bh, page, d),
+                         lambda s, hb, j, pt, ln: (pt[s, j], hb, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bh, d),
+                               lambda s, hb, j, pt, ln: (s, hb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bh, 1), jnp.float32),    # running max
+            pltpu.VMEM((bh, 1), jnp.float32),    # running denom
+            pltpu.VMEM((bh, d), jnp.float32),    # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, h, d), q.dtype),
+        interpret=default_interpret(interpret),
+    )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lengths, jnp.int32),
+      q, k_pages, v_pages)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None,

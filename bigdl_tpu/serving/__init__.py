@@ -22,9 +22,12 @@ from bigdl_tpu.serving.http_frontend import HttpClient, HttpFrontend
 
 from bigdl_tpu.serving.seq2seq import Seq2SeqService
 from bigdl_tpu.serving.pool import ServingPool
+from bigdl_tpu.serving.decode_engine import (DecodeConfig, DecodeEngine,
+                                             DecodeRequest, DecodeResult)
 
 __all__ = [
     "Seq2SeqService", "InferenceModel", "ServingServer", "ServingConfig",
     "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient",
     "ServingPool", "ServiceUnavailableError", "DeadlineExceededError",
-    "RequestDroppedError"]
+    "RequestDroppedError", "DecodeConfig", "DecodeEngine",
+    "DecodeRequest", "DecodeResult"]

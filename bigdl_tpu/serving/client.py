@@ -42,6 +42,21 @@ class InputQueue:
         return self._server.enqueue(np.asarray(arr), request_id=uri,
                                     deadline_s=deadline_s, model=model)
 
+    def enqueue_generate(self, uri: Optional[str] = None,
+                         deadline_s: Optional[float] = None,
+                         model: Optional[str] = None, *, tokens,
+                         **gen_kwargs) -> str:
+        """Queue-client surface of the decode path (docs/serving.md
+        §Autoregressive decode): admit a generate request for
+        ``model``'s continuous decode engine; ``OutputQueue.query``
+        returns the generated token array.  ``gen_kwargs`` pass through
+        to :meth:`~bigdl_tpu.serving.server.ServingServer.
+        enqueue_generate` (max_new_tokens, temperature, top_k, top_p,
+        seed, on_token)."""
+        return self._server.enqueue_generate(
+            np.asarray(tokens, np.int32), request_id=uri,
+            deadline_s=deadline_s, model=model, **gen_kwargs)
+
 
 class OutputQueue:
     def __init__(self, server: ServingServer):

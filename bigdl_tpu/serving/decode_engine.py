@@ -1,0 +1,1422 @@
+"""Token-level continuous batching — paged KV-cache decode engine.
+
+ROADMAP item 3: the continuous engine (PR 8) batches *stateless*
+predicts; autoregressive generation is stateful — a sequence occupies
+its seat for many model steps.  The r05-era answer (``seq2seq.py``'s
+one-``lax.scan`` whole-batch decode) holds every seat until the LAST
+row finishes: one long request stalls the whole batch, and a request
+arriving mid-decode waits for a full batch restart.  This engine runs
+generation ONE MODEL STEP AT A TIME over a fixed pool of sequence
+slots:
+
+- **Paged KV cache** — decoder self-attention K/V live in a pool of
+  fixed-size pages (``page_size`` tokens each); a slot owns an ordered
+  page list, so a finished sequence returns its pages mid-flight and a
+  queued request reuses them on the next step (vLLM-style paging, at
+  the block granularity the TPU memory system likes).
+- **Closed compile set** — every jitted program is keyed by a bucketed
+  cache length (pages doubling up to the slot cap) and the fixed chunk
+  size, all pre-compilable by :meth:`DecodeEngine.warmup` under
+  ``expected_compile``; a mixed prompt/generation-length sweep triggers
+  ZERO unexpected XLA recompiles (the PR 6 sentinel discipline).
+- **In-flight insertion / eviction at step granularity** — admission is
+  re-evaluated between steps from a (deadline, seq) heap (the PR 8
+  per-tenant deadline ordering); a finished or expired sequence frees
+  its slot and pages immediately and the next queued request claims
+  them on the following step.  Deadlines are re-checked per token, so
+  an expired streaming request never decodes to ``max_new_tokens``.
+- **Prefill/decode separation** — prompts chunk through a prefill
+  program (``prompt_chunk`` tokens per call, attending over the pages
+  written so far) interleaved one chunk per engine iteration with
+  decode steps, so a long prompt never stalls the decode batch; the
+  decode program only ever runs query-length-1 steps.
+
+Byte-identical parity (the acceptance invariant): the continuous
+engine's tokens are byte-identical to :meth:`DecodeEngine.
+static_generate` — the one-scan whole-sequence reference — for the
+same request set, greedy AND seeded-sample, including requests
+inserted mid-flight.  The two paths share ``chunk_forward`` (the layer
+math) and ``_select_tokens`` (the sampling rule) verbatim; parity then
+rests on three XLA facts the test suite pins: per-row results of a
+matmul are independent of the number of co-batched rows (for >= 2
+rows — single-row programs take a different gemv path, so every
+matmul in both paths keeps >= 2 rows), masked-softmax attention is
+bit-stable under padded key lengths (masked lanes contribute exact
+zeros), and threefry key streams are counter-based (per-row
+``fold_in(request_key, position)`` draws are batch-shape-independent).
+
+Observability: ``serving.decode.*`` gauges/histograms — tokens/s,
+time-to-first-token, inter-token latency, slot occupancy, page
+utilization — all described in ``obs/export.py``'s catalog
+(docs/serving.md §Autoregressive decode has the knob table).
+"""
+
+import heapq
+import itertools
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import _attn_project, positional_encoding
+from bigdl_tpu.nn.module import EMPTY
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.serving.decode")
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# config / request / result
+# ---------------------------------------------------------------------------
+
+@dataclass
+class DecodeConfig:
+    """Engine geometry.  ``slots * pages_per_slot`` pages exist by
+    default; ``page_size * pages_per_slot`` is the per-sequence token
+    cap (prompt + generated).  All sizes are static — they define the
+    closed set of compiled programs."""
+
+    slots: int = 8
+    page_size: int = 16
+    pages_per_slot: int = 8
+    # total pages in the pool; None = slots * pages_per_slot (admission
+    # then never blocks on pages).  Smaller values exercise page-level
+    # admission control: a request is only admitted when its WORST-CASE
+    # page need is reservable, so a slot can never starve mid-flight.
+    num_pages: Optional[int] = None
+    # prefill chunk length: prompts run through the prefill program
+    # this many tokens at a time, one prefill CALL per engine iteration
+    prompt_chunk: int = 16
+    # slots co-batched per prefill call (padded to exactly this many
+    # rows — one compiled program, and >= 2 rows keeps the bit-parity
+    # rule).  Batching amortizes the per-dispatch host cost that would
+    # otherwise make admission-heavy traffic prefill-bound
+    prefill_batch: int = 4
+    max_new_tokens: int = 32          # default per-request cap
+    eos_id: int = 1
+    base_seed: int = 0
+    # False = whole-batch-restart baseline: admission only happens when
+    # EVERY slot is free, and each wave decodes the FULL
+    # ``max_new_tokens`` horizon before any seat frees — the cost model
+    # of the legacy one-``lax.scan`` whole-sequence decode this engine
+    # replaces (a fixed-length scan cannot exit early; a finished row
+    # holds its seat to the last step).  The A/B arm bench_serving
+    # --decode measures the continuous engine against.
+    continuous: bool = True
+    queue_capacity: int = 4096
+    # None = auto (Pallas kernel on TPU, gathered-jnp path elsewhere).
+    # The jnp path is the byte-parity reference; the kernel path is the
+    # TPU production path (allclose, not bitwise — online softmax).
+    use_flash_decode: Optional[bool] = None
+
+    @property
+    def cap(self) -> int:
+        return self.page_size * self.pages_per_slot
+
+    @property
+    def total_pages(self) -> int:
+        return self.num_pages if self.num_pages is not None \
+            else self.slots * self.pages_per_slot
+
+    def len_buckets(self) -> Tuple[int, ...]:
+        """Cache-length buckets in PAGES: doubling from 1 up to the slot
+        cap — the closed set every decode/prefill program is keyed by."""
+        out = []
+        b = 1
+        while b < self.pages_per_slot:
+            out.append(b)
+            b *= 2
+        out.append(self.pages_per_slot)
+        return tuple(out)
+
+    def bucket_pages(self, tokens: int) -> int:
+        """Smallest bucket (in pages) covering ``tokens`` cache slots.
+        Floored so the attended width is >= 8 keys: XLA's tiny-reduce
+        path for a narrower masked softmax is not bit-stable against
+        the wider buckets (measured; docs/serving.md §Autoregressive
+        decode), and the parity invariant is non-negotiable."""
+        need = max(1, -(-max(tokens, 8) // self.page_size))
+        for b in self.len_buckets():
+            if b >= need:
+                return b
+        return self.pages_per_slot
+
+
+@dataclass
+class DecodeRequest:
+    """One generation request.  ``tokens`` is the prompt (for seq2seq:
+    the SOURCE sequence — the adapter turns it into encoder context and
+    a BOS decoder prompt)."""
+
+    tokens: np.ndarray
+    max_new_tokens: Optional[int] = None
+    temperature: float = 0.0          # 0 = greedy
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    rid: Optional[str] = None
+    tenant: str = "default"
+    deadline_t: float = math.inf      # absolute; math.inf = never
+    on_token: Optional[Callable[[str, int, int], None]] = None
+    on_done: Optional[Callable[["DecodeRequest"], None]] = None
+    # -- engine-internal ----------------------------------------------------
+    admit_t: float = 0.0
+    seq: int = 0
+    prepared: Optional[tuple] = None   # cached adapter.prepare() output
+    result: Optional["DecodeResult"] = None
+    error: Optional[Exception] = None
+    _event: threading.Event = field(default_factory=threading.Event,
+                                    repr=False)
+
+    def wait(self, timeout: Optional[float] = None) -> "DecodeResult":
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"decode request {self.rid} not done")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+@dataclass
+class DecodeResult:
+    tokens: np.ndarray        # generated tokens, EOS included if hit
+    logp: float               # summed log-prob of the generated tokens
+    prompt_len: int
+    ttft_s: float             # admission -> first token
+    finish_reason: str        # "eos" | "length" | "expired"
+
+
+class _ActiveSeq:
+    """Host-side state of one occupied slot."""
+
+    __slots__ = ("req", "prompt", "ctx", "pages", "reserved",
+                 "generated", "logp", "prefill_pos",
+                 "first_token_t", "last_token_t", "max_new", "done")
+
+    def __init__(self, req: DecodeRequest, prompt: np.ndarray, ctx,
+                 reserved: int, max_new: int):
+        self.req = req
+        self.prompt = prompt
+        self.ctx = ctx
+        self.pages: List[int] = []
+        self.reserved = reserved
+        self.generated: List[int] = []
+        self.logp = np.float32(0.0)
+        self.prefill_pos = 0          # prompt tokens consumed by prefill
+        self.first_token_t = 0.0
+        self.last_token_t = 0.0
+        self.max_new = max_new
+        self.done = False
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefill_pos < len(self.prompt)
+
+
+# ---------------------------------------------------------------------------
+# shared math: token selection (greedy / temperature / top-k / top-p)
+# ---------------------------------------------------------------------------
+
+def _select_tokens(logits, keys, positions, temps, top_ks, top_ps):
+    """Per-row next-token selection — THE sampling rule both the
+    continuous engine and the static reference trace, so they agree to
+    the bit.  ``positions`` is the sequence position each selected token
+    will occupy; the draw key is ``fold_in(request_key, position)``, a
+    counter-based stream independent of batch shape and engine step
+    index (the property that makes mid-flight insertion parity-safe).
+
+    ``temps <= 0`` rows take the greedy argmax; sampling rows apply
+    temperature, per-row top-k (threshold at the k-th sorted logit) and
+    nucleus top-p (the standard keep-the-crossing-token rule), then an
+    explicit per-row Gumbel-max draw (``categorical`` re-derived so the
+    bits depend only on the row's key).  Returns ``(token, logp)`` with
+    logp from the UNfiltered log-softmax."""
+    logits = logits.astype(jnp.float32)
+    vocab = logits.shape[-1]
+    lp_full = jax.nn.log_softmax(logits, axis=-1)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    z = logits / jnp.maximum(temps, 1e-6)[:, None]
+    zs = jnp.sort(z, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        zs, jnp.clip(top_ks - 1, 0, vocab - 1)[:, None], axis=-1)
+    z = jnp.where((top_ks > 0)[:, None] & (z < kth), -jnp.inf, z)
+    zs2 = jnp.sort(z, axis=-1)[:, ::-1]
+    ps = jax.nn.softmax(zs2, axis=-1)
+    prev_mass = jnp.cumsum(ps, axis=-1) - ps
+    keep = prev_mass < top_ps[:, None]
+    minz = jnp.min(jnp.where(keep, zs2, jnp.inf), axis=-1, keepdims=True)
+    z = jnp.where((top_ps < 1.0)[:, None] & (z < minz), -jnp.inf, z)
+
+    step_keys = jax.vmap(jax.random.fold_in)(keys, positions)
+    tiny = jnp.finfo(jnp.float32).tiny
+    u = jax.vmap(lambda k: jax.random.uniform(
+        k, (vocab,), minval=tiny, maxval=1.0))(step_keys)
+    gumbel = -jnp.log(-jnp.log(u))
+    sampled_tok = jnp.argmax(z + gumbel, axis=-1).astype(jnp.int32)
+
+    tok = jnp.where(temps <= 0.0, greedy_tok, sampled_tok)
+    logp = jnp.take_along_axis(lp_full, tok[:, None], axis=-1)[:, 0]
+    return tok, logp
+
+
+def _write_chunk(buf, positions, new, cap):
+    """Scatter ``new`` (B, h, C, hd) into ``buf`` (B, h, K, hd) at
+    per-row positions ``positions + [0..C)``; out-of-range positions
+    (padded chunk tails crossing the cap) are dropped."""
+    B, _, C, _ = new.shape
+    rows = jnp.arange(B)[:, None]
+    cols = positions[:, None] + jnp.arange(C)[None, :]
+    cols = jnp.where(cols < cap, cols, buf.shape[2])
+    return buf.at[rows, :, cols].set(
+        new.transpose(0, 2, 1, 3).astype(buf.dtype), mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# model adapters: the layer math both decode paths share
+# ---------------------------------------------------------------------------
+
+class _AdapterBase:
+    """Shared transformer step math over an explicit KV buffer.  The
+    engine feeds it a page-gathered view; the static reference feeds it
+    a contiguous cache — identical values at every unmasked position,
+    so the outputs agree bitwise (see the module docstring)."""
+
+    def __init__(self, model, params):
+        self.model = model
+        self.params = params
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.num_heads, self.head_dim).transpose(
+            0, 2, 1, 3)
+
+    def _attend(self, q, kb, vb, valid):
+        """Masked single-buffer attention: q (B,h,C,hd) over kb/vb
+        (B,h,K,hd); ``valid`` (B,C,K) True = attend.  Mirrors
+        ``nn.attention.transformer_decode_cached`` op-for-op."""
+        hd = q.shape[-1]
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32), kb,
+            preferred_element_type=jnp.float32) / jnp.sqrt(float(hd))
+        logits = jnp.where(valid[:, None], logits, _NEG_INF)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, vb,
+                          preferred_element_type=jnp.float32)
+
+    def _merge(self, a, x, p):
+        B, _, C, _ = a.shape
+        a = a.transpose(0, 2, 1, 3).reshape(B, C,
+                                            self.num_heads * self.head_dim)
+        from bigdl_tpu.tensor.policy import cast_compute
+
+        return (jnp.matmul(a.astype(x.dtype), cast_compute(p["wo"]),
+                           preferred_element_type=jnp.float32)
+                + p["bo"]).astype(x.dtype)
+
+    def _logits(self, x):
+        from bigdl_tpu.tensor.policy import cast_compute
+
+        h, _ = self.model.ln_out.forward(self.params["ln_out"], EMPTY, x)
+        emb = cast_compute(self.params["embedding"])
+        out = jnp.matmul(cast_compute(h), emb.T,
+                         preferred_element_type=jnp.float32)
+        return out.astype(jnp.float32)
+
+
+class LMAdapter(_AdapterBase):
+    """Causal LM (``Transformer(mode="lm")``): the prompt prefills the
+    self-attention cache; generation continues from its last token."""
+
+    def __init__(self, model, params, cap: int):
+        if model.mode != "lm":
+            raise ValueError("LMAdapter needs a Transformer(mode='lm')")
+        super().__init__(model, params)
+        layer = model.decoder[0].attn
+        self.num_heads = layer.num_heads
+        self.head_dim = layer.head_dim
+        self.num_layers = len(model.decoder)
+        self.vocab = model.vocab_size
+        self._pe = positional_encoding(cap + 1, model.hidden_size)
+        self._scale = jnp.sqrt(float(model.hidden_size))
+
+    def ctx_specs(self) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+        return {}
+
+    def prepare(self, tokens: np.ndarray):
+        """LM: the prompt IS the decoder prompt; no cross context."""
+        return np.asarray(tokens, np.int32).reshape(-1), {}
+
+    def chunk_forward(self, params, tokens, positions, kbuf, vbuf, ctx,
+                      self_attend=None):
+        """One step of C tokens per row: embed at absolute positions,
+        write each layer's K/V into the buffer, attend causally over
+        the cache, return last-layer logits.  ``kbuf/vbuf``:
+        (B, L, h, K, hd) f32.  ``self_attend(i, q, k_new, v_new)``
+        overrides the buffer attention (the engine's paged flash
+        path, which owns its own cache writes); ``kbuf/vbuf`` may then
+        be None."""
+        B, C = tokens.shape
+        cap = self._pe.shape[0] - 1
+        q_pos = positions[:, None] + jnp.arange(C)[None, :]        # (B,C)
+        x = (jnp.take(params["embedding"], tokens.astype(jnp.int32),
+                      axis=0) * self._scale
+             + self._pe[q_pos].astype(jnp.float32))
+        if self_attend is None:
+            K = kbuf.shape[3]
+            valid = jnp.arange(K)[None, None, :] <= q_pos[:, :, None]
+        k_news, v_news = [], []
+        for i, layer in enumerate(self.model.decoder):
+            lp = params[f"dec{i}"]
+            h1, _ = layer.ln1.forward(lp["ln1"], EMPTY, x)
+            sp = lp["attn"]
+            q = self._split(_attn_project(sp, h1, "wq", "bq"))
+            k_new = self._split(_attn_project(sp, h1, "wk", "bk"))
+            v_new = self._split(_attn_project(sp, h1, "wv", "bv"))
+            if self_attend is not None:
+                a = self_attend(i, q, k_new, v_new)
+            else:
+                kb = _write_chunk(kbuf[:, i], positions, k_new, cap)
+                vb = _write_chunk(vbuf[:, i], positions, v_new, cap)
+                kbuf = kbuf.at[:, i].set(kb)
+                vbuf = vbuf.at[:, i].set(vb)
+                a = self._attend(q, kb, vb, valid)
+            x = x + self._merge(a, x, sp)
+            h2, _ = layer.ln2.forward(lp["ln2"], EMPTY, x)
+            f, _ = layer.ffn.forward(lp["ffn"], EMPTY, h2)
+            x = x + f
+            k_news.append(k_new)
+            v_news.append(v_new)
+        return (self._logits(x), kbuf, vbuf,
+                jnp.stack(k_news, 1), jnp.stack(v_news, 1))
+
+
+class Seq2SeqAdapter(_AdapterBase):
+    """Translation transformer: "prefill" is the ENCODER — it turns the
+    source sequence into per-layer cross-attention K/V context; the
+    decoder prompt is a single BOS and every decode step is query-
+    length 1 over the paged self-attention cache plus the fixed cross
+    context (masked to the true source length)."""
+
+    def __init__(self, model, params, cap: int, bos_id: int,
+                 src_buckets: Sequence[int] = (8, 16, 32, 64)):
+        if model.mode != "translation":
+            raise ValueError("Seq2SeqAdapter needs a translation-mode "
+                             "Transformer")
+        super().__init__(model, params)
+        layer = model.decoder[0].self_attn
+        self.num_heads = layer.num_heads
+        self.head_dim = layer.head_dim
+        self.num_layers = len(model.decoder)
+        self.vocab = model.vocab_size
+        self.bos_id = bos_id
+        self.src_buckets = tuple(sorted(src_buckets))
+        self.src_cap = self.src_buckets[-1]
+        self._pe = positional_encoding(cap + 1, model.hidden_size)
+        self._scale = jnp.sqrt(float(model.hidden_size))
+        self._encode_cache: Dict[int, Any] = {}
+
+    def ctx_specs(self):
+        L, h, hd = self.num_layers, self.num_heads, self.head_dim
+        return {
+            "ck": ((L, h, self.src_cap, hd), jnp.float32),
+            "cv": ((L, h, self.src_cap, hd), jnp.float32),
+            "src_len": ((), jnp.int32),
+        }
+
+    def _encode_fn(self, bucket: int):
+        fn = self._encode_cache.get(bucket)
+        if fn is None:
+            model, params = self.model, self.params
+
+            def encode(src, src_len):
+                # key-padding mask keeps padded source positions out of
+                # encoder attention, so a bucket-padded encode matches
+                # the exact-length encode row-for-row
+                mask = (jnp.arange(bucket) < src_len)[None, None, None, :]
+                x = model._embed(params, src)
+                for i, layer in enumerate(model.encoder):
+                    x, _ = layer.forward(params[f"enc{i}"], EMPTY, x,
+                                         mask=mask)
+                cks, cvs = [], []
+                pad = self.src_cap - bucket
+                for i in range(len(model.decoder)):
+                    cp = params[f"dec{i}"]["cross_attn"]
+                    ck = self._split(_attn_project(cp, x, "wk", "bk"))
+                    cv = self._split(_attn_project(cp, x, "wv", "bv"))
+                    cks.append(jnp.pad(
+                        ck, ((0, 0), (0, 0), (0, pad), (0, 0)))[0])
+                    cvs.append(jnp.pad(
+                        cv, ((0, 0), (0, 0), (0, pad), (0, 0)))[0])
+                return jnp.stack(cks), jnp.stack(cvs)
+
+            fn = jax.jit(encode)
+            self._encode_cache[bucket] = fn
+        return fn
+
+    def prepare(self, tokens: np.ndarray):
+        src = np.asarray(tokens, np.int32).reshape(1, -1)
+        t = src.shape[1]
+        bucket = next((b for b in self.src_buckets if b >= t), None)
+        if bucket is None:
+            raise ValueError(f"source length {t} exceeds the largest "
+                             f"src bucket {self.src_buckets[-1]}")
+        if bucket > t:
+            src = np.pad(src, ((0, 0), (0, bucket - t)))
+        ck, cv = self._encode_fn(bucket)(src, np.int32(t))
+        ctx = {"ck": ck, "cv": cv, "src_len": np.int32(t)}
+        return np.asarray([self.bos_id], np.int32), ctx
+
+    def warmup_buckets(self, sample_src_lens: Optional[Sequence[int]] = None):
+        for b in (sample_src_lens or self.src_buckets):
+            b = int(b)
+            jax.block_until_ready(self._encode_fn(b)(
+                np.zeros((1, b), np.int32), np.int32(b)))
+
+    def chunk_forward(self, params, tokens, positions, kbuf, vbuf, ctx,
+                      self_attend=None):
+        """Decoder step: causal self-attention over the cache plus
+        cross-attention over the per-row encoder context — mirrors
+        ``transformer_decode_cached`` op-for-op so the engine path
+        stays byte-compatible with the legacy one-scan service."""
+        B, C = tokens.shape
+        cap = self._pe.shape[0] - 1
+        q_pos = positions[:, None] + jnp.arange(C)[None, :]
+        x = (jnp.take(params["embedding"], tokens.astype(jnp.int32),
+                      axis=0) * self._scale
+             + self._pe[q_pos].astype(jnp.float32))
+        if self_attend is None:
+            K = kbuf.shape[3]
+            valid = jnp.arange(K)[None, None, :] <= q_pos[:, :, None]
+        src_valid = (jnp.arange(self.src_cap)[None, None, :]
+                     < ctx["src_len"].reshape(-1, 1, 1))       # (B,1,Tcap)
+        src_valid = jnp.broadcast_to(src_valid, (B, C, self.src_cap))
+        k_news, v_news = [], []
+        for i, layer in enumerate(self.model.decoder):
+            lp = params[f"dec{i}"]
+            h1, _ = layer.ln1.forward(lp["ln1"], EMPTY, x)
+            sp = lp["self_attn"]
+            q = self._split(_attn_project(sp, h1, "wq", "bq"))
+            k_new = self._split(_attn_project(sp, h1, "wk", "bk"))
+            v_new = self._split(_attn_project(sp, h1, "wv", "bv"))
+            if self_attend is not None:
+                a = self_attend(i, q, k_new, v_new)
+            else:
+                kb = _write_chunk(kbuf[:, i], positions, k_new, cap)
+                vb = _write_chunk(vbuf[:, i], positions, v_new, cap)
+                kbuf = kbuf.at[:, i].set(kb)
+                vbuf = vbuf.at[:, i].set(vb)
+                a = self._attend(q, kb, vb, valid)
+            x = x + self._merge(a, x, sp)
+            h2, _ = layer.ln2.forward(lp["ln2"], EMPTY, x)
+            cp = lp["cross_attn"]
+            qc = self._split(_attn_project(cp, h2, "wq", "bq"))
+            a = self._attend(qc, ctx["ck"][:, i], ctx["cv"][:, i],
+                             src_valid)
+            x = x + self._merge(a, x, cp)
+            h3, _ = layer.ln3.forward(lp["ln3"], EMPTY, x)
+            f, _ = layer.ffn.forward(lp["ffn"], EMPTY, h3)
+            x = x + f
+            k_news.append(k_new)
+            v_news.append(v_new)
+        return (self._logits(x), kbuf, vbuf,
+                jnp.stack(k_news, 1), jnp.stack(v_news, 1))
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class DecodeEngine:
+    """Fixed slot pool + paged KV cache + step-granular scheduling.
+
+    Thread model: clients call :meth:`submit` (any thread); one engine
+    thread owns the slots, pages, and device cache buffers.  Results
+    are delivered through ``DecodeRequest.wait()`` / ``on_done``;
+    per-token streaming through ``on_token`` (called on the engine
+    thread — keep callbacks cheap)."""
+
+    def __init__(self, adapter, config: Optional[DecodeConfig] = None,
+                 metrics=None, name: str = "decode"):
+        self.adapter = adapter
+        self.cfg = config or DecodeConfig()
+        if metrics is None:
+            from bigdl_tpu.optim.metrics import global_metrics
+
+            metrics = global_metrics()
+        self.metrics = metrics
+        self.name = name
+        cfg = self.cfg
+        L, h, hd = adapter.num_layers, adapter.num_heads, adapter.head_dim
+        if cfg.slots < 2 or cfg.prefill_batch < 2:
+            raise ValueError("DecodeConfig.slots and prefill_batch must "
+                             "be >= 2 (single-row programs take a "
+                             "different XLA reduction path and break "
+                             "decode parity)")
+        self._kv_k = jnp.zeros((L, cfg.total_pages, h, cfg.page_size, hd),
+                               jnp.float32)
+        self._kv_v = jnp.zeros_like(self._kv_k)
+        self._ctx_bufs = {
+            k: jnp.zeros((cfg.slots,) + shape, dtype)
+            for k, (shape, dtype) in adapter.ctx_specs().items()}
+        # host-side slot boards (numpy; converted per dispatch)
+        S = cfg.slots
+        self._page_table = np.zeros((S, cfg.pages_per_slot), np.int32)
+        self._lengths = np.zeros((S,), np.int32)
+        self._last_tokens = np.zeros((S,), np.int32)
+        self._active_mask = np.zeros((S,), bool)
+        # per-slot request SEEDS — the request key fold happens inside
+        # the compiled programs (an eager fold_in per admission costs a
+        # device round-trip on the hot loop)
+        self._seeds = np.zeros((S,), np.int32)
+        self._temps = np.zeros((S,), np.float32)
+        self._top_ks = np.zeros((S,), np.int32)
+        self._top_ps = np.ones((S,), np.float32)
+        self._slots: List[Optional[_ActiveSeq]] = [None] * S
+        self._free_pages: List[int] = list(range(cfg.total_pages))
+        self._reserved_pages = 0
+        self._base_key = jax.random.PRNGKey(cfg.base_seed)
+        # work queue: (deadline_t, seq, req) — the PR 8 deadline-heap
+        # ordering at decode-queue granularity
+        self._heap: List[Tuple[float, int, DecodeRequest]] = []
+        self._seq = itertools.count(1)
+        self._wave_steps = 0     # continuous=False: steps into the wave
+        self._wave_horizon = cfg.max_new_tokens
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # jitted program caches — keyed by bucket pages (closed set)
+        self._step_fns: Dict[int, Callable] = {}
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._prefill_scratch: Optional[Dict[str, np.ndarray]] = None
+        self._gauge_t = 0.0
+        self._last_step_t = 0.0
+        self._ctx_write_fn: Optional[Callable] = None
+        self._static_prefill_fns: Dict[Tuple[int, int], Callable] = {}
+        self._static_scan_fns: Dict[Tuple[int, int], Callable] = {}
+        # event ring for scheduling specs ("prefill_chunk"/"decode_step")
+        self.events: deque = deque(maxlen=512)
+        self._tokens_window = deque(maxlen=256)   # (t, n) for tokens/s
+        self.stats = {"requests": 0, "completed": 0, "expired": 0,
+                      "tokens": 0, "steps": 0, "prefill_chunks": 0,
+                      "rejected": 0}
+        self.metrics.describe(
+            "serving.decode.tokens_per_s",
+            "generated tokens/s over the recent step window")
+
+    # -- client side --------------------------------------------------------
+    def submit(self, req: DecodeRequest) -> DecodeRequest:
+        if self._stop.is_set():
+            raise RuntimeError("decode engine stopped")
+        prompt_preview = np.asarray(req.tokens, np.int32).reshape(-1)
+        if len(prompt_preview) == 0:
+            # an empty prompt would occupy a slot that can never
+            # prefill, decode, or expire — reject at the door
+            raise ValueError("empty prompt: a generate request needs at "
+                             "least one input token")
+        if getattr(self.adapter, "bos_id", None) is None \
+                and len(prompt_preview) >= self.cfg.cap:
+            raise ValueError(
+                f"prompt of {len(prompt_preview)} tokens exceeds the "
+                f"cache cap {self.cfg.cap} (page_size * pages_per_slot)")
+        req.admit_t = time.time()
+        req.rid = req.rid or f"{self.name}-{next(self._seq)}"
+        with self._cv:
+            if len(self._heap) >= self.cfg.queue_capacity:
+                self.stats["rejected"] += 1
+                raise RuntimeError("decode queue full")
+            req.seq = next(self._seq)
+            heapq.heappush(self._heap, (req.deadline_t, req.seq, req))
+            self._cv.notify_all()
+        self._ensure_thread()
+        return req
+
+    def generate(self, prompts, **kw) -> List[DecodeResult]:
+        """Synchronous helper: submit every prompt, wait for all."""
+        reqs = [self.submit(DecodeRequest(tokens=np.asarray(p), **kw))
+                for p in prompts]
+        return [r.wait(timeout=120.0) for r in reqs]
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._heap)
+
+    def active_slots(self) -> int:
+        return int(self._active_mask.sum())
+
+    # -- lifecycle ----------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        # under the cv lock: concurrent submits must never race TWO
+        # engine threads into existence — both would donate the same
+        # device cache buffers and poison every later dispatch
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"decode-{self.name}")
+                self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # fail whatever is still queued or in flight — explicit verdicts
+        with self._cv:
+            queued = [r for _, _, r in self._heap]
+            self._heap.clear()
+        for req in queued:
+            self._finish_error(req, RuntimeError(
+                f"decode request {req.rid} dropped: engine stopped"))
+        if self._thread is not None and self._thread.is_alive():
+            # the engine thread is wedged past the join budget: touching
+            # slot/page state from here would race its own release path
+            # (a double page free = cross-request KV aliasing).  Leak
+            # the in-flight requests instead — strictly safer.
+            log.error("decode engine thread did not exit within 10s; "
+                      "leaving in-flight slots to it")
+            return
+        for s, seq in enumerate(self._slots):
+            if seq is not None:
+                if not seq.done:   # a done (gang-mode) seat already
+                    #                delivered its result
+                    self._finish_error(seq.req, RuntimeError(
+                        f"decode request {seq.req.rid} dropped: engine "
+                        "stopped"))
+                self._release_slot(s)
+
+    def warmup(self) -> "DecodeEngine":
+        """Compile the CLOSED program set before traffic: one decode
+        step and one prefill program per cache-length bucket (plus the
+        adapter's encode buckets), inside ``expected_compile`` so the
+        recompile sentinel stays quiet.  After this, a mixed prompt/
+        generation-length sweep runs with zero XLA compiles."""
+        from bigdl_tpu.obs.attr import expected_compile
+
+        with expected_compile():
+            if hasattr(self.adapter, "warmup_buckets"):
+                self.adapter.warmup_buckets()
+            # the one eager jax op on the admission path: the
+            # per-request key fold.  Same shapes for every seed, so one
+            # call here keeps the first real admission compile-free
+            np.asarray(jax.random.fold_in(self._base_key, 0))
+            for nb in self.cfg.len_buckets():
+                self._step_fn(nb)
+                self._prefill_fn(nb)
+            if self._ctx_bufs:
+                # CALL the ctx-write program (jit() alone compiles
+                # nothing): the first seq2seq admission must not pay —
+                # or flag — a mid-traffic compile
+                zeros = {k: jnp.zeros_like(v[0])
+                         for k, v in self._ctx_bufs.items()}
+                self._ctx_bufs = self._ctx_write()(self._ctx_bufs, 0,
+                                                   zeros)
+            # trace each program once on zero inputs (compile happens at
+            # first CALL, not jit(); results discarded, buffers donated
+            # copies so live state is untouched)
+            self._warm_run()
+        return self
+
+    def _warm_run(self) -> None:
+        cfg = self.cfg
+        S = cfg.slots
+        kv_k, kv_v = self._kv_k, self._kv_v
+        for nb in cfg.len_buckets():
+            kv_k, kv_v, _, _ = self._step_fn(nb)(
+                kv_k, kv_v, self._ctx_bufs,
+                self._page_table, np.zeros((S,), np.int32),
+                np.zeros((S,), np.int32),
+                np.zeros((S,), bool), np.zeros((S,), np.int32),
+                np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                np.ones((S,), np.float32))
+            B = cfg.prefill_batch
+            kv_k, kv_v, _, _ = self._prefill_fn(nb)(
+                kv_k, kv_v, self._ctx_bufs,
+                np.zeros((B,), np.int32),
+                np.zeros((B, cfg.pages_per_slot), np.int32),
+                np.zeros((B, cfg.prompt_chunk), np.int32),
+                np.zeros((B,), np.int32), np.zeros((B,), np.int32),
+                np.zeros((B,), bool), np.zeros((B,), np.int32),
+                np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+                np.ones((B,), np.float32))
+        jax.block_until_ready(kv_k)
+        self._kv_k, self._kv_v = kv_k, kv_v
+
+    # -- jitted programs ----------------------------------------------------
+    def _gather(self, kv, pt):
+        """(L, P, h, page, hd)[pages pt (B, nb)] -> (B, L, h, nb*page,
+        hd) contiguous per-slot cache view."""
+        g = kv[:, pt]                       # (L, B, nb, h, page, hd)
+        L, B, nb, h, page, hd = g.shape
+        return g.transpose(1, 0, 3, 2, 4, 5).reshape(B, L, h, nb * page,
+                                                     hd)
+
+    def _use_flash(self) -> bool:
+        if self.cfg.use_flash_decode is not None:
+            return bool(self.cfg.use_flash_decode)
+        from bigdl_tpu.ops.common import on_tpu
+
+        return on_tpu()
+
+    def _step_fn(self, n_blocks: int):
+        fn = self._step_fns.get(n_blocks)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        adapter = self.adapter
+        page = cfg.page_size
+        use_flash = self._use_flash()
+
+        base_key = jnp.asarray(np.asarray(self._base_key))
+
+        def step(kv_k, kv_v, ctx_bufs, page_table, lengths, last_tokens,
+                 active, seeds, temps, top_ks, top_ps):
+            keys = jax.vmap(jax.random.fold_in)(
+                jnp.broadcast_to(base_key, (seeds.shape[0], 2)), seeds)
+            pt = page_table[:, :n_blocks]
+            # write target of this step's K/V: the page holding position
+            # ``lengths`` (inactive slots get an out-of-range page id ->
+            # the scatter drops their write)
+            wid = jnp.where(active,
+                            jnp.take_along_axis(
+                                page_table, (lengths // page)[:, None],
+                                axis=1)[:, 0],
+                            cfg.total_pages)
+            off = lengths % page
+            if use_flash:
+                # paged flash path: scatter each layer's K/V into the
+                # pages FIRST, then run the single-query Pallas kernel
+                # straight off the page pool — no gathered cache copy
+                from bigdl_tpu.ops.flash_attention import \
+                    paged_decode_attention
+
+                kv = {"k": kv_k, "v": kv_v}
+
+                def self_attend(i, q, k_new, v_new):
+                    kv["k"] = kv["k"].at[i, wid, :, off].set(
+                        k_new[:, :, 0].astype(kv_k.dtype), mode="drop")
+                    kv["v"] = kv["v"].at[i, wid, :, off].set(
+                        v_new[:, :, 0].astype(kv_v.dtype), mode="drop")
+                    out = paged_decode_attention(
+                        q[:, :, 0], kv["k"][i], kv["v"][i], pt, lengths)
+                    return out.astype(jnp.float32)[:, :, None]
+
+                logits, _, _, _, _ = adapter.chunk_forward(
+                    adapter.params, last_tokens[:, None], lengths, None,
+                    None, ctx_bufs, self_attend=self_attend)
+                kv_k, kv_v = kv["k"], kv["v"]
+            else:
+                kbuf = self._gather(kv_k, pt)
+                vbuf = self._gather(kv_v, pt)
+                logits, _, _, k_new, v_new = adapter.chunk_forward(
+                    adapter.params, last_tokens[:, None], lengths, kbuf,
+                    vbuf, ctx_bufs)
+                kv_k = kv_k.at[:, wid, :, off].set(
+                    k_new[:, :, :, 0].astype(kv_k.dtype), mode="drop")
+                kv_v = kv_v.at[:, wid, :, off].set(
+                    v_new[:, :, :, 0].astype(kv_v.dtype), mode="drop")
+            tok, logp = _select_tokens(logits[:, 0], keys, lengths + 1,
+                                       temps, top_ks, top_ps)
+            return kv_k, kv_v, tok, logp
+
+        fn = jax.jit(step, donate_argnums=(0, 1))
+        self._step_fns[n_blocks] = fn
+        return fn
+
+    def _prefill_fn(self, n_blocks: int):
+        """Prefill one chunk for up to ``prefill_batch`` slots in ONE
+        program call: attends over the pages written so far, scatters
+        every row's chunk K/V into its slot's pages, and selects the
+        FIRST generated token from the logits at ``last_index`` (only
+        meaningful for rows on their final chunk).  The batch is padded
+        to exactly ``prefill_batch`` rows (inactive padding rows write
+        nowhere) — one compiled program per cache bucket, and >= 2 rows
+        keeps the bit-parity rule.  Per-row ``ctx`` arrives stacked
+        (leading dim = prefill_batch)."""
+        fn = self._prefill_fns.get(n_blocks)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        adapter = self.adapter
+        page = cfg.page_size
+        C = cfg.prompt_chunk
+
+        base_key = jnp.asarray(np.asarray(self._base_key))
+
+        def prefill(kv_k, kv_v, ctx_bufs, slot_idx, pt_rows, tokens,
+                    position, last_index, active, seeds, temps, top_ks,
+                    top_ps):
+            keys = jax.vmap(jax.random.fold_in)(
+                jnp.broadcast_to(base_key, (seeds.shape[0], 2)), seeds)
+            pt = pt_rows[:, :n_blocks]
+            kbuf = self._gather(kv_k, pt)
+            vbuf = self._gather(kv_v, pt)
+            ctx = {k: v[slot_idx] for k, v in ctx_bufs.items()}
+            logits, _, _, k_new, v_new = adapter.chunk_forward(
+                adapter.params, tokens, position, kbuf, vbuf, ctx)
+            last = jnp.take_along_axis(logits,
+                                       last_index[:, None, None],
+                                       axis=1)[:, 0]              # (B, V)
+            sel_pos = position + last_index + 1
+            tok, logp = _select_tokens(last, keys, sel_pos, temps,
+                                       top_ks, top_ps)
+            # scatter each row's chunk into its pages; padding rows and
+            # positions past the slot cap (padded final-chunk tails)
+            # drop
+            pos_c = position[:, None] + jnp.arange(C)[None, :]   # (B, C)
+            pid = jnp.take_along_axis(
+                pt_rows, jnp.clip(pos_c // page, 0,
+                                  cfg.pages_per_slot - 1), axis=1)
+            ok = active[:, None] & (pos_c < cfg.cap)
+            pid = jnp.where(ok, pid, cfg.total_pages)
+            off = pos_c % page
+            # kv (L, P, h, page, hd) at [:, pid (B,C), :, off (B,C)]
+            # -> (B, C, L, h, hd) value layout
+            kv_k = kv_k.at[:, pid, :, off].set(
+                k_new.transpose(0, 3, 1, 2, 4).astype(kv_k.dtype),
+                mode="drop")
+            kv_v = kv_v.at[:, pid, :, off].set(
+                v_new.transpose(0, 3, 1, 2, 4).astype(kv_v.dtype),
+                mode="drop")
+            return kv_k, kv_v, tok, logp
+
+        fn = jax.jit(prefill, donate_argnums=(0, 1))
+        self._prefill_fns[n_blocks] = fn
+        return fn
+
+    def _ctx_write(self):
+        if self._ctx_write_fn is None:
+            def write(bufs, slot, values):
+                return {k: jax.lax.dynamic_update_slice(
+                    bufs[k], values[k][None].astype(bufs[k].dtype),
+                    (slot,) + (0,) * values[k].ndim)
+                    for k in bufs}
+
+            self._ctx_write_fn = jax.jit(write, donate_argnums=(0,))
+        return self._ctx_write_fn
+
+    # -- engine loop --------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            occupied = any(s is not None for s in self._slots)
+            with self._cv:
+                if not self._heap and not occupied:
+                    self._cv.wait(0.2)
+                    continue
+            try:
+                now = time.time()
+                self._expire(now)
+                self._admit(now)
+                did = self._decode_step()
+                did = self._prefill_one() or did
+                if not did:
+                    # queued work blocked on slots/pages (or an empty
+                    # beat between admission and prefill): wait for a
+                    # release/submit notify instead of spinning
+                    with self._cv:
+                        self._cv.wait(0.05)
+            except Exception as e:  # noqa: BLE001 — the engine must
+                # outlive one bad batch: fail the in-flight requests
+                # with an explicit verdict and keep serving
+                log.error("decode engine iteration failed: %s", e,
+                          exc_info=True)
+                for s, seq in enumerate(self._slots):
+                    if seq is not None:
+                        self._finish_error(seq.req, e)
+                        self._release_slot(s)
+
+    def _expire(self, now: float) -> None:
+        """Deadline enforcement at BOTH granularities: queued requests
+        are dropped at slot pickup (the PR 8 discipline), and ACTIVE
+        slots are re-checked per token so an expired streaming request
+        frees its slot and pages immediately instead of decoding to
+        ``max_new_tokens``."""
+        expired_q = []
+        with self._cv:
+            # the heap is keyed by deadline, so expired requests sit at
+            # the head — O(expired) per sweep, not O(queue)
+            while self._heap and self._heap[0][0] <= now:
+                expired_q.append(heapq.heappop(self._heap)[2])
+        for req in expired_q:
+            self._finish_expired(req, now)
+        for s, seq in enumerate(self._slots):
+            if seq is not None and not seq.done \
+                    and seq.req.deadline_t <= now:
+                self._finish_expired(seq.req, now, seq=seq)
+                self._release_slot(s)
+
+    def _pages_needed(self, prompt_len: int, max_new: int) -> int:
+        cfg = self.cfg
+        C = cfg.prompt_chunk
+        padded_prompt = min(-(-prompt_len // C) * C, cfg.cap)
+        worst = min(max(padded_prompt, prompt_len + max_new), cfg.cap)
+        return -(-worst // cfg.page_size)
+
+    def _admit(self, now: float) -> None:
+        cfg = self.cfg
+        if not cfg.continuous and any(s is not None for s in self._slots):
+            return   # whole-batch-restart baseline: wait for the gang
+        while True:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            if not free:
+                return
+            with self._cv:
+                if not self._heap:
+                    return
+                d, _, req = heapq.heappop(self._heap)
+                max_new = min(req.max_new_tokens or cfg.max_new_tokens,
+                              cfg.cap - 1)
+                self._cv.notify_all()
+            try:
+                if req.prepared is None:
+                    # cache the prepared form ON the request: a page-
+                    # pressure push-back must not re-run the adapter's
+                    # prepare (for seq2seq that is a full encoder
+                    # forward) on every engine iteration
+                    req.prepared = self.adapter.prepare(req.tokens)
+                prompt, ctx = req.prepared
+            except Exception as e:  # noqa: BLE001 — bad request only
+                self._finish_error(req, e)
+                continue
+            if len(prompt) == 0:
+                self._finish_error(req, ValueError(
+                    "adapter produced an empty decoder prompt"))
+                continue
+            max_new = min(max_new, cfg.cap - len(prompt))
+            if max_new <= 0:
+                self._finish_error(req, ValueError(
+                    f"prompt of {len(prompt)} tokens leaves no room to "
+                    f"generate within the cache cap {cfg.cap}"))
+                continue
+            need = self._pages_needed(len(prompt), max_new)
+            if len(self._free_pages) - self._reserved_pages < need:
+                # not enough reservable pages: push back and wait for a
+                # mid-flight release (ordering preserved — same key)
+                with self._cv:
+                    heapq.heappush(self._heap, (d, req.seq, req))
+                return
+            s = free[0]
+            seq = _ActiveSeq(req, prompt, ctx, reserved=need,
+                             max_new=max_new)
+            self._reserved_pages += need
+            self._slots[s] = seq
+            self._lengths[s] = 0
+            self._last_tokens[s] = 0
+            self._active_mask[s] = False          # active once prefilled
+            self._seeds[s] = np.int32(req.seed)
+            self._temps[s] = np.float32(req.temperature)
+            self._top_ks[s] = np.int32(req.top_k)
+            self._top_ps[s] = np.float32(req.top_p)
+            if ctx:
+                vals = {k: v for k, v in ctx.items()}
+                self._ctx_bufs = self._ctx_write()(self._ctx_bufs,
+                                                   s, vals)
+            self.stats["requests"] += 1
+            self.metrics.inc("serving.decode.requests")
+            self.events.append(("admit", req.rid, s))
+
+    def _ensure_pages(self, s: int, upto_tokens: int) -> None:
+        """Allocate pages for slot ``s`` covering cache positions
+        ``[0, upto_tokens)`` — lazily, inside the admission-time
+        reservation, so allocation can never fail mid-flight."""
+        seq = self._slots[s]
+        need = -(-min(upto_tokens, self.cfg.cap) // self.cfg.page_size)
+        while len(seq.pages) < need:
+            pid = self._free_pages.pop()
+            self._reserved_pages -= 1
+            self._page_table[s, len(seq.pages)] = pid
+            seq.pages.append(pid)
+
+    def _release_slot(self, s: int) -> None:
+        seq = self._slots[s]
+        if seq is None:
+            return
+        self._free_pages.extend(seq.pages)
+        self._reserved_pages -= max(seq.reserved - len(seq.pages), 0)
+        self._slots[s] = None
+        self._active_mask[s] = False
+        self._lengths[s] = 0
+        self.events.append(("release", seq.req.rid, s))
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_one(self) -> bool:
+        """Run at most ONE prefill call per engine iteration — up to
+        ``prefill_batch`` slots advance one chunk each.  The one-call-
+        per-iteration interleave keeps a long prompt from ever stalling
+        the decode batch; the co-batching keeps admission-heavy traffic
+        from becoming dispatch-bound on prefill."""
+        cfg = self.cfg
+        cand = sorted(
+            (self._slots[s].req.seq, s) for s in range(cfg.slots)
+            if self._slots[s] is not None and self._slots[s].prefilling)
+        if not cand:
+            return False
+        picked = [s for _, s in cand[:cfg.prefill_batch]]
+        B, C = cfg.prefill_batch, cfg.prompt_chunk
+        sc = self._prefill_scratch
+        if sc is None:
+            # jit copies host arrays to device at dispatch, so the
+            # scratch block is safely reusable across calls
+            sc = self._prefill_scratch = {
+                "tokens": np.zeros((B, C), np.int32),
+                "position": np.zeros((B,), np.int32),
+                "last_index": np.zeros((B,), np.int32),
+                "active": np.zeros((B,), bool),
+                "seeds": np.zeros((B,), np.int32),
+                "temps": np.zeros((B,), np.float32),
+                "top_ks": np.zeros((B,), np.int32),
+                "top_ps": np.ones((B,), np.float32),
+                "slot_idx": np.zeros((B,), np.int32),
+                "pt_rows": np.zeros((B, cfg.pages_per_slot), np.int32),
+            }
+        sc["tokens"][:] = 0
+        sc["active"][:] = False
+        rows = []              # (b, s, real, final)
+        max_need = 1
+        for b, s in enumerate(picked):
+            seq = self._slots[s]
+            p0 = seq.prefill_pos
+            chunk = seq.prompt[p0:p0 + C]
+            real = len(chunk)
+            sc["tokens"][b, :real] = chunk
+            sc["position"][b] = p0
+            sc["last_index"][b] = real - 1
+            sc["active"][b] = True
+            sc["seeds"][b] = np.int32(seq.req.seed)
+            sc["temps"][b] = seq.req.temperature
+            sc["top_ks"][b] = seq.req.top_k
+            sc["top_ps"][b] = seq.req.top_p
+            sc["slot_idx"][b] = s
+            self._ensure_pages(s, min(p0 + C, cfg.cap))
+            sc["pt_rows"][b] = self._page_table[s]
+            rows.append((b, s, real, (p0 + real) >= len(seq.prompt)))
+            max_need = max(max_need, min(p0 + C, cfg.cap))
+        nb = cfg.bucket_pages(max_need)
+        t0 = time.time()
+        kv_k, kv_v, tok, logp = self._prefill_fn(nb)(
+            self._kv_k, self._kv_v, self._ctx_bufs, sc["slot_idx"],
+            sc["pt_rows"], sc["tokens"], sc["position"],
+            sc["last_index"], sc["active"], sc["seeds"], sc["temps"],
+            sc["top_ks"], sc["top_ps"])
+        self._kv_k, self._kv_v = kv_k, kv_v
+        toks = np.asarray(tok)
+        logps = np.asarray(logp, np.float32)
+        now = time.time()
+        self.stats["prefill_chunks"] += len(rows)
+        self.metrics.inc("serving.decode.prefill_chunks", len(rows))
+        self.events.append(("prefill_chunk",
+                            [self._slots[s].req.rid for _, s, _, _
+                             in rows]))
+        for b, s, real, final in rows:
+            seq = self._slots[s]
+            seq.prefill_pos += real
+            if final:
+                self._lengths[s] = len(seq.prompt)
+                self._emit_token(s, seq, int(toks[b]), logps[b], now)
+        self.metrics.observe("serving.decode.prefill_s", now - t0)
+        return True
+
+    # -- decode -------------------------------------------------------------
+    def _decode_step(self) -> bool:
+        cfg = self.cfg
+        if not cfg.continuous and any(
+                s is not None and s.prefilling for s in self._slots):
+            # whole-batch-restart mode: the legacy scan only starts
+            # once every prompt in the batch is processed — no decode
+            # step may run until the whole wave finished prefill (or a
+            # late-prefilling member would lose horizon steps)
+            return False
+        active = [s for s in range(cfg.slots) if self._active_mask[s]]
+        occupied = [s for s in range(cfg.slots)
+                    if self._slots[s] is not None]
+        # whole-batch-restart mode: the wave steps the full horizon even
+        # after every row finished (a fixed-length scan cannot exit
+        # early) — finished rows ride along inactive, seats held
+        static_wave = not cfg.continuous and occupied
+        if not active and not static_wave:
+            return False
+        for s in active:
+            self._ensure_pages(s, int(self._lengths[s]) + 1)
+        ref = active if active else occupied
+        nb = cfg.bucket_pages(int(self._lengths[ref].max()) + 1)
+        t0 = time.time()
+        kv_k, kv_v, toks, logps = self._step_fn(nb)(
+            self._kv_k, self._kv_v, self._ctx_bufs,
+            self._page_table, self._lengths, self._last_tokens,
+            self._active_mask, self._seeds, self._temps,
+            self._top_ks, self._top_ps)
+        self._kv_k, self._kv_v = kv_k, kv_v
+        toks = np.asarray(toks)
+        logps = np.asarray(logps, np.float32)
+        now = time.time()
+        self.stats["steps"] += 1
+        self.metrics.inc("serving.decode.steps")
+        self.events.append(("decode_step", len(active), nb))
+        if active and self._last_step_t:
+            # every active slot streams one token per step, so the
+            # inter-token latency of EVERY in-flight sequence is the
+            # step gap — one observation per step, not one per token
+            self.metrics.observe("serving.decode.inter_token_s",
+                                 now - self._last_step_t)
+        self._last_step_t = now
+        n_tok = 0
+        for s in active:
+            seq = self._slots[s]
+            self._lengths[s] += 1          # last_token's K/V just landed
+            self._emit_token(s, seq, int(toks[s]), logps[s], now)
+            n_tok += 1
+        self._tokens_window.append((now, n_tok))
+        self.stats["tokens"] += n_tok
+        self.metrics.inc("serving.decode.tokens_total", n_tok)
+        self.metrics.observe("serving.decode.step_s", now - t0)
+        if not cfg.continuous:
+            if self._wave_steps == 0:
+                # the wave's scan horizon: the longest member's request
+                # (the legacy scan ran max_len steps for everyone; a
+                # member asking for more than the config default must
+                # not be truncated by its seat-mates)
+                self._wave_horizon = max(
+                    (s.max_new for s in self._slots if s is not None),
+                    default=cfg.max_new_tokens)
+            self._wave_steps += 1
+            if self._wave_steps >= self._wave_horizon:
+                # scan horizon reached: the whole wave restarts at once
+                for s in range(cfg.slots):
+                    seq = self._slots[s]
+                    if seq is not None and not seq.done:
+                        self._finish_ok(s, seq, "length")  # defensive
+                    if self._slots[s] is not None:
+                        self._release_slot(s)
+                self._wave_steps = 0
+        self._export_gauges(now)
+        return True
+
+    def _emit_token(self, s: int, seq: _ActiveSeq, tok: int,
+                    logp: np.float32, now: float) -> None:
+        req = seq.req
+        if not seq.generated:
+            seq.first_token_t = now
+            self.metrics.observe("serving.decode.ttft_s",
+                                 now - req.admit_t)
+        seq.last_token_t = now
+        seq.generated.append(tok)
+        seq.logp = np.float32(seq.logp + logp)
+        if req.on_token is not None:
+            try:
+                req.on_token(req.rid, tok, len(seq.generated) - 1)
+            except Exception:  # noqa: BLE001 — a slow/broken stream
+                pass           # consumer must not kill the engine
+        if tok == self.cfg.eos_id:
+            self._finish_ok(s, seq, "eos")
+        elif len(seq.generated) >= seq.max_new:
+            self._finish_ok(s, seq, "length")
+        else:
+            self._last_tokens[s] = tok
+            self._active_mask[s] = True
+
+    def _finish_ok(self, s: int, seq: _ActiveSeq, reason: str) -> None:
+        req = seq.req
+        req.result = DecodeResult(
+            tokens=np.asarray(seq.generated, np.int32),
+            logp=float(seq.logp), prompt_len=len(seq.prompt),
+            ttft_s=seq.first_token_t - req.admit_t,
+            finish_reason=reason)
+        self.stats["completed"] += 1
+        self.metrics.inc("serving.decode.completed")
+        if self.cfg.continuous:
+            self._release_slot(s)
+        else:
+            # whole-batch-restart mode: the answer is out, but the SEAT
+            # is held to the scan horizon — that is the baseline's cost
+            seq.done = True
+            self._active_mask[s] = False
+        req._event.set()
+        if req.on_done is not None:
+            try:
+                req.on_done(req)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _finish_error(self, req: DecodeRequest, err: Exception) -> None:
+        req.error = err
+        req._event.set()
+        if req.on_done is not None:
+            try:
+                req.on_done(req)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _finish_expired(self, req: DecodeRequest, now: float,
+                        seq: Optional[_ActiveSeq] = None) -> None:
+        from bigdl_tpu.serving.server import DeadlineExceededError
+
+        self.stats["expired"] += 1
+        self.metrics.inc("serving.decode.expired")
+        err = DeadlineExceededError(req.rid, now - req.admit_t)
+        if seq is not None and seq.generated:
+            # a streaming request that already produced tokens: the
+            # partial result rides on the error for the caller's framing
+            err.partial_tokens = np.asarray(seq.generated, np.int32)
+        self._finish_error(req, err)
+
+    def _export_gauges(self, now: float) -> None:
+        if now - self._gauge_t < 0.05:   # gauge freshness beats paying
+            return                       # registry locks on every step
+        self._gauge_t = now
+        cfg = self.cfg
+        self.metrics.gauge("serving.decode.slot_occupancy",
+                           float(sum(s is not None for s in self._slots))
+                           / cfg.slots)
+        used = cfg.total_pages - len(self._free_pages)
+        self.metrics.gauge("serving.decode.page_utilization",
+                           used / cfg.total_pages)
+        self.metrics.gauge("serving.decode.queue_depth",
+                           self.queue_depth())
+        window = [(t, n) for t, n in self._tokens_window
+                  if now - t <= 2.0]
+        if len(window) >= 2:
+            span = now - window[0][0]
+            if span > 0:
+                self.metrics.gauge("serving.decode.tokens_per_s",
+                                   sum(n for _, n in window) / span)
+
+    # -- the one-scan whole-sequence parity reference -----------------------
+    def static_generate(self, requests: Sequence[DecodeRequest]
+                        ) -> List[DecodeResult]:
+        """The byte-identical reference: each request decoded by the
+        same chunked prefill followed by ONE ``lax.scan`` over a
+        contiguous whole-sequence KV cache (no pages, no slots, no
+        scheduling).  Mirrors the PR 8 ``continuous=False`` pattern:
+        this path exists to pin the engine's numerics, not to be fast.
+
+        Every request runs at batch 2 (the row duplicated) so every
+        matmul keeps >= 2 rows — the same XLA reduction path the
+        S-slot engine programs take (see the module docstring)."""
+        out = []
+        for req in requests:
+            prompt, ctx = self.adapter.prepare(req.tokens)
+            max_new = min(req.max_new_tokens or self.cfg.max_new_tokens,
+                          self.cfg.cap - len(prompt))
+            out.append(self._static_one(req, prompt, ctx, max_new))
+        return out
+
+    def _static_one(self, req: DecodeRequest, prompt: np.ndarray, ctx,
+                    max_new: int) -> DecodeResult:
+        cfg = self.cfg
+        adapter = self.adapter
+        L, h, hd = adapter.num_layers, adapter.num_heads, adapter.head_dim
+        B = 2                                  # duplicated row (>= 2 rows)
+        Kcap = cfg.cap
+        kbuf = jnp.zeros((B, L, h, Kcap, hd), jnp.float32)
+        vbuf = jnp.zeros_like(kbuf)
+        ctx2 = {k: jnp.stack([v, v]) for k, v in (ctx or {}).items()}
+        key = np.asarray(jax.random.fold_in(self._base_key,
+                                            int(req.seed)), np.uint32)
+        keys2 = jnp.asarray(np.stack([key, key]))
+        temps = jnp.full((B,), req.temperature, jnp.float32)
+        top_ks = jnp.full((B,), req.top_k, jnp.int32)
+        top_ps = jnp.full((B,), req.top_p, jnp.float32)
+        C = cfg.prompt_chunk
+        first_tok = first_lp = None
+        t_admit = time.time()
+        for p0 in range(0, len(prompt), C):
+            chunk = prompt[p0:p0 + C]
+            real = len(chunk)
+            if real < C:
+                chunk = np.concatenate([chunk,
+                                        np.zeros((C - real,), np.int32)])
+            fn = self._static_prefill(C)
+            kbuf, vbuf, tok, logp = fn(
+                kbuf, vbuf, ctx2, jnp.asarray(np.stack([chunk, chunk])),
+                jnp.full((B,), p0, jnp.int32),
+                jnp.full((B,), real - 1, jnp.int32),
+                keys2, temps, top_ks, top_ps)
+            first_tok, first_lp = tok, logp
+        scan = self._static_scan(max_new)
+        toks, logps = scan(kbuf, vbuf, ctx2,
+                           jnp.full((B,), len(prompt), jnp.int32),
+                           first_tok, keys2, temps, top_ks, top_ps)
+        toks = np.asarray(toks)[:, 0]           # (steps,) row 0
+        logps = np.asarray(logps, np.float32)[:, 0]
+        gen = [int(np.asarray(first_tok)[0])]
+        total = np.float32(np.asarray(first_lp, np.float32)[0])
+        reason = "length"
+        if gen[0] == cfg.eos_id:
+            reason = "eos"
+        else:
+            for t, lp in zip(toks, logps):
+                gen.append(int(t))
+                total = np.float32(total + lp)
+                if int(t) == cfg.eos_id:
+                    reason = "eos"
+                    break
+                if len(gen) >= max_new:
+                    break
+        return DecodeResult(tokens=np.asarray(gen, np.int32),
+                            logp=float(total), prompt_len=len(prompt),
+                            ttft_s=time.time() - t_admit,
+                            finish_reason=reason)
+
+    def _static_prefill(self, C: int):
+        key = (C, 0)
+        fn = self._static_prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        adapter = self.adapter
+        cap = self.cfg.cap
+
+        def prefill(kbuf, vbuf, ctx, tokens, position, last_index, keys,
+                    temps, top_ks, top_ps):
+            logits, kbuf, vbuf, _, _ = adapter.chunk_forward(
+                adapter.params, tokens, position, kbuf, vbuf, ctx)
+            last = jnp.take_along_axis(logits, last_index[:, None, None],
+                                       axis=1)[:, 0]
+            tok, logp = _select_tokens(last, keys,
+                                       position + last_index + 1,
+                                       temps, top_ks, top_ps)
+            return kbuf, vbuf, tok, logp
+
+        fn = jax.jit(prefill)
+        self._static_prefill_fns[key] = fn
+        return fn
+
+    def _static_scan(self, max_new: int):
+        fn = self._static_scan_fns.get(max_new)
+        if fn is not None:
+            return fn
+        adapter = self.adapter
+        eos = self.cfg.eos_id
+
+        def run(kbuf, vbuf, ctx, position, first_tok, keys, temps,
+                top_ks, top_ps):
+            def body(carry, _):
+                kbuf, vbuf, pos, last, done, = carry
+                logits, kbuf, vbuf, _, _ = adapter.chunk_forward(
+                    adapter.params, last[:, None], pos, kbuf, vbuf, ctx)
+                tok, logp = _select_tokens(logits[:, 0], keys, pos + 1,
+                                           temps, top_ks, top_ps)
+                tok = jnp.where(done, eos, tok)
+                logp = jnp.where(done, 0.0, logp)
+                done = done | (tok == eos)
+                return (kbuf, vbuf, pos + 1, tok, done), (tok, logp)
+
+            done0 = first_tok == eos
+            (_, _, _, _, _), (toks, logps) = jax.lax.scan(
+                body, (kbuf, vbuf, position, first_tok, done0),
+                None, length=max(max_new - 1, 0))
+            return toks, logps
+
+        fn = jax.jit(run)
+        self._static_scan_fns[max_new] = fn
+        return fn

@@ -38,6 +38,7 @@ generated — is echoed back as ``X-Request-Id`` on every predict response.
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib import request as _urlreq
@@ -105,6 +106,8 @@ class _Handler(BaseHTTPRequestHandler):
             **stats})
 
     def do_POST(self):
+        if self.path == "/generate":
+            return self._generate()
         if self.path != "/predict":
             return self._json(404, {"error": f"unknown path {self.path}"})
         srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
@@ -193,6 +196,190 @@ class _Handler(BaseHTTPRequestHandler):
                        rid_hdr)
 
 
+    # -- autoregressive decode (docs/serving.md §Autoregressive decode) -----
+    def _read_json_body(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length < 0:
+                raise ValueError(length)
+        except ValueError:
+            # the unread body poisons keep-alive framing — same guard
+            # as the predict path
+            self.close_connection = True
+            self._json(400, {"error": "bad Content-Length"})
+            return None
+        if length > self.server.max_body_bytes:  # type: ignore[attr-defined]
+            self.close_connection = True
+            self._json(413, {"error": f"request body {length} bytes "
+                             "exceeds limit"})
+            return None
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+    def _generate(self):
+        """POST /generate — token generation over the continuous decode
+        engine.  ``{"tokens": [...], "max_new_tokens": n,
+        "temperature": t, "top_k": k, "top_p": p, "seed": s,
+        "model": name?, "stream": bool}``.
+
+        ``stream=false`` answers one JSON body ``{"tokens": [...]}``.
+        ``stream=true`` answers ``Transfer-Encoding: chunked`` NDJSON —
+        one ``{"token": id, "index": n}`` line per generated token as
+        it decodes, then a final ``{"done": true, "tokens": [...]}``
+        line — over the same keep-alive connection (chunked framing is
+        what HTTP/1.1 keep-alive needs for a body of unknown length)."""
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            payload = self._read_json_body()
+            if payload is None:
+                return
+            tokens = np.asarray(payload.get("tokens",
+                                            payload.get("prompt")),
+                                np.int32)
+            stream = bool(payload.get("stream", False))
+            req_id = self.headers.get("X-Request-Id") \
+                or payload.get("request_id")
+            if req_id is not None and \
+                    not REQUEST_ID_RE.fullmatch(str(req_id)):
+                return self._json(400, {"error": "bad request id"})
+            model = payload.get("model") or self.headers.get("X-Model")
+            if model is not None and \
+                    not MODEL_NAME_RE.fullmatch(str(model)):
+                return self._json(400, {"error": "bad model name"})
+            hdr = self.headers.get("X-Deadline-S")
+            raw = payload.get("deadline_s", hdr)
+            deadline_s = float(raw) if raw is not None else None
+            kw = dict(
+                request_id=req_id, deadline_s=deadline_s, model=model,
+                max_new_tokens=(int(payload["max_new_tokens"])
+                                if "max_new_tokens" in payload else None),
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)),
+                seed=int(payload.get("seed", 0)))
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"bad request: {e}"})
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        with trace.span("serving/http_generate") as sp:
+            try:
+                rid = srv.enqueue_generate(
+                    tokens, on_token=(lambda r, t, i: q.put((t, i)))
+                    if stream else None, **kw)
+            except KeyError as e:
+                return self._json(404, {"error": str(e)})
+            except TypeError as e:
+                return self._json(400, {"error": str(e)})
+            except ValueError as e:
+                if "already in flight" in str(e):
+                    # duplicate X-Request-Id racing its first attempt —
+                    # retryable, like the predict path's 409
+                    return self._json(
+                        409, {"error": str(e), "duplicate": True},
+                        {"Retry-After": str(srv.config.retry_after_s)})
+                # submit-time rejection (prompt over the cache cap, ...)
+                return self._json(400, {"error": str(e)})
+            except ServiceUnavailableError as e:
+                return self._json(429, {"error": str(e)},
+                                  {"Retry-After": str(e.retry_after)})
+            sp.set_attribute("request_id", rid)
+            if not stream:
+                rid_hdr = {"X-Request-Id": rid}
+                try:
+                    result = srv.query(
+                        rid, timeout=self.server.predict_timeout)
+                except DeadlineExceededError as e:
+                    return self._json(504, {"error": str(e),
+                                            "expired": True}, rid_hdr)
+                except Exception as e:  # noqa: BLE001
+                    return self._json(500, {"error": str(e)}, rid_hdr)
+                return self._json(
+                    200, {"tokens": np.asarray(result).tolist()}, rid_hdr)
+            # streaming: chunked NDJSON, one event per token
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("X-Request-Id", rid)
+            self.end_headers()
+            deadline = time.time() + self.server.predict_timeout  # type: ignore[attr-defined]
+
+            def _drain_now() -> None:
+                # greedy drain into ONE chunk write: at thousands of
+                # tokens/s across many handler threads, per-token
+                # json.dumps + per-token socket writes would steal the
+                # GIL from the decode engine itself
+                parts = []
+                while True:
+                    try:
+                        tok, idx = q.get_nowait()
+                        parts.append(b'{"token": %d, "index": %d}\n'
+                                     % (tok, idx))
+                    except _queue.Empty:
+                        break
+                if parts:
+                    self._chunk(b"".join(parts))
+
+            try:
+                while True:
+                    # the final verdict always lands in the result
+                    # table; poll it between token events so an error
+                    # (expiry, drop) terminates the stream promptly
+                    try:
+                        tok, idx = q.get(timeout=0.05)
+                        parts = [b'{"token": %d, "index": %d}\n'
+                                 % (tok, idx)]
+                        while True:
+                            try:
+                                tok, idx = q.get_nowait()
+                                parts.append(
+                                    b'{"token": %d, "index": %d}\n'
+                                    % (tok, idx))
+                            except _queue.Empty:
+                                break
+                        self._chunk(b"".join(parts))
+                        # IDLE timeout, not whole-stream: a healthy
+                        # long generation streaming past 30s must not
+                        # be cut off mid-flight
+                        deadline = (time.time()
+                                    + self.server.predict_timeout)  # type: ignore[attr-defined]
+                        continue
+                    except _queue.Empty:
+                        pass
+                    with srv._result_cv:
+                        done = rid in srv._results
+                    if done:
+                        break
+                    if time.time() > deadline:
+                        self._chunk(json.dumps(
+                            {"error": "generate timed out"}).encode()
+                            + b"\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.close_connection = True
+                        return
+                # drain any tokens that raced the final verdict
+                _drain_now()
+                try:
+                    result = srv.query(rid, timeout=1.0)
+                    final = {"done": True,
+                             "tokens": np.asarray(result).tolist()}
+                except DeadlineExceededError as e:
+                    final = {"done": True, "error": str(e),
+                             "expired": True}
+                    partial = getattr(e, "partial_tokens", None)
+                    if partial is not None:
+                        final["tokens"] = np.asarray(partial).tolist()
+                except Exception as e:  # noqa: BLE001
+                    final = {"done": True, "error": str(e)}
+                self._chunk(json.dumps(final).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True  # client hung up mid-stream
+
+
 class HttpFrontend:
     """Serve a ServingServer over HTTP (threaded stdlib server)."""
 
@@ -264,6 +451,81 @@ class HttpClient:
             with _urlreq.urlopen(req, timeout=self.timeout) as resp:
                 out = json.loads(resp.read())
         return np.asarray(out["predictions"], np.float32)
+
+    def generate(self, tokens, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0,
+                 model: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None, stream: bool = False):
+        """POST /generate.  ``stream=False`` returns the generated token
+        array; ``stream=True`` returns an iterator of NDJSON events —
+        ``{"token": id, "index": n}`` per token, then the final
+        ``{"done": true, "tokens": [...]}`` — decoded incrementally
+        off the chunked response (the wire-framing round-trip the
+        decode tests pin)."""
+        payload = {"tokens": np.asarray(tokens, np.int32).tolist(),
+                   "temperature": temperature, "top_k": top_k,
+                   "top_p": top_p, "seed": seed, "stream": stream}
+        if max_new_tokens is not None:
+            payload["max_new_tokens"] = max_new_tokens
+        if model is not None:
+            payload["model"] = model
+        if deadline_s is not None:
+            payload["deadline_s"] = deadline_s
+        body = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        if not stream:
+            if self._keep_alive:
+                status, data = self._request_keep_alive(
+                    "POST", "/generate", body, headers)
+                if status != 200:
+                    raise RuntimeError(
+                        f"generate failed: HTTP {status}: {data[:200]!r}")
+                out = json.loads(data)
+            else:
+                # mirror predict(): the keep_alive=False mode stays
+                # connection-less (and therefore thread-shareable)
+                req = _urlreq.Request(self.url + "/generate", data=body,
+                                      headers=headers)
+                with _urlreq.urlopen(req, timeout=self.timeout) as resp:
+                    out = json.loads(resp.read())
+            return np.asarray(out["tokens"], np.int32)
+        return self._generate_stream(body, headers)
+
+    def _generate_stream(self, body: bytes, headers: dict):
+        import http.client
+
+        host, _, port = self.url.split("//", 1)[1].partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80),
+                                          timeout=self.timeout)
+        try:
+            # a dedicated one-shot connection per stream: ask the server
+            # to close it after the final chunk so tearing it down does
+            # not reset a kept-alive socket mid-listen
+            conn.request("POST", "/generate", body=body,
+                         headers=dict(headers, Connection="close"))
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(f"generate failed: HTTP {resp.status}: "
+                                   f"{resp.read()[:200]!r}")
+            # http.client un-chunks transparently; readline yields one
+            # NDJSON event per generated token as the server flushes it
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                yield event
+                if event.get("done") or "error" in event:
+                    break
+        finally:
+            conn.close()
 
     def _request_keep_alive(self, method: str, path: str,
                             body: Optional[bytes], headers: dict):

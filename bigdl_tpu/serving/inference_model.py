@@ -29,7 +29,8 @@ class InferenceModel:
 
     def __init__(self, model=None, variables: Optional[Dict] = None,
                  predict_fn: Optional[Callable] = None,
-                 batch_buckets: Sequence[int] = (1, 4, 16, 64, 256)):
+                 batch_buckets: Sequence[int] = (1, 4, 16, 64, 256),
+                 decode=None):
         if predict_fn is None:
             if model is None or variables is None:
                 raise ValueError("need (model, variables) or predict_fn")
@@ -45,6 +46,22 @@ class InferenceModel:
         else:
             self._custom = predict_fn
         self.buckets = tuple(sorted(batch_buckets))
+        # autoregressive decode path (docs/serving.md §Autoregressive
+        # decode): a DecodeConfig attaches the paged-KV continuous
+        # decode engine; generate()/generate_stream() and the server's
+        # generate requests route through it
+        self.decode_engine = None
+        if decode is not None:
+            from bigdl_tpu.serving.decode_engine import (DecodeEngine,
+                                                         LMAdapter)
+
+            if model is None or getattr(model, "mode", None) != "lm":
+                raise ValueError(
+                    "decode= needs an LM-mode Transformer (model, "
+                    "variables); for translation models use "
+                    "Seq2SeqService(continuous=True)")
+            adapter = LMAdapter(model, self._params, cap=decode.cap)
+            self.decode_engine = DecodeEngine(adapter, decode)
         # no lock: the jitted forward is pure and JAX dispatch is
         # thread-safe, so concurrent predicts are safe by construction
         # (the reference needs its replica queue only because its layers
@@ -100,6 +117,71 @@ class InferenceModel:
         out = self._jit(self._params, self._state, x)
         return np.asarray(out)[:n]
 
+    # -- autoregressive decode (docs/serving.md §Autoregressive decode) -----
+    def generate(self, prompts, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seeds=None,
+                 deadline_s: Optional[float] = None):
+        """Generate continuations for ``prompts`` (a list of int token
+        sequences) through the continuous decode engine — requests
+        share the slot pool with any concurrently streaming traffic.
+        Greedy by default; ``temperature/top_k/top_p`` sample with the
+        per-request ``seeds`` (defaults to the prompt index).  Returns
+        a list of generated-token arrays (EOS included when hit)."""
+        import math as _math
+        import time as _time
+
+        from bigdl_tpu.serving.decode_engine import DecodeRequest
+
+        if self.decode_engine is None:
+            raise ValueError("this InferenceModel has no decode engine; "
+                             "construct it with decode=DecodeConfig(...)")
+        deadline_t = (_time.time() + deadline_s if deadline_s is not None
+                      else _math.inf)
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(self.decode_engine.submit(DecodeRequest(
+                tokens=np.asarray(p, np.int32),
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                seed=int(seeds[i]) if seeds is not None else i,
+                deadline_t=deadline_t)))
+        return [r.wait(timeout=300.0).tokens for r in reqs]
+
+    def generate_stream(self, prompt, max_new_tokens: Optional[int] = None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, seed: int = 0,
+                        deadline_s: Optional[float] = None):
+        """Streaming generate: yields token ids as they decode.  One
+        request; keyword args as :meth:`generate`."""
+        import math as _math
+        import queue as _queue
+        import time as _time
+
+        from bigdl_tpu.serving.decode_engine import DecodeRequest
+
+        if self.decode_engine is None:
+            raise ValueError("this InferenceModel has no decode engine; "
+                             "construct it with decode=DecodeConfig(...)")
+        q: _queue.Queue = _queue.Queue()
+        done = object()
+        req = DecodeRequest(
+            tokens=np.asarray(prompt, np.int32),
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed,
+            deadline_t=(_time.time() + deadline_s
+                        if deadline_s is not None else _math.inf),
+            on_token=lambda rid, tok, idx: q.put(tok),
+            on_done=lambda r: q.put(done))
+        self.decode_engine.submit(req)
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            yield item
+        if req.error is not None:
+            raise req.error
+
     def warmup(self, sample: np.ndarray) -> "InferenceModel":
         """Compile every bucket's program BEFORE traffic: one predict per
         bucket from ``sample`` (a single example, with or without a batch
@@ -118,4 +200,6 @@ class InferenceModel:
         with expected_compile():
             for b in self.buckets:
                 self._predict_bucketed(np.repeat(row, b, axis=0))
+        if self.decode_engine is not None:
+            self.decode_engine.warmup()
         return self

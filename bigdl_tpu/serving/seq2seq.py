@@ -1,15 +1,26 @@
 """Seq2Seq decode service — serving for translation Transformers.
 
-Reference analog: Cluster Serving's ``InferenceModel`` holds classification
-models; its Seq2Seq story (``models/rnn`` + ``SequenceBeamSearch``) never
-got a serving surface.  Here decode IS servable: requests are bucketed to a
-few batch sizes (same discipline as ``ServingServer``/``RecallService``) so
-arbitrary request counts reuse a handful of compiled programs, and each
-bucket's program is the whole autoregressive loop (one ``lax.scan`` — KV
-caches inside, nothing host-side per token).
+Reference analog: Cluster Serving's ``InferenceModel`` holds
+classification models; its Seq2Seq story (``models/rnn`` +
+``SequenceBeamSearch``) never got a serving surface.  Here decode IS
+servable, and — since the token-level rebuild (docs/serving.md
+§Autoregressive decode) — CONTINUOUS: greedy and sampled requests run
+through the paged-KV :class:`~bigdl_tpu.serving.decode_engine.
+DecodeEngine` one model step at a time, so a short translation frees
+its sequence slot mid-flight instead of holding a batch seat until the
+longest row finishes.
+
+``continuous=False`` keeps the one-scan whole-sequence decode as the
+byte-identical parity reference (the PR 8 ``continuous=False``
+pattern): same encoder programs, same chunk/selection math, one
+``lax.scan`` per request over a contiguous cache.  Beam search
+(``beam_size > 1``) stays on the legacy bucketed whole-batch path —
+beams reorder the cache every step, which the slot engine does not
+model.
 """
 
 import itertools
+import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -21,11 +32,12 @@ class Seq2SeqService:
     """Holds a translation-mode :class:`~bigdl_tpu.nn.Transformer` and
     serves ``translate(src_batch)``.
 
-    ``beam_size=0`` → KV-cached greedy (the fast path); ``>0`` → beam
-    search with GNMT length penalty (re-attends over the prefix);
-    ``temperature>0`` with ``sample=True`` → KV-cached stochastic decode
-    (temperature / top-k / nucleus top-p, fresh fold of ``seed`` per
-    request so repeated requests differ)."""
+    ``beam_size=0`` → KV-cached greedy through the continuous decode
+    engine (the fast path); ``>0`` → beam search with GNMT length
+    penalty (legacy whole-batch scan); ``sample=True`` → stochastic
+    decode (temperature / top-k / nucleus top-p) with a per-REQUEST key
+    fold, so repeated requests differ and the continuous engine's
+    output is independent of co-scheduled traffic."""
 
     BATCH_BUCKETS: Tuple[int, ...] = (1, 4, 16, 64)
 
@@ -33,7 +45,10 @@ class Seq2SeqService:
                  max_len: int = 32, beam_size: int = 0,
                  batch_buckets: Optional[Sequence[int]] = None,
                  sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 continuous: bool = True,
+                 src_buckets: Sequence[int] = (8, 16, 32, 64),
+                 decode_config=None):
         if sample and beam_size and beam_size > 1:
             raise ValueError("sample=True and beam_size>1 are exclusive")
         if model.mode != "translation":
@@ -49,50 +64,91 @@ class Seq2SeqService:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        self.continuous = bool(continuous)
+        self.src_buckets = tuple(sorted(src_buckets))
         self._seed = jax.random.PRNGKey(seed)
+        self._seed_int = int(seed)
         # itertools.count.__next__ is atomic under the GIL: the threaded
         # serving frontends must never hand two requests the same fold
         self._request_ids = itertools.count(1)
         self._cache = {}
+        self._decode_cfg = decode_config
+        self.decode_engine = None       # built lazily on first translate
+        self._engine_lock = threading.Lock()
 
+    # -- engine plumbing ----------------------------------------------------
+    def _engine(self):
+        with self._engine_lock:
+            return self._engine_locked()
+
+    def _engine_locked(self):
+        if self.decode_engine is None:
+            from bigdl_tpu.serving.decode_engine import (DecodeConfig,
+                                                         DecodeEngine,
+                                                         Seq2SeqAdapter)
+
+            cfg = self._decode_cfg
+            if cfg is None:
+                page = 8
+                cap = self.max_len + 1
+                cfg = DecodeConfig(
+                    slots=8, page_size=page,
+                    pages_per_slot=max(1, -(-cap // page)),
+                    prompt_chunk=8, max_new_tokens=self.max_len,
+                    eos_id=self.eos_id, base_seed=self._seed_int)
+            adapter = Seq2SeqAdapter(self.model, self.params,
+                                     cap=cfg.cap, bos_id=self.bos_id,
+                                     src_buckets=self.src_buckets)
+            self.decode_engine = DecodeEngine(adapter, cfg,
+                                              name="seq2seq")
+        return self.decode_engine
+
+    def _requests(self, src: np.ndarray):
+        from bigdl_tpu.serving.decode_engine import DecodeRequest
+
+        temp = self.temperature if self.sample else 0.0
+        return [DecodeRequest(
+            tokens=row, max_new_tokens=self.max_len, temperature=temp,
+            top_k=self.top_k, top_p=self.top_p,
+            seed=next(self._request_ids)) for row in src]
+
+    def _assemble(self, results) -> Tuple[np.ndarray, np.ndarray]:
+        """Engine results -> the legacy (tokens incl. BOS, scores)
+        surface: generated tokens padded with EOS to ``max_len`` (the
+        one-scan decode freezes finished rows on EOS, so the padded
+        forms agree byte-for-byte)."""
+        n = len(results)
+        tokens = np.full((n, self.max_len + 1), self.eos_id, np.int32)
+        tokens[:, 0] = self.bos_id
+        scores = np.zeros((n,), np.float32)
+        for i, res in enumerate(results):
+            gen = res.tokens[: self.max_len]
+            tokens[i, 1:1 + len(gen)] = gen
+            scores[i] = np.float32(res.logp)
+        return tokens, scores
+
+    # -- legacy beam path ---------------------------------------------------
     def _decode_fn(self, batch: int):
         fn = self._cache.get(batch)
         if fn is None:
-            from bigdl_tpu.nn.attention import (transformer_decode,
-                                                transformer_decode_cached)
+            from bigdl_tpu.nn.attention import transformer_decode
 
-            if self.beam_size and self.beam_size > 1:
-                def run(params, src, rng):
-                    toks, scores = transformer_decode(
-                        self.model, params, src, self.bos_id, self.eos_id,
-                        max_len=self.max_len, beam_size=self.beam_size)
-                    return toks[:, 0], scores[:, 0]   # best beam
-            elif self.sample:
-                def run(params, src, rng):
-                    return transformer_decode_cached(
-                        self.model, params, src, self.bos_id, self.eos_id,
-                        max_len=self.max_len, rng=rng,
-                        temperature=self.temperature, top_k=self.top_k,
-                        top_p=self.top_p)
-            else:
-                def run(params, src, rng):
-                    return transformer_decode_cached(
-                        self.model, params, src, self.bos_id, self.eos_id,
-                        max_len=self.max_len)
+            def run(params, src, rng):
+                toks, scores = transformer_decode(
+                    self.model, params, src, self.bos_id, self.eos_id,
+                    max_len=self.max_len, beam_size=self.beam_size)
+                return toks[:, 0], scores[:, 0]   # best beam
 
             fn = jax.jit(run)
             self._cache[batch] = fn
         return fn
 
-    def translate(self, src) -> Tuple[np.ndarray, np.ndarray]:
-        """src: (n, t_src) int tokens → (tokens (n, max_len+1) incl. BOS,
-        scores (n,)).  n is padded up to a bucket; pad rows are dropped."""
-        src = np.asarray(src, np.int32)
+    def _translate_beam(self, src) -> Tuple[np.ndarray, np.ndarray]:
         n = src.shape[0]
         bucket = next((b for b in self.buckets if b >= n), None)
         if bucket is None:  # larger than the biggest bucket: chunk it
             big = self.buckets[-1]
-            outs = [self.translate(src[i:i + big]) for i in
+            outs = [self._translate_beam(src[i:i + big]) for i in
                     range(0, n, big)]
             return (np.concatenate([o[0] for o in outs]),
                     np.concatenate([o[1] for o in outs]))
@@ -102,3 +158,35 @@ class Seq2SeqService:
         rng = jax.random.fold_in(self._seed, next(self._request_ids))
         tokens, scores = self._decode_fn(bucket)(self.params, src, rng)
         return np.asarray(tokens)[:n], np.asarray(scores)[:n]
+
+    # -- public surface -----------------------------------------------------
+    def translate(self, src) -> Tuple[np.ndarray, np.ndarray]:
+        """src: (n, t_src) int tokens → (tokens (n, max_len+1) incl.
+        BOS, scores (n,)).  Greedy/sample requests run row-by-row
+        through the continuous decode engine (or the one-scan static
+        reference under ``continuous=False``); beam requests take the
+        legacy bucketed whole-batch path."""
+        src = np.asarray(src, np.int32)
+        if self.beam_size and self.beam_size > 1:
+            return self._translate_beam(src)
+        engine = self._engine()
+        reqs = self._requests(src)
+        if self.continuous:
+            for r in reqs:
+                engine.submit(r)
+            results = [r.wait(timeout=300.0) for r in reqs]
+        else:
+            results = engine.static_generate(reqs)
+        return self._assemble(results)
+
+    def warmup(self) -> "Seq2SeqService":
+        """Pre-compile the engine's closed program set (and the encode
+        buckets) under ``expected_compile`` — after this a mixed-length
+        sweep triggers zero unexpected XLA recompiles."""
+        if not (self.beam_size and self.beam_size > 1):
+            self._engine().warmup()
+        return self
+
+    def stop(self) -> None:
+        if self.decode_engine is not None:
+            self.decode_engine.stop()

@@ -604,6 +604,118 @@ class ServingServer:
             self._work_cv.notify_all()
         return True
 
+    def enqueue_generate(self, tokens, request_id: Optional[str] = None,
+                         deadline_s: Optional[float] = None,
+                         model: Optional[str] = None,
+                         max_new_tokens: Optional[int] = None,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 1.0, seed: int = 0,
+                         on_token=None) -> str:
+        """Admit one GENERATE request for ``model``'s continuous decode
+        engine (docs/serving.md §Autoregressive decode).  Admission
+        mirrors :meth:`enqueue` — draining/degraded/duplicate-id checks,
+        deadline stamped here — but the request then lives in the decode
+        engine's slot scheduler, not the predict batch heaps: tokens
+        stream via ``on_token`` (engine thread) and the final token
+        array lands in the result table for :meth:`query`.  Per-token
+        deadline enforcement is the engine's: an expired streaming
+        request frees its slot immediately and resolves as
+        :class:`DeadlineExceededError` (counted under
+        ``serving.tenant.<name>.expired``)."""
+        import math as _math
+
+        from bigdl_tpu.serving.decode_engine import DecodeRequest
+
+        cfg = self.config
+        if self._draining or self._stop.is_set():
+            self._count("shed_requests")
+            raise ServiceUnavailableError(
+                "server is draining/stopped; retry against another replica",
+                retry_after=cfg.retry_after_s)
+        name = model or self._default_name
+        tenant = self._tenants.get(name)
+        if tenant is None:
+            raise KeyError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._tenants)}")
+        engine = getattr(tenant.model, "decode_engine", None)
+        if engine is None and hasattr(tenant.model, "_engine"):
+            # Seq2SeqService builds its engine lazily on first use — a
+            # freshly registered tenant must still serve generates
+            engine = tenant.model._engine()
+        if engine is None:
+            raise TypeError(
+                f"model {name!r} has no decode engine; serve it from an "
+                "InferenceModel(decode=DecodeConfig(...)) or a "
+                "Seq2SeqService")
+        if tenant.degraded and tenant.fallback is None:
+            self._count("shed_requests")
+            raise ServiceUnavailableError(
+                f"model {name} degraded; shedding generate load",
+                retry_after=cfg.retry_after_s)
+        rid = request_id or uuid.uuid4().hex
+        now = time.time()
+        if deadline_s is None:
+            deadline_s = cfg.default_deadline_s
+        deadline_t = now + deadline_s if deadline_s is not None \
+            else _math.inf
+        with self._result_cv:
+            if rid in self._pending:
+                raise ValueError(
+                    f"request id {rid!r} is already in flight; "
+                    "request ids must be unique per outstanding request")
+            self._results.pop(rid, None)
+            self._result_expiry.pop(rid, None)
+            self._pending.add(rid)
+
+        def _done(req: DecodeRequest) -> None:
+            done_t = time.time()
+            if req.error is not None:
+                if isinstance(req.error, DeadlineExceededError):
+                    self._count("expired_requests")
+                    self.metrics.inc(f"serving.tenant.{name}.expired")
+                    flight.record("serving_deadline_drop", count=1,
+                                  request_ids=[rid], decode=True)
+                verdict: Any = req.error
+            else:
+                verdict = req.result.tokens
+                lat = done_t - req.admit_t
+                self.metrics.observe("serving.latency_s", lat)
+                self.metrics.observe(f"serving.tenant.{name}.latency_s",
+                                     lat)
+                self._count("requests")
+                self.metrics.inc(f"serving.tenant.{name}.requests")
+            ttl = done_t + cfg.result_ttl_s
+            with self._result_cv:
+                self._results[rid] = verdict
+                self._result_expiry[rid] = ttl
+                self._pending.discard(rid)
+                self._result_cv.notify_all()
+
+        req = DecodeRequest(
+            tokens=np.asarray(tokens, np.int32), rid=rid, tenant=name,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed, deadline_t=deadline_t,
+            on_token=on_token, on_done=_done)
+        with trace.span("serving/enqueue_generate", request_id=rid,
+                        model=name):
+            try:
+                engine.submit(req)
+            except RuntimeError as e:
+                with self._result_cv:
+                    self._pending.discard(rid)
+                self._count("shed_requests")
+                raise ServiceUnavailableError(
+                    f"decode queue full: {e}",
+                    retry_after=cfg.retry_after_s)
+            except Exception:
+                # submit-time rejection (e.g. prompt over the cache
+                # cap): the id must not stay poisoned in _pending
+                with self._result_cv:
+                    self._pending.discard(rid)
+                raise
+        return rid
+
     def query(self, request_id: str, timeout: float = 30.0) -> np.ndarray:
         deadline = time.time() + timeout
         with self._result_cv:
