@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-data test-bundle test-kernels test-collectives bench bench-dispatch bench-watch bench-gradcomm bench-decode dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-slo test-data test-bundle test-kernels test-collectives bench bench-dispatch bench-watch bench-gradcomm bench-decode bench-slo dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -71,6 +71,24 @@ test-decode:
 # gauges, recompile sentinel, perf-regression sentinel
 test-obs:
 	python -m pytest tests/test_obs.py tests/test_perf_attr.py -q
+
+# the fleet-observability suite (docs/observability.md §Federation /
+# §SLOs & burn rates / §Decode timelines): windowed histograms incl.
+# rotation-under-concurrent-observe, labeled Prometheus series + the
+# collision-safe tenant-label aliases, the federated pool scrape under a
+# mid-scrape worker kill, declarative SLO burn rates + the slo_burn
+# chaos spec, decode chrome-trace timelines, flight-dump event rings,
+# and cluster-side metric federation
+test-slo:
+	python -m pytest tests/test_slo.py -q
+
+# SLO burn-rate alert-latency drill (docs/observability.md §SLOs & burn
+# rates): injects a hard latency violation and measures evaluation
+# ticks until the burn gauge crosses the alert threshold; exits
+# non-zero when detection takes more than one window — the
+# SLO_r*.json artifact source
+bench-slo:
+	python -m bigdl_tpu.obs.slo --bench
 
 # the Pallas kernel suite (docs/performance.md §Pallas kernels /
 # §Kernel autotuning / §Block-sparse FFN): kernel-vs-oracle parity in

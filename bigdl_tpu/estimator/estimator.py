@@ -262,6 +262,19 @@ class Estimator:
             # bucketed gradient sync: max flat-gradient bytes per
             # collective, so communication overlaps neighbouring compute
             opt.comm_bucket_bytes = int(self.config["comm_bucket_bytes"])
+        slo_ev = None
+        if "slo_specs" in self.config:
+            # declarative SLOs over the fit (docs/observability.md §SLOs
+            # & burn rates): burn-rate gauges + slo_burn flight events
+            # for the run's objectives; stopped when the fit ends.  A
+            # bad spec degrades observability, never training
+            from bigdl_tpu.obs.slo import SLOEvaluator
+
+            try:
+                slo_ev = SLOEvaluator(self.config["slo_specs"]).start()
+            except Exception as e:  # noqa: BLE001
+                log.error("slo_specs unusable (%s); SLO evaluation "
+                          "disabled for this fit", e)
         if profile_dir is not None:
             opt.set_profile(profile_dir)
         if getattr(self, "_initial_variables", None) is not None:
@@ -280,19 +293,24 @@ class Estimator:
             opt.set_checkpoint(checkpoint_path,
                                checkpoint_trigger or Trigger.every_epoch())
         t0 = time.time()
-        if fault_tolerance:
-            from bigdl_tpu.resilience.retry import FailurePolicy
-            from bigdl_tpu.resilience.supervisor import Supervisor
+        try:
+            if fault_tolerance:
+                from bigdl_tpu.resilience.retry import FailurePolicy
+                from bigdl_tpu.resilience.supervisor import Supervisor
 
-            policy = (fault_tolerance
-                      if isinstance(fault_tolerance, FailurePolicy) else None)
-            if checkpoint_path is None:
-                log.warning("fit(fault_tolerance=...) without "
-                            "checkpoint_path: recovery can only restart "
-                            "from scratch")
-            self._trained = Supervisor(opt, policy=policy).run()
-        else:
-            self._trained = opt.optimize()
+                policy = (fault_tolerance
+                          if isinstance(fault_tolerance, FailurePolicy)
+                          else None)
+                if checkpoint_path is None:
+                    log.warning("fit(fault_tolerance=...) without "
+                                "checkpoint_path: recovery can only "
+                                "restart from scratch")
+                self._trained = Supervisor(opt, policy=policy).run()
+            else:
+                self._trained = opt.optimize()
+        finally:
+            if slo_ev is not None:
+                slo_ev.stop()
         self._last_stats = {
             "train_time_s": time.time() - t0,
             "epochs": epochs,

@@ -7,7 +7,10 @@ The layer every other subsystem reports through:
   serving request or training step across subsystems
 - :mod:`.export` — Prometheus text-format exporter over ``Metrics``
   (``GET /metrics`` on serving; :class:`MetricsServer` for training jobs)
-- :mod:`.hist`   — bounded log-bucketed histograms (p50/p95/p99)
+- :mod:`.hist`   — bounded log-bucketed histograms (p50/p95/p99 +
+  sliding windows)
+- :mod:`.slo`    — declarative per-tenant SLOs: sliding-window error
+  budgets, multi-window burn-rate alerts, the fleet health score
 - :mod:`.flight` — fixed-size ring of notable events, dumped as JSONL on
   crash or SIGTERM
 - :mod:`.attr`   — per-step wall-time attribution, the recompilation
@@ -21,19 +24,22 @@ The layer every other subsystem reports through:
 # NOTE: obs.sentinel is deliberately NOT imported here — it is the
 # `python -m bigdl_tpu.obs.sentinel` CLI, and an eager package import
 # would trip runpy's double-import warning on every invocation
-from bigdl_tpu.obs import attr, cost, flight, trace
+from bigdl_tpu.obs import attr, cost, flight, slo, trace
 from bigdl_tpu.obs.attr import (RecompileSentinel, StepAttribution,
                                 expected_compile, recompile_sentinel)
 from bigdl_tpu.obs.cost import CostReport, forward_costs, peak_flops
-from bigdl_tpu.obs.export import (MetricsServer, render_prometheus,
+from bigdl_tpu.obs.export import (MetricsServer, federate,
+                                  parse_exposition, render_prometheus,
                                   sanitize_metric_name)
 from bigdl_tpu.obs.flight import FlightRecorder
 from bigdl_tpu.obs.hist import LogHistogram
+from bigdl_tpu.obs.slo import SLOEvaluator, SLOSpec
 from bigdl_tpu.obs.trace import Span, Tracer
 
 __all__ = [
-    "trace", "flight", "attr", "cost", "Tracer", "Span",
+    "trace", "flight", "attr", "cost", "slo", "Tracer", "Span",
     "FlightRecorder", "LogHistogram", "MetricsServer", "render_prometheus",
+    "parse_exposition", "federate", "SLOEvaluator", "SLOSpec",
     "sanitize_metric_name", "StepAttribution", "RecompileSentinel",
     "recompile_sentinel", "expected_compile", "CostReport", "forward_costs",
     "peak_flops",

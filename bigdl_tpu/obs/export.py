@@ -26,7 +26,7 @@ standalone :class:`MetricsServer`.
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from bigdl_tpu.utils.log import get_logger
 
@@ -45,6 +45,22 @@ def sanitize_metric_name(name: str) -> str:
     if not out or out[0].isdigit():
         out = "_" + out
     return out
+
+
+def split_label_key(key: str) -> Tuple[str, str]:
+    """Split a registry key into (base name, label body).  Keys built by
+    :func:`bigdl_tpu.optim.metrics.label_key` look like
+    ``name{k="v",...}``; the label body is returned WITHOUT braces (empty
+    for plain keys) and rides verbatim into the sample line."""
+    if key.endswith("}") and "{" in key:
+        base, _, rest = key.partition("{")
+        return base, rest[:-1]
+    return key, ""
+
+
+def _merge_label_bodies(*bodies: str) -> str:
+    """Join label bodies (brace-less ``k="v"`` lists), dropping empties."""
+    return ",".join(b for b in bodies if b)
 
 
 def _fmt(v: float) -> str:
@@ -159,7 +175,43 @@ DEFAULT_HELP = {
                               "mid-decode)",
     "serving.decode.steps": "decode model steps executed",
     "serving.decode.prefill_chunks": "prompt prefill chunks executed",
+    # label-form per-tenant serving families (docs/observability.md
+    # §Federation): one family, one series per tenant="..." label — the
+    # name-embedded serving.tenant.<name>.* families stay as deprecated
+    # aliases for one release
+    "serving.tenant_latency_seconds": "admission-to-publish latency per "
+                                      "request, by tenant= label "
+                                      "(labeled alias of "
+                                      "serving.tenant.<name>.latency_s)",
+    "serving.tenant_queue_wait_seconds": "admission-to-predict queue wait "
+                                         "per request, by tenant= label",
+    "serving.tenant_ttft_seconds": "generate time-to-first-token per "
+                                   "request, by tenant= label",
+    "serving.tenant_queue_depth": "requests queued in the tenant's "
+                                  "admission heap, by tenant= label",
+    "serving.tenant_requests_total": "requests answered, by tenant= label",
+    "serving.tenant_expired_total": "requests dropped on deadline, by "
+                                    "tenant= label",
+    "serving.tenant_failed_total": "requests failed by predict errors, by "
+                                   "tenant= label",
+    # declarative SLOs (docs/observability.md §SLOs & burn rates)
+    "slo.burn_rate": "error-budget burn rate over the objective's short "
+                     "window, by tenant=/objective= labels (1.0 = burning "
+                     "exactly the budget; >1 exhausts it early)",
+    "slo.burn_rate_long": "burn rate over the long (6x) window — the "
+                          "sustained-burn half of multi-window alerting",
+    "slo.budget_remaining": "fraction of the window's error budget left "
+                            "(clamped at 0), by tenant=/objective=",
+    "slo.health": "pool health score in [0,1]: 1 - max burn rate across "
+                  "tenants/objectives, clamped — the autoscaler/"
+                  "degradation input",
+    "slo.tenant_health": "per-tenant health score in [0,1], by tenant=",
+    "slo.burn_events_total": "slo_burn flight events recorded (burn rate "
+                             "crossed the alert threshold)",
     "serving_pool.workers": "serving pool size (autoscaler-managed)",
+    "serving_pool.federation_stale": "federated /metrics scrapes that "
+                                     "dropped a worker's series (dead or "
+                                     "unreachable mid-scrape)",
     "serving_pool.conn_reuse": "proxy forwards served over a reused "
                                "keep-alive worker connection",
     "serving_pool.scale_up": "autoscaler worker additions",
@@ -176,6 +228,14 @@ DEFAULT_HELP = {
     "cluster.aborts_total": "gang abort flags posted by this process",
     "cluster.preempt_notices_total": "cluster-wide preemption notices "
                                      "posted or propagated",
+    # training-side metric federation (docs/observability.md §Federation):
+    # the leader re-exports each host's snapshot under cluster.host.*
+    # families with a host= label — one scrape shows the whole gang
+    "cluster.hosts_reporting": "hosts whose metric snapshots the leader "
+                               "merged in the last sweep (self included)",
+    "cluster.host.age_s": "staleness of one host's merged metric "
+                          "snapshot, by host= label — a straggler shows "
+                          "up as a growing age, not a missing series",
 }
 
 
@@ -204,52 +264,176 @@ def render_prometheus(metrics=None) -> str:
     helps.update(snap.get("helps", {}))
     lines = []
     emitted = set()
-    owner: Dict[str, str] = {}  # family -> raw name that claimed it
+    owner: Dict[str, str] = {}  # family -> raw BASE name that claimed it
 
-    def header(raw_name: str, n: str, typ: str) -> bool:
-        """Declare family ``n`` once; False when ``raw_name`` lost the
-        family to an earlier colliding name (caller skips its samples)."""
-        if owner.setdefault(n, raw_name) != raw_name:
+    def header(raw_base: str, n: str, typ: str) -> bool:
+        """Declare family ``n`` once; False when ``raw_base`` lost the
+        family to an earlier colliding name (caller skips its samples).
+        Labeled series of ONE base name share the family — only a
+        DIFFERENT base colliding onto the same sanitized family is
+        dropped."""
+        if owner.setdefault(n, raw_base) != raw_base:
             return False
         if n in emitted:
             return True  # family already declared this scrape
         emitted.add(n)
-        h = helps.get(raw_name) or helps.get(n)
+        h = helps.get(raw_base) or helps.get(n)
         if h:
             lines.append(f"# HELP {n} {_escape_help(h)}")
         lines.append(f"# TYPE {n} {typ}")
         return True
 
+    def series(key: str) -> Tuple[str, str, str]:
+        """(raw base, family, rendered sample suffix) of one registry
+        key — ``suffix`` is ``{labels}`` or empty."""
+        base, labels = split_label_key(key)
+        n = sanitize_metric_name(base)
+        return base, n, (f"{{{labels}}}" if labels else "")
+
     for name in sorted(snap["counters"]):
-        n = sanitize_metric_name(name)
-        if not header(name, n, "counter"):
+        base, n, sfx = series(name)
+        if not header(base, n, "counter"):
             continue
-        lines.append(f"{n} {_fmt(snap['counters'][name])}")
+        lines.append(f"{n}{sfx} {_fmt(snap['counters'][name])}")
     # gauges: point-in-time levels (queue depths, ring occupancy);
     # .get() tolerates snapshots from pre-gauge Metrics objects
     for name in sorted(snap.get("gauges", {})):
-        n = sanitize_metric_name(name)
-        if not header(name, n, "gauge"):
+        base, n, sfx = series(name)
+        if not header(base, n, "gauge"):
             continue
-        lines.append(f"{n} {_fmt(snap['gauges'][name])}")
+        lines.append(f"{n}{sfx} {_fmt(snap['gauges'][name])}")
     for name in sorted(snap["sums"]):
-        n = sanitize_metric_name(name)
-        if not header(name, n, "summary"):
+        base, n, sfx = series(name)
+        if not header(base, n, "summary"):
             continue
-        lines.append(f"{n}_sum {_fmt(snap['sums'][name])}")
-        lines.append(f"{n}_count {snap['counts'].get(name, 0)}")
+        lines.append(f"{n}_sum{sfx} {_fmt(snap['sums'][name])}")
+        lines.append(f"{n}_count{sfx} {snap['counts'].get(name, 0)}")
     for name in sorted(snap["hists"]):
         h = snap["hists"][name]
-        n = sanitize_metric_name(name)
-        if not header(name, n, "histogram"):
+        base, n, sfx = series(name)
+        if not header(base, n, "histogram"):
             continue
+        _, labels = split_label_key(name)
         acc = 0
         for bound, count in zip(h["bounds"], h["counts"]):
             acc += count
-            lines.append(f'{n}_bucket{{le="{_fmt(bound)}"}} {acc}')
-        lines.append(f'{n}_bucket{{le="+Inf"}} {h["n"]}')
-        lines.append(f"{n}_sum {_fmt(h['sum'])}")
-        lines.append(f"{n}_count {h['n']}")
+            lb = _merge_label_bodies(labels, f'le="{_fmt(bound)}"')
+            lines.append(f'{n}_bucket{{{lb}}} {acc}')
+        lb = _merge_label_bodies(labels, 'le="+Inf"')
+        lines.append(f'{n}_bucket{{{lb}}} {h["n"]}')
+        lines.append(f"{n}_sum{sfx} {_fmt(h['sum'])}")
+        lines.append(f"{n}_count{sfx} {h['n']}")
+    return "\n".join(lines) + "\n"
+
+
+# -- metrics federation (docs/observability.md §Federation) -----------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)(?:\s+(\S+))?$')
+
+
+def parse_exposition(text: str) -> List[Dict]:
+    """Parse one Prometheus text exposition into ordered families:
+    ``[{"name", "type", "help", "samples": [(metric, labels, value)]}]``
+    with ``labels`` the brace-less label body (may carry ``le=``).
+    Samples are grouped under the family whose ``# TYPE`` header they
+    follow (the exposition-format contract); a sample with no preceding
+    header opens an untyped family of its own name.  Tolerant by design
+    — a malformed line is skipped, never fatal: this is the proxy's read
+    path over worker scrapes."""
+    families: List[Dict] = []
+    by_name: Dict[str, Dict] = {}
+    current: Optional[Dict] = None
+
+    def family(name: str, typ: Optional[str], help_text: Optional[str]
+               ) -> Dict:
+        fam = by_name.get(name)
+        if fam is None:
+            fam = {"name": name, "type": typ, "help": help_text,
+                   "samples": []}
+            by_name[name] = fam
+            families.append(fam)
+        else:
+            if typ is not None and fam["type"] is None:
+                fam["type"] = typ
+            if help_text is not None and fam["help"] is None:
+                fam["help"] = help_text
+        return fam
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            current = family(name, None, help_text)
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, typ = rest.partition(" ")
+            current = family(name, typ.strip() or None, None)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        metric, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        fam = current
+        if fam is None or not metric.startswith(fam["name"]):
+            fam = family(metric, None, None)
+            current = fam
+        fam["samples"].append((metric, labels, value))
+    return families
+
+
+def _render_extra_labels(extra: Dict[str, str]) -> str:
+    # THE label-body renderer is optim.metrics.label_key (imported
+    # lazily — metrics imports obs.hist, so a module-level import here
+    # would re-enter the obs package mid-init); an empty name yields
+    # just the braced body, which this strips
+    from bigdl_tpu.optim.metrics import label_key
+
+    return label_key("", **extra)[1:-1] if extra else ""
+
+
+def federate(parts: List[Tuple[Dict[str, str], str]]) -> str:
+    """Merge several expositions into ONE parse-clean scrape — the pool
+    proxy's federated ``GET /metrics`` (docs/observability.md
+    §Federation).  ``parts`` is ``[(extra_labels, exposition_text)]``;
+    every sample of a part gets its extra labels (``worker="worker-0"``)
+    appended, which is what keeps same-named series from two workers
+    distinct.  Each family is DECLARED exactly once (first part wins the
+    ``# HELP``/``# TYPE``); a later part whose declared type disagrees
+    has that family's samples dropped — a type-flapping family would make
+    the whole scrape unparseable, which is strictly worse."""
+    merged: List[Dict] = []
+    by_name: Dict[str, Dict] = {}
+    for extra, text in parts:
+        sfx = _render_extra_labels(extra) if extra else ""
+        for fam in parse_exposition(text):
+            out = by_name.get(fam["name"])
+            if out is None:
+                out = {"name": fam["name"], "type": fam["type"],
+                       "help": fam["help"], "samples": []}
+                by_name[fam["name"]] = out
+                merged.append(out)
+            elif (fam["type"] is not None and out["type"] is not None
+                    and fam["type"] != out["type"]):
+                continue  # type conflict: drop the later part's samples
+            for metric, labels, value in fam["samples"]:
+                lb = _merge_label_bodies(labels, sfx)
+                out["samples"].append(
+                    (f"{metric}{{{lb}}}" if lb else metric, value))
+    lines = []
+    for fam in merged:
+        if fam["help"]:
+            lines.append(f"# HELP {fam['name']} {fam['help']}")
+        if fam["type"]:
+            lines.append(f"# TYPE {fam['name']} {fam['type']}")
+        for metric, value in fam["samples"]:
+            lines.append(f"{metric} {value}")
     return "\n".join(lines) + "\n"
 
 

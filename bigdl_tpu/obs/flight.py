@@ -86,6 +86,8 @@ class FlightRecorder:
                     blocking=not reason.startswith("signal"))
                 if snap is not None:
                     f.write(json.dumps(snap, default=str) + "\n")
+                for line in _dump_source_lines():
+                    f.write(json.dumps(line, default=str) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, default=str) + "\n")
             self._dumped = True
@@ -163,6 +165,57 @@ class FlightRecorder:
                     _signal.raise_signal(signum)
 
             _signal.signal(sig, _on_signal)
+
+
+# -- auxiliary dump sources (subsystem state rings) -------------------------
+#
+# Subsystems with their OWN bounded event state (the decode engine's
+# scheduling ring: slot admissions, expiries, prefill interleave) register
+# a provider; every dump writes one JSON line per live source next to the
+# metrics_snapshot line.  Providers are held via weakref.WeakMethod so a
+# stopped engine's ring is pruned, never pinned alive by the recorder.
+
+_dump_sources: "Dict[str, Any]" = {}
+_sources_lock = threading.Lock()
+
+
+def register_dump_source(name: str, method) -> None:
+    """Register a bound method returning a JSON-able dict to include in
+    every flight dump (keyed by ``name``; re-registering replaces)."""
+    import weakref
+
+    with _sources_lock:
+        _dump_sources[name] = weakref.WeakMethod(method)
+
+
+def unregister_dump_source(name: str) -> None:
+    with _sources_lock:
+        _dump_sources.pop(name, None)
+
+
+def _dump_source_lines() -> List[Dict[str, Any]]:
+    """Evaluate live sources (dead weakrefs pruned); never raises — a
+    broken provider must not mask the death being dumped."""
+    with _sources_lock:
+        items = list(_dump_sources.items())
+    out, dead = [], []
+    for name, ref in items:
+        fn = ref()
+        if fn is None:
+            dead.append(name)
+            continue
+        try:
+            payload = fn()
+        except Exception:  # noqa: BLE001 — see docstring
+            continue
+        if isinstance(payload, dict):
+            out.append({"t": time.time(), "kind": "dump_source",
+                        "source": name, **payload})
+    if dead:
+        with _sources_lock:
+            for name in dead:
+                _dump_sources.pop(name, None)
+    return out
 
 
 # -- process-wide recorder (what the instrumented sites hit) ----------------

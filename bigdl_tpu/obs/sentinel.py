@@ -71,6 +71,12 @@ _ARTIFACT_GLOBS = (
     # compression must keep paying)
     "MULTICHIP_LARGE_r[0-9]*.json",
     "MULTICHIP_GRADCOMM_r[0-9]*.json",
+    # SLO burn-rate alert drills (python -m bigdl_tpu.obs.slo --bench):
+    # alert latency under an injected hard violation gates lower-better —
+    # a PR that silently slows burn detection fails bench-watch; the
+    # burn peak gates higher-better (the detector must keep seeing a
+    # hard violation as a hard burn)
+    "SLO_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic, collective
@@ -79,6 +85,7 @@ _LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms",
                            "decode_ttft_ms_p50", "decode_ttft_ms_p99",
                            "decode_inter_token_p99_ms",
                            "cluster_mttr_s", "cluster_recovery_bytes",
+                           "slo_alert_latency_s",
                            "multichip_ici_bytes_per_step",
                            "multichip_dcn_bytes_per_step",
                            "multichip_grad_sync_ici_bytes_per_step",
@@ -180,6 +187,12 @@ def normalize(doc: Any, source: str) -> List[Row]:
         # beating the whole-batch-restart baseline
         add(f"decode_speedup_vs_static{sfx}",
             row.get("speedup_vs_static"))
+    if "slo_alert_latency_s" in row:
+        # SLO_r*.json burn-rate drills: both values are quantized to the
+        # evaluation cadence / a hard injected violation, so they are
+        # stable run-to-run (the bench docstring has the reasoning)
+        add("slo_alert_latency_s", row["slo_alert_latency_s"], LOWER)
+        add("slo_burn_peak", row.get("slo_burn_peak"))
     if "mttr_s" in row:  # CLUSTER_r*.json recovery drills
         add("cluster_mttr_s", row["mttr_s"], LOWER)
         add("cluster_recovery_bytes", row.get("recovery_bytes"), LOWER)

@@ -123,6 +123,21 @@ class Tracer:
         with self._lock:
             self._spans.append(span)
 
+    def add_event(self, name: str, start_s: float, end_s: float,
+                  **attrs) -> Span:
+        """Append an explicitly-timed span — for call sites that time a
+        region themselves (the decode engine's per-token steps span a
+        jitted call shared by many requests; each request's event carries
+        the same wall window with its own ``request_id``).  No
+        contextvars involvement: these events correlate by attribute, not
+        by parent link (docs/observability.md §Decode timelines)."""
+        sid = self._next_id()
+        s = Span(self, name, sid, None, sid, attrs)
+        s.start_s = float(start_s)
+        s.end_s = float(max(end_s, start_s))
+        self._finish(s)
+        return s
+
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)

@@ -18,6 +18,23 @@ from typing import Dict, Optional
 from bigdl_tpu.obs.hist import LogHistogram
 
 
+def label_key(name: str, **labels) -> str:
+    """Canonical registry key of a LABELED series:
+    ``name{k="v",k2="v2"}`` with keys sorted and values escaped per the
+    Prometheus text grammar.  The exporter (``obs.export``) splits the
+    key back into family + label set, so two series of one family
+    (``serving.tenant_latency_seconds{tenant="a"}`` / ``{tenant="b"}``)
+    share a single ``# TYPE`` declaration in the scrape."""
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+        parts.append(f'{k}="{v}"')
+    return name + "{" + ",".join(parts) + "}"
+
+
 class Metrics:
     def __init__(self):
         self.sums: Dict[str, float] = defaultdict(float)
@@ -48,20 +65,47 @@ class Metrics:
             self.sums[name] += value
             self.counts[name] += 1
 
-    def inc(self, name: str, n: float = 1):
+    def inc(self, name: str, n: float = 1,
+            labels: Optional[Dict[str, str]] = None):
+        if labels:
+            name = label_key(name, **labels)
         with self._lock:
             self.counters[name] += n
         self._mirror("inc", name, n)
 
-    def gauge(self, name: str, value: float):
+    def gauge(self, name: str, value: float,
+              labels: Optional[Dict[str, str]] = None):
         """Set a point-in-time level (queue depth, buffer-ring occupancy);
-        the scrape sees the latest value."""
+        the scrape sees the latest value.  ``labels`` selects one series
+        of a labeled family (key built by :func:`label_key`)."""
+        if labels:
+            name = label_key(name, **labels)
         with self._lock:
             self.gauges[name] = float(value)
         self._mirror("gauge", name, value)
 
-    def observe(self, name: str, value: float):
+    def ensure_hist(self, name: str,
+                    labels: Optional[Dict[str, str]] = None,
+                    **hist_kwargs) -> float:
+        """Create the named histogram with explicit geometry (window_s,
+        window_slices, ...) if it does not exist yet — the SLO evaluator
+        pre-sizes its tenant histograms so a spec window longer than the
+        default 60s ring is actually answerable.  Returns the
+        histogram's (existing or created) window_s so the caller can
+        detect a pre-existing smaller ring."""
+        if labels:
+            name = label_key(name, **labels)
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = LogHistogram(**hist_kwargs)
+            return h.window_s
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None):
         """One sample into the named histogram (created on first use)."""
+        if labels:
+            name = label_key(name, **labels)
         with self._lock:
             h = self.hists.get(name)
             if h is None:
@@ -109,6 +153,45 @@ class Metrics:
         with self._lock:
             h = self.hists.get(name)
             return h.percentile(q) if h is not None else 0.0
+
+    # -- sliding-window reads (SLO burn rates; docs/observability.md) -------
+    def window_percentile(self, name: str, q: float,
+                          labels: Optional[Dict[str, str]] = None,
+                          window_s: Optional[float] = None,
+                          now: Optional[float] = None) -> float:
+        """q-th percentile of the histogram's trailing window; NaN when
+        the window (or the histogram itself) is empty."""
+        if labels:
+            name = label_key(name, **labels)
+        with self._lock:
+            h = self.hists.get(name)
+            return (h.window_percentile(q, now=now, window_s=window_s)
+                    if h is not None else float("nan"))
+
+    def window_fraction_over(self, name: str, threshold: float,
+                             labels: Optional[Dict[str, str]] = None,
+                             window_s: Optional[float] = None,
+                             now: Optional[float] = None) -> float:
+        """Fraction of window samples over ``threshold`` (NaN when the
+        window is empty) — the SLO evaluator's bad-event ratio."""
+        if labels:
+            name = label_key(name, **labels)
+        with self._lock:
+            h = self.hists.get(name)
+            return (h.window_fraction_over(threshold, now=now,
+                                           window_s=window_s)
+                    if h is not None else float("nan"))
+
+    def window_count(self, name: str,
+                     labels: Optional[Dict[str, str]] = None,
+                     window_s: Optional[float] = None,
+                     now: Optional[float] = None) -> int:
+        if labels:
+            name = label_key(name, **labels)
+        with self._lock:
+            h = self.hists.get(name)
+            return (h.window_count(now=now, window_s=window_s)
+                    if h is not None else 0)
 
     def reset(self):
         with self._lock:

@@ -99,8 +99,26 @@ class ClusterConfig:
     # the background sweep; at most one direct board probe per this many
     # seconds — so K=1 training never pays a storage listing per step
     edge_probe_interval_s: float = 1.0
+    # metric federation (docs/observability.md §Federation): each sweep
+    # publishes this host's counters/gauges/hist-quantiles onto the
+    # board; the LEADER merges every host's snapshot into
+    # cluster.host.*-labeled series, so one scrape of the leader's
+    # /metrics shows the whole gang — stragglers included (their stale
+    # snapshot shows with a growing cluster.host.age_s, never vanishes)
+    metrics_federation: bool = True
     clock: Callable[[], float] = field(default=time.time)
     sleep: Callable[[float], None] = field(default=time.sleep)
+
+
+def _hist_quantile(h: Dict, q: float) -> float:
+    """Percentile from a ``LogHistogram.snapshot()`` dict (the board
+    carries snapshots, not live histograms) — delegates to THE shared
+    bucket-upper-bound rule so it cannot diverge from the local one."""
+    from bigdl_tpu.obs.hist import percentile_from
+
+    return percentile_from(h.get("counts", []), h.get("bounds", []),
+                           int(h.get("n", 0)), float(h.get("max", 0.0)),
+                           q)
 
 
 # ---------------------------------------------------------------------------
@@ -415,6 +433,11 @@ class ClusterCoordinator:
             self.heartbeat.beat(step=self._last_step)
         except OSError as e:  # control dir blipped; next sweep retries
             log.warning("cluster heartbeat write failed: %s", e)
+        if cfg.metrics_federation:
+            try:
+                self._publish_metrics()
+            except Exception as e:  # noqa: BLE001 — observability only
+                log.warning("cluster metric publish failed: %s", e)
         partitioned = False
         try:
             faults.fire("cluster_partition")
@@ -506,6 +529,12 @@ class ClusterCoordinator:
                         with self._lock:
                             if self._abort_seen is None:
                                 self._abort_seen = hit
+        if cfg.metrics_federation and not partitioned \
+                and min(live) == self.rank:
+            try:
+                self.merge_peer_metrics()
+            except Exception as e:  # noqa: BLE001 — observability only
+                log.warning("cluster metric merge failed: %s", e)
         return view
 
     def _probe_abort_range(self, joined: int, epoch: int
@@ -542,6 +571,86 @@ class ClusterCoordinator:
                 notices, view.epoch)
             flight.record("cluster_preempt_seen", ranks=notices,
                           epoch=view.epoch)
+
+    # -- metric federation (docs/observability.md §Federation) --------------
+    def _metrics_dir(self) -> str:
+        d = storage.join(self.cfg.directory, "metrics")
+        storage.makedirs(d)
+        return d
+
+    def _publish_metrics(self) -> None:
+        """Write this host's metric snapshot (counters + gauges + hist
+        quantiles) onto the board — one small JSON per host, overwritten
+        each sweep, so the merge is one listing + one read per peer."""
+        snap = self.metrics.snapshot(blocking=False)
+        if snap is None:  # registry busy; next sweep publishes
+            return
+        flat: Dict[str, float] = {}
+        for src in (snap["counters"], snap["gauges"]):
+            for k, v in src.items():
+                # the leader's own merged series must not re-publish —
+                # cluster.host.cluster.host.* would grow without bound
+                if k.startswith("cluster.host"):
+                    continue
+                flat[k] = float(v)
+        for name, h in snap["hists"].items():
+            # quantiles, not raw buckets: the gang-wide view answers
+            # "which host's tail is burning", not full distributions
+            if h["n"]:
+                base, _, rest = name.partition("{")
+                sfx = f"{{{rest}" if rest else ""
+                flat[f"{base}.p50{sfx}"] = _hist_quantile(h, 50)
+                flat[f"{base}.p99{sfx}"] = _hist_quantile(h, 99)
+        storage.write_json(
+            storage.join(self._metrics_dir(),
+                         f"host-r{self.rank:05d}.json"),
+            {"rank": self.rank, "t": float(self.cfg.clock()),
+             "metrics": flat})
+
+    def merge_peer_metrics(self) -> int:
+        """LEADER: re-export every host's published snapshot as
+        ``cluster.host.<name>{host="<rank>"}`` gauges (own rank included
+        — the scrape reads uniformly), plus a per-host staleness gauge.
+        A straggler's old snapshot stays visible with a growing
+        ``cluster.host.age_s`` instead of silently dropping out of the
+        scrape.  Returns the number of hosts merged."""
+        try:
+            names = storage.listdir(self._metrics_dir())
+        except (OSError, ImportError):
+            return 0
+        now = float(self.cfg.clock())
+        merged = 0
+        for name in sorted(names):
+            if not (name.startswith("host-r") and name.endswith(".json")):
+                continue
+            try:
+                doc = storage.read_json(
+                    storage.join(self._metrics_dir(), name))
+                rank = int(doc["rank"])
+                flat = doc.get("metrics", {})
+            except (OSError, ValueError, KeyError):
+                continue  # torn write: the next sweep reads the final file
+            host_lb = f'host="{rank}"'
+            for k, v in flat.items():
+                try:
+                    v = float(v)
+                except (TypeError, ValueError):
+                    continue
+                # a published key may already carry labels
+                # (serving.tenant_latency_seconds{tenant="a"}.p99 keeps
+                # them before the quantile suffix was appended — split on
+                # the FIRST brace): the host label joins the body
+                base, _, rest = k.partition("{")
+                body = rest[:-1] if rest.endswith("}") else rest
+                lb = ",".join(x for x in (body, host_lb) if x)
+                self.metrics.gauge(
+                    f"cluster.host.{base}" + "{" + lb + "}", v)
+            self.metrics.gauge("cluster.host.age_s",
+                               max(0.0, now - float(doc.get("t", now))),
+                               labels={"host": str(rank)})
+            merged += 1
+        self.metrics.gauge("cluster.hosts_reporting", merged)
+        return merged
 
     # -- driver hooks -------------------------------------------------------
     def on_step(self, step: int, n_steps: int = 1) -> None:

@@ -54,6 +54,13 @@ class EngineConfig:
     profile_dir: Optional[str] = None
     metrics_port: Optional[int] = None
     metrics_host: str = "127.0.0.1"
+    # declarative SLOs (docs/observability.md §SLOs & burn rates): a list
+    # of spec dicts ({"tenant", "objectives", "window_s"}), inline JSON,
+    # or a JSON file path.  The Engine runs an SLOEvaluator over the
+    # process registry for the process lifetime; burn rates export as
+    # slo.* gauges on /metrics (pair with metrics_port for training
+    # jobs).  BIGDL_TPU_SLO_SPECS overrides fleet-wide.
+    slo_specs: Optional[object] = None
     # per-chip peak FLOP/s pin for the live train.mfu gauge
     # (docs/performance.md): needed when device_kind is missing from the
     # obs.cost table (new hardware, CPU test meshes).
@@ -157,6 +164,8 @@ class EngineConfig:
             cfg.metrics_port = int(os.environ["BIGDL_TPU_METRICS_PORT"])
         if os.environ.get("BIGDL_TPU_METRICS_HOST"):
             cfg.metrics_host = os.environ["BIGDL_TPU_METRICS_HOST"]
+        if os.environ.get("BIGDL_TPU_SLO_SPECS"):
+            cfg.slo_specs = os.environ["BIGDL_TPU_SLO_SPECS"]
         if os.environ.get("BIGDL_TPU_DATA_WORKERS"):
             cfg.data_workers = int(os.environ["BIGDL_TPU_DATA_WORKERS"])
         if os.environ.get("BIGDL_TPU_GRAD_COMM"):
@@ -220,6 +229,18 @@ class Engine:
                 log.error("metrics server failed to bind %s:%s (%s); "
                           "continuing WITHOUT a /metrics endpoint",
                           config.metrics_host, config.metrics_port, e)
+        self.slo_evaluator = None
+        if config.slo_specs is not None:
+            # process-lifetime burn-rate evaluation over the global
+            # registry; a bad spec degrades observability, never compute
+            from bigdl_tpu.obs.slo import SLOEvaluator
+
+            try:
+                self.slo_evaluator = SLOEvaluator(
+                    config.slo_specs).start()
+            except Exception as e:  # noqa: BLE001
+                log.error("SLO specs unusable (%s); SLO evaluation "
+                          "disabled", e)
         log.info(
             "Engine initialized: %d devices (%s), %d processes, mesh %s",
             jax.device_count(),
@@ -237,9 +258,11 @@ class Engine:
 
     @classmethod
     def reset(cls) -> None:
-        if cls._instance is not None \
-                and cls._instance.metrics_server is not None:
-            cls._instance.metrics_server.stop()
+        if cls._instance is not None:
+            if cls._instance.metrics_server is not None:
+                cls._instance.metrics_server.stop()
+            if getattr(cls._instance, "slo_evaluator", None) is not None:
+                cls._instance.slo_evaluator.stop()
         cls._instance = None
 
     @property

@@ -67,6 +67,7 @@ import jax.numpy as jnp
 
 from bigdl_tpu.nn.attention import _attn_project, positional_encoding
 from bigdl_tpu.nn.module import EMPTY
+from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving.decode")
@@ -603,7 +604,11 @@ class DecodeEngine:
         self._static_prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._static_scan_fns: Dict[Tuple[int, int], Callable] = {}
         # event ring for scheduling specs ("prefill_chunk"/"decode_step")
+        # — also dumped by the flight recorder next to metrics_snapshot
+        # (weakref'd: a collected engine's ring is pruned, not pinned)
         self.events: deque = deque(maxlen=512)
+        flight.register_dump_source(
+            f"decode_engine:{name}:{id(self):x}", self._ring_snapshot)
         self._tokens_window = deque(maxlen=256)   # (t, n) for tokens/s
         self.stats = {"requests": 0, "completed": 0, "expired": 0,
                       "tokens": 0, "steps": 0, "prefill_chunks": 0,
@@ -644,6 +649,14 @@ class DecodeEngine:
         reqs = [self.submit(DecodeRequest(tokens=np.asarray(p), **kw))
                 for p in prompts]
         return [r.wait(timeout=120.0) for r in reqs]
+
+    def _ring_snapshot(self) -> dict:
+        """The scheduling ring (slot admissions, expiries, prefill
+        interleave) as one flight-dump line — a decode postmortem needs
+        WHAT the scheduler did, not just the counters."""
+        return {"engine": self.name,
+                "events": [list(e) for e in list(self.events)],
+                "stats": dict(self.stats)}
 
     def queue_depth(self) -> int:
         with self._cv:
@@ -947,10 +960,12 @@ class DecodeEngine:
             while self._heap and self._heap[0][0] <= now:
                 expired_q.append(heapq.heappop(self._heap)[2])
         for req in expired_q:
+            self.events.append(("expire_queued", req.rid))
             self._finish_expired(req, now)
         for s, seq in enumerate(self._slots):
             if seq is not None and not seq.done \
                     and seq.req.deadline_t <= now:
+                self.events.append(("expire", seq.req.rid, s))
                 self._finish_expired(seq.req, now, seq=seq)
                 self._release_slot(s)
 
@@ -1023,6 +1038,15 @@ class DecodeEngine:
             self.stats["requests"] += 1
             self.metrics.inc("serving.decode.requests")
             self.events.append(("admit", req.rid, s))
+            tr = trace.active()
+            if tr is not None:
+                # submit -> slot claim: where a queued stream's time went
+                # BEFORE any chip work (docs/observability.md §Decode
+                # timelines); correlated by request_id like every
+                # serving span
+                tr.add_event("decode/admission", req.admit_t, time.time(),
+                             request_id=req.rid, slot=s,
+                             tenant=req.tenant)
 
     def _ensure_pages(self, s: int, upto_tokens: int) -> None:
         """Allocate pages for slot ``s`` covering cache positions
@@ -1118,8 +1142,16 @@ class DecodeEngine:
         self.events.append(("prefill_chunk",
                             [self._slots[s].req.rid for _, s, _, _
                              in rows]))
+        tr = trace.active()
         for b, s, real, final in rows:
             seq = self._slots[s]
+            if tr is not None:
+                # one event per co-batched row: the rows share the wall
+                # window of the single prefill call, each joined to its
+                # own request by request_id
+                tr.add_event("decode/prefill_chunk", t0, now,
+                             request_id=seq.req.rid, slot=s,
+                             chunk_start=seq.prefill_pos, tokens=real)
             seq.prefill_pos += real
             if final:
                 self._lengths[s] = len(seq.prompt)
@@ -1171,10 +1203,17 @@ class DecodeEngine:
                                  now - self._last_step_t)
         self._last_step_t = now
         n_tok = 0
+        tr = trace.active()
         for s in active:
             seq = self._slots[s]
             self._lengths[s] += 1          # last_token's K/V just landed
             self._emit_token(s, seq, int(toks[s]), logps[s], now)
+            if tr is not None:
+                # per-token step event: every in-flight stream advanced
+                # one token inside this step's wall window
+                tr.add_event("decode/token_step", t0, now,
+                             request_id=seq.req.rid, slot=s,
+                             index=len(seq.generated) - 1)
             n_tok += 1
         self._tokens_window.append((now, n_tok))
         self.stats["tokens"] += n_tok
@@ -1234,6 +1273,12 @@ class DecodeEngine:
             finish_reason=reason)
         self.stats["completed"] += 1
         self.metrics.inc("serving.decode.completed")
+        tr = trace.active()
+        if tr is not None:
+            t = time.time()
+            tr.add_event("decode/publish", t, t, request_id=req.rid,
+                         finish_reason=reason,
+                         tokens=len(seq.generated))
         if self.cfg.continuous:
             self._release_slot(s)
         else:
@@ -1263,6 +1308,10 @@ class DecodeEngine:
 
         self.stats["expired"] += 1
         self.metrics.inc("serving.decode.expired")
+        tr = trace.active()
+        if tr is not None:
+            tr.add_event("decode/publish", now, now, request_id=req.rid,
+                         finish_reason="expired")
         err = DeadlineExceededError(req.rid, now - req.admit_t)
         if seq is not None and seq.generated:
             # a streaming request that already produced tokens: the

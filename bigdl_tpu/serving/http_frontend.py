@@ -95,6 +95,10 @@ class _Handler(BaseHTTPRequestHandler):
             "degraded": srv.degraded,
             "queue_depth": srv._in.qsize(),
             "backlog": srv.backlog(),
+            # SLO burn-rate verdicts (docs/observability.md §SLOs & burn
+            # rates): the pool autoscaler reads slo_health from here
+            "slo_health": srv.slo_health(),
+            "slo": srv.slo.snapshot() if srv.slo is not None else None,
             "p50_ms": round(
                 srv.metrics.percentile("serving.latency_s", 0.50) * 1e3, 3),
             "p99_ms": round(

@@ -54,7 +54,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from bigdl_tpu.obs import flight, trace
-from bigdl_tpu.obs.export import reply_metrics
+from bigdl_tpu.obs.export import CONTENT_TYPE, federate, render_prometheus
 from bigdl_tpu.optim.metrics import global_metrics
 from bigdl_tpu.serving.http_frontend import REQUEST_ID_RE
 from bigdl_tpu.serving.json_http import reply_json
@@ -537,6 +537,38 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             return payload
         raise payload
 
+    def _reply_federated(self, pool: "ServingPool") -> None:
+        """One federated ``GET /metrics``.  A worker that cannot answer
+        (dead, respawning, or killed mid-scrape) degrades the scrape —
+        its series are dropped and ``federation_stale`` counts the gap —
+        it NEVER fails it: the operator's dashboard must stay up exactly
+        when workers are dying."""
+        parts = []
+        for w in pool.worker_list():
+            if not w.routable():
+                pool._count("federation_stale")
+                continue
+            try:
+                code, data, _ = pool.conns.request(w.url, "GET",
+                                                   "/metrics")
+                if code != 200:
+                    raise RuntimeError(f"HTTP {code}")
+                parts.append(({"worker": w.name}, data.decode()))
+            except Exception:  # noqa: BLE001 — killed mid-scrape
+                pool._count("federation_stale")
+        # the proxy's own registry LAST: federation_stale increments from
+        # THIS scrape's failures are already visible in its own body
+        parts.append(({}, render_prometheus()))
+        try:
+            body = federate(parts).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper hung up; never kill the proxy handler thread
+
     def do_GET(self):
         pool: "ServingPool" = self.server.pool
         # handler instances persist per keep-alive CONNECTION: a prior
@@ -546,9 +578,11 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self._deadline_hdr = None
         self._model_hdr = None
         if self.path == "/metrics":
-            # proxy-process registry (serving_pool.* counters); each
-            # worker additionally serves its own /metrics on its frontend
-            return reply_metrics(self)
+            # FEDERATED scrape (docs/observability.md §Federation): the
+            # proxy's own registry plus every live worker's exposition,
+            # each worker's series labeled worker="<name>" — one scrape
+            # covers the whole pool, every tenant on every worker
+            return self._reply_federated(pool)
         if self.path == "/models":
             # the registry lives in the workers; relay the first answer
             for w in pool._next_workers():
@@ -608,7 +642,8 @@ class ServingPool:
                  autoscale_interval_s: float = 2.0,
                  scale_up_queue_depth: Optional[float] = None,
                  scale_down_after: int = 3,
-                 scale_cooldown_s: float = 5.0):
+                 scale_cooldown_s: float = 5.0,
+                 scale_up_slo_health: float = 0.5):
         self.loader = loader
         self.n = workers
         self.batch_size = batch_size
@@ -635,6 +670,11 @@ class ServingPool:
                                      else max(1.0, batch_size / 2))
         self.scale_down_after = scale_down_after
         self.scale_cooldown_s = scale_cooldown_s
+        # SLO-burn scale-up (docs/observability.md §SLOs & burn rates):
+        # a worker-reported health score below this adds a worker even
+        # when queues look shallow — burn rates see tail-latency pain
+        # queue depth alone cannot (0 disables the signal)
+        self.scale_up_slo_health = scale_up_slo_health
         self._idle_ticks = 0
         self._last_scale_t = 0.0
         self.workers: List[_Worker] = []
@@ -654,7 +694,11 @@ class ServingPool:
         self._stats_lock = threading.Lock()
         self.stats = {"hedged_requests": 0, "proxy_busy": 0,
                       "proxy_unavailable": 0, "rejected_oversize": 0,
-                      "conn_reuse": 0, "scale_up": 0, "scale_down": 0}
+                      "conn_reuse": 0, "scale_up": 0, "scale_down": 0,
+                      "federation_stale": 0}
+        # visible at 0 from the first scrape: an alert on increase needs
+        # the series to exist BEFORE the first worker dies
+        global_metrics().inc("serving_pool.federation_stale", 0)
 
     def _count(self, name: str, n: int = 1) -> None:
         # proxy handler threads count concurrently; += is not atomic
@@ -754,7 +798,7 @@ class ServingPool:
         """The autoscaler's input, from signals the workers already
         export: queue depth and latency percentiles via ``/health``
         (which reads the same gauges/histograms ``/metrics`` scrapes)."""
-        depths, p99s = [], []
+        depths, p99s, slo_healths = [], [], []
         breaker_open = False
         for w in self.worker_list():
             breaker_open |= w.breaker.snapshot()["state"] != "closed"
@@ -766,11 +810,15 @@ class ServingPool:
             # absorbs a queue_depth's worth of waiting work
             depths.append(float(h.get("backlog", h.get("queue_depth", 0))))
             p99s.append(float(h.get("p99_ms", 0.0)))
+            slo_healths.append(float(h.get("slo_health", 1.0)))
         return {
             "routable": len(depths),
             "avg_queue_depth": sum(depths) / len(depths) if depths else 0.0,
             "max_p99_ms": max(p99s) if p99s else 0.0,
             "breaker_open": breaker_open,
+            # the sickest worker's SLO health score: burn-rate pressure
+            # the queue-depth signal cannot see (tail latency, expiries)
+            "slo_health": min(slo_healths) if slo_healths else 1.0,
         }
 
     @staticmethod
@@ -779,7 +827,9 @@ class ServingPool:
                            up_depth: float, idle_ticks: int,
                            down_after: int, breaker_open: bool,
                            since_last_scale_s: float,
-                           cooldown_s: float) -> str:
+                           cooldown_s: float,
+                           slo_health: float = 1.0,
+                           unhealthy_below: float = 0.0) -> str:
         """Pure scaling policy (unit-testable without subprocesses),
         asymmetric on purpose: 'up' on a single over-threshold pressure
         tick below the max bound (queued users are waiting NOW; the
@@ -787,13 +837,19 @@ class ServingPool:
         consecutive idle ticks above the min bound — never while a
         breaker is open (a sick worker's load is about to redistribute;
         shrinking now would double the shock), never inside the cooldown
-        window after the previous action."""
+        window after the previous action.  ``slo_health`` below
+        ``unhealthy_below`` also scales up — an SLO burning on tail
+        latency is user pain the queue-depth signal can miss entirely —
+        and an unhealthy pool never scales DOWN, idle-looking or not."""
         if since_last_scale_s < cooldown_s:
             return "hold"
-        if avg_queue_depth >= up_depth and n_workers < max_workers:
+        unhealthy = slo_health < unhealthy_below
+        if (avg_queue_depth >= up_depth or unhealthy) \
+                and n_workers < max_workers:
             return "up"
         if (avg_queue_depth < 0.5 and idle_ticks >= down_after
-                and n_workers > min_workers and not breaker_open):
+                and n_workers > min_workers and not breaker_open
+                and not unhealthy):
             return "down"
         return "hold"
 
@@ -828,7 +884,9 @@ class ServingPool:
             len(self.worker_list()), self.min_workers, self.max_workers,
             p["avg_queue_depth"], self.scale_up_queue_depth,
             self._idle_ticks, self.scale_down_after, p["breaker_open"],
-            time.time() - self._last_scale_t, self.scale_cooldown_s)
+            time.time() - self._last_scale_t, self.scale_cooldown_s,
+            slo_health=p["slo_health"],
+            unhealthy_below=self.scale_up_slo_health)
         if decision == "up":
             self._scale_up(p)
         elif decision == "down":

@@ -105,6 +105,14 @@ class ServingConfig:
     result_gc_interval_s: float = 1.0
     # default budget for stop(drain=True) / drain()
     drain_timeout_s: float = 10.0
+    # declarative per-tenant SLOs (docs/observability.md §SLOs & burn
+    # rates): a list of spec dicts ({"tenant", "objectives", "window_s"}),
+    # inline JSON, or a JSON file path — same grammar as
+    # BIGDL_TPU_SLO_SPECS, which applies when this is None.  Evaluation
+    # piggybacks on the engine's result-GC tick; burn rates export as
+    # slo.* gauges and feed the pool autoscaler's health signal
+    slo: Optional[Any] = None
+    slo_alert_burn: float = 1.0
 
 
 class ServiceUnavailableError(RuntimeError):
@@ -281,6 +289,24 @@ class ServingServer:
                               "cumulative avg batch fill / batch_size")
         self.metrics.describe("serving.queue_depth",
                               "requests queued across all model heaps")
+        # declarative SLOs: explicit config wins, BIGDL_TPU_SLO_SPECS
+        # applies fleet-wide; a bad spec degrades observability only
+        self.slo = None
+        try:
+            if self.config.slo is not None:
+                from bigdl_tpu.obs.slo import SLOEvaluator
+
+                self.slo = SLOEvaluator(
+                    self.config.slo, metrics=self.metrics,
+                    alert_burn=self.config.slo_alert_burn)
+            else:
+                from bigdl_tpu.obs.slo import evaluator_from_env
+
+                self.slo = evaluator_from_env(
+                    metrics=self.metrics,
+                    alert_burn=self.config.slo_alert_burn)
+        except Exception as e:  # noqa: BLE001 — serving must start anyway
+            log.error("SLO spec unusable (%s); SLO evaluation disabled", e)
 
     # -- model registry -----------------------------------------------------
     def register_model(self, name: str, model: Any,
@@ -338,6 +364,53 @@ class ServingServer:
             return (sum(len(t.heap) for t in self._tenants.values())
                     + (len(self._slot) if self._slot else 0)
                     + self._assembling_n)
+
+    def slo_health(self) -> float:
+        """The SLO health score in [0, 1] (1.0 with no evaluator or no
+        verdict yet) — consulted by ``/health``, the pool autoscaler, and
+        operator degradation tooling (docs/observability.md §SLOs &
+        burn rates)."""
+        return self.slo.health_score() if self.slo is not None else 1.0
+
+    def _tenant_series(self, name: str, kind: str, value: float = 1.0
+                       ) -> None:
+        """One per-tenant signal, recorded BOTH ways: the legacy
+        name-embedded ``serving.tenant.<name>.<kind>`` series (deprecated
+        alias, kept one release) and the label-form family
+        (``serving.tenant_latency_seconds{tenant="..."}`` — the form a
+        fleet's Prometheus can aggregate across)."""
+        lb = {"tenant": name}
+        if kind == "latency":
+            self.metrics.observe(f"serving.tenant.{name}.latency_s", value)
+            self.metrics.observe("serving.tenant_latency_seconds", value,
+                                 labels=lb)
+        elif kind == "queue_wait":
+            self.metrics.observe(f"serving.tenant.{name}.queue_wait_s",
+                                 value)
+            self.metrics.observe("serving.tenant_queue_wait_seconds",
+                                 value, labels=lb)
+        elif kind == "ttft":
+            self.metrics.observe(f"serving.tenant.{name}.ttft_s", value)
+            self.metrics.observe("serving.tenant_ttft_seconds", value,
+                                 labels=lb)
+        elif kind == "queue_depth":
+            self.metrics.gauge(f"serving.tenant.{name}.queue_depth", value)
+            self.metrics.gauge("serving.tenant_queue_depth", value,
+                               labels=lb)
+        elif kind == "requests":
+            self.metrics.inc(f"serving.tenant.{name}.requests", value)
+            self.metrics.inc("serving.tenant_requests_total", value,
+                             labels=lb)
+        elif kind == "expired":
+            self.metrics.inc(f"serving.tenant.{name}.expired", value)
+            self.metrics.inc("serving.tenant_expired_total", value,
+                             labels=lb)
+        elif kind == "failed":
+            self.metrics.inc(f"serving.tenant.{name}.failed", value)
+            self.metrics.inc("serving.tenant_failed_total", value,
+                             labels=lb)
+        else:  # pragma: no cover — programming error, not data
+            raise ValueError(f"unknown tenant series kind {kind!r}")
 
     def _default(self) -> _Tenant:
         return self._tenants[self._default_name]
@@ -673,7 +746,7 @@ class ServingServer:
             if req.error is not None:
                 if isinstance(req.error, DeadlineExceededError):
                     self._count("expired_requests")
-                    self.metrics.inc(f"serving.tenant.{name}.expired")
+                    self._tenant_series(name, "expired")
                     flight.record("serving_deadline_drop", count=1,
                                   request_ids=[rid], decode=True)
                 verdict: Any = req.error
@@ -681,10 +754,12 @@ class ServingServer:
                 verdict = req.result.tokens
                 lat = done_t - req.admit_t
                 self.metrics.observe("serving.latency_s", lat)
-                self.metrics.observe(f"serving.tenant.{name}.latency_s",
-                                     lat)
+                self._tenant_series(name, "latency", lat)
+                if req.result.ttft_s >= 0:
+                    # the decode tail the ttft_p* SLO objectives read
+                    self._tenant_series(name, "ttft", req.result.ttft_s)
                 self._count("requests")
-                self.metrics.inc(f"serving.tenant.{name}.requests")
+                self._tenant_series(name, "requests")
             ttl = done_t + cfg.result_ttl_s
             with self._result_cv:
                 self._results[rid] = verdict
@@ -904,12 +979,21 @@ class ServingServer:
             # kill the engine thread and zombify the server
             log.error("serving batch failed outside predict: %s", e)
             self._count("failed_batches")
+            self._tenant_series(batch[0].model, "failed", len(batch))
             self._publish([r.rid for r in batch],
                           [1] * len(batch), None, error=e)
 
     def _gc_results(self) -> None:
         """TTL sweep over the result table: a client that abandoned its
-        ``query`` (timeout, disconnect) must not leak its entry forever."""
+        ``query`` (timeout, disconnect) must not leak its entry forever.
+        The SLO evaluator piggybacks on the same engine-thread tick (its
+        own rate limit inside) — no extra thread, and burn rates stay
+        fresh exactly as long as the engine is alive."""
+        if self.slo is not None:
+            try:
+                self.slo.maybe_evaluate()
+            except Exception as e:  # noqa: BLE001 — never stall serving
+                log.warning("SLO evaluation failed: %s", e)
         now = time.time()
         if now - self._last_gc_t < self.config.result_gc_interval_s:
             return
@@ -945,8 +1029,7 @@ class ServingServer:
             # batches are single-tenant (_fill_batch pops one heap), so
             # one inc attributes the whole drop — the per-tenant SLO
             # surface must say WHOSE deadlines are expiring
-            self.metrics.inc(f"serving.tenant.{expired[0].model}.expired",
-                             len(expired))
+            self._tenant_series(expired[0].model, "expired", len(expired))
             flight.record("serving_deadline_drop", count=len(expired),
                           request_ids=[r.rid for r in expired])
         return live
@@ -975,8 +1058,7 @@ class ServingServer:
             # decomposition (mirrors the train-side attribution model)
             wait = t_predict - r.admit_t
             self.metrics.observe("serving.queue_wait_s", wait)
-            self.metrics.observe(
-                f"serving.tenant.{tenant.name}.queue_wait_s", wait)
+            self._tenant_series(tenant.name, "queue_wait", wait)
         # chaos seams (docs/serving.md): a slow batch delays the loop so
         # queued requests expire; a worker kill takes the process down
         # mid-request (the pool's breaker/supervisor must absorb it)
@@ -1028,6 +1110,9 @@ class ServingServer:
                     log.error("fallback predict also failed: %s", e2)
             if out is None:
                 log.error("predict failed: %s", e)
+                # the availability half of the tenant's SLO: failed
+                # requests count against the error budget
+                self._tenant_series(tenant.name, "failed", len(batch))
                 self._publish(rids, sizes, None, error=e)
                 return
         if use_fallback:
@@ -1044,20 +1129,17 @@ class ServingServer:
             # scrape shows every model's SLO
             lat = now - r.admit_t
             self.metrics.observe("serving.latency_s", lat)
-            self.metrics.observe(
-                f"serving.tenant.{tenant.name}.latency_s", lat)
+            self._tenant_series(tenant.name, "latency", lat)
         self._count("batches")
         self._count("requests", len(batch))
-        self.metrics.inc(f"serving.tenant.{tenant.name}.requests",
-                         len(batch))
+        self._tenant_series(tenant.name, "requests", len(batch))
         with self._stats_lock:
             occ = (self.stats["requests"] / self.stats["batches"]
                    / max(cfg.batch_size, 1))
         self.metrics.gauge("serving.batch_occupancy", occ)
         self.metrics.gauge("serving.queue_depth", self._in.qsize())
         self.metrics.gauge("serving.backlog", self.backlog())
-        self.metrics.gauge(f"serving.tenant.{tenant.name}.queue_depth",
-                           len(tenant.heap))
+        self._tenant_series(tenant.name, "queue_depth", len(tenant.heap))
 
     def _publish(self, rids, sizes, out, error: Optional[Exception] = None
                  ) -> None:
