@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-slo test-data test-bundle test-kernels test-collectives bench bench-dispatch bench-watch bench-gradcomm bench-decode bench-slo dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-slo test-data test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-slo dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -125,6 +125,16 @@ test-bundle:
 test-collectives:
 	python -m pytest tests/test_grad_comm.py -q
 
+# the declarative sharding layer (docs/parallelism.md §Declarative
+# layouts): parallelism= combo-string parser errors, layout-table
+# completeness for the transformer/seq2seq/two-tower families (a new
+# param landing in silent-replicate FAILS), the replicated-params
+# audit gauge/flight line, fsdp x tp == dp loss-trajectory parity on
+# the 12L transformer, and model-sharded serving through
+# InferenceModel/DecodeEngine with zero unexpected recompiles
+test-layout:
+	python -m pytest tests/test_layout.py -q
+
 bench:
 	python bench.py
 
@@ -147,6 +157,15 @@ bench-scaling:
 # MULTICHIP_GRADCOMM_r*.json artifact source
 bench-gradcomm:
 	python bench_scaling.py --grad-comm
+
+# declarative-layout ledger A/B (docs/parallelism.md §Declarative
+# layouts): per-axis collective bytes + per-chip param bytes of
+# parallelism="dp" vs "fsdp:2,tp:4" on the 12L transformer geometry;
+# exits non-zero when the per-chip param-bytes reduction drops below 4x
+# or any parameter silently replicates — the MULTICHIP_LAYOUT_r*.json
+# artifact source
+bench-layout:
+	python bench_scaling.py --layout
 
 bench-loader:
 	python bench_loader.py

@@ -276,6 +276,89 @@ def main_grad_comm(args):
     return 0 if (reduction >= 3.0 and delta <= parity_tol) else 1
 
 
+def main_layout(args):
+    """Declarative-layout ledger A/B — ONE JSON line, the
+    MULTICHIP_LAYOUT_r*.json artifact (docs/parallelism.md §Declarative
+    layouts).
+
+    Analytic and machine-independent: the 12L GPT-2-small-class
+    transformer's parameter shapes (via ``jax.eval_shape`` — nothing
+    compiles or computes) are priced under the per-model layout table for
+    ``parallelism="dp"`` vs ``"fsdp:2,tp:4"`` on the 8-device bench
+    geometry.  Per layout: per-AXIS collective bytes per step
+    (``obs.cost.collective_bytes_for_specs`` reading the layout), the tp
+    activation-allreduce estimate, and per-chip parameter bytes — the
+    headline is the per-chip param-bytes reduction (the models-too-big-
+    for-one-chip capability the layout layer exists for).  Exits non-zero
+    when the reduction drops below 4x on this geometry or any parameter
+    falls back to silent replication."""
+    from bigdl_tpu.runtime.engine import force_cpu_devices
+
+    import jax
+
+    force_cpu_devices(8)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.obs.cost import collective_bytes_for_specs
+    from bigdl_tpu.parallel.layout import tp_activation_bytes
+    from bigdl_tpu.parallel.mesh_policy import mesh_and_layout
+
+    L, D, H, V, S, B = 12, 768, 12, 32768, 1024, 8
+    model = Transformer(V, hidden_size=D, num_heads=H, ffn_size=4 * D,
+                        num_layers=L, dropout=0.0, mode="lm")
+    ids = jax.ShapeDtypeStruct((1, S), jnp.int32)
+    shapes = jax.eval_shape(lambda r, x: model.init(r, x),
+                            jax.random.PRNGKey(0), ids)["params"]
+    n_params = int(sum(int(np.prod(s.shape))
+                       for s in jax.tree_util.tree_leaves(shapes)))
+
+    modes = {}
+    fallback_total = 0
+    for mode, spec in (("dp", "dp"), ("fsdp_tp", "fsdp:2,tp:4")):
+        resolved = mesh_and_layout(spec)
+        table = resolved.table_for(model)
+        audit = table.audit(shapes)
+        led = collective_bytes_for_specs(
+            shapes, table.param_specs(shapes), resolved.mesh)
+        tp = resolved.sizes.get("tp", 1)
+        modes[mode] = {
+            "parallelism": spec,
+            "mesh": {k: int(v) for k, v in resolved.sizes.items()},
+            "per_axis_bytes_per_step": {
+                k: round(v, 1)
+                for k, v in led["per_axis_bytes_per_step"].items()},
+            "tp_activation_bytes_per_step": round(tp_activation_bytes(
+                B, S, D, n_row_collectives=2 * L, tp=tp), 1),
+            "param_bytes_per_chip": round(led["param_bytes_per_chip"], 1),
+            "params_sharded": len(audit.sharded),
+            "params_replicate_allowlist": len(audit.allowlisted),
+            "params_silent_fallback": len(audit.fallback_replicated),
+        }
+        fallback_total += len(audit.fallback_replicated)
+
+    reduction = (modes["dp"]["param_bytes_per_chip"]
+                 / modes["fsdp_tp"]["param_bytes_per_chip"])
+    ok = bool(reduction >= 4.0 and fallback_total == 0)
+    print(json.dumps({
+        "metric": "multichip_layout_param_bytes_reduction",
+        "value": round(reduction, 3),
+        "unit": "x_smaller_per_chip_params_fsdp_tp_vs_dp",
+        "vs_baseline": None,
+        "model": f"transformer_{L}L_d{D}_v{V}",
+        "n_params": n_params,
+        "geometry": "8dev_dp_vs_fsdp2_tp4",
+        "global_batch": B,
+        "seq_len": S,
+        "layout_modes": modes,
+        "silent_fallback_params": fallback_total,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--real", action="store_true",
@@ -286,6 +369,12 @@ if __name__ == "__main__":
                          "(fp32/bf16/int8) on the MULTICHIP_LARGE "
                          "geometry + measured loss parity and overlap "
                          "efficiency (MULTICHIP_GRADCOMM artifact)")
+    ap.add_argument("--layout", action="store_true",
+                    help="declarative-layout ledger A/B: per-axis "
+                         "collective bytes + per-chip param bytes of "
+                         "parallelism='dp' vs 'fsdp:2,tp:4' on the 12L "
+                         "transformer bench geometry (MULTICHIP_LAYOUT "
+                         "artifact, sentinel-gated)")
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet_cifar"])
     ap.add_argument("--wire", default="auto",
@@ -313,5 +402,9 @@ if __name__ == "__main__":
         import sys
 
         sys.exit(main_grad_comm(cli_args))
+    elif cli_args.layout:
+        import sys
+
+        sys.exit(main_layout(cli_args))
     else:
         main()

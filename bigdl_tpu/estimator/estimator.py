@@ -226,7 +226,19 @@ class Estimator:
             checkpoint_trigger: Optional[Trigger] = None,
             fault_tolerance=False,
             profile_dir: Optional[str] = None) -> Dict[str, Any]:
-        """``fault_tolerance``: opt-in recovery for the whole fit — True
+        """``config["parallelism"]`` (or ``EngineConfig.parallelism`` /
+        ``BIGDL_TPU_PARALLELISM``): a declarative combo string —
+        ``"dp" | "fsdp" | "tp:8" | "dp:4,tp:2"`` — resolved against the
+        live device set into a named (data, fsdp, tp, seq) mesh and a
+        per-model :class:`~bigdl_tpu.parallel.SpecLayout` table; the fit
+        then runs GSPMD end to end (``jax.jit`` + ``NamedSharding``, XLA
+        inserts the collectives), so fsdp x tp trains models whose
+        parameters do not fit one chip with NO model-code change
+        (docs/parallelism.md §Declarative layouts).  Unset keeps the
+        classic ZeRO-1 driver with its full checkpoint/fault-tolerance
+        integration.
+
+        ``fault_tolerance``: opt-in recovery for the whole fit — True
         runs the training loop under a ``resilience.Supervisor`` with the
         engine's FailurePolicy (pass a ``FailurePolicy`` to override):
         failures that escape the driver's in-run retry are classified,
@@ -239,6 +251,27 @@ class Estimator:
         sets it fleet-wide); the profiler is closed when the fit ends,
         even mid-window."""
         ds = _to_xy(data, batch_size)
+        par = self.config.get("parallelism")
+        if par is None:
+            par = getattr(Engine.get().config, "parallelism", None)
+        if par is not None:
+            # features the layout path does not carry yet must fail
+            # LOUDLY — a fleet-wide BIGDL_TPU_PARALLELISM must never
+            # silently drop a job's explicitly requested resilience
+            unsupported = [n for n, v in (
+                ("fault_tolerance", fault_tolerance),
+                ("checkpoint_trigger", checkpoint_trigger),
+                ("profile_dir", profile_dir)) if v]
+            if unsupported:
+                raise ValueError(
+                    f"parallelism={par!r} (declarative GSPMD fit) does "
+                    f"not support {', '.join(unsupported)} yet — drop "
+                    "them or unset parallelism to use the classic "
+                    "ZeRO-1 driver (docs/parallelism.md §Declarative "
+                    "layouts)")
+            return self._fit_layout(ds, str(par), epochs, batch_size,
+                                    validation_data, validation_methods,
+                                    checkpoint_path)
         opt = Optimizer(self.model, ds, self.criterion,
                         batch_size=batch_size)
         # input-pipeline knobs ride the creator config (docs/data.md):
@@ -322,6 +355,43 @@ class Estimator:
             self._last_stats["time_lost_to_recovery_s"] = \
                 opt.metrics.counter("time_lost_to_recovery_s")
         return self._last_stats
+
+    def _fit_layout(self, ds, parallelism: str, epochs: int,
+                    batch_size: int, validation_data,
+                    validation_methods,
+                    checkpoint_path: Optional[str]) -> Dict[str, Any]:
+        """The declarative GSPMD fit: resolve the ``parallelism=`` combo
+        string into a mesh + layout and drive
+        :func:`~bigdl_tpu.parallel.fit_layout`.  Same seed + same policy
+        grammar => identical data order across policies, so "dp" and
+        "fsdp:2,tp:2" trajectories are comparable step for step."""
+        from bigdl_tpu.parallel.gspmd import fit_layout
+
+        self._trained, stats = fit_layout(
+            self.model, self.criterion, self.optim_method, ds,
+            parallelism=parallelism, batch_size=batch_size,
+            epochs=epochs, seed=int(self.config.get("seed", 42)),
+            log_every=int(self.config.get("log_every", 10)))
+        if checkpoint_path is not None:
+            # layout fits save the final weights in the durable model
+            # format (the periodic-trigger checkpointing stays a classic-
+            # driver capability for now — docs/parallelism.md)
+            self.save(checkpoint_path)
+        if validation_data is not None:
+            vds = _to_xy(validation_data, batch_size, shuffle=False)
+            methods = list(validation_methods)
+            if not methods:
+                from bigdl_tpu.optim.validation import Loss
+
+                methods = [Loss(self.criterion)]
+            res = self._trained.evaluate(vds, methods, batch_size)
+            stats["validation"] = {r.name: r.result for r in res}
+        losses = stats.pop("losses", None) or []
+        if losses:
+            stats["first_loss"] = losses[0]
+            stats["final_loss"] = losses[-1]
+        self._last_stats = stats
+        return stats
 
     # -- inference ----------------------------------------------------------
     def _loaded_forward(self):

@@ -132,6 +132,14 @@ class Model(Module):
     def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
             validation_data=None, checkpoint_path: Optional[str] = None,
             log_every: int = 10, **kw):
+        """Keras-style fit.  Notable keywords forwarded to the trainer:
+        ``seq_parallel=True`` (long-context sequence sharding on the
+        classic driver) and ``parallelism="dp"|"fsdp"|"tp:8"|"dp:4,tp:2"``
+        — the declarative GSPMD layout path (docs/parallelism.md
+        §Declarative layouts): the combo string resolves against the live
+        device set into a named (data, fsdp, tp, seq) mesh + per-model
+        SpecLayout table, so fsdp x tp trains models too big for one chip
+        with no model-code change."""
         from bigdl_tpu.keras.training import fit_module
 
         if self._compiled is None:

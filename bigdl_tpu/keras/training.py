@@ -89,7 +89,7 @@ def resolve_compile(optimizer, loss, metrics: Sequence) -> Dict[str, Any]:
 def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
                nb_epoch=10, validation_data=None, checkpoint_path=None,
                log_every=10, end_trigger=None,
-               seq_parallel=False) -> TrainedModel:
+               seq_parallel=False, parallelism=None) -> TrainedModel:
     n_inputs = len(getattr(model, "inputs", ()) or ())
     # ONE packing rule for fit/predict/evaluate: Model._pack_inputs
     pack = getattr(model, "_pack_inputs", np.asarray)
@@ -103,6 +103,45 @@ def fit_module(model, compiled: Dict[str, Any], x, y=None, batch_size=32,
             raise ValueError(
                 f"multi-input model ({n_inputs} inputs) requires labels y")
         ds = ArrayDataSet(px, None if y is None else np.asarray(y))
+    if parallelism is not None:
+        # declarative GSPMD fit (docs/parallelism.md §Declarative
+        # layouts): the combo string resolves into a (data, fsdp, tp,
+        # seq) mesh + per-model layout table; fsdp x tp trains models
+        # too big for one chip with the SAME keras code
+        if seq_parallel:
+            raise ValueError(
+                "parallelism= and seq_parallel= are exclusive: express "
+                "sequence sharding as a layout axis ('dp:2,seq:4')")
+        # what the layout path does not carry yet fails LOUDLY, never
+        # silently (a missing checkpoint discovered after a long run)
+        unsupported = [n for n, v in (
+            ("checkpoint_path", checkpoint_path),
+            ("end_trigger", end_trigger)) if v]
+        if unsupported:
+            raise ValueError(
+                f"parallelism={parallelism!r} (declarative GSPMD fit) "
+                f"does not support {', '.join(unsupported)} yet — drop "
+                "them or unset parallelism for the classic driver "
+                "(docs/parallelism.md §Declarative layouts)")
+        from bigdl_tpu.parallel.gspmd import fit_layout
+        from bigdl_tpu.utils.log import get_logger
+
+        trained, _ = fit_layout(
+            model, compiled["loss"], compiled["optimizer"], ds,
+            parallelism=str(parallelism), batch_size=batch_size,
+            epochs=nb_epoch, log_every=log_every)
+        if validation_data is not None:
+            if isinstance(validation_data, ArrayDataSet):
+                vds = validation_data
+            else:
+                vx, vy = validation_data
+                vds = ArrayDataSet(pack(vx), np.asarray(vy))
+            methods = compiled["metrics"] or [Loss(compiled["loss"])]
+            res = trained.evaluate(vds, methods, batch_size=batch_size)
+            get_logger("bigdl_tpu.keras").info(
+                "[layout %s] validation: %s", parallelism,
+                {r.name: r.result for r in res})
+        return trained
     opt = Optimizer(model, ds, compiled["loss"], batch_size=batch_size)
     opt.set_optim_method(compiled["optimizer"])
     opt.set_end_when(end_trigger or Trigger.max_epoch(nb_epoch))

@@ -350,6 +350,30 @@ def mfu(flops_per_step: float, step_time_s: float, n_devices: int,
     return achieved / peak
 
 
+def collective_bytes_for_specs(params, specs, mesh,
+                               dtype_bytes: int = 4) -> Dict[str, Any]:
+    """Per-step, per-AXIS collective bytes of a declarative layout — the
+    obs-side reader of ``parallel.layout`` PartitionSpec trees (docs/
+    parallelism.md §Declarative layouts).  Pure layout math, usable
+    before anything compiles: ``data`` carries the gradient allreduce,
+    ``fsdp`` the 2004.13336 param-gather/grad-scatter cycle, ``tp``
+    moves activations (priced separately via
+    ``parallel.layout.tp_activation_bytes``).  Also reports
+    ``param_bytes_per_chip`` — the "fits on one chip?" number fsdp x tp
+    layouts exist to shrink.  ``bench_scaling --layout`` and the
+    MULTICHIP_LAYOUT sentinel family consume exactly this dict.
+
+    NOTE: distinct from the LEGACY ``parallel.gspmd.
+    collective_bytes_for_specs`` (a flat
+    ``dp_allreduce_bytes_per_step``-keyed dict) — this one returns the
+    per-axis ``{"per_axis_bytes_per_step": ..., "param_bytes_per_chip":
+    ...}`` shape of ``parallel.layout.collective_bytes_by_axis``."""
+    from bigdl_tpu.parallel.layout import collective_bytes_by_axis
+
+    return collective_bytes_by_axis(params, specs, mesh,
+                                    dtype_bytes=dtype_bytes)
+
+
 def collective_ledger(step_engine) -> Dict[str, Any]:
     """Per-step collective-bytes ledger of a
     :class:`~bigdl_tpu.optim.train_step.ShardedParameterStep` — what

@@ -104,6 +104,27 @@ DEFAULT_HELP = {
                                "autotune cache",
     "ops.autotune_cache_misses": "kernel tile lookups that fell back to "
                                  "hand-picked defaults (no cache entry)",
+    "parallel.layout.replicated_params": "parameters the declarative "
+                                         "layout silently replicated "
+                                         "(matched no table rule / rank-"
+                                         "rejected); 0 for covered model "
+                                         "families — the paths ride the "
+                                         "flight recorder",
+    "parallel.layout.data_bytes_per_step": "analytic per-step gradient-"
+                                           "allreduce bytes over the "
+                                           "layout's data axes",
+    "parallel.layout.fsdp_bytes_per_step": "analytic per-step param-"
+                                           "gather + grad-scatter bytes "
+                                           "over the fsdp axis",
+    "parallel.layout.tp_bytes_per_step": "analytic per-step tp param-"
+                                         "side bytes (activations price "
+                                         "separately)",
+    "parallel.layout.seq_bytes_per_step": "analytic per-step seq-axis "
+                                          "param-side bytes",
+    "parallel.layout.param_bytes_per_chip": "per-chip parameter bytes "
+                                            "under the layout (the fits-"
+                                            "on-one-chip meter fsdp x tp "
+                                            "shrinks)",
     "train.achieved_flops_per_chip": "achieved FLOP/s per chip over the "
                                      "last log window",
     "train.collective_ici_bytes_per_step": "per-step ICI collective bytes "

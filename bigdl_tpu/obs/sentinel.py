@@ -71,6 +71,14 @@ _ARTIFACT_GLOBS = (
     # compression must keep paying)
     "MULTICHIP_LARGE_r[0-9]*.json",
     "MULTICHIP_GRADCOMM_r[0-9]*.json",
+    # declarative-layout ledger rounds (bench_scaling --layout): per-axis
+    # collective bytes and per-chip param bytes of the dp vs fsdp x tp
+    # layouts on the bench geometry.  Analytic (machine-independent), so
+    # bytes gate exactly lower-better; the headline per-chip param-bytes
+    # reduction rides the generic "metric" row higher-better — a layout-
+    # table change that silently re-replicates the big tensors fails
+    # bench-watch
+    "MULTICHIP_LAYOUT_r[0-9]*.json",
     # SLO burn-rate alert drills (python -m bigdl_tpu.obs.slo --bench):
     # alert latency under an injected hard violation gates lower-better —
     # a PR that silently slows burn detection fails bench-watch; the
@@ -206,6 +214,22 @@ def normalize(doc: Any, source: str) -> List[Row]:
             row.get("grad_sync_ici_bytes_per_step"), LOWER)
         add("multichip_grad_sync_dcn_bytes_per_step",
             row.get("grad_sync_dcn_bytes_per_step"), LOWER)
+    if isinstance(row.get("layout_modes"), dict):
+        # MULTICHIP_LAYOUT rounds (bench_scaling --layout): one family
+        # per (layout mode, axis) plus the per-chip param-bytes meter.
+        # All analytic ledger values — machine-independent, exact
+        for mode, rec in sorted(row["layout_modes"].items()):
+            if not isinstance(rec, dict):
+                continue
+            add(f"multichip_layout_{mode}_param_bytes_per_chip",
+                rec.get("param_bytes_per_chip"), LOWER)
+            per = rec.get("per_axis_bytes_per_step")
+            if isinstance(per, dict):
+                for axis, v in sorted(per.items()):
+                    add(f"multichip_layout_{mode}_{axis}_bytes_per_step",
+                        v, LOWER)
+            add(f"multichip_layout_{mode}_tp_activation_bytes_per_step",
+                rec.get("tp_activation_bytes_per_step"), LOWER)
     if isinstance(row.get("modes"), dict):
         # MULTICHIP_LARGE rounds: the measured dp_resnet50_multislice
         # ZeRO-1 cycle's per-step collective bytes (fp32 baseline ~204 MB

@@ -104,6 +104,12 @@ class TrainedModel:
         from jax.flatten_util import ravel_pytree
 
         eng = self._engine
+        if not hasattr(eng, "flat_params"):
+            # layout (GSPMD) engines own their sharded placement — they
+            # re-device_put the tree under the layout's NamedShardings
+            eng.set_variables(variables)
+            self.variables = variables
+            return
         flat, _ = ravel_pytree(variables["params"])
         if flat.shape[0] != eng.n_real:
             raise ValueError(

@@ -19,6 +19,12 @@ This package adds the TPU-native axes on the same ``Mesh``:
   over the "pipe" axis, activations rotating via ``ppermute``.
 - ``moe``: mixture-of-experts with expert parallelism — capacity-bounded
   top-k dispatch, ONE ``all_to_all`` each way over the "expert" axis.
+- ``layout`` / ``mesh_policy``: the DECLARATIVE sharding layer (docs/
+  parallelism.md §Declarative layouts) — a frozen ``SpecLayout`` of
+  canonical PartitionSpecs over a named (data, fsdp, tp, seq) mesh,
+  per-model layout tables with an audited replicate fallback, and the
+  ``parallelism="dp"|"fsdp"|"tp"|"dp:4,tp:2"`` combo-string policy the
+  Estimator/Keras/serving surfaces resolve against the live device set.
 """
 
 from bigdl_tpu.parallel.ring_attention import ring_attention
@@ -35,12 +41,26 @@ from bigdl_tpu.parallel.pp import (
 from bigdl_tpu.parallel.moe import MoE, moe_apply_ep, moe_apply_local
 from bigdl_tpu.parallel.pp_train import PipelineTrainStep
 from bigdl_tpu.parallel.gspmd import (GSPMDTrainStep, build_param_specs,
-                                      tp_spec_for_path)
+                                      fit_layout, tp_spec_for_path)
+from bigdl_tpu.parallel.layout import (ModelLayout, SpecLayout,
+                                       layout_for_model, register_layout)
+from bigdl_tpu.parallel.mesh_policy import (ResolvedLayout, mesh_and_layout,
+                                            parse_parallelism,
+                                            resolve_parallelism)
 
 __all__ = [
     "GSPMDTrainStep",
     "build_param_specs",
     "tp_spec_for_path",
+    "fit_layout",
+    "SpecLayout",
+    "ModelLayout",
+    "layout_for_model",
+    "register_layout",
+    "ResolvedLayout",
+    "mesh_and_layout",
+    "parse_parallelism",
+    "resolve_parallelism",
     "ring_attention",
     "ulysses_attention",
     "ulysses_attention_sharded",

@@ -1,0 +1,231 @@
+"""``parallelism=`` policy strings -> a named layout mesh + SpecLayout.
+
+The one-API-from-laptop-to-cluster surface (BigDL 2.0's pitch, arXiv
+2204.01715): the Estimator/Keras ``parallelism=`` config key and
+``EngineConfig.parallelism`` / ``BIGDL_TPU_PARALLELISM`` all accept the
+same combo-string grammar, resolved HERE against the live device set into
+a :class:`jax.sharding.Mesh` whose axes the declarative layout tables
+(``parallel.layout``) name.
+
+Grammar (docs/parallelism.md §Declarative layouts)::
+
+    spec     := axis ("," axis)*
+    axis     := name (":" factor)?        # no factor = fill remaining
+    name     := dp|data | fsdp | tp|mp|model | sp|seq
+
+    "dp"             # pure data parallel over every device
+    "fsdp"           # fully-sharded data parallel over every device
+    "tp:8"           # 8-way tensor parallel (serving a too-big model)
+    "dp:4,tp:2"      # 4x2 data x tensor
+    "fsdp:2,tp:4"    # weight-update sharding x tensor parallel
+    "dp:2,fsdp:2,tp:2"
+
+Errors are early and name everything: an unknown axis lists the valid
+axis names; an over-subscribed product lists the LIVE device count — the
+parser fails, not mesh construction three layers down.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from jax.sharding import Mesh
+
+from bigdl_tpu.parallel.layout import (
+    AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TP, LAYOUT_AXES, ModelLayout,
+    SpecLayout, layout_for_model)
+from bigdl_tpu.utils.log import get_logger
+
+log = get_logger("bigdl_tpu.parallel.mesh_policy")
+
+# accepted spellings -> canonical axis name
+AXIS_ALIASES: Dict[str, str] = {
+    "dp": AXIS_DATA, "data": AXIS_DATA,
+    "fsdp": AXIS_FSDP,
+    "tp": AXIS_TP, "mp": AXIS_TP, "model": AXIS_TP,
+    "sp": AXIS_SEQ, "seq": AXIS_SEQ,
+}
+
+_FILL = -1  # "no factor": absorb the remaining devices
+
+
+def _valid_axes() -> str:
+    return ("dp/data, fsdp, tp (aliases mp/model), seq (alias sp)")
+
+
+def parse_parallelism(spec: str) -> Dict[str, int]:
+    """Combo string -> {canonical axis: factor}, with ``-1`` marking the
+    single fill axis.  Pure syntax — device-count checks live in
+    :func:`resolve_parallelism`."""
+    if not isinstance(spec, str) or not spec.strip():
+        raise ValueError(
+            f"parallelism spec must be a non-empty string like 'dp' or "
+            f"'dp:4,tp:2', got {spec!r}")
+    out: Dict[str, int] = {}
+    fill_axis = None
+    for token in spec.split(","):
+        token = token.strip().lower()
+        if not token:
+            raise ValueError(
+                f"parallelism {spec!r}: empty axis token (stray comma?)")
+        name, _, factor = token.partition(":")
+        axis = AXIS_ALIASES.get(name.strip())
+        if axis is None:
+            raise ValueError(
+                f"parallelism {spec!r}: unknown axis {name.strip()!r} — "
+                f"valid axes: {_valid_axes()}")
+        if axis in out:
+            raise ValueError(
+                f"parallelism {spec!r}: axis {axis!r} given twice")
+        if factor:
+            try:
+                f = int(factor)
+            except ValueError:
+                raise ValueError(
+                    f"parallelism {spec!r}: factor {factor!r} for axis "
+                    f"{axis!r} is not an integer") from None
+            if f < 1:
+                raise ValueError(
+                    f"parallelism {spec!r}: factor {f} for axis {axis!r} "
+                    "must be >= 1")
+            out[axis] = f
+        else:
+            if fill_axis is not None:
+                raise ValueError(
+                    f"parallelism {spec!r}: only one axis may omit its "
+                    f"factor (both {fill_axis!r} and {axis!r} did)")
+            fill_axis = axis
+            out[axis] = _FILL
+    return out
+
+
+def resolve_parallelism(spec: str, n_devices: int) -> Dict[str, int]:
+    """Concrete {axis: size} for all four layout axes against the LIVE
+    device count: the fill axis absorbs the remainder; explicit factors
+    whose product exceeds ``n_devices`` fail here with the device count
+    in the message (not deep inside mesh construction)."""
+    parsed = parse_parallelism(spec)
+    explicit = int(np.prod([f for f in parsed.values() if f != _FILL])) \
+        if parsed else 1
+    if explicit > n_devices:
+        named = ",".join(f"{a}:{f}" for a, f in parsed.items()
+                         if f != _FILL)
+        raise ValueError(
+            f"parallelism {spec!r} over-subscribes the device set: "
+            f"{named} needs {explicit} devices but only {n_devices} are "
+            f"live (valid axes: {_valid_axes()})")
+    sizes = {a: 1 for a in LAYOUT_AXES}
+    fill = None
+    for a, f in parsed.items():
+        if f == _FILL:
+            fill = a
+        else:
+            sizes[a] = f
+    if fill is not None:
+        if n_devices % explicit != 0:
+            raise ValueError(
+                f"parallelism {spec!r}: {n_devices} devices not divisible "
+                f"by the explicit factors' product {explicit}, so the "
+                f"fill axis {fill!r} has no integer size")
+        sizes[fill] = n_devices // explicit
+    elif explicit < n_devices:
+        # a fully-explicit spec may deliberately use a sub-mesh (serving
+        # often wants exactly tp:N), but idle chips must be VISIBLE —
+        # append ",dp" to absorb the remainder into data parallelism
+        log.warning(
+            "parallelism %r uses %d of %d live devices; %d device(s) "
+            "stay idle (leave one axis unfactored to absorb the "
+            "remainder)", spec, explicit, n_devices,
+            n_devices - explicit)
+    return sizes
+
+
+def build_layout_mesh(sizes: Dict[str, int],
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh over the layout axes, ordered (data, fsdp, seq, tp) outer to
+    inner — tp's per-layer activation collectives ride the most-adjacent
+    chips, fsdp's per-step param gathers next, data's once-per-step
+    gradient sync outermost (the same traffic-intensity ordering as
+    ``runtime.mesh.build_mesh``)."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    order = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TP)
+    shape = tuple(int(sizes.get(a, 1)) for a in order)
+    total = int(np.prod(shape))
+    if total > len(devices):
+        raise ValueError(
+            f"layout mesh {dict(zip(order, shape))} needs {total} devices, "
+            f"{len(devices)} live")
+    devices = devices[:total]
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, order)
+
+
+@dataclass(frozen=True)
+class ResolvedLayout:
+    """A ``parallelism=`` policy resolved against a device set: the mesh,
+    the axis sizes, and the SpecLayout the tables consume.  This is the
+    object that travels — Estimator fit, ``GSPMDTrainStep``,
+    ``InferenceModel``/decode adapters all take one."""
+
+    parallelism: str
+    mesh: Mesh
+    spec_layout: SpecLayout
+    sizes: Dict[str, int]
+
+    def table_for(self, model) -> ModelLayout:
+        return layout_for_model(model, self.spec_layout)
+
+    def shard_params(self, model, params):
+        """Place a parameter tree as ``NamedSharding``s per the model's
+        layout table (the serving-side entry: a checkpoint too big for
+        one chip loads sharded).  Audited — silent replication exports
+        the ``parallel.layout.replicated_params`` gauge + flight line."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        table = self.table_for(model)
+        table.audit(params).export()
+        specs = table.param_specs(params)
+        return jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(
+                jnp.asarray(x), NamedSharding(self.mesh, sp)),
+            params, specs)
+
+    @property
+    def n_batch_shards(self) -> int:
+        """Product of the data-parallel axes — what the global batch must
+        divide by (data x fsdp)."""
+        return int(self.sizes.get(AXIS_DATA, 1)
+                   * self.sizes.get(AXIS_FSDP, 1))
+
+    @property
+    def model_sharded(self) -> bool:
+        """True when parameters are actually split across chips (tp or
+        fsdp > 1) — the too-big-for-one-chip regime."""
+        return (self.sizes.get(AXIS_TP, 1) > 1
+                or self.sizes.get(AXIS_FSDP, 1) > 1)
+
+    def describe(self) -> str:
+        live = {a: n for a, n in self.sizes.items() if n > 1}
+        return f"{self.parallelism!r} -> {live or {AXIS_DATA: 1}}"
+
+
+def mesh_and_layout(parallelism: str,
+                    devices: Optional[Sequence] = None) -> ResolvedLayout:
+    """THE entry point: combo string + live devices -> ResolvedLayout."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = resolve_parallelism(parallelism, len(devices))
+    mesh = build_layout_mesh(sizes, devices)
+    return ResolvedLayout(parallelism=parallelism, mesh=mesh,
+                          spec_layout=SpecLayout(), sizes=sizes)

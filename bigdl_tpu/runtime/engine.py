@@ -94,6 +94,14 @@ class EngineConfig:
     # None = one monolithic transfer.  BIGDL_TPU_COMM_BUCKET_BYTES
     # overrides fleet-wide.
     comm_bucket_bytes: Optional[int] = None
+    # declarative parallelism policy (docs/parallelism.md §Declarative
+    # layouts): a combo string like "dp" | "fsdp" | "tp:8" | "dp:4,tp:2"
+    # resolved against the live device set into a named (data, fsdp, tp,
+    # seq) mesh + per-model SpecLayout.  The Estimator/Keras
+    # "parallelism" config key overrides per run; BIGDL_TPU_PARALLELISM
+    # overrides fleet-wide.  None keeps the classic ZeRO-1 data-parallel
+    # driver.
+    parallelism: Optional[str] = None
     # kernel tile autotuning (docs/performance.md §Kernel autotuning):
     # "off" = hand-picked defaults only, "cache" = consult the on-disk
     # winner cache (default; never measures), "online" = measure-and-
@@ -168,6 +176,12 @@ class EngineConfig:
             cfg.slo_specs = os.environ["BIGDL_TPU_SLO_SPECS"]
         if os.environ.get("BIGDL_TPU_DATA_WORKERS"):
             cfg.data_workers = int(os.environ["BIGDL_TPU_DATA_WORKERS"])
+        if os.environ.get("BIGDL_TPU_PARALLELISM"):
+            # validated lazily at resolve time (the live device count is
+            # not known until the backend initializes); bad axis names
+            # still fail fast there with the full grammar in the message
+            cfg.parallelism = \
+                os.environ["BIGDL_TPU_PARALLELISM"].strip().lower()
         if os.environ.get("BIGDL_TPU_GRAD_COMM"):
             cfg.grad_comm = os.environ["BIGDL_TPU_GRAD_COMM"].strip().lower()
         if os.environ.get("BIGDL_TPU_COMM_BUCKET_BYTES"):

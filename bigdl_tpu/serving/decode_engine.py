@@ -291,7 +291,22 @@ class _AdapterBase:
     a contiguous cache — identical values at every unmasked position,
     so the outputs agree bitwise (see the module docstring)."""
 
-    def __init__(self, model, params):
+    def __init__(self, model, params, layout=None):
+        """``layout``: serve the checkpoint MODEL-SHARDED — a
+        ``parallelism=`` combo string ("tp:8") or a resolved
+        :class:`~bigdl_tpu.parallel.ResolvedLayout`; every parameter is
+        placed as a ``NamedSharding`` per the model's layout table
+        (docs/parallelism.md §Declarative layouts) and the engine's
+        jitted programs partition under GSPMD.  The closed compile set
+        (cache buckets x prefill/decode programs) is unchanged."""
+        self.layout = None
+        if layout is not None:
+            from bigdl_tpu.parallel.mesh_policy import (ResolvedLayout,
+                                                        mesh_and_layout)
+
+            self.layout = (layout if isinstance(layout, ResolvedLayout)
+                           else mesh_and_layout(str(layout)))
+            params = self.layout.shard_params(model, params)
         self.model = model
         self.params = params
 
@@ -337,10 +352,10 @@ class LMAdapter(_AdapterBase):
     """Causal LM (``Transformer(mode="lm")``): the prompt prefills the
     self-attention cache; generation continues from its last token."""
 
-    def __init__(self, model, params, cap: int):
+    def __init__(self, model, params, cap: int, layout=None):
         if model.mode != "lm":
             raise ValueError("LMAdapter needs a Transformer(mode='lm')")
-        super().__init__(model, params)
+        super().__init__(model, params, layout=layout)
         layer = model.decoder[0].attn
         self.num_heads = layer.num_heads
         self.head_dim = layer.head_dim
@@ -408,11 +423,12 @@ class Seq2SeqAdapter(_AdapterBase):
     context (masked to the true source length)."""
 
     def __init__(self, model, params, cap: int, bos_id: int,
-                 src_buckets: Sequence[int] = (8, 16, 32, 64)):
+                 src_buckets: Sequence[int] = (8, 16, 32, 64),
+                 layout=None):
         if model.mode != "translation":
             raise ValueError("Seq2SeqAdapter needs a translation-mode "
                              "Transformer")
-        super().__init__(model, params)
+        super().__init__(model, params, layout=layout)
         layer = model.decoder[0].self_attn
         self.num_heads = layer.num_heads
         self.head_dim = layer.head_dim

@@ -24,13 +24,34 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+
+
 class InferenceModel:
     """Wraps (model, variables) — or any callable — for concurrent serving."""
 
     def __init__(self, model=None, variables: Optional[Dict] = None,
                  predict_fn: Optional[Callable] = None,
                  batch_buckets: Sequence[int] = (1, 4, 16, 64, 256),
-                 decode=None):
+                 decode=None, layout=None):
+        """``layout``: serve MODEL-SHARDED (docs/parallelism.md
+        §Declarative layouts) — a ``parallelism=`` combo string
+        (``"tp:8"``, ``"fsdp:2,tp:4"``) or an already-resolved
+        :class:`~bigdl_tpu.parallel.ResolvedLayout`.  The per-model
+        layout table places every parameter as a ``NamedSharding`` over
+        the named mesh, so a checkpoint too big for one chip serves with
+        XLA inserting the collectives; :meth:`warmup`'s closed compile
+        set (one program per bucket + the decode engine's cache buckets)
+        is unchanged — a mixed-size sweep still runs zero unexpected
+        recompiles.  The layout is audited at load: silently replicated
+        params export ``parallel.layout.replicated_params`` + a flight
+        line."""
+        self.layout = None
+        if layout is not None:
+            from bigdl_tpu.parallel.mesh_policy import (ResolvedLayout,
+                                                        mesh_and_layout)
+
+            self.layout = (layout if isinstance(layout, ResolvedLayout)
+                           else mesh_and_layout(str(layout)))
         if predict_fn is None:
             if model is None or variables is None:
                 raise ValueError("need (model, variables) or predict_fn")
@@ -42,8 +63,14 @@ class InferenceModel:
             self._jit = jax.jit(raw)
             self._params = variables.get("params", {})
             self._state = variables.get("state", {})
+            if self.layout is not None:
+                self._params = self.layout.shard_params(model,
+                                                        self._params)
             self._custom = None
         else:
+            if self.layout is not None:
+                raise ValueError("layout= applies to (model, variables) "
+                                 "serving, not a custom predict_fn")
             self._custom = predict_fn
         self.buckets = tuple(sorted(batch_buckets))
         # autoregressive decode path (docs/serving.md §Autoregressive

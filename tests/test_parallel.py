@@ -214,16 +214,19 @@ def test_gspmd_auto_partitions_encoder_decoder_transformer():
 
     class Wrapper:
         """Adapt (src, tgt) multi-input + 3-D logits to the step's
-        (x, y) shape: inputs ride as a tuple, logits flatten to (N, V)."""
+        (x, y) shape: a tuple batch unpacks into forward's positional
+        inputs (the framework-wide multi-input convention), logits
+        flatten to (N, V)."""
 
         def __init__(self, m):
             self.m = m
 
-        def init(self, rng, xs):
-            return self.m.init(rng, xs[0], xs[1])
+        def init(self, rng, src, tgt):
+            return self.m.init(rng, src, tgt)
 
-        def forward(self, params, state, xs, training=False, rng=None):
-            logits, st = self.m.forward(params, state, xs[0], xs[1],
+        def forward(self, params, state, src, tgt, training=False,
+                    rng=None):
+            logits, st = self.m.forward(params, state, src, tgt,
                                         training=training, rng=rng)
             return logits.reshape(-1, vocab), st
 
