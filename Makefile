@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-slo test-data test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-slo dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-slo dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -110,6 +110,13 @@ bench-watch:
 test-data:
 	python -m pytest tests/test_pipeline_stream.py tests/test_records.py \
 	  tests/test_native_vision.py -q
+
+# multi-host sharded ingest (docs/data.md §Multi-host ingest): 2-host
+# feed parity (no dup/no loss, byte-identical reconstruction), restart-
+# mid-epoch determinism across a process-count change, double-buffered
+# dispatch overlap, worker autosizing, measured-window stage rates
+test-ingest:
+	python -m pytest tests/test_ingest_multihost.py -q
 
 # fused multi-step execution (docs/performance.md): K-vs-1 byte-identical
 # trajectories (incl. remainder bundles + on/off-grid resume), poisoned-

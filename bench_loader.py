@@ -73,6 +73,8 @@ def measure_pipeline(batch: int = 768, n_records: int = 1536,
         ds.close()
 
         # pipelined: stage-parallel with ring assembly
+        from bigdl_tpu.data.pipeline import autotune_workers
+
         ds = make_ds()
         rates = {}
         n_img = 0
@@ -86,11 +88,47 @@ def measure_pipeline(batch: int = 768, n_records: int = 1536,
                                    metrics=global_metrics())
             for mb in sp:
                 n_img += len(mb["input"])
-            rates = sp.stage_rates() or rates
+            new = sp.stage_rates()
+            rates = new if new.get("read_batches") else rates
         dt = time.perf_counter() - t0
         out["pipeline_img_per_sec"] = round(n_img / dt, 1)
+        out["pipeline_workers"] = workers or autotune_workers()
+        # per-stage counts + busy seconds + window so the rates are
+        # auditable (r06's read_batches_per_s=102595.69 divided 4 batches
+        # by a near-zero busy interval; these are measured-window rates)
         out["pipeline_stage_rates"] = {
-            k: round(v, 2) for k, v in rates.items()}
+            k: round(v, 6) for k, v in rates.items()}
+        ds.close()
+
+        # pipelined + device dispatch: the optimizer-side path through the
+        # double-buffered transfer window.  On the CPU backend the
+        # "transfer" is the detach copy, but the window bookkeeping — and
+        # the overlap counter the smoke gates on — is identical to the
+        # accelerator path.
+        import jax
+
+        from bigdl_tpu.data.pipeline import dispatch_to_device
+
+        ds = make_ds()
+        m = global_metrics()
+        # the registry is process-global and cumulative: gate on the
+        # DELTA so a smoke re-measure can't pass from a prior run's
+        # counts
+        base = m.snapshot()["counters"].get(
+            "data.dispatch_overlapped_total", 0)
+        sp = ds.stream_batches(batch, shuffle=True, seed=seed, epoch=0,
+                               workers=workers, metrics=m)
+        n_img = 0
+        t0 = time.perf_counter()
+        for dev in dispatch_to_device(
+                sp, lambda mb: (jax.device_put(mb["input"]),
+                                jax.device_put(mb["target"])),
+                metrics=m):
+            n_img += int(dev[0].shape[0])
+        dt = time.perf_counter() - t0
+        out["dispatch_img_per_sec"] = round(n_img / dt, 1)
+        out["dispatch_overlapped_total"] = m.snapshot()["counters"].get(
+            "data.dispatch_overlapped_total", 0) - base
         ds.close()
 
     snap = global_metrics().snapshot()
@@ -235,22 +273,41 @@ def measure_loader(batch: int = 768, n_batches: int = 4,
 
 
 def smoke() -> int:
-    """Seconds-scale pipeline sanity for CI: tiny geometry through both
-    the serial and streaming end-to-end paths, hard-failing on crashes,
-    hangs (the CI step timeout), and silently empty runs.  It is a
-    BREAKAGE gate, not a perf gate — at smoke geometry stage-threading
-    overhead dominates, so throughput ratios are meaningless here; the
-    per-round full-geometry run (``BENCH_loader_r*.json``) is where
-    regressions in img/s show up.  Returns a process exit code."""
-    r = measure_pipeline(batch=64, n_records=256, epochs=1, src_hw=64,
-                         out_hw=48, workers=2)
+    """Seconds-scale pipeline sanity for CI: a small (but not trivial)
+    geometry through the serial, streaming, and dispatch end-to-end
+    paths, hard-failing on crashes, hangs (the CI step timeout), silently
+    empty runs, a pipeline that lost to the serial stages, or a dispatch
+    double buffer that never overlapped a transfer.  The geometry is
+    sized so decode work dominates stage-threading overhead (the old
+    64x64 smoke was too small to gate the ratio on); the per-round
+    full-geometry run (``BENCH_loader_r*.json``) still tracks absolute
+    img/s via the sentinel.  Returns a process exit code."""
+    geo = dict(batch=384, n_records=768, epochs=1, src_hw=256, out_hw=224)
+    r = measure_pipeline(**geo)
+    if r.get("pipeline_img_per_sec", 0) < r.get("serial_e2e_img_per_sec",
+                                                0):
+        # one re-measure before failing: the strict >= gate is the
+        # design claim, but a single noisy scheduler window on a small
+        # shared runner must not fail CI without a second opinion
+        r = measure_pipeline(**geo)
+        r["smoke_remeasured"] = True
     r["metric"] = "loader_pipeline_smoke"
-    ok = (r.get("pipeline_img_per_sec", 0) > 0
-          and r.get("serial_e2e_img_per_sec", 0) > 0
-          and r.get("pipeline_metrics", {}).get("data.read_batches", 0) > 0)
-    r["smoke_ok"] = ok
+    checks = {
+        "ran": (r.get("pipeline_img_per_sec", 0) > 0
+                and r.get("serial_e2e_img_per_sec", 0) > 0
+                and r.get("pipeline_metrics", {}).get(
+                    "data.read_batches", 0) > 0),
+        # stage parallelism must PAY: pipelined beats the same stages run
+        # serially in one thread, or the PR-4/PR-15 design regressed
+        "pipelined_ge_serial": (r.get("pipeline_img_per_sec", 0)
+                                >= r.get("serial_e2e_img_per_sec", 1e9)),
+        # the transfer window must actually double-buffer
+        "dispatch_overlap": r.get("dispatch_overlapped_total", 0) > 0,
+    }
+    r["smoke_checks"] = checks
+    r["smoke_ok"] = all(checks.values())
     print(json.dumps(r))
-    return 0 if ok else 1
+    return 0 if r["smoke_ok"] else 1
 
 
 def main():

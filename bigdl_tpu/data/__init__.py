@@ -11,7 +11,8 @@ from bigdl_tpu.data.records import RecordDataSet, write_records
 from bigdl_tpu.data.prefetch import prefetch_to_device, thread_prefetch
 from bigdl_tpu.data.pipeline import (
     BufferRing, PipelineError, RingBatch, SharedMemoryDecodePool,
-    StreamingPipeline, autotune_depths, dispatch_to_device,
+    StreamingPipeline, autotune_depths, autotune_workers,
+    dispatch_to_device,
 )
 from bigdl_tpu.data.segmentation import (
     rle_encode, rle_decode, rle_area, polygons_to_mask, mask_to_bbox,
@@ -24,7 +25,8 @@ __all__ = [
     "RecordDataSet", "write_records", "prefetch_to_device",
     "thread_prefetch",
     "BufferRing", "PipelineError", "RingBatch", "SharedMemoryDecodePool",
-    "StreamingPipeline", "autotune_depths", "dispatch_to_device",
+    "StreamingPipeline", "autotune_depths", "autotune_workers",
+    "dispatch_to_device",
     "Brightness", "Contrast", "Saturation", "Hue", "ColorJitter",
     "ChannelOrder", "Grayscale", "Expand", "Filler", "FixedCrop",
     "AspectScale", "RandomAspectScale", "PixelNormalizer",

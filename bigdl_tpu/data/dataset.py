@@ -239,7 +239,7 @@ class ArrayDataSet(DataSet):
 
     def steps_per_epoch(self, batch_size: int, process_count: int = 1,
                         drop_last: bool = True) -> int:
-        per_host = batch_size // process_count
+        per_host = _per_host_batch(batch_size, process_count)
         n = self.size()
         min_local = n // process_count
         max_local = min_local + (1 if n % process_count else 0)

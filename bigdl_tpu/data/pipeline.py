@@ -40,6 +40,7 @@ per-stage spans (``data/read``, ``data/decode``), and the consumer-side
 ``/metrics`` shows exactly which stage starves the device.
 """
 
+import math
 import os
 import threading
 import time
@@ -78,6 +79,20 @@ class RingBatch(MiniBatch):
         if not self._released:
             object.__setattr__(self, "_released", True)
             self._release_fn()
+
+    def defer_release(self) -> Callable[[], None]:
+        """Transfer slot-release ownership to the caller: the batch is
+        marked released — the pipeline's post-yield auto-release becomes
+        a no-op — and the underlying slot release is RETURNED instead of
+        run.  The dispatch stage needs this: with transfers in flight the
+        consumer pulls batch k+1 (which fires the auto-release for k)
+        BEFORE transfer k has been synced, so without ownership transfer
+        the slot would free mid-DMA and the no-aliasing invariant would
+        hold only on paper."""
+        if self._released:
+            return lambda: None
+        object.__setattr__(self, "_released", True)
+        return self._release_fn
 
 
 class BufferRing:
@@ -224,6 +239,43 @@ def autotune_depths(read_rate: float, decode_rate: float, workers: int,
     return {"raw_depth": raw_depth, "ring_depth": ring_depth}
 
 
+def autotune_workers(decode_rate: float = 0.0, target_rate: float = 0.0,
+                     host_cores: Optional[int] = None,
+                     reserve: int = 2) -> int:
+    """Decode-pool width from probed stage rates (docs/data.md §Multi-host
+    ingest): enough workers for the pool to match ``target_rate`` (the
+    read stage's rate, or the device's demand) at ``decode_rate`` per
+    worker, capped at the host's cores minus ``reserve`` (the read thread
+    and the driver's dispatch loop must stay responsive).  With no rates —
+    decode cost unknown before the first batch, the vision-augment case —
+    the pool takes the whole ceiling: decode is the slow stage there by
+    construction, and an idle worker just parks on the raw queue.
+
+    Replaces the fixed ``min(4|8, cores)`` caps from the 2-core bench era;
+    a TPU-VM host has O(100) cores and one chip demands 1500+ img/s.  The
+    reserve only bites once the host has cores to spare: a 2-core host
+    still gets 2 workers (the geometry BENCH_loader_r06 won on), never
+    ``cores - reserve = 0``."""
+    cores = host_cores if host_cores is not None else host_core_count()
+    ceiling = max(1, min(cores, max(2, cores - max(0, reserve))))
+    if decode_rate > 0 and target_rate > 0:
+        return max(1, min(ceiling, math.ceil(target_rate / decode_rate)))
+    return ceiling
+
+
+def host_core_count() -> int:
+    """Cores THIS process may schedule on: the affinity mask when the
+    platform exposes one (cgroup-limited containers and taskset'd jobs
+    report the quota, not the node), ``os.cpu_count()`` otherwise.
+    Sizing a decode pool from the node's 128 cores inside a 4-CPU pod
+    oversubscribes 32x — exactly what the old fixed caps accidentally
+    protected against."""
+    try:
+        return len(os.sched_getaffinity(0)) or (os.cpu_count() or 2)
+    except AttributeError:  # pragma: no cover — non-Linux platforms
+        return os.cpu_count() or 2
+
+
 def fill_pad_weights(w: np.ndarray, n_real: int, lo: int, hi: int) -> None:
     """Write rows ``[lo, hi)`` of a batch's weight vector: 1.0 for genuine
     rows, 0.0 for cyclic-pad rows at index >= ``n_real`` (the
@@ -298,9 +350,13 @@ class StreamingPipeline:
         import queue as _queue
 
         self.workers = max(1, workers if workers is not None
-                           else (os.cpu_count() or 2))
-        self.parts = max(1, parts_per_batch if parts_per_batch is not None
-                         else self.workers)
+                           else host_core_count())
+        # never more parts than rows: a pool wider than the batch would
+        # otherwise split into zero-row sub-ranges (autosized pools on
+        # many-core hosts meet small batches in tests and probes)
+        self.parts = max(1, min(rows if rows else 1,
+                                parts_per_batch if parts_per_batch
+                                is not None else self.workers))
         self.rows = rows
         self._fetch = fetch
         self._decode = decode
@@ -322,10 +378,19 @@ class StreamingPipeline:
         self._error: Optional[BaseException] = None
         self._error_lock = threading.Lock()
         self._n_planned: Optional[int] = None  # set when the plan runs dry
+        self._t0 = time.perf_counter()  # stage_rates' measured window
         self._read_s = 0.0
         self._decode_s = 0.0
         self._read_n = 0
         self._decode_n = 0
+        # backpressure accounting: time each stage spent BLOCKED on its
+        # neighbour (read waiting for a free slot / queue space = the
+        # downstream stages are the bottleneck; decode waiting for work =
+        # the read stage is) — exported as data.backpressure.* gauges so
+        # one /metrics scrape names the capping stage
+        self._read_blocked_s = 0.0
+        self._decode_starved_s = 0.0
+        self._rows_out = 0
         self._rate_lock = threading.Lock()  # decode counters are updated
         #                                     from every worker thread
         self._closed = False
@@ -362,7 +427,9 @@ class StreamingPipeline:
                 # slot FIRST: ring occupancy is the pipeline's natural
                 # backpressure, and per-slot staging buffers stay safe to
                 # reuse (nothing reads slot k's staging after it frees)
+                tb = time.perf_counter()
                 slot = self.ring.assign(seq, self.parts, self._stop)
+                self._read_blocked_s += time.perf_counter() - tb
                 if slot is None:
                     return
                 t0 = time.perf_counter()
@@ -376,6 +443,7 @@ class StreamingPipeline:
                 for p in range(self.parts):
                     job = (seq, item, raw, slot,
                            int(bounds[p]), int(bounds[p + 1]))
+                    tb = time.perf_counter()
                     while not self._stop.is_set():
                         try:
                             self._raw.put(job, timeout=0.1)
@@ -384,6 +452,7 @@ class StreamingPipeline:
                             continue
                     else:
                         return
+                    self._read_blocked_s += time.perf_counter() - tb
                 self._gauge("queue_depth.raw", self._raw.qsize())
                 self._gauge("queue_depth.ring", self.ring.depth_in_use())
                 seq += 1
@@ -397,9 +466,26 @@ class StreamingPipeline:
         import queue as _queue
 
         while not self._stop.is_set():
+            tb = time.perf_counter()
             try:
                 job = self._raw.get(timeout=0.1)
             except _queue.Empty:
+                # starvation is read's fault only while read COULD have
+                # produced: the plan still has items AND a ring slot was
+                # free.  With the ring full the raw queue is empty
+                # because the CONSUMER holds the slots, and after the
+                # plan drains idleness is just the epoch tail; a wait
+                # that ends in work (the successful-get path) is not
+                # counted either — it spans consumer-bound park time.
+                # Counting any of those would invert the documented
+                # bottleneck verdict (backpressure.decode high => read-
+                # bound) on every device-bound run; a genuinely slow
+                # read stage shows up as whole Empty timeouts here.
+                if (self._n_planned is None
+                        and self.ring.depth_in_use() < self.ring.depth):
+                    with self._rate_lock:
+                        self._decode_starved_s += (
+                            time.perf_counter() - tb)
                 continue
             seq, item, raw, slot, lo, hi = job
             try:
@@ -428,15 +514,34 @@ class StreamingPipeline:
             self._metrics.gauge(f"{self._name}.{key}", v)
 
     def stage_rates(self) -> Dict[str, float]:
-        """Measured batches/s per stage (decode aggregated over parts and
-        scaled by pool width) — what :func:`autotune_depths` and the bench
-        read."""
-        out = {}
-        if self._read_s > 0:
-            out["read_batches_per_s"] = self._read_n / self._read_s
-        if self._decode_s > 0:
-            out["decode_batches_per_s"] = (
-                self._decode_n / self.parts / self._decode_s * self.workers)
+        """Per-stage throughput over the MEASURED window plus busy-time
+        capacity — what the bench and the ``data.rate.*`` gauges read.
+
+        ``*_batches_per_s`` is count / wall since the pipeline started (in
+        steady state every stage converges on the pipeline rate);
+        ``*_capacity_batches_per_s`` is count / stage-busy-seconds — what
+        the stage COULD do if never blocked (the autotuning signal).  The
+        old keys divided counts by busy time alone, which reported
+        102595 batches/s for a 4-batch read window (BENCH_loader_r06) —
+        a rate over a near-zero interval, not a throughput.  Counts and
+        busy seconds ride along so the window is auditable."""
+        wall = max(time.perf_counter() - self._t0, 1e-9)
+        out: Dict[str, float] = {"window_s": wall}
+        if self._read_n:
+            out["read_batches"] = float(self._read_n)
+            out["read_busy_s"] = self._read_s
+            out["read_batches_per_s"] = self._read_n / wall
+            if self._read_s > 0:
+                out["read_capacity_batches_per_s"] = (
+                    self._read_n / self._read_s)
+        if self._decode_n:
+            batches = self._decode_n / self.parts
+            out["decode_batches"] = batches
+            out["decode_busy_s"] = self._decode_s
+            out["decode_batches_per_s"] = batches / wall
+            if self._decode_s > 0:
+                out["decode_capacity_batches_per_s"] = (
+                    batches / self._decode_s * self.workers)
         return out
 
     # -- consumer ----------------------------------------------------------
@@ -463,6 +568,8 @@ class StreamingPipeline:
                         {k: v for k, v in meta.items()
                          if k != "n" and isinstance(v, np.ndarray)})
                 mb = RingBatch(lambda s=slot: self.ring.release(s), **fields)
+                self._rows_out += int(meta.get("n_real", self.rows)
+                                      if meta else self.rows)
                 yield mb
                 # a consumer that moved on without releasing (it copied the
                 # data, or won't touch the arrays again) must not wedge the
@@ -470,14 +577,35 @@ class StreamingPipeline:
                 mb.release()
                 seq += 1
                 if self._metrics is not None and seq % 8 == 0:
-                    # live per-stage throughput next to the queue-depth
-                    # gauges: a scrape can see WHICH stage caps the
-                    # pipeline (the attribution layer's data component
-                    # says the run is input-bound; these say why)
-                    for rk, rv in self.stage_rates().items():
-                        self._gauge(f"rate.{rk}", rv)
+                    self._emit_gauges()
         finally:
             self.close()
+
+    def _emit_gauges(self) -> None:
+        """Live per-stage throughput next to the queue-depth gauges: a
+        scrape can see WHICH stage caps the pipeline (the attribution
+        layer's data component says the run is input-bound; these say
+        why).  Emitted every 8 batches during iteration and once more
+        from :meth:`close` after the stage threads have joined, so short
+        epochs (the full-geometry bench runs 2 batches per epoch) land
+        their gauges without racing the read thread's plan-drained
+        flag."""
+        for rk, rv in self.stage_rates().items():
+            if rk.endswith("_per_s"):
+                self._gauge(f"rate.{rk}", rv)
+        wall = max(time.perf_counter() - self._t0, 1e-9)
+        # fraction of stage wall spent blocked on a neighbour:
+        # backpressure.read high → decode/consumer is the bottleneck;
+        # backpressure.decode high → read is
+        self._gauge("backpressure.read",
+                    min(1.0, self._read_blocked_s / wall))
+        with self._rate_lock:
+            starved = self._decode_starved_s
+        self._gauge("backpressure.decode",
+                    min(1.0, starved / (wall * self.workers)))
+        # per-host shard rate: genuine (unpadded) rows this host fed per
+        # wall second — the multi-host ingest headline, one per process
+        self._gauge("rate.shard_img_per_s", self._rows_out / wall)
 
     def close(self) -> None:
         """Stop every stage thread and drop queued work.  Idempotent; also
@@ -489,6 +617,10 @@ class StreamingPipeline:
         self.ring._wake_all()
         for t in self._threads:
             t.join(timeout=5)
+        if self._metrics is not None:
+            # final gauge flush with every stage thread quiesced — the
+            # epoch's complete counters, however short the plan was
+            self._emit_gauges()
         close = getattr(self._plan, "close", None)
         if close is not None:
             try:
@@ -538,7 +670,8 @@ def bundle_batches(batches: Iterable,
 
 
 def dispatch_to_device(batches: Iterable, put: Callable[[Any], Any],
-                       size: int = 2) -> Iterator:
+                       size: int = 2, inflight: int = 2,
+                       metrics=None, name: str = "data") -> Iterator:
     """Device-feed stage: dispatch each batch onto the local devices
     (``put`` shards it — a ``jax.device_put`` under a sharding) with a
     ``size``-deep lookahead, releasing ring slots only once the device no
@@ -546,24 +679,63 @@ def dispatch_to_device(batches: Iterable, put: Callable[[Any], Any],
     this degrades to exactly
     :func:`~bigdl_tpu.data.prefetch.prefetch_to_device`.
 
+    Double-buffered transfers (docs/data.md §Multi-host ingest): up to
+    ``inflight`` host→device transfers ride concurrently.  Issuing
+    transfer ``k`` first syncs-and-releases transfer ``k - inflight + 1``
+    (at the default 2: slot ``k-1`` frees when transfer ``k`` is issued),
+    so the next decode handoff overlaps the in-flight DMA instead of
+    serializing behind an inline ``block_until_ready`` — which is exactly
+    what the pre-PR-15 code did, stalling the stream's next pull until
+    every transfer landed.  The no-aliasing invariant is unchanged: a
+    slot is released only AFTER ``jax.block_until_ready`` confirms its
+    own transfer landed.
+
     On an accelerator backend the host→device transfer is a real copy, so
     the slot frees as soon as ``jax.block_until_ready`` says the transfer
     landed.  On the CPU backend ``device_put`` ZERO-COPIES page-aligned
     host buffers (ring slots are — numpy mmaps allocations this large),
     so the "device" array may alias the slot for the whole life of the
     step; there the batch is detached with a real copy before the slot is
-    released.  Catching this aliasing is exactly why the simulated-mesh
-    tests train through this path."""
+    released (the transfer window still tracks the put for the overlap
+    accounting).  Catching this aliasing is exactly why the
+    simulated-mesh tests train through this path.
+
+    ``inflight - 1`` ring slots stay lent between puts, so ``inflight``
+    must not exceed the upstream ring depth (``BufferRing`` enforces
+    depth >= 2, which the default ``inflight=2`` always fits; a deeper
+    window needs a deeper ring or the read stage starves of slots).
+
+    ``metrics``: transfer-window observability — the
+    ``<name>.dispatch.in_flight`` gauge (window depth) and the
+    ``<name>.dispatch_overlapped_total`` counter (transfers issued while
+    a previous one was still in the window; 0 means the double buffer
+    never engaged — the regression the bench smoke gates on)."""
+    import collections
+
     import jax
 
     from bigdl_tpu.data.dataset import MiniBatch
     from bigdl_tpu.data.prefetch import prefetch_to_device
 
+    if inflight < 1:
+        raise ValueError(f"inflight must be >= 1, got {inflight}")
     cpu_backend = jax.default_backend() == "cpu"
+    pending: "collections.deque" = collections.deque()  # (dev, release)
+
+    def _drain(keep: int) -> None:
+        while len(pending) > keep:
+            dev, rel = pending.popleft()
+            # block on the TRANSFER (not the step): device_put is async,
+            # and the slot must not be refilled while DMA still reads it
+            jax.block_until_ready(dev)
+            if rel is not None:
+                rel()
+        if metrics is not None:
+            metrics.gauge(f"{name}.dispatch.in_flight", len(pending))
 
     def _put(mb):
-        rel = getattr(mb, "release", None)
-        if rel is None:
+        defer = getattr(mb, "defer_release", None)
+        if defer is None:
             return put(mb)
         if cpu_backend:
             detached = MiniBatch(
@@ -571,15 +743,31 @@ def dispatch_to_device(batches: Iterable, put: Callable[[Any], Any],
                      if isinstance(v, tuple) else np.array(v))
                  for k, v in mb.items()})
             mb.release()
-            return put(detached)
+            mb, rel = detached, None
+        else:
+            # take OWNERSHIP of the slot release: the stream's post-yield
+            # auto-release (fired when the consumer pulls batch k+1)
+            # becomes a no-op, and only _drain — after block_until_ready
+            # on THIS transfer — frees the slot
+            rel = defer()
+        if metrics is not None and pending:
+            metrics.inc(f"{name}.dispatch_overlapped_total")
         dev = put(mb)
-        # block on the TRANSFER (not the step): device_put is async, and
-        # the slot must not be refilled while DMA still reads it
-        jax.block_until_ready(dev)
-        rel()
+        pending.append((dev, rel))
+        _drain(inflight - 1)
         return dev
 
-    return prefetch_to_device(batches, _put, size=size)
+    def _run():
+        try:
+            yield from prefetch_to_device(batches, _put, size=size)
+        finally:
+            # normal exhaustion AND abandonment: the tail of the window
+            # must sync and give its slots back before the pipeline (or
+            # the next epoch's stream over the same cached ring) reuses
+            # them
+            _drain(0)
+
+    return _run()
 
 
 # ---------------------------------------------------------------------------
@@ -647,7 +835,8 @@ class SharedMemoryDecodePool:
         nbytes = int(np.prod(self.shape)) * 4
         self._shm = shared_memory.SharedMemory(create=True, size=nbytes)
         self.out = np.ndarray(self.shape, np.float32, buffer=self._shm.buf)
-        self.workers = max(1, workers or (os.cpu_count() or 2))
+        # sized to the host's SCHEDULABLE cores (affinity/cgroup-aware)
+        self.workers = max(1, workers or host_core_count())
         # never plain fork: the parent runs jax/XLA threads and pipeline
         # stage threads, and forking a multithreaded process deadlocks;
         # forkserver forks from a clean helper process instead

@@ -22,7 +22,10 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from bigdl_tpu.data.dataset import DataSet, MiniBatch, batch_index_plan
+from bigdl_tpu.data.dataset import (
+    DataSet, MiniBatch, _per_host_batch, batch_index_plan,
+    resharded_batch_index_plan,
+)
 from bigdl_tpu.utils import storage
 
 _MAGIC = b"BTRECv1\x00"
@@ -242,12 +245,11 @@ class RecordDataSet(DataSet):
         return np.ascontiguousarray(block).view(
             np.dtype(fld["dtype"])).reshape([len(raw)] + fld["shape"])
 
-    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
-                drop_last=True, process_id=0, process_count=1):
-        for sel, n_real in batch_index_plan(
-                self.size(), batch_size, shuffle=shuffle, seed=seed,
-                epoch=epoch, drop_last=drop_last, process_id=process_id,
-                process_count=process_count):
+    def _emit(self, plan):
+        """Assemble MiniBatches serially from an index plan of ``(sel,
+        n_real)`` pairs — shared by the normal and resharded epoch
+        paths."""
+        for sel, n_real in plan:
             raw = self._gather(np.asarray(sel, np.int64))
             if isinstance(self.feature, (list, tuple)):
                 x = tuple(self._decode(raw, f) for f in self.feature)
@@ -262,6 +264,47 @@ class RecordDataSet(DataSet):
                 mb["weight"] = w
             yield mb
 
+    def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
+                drop_last=True, process_id=0, process_count=1):
+        return self._emit(batch_index_plan(
+            self.size(), batch_size, shuffle=shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, process_id=process_id,
+            process_count=process_count))
+
+    def resharded_batches(self, batch_size, *, trained_batches,
+                          old_process_count, shuffle=True, seed=0, epoch=0,
+                          drop_last=True, process_id=0, process_count=1):
+        """Finish an epoch interrupted under a DIFFERENT process count
+        (docs/distributed_training.md): batches over the epoch's remaining
+        examples, re-strided over the new process set — the elastic
+        mid-epoch resume path, now available to record-backed training."""
+        return self._emit(resharded_batch_index_plan(
+            self.size(), batch_size, trained_batches=trained_batches,
+            old_process_count=old_process_count, shuffle=shuffle,
+            seed=seed, epoch=epoch, drop_last=drop_last,
+            process_id=process_id, process_count=process_count))
+
+    def _probe_rates(self, per_host, out_fields):
+        """Measure one batch's gather and field-decode cost (cached per
+        geometry — only the first epoch pays): the stage-rate inputs for
+        worker autosizing and queue-depth tuning."""
+        key = ("probe", per_host)
+        hit = self._staging_cache.get(key)
+        if hit is None:
+            import time as _time
+
+            probe_sel = np.arange(min(per_host, self.size()),
+                                  dtype=np.int64)
+            t0 = _time.perf_counter()
+            raw = self._gather(probe_sel)
+            t_read = max(_time.perf_counter() - t0, 1e-9)
+            t0 = _time.perf_counter()
+            for name in out_fields:
+                self._decode(raw, name)
+            t_dec = max(_time.perf_counter() - t0, 1e-9)
+            hit = self._staging_cache[key] = (t_read, t_dec)
+        return hit
+
     def stream_batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
                        drop_last=True, process_id=0, process_count=1,
                        workers=None, parts_per_batch=None,
@@ -270,22 +313,59 @@ class RecordDataSet(DataSet):
         mmap gather runs on a read thread into per-slot staging buffers, a
         worker pool decodes fields into a preallocated buffer ring, and
         batches come out strictly in plan order — byte-identical to
-        :meth:`batches` for any worker count.  Yields
+        :meth:`batches` for any worker count AND any ``process_id``/
+        ``process_count`` sharding (each host reads and decodes ONLY its
+        stride slice of the shared epoch permutation).  Yields
         :class:`~bigdl_tpu.data.pipeline.RingBatch` (slot views; the
         optimizer's dispatch stage releases slots after the device copy).
 
-        ``raw_depth``/``ring_depth`` default to
-        :func:`~bigdl_tpu.data.pipeline.autotune_depths` over stage rates
-        probed on the first batch.  Ring/staging buffers are cached on the
-        dataset and reused across epochs (no per-epoch reallocation), so
-        at most one stream from a given dataset may be live at a time —
-        the optimizer's one-epoch-at-a-time loop satisfies this."""
+        ``workers`` defaults to
+        :func:`~bigdl_tpu.data.pipeline.autotune_workers` over stage
+        rates probed on one real batch; ``raw_depth``/``ring_depth``
+        default to :func:`~bigdl_tpu.data.pipeline.autotune_depths` over
+        the same probe.  Ring/staging buffers are cached on the dataset
+        and reused across epochs (no per-epoch reallocation), so at most
+        one stream from a given dataset may be live at a time — the
+        optimizer's one-epoch-at-a-time loop satisfies this."""
+        per_host = _per_host_batch(batch_size, process_count)
+        plan = ((np.asarray(sel, np.int64), n_real)
+                for sel, n_real in batch_index_plan(
+                    self.size(), batch_size, shuffle=shuffle, seed=seed,
+                    epoch=epoch, drop_last=drop_last, process_id=process_id,
+                    process_count=process_count))
+        return self._stream(plan, per_host, workers, parts_per_batch,
+                            raw_depth, ring_depth, metrics)
+
+    def resharded_stream_batches(self, batch_size, *, trained_batches,
+                                 old_process_count, shuffle=True, seed=0,
+                                 epoch=0, drop_last=True, process_id=0,
+                                 process_count=1, workers=None,
+                                 parts_per_batch=None, raw_depth=None,
+                                 ring_depth=None, metrics=None):
+        """:meth:`resharded_batches` through the streaming pipeline — an
+        elastic mid-epoch resume keeps the stage-parallel feed instead of
+        dropping to the serial path for the remainder epoch.  Ownership
+        math is :func:`~bigdl_tpu.data.dataset.resharded_batch_index_plan`
+        — plan-order-deterministic across restarts from (seed, epoch,
+        old_process_count) alone."""
+        per_host = _per_host_batch(batch_size, process_count)
+        plan = ((np.asarray(sel, np.int64), n_real)
+                for sel, n_real in resharded_batch_index_plan(
+                    self.size(), batch_size,
+                    trained_batches=trained_batches,
+                    old_process_count=old_process_count, shuffle=shuffle,
+                    seed=seed, epoch=epoch, drop_last=drop_last,
+                    process_id=process_id, process_count=process_count))
+        return self._stream(plan, per_host, workers, parts_per_batch,
+                            raw_depth, ring_depth, metrics)
+
+    def _stream(self, plan, per_host, workers, parts_per_batch,
+                raw_depth, ring_depth, metrics):
         from bigdl_tpu.data.pipeline import (
-            StreamingPipeline, autotune_depths, cached_slots,
-            fill_pad_weights,
+            StreamingPipeline, autotune_depths, autotune_workers,
+            cached_slots, fill_pad_weights,
         )
 
-        per_host = batch_size // max(process_count, 1)
         rb = int(self.manifest["record_bytes"])
         used = (list(self.feature)
                 if isinstance(self.feature, (list, tuple))
@@ -298,38 +378,19 @@ class RecordDataSet(DataSet):
                                  np.dtype(fld["dtype"]))
         spec["weight"] = ((per_host,), np.float32)
 
-        plan = ((np.asarray(sel, np.int64), n_real)
-                for sel, n_real in batch_index_plan(
-                    self.size(), batch_size, shuffle=shuffle, seed=seed,
-                    epoch=epoch, drop_last=drop_last, process_id=process_id,
-                    process_count=process_count))
-
-        workers_eff = workers or max(1, min(4, (os.cpu_count() or 2)))
-        if raw_depth is None or ring_depth is None:
-            # probe stage rates on one real batch (read = gather, decode =
-            # field split+copy), then size the queues from the ratio; the
-            # measurement is cached per geometry so only the FIRST epoch
-            # pays for it
-            tune_key = (per_host, workers_eff, parts_per_batch)
-            tuned = self._staging_cache.get(("tuned", tune_key))
-            if tuned is None:
-                import time as _time
-
-                probe_sel = np.arange(min(per_host, self.size()),
-                                      dtype=np.int64)
-                t0 = _time.perf_counter()
-                raw = self._gather(probe_sel)
-                t_read = max(_time.perf_counter() - t0, 1e-9)
-                t0 = _time.perf_counter()
-                for name in out_fields:
-                    self._decode(raw, name)
-                t_dec = max(_time.perf_counter() - t0, 1e-9)
-                tuned = autotune_depths(1.0 / t_read, 1.0 / t_dec,
-                                        workers_eff,
+        if workers is None or raw_depth is None or ring_depth is None:
+            t_read, t_dec = self._probe_rates(per_host, out_fields)
+            if workers is None:
+                # enough decode workers to keep up with the (probed) read
+                # stage — field decode is a memcpy, so this is usually
+                # small; the vision adapters are where the pool widens
+                workers = autotune_workers(decode_rate=1.0 / t_dec,
+                                           target_rate=1.0 / t_read)
+            if raw_depth is None or ring_depth is None:
+                tuned = autotune_depths(1.0 / t_read, 1.0 / t_dec, workers,
                                         parts_per_batch=parts_per_batch)
-                self._staging_cache[("tuned", tune_key)] = tuned
-            raw_depth = raw_depth or tuned["raw_depth"]
-            ring_depth = ring_depth or tuned["ring_depth"]
+                raw_depth = raw_depth or tuned["raw_depth"]
+                ring_depth = ring_depth or tuned["ring_depth"]
         slots = cached_slots(self._slot_cache, spec, ring_depth)
         staging = self._staging_cache
 
@@ -365,7 +426,7 @@ class RecordDataSet(DataSet):
             return fields
 
         return StreamingPipeline(
-            plan, fetch, decode, spec, rows=per_host, workers=workers_eff,
+            plan, fetch, decode, spec, rows=per_host, workers=workers,
             parts_per_batch=parts_per_batch, raw_depth=raw_depth,
             ring_depth=ring_depth, slots=slots, finalize=finalize,
             metrics=metrics)
@@ -374,7 +435,7 @@ class RecordDataSet(DataSet):
                         drop_last: bool = True) -> int:
         import math
 
-        per_host = batch_size // process_count
+        per_host = _per_host_batch(batch_size, process_count)
         n = self.size()
         min_local = n // process_count
         max_local = min_local + (1 if n % process_count else 0)

@@ -23,7 +23,8 @@ import numpy as np
 
 from bigdl_tpu import native
 from bigdl_tpu.data.dataset import (
-    DataSet, MiniBatch, batch_index_plan,
+    DataSet, MiniBatch, _per_host_batch, batch_index_plan,
+    resharded_batch_index_plan,
 )
 from bigdl_tpu.data.transformer import Transformer
 
@@ -309,20 +310,41 @@ class ImageFrameToBatches:
 # JPEGs through the stage-parallel pipeline
 # ---------------------------------------------------------------------------
 
-def _batch_geometry(rng, n, out_hw, resize_hw, random_crop, random_flip):
-    """Per-image crops/flips for one batch, drawn in PLAN order — the
-    stream and serial paths share this, so epochs are byte-identical for 1
-    or N decode workers (and for ``batches`` vs ``stream_batches``)."""
+def _index_geometry(seed, epoch, n, out_hw, resize_hw, random_crop,
+                    random_flip):
+    """Augmentation geometry for ALL ``n`` images of one (seed, epoch),
+    keyed by DATASET INDEX: image ``i`` gets ``(cy[i], cx[i], flips[i])``
+    no matter which host decodes it, which plan order reaches it, or
+    whether the epoch resumed under a different process count.  This is
+    what makes multi-host ingest reconstructible: N hosts' sharded
+    streams concatenate byte-identically to the 1-process epoch, and a
+    restart mid-epoch (PR 7's re-sharded remainder plan) re-applies the
+    SAME crop/flip to every remaining image.  Drawn vectorized from one
+    counter-based RNG — O(n) ints per epoch, microseconds at ImageNet
+    scale."""
     oh, ow = out_hw
     rh, rw = resize_hw if resize_hw is not None else (oh, ow)
+    rng = np.random.default_rng((seed, epoch))
     if random_crop:
-        crops = [(int(rng.integers(0, max(1, rh - oh + 1))),
-                  int(rng.integers(0, max(1, rw - ow + 1))))
-                 for _ in range(n)]
+        cy = rng.integers(0, max(1, rh - oh + 1), size=n)
+        cx = rng.integers(0, max(1, rw - ow + 1), size=n)
     else:
-        crops = [(max(0, (rh - oh) // 2), max(0, (rw - ow) // 2))] * n
+        cy = np.full(n, max(0, (rh - oh) // 2), np.int64)
+        cx = np.full(n, max(0, (rw - ow) // 2), np.int64)
     flips = (rng.random(n) < 0.5) if random_flip else None
-    return crops, flips
+    return cy, cx, flips
+
+
+def _plan_with_geometry(index_plan, geometry):
+    """Attach per-image geometry to an index plan: yields ``(sel, n_real,
+    crops, flips)`` work items carrying everything decode needs, so output
+    bytes are independent of worker count and host scheduling."""
+    cy, cx, flips = geometry
+    for sel, n_real in index_plan:
+        sel = np.asarray(sel, np.int64)
+        crops = list(zip(cy[sel].tolist(), cx[sel].tolist()))
+        yield (sel, n_real, crops,
+               None if flips is None else flips[sel])
 
 
 class _ThreadLocalPipes:
@@ -391,6 +413,7 @@ class AugmentedRecordImages(DataSet):
         self.num_threads = num_threads
         self._serial_pipe = None
         self._slot_cache: dict = {}
+        self._geom_cache: dict = {}
         # direct view over the record region: the streaming decode reads
         # source pixels straight from the page cache — no gather memcpy,
         # no staging buffer (the read stage just plans; the OS does the IO
@@ -431,17 +454,40 @@ class AugmentedRecordImages(DataSet):
         np.copyto(dst[lo:hi].view(np.uint8).reshape(hi - lo, nbytes),
                   raw[lo:hi, off:off + nbytes])
 
+    def _geometry(self, seed, epoch):
+        """Per-image geometry of one (seed, epoch), cached ONE epoch deep
+        (epochs advance monotonically; the arrays are O(n) ints)."""
+        key = (seed, epoch)
+        hit = self._geom_cache.get(key)
+        if hit is None:
+            hit = _index_geometry(seed, epoch, self.size(), self.out_hw,
+                                  self.resize_hw, self.random_crop,
+                                  self.random_flip)
+            self._geom_cache = {key: hit}
+        return hit
+
     def _plan(self, batch_size, shuffle, seed, epoch, drop_last,
               process_id, process_count):
-        rng = np.random.default_rng((seed, epoch))
-        for sel, n_real in batch_index_plan(
+        return _plan_with_geometry(
+            batch_index_plan(
                 self.size(), batch_size, shuffle=shuffle, seed=seed,
                 epoch=epoch, drop_last=drop_last, process_id=process_id,
-                process_count=process_count):
-            crops, flips = _batch_geometry(
-                rng, len(sel), self.out_hw, self.resize_hw,
-                self.random_crop, self.random_flip)
-            yield (np.asarray(sel, np.int64), n_real, crops, flips)
+                process_count=process_count),
+            self._geometry(seed, epoch))
+
+    def _resharded_plan(self, batch_size, trained_batches,
+                        old_process_count, shuffle, seed, epoch, drop_last,
+                        process_id, process_count):
+        # same geometry arrays as the interrupted epoch's plan: index-
+        # keyed, so every remaining image keeps its crop/flip across the
+        # process-count change
+        return _plan_with_geometry(
+            resharded_batch_index_plan(
+                self.size(), batch_size, trained_batches=trained_batches,
+                old_process_count=old_process_count, shuffle=shuffle,
+                seed=seed, epoch=epoch, drop_last=drop_last,
+                process_id=process_id, process_count=process_count),
+            self._geometry(seed, epoch))
 
     def _label_spec(self):
         label = self.records.label
@@ -453,13 +499,26 @@ class AugmentedRecordImages(DataSet):
     # -- serial path -------------------------------------------------------
     def batches(self, batch_size, *, shuffle=True, seed=0, epoch=0,
                 drop_last=True, process_id=0, process_count=1):
+        return self._serial(self._plan(
+            batch_size, shuffle, seed, epoch, drop_last, process_id,
+            process_count))
+
+    def resharded_batches(self, batch_size, *, trained_batches,
+                          old_process_count, shuffle=True, seed=0, epoch=0,
+                          drop_last=True, process_id=0, process_count=1):
+        """Finish an epoch interrupted under a different process count —
+        the elastic resume plan with the SAME index-keyed augmentation
+        geometry the interrupted epoch used."""
+        return self._serial(self._resharded_plan(
+            batch_size, trained_batches, old_process_count, shuffle, seed,
+            epoch, drop_last, process_id, process_count))
+
+    def _serial(self, plan):
         if self._serial_pipe is None:
             self._serial_pipe = native.BatchPipeline(self.num_threads)
         pipe = self._serial_pipe
         per_host = None
-        for sel, n_real, crops, flips in self._plan(
-                batch_size, shuffle, seed, epoch, drop_last, process_id,
-                process_count):
+        for sel, n_real, crops, flips in plan:
             per_host = len(sel)
             raw = self.records._gather(sel)
             images = self._image_views(raw, 0, per_host)
@@ -485,12 +544,42 @@ class AugmentedRecordImages(DataSet):
                        drop_last=True, process_id=0, process_count=1,
                        workers=None, parts_per_batch=None,
                        raw_depth=None, ring_depth=None, metrics=None):
+        """Stage-parallel epochs, sharded per host: with ``process_id``/
+        ``process_count`` each host decodes ONLY its stride slice of the
+        shared permutation, and augmentation geometry is index-keyed
+        (:func:`_index_geometry`) so the N hosts' streams concatenate
+        byte-identically to the 1-process epoch."""
+        plan = self._plan(batch_size, shuffle, seed, epoch, drop_last,
+                          process_id, process_count)
+        return self._stream(plan, _per_host_batch(batch_size,
+                                                  process_count),
+                            workers, parts_per_batch, raw_depth,
+                            ring_depth, metrics)
+
+    def resharded_stream_batches(self, batch_size, *, trained_batches,
+                                 old_process_count, shuffle=True, seed=0,
+                                 epoch=0, drop_last=True, process_id=0,
+                                 process_count=1, workers=None,
+                                 parts_per_batch=None, raw_depth=None,
+                                 ring_depth=None, metrics=None):
+        """:meth:`resharded_batches` through the streaming pipeline — the
+        elastic mid-epoch resume stays stage-parallel, with each image's
+        geometry preserved across the process-count change."""
+        plan = self._resharded_plan(
+            batch_size, trained_batches, old_process_count, shuffle, seed,
+            epoch, drop_last, process_id, process_count)
+        return self._stream(plan, _per_host_batch(batch_size,
+                                                  process_count),
+                            workers, parts_per_batch, raw_depth,
+                            ring_depth, metrics)
+
+    def _stream(self, plan, per_host, workers, parts_per_batch, raw_depth,
+                ring_depth, metrics):
         from bigdl_tpu.data.pipeline import (
-            StreamingPipeline, autotune_depths, cached_slots,
-            fill_pad_weights,
+            StreamingPipeline, autotune_depths, autotune_workers,
+            cached_slots, fill_pad_weights,
         )
 
-        per_host = batch_size // max(process_count, 1)
         oh, ow = self.out_hw
         spec = {"input": ((per_host, oh, ow, self.channels), np.float32),
                 "weight": ((per_host,), np.float32)}
@@ -499,7 +588,11 @@ class AugmentedRecordImages(DataSet):
             dt, shape = lspec
             spec["target"] = (tuple([per_host] + shape), dt)
 
-        workers_eff = workers or max(1, min(8, (os.cpu_count() or 2)))
+        # decode (resize+crop+flip+normalize) is the slow stage by
+        # construction — the read stage only plans over the mmap — so the
+        # pool takes every core the host can spare (docs/data.md §Multi-
+        # host ingest; the old min(8, cores) cap was the 2-core bench era)
+        workers_eff = workers or autotune_workers()
         if raw_depth is None or ring_depth is None:
             tuned = autotune_depths(0, 0, workers_eff,
                                     parts_per_batch=parts_per_batch)
@@ -540,8 +633,6 @@ class AugmentedRecordImages(DataSet):
                 fields["weight"] = buffers["weight"]
             return fields
 
-        plan = self._plan(batch_size, shuffle, seed, epoch, drop_last,
-                          process_id, process_count)
         return StreamingPipeline(
             plan, fetch, decode, spec, rows=per_host, workers=workers_eff,
             parts_per_batch=parts_per_batch, raw_depth=raw_depth,
@@ -552,7 +643,8 @@ class AugmentedRecordImages(DataSet):
 def stream_jpeg_batches(sources, batch_size, out_hw, mean, std, *,
                         labels=None, resize_hw=None, random_crop=False,
                         random_flip=False, shuffle=False, seed=0, epoch=0,
-                        drop_last=True, workers=None, parts_per_batch=None,
+                        drop_last=True, process_id=0, process_count=1,
+                        workers=None, parts_per_batch=None,
                         use_processes: object = "auto",
                         ring_depth=None, raw_depth=None, metrics=None):
     """Stream encoded JPEGs (file paths or ``bytes``) through the
@@ -561,10 +653,16 @@ def stream_jpeg_batches(sources, batch_size, out_hw, mean, std, *,
     parallel when the native libjpeg path is available, a shared-memory
     multiprocess PIL pool otherwise (``use_processes`` True/False/"auto").
     Yields :class:`~bigdl_tpu.data.pipeline.RingBatch` with ``input`` (and
-    ``target`` when ``labels`` is given)."""
+    ``target`` when ``labels`` is given).
+
+    ``process_id``/``process_count`` shard the stream per host (docs/
+    data.md §Multi-host ingest): each process reads and decodes ONLY its
+    stride slice of the shared (seed, epoch) permutation, with
+    augmentation geometry keyed by SOURCE INDEX so the hosts' streams
+    concatenate byte-identically to the 1-process epoch."""
     from bigdl_tpu.data.pipeline import (
         SharedMemoryDecodePool, StreamingPipeline, autotune_depths,
-        fill_pad_weights,
+        autotune_workers, fill_pad_weights,
     )
     from bigdl_tpu.native import lib as nat
 
@@ -578,12 +676,12 @@ def stream_jpeg_batches(sources, batch_size, out_hw, mean, std, *,
         # deterministic post-resize frame
         raise ValueError("stream_jpeg_batches requires resize_hw "
                          "(crop geometry is planned before decode)")
-    per_host = batch_size
+    per_host = _per_host_batch(batch_size, process_count)
     oh, ow = out_hw
     if use_processes == "auto":
         use_processes = not (nat.available() and nat.jpeg_available())
 
-    workers_eff = workers or max(1, min(4, (os.cpu_count() or 2)))
+    workers_eff = workers or autotune_workers()
     if ring_depth is None or raw_depth is None:
         tuned = autotune_depths(0, 0, workers_eff)
         ring_depth = ring_depth or tuned["ring_depth"]
@@ -599,15 +697,14 @@ def stream_jpeg_batches(sources, batch_size, out_hw, mean, std, *,
     spec = {"input": ((per_host, oh, ow, 3), np.float32),
             "weight": ((per_host,), np.float32)}
 
-    rng = np.random.default_rng((seed, epoch))
-
     def plan_gen():
-        for sel, n_real in batch_index_plan(
+        return _plan_with_geometry(
+            batch_index_plan(
                 n, batch_size, shuffle=shuffle, seed=seed, epoch=epoch,
-                drop_last=drop_last):
-            crops, flips = _batch_geometry(
-                rng, len(sel), out_hw, resize_hw, random_crop, random_flip)
-            yield (sel, n_real, crops, flips)
+                drop_last=drop_last, process_id=process_id,
+                process_count=process_count),
+            _index_geometry(seed, epoch, n, out_hw, resize_hw,
+                            random_crop, random_flip))
 
     def fetch(item, slot):
         sel = item[0]

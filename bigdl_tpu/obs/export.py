@@ -257,6 +257,45 @@ DEFAULT_HELP = {
     "cluster.host.age_s": "staleness of one host's merged metric "
                           "snapshot, by host= label — a straggler shows "
                           "up as a growing age, not a missing series",
+    # streaming input pipeline (docs/data.md §Reading the data.* metrics
+    # + §Multi-host ingest)
+    "data.read_batches": "batches fetched by the pipeline's read stage",
+    "data.decoded_images": "rows decoded into ring slots by the worker "
+                           "pool",
+    "data.ready_batches": "ring slots turned READY (all decode parts "
+                          "reported)",
+    "data.queue_depth.raw": "raw-queue occupancy in decode part-jobs "
+                            "(full = decode is the bottleneck)",
+    "data.queue_depth.ring": "buffer-ring slots not FREE (assigned, "
+                             "ready, or lent to the consumer)",
+    "data.backpressure.read": "fraction of pipeline wall the read stage "
+                              "spent blocked on a free slot or queue "
+                              "space — high means decode or the "
+                              "consumer caps the pipeline",
+    "data.backpressure.decode": "fraction of decode-pool wall spent "
+                                "starved for read work WHILE ring slots "
+                                "were free — high means the read stage "
+                                "caps the pipeline (a full ring, i.e. a "
+                                "slow consumer, does not count here)",
+    "data.dispatch.in_flight": "host-to-device transfers still unsynced "
+                               "in the dispatch double-buffer window",
+    "data.dispatch_overlapped_total": "transfers issued while a previous "
+                                      "one was still in flight — 0 "
+                                      "means the dispatch double buffer "
+                                      "never engaged",
+    "data.rate.shard_img_per_s": "genuine (unpadded) rows THIS host's "
+                                 "shard fed per wall second — the "
+                                 "per-host multi-host ingest rate",
+    "data.rate.read_batches_per_s": "read-stage batches per wall second "
+                                    "over the measured window",
+    "data.rate.decode_batches_per_s": "decoded batches per wall second "
+                                      "over the measured window",
+    "data.rate.read_capacity_batches_per_s":
+        "read-stage capacity (count / stage-busy seconds) — what the "
+        "stage could do if never blocked",
+    "data.rate.decode_capacity_batches_per_s":
+        "decode-pool capacity (count / busy seconds, scaled by pool "
+        "width) — the worker-autosizing signal",
 }
 
 

@@ -771,26 +771,57 @@ class Optimizer:
         kw = dict(shuffle=True, seed=self.seed, epoch=epoch,
                   process_id=jax.process_index(),
                   process_count=jax.process_count())
-        if reshard is not None:
-            # not streamed: the remainder plan is a one-epoch special case
-            # and the in-RAM index path costs nothing extra
-            batch_iter = self.dataset.resharded_batches(
-                self.batch_size, trained_batches=int(reshard["trained"]),
-                old_process_count=int(reshard["process_count"]), **kw)
-            skip = int(reshard.get("skip", 0) or 0)
-            if skip:
-                import itertools
 
-                batch_iter = itertools.islice(batch_iter, skip, None)
-            if self.host_prefetch:
-                batch_iter = thread_prefetch(batch_iter,
-                                             depth=self.host_prefetch)
+        def _skip_closing(inner, n):
+            # a bare islice has no close(): abandoning a RESUMED epoch
+            # (preemption, end_when, driver retry) must still shut the
+            # underlying pipeline's stage threads down, so wrap in a
+            # generator whose close propagates
+            import itertools
+
+            try:
+                yield from itertools.islice(inner, n, None)
+            finally:
+                close = getattr(inner, "close", None)
+                if close is not None:
+                    close()
+
+        def _dispatch(batch_iter):
+            # dispatch lookahead: host→device DMA double-buffers behind
+            # the running step (up to 2 transfers in flight); ring slots
+            # release only after their own transfer lands
             return dispatch_to_device(
                 batch_iter,
                 lambda mb: (step_engine.shard_batch(mb["input"]),
                             step_engine.shard_batch(
                                 np.asarray(mb["target"]))),
-                size=self.prefetch)
+                size=self.prefetch, metrics=self.metrics)
+
+        if reshard is not None:
+            rkw = dict(trained_batches=int(reshard["trained"]),
+                       old_process_count=int(reshard["process_count"]),
+                       **kw)
+            stream = (self.streaming and self.host_prefetch > 0
+                      and hasattr(self.dataset,
+                                  "resharded_stream_batches"))
+            if stream:
+                # the remainder epoch keeps the stage-parallel sharded
+                # feed: each host streams only its slice of the
+                # remaining examples (docs/data.md §Multi-host ingest)
+                batch_iter = self.dataset.resharded_stream_batches(
+                    self.batch_size,
+                    workers=getattr(engine.config, "data_workers", None),
+                    metrics=self.metrics, **rkw)
+            else:
+                batch_iter = self.dataset.resharded_batches(
+                    self.batch_size, **rkw)
+            skip = int(reshard.get("skip", 0) or 0)
+            if skip:
+                batch_iter = _skip_closing(batch_iter, skip)
+            if self.host_prefetch and not stream:
+                batch_iter = thread_prefetch(batch_iter,
+                                             depth=self.host_prefetch)
+            return _dispatch(batch_iter)
         stream = (self.streaming and self.host_prefetch > 0
                   and hasattr(self.dataset, "stream_batches"))
         if stream:
@@ -803,21 +834,7 @@ class Optimizer:
         else:
             batch_iter = self.dataset.batches(self.batch_size, **kw)
         if skip:
-            import itertools
-
-            # a bare islice has no close(): abandoning a RESUMED epoch
-            # (preemption, end_when, driver retry) must still shut the
-            # underlying pipeline's stage threads down, so wrap in a
-            # generator whose close propagates
-            def _skipped(inner=batch_iter, n=skip):
-                try:
-                    yield from itertools.islice(inner, n, None)
-                finally:
-                    close = getattr(inner, "close", None)
-                    if close is not None:
-                        close()
-
-            batch_iter = _skipped()
+            batch_iter = _skip_closing(batch_iter, skip)
         if self.host_prefetch and not stream:
             # host-side lookahead: IO/augmentation runs a thread ahead.
             # (Never stacked on the streaming path: buffering RingBatches
@@ -825,13 +842,7 @@ class Optimizer:
             # consumer; the ring provides the lookahead there.)
             batch_iter = thread_prefetch(batch_iter,
                                          depth=self.host_prefetch)
-        # dispatch lookahead: host→device DMA double-buffers behind the
-        # running step; ring slots release only after their transfer lands
-        return dispatch_to_device(
-            batch_iter,
-            lambda mb: (step_engine.shard_batch(mb["input"]),
-                        step_engine.shard_batch(np.asarray(mb["target"]))),
-            size=self.prefetch)
+        return _dispatch(batch_iter)
 
     def _traced_data(self, batch_iter):
         """The data phase under a span + timer: each ``next()`` on the
