@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-slo dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-fleet test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-fleet bench-slo dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -63,6 +63,15 @@ test-serving:
 # per-token deadline enforcement, paged flash-decode kernel parity
 test-decode:
 	python -m pytest tests/test_decode_engine.py -q
+
+# the decode-fleet suite (docs/serving.md §Decode fleet): prefix-cache
+# byte parity (cached-prefix vs cold prefill, greedy + seeded),
+# eviction-never-frees-live-pages refcounting, KV handoff wire-format
+# roundtrip + cross-engine prefill->decode parity, the KV-aware router,
+# /health decode pressure + /fleet/prefill, and the pool-proxy
+# prefill/decode split over real worker processes (streaming relay)
+test-fleet:
+	python -m pytest tests/test_fleet.py -q
 
 # the observability suite (docs/observability.md): span tracer + chrome
 # export, Prometheus exposition (+HELP lines, scrape-under-mutation),
@@ -189,6 +198,14 @@ bench-serving:
 # the DECODE_r*.json artifact source
 bench-decode:
 	python bench_serving.py --decode
+
+# disaggregated decode-fleet bench (docs/serving.md §Decode fleet):
+# mixed-geometry streaming clients against a 2-worker pool with the
+# KV-aware router + prefill/decode split; TTFT p99 gated at >= 2x
+# better than the single-host decode bench; the DECODE_POOL_r*.json
+# artifact source
+bench-fleet:
+	python bench_serving.py --fleet
 
 # session-long TPU evidence orchestrator (single instance via flock;
 # BENCH_attempts.jsonl evidence trail)

@@ -41,7 +41,18 @@ CLI::
                                              # batching + zero recompiles
     python bench_serving.py --decode         # token-level decode bench
     python bench_serving.py --decode --smoke # CI gate for the decode path
+    python bench_serving.py --fleet          # disaggregated decode fleet
+    python bench_serving.py --fleet --smoke  # CI gate for the fleet path
     python bench_serving.py --out SERVING_r08.json
+
+The ``--fleet`` mode is the DECODE_POOL_r*.json evidence source
+(docs/serving.md §Decode fleet): a ``ServingPool`` subprocess runs a
+dedicated ``role=prefill`` worker plus decode workers, the proxy's
+KV-aware router splits every streaming ``/generate`` (prompt KV pages
+cross the serialized handoff channel), and the same mixed-geometry
+streaming clients as ``--decode`` drive it — so the TTFT p99 row is
+directly comparable to the committed single-host DECODE_r* baseline,
+against which it is gated at >= 2x better.
 """
 
 import argparse
@@ -504,6 +515,10 @@ def _decode_worker_main(argv) -> int:
     client threads out over several of these."""
     host, port, threads, duration, seed = (
         argv[0], int(argv[1]), int(argv[2]), float(argv[3]), int(argv[4]))
+    # the load generator is the measuring instrument: at the default 5ms
+    # GIL switch interval its own thread scheduling shows up in the TTFT
+    # and inter-token tails it reports for the server
+    sys.setswitchinterval(0.001)
     ttfts, gaps, tokens, errors = _decode_client_threads(
         host, port, threads, duration, seed)
     print(json.dumps({"ttfts": ttfts, "gaps": gaps, "tokens": tokens,
@@ -609,6 +624,229 @@ def run_decode(clients: int, duration_s: float, out=None,
     return 0
 
 
+# ---------------------------------------------------------------------------
+# disaggregated decode-fleet bench (--fleet): the DECODE_POOL_r*.json
+# evidence source (docs/serving.md §Decode fleet)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_loader():
+    """Worker-side factory (``bench_serving:_fleet_loader`` in the worker
+    interpreter): the SAME tiny LM as the single-host decode bench, with
+    a SMALLER slot pool (5 vs 8) — on the CPU bench host the decode
+    worker is bound by token DELIVERY (callback -> handler write ->
+    relay -> client read all timeshare the cores), not step compute, so
+    each extra concurrently-streaming slot stretches the inter-token
+    tail by a whole delivery burst; 5 slots keeps the burst short while
+    the disaggregated prefill worker absorbs the long-prompt admission
+    work that would otherwise stall those bursts.  Everything else
+    (model, pages, chunking, request mix) matches DECODE_r*.json so the
+    TTFT comparison is honest — plus the fleet pieces (prefix cache;
+    the handoff path needs no config).  Installs the recompile sentinel
+    so the pool's federated /metrics carries every worker's
+    ``train_unexpected_recompiles_total``."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.obs.attr import recompile_sentinel
+    from bigdl_tpu.serving import DecodeConfig, InferenceModel
+
+    jax.config.update("jax_platforms", "cpu")
+    sent = recompile_sentinel().install()
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        num_layers=2, dropout=0.0, mode="lm")
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.arange(8, dtype=np.int32)[None])
+    slots = int(os.environ.get("BIGDL_TPU_FLEET_SLOTS", "5"))
+    im = InferenceModel(model, variables, decode=DecodeConfig(
+        slots=slots, page_size=8, pages_per_slot=16, prompt_chunk=8,
+        max_new_tokens=120, eos_id=1, prefix_cache_pages=16))
+    im.decode_engine.warmup()
+    sent.mark_steady()
+    return im
+
+
+FLEET_SERVER = textwrap.dedent("""
+    import sys
+    from bigdl_tpu.serving.pool import ServingPool
+
+    pool = ServingPool("bench_serving:_fleet_loader",
+                       workers=%(workers)d, batch_size=8,
+                       roles=%(roles)r, worker_env=%(env)r,
+                       fleet_split_min_tokens=%(split_min)d,
+                       supervise_interval_s=0.5)
+    pool.start()
+    print(f"URL={pool.url}", flush=True)
+    sys.stdin.readline()
+    pool.stop()
+""")
+
+
+class _FleetServer:
+    """The pool subprocess: proxy + role-assigned workers.  Scraping
+    (federated /metrics, /health) happens from the PARENT while the pool
+    is still up — ``scrape()`` before ``finish()``."""
+
+    def __init__(self, workers: int, roles, split_min: int = 0):
+        env = {"PYTHONPATH": os.pathsep.join(
+                   p for p in [REPO, os.environ.get("PYTHONPATH")] if p),
+               "JAX_PLATFORMS": "cpu", "BIGDL_TPU_POOL_CPU": "1"}
+        if os.environ.get("BIGDL_TPU_FLEET_SLOTS"):
+            env["BIGDL_TPU_FLEET_SLOTS"] = \
+                os.environ["BIGDL_TPU_FLEET_SLOTS"]
+        code = FLEET_SERVER % {"workers": workers, "roles": list(roles),
+                               "env": env, "split_min": split_min}
+        penv = dict(os.environ, JAX_PLATFORMS="cpu",
+                    PYTHONPATH=env["PYTHONPATH"])
+        penv.pop("XLA_FLAGS", None)
+        self.proc = subprocess.Popen([sys.executable, "-c", code],
+                                     env=penv, stdin=subprocess.PIPE,
+                                     stdout=subprocess.PIPE, text=True)
+        self.url = None
+        deadline = time.time() + 240 + 60 * workers
+        while time.time() < deadline and self.url is None:
+            line = self.proc.stdout.readline().strip()
+            if line.startswith("URL="):
+                self.url = line[4:]
+            elif not line and self.proc.poll() is not None:
+                raise RuntimeError("fleet pool died on startup")
+        if self.url is None:
+            self.proc.kill()
+            raise RuntimeError("fleet pool never printed its URL")
+        host, _, port = self.url.split("//", 1)[1].partition(":")
+        self.host, self.port = host, int(port)
+
+    def scrape(self) -> dict:
+        """Fleet-level evidence while the workers are alive: the summed
+        recompile counter from the federated exposition, KV handoff +
+        prefix-cache totals from /health, and the proxy's routing
+        counters."""
+        from urllib import request as _rq
+
+        with _rq.urlopen(self.url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        recompiles = sum(
+            int(float(line.rsplit(None, 1)[1]))
+            for line in text.splitlines()
+            if line.startswith("train_unexpected_recompiles_total"))
+        with _rq.urlopen(self.url + "/health", timeout=30) as r:
+            health = json.loads(r.read())
+        kv_exports = kv_imports = hits = misses = 0
+        for w in health.get("workers", []):
+            d = w.get("decode") or {}
+            kv_exports += int(d.get("kv_exports", 0))
+            kv_imports += int(d.get("kv_imports", 0))
+            pc = d.get("prefix_cache") or {}
+            hits += int(pc.get("hits", 0))
+            misses += int(pc.get("misses", 0))
+        return {"unexpected_recompiles": recompiles,
+                "kv_exports": kv_exports, "kv_imports": kv_imports,
+                "prefix_cache_hits": hits, "prefix_cache_misses": misses,
+                "completed_requests": int(health.get("requests", 0)),
+                **{k: health["pool"][k] for k in
+                   ("fleet_routed", "fleet_split", "stream_relays")}}
+
+    def finish(self) -> None:
+        try:
+            self.proc.stdin.close()
+            self.proc.wait(timeout=120)
+        except Exception:  # noqa: BLE001 — a hung pool must not hang CI
+            self.proc.kill()
+
+
+def run_fleet_bench(workers: int, roles, clients: int,
+                    duration_s: float, split_min: int = 0) -> dict:
+    server = _FleetServer(workers, roles, split_min=split_min)
+    try:
+        # warm phase outside the window: relay paths, handoff channel,
+        # worker handler threads, client conns
+        _decode_load(server, clients, min(0.6, duration_s))
+        ttfts, gaps, counts, wall, errors = _decode_load(
+            server, clients, duration_s)
+        if errors:
+            raise RuntimeError(f"{len(errors)} client errors: {errors[0]}")
+        fleet = server.scrape()
+    finally:
+        server.finish()
+    tokens = int(sum(counts))
+    return {
+        "engine": "decode_pool",
+        "geometry": f"decode_pool_w{workers}_c{clients}",
+        "workers": workers,
+        "roles": ",".join(roles),
+        "concurrent_clients": clients,
+        "duration_s": round(wall, 2),
+        "requests": len(ttfts),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / wall, 1),
+        "tokens_per_s_user": round(tokens / wall / clients, 2),
+        "ttft_ms_p50": round(_pct(ttfts, 0.50) * 1e3, 2),
+        "ttft_ms_p99": round(_pct(ttfts, 0.99) * 1e3, 2),
+        "inter_token_p99_ms": round(_pct(gaps, 0.99) * 1e3, 2),
+        "streaming_clients": True,
+        **fleet,
+    }
+
+
+def _single_host_ttft_baseline() -> float:
+    """The committed single-host decode TTFT p99 the fleet must halve
+    (ISSUE gate: disaggregation + capacity, not a lucky run)."""
+    try:
+        with open(os.path.join(REPO, "DECODE_r01.json")) as f:
+            return float(json.load(f)["ttft_ms_p99"])
+    except Exception:  # noqa: BLE001 — artifact not committed yet
+        return 3028.92
+
+
+def run_fleet(clients: int, duration_s: float, out=None,
+              smoke: bool = False) -> int:
+    """One fleet row: a dedicated prefill worker feeding decode workers
+    over the serialized KV-handoff channel, streaming mixed-geometry
+    clients through the pool proxy's relay.  Smoke keeps the split
+    live-or-fail gates; the full run adds the TTFT/inter-token gates
+    against the committed single-host baseline."""
+    workers, roles = 2, ("prefill", "decode")
+    # Split threshold: the handoff has a fixed cost (harvest + serialize
+    # + HTTP hop + import) that only beats local recompute past a prompt
+    # length, so the full run splits only the long tail of the mixed
+    # geometry.  Smoke forces split_min=0 — its 1.5 s window must
+    # exercise the handoff channel deterministically, not probabilistically.
+    split_min = 0 if smoke else 16
+    if smoke:
+        clients, duration_s = 6, 1.5
+    row = run_fleet_bench(workers, roles, clients, duration_s,
+                          split_min=split_min)
+    failures = []
+    if row["tokens"] <= 0:
+        failures.append("no tokens generated")
+    if row["unexpected_recompiles"] != 0:
+        failures.append(f"{row['unexpected_recompiles']} unexpected XLA "
+                        "recompiles across the fleet")
+    if row["fleet_split"] < 1 or row["kv_imports"] < 1:
+        failures.append("the prefill/decode split never happened "
+                        f"(fleet_split={row['fleet_split']}, "
+                        f"kv_imports={row['kv_imports']})")
+    if row["stream_relays"] < 1:
+        failures.append("no streams relayed through the proxy")
+    if not smoke:
+        ttft_gate = _single_host_ttft_baseline() / 2.0
+        if row["ttft_ms_p99"] > ttft_gate:
+            failures.append(f"TTFT p99 {row['ttft_ms_p99']}ms > "
+                            f"{ttft_gate:.0f}ms (2x single-host gate)")
+        if row["inter_token_p99_ms"] > 10.0:
+            failures.append(f"inter-token p99 "
+                            f"{row['inter_token_p99_ms']}ms > 10ms")
+    if out:
+        with open(out, "w") as f:
+            json.dump(row, f, indent=1)
+    print(json.dumps(row))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--decode-worker":
@@ -625,9 +863,23 @@ def main(argv=None) -> int:
     ap.add_argument("--decode", action="store_true",
                     help="token-level decode bench: continuous vs "
                          "whole-batch-restart, streaming clients")
+    ap.add_argument("--fleet", action="store_true",
+                    help="disaggregated decode-fleet bench: prefill/"
+                         "decode split over a worker pool, KV-aware "
+                         "routing, streaming relay")
     ap.add_argument("--out", default=None,
                     help="also write the artifact JSON here")
     args = ap.parse_args(argv)
+    if args.fleet:
+        if args.smoke:
+            return run_fleet(clients=6, duration_s=1.5, smoke=True)
+        out = args.out
+        if out is None and os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
+            out = os.path.join(REPO, "DECODE_POOL_r01.json")
+        # the ISSUE geometry: 24 mixed-geometry streaming clients
+        clients = 24 if args.clients == 32 else args.clients
+        return run_fleet(clients=clients, duration_s=args.duration,
+                         out=out)
     if args.decode:
         clients = args.clients
         if args.smoke:

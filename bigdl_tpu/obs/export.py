@@ -238,6 +238,29 @@ DEFAULT_HELP = {
     "serving_pool.scale_up": "autoscaler worker additions",
     "serving_pool.scale_down": "autoscaler worker removals (drained "
                                "before exit)",
+    # decode fleet (docs/serving.md §Decode fleet)
+    "serving_pool.fleet_routed": "generate requests placed by the "
+                                 "KV-aware fleet router (vs round-robin "
+                                 "fallback)",
+    "serving_pool.fleet_split": "generate requests routed through a "
+                                "dedicated prefill worker (KV handoff)",
+    "serving_pool.stream_relays": "streaming /generate token streams "
+                                  "relayed through the pool proxy",
+    "serving.fleet.prefix_cache_hits": "generate admissions that attached "
+                                       "to cached prefix KV pages",
+    "serving.fleet.prefix_cache_misses": "generate admissions with no "
+                                         "cached prefix to attach",
+    "serving.fleet.prefix_cache_evicted_pages": "prefix-cache pages "
+                                                "LRU-evicted back to the "
+                                                "engine's free pool",
+    "serving.fleet.prefix_cache_pages": "KV pages currently held by the "
+                                        "prefix cache",
+    "serving.fleet.prefix_cache_entries": "distinct token prefixes "
+                                          "currently cached",
+    "serving.fleet.kv_exports": "prefill KV handoffs exported for a "
+                                "decode worker",
+    "serving.fleet.kv_imports": "prefill KV handoffs imported from a "
+                                "prefill worker",
     # cluster control plane (docs/resilience.md §Multi-host recovery)
     "cluster.view_epoch": "current membership view epoch",
     "cluster.members": "live members in the current view",

@@ -85,6 +85,11 @@ _ARTIFACT_GLOBS = (
     # burn peak gates higher-better (the detector must keep seeing a
     # hard violation as a hard burn)
     "SLO_r[0-9]*.json",
+    # decode fleet (bench_serving --fleet): multi-worker pool serving with
+    # KV-aware routing — throughput/TTFT/inter-token gate per geometry
+    # exactly as the single-host decode rows do (the tokens_per_s
+    # normalize branch keys families by the row's geometry)
+    "DECODE_POOL_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic, collective

@@ -24,10 +24,13 @@ from bigdl_tpu.serving.seq2seq import Seq2SeqService
 from bigdl_tpu.serving.pool import ServingPool
 from bigdl_tpu.serving.decode_engine import (DecodeConfig, DecodeEngine,
                                              DecodeRequest, DecodeResult)
+from bigdl_tpu.serving.fleet import (FleetRouter, PrefixCache,
+                                     pack_handoff, unpack_handoff)
 
 __all__ = [
     "Seq2SeqService", "InferenceModel", "ServingServer", "ServingConfig",
     "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient",
     "ServingPool", "ServiceUnavailableError", "DeadlineExceededError",
     "RequestDroppedError", "DecodeConfig", "DecodeEngine",
-    "DecodeRequest", "DecodeResult"]
+    "DecodeRequest", "DecodeResult", "FleetRouter", "PrefixCache",
+    "pack_handoff", "unpack_handoff"]

@@ -52,7 +52,10 @@ class InputQueue:
         returns the generated token array.  ``gen_kwargs`` pass through
         to :meth:`~bigdl_tpu.serving.server.ServingServer.
         enqueue_generate` (max_new_tokens, temperature, top_k, top_p,
-        seed, on_token)."""
+        seed, on_token — and ``handoff``, a prefill worker's unpacked
+        KV handoff for the decode-fleet split of docs/serving.md
+        §Decode fleet, in which case ``tokens`` may be the handoff's
+        own token array)."""
         return self._server.enqueue_generate(
             np.asarray(tokens, np.int32), request_id=uri,
             deadline_s=deadline_s, model=model, **gen_kwargs)

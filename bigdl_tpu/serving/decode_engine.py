@@ -118,6 +118,13 @@ class DecodeConfig:
     # The jnp path is the byte-parity reference; the kernel path is the
     # TPU production path (allclose, not bitwise — online softmax).
     use_flash_decode: Optional[bool] = None
+    # prefix/KV-cache reuse (docs/serving.md §Decode fleet): completed
+    # cold requests DONATE their page-aligned prompt-prefix pages to a
+    # per-engine cache (up to this many pages; 0 disables) and later
+    # requests sharing the prefix attach to the cached pages instead of
+    # re-prefilling them.  Continuous mode only; cached pages are
+    # reclaimed (LRU, idle entries only) when admission runs short.
+    prefix_cache_pages: int = 0
 
     @property
     def cap(self) -> int:
@@ -169,7 +176,18 @@ class DecodeRequest:
     deadline_t: float = math.inf      # absolute; math.inf = never
     on_token: Optional[Callable[[str, int, int], None]] = None
     on_done: Optional[Callable[["DecodeRequest"], None]] = None
+    # -- fleet prefill/decode split (docs/serving.md §Decode fleet) ---------
+    # export_kv: run as a PREFILL-ONLY request (pair with
+    # max_new_tokens=1): on completion the slot's prompt KV pages are
+    # copied to host and stashed on ``kv_export`` for
+    # fleet.handoff.pack_handoff.  handoff: admit a request whose
+    # prefill ran on another worker — the unpacked handoff dict; the
+    # engine scatters the transferred pages and continues decoding from
+    # the handoff's first token, byte-identical to a local prefill.
+    export_kv: bool = False
+    handoff: Optional[dict] = None
     # -- engine-internal ----------------------------------------------------
+    kv_export: Optional[dict] = None   # filled by the export_kv path
     admit_t: float = 0.0
     seq: int = 0
     prepared: Optional[tuple] = None   # cached adapter.prepare() output
@@ -199,7 +217,8 @@ class _ActiveSeq:
     """Host-side state of one occupied slot."""
 
     __slots__ = ("req", "prompt", "ctx", "pages", "reserved",
-                 "generated", "logp", "prefill_pos",
+                 "generated", "logp", "first_logp", "prefill_pos",
+                 "shared", "shared_entry",
                  "first_token_t", "last_token_t", "max_new", "done")
 
     def __init__(self, req: DecodeRequest, prompt: np.ndarray, ctx,
@@ -207,11 +226,15 @@ class _ActiveSeq:
         self.req = req
         self.prompt = prompt
         self.ctx = ctx
-        self.pages: List[int] = []
-        self.reserved = reserved
+        self.pages: List[int] = []    # pages this slot OWNS (rows after
+        #                               any shared prefix-cache rows)
+        self.reserved = reserved      # owned pages reserved, not yet taken
         self.generated: List[int] = []
         self.logp = np.float32(0.0)
+        self.first_logp = np.float32(0.0)
         self.prefill_pos = 0          # prompt tokens consumed by prefill
+        self.shared: List[int] = []   # prefix-cache pages mapped read-only
+        self.shared_entry = None      # the cache entry holding our ref
         self.first_token_t = 0.0
         self.last_token_t = 0.0
         self.max_new = max_new
@@ -600,6 +623,16 @@ class DecodeEngine:
         self._slots: List[Optional[_ActiveSeq]] = [None] * S
         self._free_pages: List[int] = list(range(cfg.total_pages))
         self._reserved_pages = 0
+        # prefix/KV reuse (docs/serving.md §Decode fleet): pages held by
+        # the cache leave _free_pages — page accounting stays exact
+        self._prefix_cache = None
+        if cfg.prefix_cache_pages > 0 and cfg.continuous:
+            from bigdl_tpu.serving.fleet.prefix_cache import PrefixCache
+
+            self._prefix_cache = PrefixCache(
+                min(cfg.prefix_cache_pages, cfg.total_pages),
+                cfg.page_size)
+        self._import_fn: Optional[Callable] = None
         self._base_key = jax.random.PRNGKey(cfg.base_seed)
         # work queue: (deadline_t, seq, req) — the PR 8 deadline-heap
         # ordering at decode-queue granularity
@@ -628,7 +661,7 @@ class DecodeEngine:
         self._tokens_window = deque(maxlen=256)   # (t, n) for tokens/s
         self.stats = {"requests": 0, "completed": 0, "expired": 0,
                       "tokens": 0, "steps": 0, "prefill_chunks": 0,
-                      "rejected": 0}
+                      "rejected": 0, "kv_exports": 0, "kv_imports": 0}
         self.metrics.describe(
             "serving.decode.tokens_per_s",
             "generated tokens/s over the recent step window")
@@ -648,6 +681,8 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt of {len(prompt_preview)} tokens exceeds the "
                 f"cache cap {self.cfg.cap} (page_size * pages_per_slot)")
+        if req.handoff is not None or req.export_kv:
+            self._validate_fleet_request(req, prompt_preview)
         req.admit_t = time.time()
         req.rid = req.rid or f"{self.name}-{next(self._seq)}"
         with self._cv:
@@ -666,6 +701,53 @@ class DecodeEngine:
                 for p in prompts]
         return [r.wait(timeout=120.0) for r in reqs]
 
+    def submit_prefilled(self, handoff: dict, **kw) -> DecodeRequest:
+        """Admit a request whose chunked prefill ran on ANOTHER worker
+        (docs/serving.md §Decode fleet): ``handoff`` is the dict
+        ``fleet.handoff.unpack_handoff`` returns — prompt tokens, the
+        first generated token + its log-prob, and the exact float32
+        page images of the prompt's KV.  Sampling params/seed default
+        to the handoff's own (they MUST match the prefill's for the
+        parity invariant to mean anything); ``kw`` overrides ride
+        through to :class:`DecodeRequest` (max_new_tokens, rid,
+        on_token, deadline_t...)."""
+        meta = {k: handoff[k]
+                for k in ("temperature", "top_k", "top_p", "seed")
+                if k in handoff}
+        meta.update(kw)
+        req = DecodeRequest(
+            tokens=np.asarray(handoff["tokens"], np.int32),
+            handoff=handoff, **meta)
+        return self.submit(req)
+
+    def _validate_fleet_request(self, req: DecodeRequest,
+                                prompt: np.ndarray) -> None:
+        """Reject a malformed handoff/export at the door — once
+        admitted it would fail on the engine thread and take the whole
+        in-flight batch down with it."""
+        if not self.cfg.continuous:
+            raise ValueError("KV handoff/export requires continuous mode")
+        if self.adapter.ctx_specs():
+            raise ValueError(
+                "KV handoff/export supports LM adapters only (a seq2seq "
+                "'prefill' is the encoder — there are no prompt KV "
+                "pages to transfer)")
+        if req.handoff is None:
+            return
+        h = req.handoff
+        cfg, a = self.cfg, self.adapter
+        n = -(-len(prompt) // cfg.page_size)
+        want = (a.num_layers, n, a.num_heads, cfg.page_size, a.head_dim)
+        k = np.asarray(h.get("k"))
+        v = np.asarray(h.get("v"))
+        if k.shape != want or v.shape != want:
+            raise ValueError(f"handoff K/V shape {k.shape} does not "
+                             f"match engine geometry {want}")
+        toks = np.asarray(h.get("tokens"), np.int32).reshape(-1)
+        if not np.array_equal(toks, prompt):
+            raise ValueError("handoff prompt tokens do not match the "
+                             "request's tokens")
+
     def _ring_snapshot(self) -> dict:
         """The scheduling ring (slot admissions, expiries, prefill
         interleave) as one flight-dump line — a decode postmortem needs
@@ -680,6 +762,32 @@ class DecodeEngine:
 
     def active_slots(self) -> int:
         return int(self._active_mask.sum())
+
+    def decode_pressure(self) -> Dict[str, Any]:
+        """Admission-pressure snapshot for the fleet router
+        (docs/serving.md §Decode fleet): free slots, reservable pages,
+        and the prefill backlog (prefilling slots + queued requests).
+        Read from any thread — a torn read across fields only skews a
+        heuristic score, never correctness."""
+        queued = self.queue_depth()
+        slots = list(self._slots)
+        out = {
+            "total_slots": self.cfg.slots,
+            "free_slots": sum(s is None for s in slots),
+            "total_pages": self.cfg.total_pages,
+            "free_pages": max(
+                len(self._free_pages) - self._reserved_pages, 0),
+            "queued": queued,
+            "prefill_backlog": queued + sum(
+                1 for s in slots if s is not None and s.prefilling),
+            "active": int(self._active_mask.sum()),
+            # proof the physical split is live, not just configured
+            "kv_exports": self.stats["kv_exports"],
+            "kv_imports": self.stats["kv_imports"],
+        }
+        if self._prefix_cache is not None:
+            out["prefix_cache"] = self._prefix_cache.stats()
+        return out
 
     # -- lifecycle ----------------------------------------------------------
     def _ensure_thread(self) -> None:
@@ -753,6 +861,23 @@ class DecodeEngine:
             # first CALL, not jit(); results discarded, buffers donated
             # copies so live state is untouched)
             self._warm_run()
+            if not self.adapter.ctx_specs():
+                # the fleet handoff-import scatter (LM only): one fixed
+                # shape — all-dropped page ids make the warm call a
+                # no-op on the live cache
+                cfg = self.cfg
+                a = self.adapter
+                z = np.zeros((a.num_layers, cfg.pages_per_slot,
+                              a.num_heads, cfg.page_size, a.head_dim),
+                             np.float32)
+                self._kv_k, self._kv_v = self._import_write()(
+                    self._kv_k, self._kv_v,
+                    np.full((cfg.pages_per_slot,), cfg.total_pages,
+                            np.int32), z, z)
+                # ...and the export gather (same fixed index width)
+                np.asarray(self._kv_k[
+                    :, np.zeros((cfg.pages_per_slot,), np.int32)])
+                jax.block_until_ready(self._kv_k)
         return self
 
     def _warm_run(self) -> None:
@@ -933,6 +1058,25 @@ class DecodeEngine:
             self._ctx_write_fn = jax.jit(write, donate_argnums=(0,))
         return self._ctx_write_fn
 
+    def _import_write(self):
+        """Scatter a handoff's host KV page images into the pool.  The
+        host side is padded to a fixed ``pages_per_slot`` page count
+        (surplus rows carry an out-of-range page id and drop), so every
+        import — any prompt length — runs ONE compiled program: the
+        closed-compile-set discipline holds across the fleet path."""
+        if self._import_fn is None:
+            def write(kv_k, kv_v, pids, k_host, v_host):
+                # (L, P, h, page, hd) at [:, pids (PPS,)] takes the
+                # (L, PPS, h, page, hd) view the host image is shaped as
+                kv_k = kv_k.at[:, pids].set(k_host.astype(kv_k.dtype),
+                                            mode="drop")
+                kv_v = kv_v.at[:, pids].set(v_host.astype(kv_v.dtype),
+                                            mode="drop")
+                return kv_k, kv_v
+
+            self._import_fn = jax.jit(write, donate_argnums=(0, 1))
+        return self._import_fn
+
     # -- engine loop --------------------------------------------------------
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -985,10 +1129,18 @@ class DecodeEngine:
                 self._finish_expired(seq.req, now, seq=seq)
                 self._release_slot(s)
 
-    def _pages_needed(self, prompt_len: int, max_new: int) -> int:
+    def _pages_needed(self, prompt_len: int, max_new: int,
+                      start: int = 0) -> int:
+        """Worst-case page rows the slot's page table will reference.
+        ``start`` is where prefill resumes (the prefix-cache attach
+        length): chunks then run from ``start``, so the padded final
+        chunk can reach past the cold padded extent — the reservation
+        must cover it or a padded-tail scatter could pop an
+        unreserved page."""
         cfg = self.cfg
         C = cfg.prompt_chunk
-        padded_prompt = min(-(-prompt_len // C) * C, cfg.cap)
+        padded_prompt = min(start + -(-(prompt_len - start) // C) * C,
+                            cfg.cap)
         worst = min(max(padded_prompt, prompt_len + max_new), cfg.cap)
         return -(-worst // cfg.page_size)
 
@@ -1028,7 +1180,27 @@ class DecodeEngine:
                     f"prompt of {len(prompt)} tokens leaves no room to "
                     f"generate within the cache cap {cfg.cap}"))
                 continue
-            need = self._pages_needed(len(prompt), max_new)
+            cache = self._prefix_cache
+            attach = None
+            if cache is not None and req.handoff is None:
+                attach = cache.match(prompt)
+            shared = len(attach.pages) if attach is not None else 0
+            attach_len = len(attach.key) if attach is not None else 0
+            # owned pages only: the shared prefix rows are the cache's
+            need = max(self._pages_needed(len(prompt), max_new,
+                                          start=attach_len) - shared, 0)
+            short = need - (len(self._free_pages) - self._reserved_pages)
+            if short > 0 and cache is not None:
+                # out of pages: reclaim idle cached prefixes (never a
+                # page a live slot references — eviction skips entries
+                # with attached slots, and the entry being attached
+                # here is shielded)
+                freed = cache.evict(short, protect=attach)
+                if freed:
+                    self._free_pages.extend(freed)
+                    self.metrics.inc(
+                        "serving.fleet.prefix_cache_evicted_pages",
+                        len(freed))
             if len(self._free_pages) - self._reserved_pages < need:
                 # not enough reservable pages: push back and wait for a
                 # mid-flight release (ordering preserved — same key)
@@ -1047,6 +1219,23 @@ class DecodeEngine:
             self._temps[s] = np.float32(req.temperature)
             self._top_ks[s] = np.int32(req.top_k)
             self._top_ps[s] = np.float32(req.top_p)
+            if attach is not None:
+                # map the cached pages read-only into the leading page-
+                # table rows; prefill resumes at the attach boundary
+                # (strictly < len(prompt), so the first-token-selecting
+                # final chunk always runs here).  Copy-on-extend: writes
+                # only ever target rows >= len(shared)
+                cache.attach(attach)
+                seq.shared = list(attach.pages)
+                seq.shared_entry = attach
+                seq.prefill_pos = attach_len
+                self._page_table[s, :shared] = attach.pages
+                self.metrics.inc("serving.fleet.prefix_cache_hits")
+                self.events.append(("prefix_attach", req.rid, s,
+                                    attach_len))
+            elif cache is not None and req.handoff is None:
+                cache.record_miss()
+                self.metrics.inc("serving.fleet.prefix_cache_misses")
             if ctx:
                 vals = {k: v for k, v in ctx.items()}
                 self._ctx_bufs = self._ctx_write()(self._ctx_bufs,
@@ -1054,6 +1243,8 @@ class DecodeEngine:
             self.stats["requests"] += 1
             self.metrics.inc("serving.decode.requests")
             self.events.append(("admit", req.rid, s))
+            if req.handoff is not None:
+                self._import_handoff(s, seq, req)
             tr = trace.active()
             if tr is not None:
                 # submit -> slot claim: where a queued stream's time went
@@ -1070,18 +1261,36 @@ class DecodeEngine:
         reservation, so allocation can never fail mid-flight."""
         seq = self._slots[s]
         need = -(-min(upto_tokens, self.cfg.cap) // self.cfg.page_size)
-        while len(seq.pages) < need:
+        shared = len(seq.shared)   # prefix-cache rows lead the table
+        while shared + len(seq.pages) < need:
             pid = self._free_pages.pop()
             self._reserved_pages -= 1
-            self._page_table[s, len(seq.pages)] = pid
+            self._page_table[s, shared + len(seq.pages)] = pid
             seq.pages.append(pid)
 
     def _release_slot(self, s: int) -> None:
         seq = self._slots[s]
         if seq is None:
             return
-        self._free_pages.extend(seq.pages)
+        cache = self._prefix_cache
+        pages = seq.pages
+        if cache is not None and not seq.shared:
+            # donate the page-aligned PROMPT prefix of a cold request:
+            # positions < prefill_pos hold exact prompt K/V (decode
+            # writes land at >= prompt_len, padded prefill tails at
+            # >= prompt_len too), so whole covered pages are reusable
+            # byte-for-byte by any prompt sharing the prefix.  Attached
+            # requests don't donate — their prefix is already cached.
+            n = min(seq.prefill_pos, len(seq.prompt)) \
+                // self.cfg.page_size
+            if n > 0 and cache.insert(
+                    seq.prompt[:n * self.cfg.page_size], pages[:n]):
+                self.events.append(("prefix_donate", seq.req.rid, n))
+                pages = pages[n:]   # ownership moved to the cache
+        self._free_pages.extend(pages)
         self._reserved_pages -= max(seq.reserved - len(seq.pages), 0)
+        if seq.shared_entry is not None:
+            cache.detach(seq.shared_entry)
         self._slots[s] = None
         self._active_mask[s] = False
         self._lengths[s] = 0
@@ -1262,6 +1471,7 @@ class DecodeEngine:
         req = seq.req
         if not seq.generated:
             seq.first_token_t = now
+            seq.first_logp = np.float32(logp)
             self.metrics.observe("serving.decode.ttft_s",
                                  now - req.admit_t)
         seq.last_token_t = now
@@ -1287,6 +1497,10 @@ class DecodeEngine:
             logp=float(seq.logp), prompt_len=len(seq.prompt),
             ttft_s=seq.first_token_t - req.admit_t,
             finish_reason=reason)
+        if req.export_kv:
+            # harvest BEFORE the slot releases its pages: copy the
+            # prompt's KV page images to host for the fleet handoff
+            self._harvest_kv(s, seq)
         self.stats["completed"] += 1
         self.metrics.inc("serving.decode.completed")
         tr = trace.active()
@@ -1308,6 +1522,69 @@ class DecodeEngine:
                 req.on_done(req)
             except Exception:  # noqa: BLE001
                 pass
+
+    def _harvest_kv(self, s: int, seq: _ActiveSeq) -> None:
+        """Export side of the prefill/decode split: copy the pages
+        covering the prompt to host, exactly as float32.  Reads shared
+        prefix-cache rows too (read-only), so an attached prefill still
+        exports a complete image."""
+        cfg = self.cfg
+        req = seq.req
+        plen = len(seq.prompt)
+        n = -(-plen // cfg.page_size)
+        # fixed-width gather (surplus rows repeat page 0 and are sliced
+        # off on host) so every export — any prompt length — reuses ONE
+        # compiled gather: the closed-compile-set discipline again
+        pids = np.zeros((cfg.pages_per_slot,), np.int32)
+        pids[:n] = self._page_table[s, :n]
+        k = np.asarray(self._kv_k[:, pids], np.float32)[:, :n]
+        v = np.asarray(self._kv_v[:, pids], np.float32)[:, :n]
+        req.kv_export = {
+            "tokens": np.asarray(seq.prompt, np.int32),
+            "first_token": int(seq.generated[0]),
+            "first_logp": float(seq.first_logp),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "seed": int(req.seed),
+            "request_id": req.rid,
+            "k": k,
+            "v": v,
+        }
+        self.stats["kv_exports"] += 1
+        self.metrics.inc("serving.fleet.kv_exports")
+        self.events.append(("kv_export", req.rid, int(n)))
+
+    def _import_handoff(self, s: int, seq: _ActiveSeq,
+                        req: DecodeRequest) -> None:
+        """Decode side of the split: materialize pages for the prompt,
+        scatter the transferred float32 images into them, and emit the
+        prefill worker's first token.  The slot then decodes exactly as
+        if the prefill had run locally — same pages-to-positions map,
+        same bytes, same counter-based sampling keys."""
+        cfg = self.cfg
+        h = req.handoff
+        plen = len(seq.prompt)
+        n = -(-plen // cfg.page_size)
+        self._ensure_pages(s, plen)
+        pids = np.full((cfg.pages_per_slot,), cfg.total_pages, np.int32)
+        pids[:n] = self._page_table[s, :n]
+        a = self.adapter
+        shape = (a.num_layers, cfg.pages_per_slot, a.num_heads,
+                 cfg.page_size, a.head_dim)
+        k_host = np.zeros(shape, np.float32)
+        v_host = np.zeros(shape, np.float32)
+        k_host[:, :n] = np.asarray(h["k"], np.float32)
+        v_host[:, :n] = np.asarray(h["v"], np.float32)
+        self._kv_k, self._kv_v = self._import_write()(
+            self._kv_k, self._kv_v, pids, k_host, v_host)
+        seq.prefill_pos = plen
+        self._lengths[s] = plen
+        self.stats["kv_imports"] += 1
+        self.metrics.inc("serving.fleet.kv_imports")
+        self.events.append(("kv_import", req.rid, s, int(n)))
+        self._emit_token(s, seq, int(h["first_token"]),
+                         np.float32(h["first_logp"]), time.time())
 
     def _finish_error(self, req: DecodeRequest, err: Exception) -> None:
         req.error = err
@@ -1348,6 +1625,12 @@ class DecodeEngine:
                            used / cfg.total_pages)
         self.metrics.gauge("serving.decode.queue_depth",
                            self.queue_depth())
+        if self._prefix_cache is not None:
+            st = self._prefix_cache.stats()
+            self.metrics.gauge("serving.fleet.prefix_cache_pages",
+                               st["pages"])
+            self.metrics.gauge("serving.fleet.prefix_cache_entries",
+                               st["entries"])
         window = [(t, n) for t, n in self._tokens_window
                   if now - t <= 2.0]
         if len(window) >= 2:

@@ -1,0 +1,94 @@
+"""Serialized KV-page transfer — the prefill/decode split's wire format.
+
+A prefill worker runs the whole chunked prefill (selecting the first
+token during the final chunk, exactly as a local request would), then
+ships the finished pages to a decode worker as ``pack_handoff`` bytes:
+a fixed magic + length-prefixed JSON header (tokens, first token and its
+log-prob, sampling params, seed, array shape) followed by the raw
+float32 page images of K then V.
+
+The format is deliberately *exact*: ``tobytes()``/``frombuffer`` round-
+trips every float32 bit, and the first token's log-prob travels as a
+Python float (binary64 superset of the engine's float32, and JSON's
+shortest-repr round-trips binary64 exactly), so importing a handoff on
+the decode worker reproduces byte-for-byte the state the prefill worker
+would have continued from — the byte-identical-to-``static_generate``
+parity invariant survives the process boundary.  tests/test_fleet.py
+proves pack→unpack is an exact round-trip and that a cross-engine
+handoff decode matches ``static_generate``.
+
+K/V arrays are shaped ``(layers, pages, kv_heads, page_size, head_dim)``
+— the engine's page-pool layout with the page axis narrowed to the pages
+the prompt covers.  Positions in the last page at or beyond the prompt
+length carry whatever the prefill padding wrote; the decode engine
+overwrites each such position before ever attending to it (the same
+argument that makes slot reuse aliasing-free), so they need no masking
+here.
+"""
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["pack_handoff", "unpack_handoff", "HANDOFF_MAGIC"]
+
+HANDOFF_MAGIC = b"BDLFKV1\n"
+
+# header fields every handoff carries; anything else JSON-serializable
+# rides along untouched (request_id, deadline, tenant...)
+_REQUIRED = ("tokens", "first_token", "first_logp")
+
+
+def pack_handoff(h: Dict[str, Any]) -> bytes:
+    """Serialize a handoff dict (as built by the engine's ``export_kv``
+    path) to transfer bytes.  ``h["k"]``/``h["v"]`` are the float32 page
+    images; every other key must be JSON-serializable."""
+    k = np.ascontiguousarray(np.asarray(h["k"], np.float32))
+    v = np.ascontiguousarray(np.asarray(h["v"], np.float32))
+    if k.shape != v.shape or k.ndim != 5:
+        raise ValueError(f"handoff K/V must share a 5-d page-pool shape, "
+                         f"got k={k.shape} v={v.shape}")
+    header = {key: val for key, val in h.items() if key not in ("k", "v")}
+    for key in _REQUIRED:
+        if key not in header:
+            raise ValueError(f"handoff missing required field {key!r}")
+    header["tokens"] = [int(t) for t in header["tokens"]]
+    header["first_token"] = int(header["first_token"])
+    header["first_logp"] = float(header["first_logp"])
+    header["shape"] = list(k.shape)
+    header["dtype"] = "float32"
+    header["version"] = 1
+    hdr = json.dumps(header, sort_keys=True).encode()
+    return b"".join([HANDOFF_MAGIC, len(hdr).to_bytes(8, "big"), hdr,
+                     k.tobytes(), v.tobytes()])
+
+
+def unpack_handoff(data: bytes) -> Dict[str, Any]:
+    """Exact inverse of :func:`pack_handoff`."""
+    if not data.startswith(HANDOFF_MAGIC):
+        raise ValueError("not a KV handoff (bad magic)")
+    off = len(HANDOFF_MAGIC)
+    hlen = int.from_bytes(data[off:off + 8], "big")
+    off += 8
+    header = json.loads(data[off:off + hlen].decode())
+    off += hlen
+    if header.get("version") != 1:
+        raise ValueError(f"unsupported handoff version "
+                         f"{header.get('version')!r}")
+    shape = tuple(header.pop("shape"))
+    if header.pop("dtype") != "float32":
+        raise ValueError("handoff dtype must be float32")
+    nbytes = int(np.prod(shape)) * 4
+    if len(data) != off + 2 * nbytes:
+        raise ValueError(f"handoff payload truncated: expected "
+                         f"{off + 2 * nbytes} bytes, got {len(data)}")
+    k = np.frombuffer(data, np.float32, count=nbytes // 4,
+                      offset=off).reshape(shape)
+    v = np.frombuffer(data, np.float32, count=nbytes // 4,
+                      offset=off + nbytes).reshape(shape)
+    out = dict(header)
+    out["tokens"] = np.asarray(header["tokens"], np.int32)
+    out["k"] = k
+    out["v"] = v
+    return out

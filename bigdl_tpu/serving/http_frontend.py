@@ -68,6 +68,10 @@ class _Handler(BaseHTTPRequestHandler):
     # persistent client) needs 1.1 — every reply path here sets
     # Content-Length, which 1.1 requires
     protocol_version = "HTTP/1.1"
+    # token streams are many tiny writes in the server->client direction;
+    # with Nagle on, a chunk can sit in the kernel until the previous
+    # one's ACK (http.client already sets TCP_NODELAY on the other side)
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # route to our logger, not stderr
         log.debug(fmt, *args)
@@ -95,6 +99,11 @@ class _Handler(BaseHTTPRequestHandler):
             "degraded": srv.degraded,
             "queue_depth": srv._in.qsize(),
             "backlog": srv.backlog(),
+            # decode-fleet routing signals (docs/serving.md §Decode
+            # fleet): the worker's role and its engines' slot/page
+            # headroom, read by the pool proxy's FleetRouter
+            "role": getattr(srv, "role", "both"),
+            "decode": srv.decode_pressure(),
             # SLO burn-rate verdicts (docs/observability.md §SLOs & burn
             # rates): the pool autoscaler reads slo_health from here
             "slo_health": srv.slo_health(),
@@ -112,6 +121,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/generate":
             return self._generate()
+        if self.path == "/fleet/prefill":
+            return self._fleet_prefill()
         if self.path != "/predict":
             return self._json(404, {"error": f"unknown path {self.path}"})
         srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
@@ -222,6 +233,87 @@ class _Handler(BaseHTTPRequestHandler):
     def _chunk(self, data: bytes) -> None:
         self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
 
+    # -- decode fleet (docs/serving.md §Decode fleet) -----------------------
+    def _fleet_prefill(self):
+        """POST /fleet/prefill — the prefill half of a split generate.
+        Body mirrors ``/generate`` (tokens + sampling params); the reply
+        is ``pack_handoff`` bytes (application/octet-stream): the prompt
+        KV pages, the first token selected during the final prefill
+        chunk, and the sampling meta a decode worker resumes from."""
+        from bigdl_tpu.serving.fleet import pack_handoff
+
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            payload = self._read_json_body()
+            if payload is None:
+                return
+            tokens = np.asarray(payload.get("tokens",
+                                            payload.get("prompt")),
+                                np.int32)
+            req_id = self.headers.get("X-Request-Id") \
+                or payload.get("request_id")
+            if req_id is not None and \
+                    not REQUEST_ID_RE.fullmatch(str(req_id)):
+                return self._json(400, {"error": "bad request id"})
+            model = payload.get("model") or self.headers.get("X-Model")
+            if model is not None and \
+                    not MODEL_NAME_RE.fullmatch(str(model)):
+                return self._json(400, {"error": "bad model name"})
+            kw = dict(
+                request_id=req_id, model=model,
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 1.0)),
+                seed=int(payload.get("seed", 0)))
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"bad request: {e}"})
+        with trace.span("serving/http_fleet_prefill"):
+            try:
+                handoff = srv.prefill_handoff(
+                    tokens, timeout=self.server.predict_timeout, **kw)  # type: ignore[attr-defined]
+            except KeyError as e:
+                return self._json(404, {"error": str(e)})
+            except TypeError as e:
+                return self._json(400, {"error": str(e)})
+            except ServiceUnavailableError as e:
+                return self._json(429, {"error": str(e)},
+                                  {"Retry-After": str(e.retry_after)})
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — keep serving
+                return self._json(500, {"error": str(e)})
+        data = pack_handoff(handoff)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("X-Request-Id", str(handoff.get("request_id", "")))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _remote_prefill(self, url: str, tokens, kw: dict):
+        """Ship the prompt to a prefill worker; returns the unpacked
+        handoff, or None on any failure (caller prefills locally)."""
+        from bigdl_tpu.serving.fleet import unpack_handoff
+
+        body = json.dumps({
+            "tokens": np.asarray(tokens, np.int32).tolist(),
+            "temperature": kw["temperature"], "top_k": kw["top_k"],
+            "top_p": kw["top_p"], "seed": kw["seed"],
+            "model": kw.get("model"),
+            "request_id": kw.get("request_id")}).encode()
+        try:
+            req = _urlreq.Request(
+                url.rstrip("/") + "/fleet/prefill", data=body,
+                headers={"Content-Type": "application/json"})
+            with _urlreq.urlopen(
+                    req, timeout=self.server.predict_timeout) as resp:  # type: ignore[attr-defined]
+                return unpack_handoff(resp.read())
+        except Exception as e:  # noqa: BLE001 — split is best-effort
+            log.warning("remote prefill at %s failed (%s); "
+                        "prefilling locally", url, e)
+            return None
+
     def _generate(self):
         """POST /generate — token generation over the continuous decode
         engine.  ``{"tokens": [...], "max_new_tokens": n,
@@ -266,6 +358,15 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError,
                 json.JSONDecodeError) as e:
             return self._json(400, {"error": f"bad request: {e}"})
+        # physical prefill/decode split: the pool proxy names a dedicated
+        # prefill worker via X-Prefill-Url; run the chunked prefill there
+        # and resume decode locally from the shipped KV pages.  Any
+        # remote-prefill failure falls back to prefilling locally — the
+        # split is an optimization, never an availability dependency
+        handoff = None
+        prefill_url = self.headers.get("X-Prefill-Url")
+        if prefill_url:
+            handoff = self._remote_prefill(prefill_url, tokens, kw)
         import queue as _queue
 
         q: "_queue.Queue" = _queue.Queue()
@@ -273,7 +374,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 rid = srv.enqueue_generate(
                     tokens, on_token=(lambda r, t, i: q.put((t, i)))
-                    if stream else None, **kw)
+                    if stream else None, handoff=handoff, **kw)
             except KeyError as e:
                 return self._json(404, {"error": str(e)})
             except TypeError as e:

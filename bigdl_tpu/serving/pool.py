@@ -64,7 +64,7 @@ log = get_logger("bigdl_tpu.serving.pool")
 
 
 def _worker_main(loader: str, batch_size: int, queue_capacity: int,
-                 drain_timeout_s: float = 5.0) -> None:
+                 drain_timeout_s: float = 5.0, role: str = "both") -> None:
     """Entry point inside a worker subprocess."""
     import importlib
 
@@ -72,6 +72,10 @@ def _worker_main(loader: str, batch_size: int, queue_capacity: int,
 
     if os.environ.get("BIGDL_TPU_POOL_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    # same rationale as the proxy (see ServingPool.start): the handler
+    # threads stream per-token chunks and must not queue a GIL switch
+    # interval behind the engine thread for every token they write
+    sys.setswitchinterval(0.001)
     mod_name, _, fn_name = loader.partition(":")
     fn = getattr(importlib.import_module(mod_name), fn_name)
 
@@ -86,6 +90,7 @@ def _worker_main(loader: str, batch_size: int, queue_capacity: int,
         srv = ServingServer(models=loaded, config=cfg).start()
     else:
         srv = ServingServer(loaded, cfg).start()
+    srv.role = role  # fleet role, reported via /health for the router
     fe = HttpFrontend(srv, port=0).start()
     print(f"WORKER_URL={fe.url}", flush=True)
     sys.stdin.readline()           # parent closes stdin to stop us
@@ -261,13 +266,16 @@ class _Worker:
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 2.0,
                  drain_timeout_s: float = 5.0,
-                 name: str = "worker"):
+                 name: str = "worker", role: str = "both"):
         self.loader = loader
         self.batch_size = batch_size
         self.queue_capacity = queue_capacity
         self.env = env
         self.drain_timeout_s = drain_timeout_s
         self.name = name
+        # fleet role (docs/serving.md §Decode fleet): "both" | "prefill"
+        # | "decode" — the proxy's FleetRouter places /generate by it
+        self.role = role
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
         self.breaker = _Breaker(breaker_threshold, breaker_cooldown_s,
@@ -281,7 +289,7 @@ class _Worker:
              "--loader", self.loader, "--batch-size",
              str(self.batch_size), "--queue-capacity",
              str(self.queue_capacity), "--drain-timeout",
-             str(self.drain_timeout_s)],
+             str(self.drain_timeout_s), "--role", self.role],
             env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             text=True)
         # readline blocks with no deadline, so read on a helper thread: a
@@ -349,6 +357,9 @@ class _Worker:
 class _ProxyHandler(BaseHTTPRequestHandler):
     server_version = "bigdl-tpu-serving-pool/1"
     protocol_version = "HTTP/1.1"  # clients keep-alive into the proxy too
+    # the streaming relay re-frames many tiny chunks toward the client;
+    # Nagle would hold each one for the previous chunk's ACK
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):
         log.debug(fmt, *args)
@@ -377,6 +388,11 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             # header-form tenant routing: dropping it would silently
             # serve the default tenant's answer with a 200
             headers["X-Model"] = model
+        prefill = getattr(self, "_prefill_hdr", None)
+        if prefill is not None:
+            # physical prefill/decode split: tells the decode worker
+            # which prefill worker to ship the prompt to
+            headers["X-Prefill-Url"] = prefill
         return pool.conns.request(
             base, method, path, body=body, headers=headers,
             on_reuse=lambda: pool._count("conn_reuse"))
@@ -451,7 +467,15 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self._rid = rid or uuid.uuid4().hex
         self._deadline_hdr = self.headers.get("X-Deadline-S")
         self._model_hdr = self.headers.get("X-Model")
+        self._prefill_hdr = None
         rid_hdr = {"X-Request-Id": self._rid}
+        if self.path == "/generate":
+            # decode-fleet path (docs/serving.md §Decode fleet): KV-aware
+            # placement instead of round-robin, prefill/decode split when
+            # the topology has dedicated prefill workers, and streaming
+            # relay — the rid was assigned above, so every retry below
+            # shares one id end to end
+            return self._generate_fleet(pool, body, rid_hdr)
         # breaker-aware routing, starting at the round-robin cursor: dead
         # or breaker-open workers are skipped without burning a connect
         # timeout; worker-side 429/503 routes to the next worker; the
@@ -537,6 +561,200 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             return payload
         raise payload
 
+    # -- decode fleet (docs/serving.md §Decode fleet) -----------------------
+    def _generate_fleet(self, pool: "ServingPool", body: bytes,
+                        rid_hdr: dict) -> None:
+        """Route one ``POST /generate``: KV-aware placement from cached
+        worker healths (falling back to round-robin order behind the
+        router's pick), the prefill/decode split via ``X-Prefill-Url``
+        when the topology has dedicated prefill workers, and chunked
+        streaming relayed end to end.  Backpressure (429/503) before any
+        stream byte retries the next decode worker under the SAME
+        request id — the proxy assigned it, so the worker-side duplicate
+        guard never fires across a retry ladder."""
+        from bigdl_tpu.serving.fleet import FleetRouter
+
+        stream = False
+        prompt_len = None
+        try:
+            payload = json.loads(body)
+            if isinstance(payload, dict):
+                stream = bool(payload.get("stream", False))
+                toks = payload.get("tokens")
+                if isinstance(toks, list):
+                    prompt_len = len(toks)
+        except (ValueError, json.JSONDecodeError):
+            pass  # malformed body: a worker's 400 is the verdict
+        snap = pool.fleet_snapshot()
+        entries = []
+        for w, h in snap:
+            e = dict(h) if isinstance(h, dict) else {}
+            e.setdefault("role", w.role)
+            e["alive"] = w.routable()
+            entries.append(e)
+        didx, pidx = FleetRouter().route(entries)
+        workers = [w for w, _ in snap]
+        # the split is an optimization, not a routing invariant: shipping
+        # a SHORT prompt's pages costs more than recomputing them on the
+        # decode worker, so only prompts past the threshold cross the
+        # handoff channel (an unknown length — prompt-string bodies —
+        # splits: it may be arbitrarily long once tokenized)
+        worth_splitting = (prompt_len is None
+                           or prompt_len >= pool.fleet_split_min_tokens)
+        if pidx is not None and workers[pidx].routable() and worth_splitting:
+            self._prefill_hdr = workers[pidx].url
+            pool._count("fleet_split")
+        # the router's decode pick leads; every other decode-capable
+        # routable worker follows in round-robin order as the retry
+        # ladder (a prefill-role worker never decodes)
+        cands: List[_Worker] = []
+        seen = set()
+        if didx is not None and workers[didx].routable():
+            cands.append(workers[didx])
+            seen.add(id(workers[didx]))
+            pool._count("fleet_routed")
+        for w in pool._next_workers():
+            if id(w) not in seen and getattr(w, "role", "both") != "prefill":
+                cands.append(w)
+                seen.add(id(w))
+        with trace.span("serving/proxy_generate", request_id=self._rid,
+                        stream=stream):
+            if stream:
+                return self._relay_stream(pool, cands, body, rid_hdr)
+            last_err: Optional[BaseException] = None
+            busy: Optional[Tuple[int, bytes]] = None
+            for w in cands:
+                try:
+                    verdict, code, out = self._attempt(w, body)
+                except Exception as e:  # noqa: BLE001 — worker down
+                    last_err = e
+                    continue
+                if verdict == "skip":
+                    continue
+                if verdict == "busy":
+                    busy = (code, out)
+                    continue
+                return self._reply(code, out, rid_hdr)
+            self._reply_unrouted(pool, busy, last_err, rid_hdr)
+
+    @staticmethod
+    def _park(pool: "ServingPool", url: str, conn, resp) -> None:
+        if resp.will_close:
+            conn.close()
+        else:
+            pool.conns.release(url, conn)
+
+    def _relay_stream(self, pool: "ServingPool", candidates: List["_Worker"],
+                      body: bytes, rid_hdr: dict) -> None:
+        """Relay a chunked NDJSON token stream through the proxy's
+        keep-alive path: one upstream connection held for the stream's
+        life, each worker line re-framed as one chunk to the client as
+        it arrives (token latency is the product — no buffering)."""
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": self._rid}
+        if self._deadline_hdr is not None:
+            headers["X-Deadline-S"] = self._deadline_hdr
+        if self._model_hdr is not None:
+            headers["X-Model"] = self._model_hdr
+        if self._prefill_hdr is not None:
+            headers["X-Prefill-Url"] = self._prefill_hdr
+        last_err: Optional[BaseException] = None
+        busy: Optional[Tuple[int, bytes]] = None
+        for w in candidates:
+            if not w.breaker.try_acquire():
+                continue
+            resp = conn = None
+            try:
+                for attempt in (0, 1):
+                    conn, reused = pool.conns.acquire(w.url)
+                    try:
+                        conn.request("POST", "/generate", body=body,
+                                     headers=headers)
+                        resp = conn.getresponse()
+                        break
+                    except Exception:
+                        conn.close()
+                        conn = None
+                        if not (reused and attempt == 0):
+                            raise
+                        # stale keep-alive socket: one fresh retry
+            except Exception as e:  # noqa: BLE001 — worker down
+                w.breaker.record_failure()
+                last_err = e
+                continue
+            w.breaker.record_success()
+            if resp.status in (429, 503):
+                # backpressure BEFORE any stream byte: the next decode
+                # worker retries under the same request id
+                busy = (resp.status, resp.read())
+                self._park(pool, w.url, conn, resp)
+                continue
+            chunked = "chunked" in (resp.getheader("Transfer-Encoding")
+                                    or "")
+            if resp.status != 200 or not chunked:
+                # error verdicts (400/404/500...) come back framed with
+                # Content-Length; relay buffered like any forward
+                data = resp.read()
+                self._park(pool, w.url, conn, resp)
+                return self._reply(resp.status, data, rid_hdr)
+            pool._count("stream_relays")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type")
+                             or "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("X-Request-Id", self._rid)
+            self.end_headers()
+            complete = False
+            try:
+                # http.client un-chunks the worker stream; re-frame and
+                # forward whatever bytes are AVAILABLE per read — one
+                # token rides alone (latency is the product), a burst of
+                # queued tokens coalesces into one chunk write instead
+                # of paying the relay's per-line cost exactly when the
+                # proxy is busiest.  NDJSON clients split on newlines,
+                # so chunk boundaries need not align with lines.
+                while True:
+                    data = resp.read1(65536)
+                    if not data:
+                        complete = True
+                        break
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True  # client hung up mid-stream
+            except Exception:  # noqa: BLE001 — worker died mid-stream
+                try:
+                    # terminate the chunked framing so the client sees a
+                    # (truncated but) well-formed stream end
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:  # noqa: BLE001
+                    pass
+                self.close_connection = True
+            if complete and not resp.will_close:
+                pool.conns.release(w.url, conn)
+            else:
+                conn.close()
+            return
+        self._reply_unrouted(pool, busy, last_err, rid_hdr)
+
+    def _reply_unrouted(self, pool: "ServingPool",
+                        busy: Optional[Tuple[int, bytes]],
+                        last_err: Optional[BaseException],
+                        rid_hdr: dict) -> None:
+        if busy is not None:
+            # every routable worker is shedding: relay the backpressure
+            # verdict instead of inventing a 503
+            pool._count("proxy_busy")
+            return self._reply(
+                busy[0], busy[1],
+                {"Retry-After": str(pool.retry_after_s), **rid_hdr})
+        pool._count("proxy_unavailable")
+        self._reply(503, json.dumps(
+            {"error": f"no serving worker available: {last_err}"}).encode(),
+            {"Retry-After": str(pool.retry_after_s), **rid_hdr})
+
     def _reply_federated(self, pool: "ServingPool") -> None:
         """One federated ``GET /metrics``.  A worker that cannot answer
         (dead, respawning, or killed mid-scrape) degrades the scrape —
@@ -577,6 +795,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self._rid = None
         self._deadline_hdr = None
         self._model_hdr = None
+        self._prefill_hdr = None
         if self.path == "/metrics":
             # FEDERATED scrape (docs/observability.md §Federation): the
             # proxy's own registry plus every live worker's exposition,
@@ -602,7 +821,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             # url reflects the CURRENT process: spawn() clears it before
             # launching, so a corpse's old endpoint never shows up here
             one = {"name": w.name, "url": w.url, "alive": w.alive(),
-                   "breaker": w.breaker.snapshot()}
+                   "role": w.role, "breaker": w.breaker.snapshot()}
             if w.alive() and w.url:
                 try:
                     _, out, _ = self._forward("GET", w.url, "/health", None)
@@ -643,9 +862,38 @@ class ServingPool:
                  scale_up_queue_depth: Optional[float] = None,
                  scale_down_after: int = 3,
                  scale_cooldown_s: float = 5.0,
-                 scale_up_slo_health: float = 0.5):
+                 scale_up_slo_health: float = 0.5,
+                 roles: Optional[List[str]] = None,
+                 fleet_health_max_age_s: float = 0.25,
+                 fleet_split_min_tokens: int = 0):
         self.loader = loader
         self.n = workers
+        # per-initial-worker fleet roles (docs/serving.md §Decode fleet),
+        # e.g. ["prefill", "decode"]; unnamed (and autoscaled) workers
+        # default to "both".  The router only splits prefill from decode
+        # when at least one dedicated "prefill" worker exists
+        if roles is not None:
+            bad = [r for r in roles if r not in ("both", "prefill",
+                                                 "decode")]
+            if bad:
+                raise ValueError(f"bad worker roles {bad}; expected "
+                                 "'both', 'prefill' or 'decode'")
+            if len(roles) > workers:
+                raise ValueError(f"{len(roles)} roles for {workers} "
+                                 "workers")
+        self.roles = list(roles) if roles else []
+        # prompts shorter than this prefill on the decode worker even
+        # when a dedicated prefill worker exists: the handoff's fixed
+        # cost (harvest, serialize, HTTP, import) only beats local
+        # recompute past a prompt length.  0 = always split.
+        self.fleet_split_min_tokens = int(fleet_split_min_tokens)
+        # /health snapshots the generate router places by, TTL-cached so
+        # a burst of concurrent /generate POSTs costs one probe sweep
+        self._fleet_max_age_s = fleet_health_max_age_s
+        self._fleet_lock = threading.Lock()
+        self._fleet_cache: Optional[List[Tuple[_Worker,
+                                               Optional[dict]]]] = None
+        self._fleet_t = 0.0
         self.batch_size = batch_size
         self.queue_capacity = queue_capacity
         self.worker_env = worker_env
@@ -695,7 +943,8 @@ class ServingPool:
         self.stats = {"hedged_requests": 0, "proxy_busy": 0,
                       "proxy_unavailable": 0, "rejected_oversize": 0,
                       "conn_reuse": 0, "scale_up": 0, "scale_down": 0,
-                      "federation_stale": 0}
+                      "federation_stale": 0, "fleet_routed": 0,
+                      "fleet_split": 0, "stream_relays": 0}
         # visible at 0 from the first scrape: an alert on increase needs
         # the series to exist BEFORE the first worker dies
         global_metrics().inc("serving_pool.federation_stale", 0)
@@ -735,18 +984,28 @@ class ServingPool:
         return [w.url for w in self._next_workers()]
 
     # -- lifecycle ----------------------------------------------------------
-    def _new_worker(self) -> _Worker:
+    def _new_worker(self, role: str = "both") -> _Worker:
         with self._workers_lock:
             name = f"worker-{self._worker_seq}"
             self._worker_seq += 1
         return _Worker(self.loader, self.batch_size, self.queue_capacity,
                        self.worker_env, self.breaker_threshold,
                        self.breaker_cooldown_s, self.drain_timeout_s,
-                       name=name)
+                       name=name, role=role)
 
     def start(self) -> "ServingPool":
-        for _ in range(self.n):
-            w = self._new_worker()
+        # the proxy process is pure I/O relay — handler threads shuttle
+        # small per-token chunks between sockets and never compute.  At
+        # the default 5ms GIL switch interval a ready relay thread can
+        # sit several intervals behind its peers, which lands directly
+        # in every streaming client's TTFT and inter-token tail
+        # (measured on the fleet bench: ~8x TTFT p99, ~30% tokens/s).
+        sys.setswitchinterval(0.001)
+        for i in range(self.n):
+            # autoscaled workers (and unnamed slots) are "both": extra
+            # capacity must be able to serve whatever the load needs
+            w = self._new_worker(self.roles[i] if i < len(self.roles)
+                                 else "both")
             w.spawn()
             with self._workers_lock:
                 self.workers.append(w)
@@ -793,6 +1052,26 @@ class ServingPool:
             return json.loads(data)
         except Exception:  # noqa: BLE001 — dead socket or non-JSON body
             return None
+
+    def fleet_snapshot(self, max_age_s: Optional[float] = None
+                       ) -> List[Tuple[_Worker, Optional[dict]]]:
+        """Point-in-time ``(worker, health)`` pairs for the generate
+        router, TTL-cached (``fleet_health_max_age_s``): placement wants
+        fresh slot/page pressure, but a burst of concurrent /generate
+        POSTs must not turn into a /health probe per request.  Health is
+        None for a worker that cannot answer — the router scores it from
+        its configured role and liveness alone."""
+        max_age = self._fleet_max_age_s if max_age_s is None else max_age_s
+        now = time.time()
+        with self._fleet_lock:
+            if (self._fleet_cache is not None
+                    and now - self._fleet_t <= max_age):
+                return self._fleet_cache
+        snap = [(w, self._worker_health(w)) for w in self.worker_list()]
+        with self._fleet_lock:
+            self._fleet_cache = snap
+            self._fleet_t = now
+        return snap
 
     def pool_pressure(self) -> dict:
         """The autoscaler's input, from signals the workers already
@@ -964,6 +1243,16 @@ def _main() -> None:
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--queue-capacity", type=int, default=4096)
     ap.add_argument("--drain-timeout", type=float, default=5.0)
+    ap.add_argument("--role", default="both",
+                    choices=("both", "prefill", "decode"),
+                    help="fleet role for --worker mode "
+                         "(docs/serving.md §Decode fleet)")
+    ap.add_argument("--roles", default=None,
+                    help="comma-separated per-worker roles for pool mode, "
+                         "e.g. prefill,decode")
+    ap.add_argument("--fleet-split-min-tokens", type=int, default=0,
+                    help="only split prefill for prompts at least this "
+                         "long (0 = always split)")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--min-workers", type=int, default=None)
     ap.add_argument("--max-workers", type=int, default=None)
@@ -971,13 +1260,16 @@ def _main() -> None:
     args = ap.parse_args()
     if args.worker:
         _worker_main(args.loader, args.batch_size, args.queue_capacity,
-                     args.drain_timeout)
+                     args.drain_timeout, role=args.role)
         return
     pool = ServingPool(args.loader, workers=args.workers,
                        batch_size=args.batch_size,
                        queue_capacity=args.queue_capacity,
                        min_workers=args.min_workers,
                        max_workers=args.max_workers,
+                       roles=(args.roles.split(",") if args.roles
+                              else None),
+                       fleet_split_min_tokens=args.fleet_split_min_tokens,
                        port=args.port).start()
     print(f"POOL_URL={pool.url}", flush=True)
     try:

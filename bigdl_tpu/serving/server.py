@@ -238,6 +238,10 @@ class ServingServer:
         # (X-Request-Id) a duplicate of an IN-FLIGHT id must be rejected
         # at admission, or two waiters would race one _results slot
         self._pending: set = set()
+        # the generate subset of _pending: decode requests live in the
+        # engine's slot scheduler, not the tenant heaps, so backlog()
+        # would otherwise go blind to them the moment they are admitted
+        self._generate_pending: set = set()
         self._result_cv = threading.Condition()
         self._last_gc_t = 0.0
         self._stop = threading.Event()
@@ -257,6 +261,12 @@ class ServingServer:
         self._seq_n = 0
         self._predict_ema_s = 0.01  # urgency horizon for deadline jumps
         self._in = _QueueView(self)
+        # fleet role (docs/serving.md §Decode fleet): "both" serves
+        # everything; "prefill" workers run chunked prefill and hand KV
+        # pages off; "decode" workers run the token loop.  Advisory — the
+        # pool proxy routes on it via /health; the server itself never
+        # refuses work, so a mis-roled request still gets an answer
+        self.role = "both"
         if models:
             for name, m in models.items():
                 self.register_model(name, m)
@@ -355,15 +365,43 @@ class ServingServer:
                     for t in self._tenants.values()}
 
     def backlog(self) -> int:
-        """Admitted requests not yet in predict: tenant heaps + the
-        assembled handoff slot + a batch mid-assembly.  THE autoscaling
-        pressure signal — the heaps alone go quiet once the double
-        buffer absorbs a backlog (``_QueueView.qsize`` stays heap-only:
-        it is the bounded-admission capacity the enqueue path enforces)."""
+        """Admitted requests not yet answered: tenant heaps + the
+        assembled handoff slot + a batch mid-assembly + generate
+        requests living in the decode engine.  THE autoscaling and
+        fleet-routing pressure signal — the heaps alone go quiet once
+        the double buffer absorbs a backlog, and generate requests
+        never touch the heaps at all (``_QueueView.qsize`` stays
+        heap-only: it is the bounded-admission capacity the enqueue
+        path enforces)."""
+        with self._result_cv:
+            generating = len(self._generate_pending)
         with self._work_cv:
             return (sum(len(t.heap) for t in self._tenants.values())
                     + (len(self._slot) if self._slot else 0)
-                    + self._assembling_n)
+                    + self._assembling_n + generating)
+
+    def decode_pressure(self) -> Dict[str, Any]:
+        """Aggregated decode-engine capacity across tenants — the
+        ``decode`` block of ``/health`` the fleet router places
+        ``/generate`` by (docs/serving.md §Decode fleet).  Only engines
+        already built are consulted (a Seq2SeqService's lazy engine is
+        not forced into existence by a health probe); numeric fields sum
+        across tenants, and ``generate_inflight`` counts admitted
+        generate requests not yet resolved."""
+        agg: Dict[str, Any] = {}
+        for t in list(self._tenants.values()):
+            engine = getattr(t.model, "decode_engine", None)
+            pressure = getattr(engine, "decode_pressure", None)
+            if pressure is None:
+                continue
+            for k, v in pressure().items():
+                if isinstance(v, (int, float)):
+                    agg[k] = agg.get(k, 0) + v
+                elif k not in agg:   # e.g. the prefix_cache stats dict
+                    agg[k] = v
+        with self._result_cv:
+            agg["generate_inflight"] = len(self._generate_pending)
+        return agg
 
     def slo_health(self) -> float:
         """The SLO health score in [0, 1] (1.0 with no evaluator or no
@@ -677,13 +715,14 @@ class ServingServer:
             self._work_cv.notify_all()
         return True
 
-    def enqueue_generate(self, tokens, request_id: Optional[str] = None,
+    def enqueue_generate(self, tokens=None, request_id: Optional[str] = None,
                          deadline_s: Optional[float] = None,
                          model: Optional[str] = None,
                          max_new_tokens: Optional[int] = None,
                          temperature: float = 0.0, top_k: int = 0,
                          top_p: float = 1.0, seed: int = 0,
-                         on_token=None) -> str:
+                         on_token=None, handoff: Optional[dict] = None
+                         ) -> str:
         """Admit one GENERATE request for ``model``'s continuous decode
         engine (docs/serving.md §Autoregressive decode).  Admission
         mirrors :meth:`enqueue` — draining/degraded/duplicate-id checks,
@@ -694,12 +733,28 @@ class ServingServer:
         deadline enforcement is the engine's: an expired streaming
         request frees its slot immediately and resolves as
         :class:`DeadlineExceededError` (counted under
-        ``serving.tenant.<name>.expired``)."""
+        ``serving.tenant.<name>.expired``).
+
+        ``handoff`` (docs/serving.md §Decode fleet) is an unpacked KV
+        handoff from a ``role="prefill"`` worker: tokens and sampling
+        params come from it (the decode must resume under exactly the
+        sampling the prefill worker selected the first token with),
+        prefill is skipped entirely — the engine imports the shipped
+        pages and resumes decode byte-identically to having prefilled
+        locally."""
         import math as _math
 
         from bigdl_tpu.serving.decode_engine import DecodeRequest
 
         cfg = self.config
+        if handoff is not None:
+            tokens = handoff["tokens"]
+            temperature = handoff.get("temperature", temperature)
+            top_k = handoff.get("top_k", top_k)
+            top_p = handoff.get("top_p", top_p)
+            seed = handoff.get("seed", seed)
+        elif tokens is None:
+            raise ValueError("enqueue_generate needs tokens (or a handoff)")
         if self._draining or self._stop.is_set():
             self._count("shed_requests")
             raise ServiceUnavailableError(
@@ -740,6 +795,7 @@ class ServingServer:
             self._results.pop(rid, None)
             self._result_expiry.pop(rid, None)
             self._pending.add(rid)
+            self._generate_pending.add(rid)
 
         def _done(req: DecodeRequest) -> None:
             done_t = time.time()
@@ -765,13 +821,14 @@ class ServingServer:
                 self._results[rid] = verdict
                 self._result_expiry[rid] = ttl
                 self._pending.discard(rid)
+                self._generate_pending.discard(rid)
                 self._result_cv.notify_all()
 
         req = DecodeRequest(
             tokens=np.asarray(tokens, np.int32), rid=rid, tenant=name,
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, seed=seed, deadline_t=deadline_t,
-            on_token=on_token, on_done=_done)
+            on_token=on_token, on_done=_done, handoff=handoff)
         with trace.span("serving/enqueue_generate", request_id=rid,
                         model=name):
             try:
@@ -779,6 +836,7 @@ class ServingServer:
             except RuntimeError as e:
                 with self._result_cv:
                     self._pending.discard(rid)
+                    self._generate_pending.discard(rid)
                 self._count("shed_requests")
                 raise ServiceUnavailableError(
                     f"decode queue full: {e}",
@@ -788,8 +846,65 @@ class ServingServer:
                 # cap): the id must not stay poisoned in _pending
                 with self._result_cv:
                     self._pending.discard(rid)
+                    self._generate_pending.discard(rid)
                 raise
         return rid
+
+    def prefill_handoff(self, tokens, request_id: Optional[str] = None,
+                        model: Optional[str] = None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, seed: int = 0,
+                        timeout: float = 30.0) -> dict:
+        """Run the prefill half of a split generate request and return
+        the KV handoff dict (docs/serving.md §Decode fleet) — what a
+        ``role="prefill"`` worker serves at ``POST /fleet/prefill``.
+
+        Synchronous by design: the engine selects the first token during
+        the final prefill chunk (one decode step of work), so the caller
+        gets tokens + first token + the float32 page images in one call
+        and ships them to a decode worker via
+        :func:`~bigdl_tpu.serving.fleet.handoff.pack_handoff`.  The
+        request never enters the result table — the decode worker owns
+        the client-visible request id."""
+        from bigdl_tpu.serving.decode_engine import DecodeRequest
+
+        cfg = self.config
+        if self._draining or self._stop.is_set():
+            self._count("shed_requests")
+            raise ServiceUnavailableError(
+                "server is draining/stopped; retry against another replica",
+                retry_after=cfg.retry_after_s)
+        name = model or self._default_name
+        tenant = self._tenants.get(name)
+        if tenant is None:
+            raise KeyError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._tenants)}")
+        engine = getattr(tenant.model, "decode_engine", None)
+        if engine is None and hasattr(tenant.model, "_engine"):
+            engine = tenant.model._engine()
+        if engine is None:
+            raise TypeError(
+                f"model {name!r} has no decode engine; cannot prefill")
+        req = DecodeRequest(
+            tokens=np.asarray(tokens, np.int32),
+            rid=request_id or uuid.uuid4().hex, tenant=name,
+            max_new_tokens=1, temperature=temperature, top_k=top_k,
+            top_p=top_p, seed=seed, export_kv=True)
+        with trace.span("serving/prefill_handoff", request_id=req.rid,
+                        model=name):
+            try:
+                engine.submit(req)
+            except RuntimeError as e:
+                self._count("shed_requests")
+                raise ServiceUnavailableError(
+                    f"decode queue full: {e}", retry_after=cfg.retry_after_s)
+            req.wait(timeout)
+        if req.error is not None:
+            raise req.error
+        if req.kv_export is None:  # pragma: no cover - engine bug guard
+            raise RuntimeError("prefill finished without a KV export")
+        return req.kv_export
 
     def query(self, request_id: str, timeout: float = 30.0) -> np.ndarray:
         deadline = time.time() + timeout
