@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-fleet test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-fleet bench-slo dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-fleet bench-fleet-chaos bench-slo dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -72,6 +72,15 @@ test-decode:
 # prefill/decode split over real worker processes (streaming relay)
 test-fleet:
 	python -m pytest tests/test_fleet.py -q
+
+# decode-fleet fault tolerance (docs/serving.md §Fleet fault tolerance):
+# resume_from byte parity (re-prefill + migration adoption, greedy AND
+# seeded), two-phase live drain with corrupt-handoff degradation,
+# client-disconnect slot reclaim, breaker-driven snapshot invalidation,
+# and — the slow pair — SIGKILL failover and scale-down drain against
+# real subprocess pool workers with mid-flight streams
+test-fleet-chaos:
+	python -m pytest tests/test_fleet_chaos.py -q
 
 # the observability suite (docs/observability.md): span tracer + chrome
 # export, Prometheus exposition (+HELP lines, scrape-under-mutation),
@@ -206,6 +215,13 @@ bench-decode:
 # artifact source
 bench-fleet:
 	python bench_serving.py --fleet
+
+# chaos variant (docs/serving.md §Fleet fault tolerance): same 2-worker
+# pool, a decode worker SIGKILLed mid-run at 24 streaming clients; the
+# gate is zero failed requests + exact token parity vs the no-fault
+# baseline + bounded recovery p99; the DECODE_CHAOS_r*.json source
+bench-fleet-chaos:
+	python bench_serving.py --fleet --chaos
 
 # session-long TPU evidence orchestrator (single instance via flock;
 # BENCH_attempts.jsonl evidence trail)

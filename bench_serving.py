@@ -662,21 +662,70 @@ def _fleet_loader():
     im = InferenceModel(model, variables, decode=DecodeConfig(
         slots=slots, page_size=8, pages_per_slot=16, prompt_chunk=8,
         max_new_tokens=120, eos_id=1, prefix_cache_pages=16))
-    im.decode_engine.warmup()
+    eng = im.decode_engine
+    eng.warmup()
+    # chaos drill only: throttle the decode loop so the tiny CPU model
+    # holds streams in flight long enough for the mid-run SIGKILL to
+    # land on live slots (both phases get the same throttle — the
+    # baseline/chaos throughput comparison stays honest)
+    sleep_s = float(os.environ.get("BIGDL_TPU_CHAOS_DECODE_SLEEP",
+                                   "0") or 0)
+    if sleep_s > 0:
+        import time as _time
+        orig_step = eng._decode_step
+
+        def _throttled_step():
+            _time.sleep(sleep_s)
+            return orig_step()
+
+        eng._decode_step = _throttled_step
     sent.mark_steady()
     return im
 
 
 FLEET_SERVER = textwrap.dedent("""
-    import sys
+    import sys, threading, time
     from bigdl_tpu.serving.pool import ServingPool
 
     pool = ServingPool("bench_serving:_fleet_loader",
                        workers=%(workers)d, batch_size=8,
                        roles=%(roles)r, worker_env=%(env)r,
                        fleet_split_min_tokens=%(split_min)d,
-                       supervise_interval_s=0.5)
+                       supervise_interval_s=0.5,
+                       predict_timeout=%(predict_timeout)f)
     pool.start()
+
+    def _chaos_kill(after):
+        # chaos drill (--fleet --chaos): once enough client streams are
+        # in flight, SIGKILL one decode-capable worker mid-stream — the
+        # proxy must fail its streams over with token parity.  Target a
+        # worker that is actually HOLDING live generates (the router may
+        # have packed the whole first wave on one worker): a kill that
+        # lands on an idle peer proves nothing
+        while pool.stats["stream_relays"] < after:
+            time.sleep(0.02)
+        live = [w for w in reversed(pool.worker_list())
+                if w.role != "prefill" and w.alive()]
+        victim = None
+        deadline = time.time() + 30.0
+        while victim is None and time.time() < deadline:
+            for w in live:
+                h = pool._worker_health(w)
+                if (h or {}).get("decode", {}).get(
+                        "generate_inflight", 0) >= 1:
+                    victim = w
+                    break
+            else:
+                time.sleep(0.02)
+        if victim is None and live:
+            victim = live[0]
+        if victim is not None:
+            victim.proc.kill()
+            print("KILLED=" + victim.name, flush=True)
+
+    if %(kill_after)d:
+        threading.Thread(target=_chaos_kill, args=(%(kill_after)d,),
+                         daemon=True).start()
     print(f"URL={pool.url}", flush=True)
     sys.stdin.readline()
     pool.stop()
@@ -686,17 +735,25 @@ FLEET_SERVER = textwrap.dedent("""
 class _FleetServer:
     """The pool subprocess: proxy + role-assigned workers.  Scraping
     (federated /metrics, /health) happens from the PARENT while the pool
-    is still up — ``scrape()`` before ``finish()``."""
+    is still up — ``scrape()`` before ``finish()``.  ``kill_after`` > 0
+    arms the chaos thread: one decode-capable worker is SIGKILLed once
+    that many client streams have started relaying."""
 
-    def __init__(self, workers: int, roles, split_min: int = 0):
+    def __init__(self, workers: int, roles, split_min: int = 0,
+                 kill_after: int = 0, predict_timeout: float = 30.0,
+                 decode_sleep: float = 0.0):
         env = {"PYTHONPATH": os.pathsep.join(
                    p for p in [REPO, os.environ.get("PYTHONPATH")] if p),
                "JAX_PLATFORMS": "cpu", "BIGDL_TPU_POOL_CPU": "1"}
         if os.environ.get("BIGDL_TPU_FLEET_SLOTS"):
             env["BIGDL_TPU_FLEET_SLOTS"] = \
                 os.environ["BIGDL_TPU_FLEET_SLOTS"]
+        if decode_sleep > 0:
+            env["BIGDL_TPU_CHAOS_DECODE_SLEEP"] = str(decode_sleep)
         code = FLEET_SERVER % {"workers": workers, "roles": list(roles),
-                               "env": env, "split_min": split_min}
+                               "env": env, "split_min": split_min,
+                               "kill_after": kill_after,
+                               "predict_timeout": predict_timeout}
         penv = dict(os.environ, JAX_PLATFORMS="cpu",
                     PYTHONPATH=env["PYTHONPATH"])
         penv.pop("XLA_FLAGS", None)
@@ -847,6 +904,232 @@ def run_fleet(clients: int, duration_s: float, out=None,
     return 0
 
 
+# ---------------------------------------------------------------------------
+# decode-fleet chaos drill (--fleet --chaos): the DECODE_CHAOS_r*.json
+# evidence source (docs/serving.md §Fleet fault tolerance)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_request_set(clients: int, per_client: int, seed: int = 7):
+    """A FIXED, seeded request set — the same list runs in the no-fault
+    baseline phase and the chaos phase, so token parity is a strict
+    equality check, not a statistic.  Half the requests are greedy
+    (temperature 0), half seeded sampling — both must survive a failover
+    byte-identically (the engine keys sampling on absolute position, not
+    on who computed the prefix).  Each client's FIRST request carries a
+    long output so the mid-run kill lands while most of the first wave
+    is still streaming."""
+    rs = np.random.RandomState(seed)
+    reqs = []
+    for ci in range(clients):
+        for j in range(per_client):
+            plen = int(rs.randint(4, 17))
+            max_new = int(rs.randint(48, 81) if j == 0
+                          else rs.randint(8, 25))
+            seeded = bool(rs.rand() < 0.5)
+            reqs.append({
+                "client": ci, "rid": f"chaos-{ci}-{j}",
+                "tokens": rs.randint(2, 64, (plen,)).tolist(),
+                "max_new_tokens": max_new,
+                "temperature": 0.8 if seeded else 0.0,
+                "top_k": 0, "top_p": 1.0,
+                "seed": int(rs.randint(0, 2 ** 31 - 1))})
+    return reqs
+
+
+def _chaos_clients(host: str, port: int, reqs, clients: int):
+    """The chaos drill's measuring clients: one thread per client, each
+    posting its fixed request list sequentially over a keep-alive
+    connection.  Unlike the perf loops, EVERY token line is parsed —
+    parity is the gate — and each stream's worst inter-token gap is kept
+    as the client-visible recovery latency.  Returns
+    ``({rid: tokens}, {rid: max_gap_s}, [(rid, error), ...])``."""
+    import http.client as _hc
+
+    by_client = {}
+    for r in reqs:
+        by_client.setdefault(r["client"], []).append(r)
+    results, maxgaps, failed = {}, {}, []
+    lock = threading.Lock()
+
+    def one(conn, body):
+        for attempt in (0, 1):
+            if conn is None:
+                conn = _hc.HTTPConnection(host, port, timeout=240.0)
+            try:
+                conn.request("POST", "/generate", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except Exception:
+                conn.close()
+                conn = None
+                if attempt:
+                    raise
+                continue  # stale keep-alive socket: one fresh retry
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}: "
+                                   f"{resp.read()[:200]!r}")
+            toks, times, final = [], [], None
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                if "error" in ev:
+                    raise RuntimeError(f"stream error: {ev['error']}")
+                if "token" in ev:
+                    toks.append(int(ev["token"]))
+                    times.append(time.time())
+                if ev.get("done"):
+                    final = [int(t) for t in ev.get("tokens") or []]
+                    break
+            resp.read()  # drain the terminal chunk: conn stays reusable
+            if final is None:
+                # a silent truncation — exactly what failover exists to
+                # prevent; the orphan path would have sent an error line
+                raise RuntimeError("stream ended without a final verdict")
+            if toks and toks != final:
+                raise RuntimeError("streamed tokens diverge from the "
+                                   f"final verdict: {toks} vs {final}")
+            return conn, final, times
+        raise RuntimeError("unreachable")
+
+    def run(ci):
+        conn = None
+        try:
+            for r in by_client.get(ci, []):
+                body = json.dumps({
+                    "tokens": r["tokens"],
+                    "max_new_tokens": r["max_new_tokens"],
+                    "temperature": r["temperature"],
+                    "top_k": r["top_k"], "top_p": r["top_p"],
+                    "seed": r["seed"], "stream": True,
+                    "request_id": r["rid"]}).encode()
+                try:
+                    conn, final, times = one(conn, body)
+                except Exception as e:  # noqa: BLE001 — the gate counts it
+                    with lock:
+                        failed.append((r["rid"], str(e)))
+                    if conn is not None:
+                        conn.close()
+                    conn = None
+                    continue
+                gap = max((b - a for a, b in zip(times, times[1:])),
+                          default=0.0)
+                with lock:
+                    results[r["rid"]] = final
+                    maxgaps[r["rid"]] = gap
+        finally:
+            if conn is not None:
+                conn.close()
+
+    threads = [threading.Thread(target=run, args=(ci,))
+               for ci in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    return results, maxgaps, failed
+
+
+def _chaos_phase(reqs, clients: int, chaos: bool):
+    """One phase of the drill on a FRESH pool (two "both"-role workers,
+    so a killed worker's streams have a live peer to fail over to
+    immediately — the supervisor's respawn is the backstop, not the
+    recovery path).  Returns the client results plus the proxy's stats
+    and restart count, scraped while the pool is still up."""
+    kill_after = max(2, clients // 3) if chaos else 0
+    server = _FleetServer(2, ("both", "both"), split_min=0,
+                          kill_after=kill_after, predict_timeout=60.0,
+                          decode_sleep=0.008)
+    t0 = time.time()
+    try:
+        results, maxgaps, failed = _chaos_clients(
+            server.host, server.port, reqs, clients)
+        wall = time.time() - t0
+        from urllib import request as _rq
+
+        with _rq.urlopen(server.url + "/health", timeout=30) as r:
+            health = json.loads(r.read())
+    finally:
+        server.finish()
+    return results, maxgaps, failed, {
+        "stats": health.get("pool", {}),
+        "restarts": int(health.get("restarts", 0))}, wall
+
+
+def run_fleet_chaos(clients: int, out=None, smoke: bool = False) -> int:
+    """The DECODE_CHAOS_r*.json drill: the same fixed request set runs
+    against a clean pool (baseline) and against a pool where one decode
+    worker is SIGKILLed mid-run.  Gates: ZERO failed requests under
+    chaos, byte-identical token sequences for every request (greedy and
+    seeded), at least one observed failover, no orphaned streams, and a
+    bounded client-visible recovery tail."""
+    per_client = 2
+    if smoke:
+        clients = 6
+    reqs = _chaos_request_set(clients, per_client)
+    base, _, base_failed, _, _ = _chaos_phase(reqs, clients, chaos=False)
+    got, maxgaps, failed, fleet, wall = _chaos_phase(reqs, clients,
+                                                     chaos=True)
+    stats = fleet["stats"]
+    mismatched = [r["rid"] for r in reqs
+                  if got.get(r["rid"]) != base.get(r["rid"])]
+    recovery_ms_p99 = round(_pct(list(maxgaps.values()), 0.99) * 1e3, 2)
+    tokens = sum(len(v) for v in got.values())
+    row = {
+        "bench": "decode_chaos",
+        "engine": "decode_pool",
+        "geometry": f"decode_chaos_w2_c{clients}",
+        "workers": 2,
+        "concurrent_clients": clients,
+        "requests": len(reqs),
+        "duration_s": round(wall, 2),
+        "tokens": tokens,
+        "chaos_tokens_per_s": round(tokens / wall, 1) if wall else 0.0,
+        "failed_requests": len(failed),
+        "baseline_failed_requests": len(base_failed),
+        "parity_ok": not mismatched,
+        "failovers": int(stats.get("fleet_failovers", 0)),
+        "migrations": int(stats.get("fleet_migrations", 0)),
+        "resumed_tokens": int(stats.get("fleet_resumed_tokens", 0)),
+        "orphaned_requests": int(stats.get("fleet_orphans", 0)),
+        "worker_restarts": fleet["restarts"],
+        "recovery_ms_p99": recovery_ms_p99,
+        "streaming_clients": True,
+    }
+    failures = []
+    if base_failed:
+        failures.append(f"{len(base_failed)} baseline failures "
+                        f"(first: {base_failed[0]})")
+    if failed:
+        failures.append(f"{len(failed)} failed requests under chaos "
+                        f"(first: {failed[0]})")
+    if mismatched:
+        failures.append(f"token parity broken across the failover for "
+                        f"{mismatched[:4]}")
+    if row["failovers"] < 1:
+        failures.append("no failover observed — the kill missed every "
+                        "in-flight stream")
+    if row["orphaned_requests"]:
+        failures.append(f"{row['orphaned_requests']} streams orphaned")
+    bound_ms = 30000.0 if smoke else 20000.0
+    if recovery_ms_p99 > bound_ms:
+        failures.append(f"recovery p99 {recovery_ms_p99}ms > "
+                        f"{bound_ms:.0f}ms")
+    if out and not failures:
+        with open(out, "w") as f:
+            json.dump(row, f, indent=1)
+    print(json.dumps(row))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--decode-worker":
@@ -867,9 +1150,20 @@ def main(argv=None) -> int:
                     help="disaggregated decode-fleet bench: prefill/"
                          "decode split over a worker pool, KV-aware "
                          "routing, streaming relay")
+    ap.add_argument("--chaos", action="store_true",
+                    help="with --fleet: kill a decode worker mid-run and "
+                         "gate zero failed requests + token parity + "
+                         "bounded recovery")
     ap.add_argument("--out", default=None,
                     help="also write the artifact JSON here")
     args = ap.parse_args(argv)
+    if args.fleet and args.chaos:
+        out = args.out
+        if out is None and os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
+            out = os.path.join(REPO, "DECODE_CHAOS_r01.json")
+        clients = 24 if args.clients == 32 else args.clients
+        return run_fleet_chaos(clients=clients, out=out,
+                               smoke=args.smoke)
     if args.fleet:
         if args.smoke:
             return run_fleet(clients=6, duration_s=1.5, smoke=True)

@@ -261,6 +261,48 @@ DEFAULT_HELP = {
                                 "decode worker",
     "serving.fleet.kv_imports": "prefill KV handoffs imported from a "
                                 "prefill worker",
+    # fleet fault tolerance (docs/serving.md §Fleet fault tolerance)
+    "serving.fleet.failovers": "streams re-placed on a surviving decode "
+                               "worker after their worker died "
+                               "mid-stream",
+    "serving.fleet.migrations": "live decode slots migrated (KV exported "
+                                "and adopted by a peer) during a drain",
+    "serving.fleet.resumed_tokens": "tokens already delivered to clients "
+                                    "at failover time (resumed, not "
+                                    "regenerated client-side)",
+    "serving.fleet.orphaned_requests": "streams terminated with an error "
+                                       "after every re-placement attempt "
+                                       "failed within the budget",
+    "serving.fleet.recovery_s": "client-visible failover recovery "
+                                "latency: worker loss detected to the "
+                                "resumed stream's first byte",
+    "serving.fleet.hedged_prefills": "remote prefills abandoned at the "
+                                     "hedge deadline and recomputed "
+                                     "locally",
+    "serving.fleet.parked_handoffs": "migration handoffs parked on this "
+                                     "worker awaiting their resumed "
+                                     "request",
+    "serving.fleet.resumes": "generate requests carrying resume_from "
+                             "(failover re-placements)",
+    "serving.fleet.resume_adopted": "resumed requests that attached to a "
+                                    "parked migration handoff (no "
+                                    "re-prefill)",
+    "serving.fleet.resume_reprefill": "resumed requests that rebuilt KV "
+                                      "by chunked re-prefill",
+    "serving.decode.cancelled": "live decode requests cancelled "
+                                "(client disconnect, migration eviction, "
+                                "explicit cancel)",
+    "serving.decode.client_disconnects": "streaming clients that hung up "
+                                         "mid-generate (slot and pages "
+                                         "freed immediately)",
+    "serving_pool.fleet_failovers": "proxy-side count of mid-stream "
+                                    "failovers (see "
+                                    "serving.fleet.failovers)",
+    "serving_pool.fleet_migrations": "proxy-side count of drain "
+                                     "migrations recorded",
+    "serving_pool.fleet_resumed_tokens": "proxy-side count of tokens "
+                                         "carried across failovers",
+    "serving_pool.fleet_orphans": "proxy-side count of orphaned streams",
     # cluster control plane (docs/resilience.md §Multi-host recovery)
     "cluster.view_epoch": "current membership view epoch",
     "cluster.members": "live members in the current view",

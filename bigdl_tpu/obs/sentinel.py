@@ -90,6 +90,12 @@ _ARTIFACT_GLOBS = (
     # exactly as the single-host decode rows do (the tokens_per_s
     # normalize branch keys families by the row's geometry)
     "DECODE_POOL_r[0-9]*.json",
+    # decode-fleet chaos drills (bench_serving --fleet --chaos): a decode
+    # worker is killed mid-run under streaming load; the bench itself
+    # hard-gates zero failed requests + token parity, so the committed
+    # row only exists for a passing run — the sentinel trends the
+    # recovery tail (lower-better) and the under-chaos throughput
+    "DECODE_CHAOS_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic, collective
@@ -98,6 +104,7 @@ _LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms",
                            "decode_ttft_ms_p50", "decode_ttft_ms_p99",
                            "decode_inter_token_p99_ms",
                            "cluster_mttr_s", "cluster_recovery_bytes",
+                           "chaos_recovery_ms_p99",
                            "slo_alert_latency_s",
                            "multichip_ici_bytes_per_step",
                            "multichip_dcn_bytes_per_step",
@@ -200,6 +207,19 @@ def normalize(doc: Any, source: str) -> List[Row]:
         # beating the whole-batch-restart baseline
         add(f"decode_speedup_vs_static{sfx}",
             row.get("speedup_vs_static"))
+    if row.get("bench") == "decode_chaos":
+        # DECODE_CHAOS_r*.json (bench_serving --fleet --chaos): the
+        # pass/fail gates (zero failed requests, byte parity across the
+        # mid-run worker kill) are enforced by the bench before the row
+        # is written; here we trend what CAN regress gradually — the
+        # failover recovery tail and throughput under chaos.  Geometry-
+        # scoped like every serving family.
+        geo = re.sub(r"[^A-Za-z0-9]+", "_",
+                     str(row.get("geometry") or "")).strip("_")
+        sfx = f"_{geo}" if geo else ""
+        add(f"chaos_recovery_ms_p99{sfx}", row.get("recovery_ms_p99"),
+            LOWER)
+        add(f"chaos_tokens_per_s{sfx}", row.get("chaos_tokens_per_s"))
     if "slo_alert_latency_s" in row:
         # SLO_r*.json burn-rate drills: both values are quantized to the
         # evaluation cadence / a hard injected violation, so they are

@@ -74,7 +74,25 @@ POINTS = ("step_fail", "checkpoint_write_fail", "storage_io_fail",
           #   preemption notice (maintenance event on SOME host; every
           #   member must take the just-in-time checkpoint)
           "cluster_host_loss", "cluster_partition", "cluster_slow_peer",
-          "cluster_preempt_notice")
+          "cluster_preempt_notice",
+          # decode-fleet chaos seams (docs/serving.md §Fleet fault
+          # tolerance) — the stateful-serving failure modes the pool's
+          # failover/migration machinery must absorb:
+          # - fleet_worker_kill    — os._exit in a decode worker with
+          #   streams mid-flight (kill -9 / preemption; the proxy must
+          #   fail the streams over, not drop them)
+          # - fleet_handoff_corrupt — fired at the migration/handoff
+          #   export seam: the shipped blob arrives corrupt, so the
+          #   importer must reject it cleanly and the stream complete
+          #   via re-prefill failover instead
+          # - fleet_stream_sever   — raise at the proxy's stream-relay
+          #   seam (connection reset mid-stream without the worker
+          #   dying; exercises resume with a live victim)
+          # - fleet_health_stale   — raise in the proxy's /health probe
+          #   (a worker that stops answering health without dying;
+          #   drives snapshot invalidation + re-route)
+          "fleet_worker_kill", "fleet_handoff_corrupt",
+          "fleet_stream_sever", "fleet_health_stale")
 
 
 class InjectedFault(RuntimeError):
@@ -130,6 +148,22 @@ class PreemptNoticeFault(InjectedFault):
     event, never propagated as an error."""
 
 
+class StreamSeveredError(InjectedFault, ConnectionResetError):
+    """``fleet_stream_sever`` — the proxy's relay loop sees it exactly
+    as a worker connection dying mid-stream, triggering failover while
+    the worker itself stays healthy."""
+
+
+class HandoffCorruptFault(InjectedFault):
+    """``fleet_handoff_corrupt`` — raised at the handoff/migration
+    export seam; the caller degrades to the re-prefill failover path."""
+
+
+class HealthStaleFault(InjectedFault):
+    """``fleet_health_stale`` — a /health probe that never answers; the
+    proxy treats the worker as unprobeable and routes around it."""
+
+
 _EXC = {
     "step_fail": InjectedStepFailure,
     "checkpoint_write_fail": InjectedCheckpointWriteError,
@@ -143,6 +177,10 @@ _EXC = {
     "cluster_partition": PartitionError,
     "cluster_slow_peer": InjectedFault,
     "cluster_preempt_notice": PreemptNoticeFault,
+    "fleet_worker_kill": ProcessKilledError,
+    "fleet_handoff_corrupt": HandoffCorruptFault,
+    "fleet_stream_sever": StreamSeveredError,
+    "fleet_health_stale": HealthStaleFault,
 }
 
 
@@ -166,7 +204,8 @@ class FaultSpec:
                            "serving_slow_batch": "sleep",
                            "cluster_slow_peer": "sleep",
                            "process_kill": "exit",
-                           "serving_worker_kill": "exit"}.get(
+                           "serving_worker_kill": "exit",
+                           "fleet_worker_kill": "exit"}.get(
                                self.point, "raise")
         if self.max_fires is None and self.at_step is not None:
             self.max_fires = 1

@@ -68,11 +68,27 @@ import jax.numpy as jnp
 from bigdl_tpu.nn.attention import _attn_project, positional_encoding
 from bigdl_tpu.nn.module import EMPTY
 from bigdl_tpu.obs import flight, trace
+from bigdl_tpu.resilience import faults
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving.decode")
 
 _NEG_INF = -1e30
+
+
+class RequestCancelledError(RuntimeError):
+    """A request was cancelled before completing — its client went away
+    (``reason="client_disconnect"``) or its live slot was migrated to a
+    peer worker during a drain (``reason="migrated"``).  Carries the
+    request id and reason so the HTTP frontend can pick the right
+    framing: a disconnected client gets nothing (it's gone), a migrated
+    stream is aborted WITHOUT the chunked terminator so the pool proxy
+    detects truncation and fails the stream over."""
+
+    def __init__(self, rid: str, reason: str):
+        super().__init__(f"request {rid} cancelled: {reason}")
+        self.rid = rid
+        self.reason = reason
 
 
 # ---------------------------------------------------------------------------
@@ -217,9 +233,10 @@ class _ActiveSeq:
     """Host-side state of one occupied slot."""
 
     __slots__ = ("req", "prompt", "ctx", "pages", "reserved",
-                 "generated", "logp", "first_logp", "prefill_pos",
-                 "shared", "shared_entry",
-                 "first_token_t", "last_token_t", "max_new", "done")
+                 "generated", "logp", "first_logp", "last_logp",
+                 "prefill_pos", "shared", "shared_entry",
+                 "first_token_t", "last_token_t", "max_new", "done",
+                 "frozen")
 
     def __init__(self, req: DecodeRequest, prompt: np.ndarray, ctx,
                  reserved: int, max_new: int):
@@ -232,6 +249,7 @@ class _ActiveSeq:
         self.generated: List[int] = []
         self.logp = np.float32(0.0)
         self.first_logp = np.float32(0.0)
+        self.last_logp = np.float32(0.0)
         self.prefill_pos = 0          # prompt tokens consumed by prefill
         self.shared: List[int] = []   # prefix-cache pages mapped read-only
         self.shared_entry = None      # the cache entry holding our ref
@@ -239,6 +257,7 @@ class _ActiveSeq:
         self.last_token_t = 0.0
         self.max_new = max_new
         self.done = False
+        self.frozen = False   # migration export taken; no more decoding
 
     @property
     def prefilling(self) -> bool:
@@ -659,9 +678,15 @@ class DecodeEngine:
         flight.register_dump_source(
             f"decode_engine:{name}:{id(self):x}", self._ring_snapshot)
         self._tokens_window = deque(maxlen=256)   # (t, n) for tokens/s
+        # cross-thread cancellation: rid -> reason, swept on the engine
+        # thread; _iter_lock serializes one engine iteration against
+        # migrate_live_slots so an export+freeze is atomic w.r.t. steps
+        self._cancelled: Dict[str, str] = {}
+        self._iter_lock = threading.Lock()
         self.stats = {"requests": 0, "completed": 0, "expired": 0,
                       "tokens": 0, "steps": 0, "prefill_chunks": 0,
-                      "rejected": 0, "kv_exports": 0, "kv_imports": 0}
+                      "rejected": 0, "kv_exports": 0, "kv_imports": 0,
+                      "cancelled": 0}
         self.metrics.describe(
             "serving.decode.tokens_per_s",
             "generated tokens/s over the recent step window")
@@ -788,6 +813,127 @@ class DecodeEngine:
         if self._prefix_cache is not None:
             out["prefix_cache"] = self._prefix_cache.stats()
         return out
+
+    # -- cancellation / live migration (docs/serving.md §Fleet fault
+    # tolerance) ------------------------------------------------------------
+    def cancel(self, rid: str, reason: str = "cancelled") -> None:
+        """Cancel a queued or in-flight request from any thread.  The
+        engine thread sweeps the mark at the next iteration: a queued
+        request is dropped from the heap, an active slot frees its
+        pages immediately (a disconnected stream must not decode to
+        ``max_new_tokens`` on a dead socket).  Unknown rids are a no-op
+        — the request may have just finished."""
+        with self._cv:
+            self._cancelled[rid] = reason
+            self._cv.notify_all()
+
+    def _sweep_cancelled(self) -> None:
+        with self._cv:
+            if not self._cancelled:
+                return
+            marks = self._cancelled
+            self._cancelled = {}
+            keep = [(d, q, r) for d, q, r in self._heap
+                    if r.rid not in marks]
+            dropped = [r for _, _, r in self._heap if r.rid in marks]
+            if dropped:
+                self._heap = keep
+                heapq.heapify(self._heap)
+        for req in dropped:
+            self.events.append(("cancel_queued", req.rid,
+                                marks[req.rid]))
+            self._count_cancel(marks[req.rid])
+            self._finish_error(
+                req, RequestCancelledError(req.rid, marks[req.rid]))
+        for s, seq in enumerate(self._slots):
+            if seq is not None and seq.req.rid in marks:
+                reason = marks[seq.req.rid]
+                self.events.append(("cancel", seq.req.rid, s, reason))
+                self._count_cancel(reason)
+                err = RequestCancelledError(seq.req.rid, reason)
+                if seq.generated:
+                    err.partial_tokens = np.asarray(
+                        seq.generated, np.int32)
+                self._finish_error(seq.req, err)
+                self._release_slot(s)
+
+    def _count_cancel(self, reason: str) -> None:
+        self.stats["cancelled"] += 1
+        self.metrics.inc("serving.decode.cancelled")
+        if reason == "client_disconnect":
+            self.metrics.inc("serving.decode.client_disconnects")
+
+    def migrate_live_slots(self) -> Tuple[List[dict], List[str], List[str]]:
+        """Freeze-and-export every migratable live slot (docs/serving.md
+        §Fleet fault tolerance): under ``_iter_lock`` — atomically
+        w.r.t. engine iterations, so no token is emitted after its
+        slot's state left — copy each eligible slot's written KV pages
+        plus sampling state into a handoff dict the peer can import via
+        ``submit_prefilled``, and deactivate the slot.  The caller
+        ships the blobs, THEN evicts the frozen rids with
+        :meth:`cancel` (``reason="migrated"``), so the peer has parked
+        the state before the victim's stream aborts.
+
+        The export is shaped exactly as a fresh prefill of
+        ``prompt + generated[:-1]`` would export: ``lengths[s]`` cache
+        positions are written (the pending last token's K/V lands next
+        step, so it travels as ``first_token``), and the byte-parity
+        invariant (counter-based sampling keys at absolute positions)
+        makes the importing engine's continuation byte-identical to the
+        no-fault run.
+
+        Returns ``(exports, frozen_rids, leftover_rids)`` — leftover =
+        live-but-ineligible (still prefilling, no token yet, or
+        seq2seq) plus queued generate requests; the caller evicts those
+        too and lets the proxy's re-prefill failover recover them."""
+        exports: List[dict] = []
+        frozen: List[str] = []
+        leftover: List[str] = []
+        cfg = self.cfg
+        if not cfg.continuous:
+            return exports, frozen, leftover
+        with self._iter_lock:
+            for s, seq in enumerate(self._slots):
+                if seq is None or seq.done or seq.frozen:
+                    continue
+                req = seq.req
+                eligible = (not seq.ctx and not seq.prefilling
+                            and len(seq.generated) >= 1
+                            and not req.export_kv)
+                if not eligible:
+                    leftover.append(req.rid)
+                    continue
+                n = -(-int(self._lengths[s]) // cfg.page_size)
+                pids = np.zeros((cfg.pages_per_slot,), np.int32)
+                pids[:n] = self._page_table[s, :n]
+                k = np.asarray(self._kv_k[:, pids], np.float32)[:, :n]
+                v = np.asarray(self._kv_v[:, pids], np.float32)[:, :n]
+                tokens = np.concatenate([
+                    np.asarray(seq.prompt, np.int32),
+                    np.asarray(seq.generated[:-1], np.int32)])
+                exports.append({
+                    "tokens": tokens,
+                    "first_token": int(seq.generated[-1]),
+                    "first_logp": float(seq.last_logp),
+                    "temperature": float(req.temperature),
+                    "top_k": int(req.top_k),
+                    "top_p": float(req.top_p),
+                    "seed": int(req.seed),
+                    "request_id": req.rid,
+                    "migrated": True,
+                    "resume_len": len(seq.generated),
+                    "k": k,
+                    "v": v,
+                })
+                seq.frozen = True
+                self._active_mask[s] = False
+                frozen.append(req.rid)
+                self.stats["kv_exports"] += 1
+                self.metrics.inc("serving.fleet.kv_exports")
+                self.events.append(("kv_export", req.rid, int(n)))
+            with self._cv:
+                leftover.extend(r.rid for _, _, r in self._heap)
+        return exports, frozen, leftover
 
     # -- lifecycle ----------------------------------------------------------
     def _ensure_thread(self) -> None:
@@ -1086,11 +1232,13 @@ class DecodeEngine:
                     self._cv.wait(0.2)
                     continue
             try:
-                now = time.time()
-                self._expire(now)
-                self._admit(now)
-                did = self._decode_step()
-                did = self._prefill_one() or did
+                with self._iter_lock:
+                    now = time.time()
+                    self._sweep_cancelled()
+                    self._expire(now)
+                    self._admit(now)
+                    did = self._decode_step()
+                    did = self._prefill_one() or did
                 if not did:
                     # queued work blocked on slots/pages (or an empty
                     # beat between admission and prefill): wait for a
@@ -1102,10 +1250,11 @@ class DecodeEngine:
                 # with an explicit verdict and keep serving
                 log.error("decode engine iteration failed: %s", e,
                           exc_info=True)
-                for s, seq in enumerate(self._slots):
-                    if seq is not None:
-                        self._finish_error(seq.req, e)
-                        self._release_slot(s)
+                with self._iter_lock:
+                    for s, seq in enumerate(self._slots):
+                        if seq is not None:
+                            self._finish_error(seq.req, e)
+                            self._release_slot(s)
 
     def _expire(self, now: float) -> None:
         """Deadline enforcement at BOTH granularities: queued requests
@@ -1403,6 +1552,9 @@ class DecodeEngine:
         static_wave = not cfg.continuous and occupied
         if not active and not static_wave:
             return False
+        # chaos seam: a decode worker dying (os._exit) with streams in
+        # flight — the pool proxy must fail the streams over
+        faults.fire("fleet_worker_kill")
         for s in active:
             self._ensure_pages(s, int(self._lengths[s]) + 1)
         ref = active if active else occupied
@@ -1477,6 +1629,7 @@ class DecodeEngine:
         seq.last_token_t = now
         seq.generated.append(tok)
         seq.logp = np.float32(seq.logp + logp)
+        seq.last_logp = np.float32(logp)
         if req.on_token is not None:
             try:
                 req.on_token(req.rid, tok, len(seq.generated) - 1)

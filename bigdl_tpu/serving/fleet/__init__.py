@@ -28,8 +28,10 @@ Everything here preserves the engine's byte-identical-to-
 cached-prefix attach and cross-worker prefill→decode handoff.
 """
 
-from bigdl_tpu.serving.fleet.handoff import pack_handoff, unpack_handoff
+from bigdl_tpu.serving.fleet.handoff import (HandoffError, pack_handoff,
+                                             unpack_handoff)
 from bigdl_tpu.serving.fleet.prefix_cache import PrefixCache
 from bigdl_tpu.serving.fleet.router import FleetRouter
 
-__all__ = ["FleetRouter", "PrefixCache", "pack_handoff", "unpack_handoff"]
+__all__ = ["FleetRouter", "HandoffError", "PrefixCache", "pack_handoff",
+           "unpack_handoff"]

@@ -27,17 +27,39 @@ here.
 """
 
 import json
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
-__all__ = ["pack_handoff", "unpack_handoff", "HANDOFF_MAGIC"]
+__all__ = ["pack_handoff", "unpack_handoff", "HANDOFF_MAGIC",
+           "HandoffError", "MAX_HANDOFF_BYTES"]
 
 HANDOFF_MAGIC = b"BDLFKV1\n"
 
 # header fields every handoff carries; anything else JSON-serializable
 # rides along untouched (request_id, deadline, tenant...)
 _REQUIRED = ("tokens", "first_token", "first_logp")
+
+# hard ceiling on an accepted blob: a misbehaving (or chaos-injected)
+# prefill worker must not be able to make a decode worker materialize an
+# unbounded numpy array.  256 MiB covers every geometry this repo ships
+# (the bench fleet's largest handoff is < 1 MiB) with 2+ orders of
+# margin; callers with bigger pools pass max_bytes explicitly.
+MAX_HANDOFF_BYTES = 256 * 1024 * 1024
+
+# the JSON header is small (tokens + sampling meta); a multi-megabyte
+# header length is corruption, not a big request
+_MAX_HEADER_BYTES = 16 * 1024 * 1024
+
+
+class HandoffError(ValueError):
+    """A handoff blob failed validation — corrupt magic, truncated or
+    lying header, payload shorter than the header promises, or a
+    page/byte count over the caller's bound.  Subclasses ValueError so
+    pre-existing ``pytest.raises(ValueError, ...)`` specs (and callers
+    catching ValueError) keep working; raised *before* any page is
+    allocated on the importing engine, so a rejected blob never leaves
+    partially-imported state behind."""
 
 
 def pack_handoff(h: Dict[str, Any]) -> bytes:
@@ -64,25 +86,64 @@ def pack_handoff(h: Dict[str, Any]) -> bytes:
                      k.tobytes(), v.tobytes()])
 
 
-def unpack_handoff(data: bytes) -> Dict[str, Any]:
-    """Exact inverse of :func:`pack_handoff`."""
+def unpack_handoff(data: bytes, max_bytes: int = MAX_HANDOFF_BYTES,
+                   max_pages: Optional[int] = None) -> Dict[str, Any]:
+    """Exact inverse of :func:`pack_handoff`, hardened against corrupt
+    or adversarial blobs: every structural violation raises
+    :class:`HandoffError` before any array is materialized.
+
+    ``max_bytes`` bounds the accepted blob size; ``max_pages`` (when
+    given, e.g. the importing engine's ``prefix_cache_pages``) bounds
+    the page axis of the declared shape so a bad prefill worker can't
+    make the decode worker allocate pages it doesn't have."""
+    if len(data) > max_bytes:
+        raise HandoffError(f"handoff blob of {len(data)} bytes exceeds "
+                           f"the {max_bytes}-byte bound")
     if not data.startswith(HANDOFF_MAGIC):
-        raise ValueError("not a KV handoff (bad magic)")
+        raise HandoffError("not a KV handoff (bad magic)")
     off = len(HANDOFF_MAGIC)
+    if len(data) < off + 8:
+        raise HandoffError("handoff truncated: header length missing")
     hlen = int.from_bytes(data[off:off + 8], "big")
     off += 8
-    header = json.loads(data[off:off + hlen].decode())
+    if hlen > _MAX_HEADER_BYTES or off + hlen > len(data):
+        raise HandoffError(f"handoff truncated: header claims {hlen} "
+                           f"bytes, blob has {len(data) - off} after it")
+    try:
+        header = json.loads(data[off:off + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise HandoffError(f"handoff header is not valid JSON: {e}")
+    if not isinstance(header, dict):
+        raise HandoffError("handoff header must be a JSON object")
     off += hlen
     if header.get("version") != 1:
-        raise ValueError(f"unsupported handoff version "
-                         f"{header.get('version')!r}")
-    shape = tuple(header.pop("shape"))
-    if header.pop("dtype") != "float32":
-        raise ValueError("handoff dtype must be float32")
-    nbytes = int(np.prod(shape)) * 4
+        raise HandoffError(f"unsupported handoff version "
+                           f"{header.get('version')!r}")
+    for key in _REQUIRED:
+        if key not in header:
+            raise HandoffError(f"handoff missing required field {key!r}")
+    if (not isinstance(header["tokens"], list)
+            or not all(isinstance(t, int) for t in header["tokens"])):
+        raise HandoffError("handoff tokens must be a list of ints")
+    raw_shape = header.pop("shape", None)
+    if (not isinstance(raw_shape, list) or len(raw_shape) != 5
+            or not all(isinstance(d, int) and d >= 0 for d in raw_shape)):
+        raise HandoffError(f"handoff K/V must share a 5-d page-pool "
+                           f"shape, got {raw_shape!r}")
+    shape = tuple(raw_shape)
+    if header.pop("dtype", None) != "float32":
+        raise HandoffError("handoff dtype must be float32")
+    if max_pages is not None and shape[1] > max_pages:
+        raise HandoffError(f"handoff declares {shape[1]} pages, over the "
+                           f"importer's {max_pages}-page bound")
+    nbytes = int(np.prod(shape, dtype=np.int64)) * 4
+    if 2 * nbytes > max_bytes:
+        raise HandoffError(f"handoff shape {shape} implies {2 * nbytes} "
+                           f"payload bytes, over the {max_bytes}-byte "
+                           "bound")
     if len(data) != off + 2 * nbytes:
-        raise ValueError(f"handoff payload truncated: expected "
-                         f"{off + 2 * nbytes} bytes, got {len(data)}")
+        raise HandoffError(f"handoff payload truncated: expected "
+                           f"{off + 2 * nbytes} bytes, got {len(data)}")
     k = np.frombuffer(data, np.float32, count=nbytes // 4,
                       offset=off).reshape(shape)
     v = np.frombuffer(data, np.float32, count=nbytes // 4,

@@ -62,6 +62,27 @@ log = get_logger("bigdl_tpu.serving.http")
 REQUEST_ID_RE = re.compile(r"[A-Za-z0-9._:\-]{1,128}")
 
 
+def _adoptable(parked: dict, tokens, resume: list, kw: dict) -> bool:
+    """A parked migration handoff is adoptable iff it is EXACTLY the
+    state the resuming stream needs: its tokens are prompt + all-but-
+    the-last delivered token, its first_token is the last delivered
+    token, and the sampling meta matches — anything else and the
+    byte-parity invariant is safer served by re-prefill."""
+    try:
+        pt = np.asarray(parked["tokens"], np.int32).reshape(-1)
+        want = np.concatenate([np.asarray(tokens, np.int32).reshape(-1),
+                               np.asarray(resume[:-1], np.int32)])
+        return (len(pt) == len(want) and bool(np.array_equal(pt, want))
+                and int(parked["first_token"]) == int(resume[-1])
+                and float(parked.get("temperature", 0.0))
+                == float(kw["temperature"])
+                and int(parked.get("top_k", 0)) == int(kw["top_k"])
+                and float(parked.get("top_p", 1.0)) == float(kw["top_p"])
+                and int(parked.get("seed", 0)) == int(kw["seed"]))
+    except Exception:  # noqa: BLE001 — a malformed park is not adoptable
+        return False
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "bigdl-tpu-serving/1"
     # keep-alive: the proxy's per-worker connection reuse (and any
@@ -123,6 +144,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._generate()
         if self.path == "/fleet/prefill":
             return self._fleet_prefill()
+        if self.path == "/fleet/import":
+            return self._fleet_import()
+        if self.path == "/fleet/drain":
+            return self._fleet_drain()
+        if self.path == "/fleet/evict":
+            return self._fleet_evict()
         if self.path != "/predict":
             return self._json(404, {"error": f"unknown path {self.path}"})
         srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
@@ -284,6 +311,15 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — keep serving
                 return self._json(500, {"error": str(e)})
         data = pack_handoff(handoff)
+        try:
+            # chaos seam: a corrupt handoff off the prefill wire — the
+            # decode worker's hardened unpack rejects it whole and the
+            # stream falls back to a local prefill
+            from bigdl_tpu.resilience import faults
+
+            faults.fire("fleet_handoff_corrupt")
+        except Exception:  # noqa: BLE001 — any configured action corrupts
+            data = b"XXXXXXXX" + data[8:]
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(data)))
@@ -291,25 +327,113 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _fleet_import(self):
+        """POST /fleet/import — park a migrated-in KV handoff (raw
+        ``pack_handoff`` bytes) until the pool proxy re-places the
+        stream here with ``resume_from``; the resume then adopts the
+        parked pages instead of re-prefilling (docs/serving.md §Fleet
+        fault tolerance).  A corrupt blob is rejected whole (400) —
+        the hardened unpack never partially allocates."""
+        from bigdl_tpu.serving.fleet import HandoffError, unpack_handoff
+
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length < 0:
+                raise ValueError(length)
+        except ValueError:
+            self.close_connection = True
+            return self._json(400, {"error": "bad Content-Length"})
+        if length > self.server.max_body_bytes:  # type: ignore[attr-defined]
+            self.close_connection = True
+            return self._json(413, {"error": f"handoff of {length} bytes "
+                                    "exceeds limit"})
+        data = self.rfile.read(length)
+        cfg = srv.decode_config()
+        try:
+            h = unpack_handoff(
+                data,
+                max_bytes=self.server.max_body_bytes,  # type: ignore[attr-defined]
+                max_pages=getattr(cfg, "pages_per_slot", None))
+        except HandoffError as e:
+            return self._json(400, {"error": str(e)})
+        rid = srv.park_handoff(h)
+        self._json(200, {"parked": rid})
+
+    def _fleet_drain(self):
+        """POST /fleet/drain — live-migrate this worker's decode slots
+        to ``{"peers": [urls]}``.  ``"evict": false`` leaves the frozen
+        slots in place for a later ``/fleet/evict`` (the pool's
+        two-phase drain: record the migration map BEFORE the victim's
+        streams abort)."""
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            payload = self._read_json_body()
+            if payload is None:
+                return
+            peers = [str(p) for p in payload.get("peers", [])]
+            evict = bool(payload.get("evict", True))
+            model = payload.get("model")
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"bad request: {e}"})
+        try:
+            out = srv.drain_decode(peers, model=model, evict=evict)
+        except Exception as e:  # noqa: BLE001 — drain is best-effort
+            return self._json(500, {"error": str(e)})
+        self._json(200, out)
+
+    def _fleet_evict(self):
+        """POST /fleet/evict — phase two of the two-phase drain: abort
+        the frozen ``{"rids": [...]}`` whose state already shipped."""
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        try:
+            payload = self._read_json_body()
+            if payload is None:
+                return
+            rids = [str(r) for r in payload.get("rids", [])]
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"bad request: {e}"})
+        srv.evict_migrated(rids)
+        self._json(200, {"evicted": len(rids)})
+
     def _remote_prefill(self, url: str, tokens, kw: dict):
         """Ship the prompt to a prefill worker; returns the unpacked
-        handoff, or None on any failure (caller prefills locally)."""
+        handoff, or None on any failure (caller prefills locally).
+
+        The deadline is hedged: ``prefill_hedge_s`` (when set, tighter
+        than ``predict_timeout``) bounds how long a slow prefill worker
+        can stall this stream's TTFT — on breach the request falls back
+        to the local prefill path immediately and the breach is counted
+        as ``serving.fleet.hedged_prefills``."""
+        import socket
+
         from bigdl_tpu.serving.fleet import unpack_handoff
 
+        srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
+        timeout = getattr(self.server, "prefill_hedge_s", None) \
+            or self.server.predict_timeout  # type: ignore[attr-defined]
         body = json.dumps({
             "tokens": np.asarray(tokens, np.int32).tolist(),
             "temperature": kw["temperature"], "top_k": kw["top_k"],
             "top_p": kw["top_p"], "seed": kw["seed"],
             "model": kw.get("model"),
             "request_id": kw.get("request_id")}).encode()
+        cfg = srv.decode_config(kw.get("model"))
         try:
             req = _urlreq.Request(
                 url.rstrip("/") + "/fleet/prefill", data=body,
                 headers={"Content-Type": "application/json"})
-            with _urlreq.urlopen(
-                    req, timeout=self.server.predict_timeout) as resp:  # type: ignore[attr-defined]
-                return unpack_handoff(resp.read())
+            with _urlreq.urlopen(req, timeout=timeout) as resp:
+                return unpack_handoff(
+                    resp.read(),
+                    max_pages=getattr(cfg, "pages_per_slot", None))
         except Exception as e:  # noqa: BLE001 — split is best-effort
+            reason = getattr(e, "reason", None)
+            if isinstance(e, (socket.timeout, TimeoutError)) \
+                    or isinstance(reason, (socket.timeout, TimeoutError)):
+                srv.metrics.inc("serving.fleet.hedged_prefills")
             log.warning("remote prefill at %s failed (%s); "
                         "prefilling locally", url, e)
             return None
@@ -347,6 +471,12 @@ class _Handler(BaseHTTPRequestHandler):
             hdr = self.headers.get("X-Deadline-S")
             raw = payload.get("deadline_s", hdr)
             deadline_s = float(raw) if raw is not None else None
+            resume = payload.get("resume_from")
+            if resume is not None:
+                if not isinstance(resume, list):
+                    return self._json(400, {
+                        "error": "resume_from must be a token list"})
+                resume = [int(t) for t in resume]
             kw = dict(
                 request_id=req_id, deadline_s=deadline_s, model=model,
                 max_new_tokens=(int(payload["max_new_tokens"])
@@ -364,8 +494,69 @@ class _Handler(BaseHTTPRequestHandler):
         # remote-prefill failure falls back to prefilling locally — the
         # split is an optimization, never an availability dependency
         handoff = None
+        prepend: list = []      # tokens the client already holds
+        idx_off = 0             # stream indices continue past them
+        if resume:
+            # mid-stream failover resume (docs/serving.md §Fleet fault
+            # tolerance): the pool proxy re-places a stream whose worker
+            # died, naming the tokens already delivered.  Two recovery
+            # paths, both byte-identical to the no-fault run (sampling
+            # keys are counter-based on ABSOLUTE position, so the state
+            # after prompt+delivered is the state mid-original-run):
+            # adopt a parked migration handoff when one matches, else
+            # re-prefill prompt+delivered through the chunked path.
+            cfg = srv.decode_config(kw["model"])
+            if cfg is None:
+                return self._json(404, {
+                    "error": "no decode engine to resume on"})
+            # the ORIGINAL run's effective token budget (engine
+            # admission clamps); the resumed run generates the rest
+            eff = min(kw["max_new_tokens"] or cfg.max_new_tokens,
+                      cfg.cap - 1, cfg.cap - len(tokens))
+            r = len(resume)
+            rid_hdr = {"X-Request-Id": str(req_id or "")}
+            srv.metrics.inc("serving.fleet.resumes")
+            if r >= eff or resume[-1] == cfg.eos_id:
+                # the original run would have stopped exactly here:
+                # nothing left to generate, answer with what the
+                # client already holds
+                if not stream:
+                    return self._json(200, {"tokens": resume}, rid_hdr)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Request-Id", str(req_id or ""))
+                self.end_headers()
+                self._chunk(json.dumps(
+                    {"done": True, "tokens": resume}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+                return
+            parked = srv.take_parked(str(req_id)) if req_id else None
+            if parked is not None and _adoptable(parked, tokens,
+                                                 resume, kw):
+                # live migration adoption: the peer shipped the slot's
+                # pages here before the victim aborted the stream — no
+                # re-prefill, the last delivered token re-emits as the
+                # handoff's first_token and decode continues
+                handoff = parked
+                prepend = resume[:-1]
+                idx_off = r - 1
+                kw["max_new_tokens"] = eff - (r - 1)
+                srv.metrics.inc("serving.fleet.resume_adopted")
+            else:
+                # re-prefill recovery: prompt + delivered tokens run
+                # through chunked prefill (hitting this worker's prefix
+                # cache for any shared prefix); generation continues at
+                # absolute position prompt+r, exactly where the dead
+                # worker stopped
+                tokens = np.concatenate(
+                    [tokens, np.asarray(resume, np.int32)])
+                prepend = list(resume)
+                idx_off = r
+                kw["max_new_tokens"] = eff - r
+                srv.metrics.inc("serving.fleet.resume_reprefill")
         prefill_url = self.headers.get("X-Prefill-Url")
-        if prefill_url:
+        if prefill_url and not resume:
             handoff = self._remote_prefill(prefill_url, tokens, kw)
         import queue as _queue
 
@@ -373,7 +564,8 @@ class _Handler(BaseHTTPRequestHandler):
         with trace.span("serving/http_generate") as sp:
             try:
                 rid = srv.enqueue_generate(
-                    tokens, on_token=(lambda r, t, i: q.put((t, i)))
+                    tokens,
+                    on_token=(lambda r, t, i: q.put((t, i + idx_off)))
                     if stream else None, handoff=handoff, **kw)
             except KeyError as e:
                 return self._json(404, {"error": str(e)})
@@ -393,6 +585,9 @@ class _Handler(BaseHTTPRequestHandler):
                                   {"Retry-After": str(e.retry_after)})
             sp.set_attribute("request_id", rid)
             if not stream:
+                from bigdl_tpu.serving.decode_engine import \
+                    RequestCancelledError
+
                 rid_hdr = {"X-Request-Id": rid}
                 try:
                     result = srv.query(
@@ -400,10 +595,18 @@ class _Handler(BaseHTTPRequestHandler):
                 except DeadlineExceededError as e:
                     return self._json(504, {"error": str(e),
                                             "expired": True}, rid_hdr)
+                except RequestCancelledError as e:
+                    # slot migrated away mid-request: 503 marks it
+                    # retryable — the pool proxy re-runs it elsewhere
+                    return self._json(
+                        503, {"error": str(e)},
+                        dict(rid_hdr, **{"Retry-After": "0.05"}))
                 except Exception as e:  # noqa: BLE001
                     return self._json(500, {"error": str(e)}, rid_hdr)
                 return self._json(
-                    200, {"tokens": np.asarray(result).tolist()}, rid_hdr)
+                    200,
+                    {"tokens": prepend + np.asarray(result).tolist()},
+                    rid_hdr)
             # streaming: chunked NDJSON, one event per token
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
@@ -467,22 +670,38 @@ class _Handler(BaseHTTPRequestHandler):
                         return
                 # drain any tokens that raced the final verdict
                 _drain_now()
+                from bigdl_tpu.serving.decode_engine import \
+                    RequestCancelledError
+
                 try:
                     result = srv.query(rid, timeout=1.0)
-                    final = {"done": True,
-                             "tokens": np.asarray(result).tolist()}
+                    final = {"done": True, "tokens":
+                             prepend + np.asarray(result).tolist()}
                 except DeadlineExceededError as e:
                     final = {"done": True, "error": str(e),
                              "expired": True}
                     partial = getattr(e, "partial_tokens", None)
                     if partial is not None:
-                        final["tokens"] = np.asarray(partial).tolist()
+                        final["tokens"] = \
+                            prepend + np.asarray(partial).tolist()
+                except RequestCancelledError:
+                    # the slot migrated away (or the client was already
+                    # detected gone): abort WITHOUT the chunked
+                    # terminator — the pool proxy sees a truncated
+                    # stream and fails it over onto the adopting peer;
+                    # a proper 0-chunk here would read as a clean,
+                    # complete (but token-short) stream
+                    self.close_connection = True
+                    return
                 except Exception as e:  # noqa: BLE001
                     final = {"done": True, "error": str(e)}
                 self._chunk(json.dumps(final).encode() + b"\n")
                 self.wfile.write(b"0\r\n\r\n")
             except (BrokenPipeError, ConnectionResetError):
-                self.close_connection = True  # client hung up mid-stream
+                # client hung up mid-stream: free the slot + pages NOW
+                # instead of decoding to max_new_tokens on a dead socket
+                srv.cancel_generate(rid, reason="client_disconnect")
+                self.close_connection = True
 
 
 class HttpFrontend:
@@ -490,12 +709,17 @@ class HttpFrontend:
 
     def __init__(self, serving: ServingServer, host: str = "127.0.0.1",
                  port: int = 0, predict_timeout: float = 30.0,
-                 max_body_bytes: int = 64 * 1024 * 1024):
+                 max_body_bytes: int = 64 * 1024 * 1024,
+                 prefill_hedge_s: Optional[float] = None):
         self.serving = serving
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.serving = serving  # type: ignore[attr-defined]
         self._httpd.predict_timeout = predict_timeout  # type: ignore[attr-defined]
         self._httpd.max_body_bytes = max_body_bytes  # type: ignore[attr-defined]
+        # hedged prefill (docs/serving.md §Fleet fault tolerance): bound
+        # the remote-prefill wait tighter than predict_timeout so a
+        # straggling prefill worker costs a hedge, not a stalled TTFT
+        self._httpd.prefill_hedge_s = prefill_hedge_s  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -562,16 +786,21 @@ class HttpClient:
                  top_p: float = 1.0, seed: int = 0,
                  model: Optional[str] = None,
                  deadline_s: Optional[float] = None,
-                 request_id: Optional[str] = None, stream: bool = False):
+                 request_id: Optional[str] = None, stream: bool = False,
+                 resume_from: Optional[list] = None):
         """POST /generate.  ``stream=False`` returns the generated token
         array; ``stream=True`` returns an iterator of NDJSON events —
         ``{"token": id, "index": n}`` per token, then the final
         ``{"done": true, "tokens": [...]}`` — decoded incrementally
         off the chunked response (the wire-framing round-trip the
-        decode tests pin)."""
+        decode tests pin).  ``resume_from`` re-places a failed-over
+        stream: the tokens already delivered (docs/serving.md §Fleet
+        fault tolerance)."""
         payload = {"tokens": np.asarray(tokens, np.int32).tolist(),
                    "temperature": temperature, "top_k": top_k,
                    "top_p": top_p, "seed": seed, "stream": stream}
+        if resume_from is not None:
+            payload["resume_from"] = [int(t) for t in resume_from]
         if max_new_tokens is not None:
             payload["max_new_tokens"] = max_new_tokens
         if model is not None:

@@ -56,11 +56,21 @@ from typing import Dict, List, Optional, Tuple
 from bigdl_tpu.obs import flight, trace
 from bigdl_tpu.obs.export import CONTENT_TYPE, federate, render_prometheus
 from bigdl_tpu.optim.metrics import global_metrics
+from bigdl_tpu.resilience import faults
 from bigdl_tpu.serving.http_frontend import REQUEST_ID_RE
 from bigdl_tpu.serving.json_http import reply_json
 from bigdl_tpu.utils.log import get_logger
 
 log = get_logger("bigdl_tpu.serving.pool")
+
+# pool stats that ALSO publish under the fleet's canonical metric names
+# (docs/observability.md): the proxy is the only process that can count
+# failovers/orphans — the dying worker can't — so its registry carries
+# the serving.fleet.* series the chaos gate asserts on
+_FLEET_GLOBAL = {"fleet_failovers": "serving.fleet.failovers",
+                 "fleet_migrations": "serving.fleet.migrations",
+                 "fleet_resumed_tokens": "serving.fleet.resumed_tokens",
+                 "fleet_orphans": "serving.fleet.orphaned_requests"}
 
 
 def _worker_main(loader: str, batch_size: int, queue_capacity: int,
@@ -91,7 +101,10 @@ def _worker_main(loader: str, batch_size: int, queue_capacity: int,
     else:
         srv = ServingServer(loaded, cfg).start()
     srv.role = role  # fleet role, reported via /health for the router
-    fe = HttpFrontend(srv, port=0).start()
+    hedge = os.environ.get("BIGDL_TPU_PREFILL_HEDGE_S")
+    fe = HttpFrontend(srv, port=0,
+                      prefill_hedge_s=float(hedge) if hedge else None
+                      ).start()
     print(f"WORKER_URL={fe.url}", flush=True)
     sys.stdin.readline()           # parent closes stdin to stop us
     # drain-before-kill: finish queued requests (new ones are shed with
@@ -116,7 +129,7 @@ class _Breaker:
     forever with nothing ever feeding record_success/failure."""
 
     def __init__(self, fail_threshold: int = 3, cooldown_s: float = 2.0,
-                 name: str = "worker"):
+                 name: str = "worker", on_open=None):
         self.fail_threshold = fail_threshold
         self.cooldown_s = cooldown_s
         self.name = name
@@ -125,6 +138,11 @@ class _Breaker:
         self.trips = 0
         self._opened_t = 0.0
         self._lock = threading.Lock()
+        # fired (outside the lock) each time the breaker TRIPS open —
+        # the pool wires this to invalidate_fleet_snapshot so the router
+        # stops placing onto a worker the breaker just condemned, without
+        # waiting out the snapshot TTL
+        self._on_open = on_open
 
     def _transition(self, new: str, **data) -> None:
         """State change + its flight-recorder event (postmortems must show
@@ -154,15 +172,22 @@ class _Breaker:
             self._transition("closed")
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self.failures += 1
             if (self.state == "half-open"
                     or self.failures >= self.fail_threshold):
                 if self.state != "open":
                     self.trips += 1
+                    opened = True
                 self._transition("open", failures=self.failures,
                                  trips=self.trips)
                 self._opened_t = time.time()
+        if opened and self._on_open is not None:
+            try:
+                self._on_open()
+            except Exception:  # noqa: BLE001 — a callback must not poison
+                pass           # the breaker's own accounting
 
     def reset(self) -> None:
         with self._lock:
@@ -266,7 +291,8 @@ class _Worker:
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 2.0,
                  drain_timeout_s: float = 5.0,
-                 name: str = "worker", role: str = "both"):
+                 name: str = "worker", role: str = "both",
+                 on_breaker_open=None):
         self.loader = loader
         self.batch_size = batch_size
         self.queue_capacity = queue_capacity
@@ -279,7 +305,7 @@ class _Worker:
         self.proc: Optional[subprocess.Popen] = None
         self.url: Optional[str] = None
         self.breaker = _Breaker(breaker_threshold, breaker_cooldown_s,
-                                name=name)
+                                name=name, on_open=on_breaker_open)
 
     def spawn(self, timeout: float = 120.0) -> None:
         env = dict(os.environ, **(self.env or {}))
@@ -411,11 +437,16 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         (breaker already fed)."""
         if not worker.breaker.try_acquire():
             return ("skip", 0, b"")
+        pool: "ServingPool" = self.server.pool
         try:
             code, out, _ = self._forward("POST", worker.url, self.path,
                                          body)
         except Exception:
             worker.breaker.record_failure()
+            # a connection-level failure is fleet-placement news even
+            # below the breaker threshold: the cached health snapshot may
+            # still list this worker as the best decode target
+            pool.invalidate_fleet_snapshot()
             raise
         # the worker is ALIVE and answered: its breaker stays closed.
         # 429/503 are backpressure/draining — route around, the next
@@ -648,8 +679,23 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                       body: bytes, rid_hdr: dict) -> None:
         """Relay a chunked NDJSON token stream through the proxy's
         keep-alive path: one upstream connection held for the stream's
-        life, each worker line re-framed as one chunk to the client as
-        it arrives (token latency is the product — no buffering)."""
+        life, each worker LINE re-framed toward the client as it arrives
+        (token latency is the product — no buffering).
+
+        Mid-stream FAILOVER (docs/serving.md §Fleet fault tolerance):
+        every token line is parsed and its token id recorded in
+        ``delivered`` before it reaches the client, so when the worker
+        dies mid-stream (read error, truncated chunk framing, injected
+        ``fleet_stream_sever``) the proxy re-places the request on the
+        next decode-capable worker with ``resume_from=delivered`` — the
+        engine's position-keyed sampling makes the resumed continuation
+        byte-identical — and relays only tokens past the resume point.
+        A drain-migrated request prefers the peer that adopted its KV
+        (``pool.take_migrated``).  Re-placement rounds retry (the
+        supervisor may still be respawning the fleet) within the
+        predict-timeout budget; only when that runs out is the stream
+        ORPHANED: the client gets a terminal error line and a proper
+        chunk terminator, never a silent truncation."""
         headers = {"Content-Type": "application/json",
                    "X-Request-Id": self._rid}
         if self._deadline_hdr is not None:
@@ -660,84 +706,246 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             headers["X-Prefill-Url"] = self._prefill_hdr
         last_err: Optional[BaseException] = None
         busy: Optional[Tuple[int, bytes]] = None
-        for w in candidates:
-            if not w.breaker.try_acquire():
-                continue
-            resp = conn = None
-            try:
-                for attempt in (0, 1):
-                    conn, reused = pool.conns.acquire(w.url)
-                    try:
-                        conn.request("POST", "/generate", body=body,
-                                     headers=headers)
-                        resp = conn.getresponse()
-                        break
-                    except Exception:
-                        conn.close()
-                        conn = None
-                        if not (reused and attempt == 0):
-                            raise
-                        # stale keep-alive socket: one fresh retry
-            except Exception as e:  # noqa: BLE001 — worker down
-                w.breaker.record_failure()
-                last_err = e
-                continue
-            w.breaker.record_success()
-            if resp.status in (429, 503):
-                # backpressure BEFORE any stream byte: the next decode
-                # worker retries under the same request id
-                busy = (resp.status, resp.read())
-                self._park(pool, w.url, conn, resp)
-                continue
-            chunked = "chunked" in (resp.getheader("Transfer-Encoding")
-                                    or "")
-            if resp.status != 200 or not chunked:
-                # error verdicts (400/404/500...) come back framed with
-                # Content-Length; relay buffered like any forward
-                data = resp.read()
-                self._park(pool, w.url, conn, resp)
-                return self._reply(resp.status, data, rid_hdr)
-            pool._count("stream_relays")
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             resp.getheader("Content-Type")
-                             or "application/x-ndjson")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.send_header("X-Request-Id", self._rid)
-            self.end_headers()
-            complete = False
-            try:
-                # http.client un-chunks the worker stream; re-frame and
-                # forward whatever bytes are AVAILABLE per read — one
-                # token rides alone (latency is the product), a burst of
-                # queued tokens coalesces into one chunk write instead
-                # of paying the relay's per-line cost exactly when the
-                # proxy is busiest.  NDJSON clients split on newlines,
-                # so chunk boundaries need not align with lines.
-                while True:
-                    data = resp.read1(65536)
-                    if not data:
-                        complete = True
-                        break
-                    self.wfile.write(f"{len(data):X}\r\n".encode()
-                                     + data + b"\r\n")
-                self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError):
-                self.close_connection = True  # client hung up mid-stream
-            except Exception:  # noqa: BLE001 — worker died mid-stream
+        delivered: List[int] = []   # token ids already relayed, in order
+        started = False             # 200 + chunked headers already sent
+        failing_since: Optional[float] = None  # first worker-loss instant
+        cur_body = body
+        budget_t = time.time() + float(self.server.predict_timeout)
+        while True:
+            for w in candidates:
+                if not w.breaker.try_acquire():
+                    continue
+                resp = conn = None
                 try:
-                    # terminate the chunked framing so the client sees a
-                    # (truncated but) well-formed stream end
-                    self.wfile.write(b"0\r\n\r\n")
-                except Exception:  # noqa: BLE001
-                    pass
-                self.close_connection = True
-            if complete and not resp.will_close:
-                pool.conns.release(w.url, conn)
+                    for attempt in (0, 1):
+                        conn, reused = pool.conns.acquire(w.url)
+                        try:
+                            conn.request("POST", "/generate", body=cur_body,
+                                         headers=headers)
+                            resp = conn.getresponse()
+                            break
+                        except Exception:
+                            conn.close()
+                            conn = None
+                            if not (reused and attempt == 0):
+                                raise
+                            # stale keep-alive socket: one fresh retry
+                except Exception as e:  # noqa: BLE001 — worker down
+                    w.breaker.record_failure()
+                    pool.invalidate_fleet_snapshot()
+                    last_err = e
+                    continue
+                w.breaker.record_success()
+                if resp.status in (429, 503):
+                    # backpressure BEFORE any stream byte: the next
+                    # decode worker retries under the same request id
+                    # (a resume body re-prefills deterministically, so
+                    # bouncing it between workers is safe)
+                    busy = (resp.status, resp.read())
+                    self._park(pool, w.url, conn, resp)
+                    continue
+                chunked = "chunked" in (resp.getheader("Transfer-Encoding")
+                                        or "")
+                if resp.status != 200 or not chunked:
+                    # error verdicts (400/404/500...) come back framed
+                    # with Content-Length; relay buffered like any
+                    # forward — unless the client already holds half a
+                    # stream, in which case this worker merely refused
+                    # the resume and the ladder continues
+                    data = resp.read()
+                    self._park(pool, w.url, conn, resp)
+                    if started:
+                        last_err = RuntimeError(
+                            f"resume refused: HTTP {resp.status} "
+                            f"{data[:200]!r}")
+                        continue
+                    return self._reply(resp.status, data, rid_hdr)
+                if failing_since is not None:
+                    # the request survived its worker: count the
+                    # failover and the recovery latency the client paid
+                    pool._count("fleet_failovers")
+                    if delivered:
+                        pool._count("fleet_resumed_tokens",
+                                    len(delivered))
+                    global_metrics().observe(
+                        "serving.fleet.recovery_s",
+                        time.time() - failing_since)
+                    flight.record("fleet_failover", request_id=self._rid,
+                                  worker=w.name,
+                                  resumed_tokens=len(delivered))
+                    failing_since = None
+                if not started:
+                    pool._count("stream_relays")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     resp.getheader("Content-Type")
+                                     or "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("X-Request-Id", self._rid)
+                    self.end_headers()
+                    started = True
+                outcome, err = self._pump_stream(pool, w, conn, resp,
+                                                 delivered)
+                if outcome in ("done", "client_gone"):
+                    return
+                # "severed": the WORKER side failed mid-stream
+                w.breaker.record_failure()
+                pool.invalidate_fleet_snapshot()
+                if failing_since is None:
+                    failing_since = time.time()
+                last_err = err
+                cur_body = self._resume_body(body, delivered)
+                if cur_body is None:
+                    return self._orphan(pool, started, last_err, rid_hdr)
+                candidates = self._failover_candidates(pool, w)
+                break  # restart the ladder against the rebuilt list
             else:
+                # ladder exhausted without an answer
+                if not started:
+                    return self._reply_unrouted(pool, busy, last_err,
+                                                rid_hdr)
+                if time.time() >= budget_t:
+                    return self._orphan(pool, started, last_err, rid_hdr)
+                # the fleet may be mid-respawn: wait a beat, rebuild
+                time.sleep(0.25)
+                candidates = self._failover_candidates(pool, None)
+            if started and time.time() >= budget_t:
+                return self._orphan(pool, started, last_err, rid_hdr)
+
+    def _pump_stream(self, pool: "ServingPool", w: "_Worker", conn, resp,
+                     delivered: List[int]
+                     ) -> Tuple[str, Optional[BaseException]]:
+        """Pump one worker's un-chunked NDJSON stream to the client,
+        line-buffered so every ``{"token":..,"index":..}`` event lands in
+        ``delivered`` — the failover resume point — before the client
+        sees it.  Lines whose index is already delivered (an adopting
+        worker re-emits its import-boundary token) are dropped, not
+        duplicated.  Returns ``('done', None)`` after a complete stream
+        (the worker wrote its terminator — a severed socket raises
+        ``IncompleteRead`` from ``read1`` instead), ``('client_gone',
+        None)`` when the CLIENT hung up (write-side failure — never
+        confused with a worker death), or ``('severed', err)`` when the
+        WORKER side failed mid-stream."""
+        buf = b""
+        while True:
+            try:
+                faults.fire("fleet_stream_sever")
+                data = resp.read1(65536)
+            except Exception as e:  # noqa: BLE001 — worker died mid-stream
                 conn.close()
-            return
-        self._reply_unrouted(pool, busy, last_err, rid_hdr)
+                return ("severed", e)
+            if not data:
+                break
+            buf += data
+            out = bytearray()
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                if self._track_line(line, delivered):
+                    out += line + b"\n"
+            if out:
+                try:
+                    self.wfile.write(f"{len(out):X}\r\n".encode()
+                                     + bytes(out) + b"\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    conn.close()  # worker sees the reset and cancels
+                    self.close_connection = True
+                    return ("client_gone", None)
+        try:
+            if buf:
+                # defensive: a final line without its newline
+                self.wfile.write(f"{len(buf):X}\r\n".encode() + buf
+                                 + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            conn.close()
+            self.close_connection = True
+            return ("client_gone", None)
+        if resp.will_close:
+            conn.close()
+        else:
+            pool.conns.release(w.url, conn)
+        return ("done", None)
+
+    @staticmethod
+    def _track_line(line: bytes, delivered: List[int]) -> bool:
+        """Failover bookkeeping for one NDJSON event: token events append
+        to ``delivered``; an index the client already holds (the resume
+        boundary re-emitted by an adopting worker) is dropped.  Anything
+        else — final verdicts, unparseable bytes — passes through
+        untouched."""
+        if not line.strip():
+            return False  # swallow keep-alive blanks, don't re-frame them
+        try:
+            ev = json.loads(line)
+        except Exception:  # noqa: BLE001 — not ours to judge
+            return True
+        if not isinstance(ev, dict):
+            return True
+        idx, tok = ev.get("index"), ev.get("token")
+        if not isinstance(idx, int) or not isinstance(tok, int):
+            return True
+        if idx < len(delivered):
+            return False  # duplicate of a token the client already has
+        delivered.append(tok)
+        return True
+
+    def _resume_body(self, body: bytes, delivered: List[int]
+                     ) -> Optional[bytes]:
+        """Rebuild the request body for a failover re-placement: the
+        original payload plus ``resume_from`` = every token the client
+        already holds (the worker frontend re-prefills prompt+resume, or
+        adopts a parked migration handoff, and continues byte-
+        identically).  None when the body cannot be rebuilt (non-JSON
+        payload) — the caller orphans the stream."""
+        try:
+            payload = json.loads(body)
+        except Exception:  # noqa: BLE001
+            return None
+        if not isinstance(payload, dict):
+            return None
+        if delivered:
+            payload["resume_from"] = list(delivered)
+        payload["stream"] = True
+        return json.dumps(payload).encode()
+
+    def _failover_candidates(self, pool: "ServingPool",
+                             exclude: Optional["_Worker"]
+                             ) -> List["_Worker"]:
+        """Decode-capable routable workers for one failover round — the
+        peer that adopted this request's migrated KV (when the pool
+        drained the dying worker first) sorted to the front, so a
+        migrated request resumes from imported pages instead of paying a
+        full re-prefill."""
+        cands = [w for w in pool._next_workers()
+                 if getattr(w, "role", "both") != "prefill"
+                 and w is not exclude]
+        peer = pool.take_migrated(self._rid)
+        if peer is not None:
+            cands.sort(key=lambda w: 0 if w.url == peer else 1)
+        return cands
+
+    def _orphan(self, pool: "ServingPool", started: bool,
+                err: Optional[BaseException], rid_hdr: dict) -> None:
+        """Every re-placement failed inside the budget: the stream is
+        ORPHANED.  The client gets a terminal error line plus a proper
+        chunk terminator — a well-formed, explicitly failed stream the
+        SDK surfaces as an error, never a silent truncation it could
+        mistake for completion."""
+        pool._count("fleet_orphans")
+        flight.record("fleet_orphan", request_id=self._rid,
+                      error=str(err))
+        if not started:
+            return self._reply_unrouted(pool, None, err, rid_hdr)
+        line = json.dumps(
+            {"done": True,
+             "error": f"stream orphaned: worker lost mid-stream and no "
+                      f"re-placement succeeded ({err})"}).encode() + b"\n"
+        try:
+            self.wfile.write(f"{len(line):X}\r\n".encode() + line
+                             + b"\r\n" + b"0\r\n\r\n")
+        except Exception:  # noqa: BLE001 — client gone too
+            pass
+        self.close_connection = True
 
     def _reply_unrouted(self, pool: "ServingPool",
                         busy: Optional[Tuple[int, bytes]],
@@ -944,10 +1152,20 @@ class ServingPool:
                       "proxy_unavailable": 0, "rejected_oversize": 0,
                       "conn_reuse": 0, "scale_up": 0, "scale_down": 0,
                       "federation_stale": 0, "fleet_routed": 0,
-                      "fleet_split": 0, "stream_relays": 0}
+                      "fleet_split": 0, "stream_relays": 0,
+                      "fleet_failovers": 0, "fleet_migrations": 0,
+                      "fleet_resumed_tokens": 0, "fleet_orphans": 0}
+        # where each drain-migrated request's KV went: request id ->
+        # adopting peer url, recorded in phase 1 of _drain_victim BEFORE
+        # phase 2 severs the victim's streams, so the failover relay
+        # always finds the peer already holding its pages
+        self._migrated: Dict[str, str] = {}
+        self._migrated_lock = threading.Lock()
         # visible at 0 from the first scrape: an alert on increase needs
         # the series to exist BEFORE the first worker dies
         global_metrics().inc("serving_pool.federation_stale", 0)
+        for alias in _FLEET_GLOBAL.values():
+            global_metrics().inc(alias, 0)
 
     def _count(self, name: str, n: int = 1) -> None:
         # proxy handler threads count concurrently; += is not atomic
@@ -956,6 +1174,15 @@ class ServingPool:
         # namespaced into the process registry so the proxy's /metrics
         # scrape exposes them in Prometheus form
         global_metrics().inc(f"serving_pool.{name}", n)
+        alias = _FLEET_GLOBAL.get(name)
+        if alias is not None:
+            global_metrics().inc(alias, n)
+
+    def take_migrated(self, request_id: str) -> Optional[str]:
+        """Pop (single failover consumer) the url of the peer that
+        adopted this request's migrated KV, if a drain recorded one."""
+        with self._migrated_lock:
+            return self._migrated.pop(request_id, None)
 
     @property
     def url(self) -> str:
@@ -991,7 +1218,8 @@ class ServingPool:
         return _Worker(self.loader, self.batch_size, self.queue_capacity,
                        self.worker_env, self.breaker_threshold,
                        self.breaker_cooldown_s, self.drain_timeout_s,
-                       name=name, role=role)
+                       name=name, role=role,
+                       on_breaker_open=self.invalidate_fleet_snapshot)
 
     def start(self) -> "ServingPool":
         # the proxy process is pure I/O relay — handler threads shuttle
@@ -1030,6 +1258,7 @@ class ServingPool:
                 if not w.alive() and not self._stop.is_set():
                     log.warning("serving worker %s died; respawning", w.url)
                     flight.record("worker_died", worker=w.name, url=w.url)
+                    self.invalidate_fleet_snapshot()  # don't route to it
                     if w.url:
                         self.conns.clear(w.url)  # the corpse's sockets
                     w.url = None  # stale endpoint: not routable, not
@@ -1048,10 +1277,24 @@ class ServingPool:
         if not w.routable():
             return None
         try:
+            # chaos seam: fleet_health_stale makes this probe fail as an
+            # injected fault — the router must degrade to role+liveness
+            # scoring, exactly as it does for a genuinely dead worker
+            faults.fire("fleet_health_stale")
             _, data, _ = self.conns.request(w.url, "GET", "/health")
             return json.loads(data)
         except Exception:  # noqa: BLE001 — dead socket or non-JSON body
             return None
+
+    def invalidate_fleet_snapshot(self) -> None:
+        """Drop the TTL-cached fleet snapshot NOW — wired as every worker
+        breaker's ``on_open`` callback and called on connection-level
+        forward failures, so the next /generate routes from fresh healths
+        instead of a snapshot that still scores the dead worker as the
+        best decode target."""
+        with self._fleet_lock:
+            self._fleet_cache = None
+            self._fleet_t = 0.0
 
     def fleet_snapshot(self, max_age_s: Optional[float] = None
                        ) -> List[Tuple[_Worker, Optional[dict]]]:
@@ -1212,10 +1455,63 @@ class ServingPool:
                       workers=len(self.worker_list()), **pressure)
         log.info("autoscale: -%s (idle) -> %d workers", victim.name,
                  len(self.worker_list()))
+        # live KV migration (docs/serving.md §Fleet fault tolerance):
+        # before the drain, the victim exports its in-flight decode
+        # slots to surviving decode-capable peers — a scale-down must
+        # never cost a client its stream
+        peers = [w.url for w in self.worker_list()
+                 if w.routable() and getattr(w, "role", "both") != "prefill"]
+        if peers and victim.url:
+            self._drain_victim(victim, peers)
         victim.request_stop()
         victim.join_stop()
         if victim.url:
             self.conns.clear(victim.url)
+
+    def _drain_victim(self, victim: _Worker, peers: List[str]) -> None:
+        """Two-phase live migration of the victim's in-flight decode
+        slots.  Phase 1 (``/fleet/drain`` with ``evict: false``): the
+        victim freezes each live slot, exports its pages + sampling
+        state as a handoff blob and ships it to a peer, which PARKS it
+        keyed by request id — and reports who adopted what.  The
+        migration map is recorded HERE, at the proxy, before anything is
+        severed.  Phase 2 (``/fleet/evict``): the frozen slots are
+        cancelled, which aborts their victim-side streams WITHOUT a
+        chunk terminator — the relay sees the truncation, finds the
+        adopting peer in ``_migrated`` and resumes from the imported
+        pages.  Any phase failing degrades to plain failover-by-
+        re-prefill; a drain never drops a request."""
+        try:
+            code, out, _ = self.conns.request(
+                victim.url, "POST", "/fleet/drain",
+                body=json.dumps({"peers": peers,
+                                 "evict": False}).encode(),
+                headers={"Content-Type": "application/json"})
+            if code != 200:
+                raise RuntimeError(f"HTTP {code}: {out[:200]!r}")
+            res = json.loads(out)
+        except Exception as e:  # noqa: BLE001 — degrade, never drop
+            log.warning("fleet drain of %s failed (%s); its streams will "
+                        "fail over by re-prefill", victim.name, e)
+            return
+        migrated = res.get("migrated") or {}
+        frozen = res.get("frozen") or []
+        if migrated:
+            with self._migrated_lock:
+                self._migrated.update(migrated)
+            self._count("fleet_migrations", len(migrated))
+        flight.record("fleet_drain", worker=victim.name,
+                      migrated=len(migrated),
+                      failed=len(res.get("failed") or []),
+                      request_ids=sorted(migrated))
+        if frozen:
+            try:
+                self.conns.request(
+                    victim.url, "POST", "/fleet/evict",
+                    body=json.dumps({"rids": frozen}).encode(),
+                    headers={"Content-Type": "application/json"})
+            except Exception as e:  # noqa: BLE001 — stop() severs anyway
+                log.warning("fleet evict on %s failed: %s", victim.name, e)
 
     def stop(self) -> None:
         """Shut down: close the proxy to new requests, then drain each

@@ -282,6 +282,13 @@ class ServingServer:
                       "fallback_batches": 0, "shed_requests": 0,
                       "expired_requests": 0, "drained_requests": 0,
                       "dropped_requests": 0, "results_gc": 0}
+        # migrated-in KV handoffs parked until the pool proxy re-places
+        # the stream here with resume_from (docs/serving.md §Fleet fault
+        # tolerance): rid -> (park time, handoff dict).  Bounded + TTL'd
+        # — an orphaned park (proxy never resumed) must not pin host KV
+        # images forever
+        self._parked: Dict[str, tuple] = {}
+        self._parked_lock = threading.Lock()
         # /metrics HELP lines for the lifecycle counters a fleet alerts on
         # (obs.export renders describe() strings next to # TYPE)
         self.metrics.describe("serving.shed_requests",
@@ -905,6 +912,148 @@ class ServingServer:
         if req.kv_export is None:  # pragma: no cover - engine bug guard
             raise RuntimeError("prefill finished without a KV export")
         return req.kv_export
+
+    # -- fleet fault tolerance (docs/serving.md §Fleet fault tolerance) ------
+    def _engine_for(self, model: Optional[str] = None):
+        """The decode engine serving ``model`` (default tenant when
+        None), or None when the tenant has no engine built."""
+        tenant = self._tenants.get(model or self._default_name)
+        if tenant is None:
+            return None
+        engine = getattr(tenant.model, "decode_engine", None)
+        if engine is None and hasattr(tenant.model, "_engine"):
+            engine = tenant.model._engine()
+        return engine
+
+    def decode_config(self, model: Optional[str] = None):
+        """The decode engine's config (cap, max_new_tokens, eos_id) —
+        what the frontend's resume_from math needs to reproduce the
+        original run's effective token budget."""
+        engine = self._engine_for(model)
+        return None if engine is None else engine.cfg
+
+    def cancel_generate(self, request_id: str,
+                        reason: str = "cancelled") -> None:
+        """Cancel an in-flight generate on every tenant engine that
+        might hold it — the client went away (broken pipe on the
+        stream) or the slot migrated.  Unknown ids are a no-op."""
+        for t in list(self._tenants.values()):
+            engine = getattr(t.model, "decode_engine", None)
+            if engine is not None and hasattr(engine, "cancel"):
+                engine.cancel(request_id, reason)
+
+    _PARKED_MAX = 32
+    _PARKED_TTL_S = 120.0
+
+    def park_handoff(self, handoff: dict) -> str:
+        """Hold a migrated-in KV handoff until the proxy re-places its
+        stream here (``POST /fleet/import`` body).  Returns the parked
+        request id."""
+        rid = str(handoff.get("request_id") or uuid.uuid4().hex)
+        now = time.time()
+        with self._parked_lock:
+            stale = [r for r, (t, _) in self._parked.items()
+                     if now - t > self._PARKED_TTL_S]
+            for r in stale:
+                del self._parked[r]
+            while len(self._parked) >= self._PARKED_MAX:
+                oldest = min(self._parked, key=lambda r: self._parked[r][0])
+                del self._parked[oldest]
+            self._parked[rid] = (now, handoff)
+        self.metrics.inc("serving.fleet.parked_handoffs")
+        return rid
+
+    def take_parked(self, request_id: str) -> Optional[dict]:
+        """Pop a parked migration handoff for adoption (returns None
+        when absent or expired — the resume falls back to re-prefill)."""
+        with self._parked_lock:
+            item = self._parked.pop(request_id, None)
+        if item is None:
+            return None
+        t, handoff = item
+        if time.time() - t > self._PARKED_TTL_S:
+            return None
+        return handoff
+
+    def drain_decode(self, peers: List[str],
+                     model: Optional[str] = None,
+                     timeout: float = 10.0,
+                     evict: bool = True) -> Dict[str, Any]:
+        """Live-drain this worker's decode state (docs/serving.md
+        §Fleet fault tolerance): freeze-and-export every migratable
+        slot, ship each as a BDLFKV1 blob to a peer's ``/fleet/import``
+        (round-robin over ``peers``), then evict the frozen slots so
+        their streams abort and the pool proxy fails them over — onto
+        the peer that parked the state, which adopts it instead of
+        re-prefilling.  A failed ship (or a ``fleet_handoff_corrupt``
+        injection) degrades to the re-prefill failover path: the
+        request is never dropped, it just pays a re-prefill.
+
+        With ``evict=False`` the frozen slots are left in place and
+        their rids returned under ``"frozen"`` — the pool uses the
+        two-phase form (ship, record the migration map, THEN evict) so
+        its failover path already knows the adopting peer when the
+        victim's streams abort."""
+        import urllib.request
+
+        from bigdl_tpu.serving.fleet.handoff import pack_handoff
+
+        engine = self._engine_for(model)
+        if engine is None or not hasattr(engine, "migrate_live_slots"):
+            return {"migrated": {}, "failed": [], "frozen": []}
+        exports, frozen, leftover = engine.migrate_live_slots()
+        migrated: Dict[str, str] = {}
+        failed: List[str] = list(leftover)
+        for i, h in enumerate(exports):
+            rid = str(h["request_id"])
+            blob = pack_handoff(h)
+            try:
+                # chaos seam: a corrupted migration blob — the peer's
+                # hardened unpack rejects it and the stream recovers
+                # through re-prefill failover instead
+                faults.fire("fleet_handoff_corrupt")
+            except faults.HandoffCorruptFault:
+                blob = b"XXXXXXXX" + blob[8:]
+            shipped = None
+            for j in range(len(peers)):
+                peer = peers[(i + j) % len(peers)]
+                try:
+                    req = urllib.request.Request(
+                        peer.rstrip("/") + "/fleet/import", data=blob,
+                        headers={"Content-Type":
+                                 "application/octet-stream"})
+                    with urllib.request.urlopen(
+                            req, timeout=timeout) as resp:
+                        if resp.status == 200:
+                            shipped = peer
+                            break
+                except Exception as e:  # noqa: BLE001 — degrade, never drop
+                    log.warning("KV migration of %s to %s failed: %s",
+                                rid, peer, e)
+            if shipped is None:
+                failed.append(rid)
+            else:
+                migrated[rid] = shipped
+        if evict:
+            for rid in frozen:
+                engine.cancel(rid, "migrated")
+        for rid in leftover:
+            engine.cancel(rid, "migrated")
+        self.metrics.inc("serving.fleet.migrations", len(migrated))
+        flight.record("fleet_drain", migrated=len(migrated),
+                      failed=len(failed),
+                      request_ids=sorted(migrated))
+        return {"migrated": migrated, "failed": failed,
+                "frozen": [] if evict else frozen}
+
+    def evict_migrated(self, request_ids: List[str]) -> None:
+        """Phase two of a two-phase drain: evict the frozen slots whose
+        state already shipped (their streams abort and fail over)."""
+        for t in list(self._tenants.values()):
+            engine = getattr(t.model, "decode_engine", None)
+            if engine is not None and hasattr(engine, "cancel"):
+                for rid in request_ids:
+                    engine.cancel(rid, "migrated")
 
     def query(self, request_id: str, timeout: float = 30.0) -> np.ndarray:
         deadline = time.time() + timeout
