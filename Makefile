@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-fleet bench-fleet-chaos bench-slo dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout test-recsys bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-fleet bench-fleet-chaos bench-slo bench-recsys dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -195,6 +195,15 @@ bench-layout:
 bench-loader:
 	python bench_loader.py
 
+# the recsys serving suite (docs/recsys.md): feature->recall->ranking
+# pipeline end-to-end, sharded-vs-unsharded candidate-id parity, the
+# closed (batch, k) recall bucket set under a mixed sweep (zero
+# unexpected recompiles), predict_inline tenant routing, POST /recommend
+# through the HTTP frontend, and the sharded feature-table merge cap
+test-recsys:
+	python -m pytest tests/test_recsys_pipeline.py \
+	  tests/test_friesian_serving.py tests/test_friesian_sharded.py -q
+
 # sustained-load serving bench (docs/serving.md §Continuous batching):
 # subprocess server + keep-alive load clients, reports rps/p50/p99/
 # occupancy + the zero-recompile mixed-size sweep; --smoke is the CI gate
@@ -222,6 +231,15 @@ bench-fleet:
 # baseline + bounded recovery p99; the DECODE_CHAOS_r*.json source
 bench-fleet-chaos:
 	python bench_serving.py --fleet --chaos
+
+# recsys + forecast bench (docs/recsys.md §Bench geometry): sharded
+# feature engineering -> TwoTower + TCN(parallelism=dp)/Autoformer
+# training, then sustained keep-alive POST /recommend load against the
+# mesh-sharded (fsdp:2,tp:4) pipeline; gates candidate-id parity, the
+# >= 8x per-chip embedding shrink, and zero unexpected recompiles; the
+# RECSYS_r*.json artifact source
+bench-recsys:
+	python bench_recsys.py
 
 # session-long TPU evidence orchestrator (single instance via flock;
 # BENCH_attempts.jsonl evidence trail)

@@ -83,9 +83,32 @@ class BaseForecaster:
 
     # -- lifecycle ----------------------------------------------------------
     def fit(self, data, epochs: int = 10, batch_size: int = 32,
-            validation_data=None) -> "BaseForecaster":
+            validation_data=None,
+            parallelism: Optional[str] = None) -> "BaseForecaster":
+        """Train.  ``parallelism=`` routes through the declarative GSPMD
+        driver (docs/parallelism.md §Declarative layouts) — the same
+        combo-string grammar as ``Estimator(config={"parallelism": ..})``;
+        layout stats land on ``self._layout_stats``.  ``None`` keeps the
+        classic ZeRO-1 Optimizer path."""
         x, y = _as_xy(data, self.lookback, self.horizon)
         ds = DataSet.array(x, y)
+        if parallelism is not None:
+            # same carried-feature contract as the Estimator layout path:
+            # what fit_layout doesn't do yet must fail loudly, not drop
+            if validation_data is not None:
+                raise ValueError(
+                    f"parallelism={parallelism!r} (declarative GSPMD fit) "
+                    "does not support validation_data yet — drop it or "
+                    "unset parallelism to use the classic ZeRO-1 driver "
+                    "(docs/parallelism.md §Declarative layouts)")
+            from bigdl_tpu.parallel.gspmd import fit_layout
+
+            self._trained, self._layout_stats = fit_layout(
+                self.model, self.criterion, self.optim, ds,
+                parallelism=str(parallelism), batch_size=batch_size,
+                epochs=epochs, seed=self.seed)
+            self._opt_cache = {}  # weights changed: traces are stale
+            return self
         opt = Optimizer(self.model, ds, self.criterion, batch_size=batch_size)
         opt.set_optim_method(self.optim)
         opt.set_end_when(Trigger.max_epoch(epochs))

@@ -15,7 +15,11 @@ from bigdl_tpu.friesian.serving import (
     FeatureService, IVFRecallService, RankingService, RecallService,
     Recommender, RecsysHTTPServer,
 )
+from bigdl_tpu.friesian.pipeline import (
+    RecallTopKModel, RankTowerModel, RecommendationPipeline,
+)
 
 __all__ = ["FeatureTable", "StringIndex", "FeatureService", "RecallService",
            "IVFRecallService", "RankingService", "Recommender",
-           "RecsysHTTPServer"]
+           "RecsysHTTPServer", "RecallTopKModel", "RankTowerModel",
+           "RecommendationPipeline"]

@@ -101,22 +101,59 @@ class RecallService:
         return fn
 
     BATCH_BUCKETS = (1, 4, 16, 64, 256)
+    K_BUCKETS = (1, 8, 32, 128)
+
+    def _k_cap(self) -> int:
+        """Largest k a compiled search can return (catalog size here; the
+        IVF subclass caps at its probed candidate pool)."""
+        return self.n_items
+
+    def _k_bucket(self, k: int) -> int:
+        """Round k up to the closed K_BUCKETS set (then clamp to the index
+        cap) so a mixed-k recommend sweep reuses a handful of compiled
+        programs instead of tracing one per distinct k.  A k beyond the
+        largest bucket rounds to the cap itself — NOT to k — so the
+        compile set stays closed even for over-asks on a big catalog."""
+        cap = self._k_cap()
+        kb = next((b for b in self.K_BUCKETS if b >= k), cap)
+        return min(kb, cap)
 
     def search(self, queries, k: int = 10) -> List[List[Tuple[Any, float]]]:
         if self.n_items == 0:
             raise RuntimeError("no items indexed; call add_items first")
         q = np.atleast_2d(np.asarray(queries, np.float32))
-        k = min(k, self.n_items)
+        k = min(k, self._k_cap())
+        kb = self._k_bucket(k)
         n = q.shape[0]
         # pad to a batch bucket so arbitrary request sizes reuse a handful
         # of compiled programs (same discipline as InferenceModel)
         bucket = next((b for b in self.BATCH_BUCKETS if b >= n), n)
         if bucket > n:
             q = np.concatenate([q, np.repeat(q[-1:], bucket - n, 0)])
-        scores, idx = self._searcher(q.shape[0], k)(q)
-        scores, idx = np.asarray(scores)[:n], np.asarray(idx)[:n]
+        scores, idx = self._searcher(q.shape[0], kb)(q)
+        scores, idx = np.asarray(scores)[:n, :k], np.asarray(idx)[:n, :k]
         return [[(self._ids[j], float(s)) for j, s in zip(row_i, row_s)]
                 for row_i, row_s in zip(idx, scores)]
+
+    def warmup(self) -> "RecallService":
+        """Pre-compile every (batch-bucket, k-bucket) program under
+        ``expected_compile`` so the serving path never traces under load —
+        the same closed-bucket discipline as ``InferenceModel.warmup``.
+        After this, a mixed-size search sweep is zero unexpected recompiles
+        under the recompile sentinel."""
+        from bigdl_tpu.obs.attr import expected_compile
+
+        if self.n_items == 0:
+            raise RuntimeError("no items indexed; call add_items first")
+        # the cap rides along: k-asks beyond the largest bucket round to it
+        kbs = sorted({self._k_bucket(b) for b in self.K_BUCKETS}
+                     | {self._k_cap()})
+        with expected_compile():
+            for b in self.BATCH_BUCKETS:
+                q = np.zeros((b, self.dim), np.float32)
+                for kb in kbs:
+                    self._searcher(b, kb)(q)
+        return self
 
 
 class IVFRecallService(RecallService):
@@ -196,15 +233,19 @@ class IVFRecallService(RecallService):
         self._jit_cache.clear()
         return self
 
-    def search(self, queries, k: int = 10) -> List[List[Tuple[Any, float]]]:
+    def _k_cap(self) -> int:
+        # the probed pool holds at most nprobe*max_len candidates; cap k
+        # there (lax.top_k over a narrower row is a trace error)
         if self._centroids is None and self.n_items:
             self.build()
-        # the probed pool holds at most nprobe*max_len candidates; clamp k
-        # there (lax.top_k over a narrower row is a trace error) and drop
-        # -inf padding slots — a thin cluster must not surface phantom ids
-        pool = (self.nprobe * self._lists.shape[1]
-                if self._lists is not None else k)
-        rows = super().search(queries, min(k, pool))
+        if self._lists is None:
+            return self.n_items
+        return min(self.n_items, self.nprobe * self._lists.shape[1])
+
+    def search(self, queries, k: int = 10) -> List[List[Tuple[Any, float]]]:
+        # base search buckets/caps k via _k_cap; drop -inf padding slots —
+        # a thin cluster must not surface phantom ids
+        rows = super().search(queries, k)
         return [[(i, s) for i, s in row if s != float("-inf")]
                 for row in rows]
 
@@ -243,10 +284,19 @@ class IVFRecallService(RecallService):
 
 
 class RankingService:
-    """Model-scored ranking — the InferenceModel-backed ranking service."""
+    """Model-scored ranking — the InferenceModel-backed ranking service.
 
-    def __init__(self, model=None, variables=None, predict_fn=None):
-        self._im = InferenceModel(model, variables, predict_fn=predict_fn)
+    ``layout=`` serves the ranking model mesh-sharded (``InferenceModel``
+    resolves a ``parallelism=`` combo string or a ResolvedLayout — see
+    docs/parallelism.md §Declarative layouts), and ``batch_buckets``
+    closes the compile-shape set like the recall side."""
+
+    def __init__(self, model=None, variables=None, predict_fn=None,
+                 batch_buckets: Sequence[int] = (1, 4, 16, 64, 256),
+                 layout=None):
+        self._im = InferenceModel(model, variables, predict_fn=predict_fn,
+                                  batch_buckets=tuple(batch_buckets),
+                                  layout=layout)
 
     def rank(self, features: np.ndarray) -> np.ndarray:
         """features (n_candidates, ...) -> scores (n_candidates,)."""
@@ -254,6 +304,12 @@ class RankingService:
         if out.ndim > 1:
             out = out.reshape(out.shape[0], -1)[:, -1]  # score column
         return out
+
+    def warmup(self, sample: np.ndarray) -> "RankingService":
+        """Pre-compile every batch bucket from one sample row (delegates to
+        ``InferenceModel.warmup`` under ``expected_compile``)."""
+        self._im.warmup(np.asarray(sample))
+        return self
 
 
 class Recommender:

@@ -28,18 +28,39 @@ from bigdl_tpu.data.shards import XShards
 from bigdl_tpu.friesian.table import FeatureTable, StringIndex
 
 
-def _allgather_objects(obj):
+# default cap on one process's pickled stat payload: the allgather pads
+# every process to the GLOBAL max, so one runaway merge multiplies its
+# bytes by process_count at the rendezvous — fail loudly before that
+MAX_MERGE_BYTES = 64 * 1024 * 1024
+
+
+def _allgather_objects(obj, op: str = "stat_merge",
+                       max_bytes: int = MAX_MERGE_BYTES):
     """Gather one picklable object from every process (list, rank order).
     Single-process: ``[obj]``.  Multi-process: pad pickled bytes to the
     global max and allgather as uint8 (stats are small — vocab counts, not
-    data)."""
+    data).  The payload is bounded by ``max_bytes`` and metered on the
+    ``friesian.sharded.merge_bytes_total`` counter on EVERY path — a
+    vocab merge that outgrows the stat-sized design OOMs the rendezvous
+    otherwise, so it raises here, naming the ``op`` that produced it."""
     import jax
 
+    from bigdl_tpu.optim.metrics import global_metrics
+
+    buf = np.frombuffer(pickle.dumps(obj), np.uint8)
+    global_metrics().inc("friesian.sharded.merge_bytes_total",
+                         float(buf.size))
+    if buf.size > max_bytes:
+        raise ValueError(
+            f"friesian.sharded {op}: pickled stat payload is "
+            f"{buf.size} bytes, over the {max_bytes}-byte merge cap — "
+            f"this allgather is for per-shard STATISTICS (vocab counts, "
+            f"min/max), not data; raise max_bytes only if the stats "
+            f"themselves are genuinely this large")
     if jax.process_count() == 1:
         return [obj]
     from jax.experimental import multihost_utils
 
-    buf = np.frombuffer(pickle.dumps(obj), np.uint8)
     n = np.asarray([buf.size], np.int64)
     sizes = np.asarray(multihost_utils.process_allgather(n)).ravel()
     padded = np.zeros((int(sizes.max()),), np.uint8)
@@ -79,11 +100,12 @@ class ShardedFeatureTable:
     def _map(self, fn) -> "ShardedFeatureTable":
         return ShardedFeatureTable(self.shards.transform_shard(fn))
 
-    def _owned_partials(self, fn) -> List:
+    def _owned_partials(self, fn, op: str = "stat_merge") -> List:
         """``fn`` over each owned shard, then allgather across processes
-        (flattened, deterministic rank-then-shard order)."""
+        (flattened, deterministic rank-then-shard order).  ``op`` names
+        the calling stat op in the merge-cap error."""
         local = [fn(s) for s in self.shards.owned()]
-        gathered = _allgather_objects(local)
+        gathered = _allgather_objects(local, op=op)
         return [p for proc in gathered for p in proc]
 
     def num_partitions(self) -> int:
@@ -133,7 +155,8 @@ class ShardedFeatureTable:
         cols = [columns] if single else list(columns)
 
         partials = self._owned_partials(
-            lambda df: {c: df[c].value_counts().to_dict() for c in cols})
+            lambda df: {c: df[c].value_counts().to_dict() for c in cols},
+            op="gen_string_idx")
         out = []
         for c in cols:
             counts = _merge_counts([p[c] for p in partials])
@@ -156,7 +179,8 @@ class ShardedFeatureTable:
         every category by the rows living on other shards)."""
         cols = [columns] if isinstance(columns, str) else list(columns)
         partials = self._owned_partials(
-            lambda df: {c: df[c].value_counts().to_dict() for c in cols})
+            lambda df: {c: df[c].value_counts().to_dict() for c in cols},
+            op="count_encode")
         merged = {c: _merge_counts([p[c] for p in partials]) for c in cols}
 
         def one(df):
@@ -185,7 +209,7 @@ class ShardedFeatureTable:
                     "t_sum": float(df[target_col].sum()),
                     "t_cnt": int(len(df))}
 
-        partials = self._owned_partials(partial)
+        partials = self._owned_partials(partial, op="target_encode")
         t_cnt = sum(p["t_cnt"] for p in partials)
         g_mean = (sum(p["t_sum"] for p in partials) / t_cnt
                   if t_cnt else 0.0)
@@ -215,7 +239,8 @@ class ShardedFeatureTable:
         cols = [columns] if isinstance(columns, str) else list(columns)
         partials = self._owned_partials(
             lambda df: {c: (float(df[c].min()), float(df[c].max()))
-                        for c in cols})
+                        for c in cols},
+            op="min_max_scale")
         stats = {c: (min(p[c][0] for p in partials),
                      max(p[c][1] for p in partials)) for c in cols}
 

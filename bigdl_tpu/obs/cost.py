@@ -374,6 +374,19 @@ def collective_bytes_for_specs(params, specs, mesh,
                                     dtype_bytes=dtype_bytes)
 
 
+def embedding_lookup_bytes(batch: int, dim: int, sizes,
+                           n_tables: int = 1,
+                           dtype_bytes: int = 4) -> Dict[str, Any]:
+    """Per-axis collective bytes of sparse embedding lookups against a
+    vocab-sharded (fsdp x tp) table — the obs-side reader of the
+    serving-side lookup accounting (docs/recsys.md §Lookup-collective
+    ledger).  The RECSYS sentinel family consumes exactly this dict."""
+    from bigdl_tpu.parallel.layout import embedding_lookup_bytes as _impl
+
+    return _impl(batch, dim, sizes, n_tables=n_tables,
+                 dtype_bytes=dtype_bytes)
+
+
 def collective_ledger(step_engine) -> Dict[str, Any]:
     """Per-step collective-bytes ledger of a
     :class:`~bigdl_tpu.optim.train_step.ShardedParameterStep` — what

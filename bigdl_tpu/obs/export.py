@@ -361,6 +361,29 @@ DEFAULT_HELP = {
     "data.rate.decode_capacity_batches_per_s":
         "decode-pool capacity (count / busy seconds, scaled by pool "
         "width) — the worker-autosizing signal",
+    # recsys serving pipeline (docs/recsys.md): per-stage latency of the
+    # feature -> recall -> ranking path; the recall/ranking tenants'
+    # queue/SLO series ride the generic serving.tenant.* families
+    "serving.recsys.feature_s": "recommend feature-fetch stage latency "
+                                "(user history lookup)",
+    "serving.recsys.recall_s": "recommend recall stage latency (tenant "
+                               "admission + MXU top-k)",
+    "serving.recsys.rank_s": "recommend ranking stage latency (inline "
+                             "candidate scoring, no re-admission)",
+    "serving.recsys.recommend_s": "end-to-end recommend latency across "
+                                  "all three stages",
+    "serving.recsys.candidates": "recall candidates handed to ranking "
+                                 "per recommend request",
+    "serving.recsys.requests": "recommend requests completed by the "
+                               "pipeline",
+    # sharded friesian feature engineering (docs/recsys.md §Sharded
+    # feature tables): pickled-stat bytes through the cross-process
+    # merge allgather — the payload the merge cap bounds
+    "friesian.sharded.merge_bytes_total": "pickled stat-merge payload "
+                                          "bytes offered to the "
+                                          "cross-process allgather "
+                                          "(bounded per op by the "
+                                          "merge-bytes cap)",
 }
 
 

@@ -96,6 +96,13 @@ _ARTIFACT_GLOBS = (
     # row only exists for a passing run — the sentinel trends the
     # recovery tail (lower-better) and the under-chaos throughput
     "DECODE_CHAOS_r[0-9]*.json",
+    # recsys serving rounds (bench_recsys.py): the feature->recall->
+    # ranking pipeline under sustained mixed-tenant load — recommend QPS
+    # and recall candidate throughput gate higher-better, the recommend
+    # p99 tail lower-better, geometry-scoped like every serving family.
+    # The zero-unexpected-recompiles and sharded-parity gates are
+    # enforced by the bench before the row is written
+    "RECSYS_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic, collective
@@ -105,6 +112,7 @@ _LOWER_BETTER = frozenset({"serving_p50_ms", "serving_p99_ms",
                            "decode_inter_token_p99_ms",
                            "cluster_mttr_s", "cluster_recovery_bytes",
                            "chaos_recovery_ms_p99",
+                           "recsys_recommend_p99_ms",
                            "slo_alert_latency_s",
                            "multichip_ici_bytes_per_step",
                            "multichip_dcn_bytes_per_step",
@@ -220,6 +228,21 @@ def normalize(doc: Any, source: str) -> List[Row]:
         add(f"chaos_recovery_ms_p99{sfx}", row.get("recovery_ms_p99"),
             LOWER)
         add(f"chaos_tokens_per_s{sfx}", row.get("chaos_tokens_per_s"))
+    if row.get("bench") == "recsys":
+        # RECSYS_r*.json (bench_recsys.py): sustained mixed-tenant load
+        # through the feature->recall->ranking pipeline.  The binary
+        # gates (zero unexpected recompiles, sharded-vs-unsharded parity,
+        # per-chip embedding shrink factor) fail the bench itself; here
+        # we trend what can regress gradually.  Geometry-scoped like the
+        # SERVING/DECODE families
+        geo = re.sub(r"[^A-Za-z0-9]+", "_",
+                     str(row.get("geometry") or "")).strip("_")
+        sfx = f"_{geo}" if geo else ""
+        add(f"recsys_qps{sfx}", row.get("recsys_qps"))
+        add(f"recsys_recommend_p99_ms{sfx}",
+            row.get("recommend_p99_ms"), LOWER)
+        add(f"recsys_recall_candidates_per_s{sfx}",
+            row.get("recall_candidates_per_s"))
     if "slo_alert_latency_s" in row:
         # SLO_r*.json burn-rate drills: both values are quantized to the
         # evaluation cadence / a hard injected violation, so they are

@@ -69,13 +69,25 @@ class Span:
         self.start_s = time.time()
         return self
 
-    def __exit__(self, exc_type, exc, tb) -> bool:
+    def end(self) -> "Span":
+        """Finish the span NOW (idempotent; the context exit becomes a
+        no-op).  For handlers whose LAST wire write is what signals
+        completion to the client: ending before that write guarantees a
+        reader reacting to the completion event sees the span exported,
+        instead of racing the handler thread to the context exit."""
+        if self.end_s:
+            return self
         self.end_s = time.time()
-        if exc_type is not None:
-            self.attrs["error"] = f"{exc_type.__name__}: {exc}"
         if self._token is not None:
             _current.reset(self._token)
+            self._token = None
         self._tracer._finish(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.attrs["error"] = f"{exc_type.__name__}: {exc}"
+        self.end()
         return False
 
 
@@ -85,6 +97,9 @@ class _NullSpan:
     __slots__ = ()
 
     def set_attribute(self, key, value):
+        return self
+
+    def end(self):
         return self
 
     def __enter__(self):

@@ -130,6 +130,22 @@ class SpecLayout:
         pair's single activation all-reduce), output cols over fsdp."""
         return _ps(self.tp, self.fsdp)
 
+    def tower_kernel(self) -> P:
+        """Two-tower MLP kernels: pure column-parallel — output columns
+        jointly split over tp x fsdp, contraction dim UNSHARDED.  The
+        tower input is ``concat([id_emb, pooled_hist])``, and sharding
+        the contraction dim of a dot whose operand is a concatenate
+        miscompiles on the CPU SPMD partitioner this sim stack runs on
+        (outputs off by O(1), verified against the replicated program);
+        tower kernels are tiny next to the vocab tables, so keeping the
+        contraction local costs nothing and sidesteps the fused
+        concat-dot partition entirely."""
+        return _ps(None, (self.tp, self.fsdp))
+
+    def tower_bias(self) -> P:
+        """Bias of a tower kernel rides the same joint column split."""
+        return _ps((self.tp, self.fsdp))
+
     def col_bias(self) -> P:
         """Bias of a column-parallel kernel rides the tp split."""
         return _ps(self.tp)
@@ -200,8 +216,8 @@ TRANSFORMER_RULES: Tuple[LayoutRule, ...] = (
 TWO_TOWER_RULES: Tuple[LayoutRule, ...] = (
     _r("tower_embedding", r"(^|/)(user_emb|item_emb)$",
        lambda l: l.vocab_embedding()),
-    _r("tower_kernel", r"(^|/)[ui]w\d+$", lambda l: l.hidden_in()),
-    _r("tower_bias", r"(^|/)[ui]b\d+$", lambda l: l.col_bias()),
+    _r("tower_kernel", r"(^|/)[ui]w\d+$", lambda l: l.tower_kernel()),
+    _r("tower_bias", r"(^|/)[ui]b\d+$", lambda l: l.tower_bias()),
     _r("tower_out", r"(^|/)[ui]w_out$", lambda l: l.hidden_out()),
 )
 
@@ -486,3 +502,29 @@ def tp_activation_bytes(batch: int, seq: int, d_model: int,
         return 0.0
     one = 2.0 * (tp - 1) / tp * batch * seq * d_model * dtype_bytes
     return 3.0 * n_row_collectives * one
+
+
+def embedding_lookup_bytes(batch: int, dim: int, sizes: Dict[str, int],
+                           n_tables: int = 1,
+                           dtype_bytes: int = 4) -> Dict[str, Any]:
+    """Analytic per-axis traffic of sparse embedding lookups against a
+    vocab-sharded table (the ``vocab_embedding`` spec: rows sharded over
+    fsdp x tp).  A gather of ``batch`` rows of width ``dim`` produces
+    local partial rows (a chip owns only the ids that hash to its shard);
+    serving them whole costs one ring all-gather of the gathered block
+    over each vocab-shard axis — ``(n-1)/n`` of ``batch x dim`` per chip,
+    the inference-side analog of the weight-update-sharding accounting in
+    :func:`collective_bytes_by_axis`.  An unsharded mesh prices to zero,
+    keeping the ledger honest for the single-chip baseline."""
+    per_axis: Dict[str, float] = {}
+    block = float(batch) * float(dim) * float(dtype_bytes) * \
+        float(n_tables)
+    for axis in (AXIS_FSDP, AXIS_TP):
+        n = int(sizes.get(axis, 1) or 1)
+        per_axis[axis] = block * (n - 1) / n if n > 1 else 0.0
+    return {
+        "per_axis_bytes": per_axis,
+        "total_bytes": float(sum(per_axis.values())),
+        "rows": int(batch),
+        "dim": int(dim),
+    }

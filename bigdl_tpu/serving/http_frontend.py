@@ -150,6 +150,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._fleet_drain()
         if self.path == "/fleet/evict":
             return self._fleet_evict()
+        if self.path == "/recommend":
+            return self._recommend()
         if self.path != "/predict":
             return self._json(404, {"error": f"unknown path {self.path}"})
         srv: ServingServer = self.server.serving  # type: ignore[attr-defined]
@@ -237,6 +239,68 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"predictions": np.asarray(result).tolist()},
                        rid_hdr)
 
+
+    # -- recsys pipeline (docs/recsys.md) -----------------------------------
+    def _recommend(self):
+        """POST /recommend {"user_id":.., "k":..} — the recommendation
+        pipeline surface (docs/recsys.md).  Error mapping mirrors
+        /predict: 404 unknown user / no pipeline attached, 409 duplicate
+        in-flight id, 429 shed (Retry-After), 504 deadline, 500 other.
+        Rides the pool proxy unchanged — any non-/generate POST forwards
+        path-verbatim to a worker."""
+        pipeline = getattr(self.server, "recsys_pipeline", None)
+        if pipeline is None:
+            return self._json(404, {
+                "error": "no recommendation pipeline attached to this "
+                         "frontend (HttpFrontend(recsys_pipeline=...))"})
+        try:
+            payload = self._read_json_body()
+            if payload is None:
+                return
+            user_id = int(payload["user_id"])
+            k = int(payload.get("k", 10))
+            if k < 1:
+                raise ValueError(f"k must be >= 1, got {k}")
+            deadline_s = None
+            raw = payload.get("deadline_s",
+                              self.headers.get("X-Deadline-S"))
+            if raw is not None:
+                deadline_s = float(raw)
+            req_id = self.headers.get("X-Request-Id") \
+                or payload.get("request_id")
+            if req_id is not None:
+                req_id = str(req_id)
+                if not REQUEST_ID_RE.fullmatch(req_id):
+                    return self._json(400, {
+                        "error": "bad request id: must match "
+                                 "[A-Za-z0-9._:-]{1,128}"})
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"bad request: {e}"})
+        with trace.span("serving/http_recommend") as sp:
+            try:
+                items = pipeline.recommend(user_id, k=k,
+                                           deadline_s=deadline_s,
+                                           request_id=req_id)
+            except KeyError as e:
+                return self._json(404, {"error": str(e)})
+            except ValueError as e:
+                srv = self.server.serving  # type: ignore[attr-defined]
+                return self._json(
+                    409, {"error": str(e), "duplicate": True},
+                    {"Retry-After": str(srv.config.retry_after_s)})
+            except ServiceUnavailableError as e:
+                return self._json(429, {"error": str(e)},
+                                  {"Retry-After": str(e.retry_after)})
+            except (DeadlineExceededError, TimeoutError) as e:
+                return self._json(504, {"error": str(e), "expired": True})
+            except RequestDroppedError as e:
+                return self._json(503, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — 500, keep serving
+                return self._json(500, {"error": str(e)})
+            sp.set_attribute("user_id", str(user_id))
+            self._json(200, {"items": [{"id": i, "score": s}
+                                       for i, s in items]})
 
     # -- autoregressive decode (docs/serving.md §Autoregressive decode) -----
     def _read_json_body(self):
@@ -662,6 +726,7 @@ class _Handler(BaseHTTPRequestHandler):
                     if done:
                         break
                     if time.time() > deadline:
+                        sp.end()  # error event = completion cue, as above
                         self._chunk(json.dumps(
                             {"error": "generate timed out"}).encode()
                             + b"\n")
@@ -695,6 +760,11 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 except Exception as e:  # noqa: BLE001
                     final = {"done": True, "error": str(e)}
+                # the done event is the client's cue to move on: export
+                # the span BEFORE writing it, or a reader that snapshots
+                # the trace right after the stream completes races this
+                # thread to the context exit and misses the span
+                sp.end()
                 self._chunk(json.dumps(final).encode() + b"\n")
                 self.wfile.write(b"0\r\n\r\n")
             except (BrokenPipeError, ConnectionResetError):
@@ -710,18 +780,27 @@ class HttpFrontend:
     def __init__(self, serving: ServingServer, host: str = "127.0.0.1",
                  port: int = 0, predict_timeout: float = 30.0,
                  max_body_bytes: int = 64 * 1024 * 1024,
-                 prefill_hedge_s: Optional[float] = None):
+                 prefill_hedge_s: Optional[float] = None,
+                 recsys_pipeline=None):
         self.serving = serving
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.serving = serving  # type: ignore[attr-defined]
         self._httpd.predict_timeout = predict_timeout  # type: ignore[attr-defined]
         self._httpd.max_body_bytes = max_body_bytes  # type: ignore[attr-defined]
+        # POST /recommend routes through this pipeline (docs/recsys.md);
+        # None keeps the surface 404 until attach_pipeline
+        self._httpd.recsys_pipeline = recsys_pipeline  # type: ignore[attr-defined]
         # hedged prefill (docs/serving.md §Fleet fault tolerance): bound
         # the remote-prefill wait tighter than predict_timeout so a
         # straggling prefill worker costs a hedge, not a stalled TTFT
         self._httpd.prefill_hedge_s = prefill_hedge_s  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    def attach_pipeline(self, pipeline) -> "HttpFrontend":
+        """Attach (or swap) the /recommend pipeline on a live frontend."""
+        self._httpd.recsys_pipeline = pipeline  # type: ignore[attr-defined]
+        return self
 
     @property
     def url(self) -> str:
@@ -780,6 +859,33 @@ class HttpClient:
             with _urlreq.urlopen(req, timeout=self.timeout) as resp:
                 out = json.loads(resp.read())
         return np.asarray(out["predictions"], np.float32)
+
+    def recommend(self, user_id: int, k: int = 10,
+                  deadline_s: Optional[float] = None,
+                  request_id: Optional[str] = None) -> list:
+        """POST /recommend — ranked [(item_id, score), ...] for one user
+        through the full feature->recall->ranking pipeline
+        (docs/recsys.md)."""
+        payload = {"user_id": int(user_id), "k": int(k)}
+        if deadline_s is not None:
+            payload["deadline_s"] = deadline_s
+        body = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Request-Id"] = request_id
+        if self._keep_alive:
+            status, data = self._request_keep_alive("POST", "/recommend",
+                                                    body, headers)
+            if status != 200:
+                raise RuntimeError(
+                    f"recommend failed: HTTP {status}: {data[:200]!r}")
+            out = json.loads(data)
+        else:
+            req = _urlreq.Request(self.url + "/recommend", data=body,
+                                  headers=headers)
+            with _urlreq.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        return [(item["id"], item["score"]) for item in out["items"]]
 
     def generate(self, tokens, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,

@@ -10,6 +10,8 @@ handful of compiled shapes.  Concurrency capacity lives in
 ``optim.PredictionService``.
 """
 
+import threading
+
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -22,6 +24,16 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+# One MESH-SHARDED program in flight per process: a sharded predict runs
+# collectives across every mesh device, and two host threads launching
+# such programs concurrently can interleave their collective rendezvous
+# in different orders on different devices — a deadlock.  Unsharded
+# predicts don't take this lock (pure jitted forwards are thread-safe by
+# construction); sharded ones serialize at launch, which matches the
+# per-device program queue a real accelerator runtime enforces anyway.
+_MESH_EXEC_LOCK = threading.Lock()
 
 
 
@@ -141,6 +153,10 @@ class InferenceModel:
         if n < b:  # pad to the bucket so XLA reuses the compiled program
             pad = np.repeat(x[-1:], b - n, axis=0)
             x = np.concatenate([x, pad], axis=0)
+        if self.layout is not None:
+            with _MESH_EXEC_LOCK:
+                out = self._jit(self._params, self._state, x)
+                return np.asarray(out)[:n]
         out = self._jit(self._params, self._state, x)
         return np.asarray(out)[:n]
 

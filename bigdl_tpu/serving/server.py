@@ -627,6 +627,81 @@ class ServingServer:
                           model=t.name)
         t.degraded = False
 
+    def predict_inline(self, name: str, arr: np.ndarray) -> np.ndarray:
+        """Run one batch through tenant ``name``'s model ON THE CALLER'S
+        thread — the pipeline fast path for a stage whose input was
+        already produced by an admitted request (recall -> ranking in
+        ``friesian/pipeline.py``): candidates never re-enter admission,
+        so an accepted recommend cannot be shed halfway through by its
+        own second stage.  Tenant health accounting matches the engine
+        loop — success clears the failure streak (and degradation),
+        failures feed the degradation threshold and the fallback model
+        answers when one exists; degraded-without-fallback sheds with
+        :class:`ServiceUnavailableError` like admission would."""
+        cfg = self.config
+        with self._work_cv:
+            tenant = self._tenants.get(name)
+        if tenant is None:
+            raise KeyError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._tenants)}")
+        if tenant.degraded and tenant.fallback is None:
+            self._count("shed_requests")
+            raise ServiceUnavailableError(
+                f"model {name!r} is degraded with no fallback; retry "
+                "against another replica", retry_after=cfg.retry_after_s)
+        stacked = np.asarray(arr)
+        n = int(stacked.shape[0]) if stacked.ndim else 1
+        use_fallback = tenant.degraded and tenant.fallback is not None
+        primary = tenant.fallback if use_fallback else tenant.model
+        t0 = time.time()
+        out = None
+        try:
+            out = primary.predict(stacked)
+            tenant.consecutive_failures = 0
+            if not use_fallback and tenant.degraded:
+                log.info("serving: inline predict recovered; %s leaving "
+                         "degraded mode", tenant.name)
+                tenant.degraded = False
+                flight.record("serving_recovered",
+                              via="predict_inline_success",
+                              model=tenant.name)
+        except Exception as e:
+            tenant.consecutive_failures += 1
+            self._count("failed_batches")
+            if (not tenant.degraded and tenant.consecutive_failures
+                    >= cfg.degraded_after_failures):
+                tenant.degraded = True
+                log.error(
+                    "serving: %d consecutive predict failures — model %s "
+                    "DEGRADED (%s)", tenant.consecutive_failures,
+                    tenant.name,
+                    "serving from fallback model"
+                    if tenant.fallback is not None
+                    else "no fallback: shedding new load")
+                flight.record(
+                    "serving_degraded", model=tenant.name,
+                    consecutive_failures=tenant.consecutive_failures,
+                    fallback=tenant.fallback is not None, error=str(e))
+            if not use_fallback and tenant.fallback is not None:
+                try:
+                    out = tenant.fallback.predict(stacked)
+                    use_fallback = True
+                except Exception as e2:
+                    log.error("inline fallback predict also failed: %s",
+                              e2)
+            if out is None:
+                self._tenant_series(name, "failed", float(n))
+                raise
+        if use_fallback:
+            self._count("fallback_batches")
+        lat = time.time() - t0
+        self._count("batches")
+        self._count("requests", n)
+        self._tenant_series(name, "requests", float(n))
+        self._tenant_series(name, "latency", lat)
+        return np.asarray(out)
+
     # -- client side --------------------------------------------------------
     def enqueue(self, arr: np.ndarray, request_id: Optional[str] = None,
                 deadline_s: Optional[float] = None,

@@ -71,6 +71,35 @@ def test_forecaster_learns_sine(cls, kw):
     assert pred.shape == (8, 4, 1)
 
 
+def test_forecaster_fit_parallelism_routes_to_layout_driver():
+    # fit(parallelism=) is the declarative-layout carry
+    # (docs/parallelism.md §Declarative layouts): same Forecaster API,
+    # the GSPMD driver underneath, predict/evaluate/save unchanged
+    ts = _tsdata()
+    x, y = ts.to_numpy()
+    f = TCNForecaster(past_seq_len=24, future_seq_len=4,
+                      input_feature_num=1, output_feature_num=1,
+                      num_channels=(8,), lr=5e-3)
+    f.fit((x, y), epochs=2, batch_size=16, parallelism="dp")
+    stats = f._layout_stats
+    assert stats["losses"] and stats["mesh"]["data"] >= 1
+    assert np.isfinite(stats["losses"][-1])
+    pred = f.predict(x[:4])
+    assert pred.shape == (4, 4, 1)
+    assert np.isfinite(f.evaluate((x, y))["mse"])
+
+
+def test_forecaster_fit_parallelism_rejects_validation_data():
+    ts = _tsdata()
+    x, y = ts.to_numpy()
+    f = TCNForecaster(past_seq_len=24, future_seq_len=4,
+                      input_feature_num=1, output_feature_num=1,
+                      num_channels=(8,))
+    with pytest.raises(ValueError, match="validation_data"):
+        f.fit((x, y), epochs=1, batch_size=16, parallelism="dp",
+              validation_data=(x, y))
+
+
 def test_forecaster_save_load(tmp_path):
     ts = _tsdata()
     x, y = ts.to_numpy()

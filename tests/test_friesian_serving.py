@@ -239,3 +239,52 @@ class TestIVFRecall:
             assert 0 < len(row) <= 10_000
             assert all(s != float("-inf") for _, s in row)
             assert len({i for i, _ in row}) == len(row)  # no phantom dups
+
+    def test_k_cap_bounded_by_probe_pool(self):
+        # the IVF k-cap is nprobe * padded-list-size, never more than
+        # n_items — the k-bucket clamp must respect the probe pool, not
+        # the full corpus (docs/recsys.md §Closed compile buckets)
+        svc, items, ids, _ = self._clustered()
+        cap = svc._k_cap()
+        assert 0 < cap <= svc.n_items
+        assert svc.nprobe * svc._lists.shape[1] >= cap
+
+
+class TestRecallKBuckets:
+    """The closed (batch, k) compile-bucket discipline
+    (docs/recsys.md §Closed compile buckets)."""
+
+    def test_k_bucket_rounds_up_and_clamps(self):
+        fs, rs, *_ = _stack(n_items=50)
+        assert rs._k_bucket(1) == 1
+        assert rs._k_bucket(2) == 8
+        assert rs._k_bucket(9) == 32
+        assert rs._k_bucket(33) == 50   # clamped to the corpus
+        assert rs._k_bucket(500) == 50
+
+    def test_warmup_closes_the_compile_set(self):
+        from bigdl_tpu.obs.attr import recompile_sentinel
+        from bigdl_tpu.optim.metrics import global_metrics
+
+        fs, rs, *_ , rng = _stack(n_items=150, seed=5)
+        rs.warmup()
+        sent = recompile_sentinel().install()
+        m = global_metrics()
+        before = m.counter("train.unexpected_recompiles_total")
+        sent.mark_steady()
+        try:
+            for n, k in [(1, 1), (2, 3), (3, 7), (1, 20), (3, 130),
+                         (2, 9_999)]:
+                q = rng.randn(n, 8).astype(np.float32)
+                got = rs.search(q, k=k)
+                assert len(got) == n
+                assert all(len(row) == min(k, 150) for row in got)
+        finally:
+            sent.mark_warmup()
+        after = m.counter("train.unexpected_recompiles_total")
+        assert after - before == 0, \
+            "mixed (batch, k) sweep recompiled after warmup"
+
+    def test_warmup_without_items_raises(self):
+        with pytest.raises(RuntimeError, match="no items"):
+            RecallService(8).warmup()

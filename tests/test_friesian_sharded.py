@@ -144,6 +144,35 @@ class TestAllgatherHelper:
         assert out.num_partitions() == 4
         assert len(out) == len(df)
 
+    def test_merge_cap_raises_naming_the_op(self):
+        # a stat payload over the merge cap must fail loudly BEFORE the
+        # collective, naming the op that produced it (docs/recsys.md
+        # §Merge cap) — not OOM inside the allgather
+        big = {"blob": "x" * 4096}
+        with pytest.raises(ValueError, match="gen_string_idx"):
+            _allgather_objects(big, op="gen_string_idx", max_bytes=1024)
+
+    def test_merge_bytes_counter_increments(self):
+        from bigdl_tpu.optim.metrics import global_metrics
+
+        m = global_metrics()
+        before = m.counter("friesian.sharded.merge_bytes_total")
+        _allgather_objects({"k": list(range(100))})
+        after = m.counter("friesian.sharded.merge_bytes_total")
+        assert after > before  # every merge prices its pickled payload
+
+    def test_vocab_feeds_identical_training_step(self, pair):
+        # the end-to-end carry: the sharded vocab drives the SAME encoded
+        # ids — so the same TwoTower embedding rows — as the single-host
+        # twin (vocab drift would silently scramble the embedding table)
+        sh, single = pair
+        i_sh = sh.gen_string_idx("cat")
+        i_single = single.gen_string_idx("cat")
+        vals = single.df["cat"]
+        np.testing.assert_array_equal(i_sh.encode(vals),
+                                      i_single.encode(vals))
+        assert i_sh.size == i_single.size
+
 
 # ---------------------------------------------------------------------------
 # true multi-process: each process owns DISJOINT shards; the stat merge must

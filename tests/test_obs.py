@@ -354,6 +354,21 @@ def test_tracer_records_exceptions():
     assert "ValueError" in s.attrs["error"]
 
 
+def test_span_end_exports_before_context_exit():
+    # handlers whose LAST write signals completion end the span first,
+    # so a reader reacting to that write finds it exported; the context
+    # exit then must not double-record or clobber the recorded end time
+    t = trace.enable()
+    with trace.span("early") as sp:
+        sp.end()
+        assert trace.current_span() is None
+        assert [s.name for s in t.spans()] == ["early"]
+        recorded_end = sp.end_s
+    assert len(t.spans()) == 1, "context exit double-recorded the span"
+    assert sp.end_s == recorded_end
+    trace.disable()
+
+
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------

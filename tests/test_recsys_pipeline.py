@@ -1,0 +1,281 @@
+"""RecommendationPipeline specs — the production feature->recall->ranking
+chain over the multi-tenant ServingServer (docs/recsys.md).
+
+Covers: end-to-end recommend ordering, the two pipeline tenants and their
+per-stage SLO metrics, predict_inline's no-re-admission contract (unknown
+tenant, degraded shed, accounting), mesh-sharded serving parity
+(candidate ids byte-identical to the unsharded twin; scores equal to
+float-reduction tolerance), the closed (batch, k) compile set under a
+mixed sweep, and POST /recommend through the HTTP frontend."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigdl_tpu.friesian.pipeline import (
+    RecallTopKModel, RankTowerModel, RecommendationPipeline,
+)
+from bigdl_tpu.friesian.serving import FeatureService
+from bigdl_tpu.models.recsys import TwoTower
+from bigdl_tpu.optim.metrics import global_metrics
+
+HIST = 6
+N_USERS, N_ITEMS, DIM = 16, 64, 8
+
+
+def _pipeline(layout=None, k_candidates=16, seed=0, train_iters=0,
+              **kw):
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(seed)
+    tt = TwoTower(n_users=N_USERS, n_items=N_ITEMS, dim=DIM, hidden=(16,))
+    params, _ = tt.build(jax.random.PRNGKey(seed),
+                         np.zeros((2,), np.int32),
+                         np.zeros((2, HIST), np.int32),
+                         np.zeros((2,), np.int32))
+    params = {k: np.asarray(v) for k, v in params.items()}
+    if train_iters:
+        # a few SGD steps: break the zero-bias init so sharded-parity
+        # exercises REAL parameters, not the symmetric init
+        @jax.jit
+        def step(p, u, h, i):
+            def loss_fn(p):
+                logits, _ = tt.forward(p, None, u, h, i)
+                lp = jax.nn.log_softmax(logits, axis=-1)
+                lab = jnp.arange(logits.shape[0])
+                return -jnp.mean(jnp.take_along_axis(
+                    lp, lab[:, None], axis=1))
+            _, g = jax.value_and_grad(loss_fn)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+        for _ in range(train_iters):
+            u = rs.randint(1, N_USERS, 32).astype(np.int32)
+            h = rs.randint(0, N_ITEMS, (32, HIST)).astype(np.int32)
+            i = rs.randint(1, N_ITEMS, 32).astype(np.int32)
+            params = step(params, u, h, i)
+        params = {k: np.asarray(v) for k, v in params.items()}
+
+    fs = FeatureService()
+    p = RecommendationPipeline(tt, params, fs, hist_len=HIST,
+                               k_candidates=k_candidates, layout=layout,
+                               batch_buckets=(1, 4, 16), **kw)
+    for u in range(1, N_USERS):
+        p.put_user_history(u, rs.randint(1, N_ITEMS, HIST))
+    return p
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    p = _pipeline()
+    p.start()
+    p.warmup()
+    yield p
+    p.stop()
+
+
+class TestRecommendEndToEnd:
+    def test_ranked_descending_and_sized(self, pipe):
+        out = pipe.recommend(3, k=5)
+        assert len(out) == 5
+        scores = [s for _, s in out]
+        assert scores == sorted(scores, reverse=True)
+        ids = [i for i, _ in out]
+        assert len(set(ids)) == 5
+        assert all(0 <= i < N_ITEMS for i in ids)
+
+    def test_k_clamped_to_candidates(self, pipe):
+        out = pipe.recommend(3, k=500)
+        assert len(out) == pipe.k_candidates
+
+    def test_unknown_user_raises_keyerror(self, pipe):
+        with pytest.raises(KeyError, match="unknown user"):
+            pipe.recommend(9999)
+
+    def test_tenants_and_stage_metrics_registered(self, pipe):
+        assert set(pipe.server._tenants) >= {"recall", "ranking"}
+        pipe.recommend(4, k=3)
+        m = global_metrics()
+        snap = m.snapshot()
+        seen = (list(snap["counters"]) + list(snap["gauges"])
+                + list(snap["sums"]) + list(snap["hists"]))
+        for stage in ("feature_s", "recall_s", "rank_s", "recommend_s",
+                      "candidates", "requests"):
+            name = f"serving.recsys.{stage}"
+            assert any(k.startswith(name) for k in seen), (name, seen)
+
+    def test_recall_only_matches_dense_scores(self, pipe):
+        scores, ids = pipe.recall_only(5)
+        assert len(ids) == pipe.k_candidates
+        row = pipe._user_row(5)
+        tt, params = pipe.two_tower, pipe.params
+        u = np.asarray(tt.encode_users(
+            params, row[:1].astype(np.int32),
+            row[None, 1:].astype(np.int32)))
+        v = np.asarray(tt.encode_items(
+            params, np.arange(N_ITEMS, dtype=np.int32)))
+        dense = (u @ v.T)[0]
+        want = np.argsort(-dense)[:pipe.k_candidates]
+        np.testing.assert_array_equal(np.sort(ids), np.sort(want))
+        np.testing.assert_allclose(scores, dense[ids], rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestPredictInline:
+    def test_unknown_tenant_raises(self, pipe):
+        with pytest.raises(KeyError, match="unknown model"):
+            pipe.server.predict_inline(
+                "nope", np.zeros((1, 1 + HIST), np.float32))
+
+    def test_accounting_counts_requests(self, pipe):
+        before = pipe.server.stats["requests"]
+        rows = np.zeros((3, 1 + HIST + 1), np.float32)
+        out = pipe.server.predict_inline("ranking", rows)
+        assert out.shape[0] == 3
+        assert pipe.server.stats["requests"] == before + 3
+
+    def test_degraded_tenant_sheds_inline(self):
+        from bigdl_tpu.serving.server import (
+            ServiceUnavailableError, ServingConfig, ServingServer,
+        )
+
+        boom = ServingServer(
+            config=ServingConfig(degraded_after_failures=1),
+            models={"bad": _Failing()})
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                boom.predict_inline("bad", np.zeros((1, 2), np.float32))
+            with pytest.raises(ServiceUnavailableError):
+                boom.predict_inline("bad", np.zeros((1, 2), np.float32))
+            assert boom.stats["shed_requests"] >= 1
+        finally:
+            boom.stop()
+
+
+class _Failing:
+    def predict(self, x):
+        raise RuntimeError("boom")
+
+
+class TestShardedParity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        plain = _pipeline(train_iters=25)
+        shard = _pipeline(layout="fsdp:2,tp:2", train_iters=25)
+        plain.start(); shard.start()
+        plain.warmup(); shard.warmup()
+        yield plain, shard
+        plain.stop(); shard.stop()
+
+    def test_candidate_ids_byte_identical(self, pair):
+        plain, shard = pair
+        for u in range(1, 8):
+            _, i1 = plain.recall_only(u)
+            _, i2 = shard.recall_only(u)
+            np.testing.assert_array_equal(i1, i2)
+            r1 = plain.recommend(u, k=6)
+            r2 = shard.recommend(u, k=6)
+            assert [i for i, _ in r1] == [i for i, _ in r2]
+
+    def test_scores_match_to_reduction_tolerance(self, pair):
+        # the tower contractions are mesh-sharded, so partial-sum order
+        # differs: scores agree to float tolerance, NOT bit-exactly
+        # (docs/recsys.md §Sharded-serving parity)
+        plain, shard = pair
+        s1, _ = plain.recall_only(2)
+        s2, _ = shard.recall_only(2)
+        np.testing.assert_allclose(s1, s2, rtol=1e-4, atol=1e-6)
+
+    def test_per_chip_embedding_bytes_shrink(self, pair):
+        plain, shard = pair
+        full = plain.param_bytes_per_chip()
+        per_chip = shard.param_bytes_per_chip()
+        for k in ("user_emb", "item_emb"):
+            assert full[k] / per_chip[k] >= 4  # fsdp:2 x tp:2 mesh
+
+    def test_lookup_collective_bytes_priced_per_axis(self, pair):
+        _, shard = pair
+        led = shard.lookup_collective_bytes()
+        assert led["total_bytes"] > 0
+        assert set(led["per_axis_bytes"]) == {"fsdp", "tp"}
+        assert led["rows"] == 1 + HIST + shard.k_candidates
+
+
+class TestClosedCompileSet:
+    def test_mixed_k_recommend_sweep_zero_recompiles(self, pipe):
+        from bigdl_tpu.obs.attr import recompile_sentinel
+
+        # pre-touch every k once (top-k width is part of the recall
+        # program; the pipeline's compile set closes over its fixed
+        # k_candidates, so recommend-k only slices host-side)
+        sent = recompile_sentinel().install()
+        m = global_metrics()
+        pipe.recommend(1, k=2)
+        before = m.counter("train.unexpected_recompiles_total")
+        sent.mark_steady()
+        try:
+            for u, k in [(1, 1), (2, 5), (3, 10), (4, 3), (5, 16),
+                         (6, 500)]:
+                out = pipe.recommend(u, k=k)
+                assert len(out) == min(k, pipe.k_candidates)
+        finally:
+            sent.mark_warmup()
+        after = m.counter("train.unexpected_recompiles_total")
+        assert after - before == 0, \
+            "mixed-k recommend sweep recompiled after warmup"
+
+
+class TestHttpRecommend:
+    @pytest.fixture()
+    def frontend(self, pipe):
+        from bigdl_tpu.serving.http_frontend import HttpFrontend
+
+        fe = HttpFrontend(pipe.server, recsys_pipeline=pipe).start()
+        yield fe
+        fe.stop()
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url + "/recommend", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    def test_recommend_roundtrip(self, frontend, pipe):
+        out = self._post(frontend.url, {"user_id": 3, "k": 4})
+        assert len(out["items"]) == 4
+        want = pipe.recommend(3, k=4)
+        assert [it["id"] for it in out["items"]] == [i for i, _ in want]
+
+    def test_http_client_recommend(self, frontend, pipe):
+        from bigdl_tpu.serving.http_frontend import HttpClient
+
+        c = HttpClient(frontend.url, keep_alive=True)
+        got = c.recommend(5, k=3)
+        assert len(got) == 3
+        assert [i for i, _ in got] == [i for i, _ in pipe.recommend(5, k=3)]
+
+    def test_unknown_user_404(self, frontend):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(frontend.url, {"user_id": 12345, "k": 2})
+        assert e.value.code == 404
+
+    def test_missing_user_id_400(self, frontend):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(frontend.url, {"k": 2})
+        assert e.value.code == 400
+
+    def test_no_pipeline_attached_404(self, pipe):
+        from bigdl_tpu.serving.http_frontend import HttpFrontend
+
+        fe = HttpFrontend(pipe.server).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._post(fe.url, {"user_id": 3})
+            assert e.value.code == 404
+        finally:
+            fe.stop()
