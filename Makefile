@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout test-recsys bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-fleet bench-fleet-chaos bench-slo bench-recsys dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-quant-serving test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout test-recsys bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-decode-quant bench-fleet bench-fleet-chaos bench-slo bench-recsys dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -63,6 +63,15 @@ test-serving:
 # per-token deadline enforcement, paged flash-decode kernel parity
 test-decode:
 	python -m pytest tests/test_decode_engine.py -q
+
+# the quantized-serving suite (docs/quantization.md §Serving memory
+# hierarchy): per-page int8 quantize/dequantize bounds + monotone scale
+# floors, stale-scale aliasing under slot reuse, int8-vs-f32 token
+# parity budget (greedy + bounded logp drift), kernel-vs-reference
+# agreement on int8 pages, weight_quant="int8" serving, the quantized
+# KV handoff/migration surface, and /health page-dtype accounting
+test-quant-serving:
+	python -m pytest tests/test_quant_serving.py -q
 
 # the decode-fleet suite (docs/serving.md §Decode fleet): prefix-cache
 # byte parity (cached-prefix vs cold prefill, greedy + seeded),
@@ -216,6 +225,14 @@ bench-serving:
 # the DECODE_r*.json artifact source
 bench-decode:
 	python bench_serving.py --decode
+
+# quantized decode bench (docs/quantization.md §Serving memory
+# hierarchy): int8 KV pages + int8 serving weights vs f32 on the same
+# geometry — greedy token parity, >= 1.8x slot capacity at an equal KV
+# HBM budget, zero unexpected recompiles; the DECODE_QUANT_r*.json
+# artifact source
+bench-decode-quant:
+	python bench_serving.py --decode --quant
 
 # disaggregated decode-fleet bench (docs/serving.md §Decode fleet):
 # mixed-geometry streaming clients against a 2-worker pool with the

@@ -358,7 +358,8 @@ DECODE_SERVER = textwrap.dedent("""
                            np.arange(8, dtype=np.int32)[None])
     im = InferenceModel(model, variables, decode=DecodeConfig(
         slots=%(slots)d, page_size=8, pages_per_slot=16, prompt_chunk=8,
-        max_new_tokens=120, eos_id=1, continuous=%(continuous)s))
+        max_new_tokens=120, eos_id=1, continuous=%(continuous)s,
+        kv_dtype=%(kv_dtype)r), weight_quant=%(weight_quant)r)
     im.decode_engine.warmup()
     srv = ServingServer(im, ServingConfig(batch_size=8)).start()
     fe = HttpFrontend(srv, port=0).start()
@@ -376,9 +377,11 @@ DECODE_SERVER = textwrap.dedent("""
 
 
 class _DecodeServer(_Server):
-    def __init__(self, continuous: bool, slots: int = 8):
+    def __init__(self, continuous: bool, slots: int = 8,
+                 kv_dtype: str = "float32", weight_quant=None):
         code = DECODE_SERVER % {"continuous": repr(continuous),
-                                "slots": slots}
+                                "slots": slots, "kv_dtype": kv_dtype,
+                                "weight_quant": weight_quant}
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PYTHONPATH=os.pathsep.join(
                        p for p in [REPO, os.environ.get("PYTHONPATH")]
@@ -561,8 +564,11 @@ def _pct(xs, q):
 
 
 def run_decode_bench(continuous: bool, clients: int,
-                     duration_s: float) -> dict:
-    server = _DecodeServer(continuous=continuous)
+                     duration_s: float, slots: int = 8,
+                     kv_dtype: str = "float32",
+                     weight_quant=None) -> dict:
+    server = _DecodeServer(continuous=continuous, slots=slots,
+                           kv_dtype=kv_dtype, weight_quant=weight_quant)
     try:
         # warm phase outside the window: handler threads + client conns
         _decode_load(server, clients, min(0.6, duration_s))
@@ -575,7 +581,7 @@ def run_decode_bench(continuous: bool, clients: int,
     tokens = int(sum(counts))
     return {
         "engine": "continuous" if continuous else "static_batch_restart",
-        "geometry": f"decode_s8_c{clients}",
+        "geometry": f"decode_s{slots}_c{clients}",
         "concurrent_clients": clients,
         "duration_s": round(wall, 2),
         "requests": len(ttfts),
@@ -615,6 +621,159 @@ def run_decode(clients: int, duration_s: float, out=None,
         failures.append(f"continuous tokens/s only {speedup}x the "
                         "whole-batch-restart baseline (< 2x)")
     if out:
+        with open(out, "w") as f:
+            json.dump(row, f, indent=1)
+    print(json.dumps(row))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# quantized decode bench (--decode --quant): the DECODE_QUANT_r*.json
+# evidence source (docs/quantization.md §Serving memory hierarchy)
+# ---------------------------------------------------------------------------
+
+# Engine-level parity drill run in its own interpreter: builds the SAME
+# tiny LM twice — f32 KV + f32 weights vs int8 KV pages + int8 serving
+# weights — and greedy-decodes an identical mixed-geometry prompt batch
+# through both.  Prints the token-agreement fraction, the per-page HBM
+# cost of each KV dtype (the equal-HBM-budget slot math runs on these),
+# and the unexpected-recompile counter (both engines warm up BEFORE
+# mark_steady, so the int8 programs joining the compile set is expected;
+# anything after is not).
+QUANT_PARITY = textwrap.dedent("""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.obs.attr import recompile_sentinel
+    from bigdl_tpu.optim.metrics import global_metrics
+    from bigdl_tpu.serving.decode_engine import (DecodeConfig,
+                                                 DecodeEngine, LMAdapter)
+
+    sent = recompile_sentinel().install()
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        num_layers=2, dropout=0.0, mode="lm")
+    params = model.init(jax.random.PRNGKey(0),
+                        np.arange(8, dtype=np.int32)[None])["params"]
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(2, 64, (int(rs.randint(4, 17)),)).tolist()
+               for _ in range(8)]
+
+    def build(kv_dtype, weight_quant):
+        cfg = DecodeConfig(slots=4, page_size=8, pages_per_slot=16,
+                           prompt_chunk=8, max_new_tokens=32, eos_id=1,
+                           kv_dtype=kv_dtype)
+        eng = DecodeEngine(LMAdapter(model, params, cap=cfg.cap,
+                                     weight_quant=weight_quant), cfg)
+        eng.warmup()
+        return eng
+
+    e32 = build("float32", None)
+    e8 = build("int8", "int8")
+    sent.mark_steady()
+    ref = e32.generate(prompts, max_new_tokens=24)
+    qnt = e8.generate(prompts, max_new_tokens=24)
+    agree = sum(1 for a, b in zip(ref, qnt)
+                if a.tokens.tolist() == b.tokens.tolist()) / len(ref)
+    drift = max(abs(a.logp - b.logp) for a, b in zip(ref, qnt))
+    print("PARITY=%.4f" % agree, flush=True)
+    print("LOGP_DRIFT=%.4f" % drift, flush=True)
+    print("BYTES=%d,%d" % (e32.kv_bytes_per_page(),
+                           e8.kv_bytes_per_page()), flush=True)
+    e32.stop(); e8.stop()
+    m = global_metrics()
+    print("RECOMPILES="
+          + str(int(m.counter('train.unexpected_recompiles_total'))),
+          flush=True)
+""")
+
+
+def _run_quant_parity() -> dict:
+    """Run the parity drill subprocess; parse its KEY=value lines."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in [REPO, os.environ.get("PYTHONPATH")] if p))
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", QUANT_PARITY], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError("quant parity drill died:\n" + proc.stderr[-2000:])
+    vals = {}
+    for line in proc.stdout.splitlines():
+        if "=" in line:
+            k, _, v = line.partition("=")
+            vals[k.strip()] = v.strip()
+    f32_bytes, int8_bytes = (int(x) for x in vals["BYTES"].split(","))
+    return {
+        "parity": float(vals["PARITY"]),
+        "logp_drift": float(vals["LOGP_DRIFT"]),
+        "f32_bytes_per_page": f32_bytes,
+        "int8_bytes_per_page": int8_bytes,
+        "recompiles": int(vals["RECOMPILES"]),
+    }
+
+
+def run_decode_quant(clients: int, duration_s: float, out=None,
+                     smoke: bool = False) -> int:
+    """The quantized-serving smoke gate (docs/quantization.md §Serving
+    memory hierarchy): greedy token parity int8-vs-f32, >= 1.8x slot
+    capacity at an EQUAL KV HBM budget, zero unexpected recompiles on
+    every arm, and (non-smoke) quantized tokens/s within 10% of the f32
+    arm run in the same invocation."""
+    par = _run_quant_parity()
+    # equal HBM budget: the f32 arm's 8 slots of pages, re-spent on
+    # int8 pages (per-page scales included in int8_bytes_per_page)
+    base_slots = 8
+    ratio = par["f32_bytes_per_page"] / par["int8_bytes_per_page"]
+    quant_slots = max(1, int(base_slots * ratio))
+    f32 = run_decode_bench(True, clients, duration_s, slots=base_slots,
+                           kv_dtype="float32")
+    quant = run_decode_bench(True, clients, duration_s,
+                             slots=quant_slots, kv_dtype="int8",
+                             weight_quant="int8")
+    row = {
+        "bench": "decode_quant",
+        "geometry": f"decode_s{base_slots}q{quant_slots}_c{clients}",
+        "concurrent_clients": clients,
+        "kv_dtype": "int8",
+        "weight_quant": "int8",
+        "f32_kv_bytes_per_page": par["f32_bytes_per_page"],
+        "int8_kv_bytes_per_page": par["int8_bytes_per_page"],
+        "f32_slots": base_slots,
+        "int8_slots_equal_hbm": quant_slots,
+        "slots_per_chip_ratio": round(quant_slots / base_slots, 2),
+        "token_parity": par["parity"],
+        "logp_drift_max": par["logp_drift"],
+        "f32_tokens_per_s": f32["tokens_per_s"],
+        "quant_tokens_per_s": quant["tokens_per_s"],
+        "quant_ttft_ms_p99": quant["ttft_ms_p99"],
+        "unexpected_recompiles": (par["recompiles"]
+                                  + f32["unexpected_recompiles"]
+                                  + quant["unexpected_recompiles"]),
+    }
+    failures = []
+    if par["parity"] < 1.0:
+        failures.append(f"greedy token parity {par['parity']:.2f} < 1.0 "
+                        "(int8 KV + int8 weights vs f32)")
+    if row["slots_per_chip_ratio"] < 1.8:
+        failures.append(f"int8 slots only {row['slots_per_chip_ratio']}x "
+                        "f32 at equal HBM budget (< 1.8x)")
+    if row["unexpected_recompiles"] != 0:
+        failures.append(f"{row['unexpected_recompiles']} unexpected XLA "
+                        "recompiles across the quant sweep")
+    for arm, name in ((f32, "f32"), (quant, "int8")):
+        if arm["tokens"] <= 0:
+            failures.append(f"{name} arm: no tokens generated")
+    if not smoke and f32["tokens_per_s"] > 0:
+        rel = quant["tokens_per_s"] / f32["tokens_per_s"]
+        if rel < 0.9:
+            failures.append(f"quantized tokens/s only {rel:.2f}x the f32 "
+                            "arm (< 0.9x): dequant overhead regressed")
+    if out and not failures:
         with open(out, "w") as f:
             json.dump(row, f, indent=1)
     print(json.dumps(row))
@@ -1146,6 +1305,10 @@ def main(argv=None) -> int:
     ap.add_argument("--decode", action="store_true",
                     help="token-level decode bench: continuous vs "
                          "whole-batch-restart, streaming clients")
+    ap.add_argument("--quant", action="store_true",
+                    help="with --decode: int8 KV pages + int8 serving "
+                         "weights vs f32 at equal HBM budget — token "
+                         "parity, >= 1.8x slots, zero recompiles")
     ap.add_argument("--fleet", action="store_true",
                     help="disaggregated decode-fleet bench: prefill/"
                          "decode split over a worker pool, KV-aware "
@@ -1174,6 +1337,16 @@ def main(argv=None) -> int:
         clients = 24 if args.clients == 32 else args.clients
         return run_fleet(clients=clients, duration_s=args.duration,
                          out=out)
+    if args.decode and args.quant:
+        out = args.out
+        if out is None and os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
+            out = os.path.join(REPO, "DECODE_QUANT_r01.json")
+        if args.smoke:
+            return run_decode_quant(clients=4, duration_s=1.5, out=out,
+                                    smoke=True)
+        clients = 24 if args.clients == 32 else args.clients
+        return run_decode_quant(clients=clients,
+                                duration_s=args.duration, out=out)
     if args.decode:
         clients = args.clients
         if args.smoke:

@@ -284,6 +284,75 @@ def _quantize_rec(module: Module, params, calib, weight_only=False):
 
 
 # ---------------------------------------------------------------------------
+# raw param-tree quantization — serving models whose weights are plain
+# matrices in a nested-dict pytree (the Transformer convention: wq/wk/wv/
+# wo, FFN weights, the tied embedding) rather than Linear/Conv2D leaves
+# the module-swap path above can replace.  Weight-ONLY int8 storage with
+# per-out-column scales; dequantize INSIDE jit so HBM at rest holds int8
+# (4x smaller checkpoint residency) and the convert+scale fuses into each
+# weight read — the WeightOnlyLinear trade generalized to a pytree.
+# ---------------------------------------------------------------------------
+
+# marker key of a quantized leaf subtree: {"__w8__": int8 (in, out),
+# "scale": f32 (out,)}.  A dict key (not a wrapper class) keeps the tree
+# a plain jax pytree — it jit-traces, shards, and donates like any params.
+_Q8_KEY = "__w8__"
+
+
+def quantize_params(params, min_dim: int = 16):
+    """Weight-only int8 quantization of a RAW param pytree.
+
+    Every floating 2-D leaf with both dims >= ``min_dim`` — the matmul
+    family: embeddings, attention projections, FFN weights — becomes a
+    ``{"__w8__": int8, "scale": f32 per-out-column}`` subtree; biases,
+    LayerNorm vectors and small tables stay full precision (quantizing
+    a (d,) vector saves nothing and costs accuracy).  Idempotent on an
+    already-quantized tree.  Inverse: :func:`dequantize_params` — run it
+    INSIDE the jitted forward so storage stays int8."""
+
+    def rec(p):
+        if isinstance(p, dict):
+            if _Q8_KEY in p:            # already quantized — idempotent
+                return p
+            return {k: rec(v) for k, v in p.items()}
+        if (hasattr(p, "ndim") and p.ndim == 2
+                and p.shape[0] >= min_dim and p.shape[1] >= min_dim
+                and jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating)):
+            w_q, scales = quantize_int8(jnp.asarray(p, jnp.float32),
+                                        axis=0)
+            return {_Q8_KEY: w_q, "scale": scales}
+        return p
+
+    return rec(params)
+
+
+def dequantize_params(params):
+    """Trace-safe inverse of :func:`quantize_params`: collapses every
+    ``__w8__`` subtree back to its f32 matrix.  Call inside jit — XLA
+    fuses the int8->f32 convert and the per-column rescale into the
+    consuming matmul's weight read, so the dequantized copy never lives
+    in HBM between steps."""
+
+    def rec(p):
+        if isinstance(p, dict):
+            if _Q8_KEY in p:
+                return p[_Q8_KEY].astype(jnp.float32) * p["scale"]
+            return {k: rec(v) for k, v in p.items()}
+        return p
+
+    return rec(params)
+
+
+def is_quantized_params(params) -> bool:
+    """True when the pytree holds at least one ``__w8__`` leaf subtree."""
+    if isinstance(params, dict):
+        if _Q8_KEY in params:
+            return True
+        return any(is_quantized_params(v) for v in params.values())
+    return False
+
+
+# ---------------------------------------------------------------------------
 # activation calibration — reference min/max calibration over a calibration
 # set (SURVEY.md §3.2 quantization row); percentile clipping beats raw
 # abs-max when activations have outliers

@@ -395,15 +395,17 @@ def collective_ledger(step_engine) -> Dict[str, Any]:
     DCN hop when the mesh is multislice).
 
     Bytes are counted in the ACTUAL wire dtype of the configured
-    ``grad_comm`` mode — bf16 payloads at 2 B/elem, int8 payloads at
-    1 B/elem PLUS the f32 per-block quantization scales and block
-    padding (``parallel.collectives`` estimators) — so before/after
-    compression comparisons are honest.  ``grad_ici`` / ``param_ici``
-    split the ICI total into the gradient scatter (compressible) and the
-    f32 param gather (not compressed)."""
+    ``grad_comm`` / ``param_comm`` modes — bf16 payloads at 2 B/elem,
+    int8 payloads at 1 B/elem PLUS the f32 per-block quantization scales
+    and block padding (``parallel.collectives`` estimators) — so
+    before/after compression comparisons are honest.  ``grad_ici`` /
+    ``param_ici`` split the ICI total into the gradient scatter and the
+    param gather (f32, or the int8 delta gather under
+    ``param_comm="int8"``)."""
     mode = getattr(step_engine, "grad_comm",
                    "bf16" if getattr(step_engine, "bf16_grads", False)
                    else "fp32")
+    param_mode = getattr(step_engine, "param_comm", "fp32")
     grad_ici = float(getattr(step_engine, "grad_sync_ici_bytes_per_step",
                              step_engine.collective_bytes_per_step))
     param_ici = float(getattr(step_engine, "param_sync_ici_bytes_per_step",
@@ -417,6 +419,7 @@ def collective_ledger(step_engine) -> Dict[str, Any]:
         "param_ici_bytes_per_step": param_ici,
         "n_data_replicas": float(step_engine.n_data_replicas),
         "grad_comm": mode,
+        "param_comm": param_mode,
         # legacy key: payload bytes per gradient element on the wire
         "grad_dtype_bytes": wire_itemsize(mode),
         "comm_buckets": float(getattr(step_engine, "comm_buckets", 1)),

@@ -196,6 +196,14 @@ DEFAULT_HELP = {
                               "mid-decode)",
     "serving.decode.steps": "decode model steps executed",
     "serving.decode.prefill_chunks": "prompt prefill chunks executed",
+    "serving.decode.kv_bytes_per_page": "HBM bytes one KV page costs in "
+                                        "its stored dtype (int8 pages "
+                                        "include the per-page scale "
+                                        "pair; docs/quantization.md "
+                                        "§Serving memory hierarchy) — "
+                                        "page_dtype itself rides "
+                                        "/health decode_pressure as a "
+                                        "string",
     # label-form per-tenant serving families (docs/observability.md
     # §Federation): one family, one series per tenant="..." label — the
     # name-embedded serving.tenant.<name>.* families stay as deprecated

@@ -103,6 +103,13 @@ _ARTIFACT_GLOBS = (
     # The zero-unexpected-recompiles and sharded-parity gates are
     # enforced by the bench before the row is written
     "RECSYS_r[0-9]*.json",
+    # quantized decode serving rounds (bench_serving --decode --quant):
+    # int8 KV pages vs the f32 pool at EQUAL HBM budget.  The bench
+    # hard-gates token parity and zero unexpected recompiles before the
+    # row is written; the sentinel trends the slots-per-chip capacity
+    # ratio and the quantized engine's tokens/s (both higher-better —
+    # the memory win must keep paying and must not cost throughput)
+    "DECODE_QUANT_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic, collective
@@ -215,6 +222,19 @@ def normalize(doc: Any, source: str) -> List[Row]:
         # beating the whole-batch-restart baseline
         add(f"decode_speedup_vs_static{sfx}",
             row.get("speedup_vs_static"))
+    if row.get("bench") == "decode_quant":
+        # DECODE_QUANT_r*.json (bench_serving --decode --quant): int8 KV
+        # pages vs f32 at equal HBM budget.  Token parity and the zero-
+        # recompile sweep are hard gates inside the bench (a failing run
+        # writes no row); the sentinel trends the capacity ratio and the
+        # quantized throughput, both higher-better and geometry-scoped
+        geo = re.sub(r"[^A-Za-z0-9]+", "_",
+                     str(row.get("geometry") or "")).strip("_")
+        sfx = f"_{geo}" if geo else ""
+        add(f"decode_quant_slots_per_chip{sfx}",
+            row.get("slots_per_chip_ratio"))
+        add(f"decode_quant_tokens_per_s{sfx}",
+            row.get("quant_tokens_per_s"))
     if row.get("bench") == "decode_chaos":
         # DECODE_CHAOS_r*.json (bench_serving --fleet --chaos): the
         # pass/fail gates (zero failed requests, byte parity across the

@@ -210,13 +210,23 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, block_k_bwd,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, sm_scale, page_size):
+def _decode_kernel(pt_ref, len_ref, *refs, sm_scale, page_size, quantized):
     """Single-query attention over one slot's paged KV cache.  Grid
     (slots, head-blocks, page-blocks); the page dimension is innermost
     and walks the slot's page table via the scalar-prefetched index map
     — only the slot's own pages are ever touched, so HBM traffic scales
-    with the sequence's true length, not the pool size."""
+    with the sequence's true length, not the pool size.
+
+    ``quantized`` adds two scalar-prefetched per-page scale tables
+    (k/v, one f32 per pool page — docs/quantization.md §Serving memory
+    hierarchy): the int8 page block is dequantized IN-REGISTER right
+    after the DMA, so HBM reads stay 1 byte/element and the softmax
+    math is identical to the f32 kernel."""
+    if quantized:
+        (ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
     s = pl.program_id(0)
     j = pl.program_id(2)
     num_pb = pl.num_programs(2)
@@ -233,6 +243,10 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32) * sm_scale      # (bh, d)
         k = k_ref[0].astype(jnp.float32)                 # (bh, page, d)
         v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            pid = pt_ref[s, j]
+            k = k * ks_ref[pid]
+            v = v * vs_ref[pid]
         # VPU-friendly batched dot: broadcast-multiply-reduce keeps the
         # per-head contraction off the (batched-dot-averse) MXU path
         sc = jnp.sum(q[:, None, :] * k, axis=-1)         # (bh, page)
@@ -257,6 +271,7 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           k_scales=None, v_scales=None,
                            sm_scale: Optional[float] = None,
                            block_h: Optional[int] = None,
                            interpret: Optional[bool] = None):
@@ -272,6 +287,11 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     ``lengths``: (slots,) int32 — the highest valid cache position per
     slot, INCLUSIVE (the current token's K/V must already be written).
 
+    int8 page pools (docs/quantization.md §Serving memory hierarchy)
+    pass ``k_scales``/``v_scales``: (num_pages,) float32 per-page
+    abs-max scales, scalar-prefetched alongside the page table so each
+    page block is dequantized in-register after its 1-byte/element DMA.
+
     ``block_h`` tiles the head dimension per program (must divide
     heads); ``None`` consults the autotune cache under the
     ``flash_attention_decode`` registry entry and falls back to the
@@ -279,6 +299,13 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     S, h, d = q.shape
     P, hk, page, dk = k_pages.shape
     assert (h, d) == (hk, dk), (q.shape, k_pages.shape)
+    quantized = k_pages.dtype == jnp.int8
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("int8 k_pages/v_pages need k_scales/v_scales "
+                         "(one f32 abs-max scale per pool page)")
+    if not quantized and (k_scales is not None or v_scales is not None):
+        raise ValueError("k_scales/v_scales only apply to int8 pages, "
+                         f"got {k_pages.dtype} pages")
     nb = page_table.shape[1]
     if sm_scale is None:
         sm_scale = d ** -0.5
@@ -299,32 +326,48 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
             raise ValueError(f"block_h {bh} must divide heads {h}")
 
     kernel = functools.partial(_decode_kernel, sm_scale=float(sm_scale),
-                               page_size=page)
+                               page_size=page, quantized=quantized)
+    # scalar-prefetch operands: (page_table, lengths) always; the int8
+    # pool adds the two per-page scale tables (index maps then take four
+    # trailing scalar refs instead of two — hence the arity split below)
+    if quantized:
+        def q_map(s, hb, j, pt, ln, ks, vs):
+            return (s, hb, 0)
+
+        def kv_map(s, hb, j, pt, ln, ks, vs):
+            return (pt[s, j], hb, 0, 0)
+    else:
+        def q_map(s, hb, j, pt, ln):
+            return (s, hb, 0)
+
+        def kv_map(s, hb, j, pt, ln):
+            return (pt[s, j], hb, 0, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4 if quantized else 2,
         grid=(S, h // bh, nb),
         in_specs=[
-            pl.BlockSpec((1, bh, d), lambda s, hb, j, pt, ln: (s, hb, 0)),
-            pl.BlockSpec((1, bh, page, d),
-                         lambda s, hb, j, pt, ln: (pt[s, j], hb, 0, 0)),
-            pl.BlockSpec((1, bh, page, d),
-                         lambda s, hb, j, pt, ln: (pt[s, j], hb, 0, 0)),
+            pl.BlockSpec((1, bh, d), q_map),
+            pl.BlockSpec((1, bh, page, d), kv_map),
+            pl.BlockSpec((1, bh, page, d), kv_map),
         ],
-        out_specs=pl.BlockSpec((1, bh, d),
-                               lambda s, hb, j, pt, ln: (s, hb, 0)),
+        out_specs=pl.BlockSpec((1, bh, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((bh, 1), jnp.float32),    # running max
             pltpu.VMEM((bh, 1), jnp.float32),    # running denom
             pltpu.VMEM((bh, d), jnp.float32),    # output accumulator
         ],
     )
+    scalars = [jnp.asarray(page_table, jnp.int32),
+               jnp.asarray(lengths, jnp.int32)]
+    if quantized:
+        scalars += [jnp.asarray(k_scales, jnp.float32),
+                    jnp.asarray(v_scales, jnp.float32)]
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, h, d), q.dtype),
         interpret=default_interpret(interpret),
-    )(jnp.asarray(page_table, jnp.int32), jnp.asarray(lengths, jnp.int32),
-      q, k_pages, v_pages)
+    )(*scalars, q, k_pages, v_pages)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,

@@ -65,6 +65,45 @@ def dequantize_blockwise(q, scales) -> jnp.ndarray:
     return (xb * scales[..., None]).reshape(*lead, L)
 
 
+def quantize_pages(pages, floor_scales=None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-page symmetric int8 quantization of KV page images.
+
+    ``pages`` is ``(..., heads, page_size, head_dim)`` float32 — every
+    trailing-3-dim page image gets ONE abs-max scale (the page-table
+    granularity of docs/quantization.md §Serving memory hierarchy), so
+    the scales ride the page table as a flat ``(..., )`` float32 array.
+    Without ``floor_scales`` this is :func:`quantize_blockwise` with one
+    block per page.
+
+    ``floor_scales`` (shape = the returned scales) makes the scale
+    MONOTONE within a page's occupancy: ``new = max(floor, amax/127)``.
+    A page whose contents did not change since the last quantization
+    requantizes EXACTLY under a monotone scale (``round(q·s / s) == q``),
+    which is what makes the decode engine's whole-row write-back safe.
+    A floor of 0.0 marks a freshly allocated page: until something is
+    written, dequantize yields zeros regardless of the stale int8
+    payload left by the page's previous owner."""
+    lead, elems = pages.shape[:-3], int(
+        pages.shape[-3] * pages.shape[-2] * pages.shape[-1])
+    flat = pages.reshape(*lead, elems)
+    if floor_scales is None:
+        q, scales = quantize_blockwise(flat, elems)
+        return q.reshape(pages.shape), scales[..., 0]
+    amax = jnp.max(jnp.abs(flat), axis=-1)
+    scales = jnp.maximum(amax / 127.0,
+                         jnp.asarray(floor_scales, jnp.float32))
+    safe = jnp.maximum(scales, 1e-12)[..., None]
+    q = jnp.clip(jnp.round(flat / safe), -127, 127).astype(jnp.int8)
+    return q.reshape(pages.shape), scales.astype(jnp.float32)
+
+
+def dequantize_pages(q, scales) -> jnp.ndarray:
+    """Inverse of :func:`quantize_pages`: int8 pages ``(..., h, p, hd)``
+    + per-page scales ``(...,)`` → float32 pages."""
+    return q.astype(jnp.float32) * scales[..., None, None, None]
+
+
 def _int8_mm_kernel(x_ref, w_ref, o_ref):
     # x: (bm, bk) int8, w: (bk, bn) int8 → o: (bm, bn) int32; the K grid
     # dimension is innermost (sequential on-core), so the output block stays

@@ -170,6 +170,11 @@ class Optimizer:
         #                                collective (bucketed overlap);
         #                                None = EngineConfig's, which
         #                                defaults to one monolithic sync
+        self.param_comm = None  # updated-param all_gather wire format:
+        #                         "fp32" | "int8" (blockwise-quantized
+        #                         delta gather, ~4x fewer param-gather
+        #                         bytes — docs/parallelism.md);
+        #                         None = fp32
         self.quant_block = None  # int8 scale granularity (elements per
         #                          f32 scale); None = collectives default
         self.remat = False       # jax.checkpoint the forward (HBM for FLOPs)
@@ -409,6 +414,8 @@ class Optimizer:
                                             "comm_bucket_bytes", None)))
         if self.quant_block is not None:
             step_kw["quant_block"] = int(self.quant_block)
+        if self.param_comm is not None:
+            step_kw["param_comm"] = str(self.param_comm)
         step_engine = ShardedParameterStep(
             self.model, self.criterion, self.optim_method, mesh, init_vars,
             clip=self.clip, remat=self.remat,

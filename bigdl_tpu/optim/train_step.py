@@ -141,7 +141,8 @@ class ShardedParameterStep:
                  seq_parallel: bool = False, trainable_mask=None,
                  grad_comm: Optional[str] = None,
                  comm_bucket_bytes: Optional[int] = None,
-                 quant_block: int = collectives.DEFAULT_QUANT_BLOCK):
+                 quant_block: int = collectives.DEFAULT_QUANT_BLOCK,
+                 param_comm: Optional[str] = None):
         """``grad_comm``: wire format of the gradient sync
         (docs/parallelism.md §Gradient compression) —
 
@@ -155,6 +156,22 @@ class ShardedParameterStep:
           fewer gradient bytes on ICI and DCN.  The optimizer update
           always runs on the f32 master params; a single-device data
           axis skips quantization entirely (no wire, no rounding).
+
+        ``param_comm``: wire format of the updated-param all_gather
+        (the other half of the ZeRO-1 cycle's ICI bytes) —
+
+        - ``"fp32"`` (default): full-precision gather, the original
+          cycle — byte-identical params on every rank by construction.
+        - ``"int8"``: gather the blockwise-int8 UPDATE DELTA
+          (``new - old`` per shard chunk) + f32 per-block scales and
+          reconstruct ``base + dequantized delta`` against the
+          replicated flat params — ~4x fewer param-gather ICI bytes.
+          The gathered bytes are identical on every rank, so params
+          stay bit-identical replicated; the per-step rounding rides
+          the small delta, not the param magnitude, and passes the
+          same loss-parity gate as ``grad_comm="int8"``
+          (tests/test_grad_comm.py).  Master params and the optimizer
+          update stay f32.
 
         ``bf16_grads``: DEPRECATED spelling of ``grad_comm="bf16"``;
         still accepted (with a warning) so existing configs keep working.
@@ -218,6 +235,14 @@ class ShardedParameterStep:
             raise ValueError(f"grad_comm {grad_comm!r}: one of "
                              f"{collectives.GRAD_COMM_MODES}")
         self.grad_comm = grad_comm
+        if param_comm is not None:
+            param_comm = str(param_comm).strip().lower()
+        if param_comm is None:
+            param_comm = "fp32"
+        if param_comm not in collectives.PARAM_COMM_MODES:
+            raise ValueError(f"param_comm {param_comm!r}: one of "
+                             f"{collectives.PARAM_COMM_MODES}")
+        self.param_comm = param_comm
         # legacy readers (benches, old ledgers): True exactly for bf16 wire
         self.bf16_grads = grad_comm == "bf16"
         self.quant_block = int(quant_block)
@@ -398,6 +423,7 @@ class ShardedParameterStep:
         elementwise = optim.elementwise
         remat = self.remat
         grad_comm, quant_block = self.grad_comm, self.quant_block
+        param_comm = self.param_comm
         bucket_cols = tuple(self._bucket_cols)
         dcn = self.dcn
         remat_policy = self.remat_policy
@@ -547,8 +573,19 @@ class ShardedParameterStep:
                                opt_state))
                     np_b, no_b = optim.update(step, sb, p_b, o_b)
                     if ndev > 1 and comm:
-                        np_b = jax.lax.all_gather(
-                            np_b, AXIS_DATA, tiled=True)
+                        if param_comm == "int8":
+                            # delta gather: int8 payload + scales are
+                            # identical bytes on every rank, the base
+                            # rows come from the replicated flat_p —
+                            # params stay bit-identical replicated
+                            base = flat_p.reshape(
+                                ndev, shard_size)[:, c0:c1]
+                            np_b = collectives.all_gather_delta_quantized(
+                                np_b - p_b, base, AXIS_DATA,
+                                block=quant_block).reshape(-1)
+                        else:
+                            np_b = jax.lax.all_gather(
+                                np_b, AXIS_DATA, tiled=True)
                     elif ndev > 1:  # comm=False probe: same-shape local op
                         np_b = jnp.tile(np_b, ndev)
                     new_parts.append(np_b.reshape(max(ndev, 1), wb))
@@ -745,9 +782,15 @@ class ShardedParameterStep:
 
     @property
     def param_sync_ici_bytes_per_step(self) -> int:
-        """Per-step ICI wire bytes of the updated-param all_gather —
-        always f32 (master params stay full precision on the wire)."""
-        return self.n_pad * 4 if self.ndev > 1 else 0
+        """Per-step ICI wire bytes of the updated-param all_gather, in
+        the ACTUAL ``param_comm`` wire dtype: f32 gather bytes
+        (``n_pad * 4``) by default; int8 delta payload + f32 per-block
+        scales under ``param_comm="int8"``."""
+        if self.ndev <= 1:
+            return 0
+        return sum(collectives.ag_wire_bytes(
+            c1 - c0, self.ndev, self.param_comm, self.quant_block)
+            for c0, c1 in self._bucket_cols)
 
     @property
     def collective_bytes_per_step(self) -> int:
@@ -786,6 +829,7 @@ class ShardedParameterStep:
         ndev, shard_size, dcn = self.ndev, self.shard_size, self.dcn
         dcn_axis = self._dcn_axis
         grad_comm, block = self.grad_comm, self.quant_block
+        param_comm = self.param_comm
         cols = tuple(self._bucket_cols)
         batch_axes = self._batch_axes
 
@@ -808,7 +852,14 @@ class ShardedParameterStep:
                 acc = acc + jnp.sum(sb.astype(jnp.float32))
                 p_b = jax.lax.dynamic_slice(
                     flat_p, (rank * shard_size + c0,), (wb,))
-                if ndev > 1:
+                if ndev > 1 and param_comm == "int8":
+                    # same wire shape as the step's delta gather (int8
+                    # payload + scales); p_b stands in for the delta —
+                    # the probe only needs byte-identical collectives
+                    base = flat_p.reshape(ndev, shard_size)[:, c0:c1]
+                    p_b = collectives.all_gather_delta_quantized(
+                        p_b, base, AXIS_DATA, block=block)
+                elif ndev > 1:
                     p_b = jax.lax.all_gather(p_b, AXIS_DATA, tiled=True)
                 acc = acc + jnp.sum(p_b)
             # replicate the scalar so the out_spec holds on every rank

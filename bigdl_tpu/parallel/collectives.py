@@ -56,6 +56,14 @@ from bigdl_tpu.ops.quantized import dequantize_blockwise, quantize_blockwise
 # gradient-sync wire formats for the ZeRO-1 cycle (train_step.grad_comm)
 GRAD_COMM_MODES = ("fp32", "bf16", "int8")
 
+# updated-param all_gather wire formats (train_step.param_comm): fp32 is
+# the original full-precision gather; int8 gathers the blockwise-
+# quantized UPDATE DELTA and reconstructs against the replicated base
+# params — no bf16 mode (a bf16 param wire would round the master
+# params themselves; the delta trick only works because the base is
+# already replicated bit-identically)
+PARAM_COMM_MODES = ("fp32", "int8")
+
 # default quantization block: 1024 elements per scale keeps the scale
 # overhead at 4/1024 ≈ 0.4% of the payload while isolating outliers to
 # ~4 KB runs of the flat gradient
@@ -139,6 +147,37 @@ def psum_quantized(vec, axis: str, n: int, *,
     return dequantize_blockwise(q, scales)[:w]
 
 
+def all_gather_delta_quantized(delta, base_rows, axis: str, *,
+                               block: int = DEFAULT_QUANT_BLOCK):
+    """All-gather one bucket's updated-param chunk with int8 wire bytes
+    — the ``param_comm="int8"`` leg of the ZeRO-1 cycle.
+
+    ZeRO-1 keeps the flat f32 params REPLICATED; only the optimizer
+    update is sharded.  So instead of gathering each rank's f32 updated
+    chunk (4 bytes/elem), gather the blockwise-int8 UPDATE DELTA
+    ``np_b - p_b`` plus f32 per-block scales (~4x fewer ICI bytes) and
+    reconstruct ``base + dequantize(delta)`` locally.  The gathered
+    payload+scales are identical bytes on every rank and the base rows
+    come from the replicated ``flat_p``, so the reconstructed params
+    stay bit-identical replicated — the invariant the whole cycle rests
+    on.  Quantizing the DELTA (small against its own abs-max, reset
+    every step — rounding does not accumulate in the master params'
+    magnitude) is what makes int8 survive the loss-parity gate where
+    quantizing the params themselves would not.
+
+    ``delta``: this rank's ``(w,)`` f32 update delta.  ``base_rows``:
+    ``(n, w)`` f32 — EVERY rank's base param chunk at these columns
+    (``flat_p.reshape(n, shard)[:, c0:c1]``, replicated).  Returns the
+    ``(n, w)`` f32 new param rows."""
+    n, w = base_rows.shape
+    block = max(1, min(block, w))   # same clamp as reduce_scatter
+    dp = _pad_last(delta.astype(jnp.float32)[None], block)[0]
+    q, scales = quantize_blockwise(dp, block)
+    q = jax.lax.all_gather(q, axis)                  # (n, wq) int8
+    scales = jax.lax.all_gather(scales, axis)        # (n, wq/block) f32
+    return base_rows + dequantize_blockwise(q, scales)[:, :w]
+
+
 def reduce_scatter_wire(g2d, axis: str, mode: str, *,
                         block: int = DEFAULT_QUANT_BLOCK):
     """Mode-dispatched reduce-scatter of ONE bucket — the single wire
@@ -218,6 +257,22 @@ def rs_wire_bytes(w: int, n: int, mode: str,
     return int(n * w * wire_itemsize(mode))
 
 
+def ag_wire_bytes(w: int, n: int, mode: str,
+                  block: int = DEFAULT_QUANT_BLOCK) -> int:
+    """Per-step wire bytes to all_gather ONE bucket of per-rank width
+    ``w`` over ``n`` ranks — the updated-param leg.  ``"fp32"`` is the
+    plain f32 gather (``n * w * 4``, summing to the classic
+    ``n_pad * 4``); ``"int8"`` prices the delta gather's padded int8
+    payload plus f32 per-block scales."""
+    if n <= 1 or w <= 0:
+        return 0
+    if mode == "int8":
+        block = max(1, min(block, w))  # same clamp as the collective
+        wq = _round_up(w, block)
+        return n * wq + n * (wq // block) * _SCALE_BYTES
+    return int(n * w * 4)
+
+
 def psum_wire_bytes(w: int, n: int, mode: str,
                     block: int = DEFAULT_QUANT_BLOCK) -> int:
     """Per-step wire bytes for the hierarchical psum of a ``w``-elem
@@ -236,15 +291,20 @@ def psum_wire_bytes(w: int, n: int, mode: str,
 def layout_ledger(n_params: int, ndev: int, dcn: int = 1,
                   mode: str = "fp32",
                   bucket_bytes: Optional[int] = None,
-                  block: int = DEFAULT_QUANT_BLOCK) -> Dict[str, float]:
+                  block: int = DEFAULT_QUANT_BLOCK,
+                  param_comm: str = "fp32") -> Dict[str, float]:
     """Pure layout math: the per-step collective-bytes ledger of a ZeRO-1
     cycle over ``n_params`` parameters WITHOUT building a step engine (no
     devices touched) — what ``bench_scaling --grad-comm`` uses to price
     the MULTICHIP_LARGE geometry on any host.  Mirrors
     ``ShardedParameterStep``'s properties exactly (same bucket table,
-    same estimators)."""
+    same estimators).  ``param_comm`` prices the updated-param gather in
+    its actual wire dtype — fp32 stays the classic ``n_pad * 4``."""
     if mode not in GRAD_COMM_MODES:
         raise ValueError(f"grad_comm {mode!r}: one of {GRAD_COMM_MODES}")
+    if param_comm not in PARAM_COMM_MODES:
+        raise ValueError(f"param_comm {param_comm!r}: one of "
+                         f"{PARAM_COMM_MODES}")
     n_pad = _round_up(n_params, ndev)
     shard = n_pad // ndev
     cols = bucket_columns(shard, ndev, bucket_bytes,
@@ -252,11 +312,13 @@ def layout_ledger(n_params: int, ndev: int, dcn: int = 1,
                           block if mode == "int8" else None)
     grad_ici = sum(rs_wire_bytes(c1 - c0, ndev, mode, block)
                    for c0, c1 in cols)
-    param_ici = n_pad * 4 if ndev > 1 else 0
+    param_ici = (sum(ag_wire_bytes(c1 - c0, ndev, param_comm, block)
+                     for c0, c1 in cols) if ndev > 1 else 0)
     dcn_bytes = sum(psum_wire_bytes(c1 - c0, dcn, mode, block)
                     for c0, c1 in cols)
     return {
         "grad_comm": mode,
+        "param_comm": param_comm,
         "n_params": float(n_params),
         "n_params_padded": float(n_pad),
         "comm_buckets": float(len(cols)),

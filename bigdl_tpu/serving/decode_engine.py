@@ -141,6 +141,15 @@ class DecodeConfig:
     # re-prefilling them.  Continuous mode only; cached pages are
     # reclaimed (LRU, idle entries only) when admission runs short.
     prefix_cache_pages: int = 0
+    # KV page storage dtype (docs/quantization.md §Serving memory
+    # hierarchy): "float32" (the byte-parity default) or "int8" —
+    # pages store int8 payloads with one abs-max scale per (layer,
+    # page) riding the page table.  int8 shrinks page HBM ~4x (so a
+    # fixed HBM budget holds ~2x the decode slots once weights are
+    # quantized too) at the cost of relaxing byte parity to the
+    # token-parity budget (greedy token agreement + bounded logp
+    # drift) asserted in tests/test_quant_serving.py.
+    kv_dtype: str = "float32"
 
     @property
     def cap(self) -> int:
@@ -333,14 +342,23 @@ class _AdapterBase:
     a contiguous cache — identical values at every unmasked position,
     so the outputs agree bitwise (see the module docstring)."""
 
-    def __init__(self, model, params, layout=None):
+    def __init__(self, model, params, layout=None, weight_quant=None):
         """``layout``: serve the checkpoint MODEL-SHARDED — a
         ``parallelism=`` combo string ("tp:8") or a resolved
         :class:`~bigdl_tpu.parallel.ResolvedLayout`; every parameter is
         placed as a ``NamedSharding`` per the model's layout table
         (docs/parallelism.md §Declarative layouts) and the engine's
         jitted programs partition under GSPMD.  The closed compile set
-        (cache buckets x prefill/decode programs) is unchanged."""
+        (cache buckets x prefill/decode programs) is unchanged.
+
+        ``weight_quant="int8"``: store the matmul-family params int8
+        with per-out-column scales (docs/quantization.md §Serving
+        memory hierarchy) — 4x less HBM at rest, so one chip holds a
+        bigger checkpoint.  Every adapter param access happens inside
+        the engine's traced programs, so the dequantize compiles into
+        each program (fused into the weight reads) and the f32 copy
+        never persists between steps.  Accepts an already-quantized
+        tree unchanged (the InferenceModel path quantizes once)."""
         self.layout = None
         if layout is not None:
             from bigdl_tpu.parallel.mesh_policy import (ResolvedLayout,
@@ -349,8 +367,28 @@ class _AdapterBase:
             self.layout = (layout if isinstance(layout, ResolvedLayout)
                            else mesh_and_layout(str(layout)))
             params = self.layout.shard_params(model, params)
+        if weight_quant not in (None, "int8"):
+            raise ValueError(
+                f"weight_quant {weight_quant!r}: None | 'int8'")
+        self.weight_quant = weight_quant
+        if weight_quant == "int8":
+            from bigdl_tpu.nn.quantized import quantize_params
+
+            params = quantize_params(params)   # idempotent
         self.model = model
-        self.params = params
+        self._params_stored = params
+
+    @property
+    def params(self):
+        """The param tree the traced step math consumes.  Under
+        ``weight_quant="int8"`` each access rebuilds the f32 view from
+        the stored int8 tree — cheap at trace time (ops, not data; XLA
+        CSEs repeated accesses within one program)."""
+        if self.weight_quant == "int8":
+            from bigdl_tpu.nn.quantized import dequantize_params
+
+            return dequantize_params(self._params_stored)
+        return self._params_stored
 
     def _split(self, x):
         b, t, _ = x.shape
@@ -394,10 +432,12 @@ class LMAdapter(_AdapterBase):
     """Causal LM (``Transformer(mode="lm")``): the prompt prefills the
     self-attention cache; generation continues from its last token."""
 
-    def __init__(self, model, params, cap: int, layout=None):
+    def __init__(self, model, params, cap: int, layout=None,
+                 weight_quant=None):
         if model.mode != "lm":
             raise ValueError("LMAdapter needs a Transformer(mode='lm')")
-        super().__init__(model, params, layout=layout)
+        super().__init__(model, params, layout=layout,
+                         weight_quant=weight_quant)
         layer = model.decoder[0].attn
         self.num_heads = layer.num_heads
         self.head_dim = layer.head_dim
@@ -466,11 +506,12 @@ class Seq2SeqAdapter(_AdapterBase):
 
     def __init__(self, model, params, cap: int, bos_id: int,
                  src_buckets: Sequence[int] = (8, 16, 32, 64),
-                 layout=None):
+                 layout=None, weight_quant=None):
         if model.mode != "translation":
             raise ValueError("Seq2SeqAdapter needs a translation-mode "
                              "Transformer")
-        super().__init__(model, params, layout=layout)
+        super().__init__(model, params, layout=layout,
+                         weight_quant=weight_quant)
         layer = model.decoder[0].self_attn
         self.num_heads = layer.num_heads
         self.head_dim = layer.head_dim
@@ -620,9 +661,27 @@ class DecodeEngine:
                              "be >= 2 (single-row programs take a "
                              "different XLA reduction path and break "
                              "decode parity)")
+        if cfg.kv_dtype not in ("float32", "int8"):
+            raise ValueError(f"DecodeConfig.kv_dtype must be 'float32' "
+                             f"or 'int8', got {cfg.kv_dtype!r}")
+        # int8 pages (docs/quantization.md §Serving memory hierarchy):
+        # pages store int8 payloads; one f32 abs-max scale per (layer,
+        # page) rides alongside.  The scale tables exist for the f32
+        # engine too (L*P floats — noise next to the pool) so every
+        # jitted program has ONE signature; the f32 trace just passes
+        # them through untouched.
+        self._quant_kv = cfg.kv_dtype == "int8"
+        kv_dt = jnp.int8 if self._quant_kv else jnp.float32
         self._kv_k = jnp.zeros((L, cfg.total_pages, h, cfg.page_size, hd),
-                               jnp.float32)
+                               kv_dt)
         self._kv_v = jnp.zeros_like(self._kv_k)
+        self._kv_sk = jnp.zeros((L, cfg.total_pages), jnp.float32)
+        self._kv_sv = jnp.zeros_like(self._kv_sk)
+        # pages popped from the free list whose scales still carry the
+        # previous owner's value — zeroed (in fixed-width chunks) before
+        # the next program dispatch so a reclaimed page can never
+        # dequantize stale payload against a stale scale
+        self._fresh_pages: List[int] = []
         self._ctx_bufs = {
             k: jnp.zeros((cfg.slots,) + shape, dtype)
             for k, (shape, dtype) in adapter.ctx_specs().items()}
@@ -650,8 +709,9 @@ class DecodeEngine:
 
             self._prefix_cache = PrefixCache(
                 min(cfg.prefix_cache_pages, cfg.total_pages),
-                cfg.page_size)
+                cfg.page_size, page_dtype=cfg.kv_dtype)
         self._import_fn: Optional[Callable] = None
+        self._scale_reset_fn: Optional[Callable] = None
         self._base_key = jax.random.PRNGKey(cfg.base_seed)
         # work queue: (deadline_t, seq, req) — the PR 8 deadline-heap
         # ordering at decode-queue granularity
@@ -761,6 +821,16 @@ class DecodeEngine:
             return
         h = req.handoff
         cfg, a = self.cfg, self.adapter
+        hd_dt = str(h.get("kv_dtype", "float32"))
+        if hd_dt != cfg.kv_dtype:
+            # mixed-dtype pages must never be imported (an f32 engine
+            # has no scale tables; an int8 engine would quantize-import
+            # an f32 image and silently break handoff parity) — the
+            # pool proxy degrades this slot to re-prefill failover
+            raise ValueError(
+                f"handoff kv_dtype {hd_dt!r} does not match this "
+                f"engine's kv_dtype {cfg.kv_dtype!r}; refusing the "
+                "page import (re-prefill instead)")
         n = -(-len(prompt) // cfg.page_size)
         want = (a.num_layers, n, a.num_heads, cfg.page_size, a.head_dim)
         k = np.asarray(h.get("k"))
@@ -768,6 +838,14 @@ class DecodeEngine:
         if k.shape != want or v.shape != want:
             raise ValueError(f"handoff K/V shape {k.shape} does not "
                              f"match engine geometry {want}")
+        if self._quant_kv:
+            ks = np.asarray(h.get("k_scales"))
+            vs = np.asarray(h.get("v_scales"))
+            if ks.shape != (a.num_layers, n) \
+                    or vs.shape != (a.num_layers, n):
+                raise ValueError(
+                    f"int8 handoff scale shape {ks.shape} does not "
+                    f"match (layers, pages) {(a.num_layers, n)}")
         toks = np.asarray(h.get("tokens"), np.int32).reshape(-1)
         if not np.array_equal(toks, prompt):
             raise ValueError("handoff prompt tokens do not match the "
@@ -787,6 +865,18 @@ class DecodeEngine:
 
     def active_slots(self) -> int:
         return int(self._active_mask.sum())
+
+    def kv_bytes_per_page(self) -> int:
+        """HBM bytes one page row costs across every layer's K AND V
+        pool, in the ACTUAL stored dtype — plus, for int8, the two f32
+        scales per (layer, page).  This is the figure the wire/HBM
+        ledger and the router's capacity scoring price pages by."""
+        a = self.adapter
+        elems = (a.num_layers * a.num_heads * self.cfg.page_size
+                 * a.head_dim)
+        itemsize = 1 if self._quant_kv else 4
+        scale_bytes = 2 * a.num_layers * 4 if self._quant_kv else 0
+        return 2 * elems * itemsize + scale_bytes
 
     def decode_pressure(self) -> Dict[str, Any]:
         """Admission-pressure snapshot for the fleet router
@@ -809,6 +899,12 @@ class DecodeEngine:
             # proof the physical split is live, not just configured
             "kv_exports": self.stats["kv_exports"],
             "kv_imports": self.stats["kv_imports"],
+            # page capacity in BYTES, not just counts: the fleet router
+            # must not score an int8 worker's free page and an f32
+            # worker's free page as equal capacity (docs/serving.md
+            # §Decode fleet)
+            "page_dtype": self.cfg.kv_dtype,
+            "kv_bytes_per_page": self.kv_bytes_per_page(),
         }
         if self._prefix_cache is not None:
             out["prefix_cache"] = self._prefix_cache.stats()
@@ -906,12 +1002,12 @@ class DecodeEngine:
                 n = -(-int(self._lengths[s]) // cfg.page_size)
                 pids = np.zeros((cfg.pages_per_slot,), np.int32)
                 pids[:n] = self._page_table[s, :n]
-                k = np.asarray(self._kv_k[:, pids], np.float32)[:, :n]
-                v = np.asarray(self._kv_v[:, pids], np.float32)[:, :n]
+                k = np.asarray(self._kv_k[:, pids])[:, :n]
+                v = np.asarray(self._kv_v[:, pids])[:, :n]
                 tokens = np.concatenate([
                     np.asarray(seq.prompt, np.int32),
                     np.asarray(seq.generated[:-1], np.int32)])
-                exports.append({
+                export = {
                     "tokens": tokens,
                     "first_token": int(seq.generated[-1]),
                     "first_logp": float(seq.last_logp),
@@ -922,9 +1018,16 @@ class DecodeEngine:
                     "request_id": req.rid,
                     "migrated": True,
                     "resume_len": len(seq.generated),
+                    "kv_dtype": cfg.kv_dtype,
                     "k": k,
                     "v": v,
-                })
+                }
+                if self._quant_kv:
+                    export["k_scales"] = np.asarray(
+                        self._kv_sk[:, pids], np.float32)[:, :n]
+                    export["v_scales"] = np.asarray(
+                        self._kv_sv[:, pids], np.float32)[:, :n]
+                exports.append(export)
                 seq.frozen = True
                 self._active_mask[s] = False
                 frozen.append(req.rid)
@@ -1015,11 +1118,14 @@ class DecodeEngine:
                 a = self.adapter
                 z = np.zeros((a.num_layers, cfg.pages_per_slot,
                               a.num_heads, cfg.page_size, a.head_dim),
-                             np.float32)
-                self._kv_k, self._kv_v = self._import_write()(
-                    self._kv_k, self._kv_v,
+                             np.int8 if self._quant_kv else np.float32)
+                zs = np.zeros((a.num_layers, cfg.pages_per_slot),
+                              np.float32)
+                (self._kv_k, self._kv_v, self._kv_sk,
+                 self._kv_sv) = self._import_write()(
+                    self._kv_k, self._kv_v, self._kv_sk, self._kv_sv,
                     np.full((cfg.pages_per_slot,), cfg.total_pages,
-                            np.int32), z, z)
+                            np.int32), z, z, zs, zs)
                 # ...and the export gather (same fixed index width)
                 np.asarray(self._kv_k[
                     :, np.zeros((cfg.pages_per_slot,), np.int32)])
@@ -1030,17 +1136,18 @@ class DecodeEngine:
         cfg = self.cfg
         S = cfg.slots
         kv_k, kv_v = self._kv_k, self._kv_v
+        kv_sk, kv_sv = self._kv_sk, self._kv_sv
         for nb in cfg.len_buckets():
-            kv_k, kv_v, _, _ = self._step_fn(nb)(
-                kv_k, kv_v, self._ctx_bufs,
+            kv_k, kv_v, kv_sk, kv_sv, _, _ = self._step_fn(nb)(
+                kv_k, kv_v, kv_sk, kv_sv, self._ctx_bufs,
                 self._page_table, np.zeros((S,), np.int32),
                 np.zeros((S,), np.int32),
                 np.zeros((S,), bool), np.zeros((S,), np.int32),
                 np.zeros((S,), np.float32), np.zeros((S,), np.int32),
                 np.ones((S,), np.float32))
             B = cfg.prefill_batch
-            kv_k, kv_v, _, _ = self._prefill_fn(nb)(
-                kv_k, kv_v, self._ctx_bufs,
+            kv_k, kv_v, kv_sk, kv_sv, _, _ = self._prefill_fn(nb)(
+                kv_k, kv_v, kv_sk, kv_sv, self._ctx_bufs,
                 np.zeros((B,), np.int32),
                 np.zeros((B, cfg.pages_per_slot), np.int32),
                 np.zeros((B, cfg.prompt_chunk), np.int32),
@@ -1048,8 +1155,20 @@ class DecodeEngine:
                 np.zeros((B,), bool), np.zeros((B,), np.int32),
                 np.zeros((B,), np.float32), np.zeros((B,), np.int32),
                 np.ones((B,), np.float32))
+        if self._quant_kv:
+            # the scale-reset program (all page ids dropped — no-op on
+            # the live tables)
+            kv_sk, kv_sv = self._scale_reset()(
+                kv_sk, kv_sv,
+                np.full((cfg.pages_per_slot,), cfg.total_pages,
+                        np.int32))
+            # ...and the fixed-width scale gather the harvest/migration
+            # exports run
+            np.asarray(kv_sk[:, np.zeros((cfg.pages_per_slot,),
+                                         np.int32)])
         jax.block_until_ready(kv_k)
         self._kv_k, self._kv_v = kv_k, kv_v
+        self._kv_sk, self._kv_sv = kv_sk, kv_sv
 
     # -- jitted programs ----------------------------------------------------
     def _gather(self, kv, pt):
@@ -1059,6 +1178,48 @@ class DecodeEngine:
         L, B, nb, h, page, hd = g.shape
         return g.transpose(1, 0, 3, 2, 4, 5).reshape(B, L, h, nb * page,
                                                      hd)
+
+    def _gather_deq(self, kv, sc, pt):
+        """:meth:`_gather` for int8 pools: dequantize each gathered page
+        against its (layer, page) scale before flattening — a freshly
+        allocated page carries scale 0.0, so its stale int8 payload
+        dequantizes to exact zeros."""
+        g = (kv[:, pt].astype(jnp.float32)
+             * sc[:, pt][..., None, None, None])
+        L, B, nb, h, page, hd = g.shape
+        return g.transpose(1, 0, 3, 2, 4, 5).reshape(B, L, h, nb * page,
+                                                     hd)
+
+    def _scale_reset(self):
+        if self._scale_reset_fn is None:
+            def reset(sk, sv, pids):
+                return (sk.at[:, pids].set(0.0, mode="drop"),
+                        sv.at[:, pids].set(0.0, mode="drop"))
+
+            self._scale_reset_fn = jax.jit(reset, donate_argnums=(0, 1))
+        return self._scale_reset_fn
+
+    def _flush_fresh_scales(self) -> None:
+        """Zero the scales of pages just popped off the free list (int8
+        only), BEFORE the next program dispatch: a reclaimed page
+        otherwise inherits its previous owner's scale and dequantizes
+        that owner's stale payload — the stale-scale aliasing hazard
+        tests/test_quant_serving.py pins.  Fixed ``pages_per_slot``-wide
+        chunks (out-of-range padding drops) keep the compile set
+        closed."""
+        if not self._fresh_pages:
+            return
+        fresh, self._fresh_pages = self._fresh_pages, []
+        if not self._quant_kv:
+            return
+        W = self.cfg.pages_per_slot
+        fn = self._scale_reset()
+        for c0 in range(0, len(fresh), W):
+            pids = np.full((W,), self.cfg.total_pages, np.int32)
+            chunk = fresh[c0:c0 + W]
+            pids[:len(chunk)] = chunk
+            self._kv_sk, self._kv_sv = fn(self._kv_sk, self._kv_sv,
+                                          pids)
 
     def _use_flash(self) -> bool:
         if self.cfg.use_flash_decode is not None:
@@ -1075,11 +1236,12 @@ class DecodeEngine:
         adapter = self.adapter
         page = cfg.page_size
         use_flash = self._use_flash()
+        quant = self._quant_kv
 
         base_key = jnp.asarray(np.asarray(self._base_key))
 
-        def step(kv_k, kv_v, ctx_bufs, page_table, lengths, last_tokens,
-                 active, seeds, temps, top_ks, top_ps):
+        def step(kv_k, kv_v, kv_sk, kv_sv, ctx_bufs, page_table, lengths,
+                 last_tokens, active, seeds, temps, top_ks, top_ps):
             keys = jax.vmap(jax.random.fold_in)(
                 jnp.broadcast_to(base_key, (seeds.shape[0], 2)), seeds)
             pt = page_table[:, :n_blocks]
@@ -1092,7 +1254,65 @@ class DecodeEngine:
                                 axis=1)[:, 0],
                             cfg.total_pages)
             off = lengths % page
-            if use_flash:
+            if quant:
+                # int8 pages (docs/quantization.md §Serving memory
+                # hierarchy): read-modify-write ONLY the page holding
+                # this step's position — dequantize it, insert the new
+                # row, requantize under a monotone per-page scale (an
+                # unchanged page round-trips exactly; see
+                # ops.quantized.quantize_pages) — then attend over the
+                # dequantized pool.  Both the flash and jnp paths run
+                # through the self_attend hook so the quantize-then-
+                # attend order (and hence the tokens) agree.
+                from bigdl_tpu.ops.flash_attention import \
+                    paged_decode_attention
+                from bigdl_tpu.ops.quantized import quantize_pages
+
+                kv = {"k": kv_k, "v": kv_v, "sk": kv_sk, "sv": kv_sv}
+                B = lengths.shape[0]
+                rows = jnp.arange(B)
+                K = n_blocks * page
+                h, hd = adapter.num_heads, adapter.head_dim
+
+                def rmw(pool, scales, i, new):
+                    floor = scales[i, wid]                      # (B,)
+                    pg = (pool[i, wid].astype(jnp.float32)
+                          * floor[:, None, None, None])      # (B,h,p,hd)
+                    pg = pg.at[rows, :, off].set(new[:, :, 0])
+                    q, s = quantize_pages(pg, floor_scales=floor)
+                    return (pool.at[i, wid].set(q, mode="drop"),
+                            scales.at[i, wid].set(s, mode="drop"))
+
+                def self_attend(i, q, k_new, v_new):
+                    kv["k"], kv["sk"] = rmw(kv["k"], kv["sk"], i, k_new)
+                    kv["v"], kv["sv"] = rmw(kv["v"], kv["sv"], i, v_new)
+                    if use_flash:
+                        out = paged_decode_attention(
+                            q[:, :, 0], kv["k"][i], kv["v"][i], pt,
+                            lengths, k_scales=kv["sk"][i],
+                            v_scales=kv["sv"][i])
+                        return out.astype(jnp.float32)[:, :, None]
+                    # gathered-jnp reference: dequantize this layer's
+                    # pages and attend over the contiguous view — the
+                    # kernel-vs-jnp agreement surface for int8
+                    def deq(pool, scales):
+                        g = (pool[i][pt].astype(jnp.float32)
+                             * scales[i][pt][..., None, None, None])
+                        return g.transpose(0, 2, 1, 3, 4).reshape(
+                            B, h, K, hd)
+
+                    valid = (jnp.arange(K)[None, :]
+                             <= lengths[:, None])[:, None, :]
+                    return adapter._attend(q, deq(kv["k"], kv["sk"]),
+                                           deq(kv["v"], kv["sv"]),
+                                           valid)
+
+                logits, _, _, _, _ = adapter.chunk_forward(
+                    adapter.params, last_tokens[:, None], lengths, None,
+                    None, ctx_bufs, self_attend=self_attend)
+                kv_k, kv_v = kv["k"], kv["v"]
+                kv_sk, kv_sv = kv["sk"], kv["sv"]
+            elif use_flash:
                 # paged flash path: scatter each layer's K/V into the
                 # pages FIRST, then run the single-query Pallas kernel
                 # straight off the page pool — no gathered cache copy
@@ -1126,9 +1346,9 @@ class DecodeEngine:
                     v_new[:, :, :, 0].astype(kv_v.dtype), mode="drop")
             tok, logp = _select_tokens(logits[:, 0], keys, lengths + 1,
                                        temps, top_ks, top_ps)
-            return kv_k, kv_v, tok, logp
+            return kv_k, kv_v, kv_sk, kv_sv, tok, logp
 
-        fn = jax.jit(step, donate_argnums=(0, 1))
+        fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         self._step_fns[n_blocks] = fn
         return fn
 
@@ -1149,19 +1369,24 @@ class DecodeEngine:
         adapter = self.adapter
         page = cfg.page_size
         C = cfg.prompt_chunk
+        quant = self._quant_kv
 
         base_key = jnp.asarray(np.asarray(self._base_key))
 
-        def prefill(kv_k, kv_v, ctx_bufs, slot_idx, pt_rows, tokens,
-                    position, last_index, active, seeds, temps, top_ks,
-                    top_ps):
+        def prefill(kv_k, kv_v, kv_sk, kv_sv, ctx_bufs, slot_idx,
+                    pt_rows, tokens, position, last_index, active, seeds,
+                    temps, top_ks, top_ps):
             keys = jax.vmap(jax.random.fold_in)(
                 jnp.broadcast_to(base_key, (seeds.shape[0], 2)), seeds)
             pt = pt_rows[:, :n_blocks]
-            kbuf = self._gather(kv_k, pt)
-            vbuf = self._gather(kv_v, pt)
+            if quant:
+                kbuf = self._gather_deq(kv_k, kv_sk, pt)
+                vbuf = self._gather_deq(kv_v, kv_sv, pt)
+            else:
+                kbuf = self._gather(kv_k, pt)
+                vbuf = self._gather(kv_v, pt)
             ctx = {k: v[slot_idx] for k, v in ctx_bufs.items()}
-            logits, _, _, k_new, v_new = adapter.chunk_forward(
+            logits, kbuf, vbuf, k_new, v_new = adapter.chunk_forward(
                 adapter.params, tokens, position, kbuf, vbuf, ctx)
             last = jnp.take_along_axis(logits,
                                        last_index[:, None, None],
@@ -1169,6 +1394,38 @@ class DecodeEngine:
             sel_pos = position + last_index + 1
             tok, logp = _select_tokens(last, keys, sel_pos, temps,
                                        top_ks, top_ps)
+            if quant:
+                # whole-page requantize-write-back of ONLY the pages
+                # this chunk touched: rows past a slot's allocated count
+                # may reference pages another slot owns now (the table
+                # is not cleared on release), and the leading rows may
+                # be shared prefix-cache pages — neither may be written.
+                # Untouched positions inside a touched page came from
+                # the dequantized gather, so under the monotone scale
+                # floor they requantize exactly (quantize_pages).
+                from bigdl_tpu.ops.quantized import quantize_pages
+
+                B = tokens.shape[0]
+                L, h, hd = (adapter.num_layers, adapter.num_heads,
+                            adapter.head_dim)
+                pg0 = jnp.arange(n_blocks)[None, :] * page       # (1,nb)
+                lim = jnp.minimum(position + C, cfg.cap)[:, None]
+                mask = (active[:, None] & (pg0 < lim)
+                        & (pg0 + page > position[:, None]))      # (B,nb)
+                pidq = jnp.where(mask, pt, cfg.total_pages)
+                floors_k = kv_sk[:, pt]                        # (L,B,nb)
+                floors_v = kv_sv[:, pt]
+
+                def wb(pool, scales, buf, floors):
+                    pages = buf.reshape(B, L, h, n_blocks, page,
+                                        hd).transpose(1, 0, 3, 2, 4, 5)
+                    q, s = quantize_pages(pages, floor_scales=floors)
+                    return (pool.at[:, pidq].set(q, mode="drop"),
+                            scales.at[:, pidq].set(s, mode="drop"))
+
+                kv_k, kv_sk = wb(kv_k, kv_sk, kbuf, floors_k)
+                kv_v, kv_sv = wb(kv_v, kv_sv, vbuf, floors_v)
+                return kv_k, kv_v, kv_sk, kv_sv, tok, logp
             # scatter each row's chunk into its pages; padding rows and
             # positions past the slot cap (padded final-chunk tails)
             # drop
@@ -1187,9 +1444,9 @@ class DecodeEngine:
             kv_v = kv_v.at[:, pid, :, off].set(
                 v_new.transpose(0, 3, 1, 2, 4).astype(kv_v.dtype),
                 mode="drop")
-            return kv_k, kv_v, tok, logp
+            return kv_k, kv_v, kv_sk, kv_sv, tok, logp
 
-        fn = jax.jit(prefill, donate_argnums=(0, 1))
+        fn = jax.jit(prefill, donate_argnums=(0, 1, 2, 3))
         self._prefill_fns[n_blocks] = fn
         return fn
 
@@ -1211,16 +1468,20 @@ class DecodeEngine:
         import — any prompt length — runs ONE compiled program: the
         closed-compile-set discipline holds across the fleet path."""
         if self._import_fn is None:
-            def write(kv_k, kv_v, pids, k_host, v_host):
+            def write(kv_k, kv_v, kv_sk, kv_sv, pids, k_host, v_host,
+                      sk_host, sv_host):
                 # (L, P, h, page, hd) at [:, pids (PPS,)] takes the
                 # (L, PPS, h, page, hd) view the host image is shaped as
                 kv_k = kv_k.at[:, pids].set(k_host.astype(kv_k.dtype),
                                             mode="drop")
                 kv_v = kv_v.at[:, pids].set(v_host.astype(kv_v.dtype),
                                             mode="drop")
-                return kv_k, kv_v
+                kv_sk = kv_sk.at[:, pids].set(sk_host, mode="drop")
+                kv_sv = kv_sv.at[:, pids].set(sv_host, mode="drop")
+                return kv_k, kv_v, kv_sk, kv_sv
 
-            self._import_fn = jax.jit(write, donate_argnums=(0, 1))
+            self._import_fn = jax.jit(write,
+                                      donate_argnums=(0, 1, 2, 3))
         return self._import_fn
 
     # -- engine loop --------------------------------------------------------
@@ -1416,6 +1677,8 @@ class DecodeEngine:
             self._reserved_pages -= 1
             self._page_table[s, shared + len(seq.pages)] = pid
             seq.pages.append(pid)
+            if self._quant_kv:
+                self._fresh_pages.append(pid)
 
     def _release_slot(self, s: int) -> None:
         seq = self._slots[s]
@@ -1433,7 +1696,8 @@ class DecodeEngine:
             n = min(seq.prefill_pos, len(seq.prompt)) \
                 // self.cfg.page_size
             if n > 0 and cache.insert(
-                    seq.prompt[:n * self.cfg.page_size], pages[:n]):
+                    seq.prompt[:n * self.cfg.page_size], pages[:n],
+                    page_dtype=self.cfg.kv_dtype):
                 self.events.append(("prefix_donate", seq.req.rid, n))
                 pages = pages[n:]   # ownership moved to the cache
         self._free_pages.extend(pages)
@@ -1501,13 +1765,15 @@ class DecodeEngine:
             rows.append((b, s, real, (p0 + real) >= len(seq.prompt)))
             max_need = max(max_need, min(p0 + C, cfg.cap))
         nb = cfg.bucket_pages(max_need)
+        self._flush_fresh_scales()
         t0 = time.time()
-        kv_k, kv_v, tok, logp = self._prefill_fn(nb)(
-            self._kv_k, self._kv_v, self._ctx_bufs, sc["slot_idx"],
-            sc["pt_rows"], sc["tokens"], sc["position"],
-            sc["last_index"], sc["active"], sc["seeds"], sc["temps"],
-            sc["top_ks"], sc["top_ps"])
+        kv_k, kv_v, kv_sk, kv_sv, tok, logp = self._prefill_fn(nb)(
+            self._kv_k, self._kv_v, self._kv_sk, self._kv_sv,
+            self._ctx_bufs, sc["slot_idx"], sc["pt_rows"], sc["tokens"],
+            sc["position"], sc["last_index"], sc["active"], sc["seeds"],
+            sc["temps"], sc["top_ks"], sc["top_ps"])
         self._kv_k, self._kv_v = kv_k, kv_v
+        self._kv_sk, self._kv_sv = kv_sk, kv_sv
         toks = np.asarray(tok)
         logps = np.asarray(logp, np.float32)
         now = time.time()
@@ -1559,13 +1825,15 @@ class DecodeEngine:
             self._ensure_pages(s, int(self._lengths[s]) + 1)
         ref = active if active else occupied
         nb = cfg.bucket_pages(int(self._lengths[ref].max()) + 1)
+        self._flush_fresh_scales()
         t0 = time.time()
-        kv_k, kv_v, toks, logps = self._step_fn(nb)(
-            self._kv_k, self._kv_v, self._ctx_bufs,
-            self._page_table, self._lengths, self._last_tokens,
-            self._active_mask, self._seeds, self._temps,
-            self._top_ks, self._top_ps)
+        kv_k, kv_v, kv_sk, kv_sv, toks, logps = self._step_fn(nb)(
+            self._kv_k, self._kv_v, self._kv_sk, self._kv_sv,
+            self._ctx_bufs, self._page_table, self._lengths,
+            self._last_tokens, self._active_mask, self._seeds,
+            self._temps, self._top_ks, self._top_ps)
         self._kv_k, self._kv_v = kv_k, kv_v
+        self._kv_sk, self._kv_sv = kv_sk, kv_sv
         toks = np.asarray(toks)
         logps = np.asarray(logps, np.float32)
         now = time.time()
@@ -1690,8 +1958,10 @@ class DecodeEngine:
         # compiled gather: the closed-compile-set discipline again
         pids = np.zeros((cfg.pages_per_slot,), np.int32)
         pids[:n] = self._page_table[s, :n]
-        k = np.asarray(self._kv_k[:, pids], np.float32)[:, :n]
-        v = np.asarray(self._kv_v[:, pids], np.float32)[:, :n]
+        # pages travel in their stored dtype (int8 handoffs are ~4x
+        # smaller on the wire); int8 adds the per-(layer, page) scales
+        k = np.asarray(self._kv_k[:, pids])[:, :n]
+        v = np.asarray(self._kv_v[:, pids])[:, :n]
         req.kv_export = {
             "tokens": np.asarray(seq.prompt, np.int32),
             "first_token": int(seq.generated[0]),
@@ -1701,9 +1971,15 @@ class DecodeEngine:
             "top_p": float(req.top_p),
             "seed": int(req.seed),
             "request_id": req.rid,
+            "kv_dtype": cfg.kv_dtype,
             "k": k,
             "v": v,
         }
+        if self._quant_kv:
+            req.kv_export["k_scales"] = np.asarray(
+                self._kv_sk[:, pids], np.float32)[:, :n]
+            req.kv_export["v_scales"] = np.asarray(
+                self._kv_sv[:, pids], np.float32)[:, :n]
         self.stats["kv_exports"] += 1
         self.metrics.inc("serving.fleet.kv_exports")
         self.events.append(("kv_export", req.rid, int(n)))
@@ -1720,17 +1996,27 @@ class DecodeEngine:
         plen = len(seq.prompt)
         n = -(-plen // cfg.page_size)
         self._ensure_pages(s, plen)
+        self._flush_fresh_scales()
         pids = np.full((cfg.pages_per_slot,), cfg.total_pages, np.int32)
         pids[:n] = self._page_table[s, :n]
         a = self.adapter
         shape = (a.num_layers, cfg.pages_per_slot, a.num_heads,
                  cfg.page_size, a.head_dim)
-        k_host = np.zeros(shape, np.float32)
-        v_host = np.zeros(shape, np.float32)
-        k_host[:, :n] = np.asarray(h["k"], np.float32)
-        v_host[:, :n] = np.asarray(h["v"], np.float32)
-        self._kv_k, self._kv_v = self._import_write()(
-            self._kv_k, self._kv_v, pids, k_host, v_host)
+        dt = np.int8 if self._quant_kv else np.float32
+        k_host = np.zeros(shape, dt)
+        v_host = np.zeros(shape, dt)
+        k_host[:, :n] = np.asarray(h["k"], dt)
+        v_host[:, :n] = np.asarray(h["v"], dt)
+        sk_host = np.zeros((a.num_layers, cfg.pages_per_slot),
+                           np.float32)
+        sv_host = np.zeros_like(sk_host)
+        if self._quant_kv:
+            sk_host[:, :n] = np.asarray(h["k_scales"], np.float32)
+            sv_host[:, :n] = np.asarray(h["v_scales"], np.float32)
+        (self._kv_k, self._kv_v, self._kv_sk,
+         self._kv_sv) = self._import_write()(
+            self._kv_k, self._kv_v, self._kv_sk, self._kv_sv, pids,
+            k_host, v_host, sk_host, sv_host)
         seq.prefill_pos = plen
         self._lengths[s] = plen
         self.stats["kv_imports"] += 1
@@ -1778,6 +2064,11 @@ class DecodeEngine:
                            used / cfg.total_pages)
         self.metrics.gauge("serving.decode.queue_depth",
                            self.queue_depth())
+        # constant per engine, but exported so one scrape answers "what
+        # does a page cost here" without reading config: int8 pools
+        # report ~4x smaller pages (+ the per-page scale pair)
+        self.metrics.gauge("serving.decode.kv_bytes_per_page",
+                           float(self.kv_bytes_per_page()))
         if self._prefix_cache is not None:
             st = self._prefix_cache.stats()
             self.metrics.gauge("serving.fleet.prefix_cache_pages",

@@ -4,8 +4,12 @@ A prefill worker runs the whole chunked prefill (selecting the first
 token during the final chunk, exactly as a local request would), then
 ships the finished pages to a decode worker as ``pack_handoff`` bytes:
 a fixed magic + length-prefixed JSON header (tokens, first token and its
-log-prob, sampling params, seed, array shape) followed by the raw
-float32 page images of K then V.
+log-prob, sampling params, seed, array shape, ``kv_dtype``) followed by
+the raw page images of K then V — float32, or int8 followed by the
+per-(layer, page) float32 scale tables (K scales then V scales), ~4x
+fewer wire bytes per page.  A header without ``kv_dtype`` is a blob
+from before the field existed and is read as float32; an unrecognized
+``kv_dtype`` is rejected BY NAME rather than misread as f32.
 
 The format is deliberately *exact*: ``tobytes()``/``frombuffer`` round-
 trips every float32 bit, and the first token's log-prob travels as a
@@ -64,14 +68,34 @@ class HandoffError(ValueError):
 
 def pack_handoff(h: Dict[str, Any]) -> bytes:
     """Serialize a handoff dict (as built by the engine's ``export_kv``
-    path) to transfer bytes.  ``h["k"]``/``h["v"]`` are the float32 page
-    images; every other key must be JSON-serializable."""
-    k = np.ascontiguousarray(np.asarray(h["k"], np.float32))
-    v = np.ascontiguousarray(np.asarray(h["v"], np.float32))
+    path) to transfer bytes.  ``h["k"]``/``h["v"]`` are the page images
+    in the engine's stored page dtype — float32, or int8 with the
+    per-(layer, page) float32 ``k_scales``/``v_scales`` riding behind
+    the V payload (an int8 blob is ~4x smaller on the wire); every
+    other key must be JSON-serializable."""
+    kv_dtype = str(h.get("kv_dtype", "float32"))
+    if kv_dtype not in ("float32", "int8"):
+        raise ValueError(f"unsupported handoff kv_dtype {kv_dtype!r}")
+    dt = np.int8 if kv_dtype == "int8" else np.float32
+    k = np.ascontiguousarray(np.asarray(h["k"], dt))
+    v = np.ascontiguousarray(np.asarray(h["v"], dt))
     if k.shape != v.shape or k.ndim != 5:
         raise ValueError(f"handoff K/V must share a 5-d page-pool shape, "
                          f"got k={k.shape} v={v.shape}")
-    header = {key: val for key, val in h.items() if key not in ("k", "v")}
+    payload = [k.tobytes(), v.tobytes()]
+    if kv_dtype == "int8":
+        ks = np.ascontiguousarray(np.asarray(h.get("k_scales"),
+                                             np.float32))
+        vs = np.ascontiguousarray(np.asarray(h.get("v_scales"),
+                                             np.float32))
+        if ks.shape != k.shape[:2] or vs.shape != k.shape[:2]:
+            raise ValueError(
+                f"int8 handoff needs (layers, pages) scale tables "
+                f"{k.shape[:2]}, got k_scales={ks.shape} "
+                f"v_scales={vs.shape}")
+        payload += [ks.tobytes(), vs.tobytes()]
+    header = {key: val for key, val in h.items()
+              if key not in ("k", "v", "k_scales", "v_scales")}
     for key in _REQUIRED:
         if key not in header:
             raise ValueError(f"handoff missing required field {key!r}")
@@ -79,11 +103,16 @@ def pack_handoff(h: Dict[str, Any]) -> bytes:
     header["first_token"] = int(header["first_token"])
     header["first_logp"] = float(header["first_logp"])
     header["shape"] = list(k.shape)
-    header["dtype"] = "float32"
+    # "dtype" is the pre-kv_dtype name for the same field: writing both
+    # keeps an int8 blob REJECTED (not silently misread as f32) by
+    # decoders from before kv_dtype existed, and f32 blobs bit-identical
+    # to what those decoders always produced
+    header["dtype"] = kv_dtype
+    header["kv_dtype"] = kv_dtype
     header["version"] = 1
     hdr = json.dumps(header, sort_keys=True).encode()
-    return b"".join([HANDOFF_MAGIC, len(hdr).to_bytes(8, "big"), hdr,
-                     k.tobytes(), v.tobytes()])
+    return b"".join([HANDOFF_MAGIC, len(hdr).to_bytes(8, "big"), hdr]
+                    + payload)
 
 
 def unpack_handoff(data: bytes, max_bytes: int = MAX_HANDOFF_BYTES,
@@ -131,25 +160,47 @@ def unpack_handoff(data: bytes, max_bytes: int = MAX_HANDOFF_BYTES,
         raise HandoffError(f"handoff K/V must share a 5-d page-pool "
                            f"shape, got {raw_shape!r}")
     shape = tuple(raw_shape)
-    if header.pop("dtype", None) != "float32":
-        raise HandoffError("handoff dtype must be float32")
+    legacy_dt = header.pop("dtype", None)
+    kv_dtype = header.pop("kv_dtype", legacy_dt or "float32")
+    if kv_dtype not in ("float32", "int8"):
+        # NAME the dtype: a future blob must be rejected loudly (HTTP
+        # 400 at the serving frontend), never misread as f32 pages
+        raise HandoffError(f"unsupported handoff kv_dtype {kv_dtype!r} "
+                           "(this build understands float32 and int8)")
+    if legacy_dt is not None and legacy_dt != kv_dtype:
+        raise HandoffError(f"handoff header dtype {legacy_dt!r} "
+                           f"contradicts kv_dtype {kv_dtype!r}")
     if max_pages is not None and shape[1] > max_pages:
         raise HandoffError(f"handoff declares {shape[1]} pages, over the "
                            f"importer's {max_pages}-page bound")
-    nbytes = int(np.prod(shape, dtype=np.int64)) * 4
-    if 2 * nbytes > max_bytes:
-        raise HandoffError(f"handoff shape {shape} implies {2 * nbytes} "
+    dt = np.int8 if kv_dtype == "int8" else np.float32
+    itemsize = dt().itemsize
+    elems = int(np.prod(shape, dtype=np.int64))
+    nbytes = elems * itemsize
+    n_scales = shape[0] * shape[1]          # one per (layer, page)
+    scale_bytes = 2 * n_scales * 4 if kv_dtype == "int8" else 0
+    total = 2 * nbytes + scale_bytes
+    if total > max_bytes:
+        raise HandoffError(f"handoff shape {shape} implies {total} "
                            f"payload bytes, over the {max_bytes}-byte "
                            "bound")
-    if len(data) != off + 2 * nbytes:
+    if len(data) != off + total:
         raise HandoffError(f"handoff payload truncated: expected "
-                           f"{off + 2 * nbytes} bytes, got {len(data)}")
-    k = np.frombuffer(data, np.float32, count=nbytes // 4,
-                      offset=off).reshape(shape)
-    v = np.frombuffer(data, np.float32, count=nbytes // 4,
+                           f"{off + total} bytes, got {len(data)}")
+    k = np.frombuffer(data, dt, count=elems, offset=off).reshape(shape)
+    v = np.frombuffer(data, dt, count=elems,
                       offset=off + nbytes).reshape(shape)
     out = dict(header)
     out["tokens"] = np.asarray(header["tokens"], np.int32)
+    out["kv_dtype"] = kv_dtype
     out["k"] = k
     out["v"] = v
+    if kv_dtype == "int8":
+        so = off + 2 * nbytes
+        out["k_scales"] = np.frombuffer(
+            data, np.float32, count=n_scales,
+            offset=so).reshape(shape[0], shape[1])
+        out["v_scales"] = np.frombuffer(
+            data, np.float32, count=n_scales,
+            offset=so + n_scales * 4).reshape(shape[0], shape[1])
     return out

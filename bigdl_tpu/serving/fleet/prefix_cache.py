@@ -55,13 +55,19 @@ class _Entry:
 class PrefixCache:
     """Token-prefix -> KV-page cache with refcounted LRU eviction."""
 
-    def __init__(self, max_pages: int, page_size: int):
+    def __init__(self, max_pages: int, page_size: int,
+                 page_dtype: str = "float32"):
         if max_pages <= 0:
             raise ValueError(f"max_pages must be positive, got {max_pages}")
         if page_size <= 0:
             raise ValueError(f"page_size must be positive, got {page_size}")
         self.max_pages = int(max_pages)
         self.page_size = int(page_size)
+        # the storage dtype every cached page shares (docs/quantization.md
+        # §Serving memory hierarchy): a cache holding int8 pages + scales
+        # must never accept or serve f32 page ids, and vice versa —
+        # attaching a mismatched page would dequantize garbage
+        self.page_dtype = str(page_dtype)
         self._entries: Dict[Tuple[int, ...], _Entry] = {}
         self._tick = 0
         self._pages_held = 0
@@ -112,11 +118,18 @@ class PrefixCache:
 
     # -- population / eviction (engine thread) --------------------------
 
-    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> bool:
+    def insert(self, tokens: Sequence[int], pages: Sequence[int],
+               page_dtype: Optional[str] = None) -> bool:
         """Donate ``pages`` covering exactly ``tokens``.  Returns False
         (caller keeps ownership and frees the pages) when the prefix is
         already cached or the ``max_pages`` budget cannot be made by
-        evicting idle entries."""
+        evicting idle entries.  ``page_dtype`` (when given) must match
+        the cache's — mixed-dtype page donation is an engine bug, not a
+        capacity condition, so it raises instead of returning False."""
+        if page_dtype is not None and page_dtype != self.page_dtype:
+            raise ValueError(
+                f"prefix-cache page dtype mismatch: cache holds "
+                f"{self.page_dtype!r} pages, donation is {page_dtype!r}")
         key = tuple(int(t) for t in tokens)
         n = len(pages)
         if n == 0 or len(key) != n * self.page_size:
@@ -168,6 +181,7 @@ class PrefixCache:
             out = dict(self.stats_counters)
             out["entries"] = len(self._entries)
             out["pages"] = self._pages_held
+            out["page_dtype"] = self.page_dtype
             return out
 
     @property

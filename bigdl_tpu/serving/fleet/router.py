@@ -38,6 +38,24 @@ _W_PAGES = 1.0
 _W_BACKLOG = 0.25
 _W_PREFILL_BACKLOG = 0.5
 
+# page-headroom weighting by the worker's reported ``page_dtype``
+# (docs/quantization.md §Serving memory hierarchy): pages of different
+# storage dtypes are NOT interchangeable capacity.  At a fixed HBM
+# budget an int8 pool fits ~4x the pages an f32 pool does, so a worker
+# reporting the same free-page FRACTION holds ~4x the absolute free
+# token capacity — the headroom term counts free pages in
+# f32-page-equivalent units rather than scoring the two as equal.
+# Workers from before page_dtype existed report nothing and keep the
+# f32 weight.
+_DTYPE_PAGE_FACTOR = {"float32": 1.0, "bfloat16": 2.0, "int8": 4.0}
+
+
+def _page_headroom(d: Dict[str, Any]) -> float:
+    total_pages = max(float(d.get("total_pages", 0)), 1.0)
+    frac = float(d.get("free_pages", 0)) / total_pages
+    return frac * _DTYPE_PAGE_FACTOR.get(
+        str(d.get("page_dtype", "float32")), 1.0)
+
 
 class FleetRouter:
     """Pure placement policy: health snapshots in, worker indices out."""
@@ -47,20 +65,16 @@ class FleetRouter:
         d = health.get("decode") or {}
         slo = float(health.get("slo_health", 1.0))
         free_slots = float(d.get("free_slots", 0))
-        total_pages = max(float(d.get("total_pages", 0)), 1.0)
-        pages_frac = float(d.get("free_pages", 0)) / total_pages
         backlog = (float(d.get("queued", 0))
                    + float(d.get("generate_inflight", 0)))
-        return slo * (free_slots + _W_PAGES * pages_frac) \
+        return slo * (free_slots + _W_PAGES * _page_headroom(d)) \
             - _W_BACKLOG * backlog
 
     @staticmethod
     def prefill_score(health: Dict[str, Any]) -> float:
         d = health.get("decode") or {}
         slo = float(health.get("slo_health", 1.0))
-        total_pages = max(float(d.get("total_pages", 0)), 1.0)
-        pages_frac = float(d.get("free_pages", 0)) / total_pages
-        return slo * (1.0 + pages_frac) \
+        return slo * (1.0 + _page_headroom(d)) \
             - _W_PREFILL_BACKLOG * float(d.get("prefill_backlog", 0))
 
     def route(self, healths: Sequence[Dict[str, Any]]

@@ -421,6 +421,19 @@ class _Handler(BaseHTTPRequestHandler):
                 max_pages=getattr(cfg, "pages_per_slot", None))
         except HandoffError as e:
             return self._json(400, {"error": str(e)})
+        eng_dt = str(getattr(cfg, "kv_dtype", "float32"))
+        hd_dt = str(h.get("kv_dtype", "float32"))
+        if hd_dt != eng_dt:
+            # mixed-dtype pages must never park here (an f32 engine has
+            # no scale tables; an int8 engine would quantize-import an
+            # f32 image and break handoff parity) — refusing the WHOLE
+            # blob makes the drain report the failure and the re-placed
+            # stream recover via re-prefill failover instead
+            # (docs/quantization.md §Serving memory hierarchy)
+            return self._json(400, {
+                "error": f"handoff kv_dtype {hd_dt!r} does not match "
+                         f"this worker's kv_dtype {eng_dt!r}; refusing "
+                         "the page import (re-prefill instead)"})
         rid = srv.park_handoff(h)
         self._json(200, {"parked": rid})
 
@@ -596,6 +609,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(b"0\r\n\r\n")
                 return
             parked = srv.take_parked(str(req_id)) if req_id else None
+            if parked is not None and str(parked.get(
+                    "kv_dtype", "float32")) != str(getattr(
+                        srv.decode_config(), "kv_dtype", "float32")):
+                # a directly-parked handoff in the wrong page dtype
+                # (the import gate normally refuses these): byte parity
+                # is safer served by re-prefill than a mixed-dtype
+                # adoption the engine would reject at submit
+                parked = None
             if parked is not None and _adoptable(parked, tokens,
                                                  resume, kw):
                 # live migration adoption: the peer shipped the slot's

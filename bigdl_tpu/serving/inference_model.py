@@ -44,7 +44,8 @@ class InferenceModel:
     def __init__(self, model=None, variables: Optional[Dict] = None,
                  predict_fn: Optional[Callable] = None,
                  batch_buckets: Sequence[int] = (1, 4, 16, 64, 256),
-                 decode=None, layout=None):
+                 decode=None, layout=None,
+                 weight_quant: Optional[str] = None):
         """``layout``: serve MODEL-SHARDED (docs/parallelism.md
         §Declarative layouts) — a ``parallelism=`` combo string
         (``"tp:8"``, ``"fsdp:2,tp:4"``) or an already-resolved
@@ -56,7 +57,18 @@ class InferenceModel:
         is unchanged — a mixed-size sweep still runs zero unexpected
         recompiles.  The layout is audited at load: silently replicated
         params export ``parallel.layout.replicated_params`` + a flight
-        line."""
+        line.
+
+        ``weight_quant="int8"``: serve int8 weights (docs/quantization.md
+        §Serving memory hierarchy).  Layered models (Container / keras
+        Model) get the module-swap quantization — Linear/Conv2D leaves
+        become int8 twins running the autotuned int8 MXU matmul.  Raw-
+        matrix models (Transformer) get weight-only int8 param storage
+        dequantized inside the jitted forward, so HBM at rest drops 4x
+        and one chip holds a proportionally bigger checkpoint; the
+        decode engine's programs inherit the same stored-int8 params.
+        Quantization happens AFTER layout placement, so the int8
+        tensors keep the layout's shardings."""
         self.layout = None
         if layout is not None:
             from bigdl_tpu.parallel.mesh_policy import (ResolvedLayout,
@@ -64,25 +76,55 @@ class InferenceModel:
 
             self.layout = (layout if isinstance(layout, ResolvedLayout)
                            else mesh_and_layout(str(layout)))
+        if weight_quant not in (None, "int8"):
+            raise ValueError(f"weight_quant {weight_quant!r}: "
+                             "None | 'int8'")
+        self.weight_quant = weight_quant
         if predict_fn is None:
             if model is None or variables is None:
                 raise ValueError("need (model, variables) or predict_fn")
 
-            def raw(params, state, x):
-                out, _ = model.forward(params, state, x, training=False)
-                return out
-
-            self._jit = jax.jit(raw)
             self._params = variables.get("params", {})
             self._state = variables.get("state", {})
             if self.layout is not None:
                 self._params = self.layout.shard_params(model,
                                                         self._params)
+            deq = None
+            if weight_quant == "int8":
+                from bigdl_tpu.nn import quantized as nq
+                from bigdl_tpu.nn.module import Container
+
+                if isinstance(model, Container) or nq._is_keras_model(
+                        model):
+                    # module swap: Linear/Conv2D leaves become int8
+                    # twins on the autotuned int8 MXU matmul path
+                    model, v = nq.quantize(
+                        model, {"params": self._params,
+                                "state": self._state})
+                    self._params = v.get("params", {})
+                    self._state = v.get("state", {})
+                else:
+                    # raw-matrix models (Transformer): weight-only int8
+                    # storage, dequantized inside the jitted forward
+                    self._params = nq.quantize_params(self._params)
+                    deq = nq.dequantize_params
+
+            def raw(params, state, x):
+                if deq is not None:
+                    params = deq(params)
+                out, _ = model.forward(params, state, x, training=False)
+                return out
+
+            self._jit = jax.jit(raw)
             self._custom = None
         else:
             if self.layout is not None:
                 raise ValueError("layout= applies to (model, variables) "
                                  "serving, not a custom predict_fn")
+            if weight_quant is not None:
+                raise ValueError("weight_quant= applies to (model, "
+                                 "variables) serving, not a custom "
+                                 "predict_fn")
             self._custom = predict_fn
         self.buckets = tuple(sorted(batch_buckets))
         # autoregressive decode path (docs/serving.md §Autoregressive
@@ -99,7 +141,11 @@ class InferenceModel:
                     "decode= needs an LM-mode Transformer (model, "
                     "variables); for translation models use "
                     "Seq2SeqService(continuous=True)")
-            adapter = LMAdapter(model, self._params, cap=decode.cap)
+            # the adapter receives the already-quantized tree under
+            # weight_quant="int8" (quantize_params is idempotent) — the
+            # engine's traced programs dequantize at each weight read
+            adapter = LMAdapter(model, self._params, cap=decode.cap,
+                                weight_quant=self.weight_quant)
             self.decode_engine = DecodeEngine(adapter, decode)
         # no lock: the jitted forward is pure and JAX dispatch is
         # thread-safe, so concurrent predicts are safe by construction

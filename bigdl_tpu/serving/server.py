@@ -402,7 +402,17 @@ class ServingServer:
             if pressure is None:
                 continue
             for k, v in pressure().items():
-                if isinstance(v, (int, float)):
+                if k == "kv_bytes_per_page":
+                    # a per-page PROPERTY, not a capacity count: summing
+                    # across tenants would inflate it.  Report the max —
+                    # the conservative per-page cost for the router
+                    agg[k] = max(agg.get(k, 0), v)
+                elif k == "page_dtype":
+                    # tenants should agree; if they don't, say so rather
+                    # than letting the first tenant's dtype win and the
+                    # router misprice the rest
+                    agg[k] = v if agg.get(k, v) == v else "mixed"
+                elif isinstance(v, (int, float)):
                     agg[k] = agg.get(k, 0) + v
                 elif k not in agg:   # e.g. the prefix_cache stats dict
                     agg[k] = v

@@ -25,6 +25,7 @@ from bigdl_tpu.serving.decode_engine import (DecodeConfig, DecodeEngine,
                                              DecodeRequest, LMAdapter)
 from bigdl_tpu.serving.fleet import (FleetRouter, PrefixCache,
                                      pack_handoff, unpack_handoff)
+from bigdl_tpu.serving.fleet.handoff import HANDOFF_MAGIC, HandoffError
 
 BOS, EOS = 0, 1
 
@@ -227,6 +228,65 @@ def test_handoff_rejects_bad_payloads():
     bad = dict(h, v=h["v"][:1])
     with pytest.raises(ValueError, match="5-d page-pool shape"):
         pack_handoff(bad)
+
+
+def test_handoff_kv_dtype_roundtrip():
+    """The kv_dtype header rides the wire for BOTH dtypes: f32 blobs
+    stay bit-identical to the pre-kv_dtype format, int8 blobs carry the
+    per-(layer, page) scale tables behind the V payload and cut wire
+    bytes ~4x (docs/quantization.md §Serving memory hierarchy)."""
+    rs = np.random.RandomState(4)
+    f32 = unpack_handoff(pack_handoff(_fake_handoff()))
+    assert f32["kv_dtype"] == "float32"
+    assert f32["k"].dtype == np.float32
+    assert "k_scales" not in f32
+    h8 = dict(_fake_handoff(), kv_dtype="int8",
+              k=rs.randint(-127, 128, (2, 2, 2, 4, 3)).astype(np.int8),
+              v=rs.randint(-127, 128, (2, 2, 2, 4, 3)).astype(np.int8),
+              k_scales=rs.rand(2, 2).astype(np.float32),
+              v_scales=rs.rand(2, 2).astype(np.float32))
+    blob = pack_handoff(h8)
+    f32_blob = pack_handoff(_fake_handoff())
+    assert len(blob) < len(f32_blob) / 2   # int8 pages shrink the wire
+    out = unpack_handoff(blob)
+    assert out["kv_dtype"] == "int8" and out["k"].dtype == np.int8
+    assert out["k"].tobytes() == h8["k"].tobytes()
+    assert out["v"].tobytes() == h8["v"].tobytes()
+    np.testing.assert_array_equal(out["k_scales"], h8["k_scales"])
+    np.testing.assert_array_equal(out["v_scales"], h8["v_scales"])
+    # int8 without the scale tables is unserializable, not silently f32
+    with pytest.raises(ValueError, match="scale tables"):
+        pack_handoff(dict(h8, k_scales=None))
+
+
+def test_handoff_unknown_kv_dtype_rejected_by_name():
+    """A future dtype must be rejected NAMING the dtype — never misread
+    as f32 pages — and a legacy 'dtype' field that contradicts
+    'kv_dtype' is a corrupt header."""
+    import json as _json
+
+    h = _fake_handoff()
+    with pytest.raises(ValueError, match="fp4"):
+        pack_handoff(dict(h, kv_dtype="fp4"))
+    # forge the header of a valid blob to claim an unknown dtype
+    data = pack_handoff(h)
+    off = len(HANDOFF_MAGIC)
+    hlen = int.from_bytes(data[off:off + 8], "big")
+    hdr = _json.loads(data[off + 8:off + 8 + hlen].decode())
+
+    def _reforge(hdr):
+        enc = _json.dumps(hdr, sort_keys=True).encode()
+        return (HANDOFF_MAGIC + len(enc).to_bytes(8, "big") + enc
+                + data[off + 8 + hlen:])
+
+    forged = _reforge(dict(hdr, kv_dtype="fp4", dtype="fp4"))
+    with pytest.raises(HandoffError, match="fp4"):
+        unpack_handoff(forged)
+    # legacy decoders keyed on "dtype": a blob where the two fields
+    # disagree must not be trusted either way
+    forged = _reforge(dict(hdr, kv_dtype="int8", dtype="float32"))
+    with pytest.raises(HandoffError, match="contradicts"):
+        unpack_handoff(forged)
 
 
 # ---------------------------------------------------------------------------

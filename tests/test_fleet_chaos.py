@@ -594,6 +594,91 @@ def test_drain_corrupt_handoff_degrades_to_reprefill(lm, drain_pair):
     assert eng_b.stats["kv_imports"] == imports_before
 
 
+def test_drain_int8_to_f32_degrades_to_reprefill(lm):
+    """Mixed-dtype drain (docs/quantization.md §Serving memory
+    hierarchy): an int8 victim draining to an f32 peer must NOT ship
+    pages the peer can't read — the peer refuses the import naming both
+    dtypes, drain reports the failure, and the re-placed stream still
+    completes byte-identically via re-prefill failover (int8 greedy
+    token parity makes the joined stream exact)."""
+    import http.client
+
+    from bigdl_tpu.serving.http_frontend import HttpClient
+
+    srv_a, fe_a = _serving_pair(lm, kv_dtype="int8")
+    srv_b, fe_b = _serving_pair(lm)
+    _slow_engine(srv_a.model.decode_engine)
+    try:
+        eng_b = srv_b.model.decode_engine
+        imports_before = eng_b.stats["kv_imports"]
+        p = _prompt()
+        ref = _ref_tokens(eng_b, p, 10)
+        assert len(ref) == 10
+        conn = http.client.HTTPConnection(fe_a.host, fe_a.port,
+                                          timeout=30)
+        conn.request("POST", "/generate", body=json.dumps(dict(
+            tokens=[int(t) for t in p], stream=True, max_new_tokens=10,
+            request_id="dt-1")).encode(),
+            headers={"Content-Type": "application/json",
+                     "Connection": "close"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = [json.loads(resp.readline()) for _ in range(2)]
+        out = srv_a.drain_decode([fe_b.url], evict=False)
+        # the peer refused the int8 pages whole: failed, nothing parked
+        assert out["migrated"] == {} and out["failed"] == ["dt-1"]
+        assert srv_b.take_parked("dt-1") is None
+        srv_a.evict_migrated(out["frozen"] or ["dt-1"])
+        rest, final, severed = _read_stream_until_severed(resp)
+        conn.close()
+        assert severed and final is None
+        delivered = [int(ev["token"]) for ev in first] + rest
+        got = HttpClient(fe_b.url).generate(
+            p, max_new_tokens=10, resume_from=delivered,
+            request_id="dt-1")
+        assert [int(t) for t in got] == ref
+        # recovered by re-prefill on the f32 peer, never an adoption
+        assert eng_b.stats["kv_imports"] == imports_before
+    finally:
+        fe_a.stop()
+        fe_b.stop()
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_mixed_dtype_parked_handoff_not_adopted(lm):
+    """Defense in depth behind the import gate: a parked handoff whose
+    page dtype contradicts the engine's is skipped at adoption time —
+    the resume re-prefills instead of submitting pages the engine would
+    reject."""
+    from bigdl_tpu.serving.http_frontend import HttpClient
+
+    eng_a = _engine(lm, kv_dtype="int8", prefix_cache_pages=0)
+    srv_b, fe_b = _serving_pair(lm, prefix_cache_pages=0)
+    try:
+        eng_b = srv_b.model.decode_engine
+        imports_before = eng_b.stats["kv_imports"]
+        p = _prompt()
+        ref = _ref_tokens(eng_b, p, 8)
+        pre = eng_a.submit(DecodeRequest(
+            tokens=np.concatenate([p, np.asarray(ref[:3], np.int32)]),
+            max_new_tokens=1, export_kv=True))
+        pre.wait(30)
+        h = dict(pre.kv_export, request_id="dtp-1")
+        assert h["kv_dtype"] == "int8"
+        # park directly (bypassing the /fleet/import dtype gate)
+        srv_b.park_handoff(h)
+        got = HttpClient(fe_b.url).generate(
+            p, max_new_tokens=8, resume_from=ref[:4],
+            request_id="dtp-1")
+        assert [int(t) for t in got] == ref
+        assert eng_b.stats["kv_imports"] == imports_before
+    finally:
+        fe_b.stop()
+        srv_b.stop()
+        eng_a.stop()
+
+
 def test_client_disconnect_frees_slot_mid_stream(lm, drain_pair):
     """A client hanging up mid-stream must free the slot + pages NOW
     (counted as a client_disconnect cancel), not decode to

@@ -276,6 +276,81 @@ def test_ledger_reports_actual_wire_dtype():
         int8.grad_sync_ici_bytes_per_step >= 3.0
 
 
+def test_loss_parity_param_comm_int8():
+    """ACCEPTANCE (ISSUE 19): the int8 delta param gather
+    (``param_comm="int8"``) lands within the same loss-parity tolerance
+    as the fp32 gather, alone and composed with the quantized gradient
+    wire."""
+    mesh = _mesh(2)
+    x, y = _data()
+    rng = jax.random.PRNGKey(1)
+    fp32 = _step(mesh, x)
+    q = _step(mesh, x, param_comm="int8", quant_block=64)
+    lf = [float(fp32.train_step(i, rng, x, y)) for i in range(30)]
+    lq = [float(q.train_step(i, rng, x, y)) for i in range(30)]
+    assert lf[-1] < 0.5 * lf[0], "fp32 baseline failed to converge"
+    assert lq[-1] < 0.5 * lq[0], "param_comm=int8 failed to converge"
+    assert abs(lq[-1] - lf[-1]) <= max(0.05 * abs(lf[-1]), 0.02)
+    # the fully-quantized cycle (int8 gradients AND int8 param deltas)
+    full = _step(mesh, x, grad_comm="int8", param_comm="int8",
+                 quant_block=64)
+    lfull = [float(full.train_step(i, rng, x, y)) for i in range(30)]
+    assert lfull[-1] < 0.5 * lfull[0], "fully-quantized cycle diverged"
+    assert abs(lfull[-1] - lf[-1]) <= max(0.05 * abs(lf[-1]), 0.03)
+
+
+def test_param_comm_ledger_and_validation():
+    """param_comm="int8" prices the param gather in its actual wire
+    dtype (payload + scales), fp32 stays the classic n_pad * 4, the
+    pure layout math mirrors the engine, bad modes are rejected."""
+    from bigdl_tpu.obs.cost import collective_ledger
+
+    mesh = _mesh(2)
+    x, _ = _data(d=8)
+    fp32 = _step(mesh, x, hidden=256)
+    q = _step(mesh, x, hidden=256, param_comm="int8", quant_block=64)
+    n_pad, shard = fp32.n_pad, fp32.shard_size
+    assert fp32.param_sync_ici_bytes_per_step == n_pad * 4
+    wq = -(-shard // 64) * 64
+    assert q.param_sync_ici_bytes_per_step == 2 * wq + 2 * (wq // 64) * 4
+    assert q.param_sync_ici_bytes_per_step < \
+        fp32.param_sync_ici_bytes_per_step / 3
+    led = collective_ledger(q)
+    assert led["param_comm"] == "int8"
+    assert led["param_ici_bytes_per_step"] == \
+        q.param_sync_ici_bytes_per_step
+    assert led["ici_bytes_per_step"] == \
+        led["grad_ici_bytes_per_step"] + led["param_ici_bytes_per_step"]
+    ll = collectives.layout_ledger(fp32.n_real, 2, param_comm="int8",
+                                   block=64)
+    assert ll["param_comm"] == "int8"
+    assert ll["param_sync_ici_bytes_per_step"] == \
+        q.param_sync_ici_bytes_per_step
+    # estimator: fp32 payload, int8 payload + scales + block padding
+    assert collectives.ag_wire_bytes(100, 4, "fp32") == 1600
+    assert collectives.ag_wire_bytes(100, 4, "int8", block=64) == \
+        4 * 128 + 4 * 2 * 4
+    assert collectives.ag_wire_bytes(100, 1, "int8") == 0
+    with pytest.raises(ValueError, match="param_comm"):
+        _step(mesh, x, param_comm="bf16")
+    assert _step(mesh, x, param_comm=" INT8 ").param_comm == "int8"
+
+
+def test_param_comm_overlap_probe():
+    """The comm-only probe mirrors the int8 delta gather's wire shape,
+    so the overlap audit times the same collectives the step runs."""
+    mesh = _mesh(2)
+    x, y = _data()
+    s = _step(mesh, x, grad_comm="int8", param_comm="int8",
+              quant_block=32)
+    xd, yd = s.shard_batch(x), s.shard_batch(y)
+    ov = s.measure_overlap(xd, yd, steps=2)
+    assert ov["collective_s"] > 0
+    assert 0.0 <= ov["overlap_efficiency"] <= 1.0
+    assert np.isfinite(float(s.train_step(0, jax.random.PRNGKey(0),
+                                          x, y)))
+
+
 def test_invalid_grad_comm_rejected():
     mesh = _mesh(2)
     x, _ = _data()

@@ -1,0 +1,366 @@
+"""Quantized serving memory hierarchy (docs/quantization.md §Serving
+memory hierarchy): per-page int8 KV quantization bounds + the MONOTONE
+scale floor that makes whole-row write-back exact, fresh-page zero
+scales (no stale-scale aliasing across slot reuse), the paged flash
+kernel's in-register dequantization vs the gathered-jnp reference, the
+int8-vs-f32 token-parity budget the tier-1 gate rides on, the
+zero-recompile sweep for the int8 program set, ``weight_quant="int8"``
+serving weights, and the /health page-dtype + bytes-per-page
+accounting the fleet router scores capacity by.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import Transformer
+from bigdl_tpu.ops.flash_attention import paged_decode_attention
+from bigdl_tpu.ops.quantized import dequantize_pages, quantize_pages
+from bigdl_tpu.serving.decode_engine import (DecodeConfig, DecodeEngine,
+                                             LMAdapter)
+
+BOS, EOS = 0, 1
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(vocab_size=32, hidden_size=16, num_heads=2,
+                        num_layers=2, dropout=0.0, mode="lm")
+    v = model.init(jax.random.PRNGKey(0),
+                   np.arange(6, dtype=np.int32)[None])
+    return model, v["params"]
+
+
+def _engine(lm, **over):
+    model, params = lm
+    kw = dict(slots=4, page_size=4, pages_per_slot=8, prompt_chunk=4,
+              max_new_tokens=16, eos_id=EOS, prefill_batch=2)
+    kw.update(over)
+    weight_quant = kw.pop("weight_quant", None)
+    cfg = DecodeConfig(**kw)
+    return DecodeEngine(LMAdapter(model, params, cap=cfg.cap,
+                                  weight_quant=weight_quant), cfg)
+
+
+def _prompts(n=6, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(2, 32, (int(rs.randint(2, 11)),)).tolist()
+            for i in range(n)]
+
+
+@pytest.fixture(scope="module")
+def eng_pair(lm):
+    """One warmed f32/int8 engine pair shared by every parity spec —
+    warmup dominates 1-core wall time, and the parity contract is
+    about STEADY-STATE decode, so sharing (and dirtying) the pool
+    across specs is the realistic regime, not a shortcut."""
+    e32 = _engine(lm, kv_dtype="float32")
+    e8 = _engine(lm, kv_dtype="int8")
+    e32.warmup()
+    e8.warmup()
+    yield e32, e8
+    e32.stop()
+    e8.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-page quantization math (ops.quantized.quantize_pages)
+# ---------------------------------------------------------------------------
+
+def test_quantize_pages_roundtrip_bound():
+    """Dequantized error is bounded by half an int8 step of each page's
+    OWN abs-max scale — the bound the token-parity budget rests on."""
+    rs = np.random.RandomState(0)
+    pages = jnp.asarray(rs.randn(6, 2, 4, 8).astype(np.float32) * 3.0)
+    q, scales = quantize_pages(pages)
+    assert q.dtype == jnp.int8 and q.shape == pages.shape
+    assert scales.shape == (6,)
+    back = dequantize_pages(q, scales)
+    err = np.max(np.abs(np.asarray(back - pages)), axis=(1, 2, 3))
+    amax = np.max(np.abs(np.asarray(pages)), axis=(1, 2, 3))
+    assert np.all(err <= amax / 127.0 * 0.5 + 1e-6), (err, amax / 127.0)
+
+
+def test_monotone_floor_requantizes_exactly():
+    """Under a monotone floor, re-quantizing a page whose contents came
+    FROM that quantization grid is exact: round(q*s / s) == q.  This is
+    what makes the engine's dequantize -> insert-token -> requantize
+    whole-row write-back safe for the untouched positions."""
+    rs = np.random.RandomState(1)
+    pages = jnp.asarray(rs.randn(5, 2, 4, 8).astype(np.float32))
+    q1, s1 = quantize_pages(pages, floor_scales=jnp.zeros(5))
+    deq = dequantize_pages(q1, s1)
+    # the page grew (amax can only grow the floor, never shrink it)
+    q2, s2 = quantize_pages(deq, floor_scales=s1)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=0,
+                               atol=0)
+
+
+def test_fresh_page_zero_scale_masks_stale_payload():
+    """A freshly allocated page carries scale 0.0: whatever int8 garbage
+    its previous owner left behind dequantizes to exact zeros, so slot
+    reuse can never alias a dead sequence's KV into a live one."""
+    stale = jnp.asarray(
+        np.random.RandomState(2).randint(-127, 128, (3, 2, 4, 8)),
+        jnp.int8)
+    out = dequantize_pages(stale, jnp.zeros(3))
+    assert np.all(np.asarray(out) == 0.0)
+    # and quantizing genuinely-zero content under a 0.0 floor keeps the
+    # scale at 0.0 (no epsilon creep that would resurrect the payload)
+    q, s = quantize_pages(jnp.zeros((3, 2, 4, 8)),
+                          floor_scales=jnp.zeros(3))
+    assert np.all(np.asarray(s) == 0.0)
+
+
+def test_paged_kernel_scale_validation():
+    q = jnp.zeros((2, 2, 8), jnp.float32)
+    kq = jnp.zeros((4, 2, 4, 8), jnp.int8)
+    kf = jnp.zeros((4, 2, 4, 8), jnp.float32)
+    pt = jnp.zeros((2, 2), jnp.int32)
+    ln = jnp.zeros((2,), jnp.int32)
+    sc = jnp.ones((4,), jnp.float32)
+    with pytest.raises(ValueError, match="k_scales"):
+        paged_decode_attention(q, kq, kq, pt, ln, interpret=True)
+    with pytest.raises(ValueError, match="int8"):
+        paged_decode_attention(q, kf, kf, pt, ln, k_scales=sc,
+                               v_scales=sc, interpret=True)
+
+
+def test_paged_kernel_int8_matches_f32_on_dequantized_pages():
+    """The kernel's in-register dequantization must agree with handing
+    it pre-dequantized f32 pages — same math, different memory format."""
+    rs = np.random.RandomState(3)
+    S, h, p, d, P, nb = 4, 2, 4, 8, 16, 4
+    q = jnp.asarray(rs.randn(S, h, d).astype(np.float32))
+    k32 = jnp.asarray(rs.randn(P, h, p, d).astype(np.float32))
+    v32 = jnp.asarray(rs.randn(P, h, p, d).astype(np.float32))
+    kq, ks = quantize_pages(k32)
+    vq, vs = quantize_pages(v32)
+    pt = jnp.asarray(rs.permutation(P)[:S * nb].reshape(S, nb),
+                     jnp.int32)
+    ln = jnp.asarray(rs.randint(0, p * nb, (S,)), jnp.int32)
+    ref = paged_decode_attention(q, dequantize_pages(kq, ks),
+                                 dequantize_pages(vq, vs), pt, ln,
+                                 block_h=1, interpret=True)
+    out = paged_decode_attention(q, kq, vq, pt, ln, k_scales=ks,
+                                 v_scales=vs, block_h=1, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine-level token parity: the tier-1 acceptance budget
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_token_parity_budget(eng_pair):
+    """Greedy decode through int8 KV pages must produce the SAME tokens
+    as f32, with the summed log-prob drifting within the quantization
+    budget — this is the acceptance bar for the whole memory hierarchy."""
+    prompts = _prompts(6)
+    e32, e8 = eng_pair
+    ref = e32.generate(prompts, max_new_tokens=12)
+    out = e8.generate(prompts, max_new_tokens=12)
+    for r, o in zip(ref, out):
+        assert r.tokens.tolist() == o.tokens.tolist(), (
+            "int8 KV pages changed the greedy token stream")
+        assert abs(r.logp - o.logp) < 0.15, (
+            f"logp drift {abs(r.logp - o.logp):.4f} blows the int8 "
+            "quantization budget")
+
+
+def test_int8_kv_seeded_sample_parity(eng_pair):
+    """Seeded sampling rides the same budget: sampling keys are
+    counter-based on absolute position, so with the logit drift inside
+    the int8 budget the sampled stream matches f32 token for token."""
+    prompts = _prompts(6, seed=11)
+    kw = dict(temperature=0.8, top_k=8, top_p=0.9, seed=13)
+    e32, e8 = eng_pair
+    ref = e32.generate(prompts, max_new_tokens=12, **kw)
+    out = e8.generate(prompts, max_new_tokens=12, **kw)
+    for r, o in zip(ref, out):
+        assert r.tokens.tolist() == o.tokens.tolist(), (
+            "int8 KV pages changed the seeded sample stream")
+        assert abs(r.logp - o.logp) < 0.15
+
+
+@pytest.mark.slow
+def test_full_hierarchy_token_parity(lm, eng_pair):
+    """int8 KV pages + int8 serving weights together: greedy tokens
+    still match f32, with a (larger) bounded logp drift."""
+    prompts = _prompts(5, seed=4)
+    e32, _ = eng_pair
+    e8 = _engine(lm, kv_dtype="int8", weight_quant="int8")
+    try:
+        e8.warmup()
+        ref = e32.generate(prompts, max_new_tokens=10)
+        out = e8.generate(prompts, max_new_tokens=10)
+    finally:
+        e8.stop()
+    agree = sum(r.tokens.tolist() == o.tokens.tolist()
+                for r, o in zip(ref, out))
+    assert agree == len(ref), (
+        f"only {agree}/{len(ref)} greedy streams survived int8 weights "
+        "+ int8 KV")
+    drift = max(abs(r.logp - o.logp) for r, o in zip(ref, out))
+    assert drift < 1.0, f"logp drift {drift:.3f} out of budget"
+
+
+@pytest.mark.slow
+def test_int8_slot_reuse_no_stale_scale_aliasing(lm):
+    """Run two back-to-back waves through the SAME int8 engine (the
+    second wave reuses freed slots and pages) and compare the second
+    wave against a fresh engine: stale per-page scales from wave one
+    must not leak into wave two's dequantization."""
+    wave1, wave2 = _prompts(6, seed=5), _prompts(6, seed=6)
+    reused = _engine(lm, kv_dtype="int8", slots=3)
+    fresh = _engine(lm, kv_dtype="int8", slots=3)
+    try:
+        reused.warmup()
+        fresh.warmup()
+        reused.generate(wave1, max_new_tokens=12)   # dirty the pool
+        out = reused.generate(wave2, max_new_tokens=12)
+        ref = fresh.generate(wave2, max_new_tokens=12)
+    finally:
+        reused.stop()
+        fresh.stop()
+    for r, o in zip(ref, out):
+        assert r.tokens.tolist() == o.tokens.tolist(), (
+            "slot reuse changed int8 decode output: stale scale or "
+            "stale payload aliasing")
+
+
+@pytest.mark.slow
+def test_int8_kernel_vs_gathered_jnp_tokens(lm):
+    """The Pallas paged-decode kernel (interpret mode on CPU) and the
+    gathered-jnp fallback must emit identical greedy tokens from the
+    same int8 page pool."""
+    prompts = _prompts(5, seed=7)
+    ek = _engine(lm, kv_dtype="int8", use_flash_decode=True)
+    ej = _engine(lm, kv_dtype="int8", use_flash_decode=False)
+    try:
+        ek.warmup()
+        ej.warmup()
+        a = ek.generate(prompts, max_new_tokens=10)
+        b = ej.generate(prompts, max_new_tokens=10)
+    finally:
+        ek.stop()
+        ej.stop()
+    for x, y in zip(a, b):
+        assert x.tokens.tolist() == y.tokens.tolist(), (
+            "kernel and jnp int8 decode paths disagree")
+
+
+def test_int8_mixed_sweep_zero_unexpected_recompiles(eng_pair):
+    """The int8 program set stays closed: a mixed prompt/generation
+    sweep after warmup triggers zero unexpected XLA recompiles."""
+    from bigdl_tpu.obs.attr import recompile_sentinel
+    from bigdl_tpu.optim.metrics import global_metrics
+
+    sent = recompile_sentinel()
+    _, eng = eng_pair
+    m = global_metrics()
+    try:
+        before = m.counter("train.unexpected_recompiles_total")
+        sent.mark_steady()
+        rs = np.random.RandomState(8)
+        prompts = [rs.randint(2, 32, (int(rs.randint(1, 12)),)).tolist()
+                   for _ in range(16)]
+        eng.generate(prompts, max_new_tokens=int(rs.randint(4, 13)))
+        after = m.counter("train.unexpected_recompiles_total")
+        assert after - before == 0, (
+            f"{after - before} unexpected XLA recompiles in the int8 "
+            "mixed-length sweep")
+    finally:
+        sent.mark_warmup()
+
+
+# ---------------------------------------------------------------------------
+# int8 serving weights (nn.quantized.quantize_params / weight_quant)
+# ---------------------------------------------------------------------------
+
+def test_quantize_params_roundtrip_and_min_dim(lm):
+    from bigdl_tpu.nn import quantized as nq
+
+    _, params = lm
+    qp = nq.quantize_params(params)
+    assert nq.is_quantized_params(qp)
+    assert not nq.is_quantized_params(params)
+    # idempotent: re-quantizing an already-quantized tree is a no-op
+    qp2 = nq.quantize_params(qp)
+    assert jax.tree_util.tree_structure(qp) == \
+        jax.tree_util.tree_structure(qp2)
+    deq = nq.dequantize_params(qp)
+    assert jax.tree_util.tree_structure(deq) == \
+        jax.tree_util.tree_structure(params)
+    # bounded relative error on every quantized matrix
+    flat_p = jax.tree_util.tree_leaves_with_path(params)
+    flat_d = dict(jax.tree_util.tree_leaves_with_path(deq))
+    n_quant = 0
+    for path, leaf in flat_p:
+        back = flat_d[path]
+        if leaf.ndim == 2 and min(leaf.shape) >= 16:
+            n_quant += 1
+            scale = np.max(np.abs(np.asarray(leaf)), axis=0)
+            err = np.max(np.abs(np.asarray(back - leaf)), axis=0)
+            assert np.all(err <= scale / 127.0 * 0.5 + 1e-6)
+        else:
+            np.testing.assert_array_equal(np.asarray(back),
+                                          np.asarray(leaf))
+    assert n_quant > 0, "fixture model produced no quantizable matrices"
+
+
+def test_weight_quant_inference_model(lm):
+    from bigdl_tpu.serving.inference_model import InferenceModel
+
+    model, params = lm
+    variables = {"params": params}
+    x = np.arange(6, dtype=np.int32)[None]
+    ref = np.asarray(InferenceModel(model, variables).predict(x))
+    out = np.asarray(InferenceModel(model, variables,
+                                    weight_quant="int8").predict(x))
+    assert out.shape == ref.shape
+    denom = np.maximum(np.max(np.abs(ref)), 1e-6)
+    assert np.max(np.abs(out - ref)) / denom < 0.05, (
+        "int8 serving weights drifted the logits beyond the budget")
+    with pytest.raises(ValueError, match="weight_quant"):
+        InferenceModel(model, variables, weight_quant="int4")
+
+
+def test_weight_quant_adapter_rejects_unknown(lm):
+    model, params = lm
+    with pytest.raises(ValueError, match="weight_quant"):
+        LMAdapter(model, params, cap=32, weight_quant="fp8")
+
+
+# ---------------------------------------------------------------------------
+# capacity accounting: /health page dtype + bytes per page
+# ---------------------------------------------------------------------------
+
+def test_kv_bytes_per_page_and_pressure_fields(lm):
+    e32 = _engine(lm, kv_dtype="float32")
+    e8 = _engine(lm, kv_dtype="int8")
+    try:
+        b32, b8 = e32.kv_bytes_per_page(), e8.kv_bytes_per_page()
+        # int8 payload is 4x smaller; the per-(layer, page) scale pair
+        # keeps the total just above a strict /4
+        assert b8 < b32 / 3
+        a = e8.adapter
+        assert b32 == 2 * a.num_layers * a.num_heads * 4 * a.head_dim * 4
+        assert b8 == (2 * a.num_layers * a.num_heads * 4 * a.head_dim
+                      + 2 * a.num_layers * 4)
+        p32, p8 = e32.decode_pressure(), e8.decode_pressure()
+        assert p32["page_dtype"] == "float32"
+        assert p8["page_dtype"] == "int8"
+        assert p32["kv_bytes_per_page"] == b32
+        assert p8["kv_bytes_per_page"] == b8
+    finally:
+        e32.stop()
+        e8.stop()
+
+
+def test_invalid_kv_dtype_rejected(lm):
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _engine(lm, kv_dtype="int4")
