@@ -1,6 +1,6 @@
 # Test/bench entry points (CI runs these; see .github/workflows/ci.yml)
 
-.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-quant-serving test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout test-recsys bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-decode-quant bench-fleet bench-fleet-chaos bench-slo bench-recsys dryrun examples bench-scaling bench-loader watch
+.PHONY: test test-fast test-resilience test-cluster test-serving test-decode test-quant-serving test-spec-decode test-fleet test-fleet-chaos test-obs test-slo test-data test-ingest test-bundle test-kernels test-collectives test-layout test-recsys bench bench-dispatch bench-watch bench-gradcomm bench-layout bench-decode bench-decode-quant bench-spec bench-fleet bench-fleet-chaos bench-slo bench-recsys dryrun examples bench-scaling bench-loader watch
 
 # full suite, parallelized over cores (pytest-xdist): each worker is its
 # own process with its own 8-virtual-device CPU mesh, so distribution
@@ -72,6 +72,16 @@ test-decode:
 # KV handoff/migration surface, and /health page-dtype accounting
 test-quant-serving:
 	python -m pytest tests/test_quant_serving.py -q
+
+# the speculative-decoding suite (docs/serving.md §Speculative
+# decoding): spec-on vs spec-off byte parity (greedy + seeded sample,
+# mid-flight admission), dense-twin acceptance pinned at 1.0,
+# zero-recompile sweep with the draft/verify programs in the bucket
+# set, spec x int8 token-parity budget, draft-page free on
+# cancel/disconnect, decode_pressure honesty, and the multi-query
+# verify kernel's parity with the gathered-jnp reference
+test-spec-decode:
+	python -m pytest tests/test_spec_decode.py -q
 
 # the decode-fleet suite (docs/serving.md §Decode fleet): prefix-cache
 # byte parity (cached-prefix vs cold prefill, greedy + seeded),
@@ -233,6 +243,14 @@ bench-decode:
 # artifact source
 bench-decode-quant:
 	python bench_serving.py --decode --quant
+
+# speculative decode bench (docs/serving.md §Speculative decoding):
+# the weight-shared block-sparse draft + single-call verify vs the
+# same engine spec-off on the mixed geometry — byte parity, >= 1.5x
+# tokens/s/user, zero unexpected recompiles; the DECODE_SPEC_r*.json
+# artifact source
+bench-spec:
+	python bench_serving.py --decode --spec
 
 # disaggregated decode-fleet bench (docs/serving.md §Decode fleet):
 # mixed-geometry streaming clients against a 2-worker pool with the

@@ -41,6 +41,8 @@ CLI::
                                              # batching + zero recompiles
     python bench_serving.py --decode         # token-level decode bench
     python bench_serving.py --decode --smoke # CI gate for the decode path
+    python bench_serving.py --decode --spec  # speculative decode A/B
+                                             # (DECODE_SPEC_r*.json)
     python bench_serving.py --fleet          # disaggregated decode fleet
     python bench_serving.py --fleet --smoke  # CI gate for the fleet path
     python bench_serving.py --out SERVING_r08.json
@@ -158,6 +160,11 @@ class _Server:
             elif line.startswith("STATS="):
                 b, r = line.split("=", 1)[1].split(",")
                 info["batches"], info["requests"] = int(b), int(r)
+            elif line.startswith("SPEC="):
+                d, a, rj = line.split("=", 1)[1].split(",")
+                info["spec_drafted"] = int(d)
+                info["spec_accepted"] = int(a)
+                info["spec_rejected"] = int(rj)
         if "batches" not in info:
             raise RuntimeError(f"bench server exited without stats: {out!r}")
         return info
@@ -348,7 +355,8 @@ DECODE_SERVER = textwrap.dedent("""
     from bigdl_tpu.obs.attr import recompile_sentinel
     from bigdl_tpu.optim.metrics import global_metrics
     from bigdl_tpu.serving import (DecodeConfig, InferenceModel,
-                                   ServingConfig, ServingServer)
+                                   ServingConfig, ServingServer,
+                                   SpecConfig)
     from bigdl_tpu.serving.http_frontend import HttpFrontend
 
     sent = recompile_sentinel().install()
@@ -359,7 +367,8 @@ DECODE_SERVER = textwrap.dedent("""
     im = InferenceModel(model, variables, decode=DecodeConfig(
         slots=%(slots)d, page_size=8, pages_per_slot=16, prompt_chunk=8,
         max_new_tokens=120, eos_id=1, continuous=%(continuous)s,
-        kv_dtype=%(kv_dtype)r), weight_quant=%(weight_quant)r)
+        kv_dtype=%(kv_dtype)r, speculative=%(speculative)s),
+        weight_quant=%(weight_quant)r)
     im.decode_engine.warmup()
     srv = ServingServer(im, ServingConfig(batch_size=8)).start()
     fe = HttpFrontend(srv, port=0).start()
@@ -373,15 +382,19 @@ DECODE_SERVER = textwrap.dedent("""
           flush=True)
     st = im.decode_engine.stats
     print("STATS=%%d,%%d" %% (st['steps'], st['completed']), flush=True)
+    print("SPEC=%%d,%%d,%%d" %% (st['spec_drafted'], st['spec_accepted'],
+                                 st['spec_rejected']), flush=True)
 """)
 
 
 class _DecodeServer(_Server):
     def __init__(self, continuous: bool, slots: int = 8,
-                 kv_dtype: str = "float32", weight_quant=None):
+                 kv_dtype: str = "float32", weight_quant=None,
+                 speculative: str = "None"):
         code = DECODE_SERVER % {"continuous": repr(continuous),
                                 "slots": slots, "kv_dtype": kv_dtype,
-                                "weight_quant": weight_quant}
+                                "weight_quant": weight_quant,
+                                "speculative": speculative}
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PYTHONPATH=os.pathsep.join(
                        p for p in [REPO, os.environ.get("PYTHONPATH")]
@@ -566,9 +579,11 @@ def _pct(xs, q):
 def run_decode_bench(continuous: bool, clients: int,
                      duration_s: float, slots: int = 8,
                      kv_dtype: str = "float32",
-                     weight_quant=None) -> dict:
+                     weight_quant=None,
+                     speculative: str = "None") -> dict:
     server = _DecodeServer(continuous=continuous, slots=slots,
-                           kv_dtype=kv_dtype, weight_quant=weight_quant)
+                           kv_dtype=kv_dtype, weight_quant=weight_quant,
+                           speculative=speculative)
     try:
         # warm phase outside the window: handler threads + client conns
         _decode_load(server, clients, min(0.6, duration_s))
@@ -579,8 +594,13 @@ def run_decode_bench(continuous: bool, clients: int,
     finally:
         info = server.finish()
     tokens = int(sum(counts))
+    adjud = info.get("spec_accepted", 0) + info.get("spec_rejected", 0)
     return {
         "engine": "continuous" if continuous else "static_batch_restart",
+        "spec_drafted": info.get("spec_drafted", 0),
+        "spec_accepted": info.get("spec_accepted", 0),
+        "spec_accept_rate": (round(info["spec_accepted"] / adjud, 4)
+                             if adjud else 0.0),
         "geometry": f"decode_s{slots}_c{clients}",
         "concurrent_clients": clients,
         "duration_s": round(wall, 2),
@@ -773,6 +793,299 @@ def run_decode_quant(clients: int, duration_s: float, out=None,
         if rel < 0.9:
             failures.append(f"quantized tokens/s only {rel:.2f}x the f32 "
                             "arm (< 0.9x): dequant overhead regressed")
+    if out and not failures:
+        with open(out, "w") as f:
+            json.dump(row, f, indent=1)
+    print(json.dumps(row))
+    if failures:
+        print("FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decode bench (--decode --spec): the DECODE_SPEC_r*.json
+# evidence source (docs/serving.md §Speculative decoding)
+# ---------------------------------------------------------------------------
+
+# Engine-level parity drill in its own interpreter: the SAME tiny LM
+# spec-off vs spec-on (weight-shared block-sparse draft, k tokens per
+# iteration, single-call verify), greedy AND seeded-sample over an
+# identical mixed-geometry batch.  Speculation must be invisible in the
+# output: byte-identical tokens and logp on both legs (the acceptance
+# rule emits only target selections).  Prints the agreement fraction,
+# the accept rate, and the unexpected-recompile counter (both engines
+# warm BEFORE mark_steady — the draft/verify programs joining the
+# compile set is expected; anything after is not).
+SPEC_PARITY = textwrap.dedent("""
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.obs.attr import recompile_sentinel
+    from bigdl_tpu.optim.metrics import global_metrics
+    from bigdl_tpu.serving.decode_engine import (DecodeConfig,
+                                                 DecodeEngine, LMAdapter,
+                                                 SpecConfig)
+
+    sent = recompile_sentinel().install()
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        num_layers=2, dropout=0.0, mode="lm")
+    params = model.init(jax.random.PRNGKey(0),
+                        np.arange(8, dtype=np.int32)[None])["params"]
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(2, 64, (int(rs.randint(4, 17)),)).tolist()
+               for _ in range(8)]
+
+    def build(spec):
+        cfg = DecodeConfig(slots=4, page_size=8, pages_per_slot=16,
+                           prompt_chunk=8, max_new_tokens=32, eos_id=1,
+                           speculative=spec)
+        eng = DecodeEngine(LMAdapter(model, params, cap=cfg.cap), cfg)
+        eng.warmup()
+        return eng
+
+    off = build(None)
+    on = build(SpecConfig(k=%(k)d, sparsity=%(sparsity)r))
+    chunk = build(SpecConfig(k=%(k)d, sparsity=%(sparsity)r,
+                             verify_impl="chunk"))
+    sent.mark_steady()
+    agree = chunk_agree = 0
+    for kw in ({}, dict(temperature=0.9, top_k=8, top_p=0.9)):
+        ref = off.generate(prompts, max_new_tokens=24, **kw)
+        spc = on.generate(prompts, max_new_tokens=24, **kw)
+        chk = chunk.generate(prompts, max_new_tokens=24, **kw)
+        agree += sum(1 for a, b in zip(ref, spc)
+                     if a.tokens.tolist() == b.tokens.tolist()
+                     and np.float32(a.logp) == np.float32(b.logp))
+        # the chunk verify is a different (multi-query) program: token
+        # stream still exact, logp pinned to allclose (same math, one
+        # batched softmax instead of k+1 single-token ones)
+        chunk_agree += sum(1 for a, b in zip(ref, chk)
+                           if a.tokens.tolist() == b.tokens.tolist()
+                           and np.allclose(a.logp, b.logp,
+                                           rtol=2e-5, atol=2e-5))
+    st = on.stats
+    adjud = st['spec_accepted'] + st['spec_rejected']
+    print("PARITY=%%.4f" %% (agree / (2 * len(prompts))), flush=True)
+    print("CHUNK_PARITY=%%.4f" %% (chunk_agree / (2 * len(prompts))),
+          flush=True)
+    print("ACCEPT=%%.4f" %% (st['spec_accepted'] / max(adjud, 1)),
+          flush=True)
+    off.stop(); on.stop(); chunk.stop()
+    m = global_metrics()
+    print("RECOMPILES="
+          + str(int(m.counter('train.unexpected_recompiles_total'))),
+          flush=True)
+""")
+
+
+# The throughput A/B in its own interpreter, at the geometry where
+# speculation's physics live: LONG context (768-token cap, 150-250
+# token prompts, 480-token decodes).  Per decoded token the spec-off
+# engine re-reads the slot's whole KV pool to score ONE position; the
+# draft pays that same read k+1 times but the verify scores k+1
+# positions in a single pass over it, so the pool traffic per EMITTED
+# token drops by the acceptance-weighted chunk length.  Short-context
+# geometries hide this (the pool read is too cheap to amortize) — the
+# committed artifact says so via the geometry field.  Arms run ABBA
+# (off,on,on,off) per wave with a shared warm wave first: on the
+# 1-CPU bench host wall-clock drifts +/-30%% run to run, and pairing
+# cancels it where back-to-back arms would bake it in.  Both engines
+# warm BEFORE mark_steady; every wave after is a zero-recompile gate.
+SPEC_AB = textwrap.dedent("""
+    import time
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu.nn.attention import Transformer
+    from bigdl_tpu.obs.attr import recompile_sentinel
+    from bigdl_tpu.optim.metrics import global_metrics
+    from bigdl_tpu.serving.decode_engine import (DecodeConfig,
+                                                 DecodeEngine,
+                                                 DecodeRequest,
+                                                 LMAdapter, SpecConfig)
+
+    sent = recompile_sentinel().install()
+    model = Transformer(vocab_size=64, hidden_size=32, num_heads=2,
+                        num_layers=2, dropout=0.0, mode="lm")
+    params = model.init(jax.random.PRNGKey(0),
+                        np.arange(8, dtype=np.int32)[None])["params"]
+
+    def build(spec):
+        cfg = DecodeConfig(slots=%(slots)d, page_size=16,
+                           pages_per_slot=%(pps)d, prompt_chunk=64,
+                           max_new_tokens=%(horizon)d, eos_id=1,
+                           speculative=spec)
+        eng = DecodeEngine(LMAdapter(model, params, cap=cfg.cap), cfg)
+        eng.warmup()
+        return eng
+
+    off = build(None)
+    on = build(SpecConfig(k=%(k)d, sparsity=%(sparsity)r,
+                          verify_impl=%(verify_impl)r))
+    sent.mark_steady()
+
+    def wave(eng, seed):
+        rs = np.random.RandomState(seed)
+        reqs = [DecodeRequest(
+                    tokens=rs.randint(2, 64, (int(rs.randint(
+                        %(plo)d, %(phi)d)),)).astype(np.int32),
+                    max_new_tokens=%(new)d, seed=seed * 100 + i)
+                for i in range(%(conc)d)]
+        t0 = time.perf_counter()
+        for r in reqs:
+            eng.submit(r)
+        outs = [r.wait(timeout=600) for r in reqs]
+        dt = time.perf_counter() - t0
+        toks = sum(len(o.tokens) for o in outs)
+        assert toks > 0, "wave produced no tokens"
+        return toks / dt / %(conc)d
+
+    wave(off, 0); wave(on, 0)   # shared warm wave, outside the window
+    for w in range(1, %(waves)d + 1):
+        a1 = wave(off, w); b1 = wave(on, w)
+        b2 = wave(on, w + 100); a2 = wave(off, w + 100)
+        print("WAVE=%%.4f,%%.4f" %% (a1 + a2, b1 + b2), flush=True)
+    st = on.stats
+    adjud = st['spec_accepted'] + st['spec_rejected']
+    print("ACCEPT=%%.4f" %% (st['spec_accepted'] / max(adjud, 1)),
+          flush=True)
+    print("DRAFTED=%%d" %% st['spec_drafted'], flush=True)
+    off.stop(); on.stop()
+    m = global_metrics()
+    print("RECOMPILES="
+          + str(int(m.counter('train.unexpected_recompiles_total'))),
+          flush=True)
+""")
+
+
+def _run_spec_ab(k: int, sparsity: float, verify_impl: str,
+                 smoke: bool) -> dict:
+    """Run the paired long-context A/B subprocess; parse its lines.
+    Smoke collapses the geometry (256-token cap, 48-token decodes, one
+    wave) — it exercises the identical wave/pairing machinery and the
+    zero-recompile gate, just not the speedup floor."""
+    geo = dict(slots=4, conc=4, k=k, sparsity=sparsity,
+               verify_impl=verify_impl)
+    if smoke:
+        geo.update(pps=16, horizon=64, plo=40, phi=80, new=48, waves=1)
+    else:
+        geo.update(pps=48, horizon=520, plo=150, phi=250, new=480,
+                   waves=3)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in [REPO, os.environ.get("PYTHONPATH")] if p))
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", SPEC_AB % geo], env=env,
+                          capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError("spec A/B died:\n" + proc.stderr[-2000:])
+    waves, vals = [], {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("WAVE="):
+            a, _, b = line[5:].partition(",")
+            waves.append((float(a), float(b)))
+        elif "=" in line:
+            key, _, v = line.partition("=")
+            vals[key.strip()] = v.strip()
+    return {
+        "geometry": ("decode_spec_s4_c4_ctx256_smoke" if smoke
+                     else "decode_spec_s4_c4_ctx768"),
+        "waves": waves,
+        "accept_rate": float(vals["ACCEPT"]),
+        "drafted": int(vals["DRAFTED"]),
+        "recompiles": int(vals["RECOMPILES"]),
+    }
+
+
+def _run_spec_parity(k: int, sparsity: float) -> dict:
+    """Run the spec parity drill subprocess; parse its KEY=value lines."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in [REPO, os.environ.get("PYTHONPATH")] if p))
+    env.pop("XLA_FLAGS", None)
+    code = SPEC_PARITY % {"k": k, "sparsity": sparsity}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError("spec parity drill died:\n" + proc.stderr[-2000:])
+    vals = {}
+    for line in proc.stdout.splitlines():
+        if "=" in line:
+            key, _, v = line.partition("=")
+            vals[key.strip()] = v.strip()
+    return {
+        "parity": float(vals["PARITY"]),
+        "chunk_parity": float(vals["CHUNK_PARITY"]),
+        "accept_rate": float(vals["ACCEPT"]),
+        "recompiles": int(vals["RECOMPILES"]),
+    }
+
+
+def run_decode_spec(out=None, smoke: bool = False, k: int = 48,
+                    sparsity: float = 0.5,
+                    verify_impl: str = "chunk") -> int:
+    """The speculative-decoding gate (docs/serving.md §Speculative
+    decoding).  Two drills, each its own interpreter:
+
+    1. Parity: spec-on vs spec-off over an identical batch, greedy AND
+       seeded sample.  Scan verify must be BYTE-identical (tokens and
+       logp); the chunk verify must match tokens exactly with logp
+       allclose.
+    2. Throughput: ABBA-paired waves at the long-context geometry,
+       tokens/s/user spec-on vs spec-off, median-of-waves speedup
+       gated >= 1.5x (non-smoke).
+
+    Zero unexpected recompiles across both drills — every draft /
+    verify / step / prefill program joins warmup()'s closed bucket
+    set before mark_steady."""
+    par = _run_spec_parity(k, sparsity)
+    ab = _run_spec_ab(k, sparsity, verify_impl, smoke)
+    ratios = sorted(b / a for a, b in ab["waves"] if a > 0)
+    speedup = (round(ratios[len(ratios) // 2], 2) if ratios else 0.0)
+    off_rate = sorted(a for a, _ in ab["waves"])[len(ab["waves"]) // 2]
+    on_rate = sorted(b for _, b in ab["waves"])[len(ab["waves"]) // 2]
+    row = {
+        "bench": "decode_spec",
+        "geometry": ab["geometry"],
+        "concurrent_clients": 4,
+        "spec_k": k,
+        "spec_sparsity": sparsity,
+        "spec_verify_impl": verify_impl,
+        "token_parity": par["parity"],
+        "chunk_token_parity": par["chunk_parity"],
+        "accept_rate": ab["accept_rate"],
+        "parity_accept_rate": par["accept_rate"],
+        # median per-wave PAIRED rates (each wave sums its two ABBA
+        # runs); the speedup is the median of per-wave ratios, not the
+        # ratio of medians — pairing is what cancels host drift
+        "spec_tokens_per_s_user": round(on_rate / 2, 2),
+        "base_tokens_per_s_user": round(off_rate / 2, 2),
+        "wave_speedups": [round(r, 3) for r in ratios],
+        "speedup_vs_off": speedup,
+        "spec_drafted": ab["drafted"],
+        "unexpected_recompiles": (par["recompiles"]
+                                  + ab["recompiles"]),
+    }
+    failures = []
+    if par["parity"] < 1.0:
+        failures.append(f"token/logp parity {par['parity']:.2f} < 1.0 "
+                        "(spec-on vs spec-off must be byte-identical)")
+    if par["chunk_parity"] < 1.0:
+        failures.append(f"chunk-verify parity {par['chunk_parity']:.2f}"
+                        " < 1.0 (tokens exact, logp allclose)")
+    if row["unexpected_recompiles"] != 0:
+        failures.append(f"{row['unexpected_recompiles']} unexpected XLA "
+                        "recompiles across the spec sweep")
+    if ab["drafted"] <= 0:
+        failures.append("spec-on arm never drafted — speculation "
+                        "silently disabled")
+    if not smoke and speedup < 1.5:
+        failures.append(f"speculative tokens/s/user only {speedup}x the "
+                        "spec-off arm (< 1.5x median of paired waves)")
     if out and not failures:
         with open(out, "w") as f:
             json.dump(row, f, indent=1)
@@ -1309,6 +1622,11 @@ def main(argv=None) -> int:
                     help="with --decode: int8 KV pages + int8 serving "
                          "weights vs f32 at equal HBM budget — token "
                          "parity, >= 1.8x slots, zero recompiles")
+    ap.add_argument("--spec", action="store_true",
+                    help="with --decode: speculative decoding with the "
+                         "weight-shared block-sparse draft, spec-on vs "
+                         "spec-off A/B — byte parity, >= 1.5x tokens/s"
+                         "/user, zero recompiles")
     ap.add_argument("--fleet", action="store_true",
                     help="disaggregated decode-fleet bench: prefill/"
                          "decode split over a worker pool, KV-aware "
@@ -1337,6 +1655,11 @@ def main(argv=None) -> int:
         clients = 24 if args.clients == 32 else args.clients
         return run_fleet(clients=clients, duration_s=args.duration,
                          out=out)
+    if args.decode and args.spec:
+        out = args.out
+        if out is None and os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):
+            out = os.path.join(REPO, "DECODE_SPEC_r01.json")
+        return run_decode_spec(out=out, smoke=args.smoke)
     if args.decode and args.quant:
         out = args.out
         if out is None and os.environ.get("BIGDL_TPU_WRITE_ARTIFACTS"):

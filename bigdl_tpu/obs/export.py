@@ -196,6 +196,28 @@ DEFAULT_HELP = {
                               "mid-decode)",
     "serving.decode.steps": "decode model steps executed",
     "serving.decode.prefill_chunks": "prompt prefill chunks executed",
+    "serving.decode.spec_accept_rate": "speculative decode: accepted / "
+                                       "adjudicated draft tokens over "
+                                       "the recent window "
+                                       "(docs/serving.md §Speculative "
+                                       "decoding) — 1.0 means every "
+                                       "draft the target scored agreed",
+    "serving.decode.spec_drafted_tokens": "speculative decode: tokens "
+                                          "drafted by the block-sparse "
+                                          "twin, engine lifetime",
+    "serving.decode.spec_accepted_tokens": "speculative decode: drafted "
+                                           "tokens the target verify "
+                                           "accepted",
+    "serving.decode.spec_rejected_tokens": "speculative decode: drafted "
+                                           "tokens rejected by a verify "
+                                           "mismatch (drafts past an "
+                                           "eos/length finish count as "
+                                           "neither)",
+    "serving.decode.spec_draft_step_s": "one draft-model k-token scan "
+                                        "(all active slots, one "
+                                        "program call)",
+    "serving.decode.spec_verify_step_s": "one target-model verify call "
+                                         "scoring the drafted chunk",
     "serving.decode.kv_bytes_per_page": "HBM bytes one KV page costs in "
                                         "its stored dtype (int8 pages "
                                         "include the per-page scale "

@@ -110,6 +110,14 @@ _ARTIFACT_GLOBS = (
     # ratio and the quantized engine's tokens/s (both higher-better —
     # the memory win must keep paying and must not cost throughput)
     "DECODE_QUANT_r[0-9]*.json",
+    # speculative decode rounds (bench_serving --decode --spec): the
+    # weight-shared block-sparse draft + single-call verify vs the same
+    # engine spec-off.  Greedy byte parity and zero unexpected
+    # recompiles are hard gates inside the bench; the sentinel trends
+    # the per-user token rate and the acceptance rate (both higher-
+    # better — speculation must keep paying, and a draft that stops
+    # agreeing with the target is a silent regression)
+    "DECODE_SPEC_r[0-9]*.json",
 )
 
 # lower-is-better families (latencies, recovery time/traffic, collective
@@ -235,6 +243,20 @@ def normalize(doc: Any, source: str) -> List[Row]:
             row.get("slots_per_chip_ratio"))
         add(f"decode_quant_tokens_per_s{sfx}",
             row.get("quant_tokens_per_s"))
+    if row.get("bench") == "decode_spec":
+        # DECODE_SPEC_r*.json (bench_serving --decode --spec): the
+        # block-sparse draft + single-call verify vs the same engine
+        # spec-off.  Byte parity, the >=1.5x speedup floor, and the
+        # zero-recompile sweep are hard gates inside the bench; the
+        # sentinel trends the per-user rate and the acceptance rate —
+        # acceptance decaying means the draft stopped earning its keep
+        # long before the speedup gate trips.  Geometry-scoped.
+        geo = re.sub(r"[^A-Za-z0-9]+", "_",
+                     str(row.get("geometry") or "")).strip("_")
+        sfx = f"_{geo}" if geo else ""
+        add(f"decode_spec_tokens_per_s_user{sfx}",
+            row.get("spec_tokens_per_s_user"))
+        add(f"decode_spec_accept_rate{sfx}", row.get("accept_rate"))
     if row.get("bench") == "decode_chaos":
         # DECODE_CHAOS_r*.json (bench_serving --fleet --chaos): the
         # pass/fail gates (zero failed requests, byte parity across the

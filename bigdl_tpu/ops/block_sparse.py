@@ -238,13 +238,19 @@ class BlockSparseLinear(Linear):
                  out_features: int = 0,
                  block_shape: Tuple[int, int] = (64, 64),
                  with_bias: bool = True, target_sparsity: float = 0.0,
-                 name=None, **linear_kwargs):
+                 use_kernel: bool = True, name=None, **linear_kwargs):
         super().__init__(in_features, out_features, with_bias=with_bias,
                          name=name, **linear_kwargs)
         self.block_shape = (int(block_shape[0]), int(block_shape[1]))
         # the pruning schedule's end state; the schedule/prune helpers
         # read it, the layer itself only ever applies self.mask
         self.target_sparsity = float(target_sparsity)
+        # use_kernel=False routes a pruned mask through a masked DENSE
+        # matmul instead of the Pallas kernel: identical math (the mask
+        # zeroes the same blocks), no Pallas dispatch — the right trade
+        # for the tiny hidden sizes of a speculative draft model on CPU,
+        # where a grid launch per FFN costs more than the skipped FLOPs
+        self.use_kernel = bool(use_kernel)
         self.mask: Optional[np.ndarray] = None
 
     def build(self, rng, x):
@@ -308,9 +314,18 @@ class BlockSparseLinear(Linear):
         from bigdl_tpu.tensor.policy import cast_compute
 
         xc, wc = cast_compute(x, params["weight"])
-        y = block_sparse_matmul(
-            xc, wc, self.mask, block_k=self.block_shape[0],
-            block_n=self.block_shape[1]).astype(jnp.float32)
+        if self.use_kernel:
+            y = block_sparse_matmul(
+                xc, wc, self.mask, block_k=self.block_shape[0],
+                block_n=self.block_shape[1]).astype(jnp.float32)
+        else:
+            k, n = int(wc.shape[0]), int(wc.shape[1])
+            em = jnp.asarray(expand_mask(self.mask, k, n,
+                                         self.block_shape[0],
+                                         self.block_shape[1]))
+            y = jnp.matmul(xc.astype(jnp.float32),
+                           jnp.where(em, wc.astype(jnp.float32), 0.0),
+                           preferred_element_type=jnp.float32)
         if self.with_bias:
             y = y + params["bias"]
         return y.astype(x.dtype), EMPTY
@@ -445,6 +460,32 @@ def prune_model_to_sparsity(model, variables, sparsity: float,
         goal = min(float(sparsity), mod.target_sparsity or float(sparsity))
         out[path] = mod.prune_to(params, goal)
     return out
+
+
+def derive_draft_masks(model, params, sparsity: float) -> Dict[str, float]:
+    """Derive block masks for a SPECULATIVE DRAFT twin from a SERVED
+    checkpoint (docs/serving.md §Speculative decoding): ``model`` is a
+    freshly-constructed sparse twin of the target architecture (its
+    :class:`BlockSparseLinear` layers carry ctor-known shapes but have
+    never been built, so their masks are ``None``); ``params`` is the
+    target's trained ``variables["params"]`` tree, which the twin
+    consumes verbatim — weight sharing is the whole point.  Seeds every
+    sparse layer with the all-ones mask its ``build`` would create, then
+    runs one magnitude-pruning event to ``sparsity``.  Returns
+    ``{path: achieved_sparsity}``."""
+    for path, mod in iter_sparse_modules(model):
+        if mod.mask is not None:
+            continue
+        if not mod.in_features or not mod.out_features:
+            raise ValueError(
+                f"derive_draft_masks: {path or 'layer'} has no ctor "
+                "shapes — construct the draft twin with explicit "
+                "in/out features (PositionwiseFFN does)")
+        bk, bn = mod.block_shape
+        mod.mask = np.ones((cdiv(mod.in_features, bk),
+                            cdiv(mod.out_features, bn)), bool)
+    return prune_model_to_sparsity(model, {"params": params},
+                                   float(sparsity))
 
 
 def collect_masks(model) -> Dict[str, Any]:

@@ -370,6 +370,155 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     )(*scalars, q, k_pages, v_pages)
 
 
+def _verify_kernel(pt_ref, pos_ref, *refs, sm_scale, page_size, chunk,
+                   quantized):
+    """Multi-query (speculative-verify) attention over one slot's paged
+    KV cache (docs/serving.md §Speculative decoding).  Identical page
+    walk to :func:`_decode_kernel`, but the query block carries the
+    whole k+1-token verify chunk: query ``c`` sits at cache position
+    ``pos_ref[s] + c`` and attends keys at positions ``<= pos_ref[s] +
+    c`` — the per-query causal staircase that makes one program score
+    every drafted token."""
+    if quantized:
+        (ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    num_pb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # the LAST query (c = chunk-1) attends the furthest position, so a
+    # page participates iff it starts at or below pos + chunk - 1
+    @pl.when(j * page_size <= pos_ref[s] + chunk - 1)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # (bh, C, d)
+        k = k_ref[0].astype(jnp.float32)                 # (bh, page, d)
+        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            pid = pt_ref[s, j]
+            k = k * ks_ref[pid]
+            v = v * vs_ref[pid]
+        # (bh, C, page) scores via broadcast-multiply-reduce (VPU path,
+        # like the decode kernel — C and page are both small here)
+        sc = jnp.sum(q[:, :, None, :] * k[:, None, :, :], axis=-1)
+        key_pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 2)
+        q_lim = pos_ref[s] + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1)
+        sc = jnp.where(key_pos <= q_lim, sc, _NEG_INF)
+        m_prev = m_scr[:]                                # (bh, C)
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[..., None] + jnp.sum(
+            p[..., None] * v[:, None], axis=2)
+
+    @pl.when(j == num_pb - 1)
+    def _finish():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe[..., None]).astype(o_ref.dtype)
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_table, positions, *,
+                           k_scales=None, v_scales=None,
+                           sm_scale: Optional[float] = None,
+                           block_h: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """Query-length-``k+1`` speculative-VERIFY attention over a paged KV
+    cache — the multi-query sibling of :func:`paged_decode_attention`
+    (docs/serving.md §Speculative decoding): one call scores the whole
+    drafted chunk against the target cache instead of k+1 single-query
+    steps.
+
+    ``q``: (slots, heads, chunk, head_dim) — the verify chunk's queries,
+    query ``c`` of slot ``s`` sitting at cache position ``positions[s]
+    + c``.  ``k_pages``/``v_pages``/``page_table`` exactly as
+    :func:`paged_decode_attention`; the chunk's own K/V must already be
+    scattered into the pages (positions ``[positions[s], positions[s] +
+    chunk)``) before the call.  ``positions``: (slots,) int32 — the
+    FIRST query's cache position per slot; the per-query causal
+    staircase ``key_pos <= positions[s] + c`` makes each query attend
+    its own prefix only, so the outputs match chunk single-query decode
+    steps.
+
+    int8 pools pass ``k_scales``/``v_scales`` per-page f32 abs-max
+    scales, dequantized in-register like the decode kernel.  ``block_h``
+    tiles heads (``None`` = the largest of {1, 2, 4, 8} dividing
+    ``heads`` — the verify chunk is not autotuned separately)."""
+    S, h, C, d = q.shape
+    P, hk, page, dk = k_pages.shape
+    assert (h, d) == (hk, dk), (q.shape, k_pages.shape)
+    quantized = k_pages.dtype == jnp.int8
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("int8 k_pages/v_pages need k_scales/v_scales "
+                         "(one f32 abs-max scale per pool page)")
+    if not quantized and (k_scales is not None or v_scales is not None):
+        raise ValueError("k_scales/v_scales only apply to int8 pages, "
+                         f"got {k_pages.dtype} pages")
+    nb = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = d ** -0.5
+    if block_h is None:
+        bh = max(c for c in (1, 2, 4, 8) if h % c == 0)
+    else:
+        bh = int(block_h)
+        if h % bh != 0:
+            raise ValueError(f"block_h {bh} must divide heads {h}")
+
+    kernel = functools.partial(_verify_kernel, sm_scale=float(sm_scale),
+                               page_size=page, chunk=C,
+                               quantized=quantized)
+    if quantized:
+        def q_map(s, hb, j, pt, pos, ks, vs):
+            return (s, hb, 0, 0)
+
+        def kv_map(s, hb, j, pt, pos, ks, vs):
+            return (pt[s, j], hb, 0, 0)
+    else:
+        def q_map(s, hb, j, pt, pos):
+            return (s, hb, 0, 0)
+
+        def kv_map(s, hb, j, pt, pos):
+            return (pt[s, j], hb, 0, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4 if quantized else 2,
+        grid=(S, h // bh, nb),
+        in_specs=[
+            pl.BlockSpec((1, bh, C, d), q_map),
+            pl.BlockSpec((1, bh, page, d), kv_map),
+            pl.BlockSpec((1, bh, page, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, bh, C, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((bh, C), jnp.float32),    # running max per query
+            pltpu.VMEM((bh, C), jnp.float32),    # running denom
+            pltpu.VMEM((bh, C, d), jnp.float32),  # output accumulator
+        ],
+    )
+    scalars = [jnp.asarray(page_table, jnp.int32),
+               jnp.asarray(positions, jnp.int32)]
+    if quantized:
+        scalars += [jnp.asarray(k_scales, jnp.float32),
+                    jnp.asarray(v_scales, jnp.float32)]
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, h, C, d), q.dtype),
+        interpret=default_interpret(interpret),
+    )(*scalars, q, k_pages, v_pages)
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None,

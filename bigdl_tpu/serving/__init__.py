@@ -23,7 +23,8 @@ from bigdl_tpu.serving.http_frontend import HttpClient, HttpFrontend
 from bigdl_tpu.serving.seq2seq import Seq2SeqService
 from bigdl_tpu.serving.pool import ServingPool
 from bigdl_tpu.serving.decode_engine import (DecodeConfig, DecodeEngine,
-                                             DecodeRequest, DecodeResult)
+                                             DecodeRequest, DecodeResult,
+                                             SpecConfig)
 from bigdl_tpu.serving.fleet import (FleetRouter, PrefixCache,
                                      pack_handoff, unpack_handoff)
 
@@ -32,5 +33,5 @@ __all__ = [
     "InputQueue", "OutputQueue", "HttpFrontend", "HttpClient",
     "ServingPool", "ServiceUnavailableError", "DeadlineExceededError",
     "RequestDroppedError", "DecodeConfig", "DecodeEngine",
-    "DecodeRequest", "DecodeResult", "FleetRouter", "PrefixCache",
-    "pack_handoff", "unpack_handoff"]
+    "DecodeRequest", "DecodeResult", "SpecConfig", "FleetRouter",
+    "PrefixCache", "pack_handoff", "unpack_handoff"]

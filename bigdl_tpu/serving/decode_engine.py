@@ -45,10 +45,27 @@ bit-stable under padded key lengths (masked lanes contribute exact
 zeros), and threefry key streams are counter-based (per-row
 ``fold_in(request_key, position)`` draws are batch-shape-independent).
 
+Speculative decoding (docs/serving.md §Speculative decoding):
+``DecodeConfig.speculative=SpecConfig(k, sparsity)`` swaps the
+one-token decode step for a draft+verify iteration — a block-sparse
+twin of the SAME checkpoint (weights shared verbatim, only the FFN
+block masks differ; BLaST lineage, ops/block_sparse.py) drafts ``k``
+tokens against its own float32 KV pages, then ONE target verify
+program of query length ``k+1`` scores the whole chunk and the host
+accepts the longest agreeing prefix.  Every emitted token is a TARGET
+selection, so greedy output is byte-identical to the spec-off engine
+and to :meth:`DecodeEngine.static_generate` by construction, and
+temperature>0 keeps seeded parity because draft and verify share
+``_select_tokens``'s counter-based key streams (the shared-Gumbel
+coupling also makes a close draft agree often).  Draft pages live in a
+parallel f32 pool indexed by the SAME page table, so cancel/expiry/
+migration free draft state together with target state structurally.
+
 Observability: ``serving.decode.*`` gauges/histograms — tokens/s,
 time-to-first-token, inter-token latency, slot occupancy, page
-utilization — all described in ``obs/export.py``'s catalog
-(docs/serving.md §Autoregressive decode has the knob table).
+utilization, speculation acceptance — all described in
+``obs/export.py``'s catalog (docs/serving.md §Autoregressive decode
+has the knob table).
 """
 
 import heapq
@@ -94,6 +111,45 @@ class RequestCancelledError(RuntimeError):
 # ---------------------------------------------------------------------------
 # config / request / result
 # ---------------------------------------------------------------------------
+
+@dataclass
+class SpecConfig:
+    """Speculative-decoding knobs (docs/serving.md §Speculative
+    decoding).  The draft is ALWAYS the served checkpoint itself with
+    block-sparse FFNs — no second model, no distillation; ``sparsity``
+    trades draft speed against acceptance rate (0.0 = a dense twin:
+    acceptance 1.0, no speedup — the accounting-test configuration)."""
+
+    k: int = 4                 # tokens drafted per engine iteration
+    sparsity: float = 0.5      # FFN block sparsity of the draft twin
+    sparse_block: Tuple[int, int] = (8, 8)
+    # "auto" = the Pallas block-sparse kernel on TPU, masked-dense jnp
+    # elsewhere (a grid launch per FFN costs more than the skipped
+    # FLOPs at CPU-test sizes); "kernel"/"masked" force a path
+    draft_impl: str = "auto"
+    # How the target scores the drafted chunk (docs/serving.md
+    # §Speculative decoding — "Two verify tracings"):
+    #   "scan"  — k+1 single-token steps mirroring the decode step
+    #             op-for-op under one lax.scan: ONE dispatch, byte
+    #             parity (tokens AND logp) with spec-off output.
+    #   "chunk" — one multi-query pass over the chunk (query length
+    #             k+1): collapses the per-step op count ~(k+1)x, the
+    #             perf configuration.  Token-stream parity holds (the
+    #             selections agree); logp is allclose-not-bitwise —
+    #             the same contract as spec-off flash decode.  f32 KV
+    #             only (int8 RMW is inherently per-position).
+    #   "auto"  — "chunk" where the flash kernel runs (TPU), "scan"
+    #             elsewhere: byte parity wherever the platform has it.
+    verify_impl: str = "auto"
+    # Draft attention window: None = the draft attends its full
+    # context (exactly like the target); an int W = the draft scan
+    # attends only the last W positions through a ring buffer carried
+    # across the k+1 steps.  At long contexts this caps the draft's
+    # per-step attention traffic at O(W) while the target re-reads the
+    # whole cache — the verify is still exact over the full context,
+    # so output parity is untouched; only the acceptance rate moves.
+    draft_window: Optional[int] = None
+
 
 @dataclass
 class DecodeConfig:
@@ -150,6 +206,12 @@ class DecodeConfig:
     # token-parity budget (greedy token agreement + bounded logp
     # drift) asserted in tests/test_quant_serving.py.
     kv_dtype: str = "float32"
+    # speculative decoding (docs/serving.md §Speculative decoding):
+    # a SpecConfig turns every decode iteration into draft(k)+verify —
+    # continuous LM engines only.  Greedy output stays byte-identical
+    # to speculative=None; the f32 draft page pool roughly doubles the
+    # per-page HBM cost (see kv_bytes_per_page).
+    speculative: Optional[SpecConfig] = None
 
     @property
     def cap(self) -> int:
@@ -296,24 +358,33 @@ def _select_tokens(logits, keys, positions, temps, top_ks, top_ps):
     lp_full = jax.nn.log_softmax(logits, axis=-1)
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    z = logits / jnp.maximum(temps, 1e-6)[:, None]
-    zs = jnp.sort(z, axis=-1)[:, ::-1]
-    kth = jnp.take_along_axis(
-        zs, jnp.clip(top_ks - 1, 0, vocab - 1)[:, None], axis=-1)
-    z = jnp.where((top_ks > 0)[:, None] & (z < kth), -jnp.inf, z)
-    zs2 = jnp.sort(z, axis=-1)[:, ::-1]
-    ps = jax.nn.softmax(zs2, axis=-1)
-    prev_mass = jnp.cumsum(ps, axis=-1) - ps
-    keep = prev_mass < top_ps[:, None]
-    minz = jnp.min(jnp.where(keep, zs2, jnp.inf), axis=-1, keepdims=True)
-    z = jnp.where((top_ps < 1.0)[:, None] & (z < minz), -jnp.inf, z)
+    def _sampled(_):
+        z = logits / jnp.maximum(temps, 1e-6)[:, None]
+        zs = jnp.sort(z, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(
+            zs, jnp.clip(top_ks - 1, 0, vocab - 1)[:, None], axis=-1)
+        z = jnp.where((top_ks > 0)[:, None] & (z < kth), -jnp.inf, z)
+        zs2 = jnp.sort(z, axis=-1)[:, ::-1]
+        ps = jax.nn.softmax(zs2, axis=-1)
+        prev_mass = jnp.cumsum(ps, axis=-1) - ps
+        keep = prev_mass < top_ps[:, None]
+        minz = jnp.min(jnp.where(keep, zs2, jnp.inf), axis=-1,
+                       keepdims=True)
+        z = jnp.where((top_ps < 1.0)[:, None] & (z < minz), -jnp.inf, z)
 
-    step_keys = jax.vmap(jax.random.fold_in)(keys, positions)
-    tiny = jnp.finfo(jnp.float32).tiny
-    u = jax.vmap(lambda k: jax.random.uniform(
-        k, (vocab,), minval=tiny, maxval=1.0))(step_keys)
-    gumbel = -jnp.log(-jnp.log(u))
-    sampled_tok = jnp.argmax(z + gumbel, axis=-1).astype(jnp.int32)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, positions)
+        tiny = jnp.finfo(jnp.float32).tiny
+        u = jax.vmap(lambda k: jax.random.uniform(
+            k, (vocab,), minval=tiny, maxval=1.0))(step_keys)
+        gumbel = -jnp.log(-jnp.log(u))
+        return jnp.argmax(z + gumbel, axis=-1).astype(jnp.int32)
+
+    # the sort/threefry machinery above is ~vocab-sized work PER ROW;
+    # all-greedy batches (temps <= 0 everywhere) never read its result,
+    # so gate it behind a runtime cond — with any sampling row present
+    # the exact same ops run, so the bits never change
+    sampled_tok = jax.lax.cond(jnp.any(temps > 0.0), _sampled,
+                               lambda _: greedy_tok, None)
 
     tok = jnp.where(temps <= 0.0, greedy_tok, sampled_tok)
     logp = jnp.take_along_axis(lp_full, tok[:, None], axis=-1)[:, 0]
@@ -454,14 +525,17 @@ class LMAdapter(_AdapterBase):
         return np.asarray(tokens, np.int32).reshape(-1), {}
 
     def chunk_forward(self, params, tokens, positions, kbuf, vbuf, ctx,
-                      self_attend=None):
+                      self_attend=None, model=None):
         """One step of C tokens per row: embed at absolute positions,
         write each layer's K/V into the buffer, attend causally over
         the cache, return last-layer logits.  ``kbuf/vbuf``:
         (B, L, h, K, hd) f32.  ``self_attend(i, q, k_new, v_new)``
         overrides the buffer attention (the engine's paged flash
         path, which owns its own cache writes); ``kbuf/vbuf`` may then
-        be None."""
+        be None.  ``model`` substitutes a same-architecture twin for
+        the layer walk (the speculative DRAFT — identical params,
+        block-sparse FFNs); attention/layer-norm modules are stateless
+        so only the FFN forwards differ."""
         B, C = tokens.shape
         cap = self._pe.shape[0] - 1
         q_pos = positions[:, None] + jnp.arange(C)[None, :]        # (B,C)
@@ -472,7 +546,7 @@ class LMAdapter(_AdapterBase):
             K = kbuf.shape[3]
             valid = jnp.arange(K)[None, None, :] <= q_pos[:, :, None]
         k_news, v_news = [], []
-        for i, layer in enumerate(self.model.decoder):
+        for i, layer in enumerate((model or self.model).decoder):
             lp = params[f"dec{i}"]
             h1, _ = layer.ln1.forward(lp["ln1"], EMPTY, x)
             sp = lp["attn"]
@@ -495,6 +569,47 @@ class LMAdapter(_AdapterBase):
             v_news.append(v_new)
         return (self._logits(x), kbuf, vbuf,
                 jnp.stack(k_news, 1), jnp.stack(v_news, 1))
+
+    def build_draft(self, spec: "SpecConfig"):
+        """Construct the weight-shared speculative DRAFT twin
+        (docs/serving.md §Speculative decoding): the same LM
+        architecture rebuilt with ``ffn_sparsity=spec.sparsity``, whose
+        :class:`~bigdl_tpu.ops.block_sparse.BlockSparseLinear` FFNs
+        consume the target's params verbatim ({"weight", "bias"} — the
+        Linear layout) and whose block masks are derived from the
+        SERVED weights by one magnitude-pruning event
+        (``derive_draft_masks``).  ``sparsity=0.0`` returns a dense
+        twin — bit-identical to the target, acceptance rate 1.0."""
+        from bigdl_tpu.nn.attention import Transformer
+
+        m = self.model
+        ffn_size = int(m.decoder[0].ffn.l1.out_features)
+        sparsity = float(spec.sparsity)
+        draft = Transformer(
+            m.vocab_size, m.hidden_size, self.num_heads,
+            ffn_size=ffn_size, num_layers=self.num_layers, dropout=0.0,
+            mode="lm", ffn_sparsity=sparsity,
+            sparse_block=tuple(spec.sparse_block))
+        if sparsity > 0.0:
+            from bigdl_tpu.ops.block_sparse import (derive_draft_masks,
+                                                    iter_sparse_modules)
+
+            if spec.draft_impl not in ("auto", "kernel", "masked"):
+                raise ValueError(f"SpecConfig.draft_impl "
+                                 f"{spec.draft_impl!r}: auto | kernel "
+                                 "| masked")
+            if spec.draft_impl == "auto":
+                from bigdl_tpu.ops.common import on_tpu
+
+                use_kernel = on_tpu()
+            else:
+                use_kernel = spec.draft_impl == "kernel"
+            for _, mod in iter_sparse_modules(draft):
+                mod.use_kernel = use_kernel
+            # mask derivation reads the DEQUANTIZED weights under
+            # weight_quant="int8" — block magnitudes of the f32 view
+            derive_draft_masks(draft, self.params, sparsity)
+        return draft
 
 
 class Seq2SeqAdapter(_AdapterBase):
@@ -710,6 +825,49 @@ class DecodeEngine:
             self._prefix_cache = PrefixCache(
                 min(cfg.prefix_cache_pages, cfg.total_pages),
                 cfg.page_size, page_dtype=cfg.kv_dtype)
+        # speculative decoding (docs/serving.md §Speculative decoding):
+        # the draft's KV pages live in a parallel ALWAYS-f32 pool
+        # indexed by the SAME page table — one allocation/release path,
+        # so a cancelled or expired slot structurally cannot leak draft
+        # pages (tests/test_spec_decode.py pins the regression)
+        self._spec = cfg.speculative
+        self._draft_model = None
+        self._dr_k = self._dr_v = None
+        if self._spec is not None:
+            sp = self._spec
+            if not cfg.continuous:
+                raise ValueError("speculative decoding requires "
+                                 "continuous mode")
+            if adapter.ctx_specs() or not hasattr(adapter,
+                                                  "build_draft"):
+                raise ValueError(
+                    "speculative decoding supports LM adapters only "
+                    "(a seq2seq draft would need its own cross "
+                    "context)")
+            if not 1 <= int(sp.k) < cfg.cap:
+                raise ValueError(f"SpecConfig.k must be in [1, "
+                                 f"{cfg.cap}), got {sp.k}")
+            if sp.verify_impl not in ("auto", "scan", "chunk"):
+                raise ValueError(
+                    f"SpecConfig.verify_impl {sp.verify_impl!r}: "
+                    "auto | scan | chunk")
+            if sp.verify_impl == "chunk" and cfg.kv_dtype != "float32":
+                raise ValueError(
+                    "SpecConfig.verify_impl='chunk' requires f32 KV "
+                    "pages (int8 page RMW is per-position; the scan "
+                    "verify handles kv_dtype='int8')")
+            if sp.draft_window is not None and int(sp.draft_window) < 1:
+                raise ValueError(
+                    f"SpecConfig.draft_window must be None or >= 1, "
+                    f"got {sp.draft_window}")
+            self._draft_model = adapter.build_draft(sp)
+            self._dr_k = jnp.zeros(
+                (L, cfg.total_pages, h, cfg.page_size, hd), jnp.float32)
+            self._dr_v = jnp.zeros_like(self._dr_k)
+        self._draft_fns: Dict[int, Callable] = {}
+        self._verify_fns: Dict[int, Callable] = {}
+        self._draft_prefill_fns: Dict[int, Callable] = {}
+        self._accept_window = deque(maxlen=256)  # (t, accepted, adjudicated)
         self._import_fn: Optional[Callable] = None
         self._scale_reset_fn: Optional[Callable] = None
         self._base_key = jax.random.PRNGKey(cfg.base_seed)
@@ -746,7 +904,8 @@ class DecodeEngine:
         self.stats = {"requests": 0, "completed": 0, "expired": 0,
                       "tokens": 0, "steps": 0, "prefill_chunks": 0,
                       "rejected": 0, "kv_exports": 0, "kv_imports": 0,
-                      "cancelled": 0}
+                      "cancelled": 0, "spec_drafted": 0,
+                      "spec_accepted": 0, "spec_rejected": 0}
         self.metrics.describe(
             "serving.decode.tokens_per_s",
             "generated tokens/s over the recent step window")
@@ -876,7 +1035,10 @@ class DecodeEngine:
                  * a.head_dim)
         itemsize = 1 if self._quant_kv else 4
         scale_bytes = 2 * a.num_layers * 4 if self._quant_kv else 0
-        return 2 * elems * itemsize + scale_bytes
+        # speculation: every page id also has a row in the f32 draft
+        # K/V pool — the fleet router must price that honestly
+        draft_bytes = 2 * elems * 4 if self._spec is not None else 0
+        return 2 * elems * itemsize + scale_bytes + draft_bytes
 
     def decode_pressure(self) -> Dict[str, Any]:
         """Admission-pressure snapshot for the fleet router
@@ -905,6 +1067,12 @@ class DecodeEngine:
             # §Decode fleet)
             "page_dtype": self.cfg.kv_dtype,
             "kv_bytes_per_page": self.kv_bytes_per_page(),
+            # draft-page accounting is structural (same page ids), so
+            # free_pages above is already honest under speculation —
+            # these keys just let the router see the mode and the
+            # per-iteration page burst (+k positions per active slot)
+            "speculative": self._spec is not None,
+            "spec_k": int(self._spec.k) if self._spec is not None else 0,
         }
         if self._prefix_cache is not None:
             out["prefix_cache"] = self._prefix_cache.stats()
@@ -1098,6 +1266,14 @@ class DecodeEngine:
             for nb in self.cfg.len_buckets():
                 self._step_fn(nb)
                 self._prefill_fn(nb)
+                if self._spec is not None:
+                    # the draft/verify/draft-prefill programs join the
+                    # SAME closed bucket set — a spec-on mixed sweep
+                    # stays at zero unexpected recompiles
+                    self._draft_fn(nb)
+                    self._verify_fn(nb)
+                    self._verify_fn(nb, force_scan=True)
+                    self._draft_prefill_fn(nb)
             if self._ctx_bufs:
                 # CALL the ctx-write program (jit() alone compiles
                 # nothing): the first seq2seq admission must not pay —
@@ -1137,6 +1313,7 @@ class DecodeEngine:
         S = cfg.slots
         kv_k, kv_v = self._kv_k, self._kv_v
         kv_sk, kv_sv = self._kv_sk, self._kv_sv
+        dr_k, dr_v = self._dr_k, self._dr_v
         for nb in cfg.len_buckets():
             kv_k, kv_v, kv_sk, kv_sv, _, _ = self._step_fn(nb)(
                 kv_k, kv_v, kv_sk, kv_sv, self._ctx_bufs,
@@ -1155,6 +1332,31 @@ class DecodeEngine:
                 np.zeros((B,), bool), np.zeros((B,), np.int32),
                 np.zeros((B,), np.float32), np.zeros((B,), np.int32),
                 np.ones((B,), np.float32))
+            if self._spec is not None:
+                # all-inactive rows: every write masks out, so the warm
+                # calls compile without touching live pool state
+                dr_k, dr_v, _ = self._draft_fn(nb)(
+                    dr_k, dr_v, self._page_table,
+                    np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                    np.zeros((S,), bool), np.zeros((S,), np.int32),
+                    np.zeros((S,), np.float32),
+                    np.zeros((S,), np.int32), np.ones((S,), np.float32))
+                for force_scan in (False, True):
+                    kv_k, kv_v, kv_sk, kv_sv, _, _ = self._verify_fn(
+                        nb, force_scan)(
+                        kv_k, kv_v, kv_sk, kv_sv, self._page_table,
+                        np.zeros((S,), np.int32),
+                        np.zeros((S, self._spec.k + 1), np.int32),
+                        np.zeros((S,), np.int32), np.zeros((S,), bool),
+                        np.zeros((S,), np.int32),
+                        np.zeros((S,), np.float32),
+                        np.zeros((S,), np.int32),
+                        np.ones((S,), np.float32))
+                dr_k, dr_v = self._draft_prefill_fn(nb)(
+                    dr_k, dr_v,
+                    np.zeros((B, cfg.pages_per_slot), np.int32),
+                    np.zeros((B, cfg.prompt_chunk), np.int32),
+                    np.zeros((B,), np.int32), np.zeros((B,), bool))
         if self._quant_kv:
             # the scale-reset program (all page ids dropped — no-op on
             # the live tables)
@@ -1169,6 +1371,9 @@ class DecodeEngine:
         jax.block_until_ready(kv_k)
         self._kv_k, self._kv_v = kv_k, kv_v
         self._kv_sk, self._kv_sv = kv_sk, kv_sv
+        if self._spec is not None:
+            jax.block_until_ready(dr_k)
+            self._dr_k, self._dr_v = dr_k, dr_v
 
     # -- jitted programs ----------------------------------------------------
     def _gather(self, kv, pt):
@@ -1178,6 +1383,45 @@ class DecodeEngine:
         L, B, nb, h, page, hd = g.shape
         return g.transpose(1, 0, 3, 2, 4, 5).reshape(B, L, h, nb * page,
                                                      hd)
+
+    def _write_chunk_pages(self, pool, new, page_table, lengths,
+                           active):
+        """Persist a speculative chunk's K/V into an f32 page pool with
+        ONE page-granular scatter.  ``new`` is (L, B, h, C, hd) — fresh
+        K or V for positions ``lengths..lengths+C-1`` per slot.  A
+        cell-granular ``.at[:, pid, :, off]`` scatter costs B*C scatter
+        rows (XLA CPU serializes them — it dominated the whole verify
+        call); the chunk only ever touches ``ceil(C/page)+1``
+        consecutive pages per slot, so gather those, splice the chunk
+        in with a vectorized ``where``, and write whole pages back."""
+        cfg = self.cfg
+        page = cfg.page_size
+        L, B, h, C, hd = new.shape
+        TP = (C - 1) // page + 2          # straddle: one extra page
+        p0 = lengths // page
+        tp = p0[:, None] + jnp.arange(TP)[None, :]           # (B, TP)
+        pid = jnp.take_along_axis(
+            page_table, jnp.clip(tp, 0, cfg.pages_per_slot - 1),
+            axis=1)
+        # out-of-range slots/pages go to the dump index and drop — and
+        # never duplicate an in-range pid, keeping the scatter
+        # conflict-free (duplicate rows would race)
+        pid = jnp.where(active[:, None] & (tp < cfg.pages_per_slot),
+                        pid, cfg.total_pages)
+        cell = (tp[:, :, None] * page
+                + jnp.arange(page)[None, None, :])       # (B, TP, page)
+        c = cell - lengths[:, None, None]   # chunk index of each cell
+        inside = (c >= 0) & (c < C) & (cell < cfg.cap)
+        cc = jnp.clip(c, 0, C - 1).reshape(1, B, 1, TP * page)
+        sel = jnp.take_along_axis(
+            new, jnp.broadcast_to(cc[..., None], (L, B, h, TP * page,
+                                                  hd)), axis=3)
+        sel = sel.reshape(L, B, h, TP, page, hd).transpose(
+            0, 1, 3, 2, 4, 5)                # (L, B, TP, h, page, hd)
+        old = pool[:, pid]
+        mask = inside[None, :, :, None, :, None]
+        return pool.at[:, pid].set(jnp.where(mask, sel, old),
+                                   mode="drop")
 
     def _gather_deq(self, kv, sc, pt):
         """:meth:`_gather` for int8 pools: dequantize each gathered page
@@ -1450,6 +1694,437 @@ class DecodeEngine:
         self._prefill_fns[n_blocks] = fn
         return fn
 
+    # -- speculative programs (docs/serving.md §Speculative decoding) -------
+    def _draft_fn(self, n_blocks: int):
+        """Draft ``k+1`` tokens per active slot with the block-sparse
+        twin over the f32 draft page pool: gather the slot's draft
+        cache once, ``lax.scan`` k+1 single-token steps through
+        ``chunk_forward(model=draft)``, then scatter the chunk of fresh
+        draft K/V back into the pool.  k+1 steps (not k) because step
+        ``j`` writes draft KV at position ``lengths+j`` — the extra
+        step fills the cache hole at ``lengths+k`` the full-accept
+        bonus token needs on the NEXT iteration.  Selection goes
+        through ``_select_tokens`` with the same keys/positions the
+        verify uses, so at temperature>0 a close draft samples the same
+        token (shared-Gumbel coupling) and acceptance stays high.
+
+        With ``SpecConfig.draft_window=W`` (and a cache bucket wider
+        than W) the scan carries a RING of the last W positions'
+        draft K/V instead of the full gathered cache: slot ``q % W``
+        holds position ``q``, each step overwrites one slot and
+        attends the whole ring under a ``q >= 0`` mask.  The draft's
+        per-step attention traffic is then O(W) however long the
+        sequence grows — the asymmetry speculation lives on, since
+        the target still re-reads its full cache but only once per
+        k+1 tokens (the verify)."""
+        fn = self._draft_fns.get(n_blocks)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        adapter = self.adapter
+        page = cfg.page_size
+        k_spec = self._spec.k
+        W = self._spec.draft_window
+        windowed = W is not None and int(W) < n_blocks * page
+        draft_model = self._draft_model
+        base_key = jnp.asarray(np.asarray(self._base_key))
+
+        def draft(dr_k, dr_v, page_table, lengths, last_tokens, active,
+                  seeds, temps, top_ks, top_ps):
+            B = lengths.shape[0]
+            keys = jax.vmap(jax.random.fold_in)(
+                jnp.broadcast_to(base_key, (B, 2)), seeds)
+            pt = page_table[:, :n_blocks]
+            if windowed:
+                # seed ring slot j with the LAST cached position
+                # congruent to j mod W (negative = not cached yet,
+                # masked out at attend time)
+                q_seed = ((lengths - 1)[:, None]
+                          - ((lengths - 1)[:, None] - jnp.arange(W))
+                          % W)                               # (B, W)
+                cell = jnp.clip(q_seed, 0, cfg.cap - 1)
+                pid = jnp.take_along_axis(
+                    pt, jnp.clip(cell // page, 0, n_blocks - 1), axis=1)
+                off = cell % page
+                rows = jnp.arange(B)
+
+                def seed(pool):
+                    g = pool[:, pid, :, off]      # (B, W, L, h, hd)
+                    return g.transpose(2, 0, 3, 1, 4)  # (L, B, h, W, hd)
+
+                rk, rv = seed(dr_k), seed(dr_v)
+
+                def body(carry, _):
+                    rk, rv, pos, last = carry
+                    ring = {"k": rk, "v": rv}
+                    slot = pos % W
+                    # slot j holds position pos - ((pos - j) % W); only
+                    # q >= 0 rows are real (short sequences)
+                    q_j = (pos[:, None]
+                           - (pos[:, None] - jnp.arange(W)) % W)
+                    ok = (q_j >= 0)[:, None, :]            # (B, 1, W)
+
+                    def self_attend(i, q, k_new, v_new):
+                        ring["k"] = ring["k"].at[i, rows, :, slot].set(
+                            k_new[:, :, 0])
+                        ring["v"] = ring["v"].at[i, rows, :, slot].set(
+                            v_new[:, :, 0])
+                        return adapter._attend(q, ring["k"][i],
+                                               ring["v"][i], ok)
+
+                    logits, _, _, k_new, v_new = adapter.chunk_forward(
+                        adapter.params, last[:, None], pos, None, None,
+                        {}, self_attend=self_attend, model=draft_model)
+                    tok, _ = _select_tokens(logits[:, 0], keys, pos + 1,
+                                            temps, top_ks, top_ps)
+                    return ((ring["k"], ring["v"], pos + 1, tok),
+                            (tok, k_new[:, :, :, 0], v_new[:, :, :, 0]))
+
+                (_, _, _, _), (toks, k_news, v_news) = jax.lax.scan(
+                    body, (rk, rv, lengths, last_tokens), None,
+                    length=k_spec + 1)
+            else:
+                kbuf = self._gather(dr_k, pt)
+                vbuf = self._gather(dr_v, pt)
+
+                def body(carry, _):
+                    kbuf, vbuf, pos, last = carry
+                    logits, kbuf, vbuf, k_new, v_new = \
+                        adapter.chunk_forward(
+                            adapter.params, last[:, None], pos, kbuf,
+                            vbuf, {}, model=draft_model)
+                    tok, _ = _select_tokens(logits[:, 0], keys, pos + 1,
+                                            temps, top_ks, top_ps)
+                    return ((kbuf, vbuf, pos + 1, tok),
+                            (tok, k_new[:, :, :, 0], v_new[:, :, :, 0]))
+
+                (_, _, _, _), (toks, k_news, v_news) = jax.lax.scan(
+                    body, (kbuf, vbuf, lengths, last_tokens), None,
+                    length=k_spec + 1)
+            # persist the fresh chunk into the draft pool with one
+            # page-granular write (k_news (C, B, L, h, hd) -> the
+            # helper's (L, B, h, C, hd) layout); inactive rows and
+            # positions past the cap drop
+            dr_k = self._write_chunk_pages(
+                dr_k, jnp.transpose(k_news, (2, 1, 3, 0, 4)),
+                page_table, lengths, active)
+            dr_v = self._write_chunk_pages(
+                dr_v, jnp.transpose(v_news, (2, 1, 3, 0, 4)),
+                page_table, lengths, active)
+            return dr_k, dr_v, jnp.moveaxis(toks, 0, 1)       # (B, C)
+
+        fn = jax.jit(draft, donate_argnums=(0, 1))
+        self._draft_fns[n_blocks] = fn
+        return fn
+
+    def _verify_fn(self, n_blocks: int, force_scan: bool = False):
+        """ONE target-model call scoring the whole drafted chunk
+        ``[last_token, d_1..d_k]`` at positions ``[lengths..lengths+k]``
+        and returning the target's selections for positions
+        ``lengths+1..lengths+k+1`` — the tokens the spec-off engine
+        would have emitted.
+
+        Two tracings behind one signature, picked by
+        ``SpecConfig.verify_impl``: the scan path runs k+1 single-token
+        steps that mirror :meth:`_step_fn` OP-FOR-OP (same shapes, same
+        pool writes, same selection call), so spec-on output is
+        byte-identical to spec-off by construction — one dispatch
+        replacing k+1 is where its speedup lives, not a changed
+        computation.  The chunk path instead scatters the whole chunk's
+        K/V and attends all k+1 queries in one multi-query pass
+        (``paged_verify_attention`` on TPU, a gathered causal-staircase
+        jnp attention elsewhere) — ~(k+1)x fewer ops, token-stream
+        parity with logp allclose-not-bitwise, exactly like the
+        spec-off flash path's own contract.  int8 KV always takes the
+        scan path (page RMW is per-position).
+
+        ``force_scan`` routes one iteration to the scan tracing even
+        when chunk is configured: the chunk attention's last-ulp logit
+        drift is harmless under greedy argmax but the top-k/top-p
+        threshold masks are DISCONTINUOUS in it (a logit within an ulp
+        of the kth value flips in or out of the candidate set), so any
+        iteration with a sampled (temperature>0) slot takes the scan
+        program and seeded parity stays unconditional.  Both tracings
+        join warmup()'s closed set — the fallback is never a
+        recompile."""
+        cfg = self.cfg
+        quant = self._quant_kv
+        use_flash = self._use_flash()
+        impl = self._spec.verify_impl
+        chunk_mode = (not quant) and not force_scan and (
+            use_flash if impl == "auto" else impl == "chunk")
+        fn = self._verify_fns.get((n_blocks, chunk_mode))
+        if fn is not None:
+            return fn
+        adapter = self.adapter
+        page = cfg.page_size
+        C = self._spec.k + 1
+        base_key = jnp.asarray(np.asarray(self._base_key))
+
+        def verify(kv_k, kv_v, kv_sk, kv_sv, page_table, last_tokens,
+                   d_toks, lengths, active, seeds, temps, top_ks,
+                   top_ps):
+            # the verify row [t_L, d_0..d_{k-1}] is assembled ON DEVICE
+            # from the draft program's output, so the engine can enqueue
+            # this program without first syncing the draft tokens back
+            # to the host — the two dispatches overlap with the host's
+            # acceptance bookkeeping
+            tokens = jnp.concatenate(
+                [last_tokens[:, None].astype(jnp.int32),
+                 d_toks[:, :C - 1]], axis=1)
+            B = tokens.shape[0]
+            keys = jax.vmap(jax.random.fold_in)(
+                jnp.broadcast_to(base_key, (B, 2)), seeds)
+            pt = page_table[:, :n_blocks]
+            if chunk_mode:
+                # multi-query chunk path: scatter the chunk's K/V into
+                # the pages per layer, then verify straight off the
+                # pool (ops.flash_attention.paged_verify_attention)
+                from bigdl_tpu.ops.flash_attention import \
+                    paged_verify_attention
+
+                pos_c = lengths[:, None] + jnp.arange(C)[None, :]
+                pid = jnp.take_along_axis(
+                    page_table, jnp.clip(pos_c // page, 0,
+                                         cfg.pages_per_slot - 1),
+                    axis=1)
+                ok = active[:, None] & (pos_c < cfg.cap)
+                h, hd = adapter.num_heads, adapter.head_dim
+                K = n_blocks * page
+
+                if use_flash:
+                    pid = jnp.where(ok, pid, cfg.total_pages)
+                    off = pos_c % page
+                    kv = {"k": kv_k, "v": kv_v}
+
+                    def self_attend(i, q, k_new, v_new):
+                        kv["k"] = kv["k"].at[i, pid, :, off].set(
+                            k_new.transpose(0, 2, 1, 3).astype(
+                                kv_k.dtype), mode="drop")
+                        kv["v"] = kv["v"].at[i, pid, :, off].set(
+                            v_new.transpose(0, 2, 1, 3).astype(
+                                kv_v.dtype), mode="drop")
+                        out = paged_verify_attention(
+                            q, kv["k"][i], kv["v"][i], pt, lengths)
+                        return out.astype(jnp.float32)
+
+                    logits, _, _, _, _ = adapter.chunk_forward(
+                        adapter.params, tokens, lengths, None, None,
+                        {}, self_attend=self_attend)
+                    out_k, out_v = kv["k"], kv["v"]
+                else:
+                    # jnp chunk: attend the in-flight chunk K/V from
+                    # REGISTERS (old pool keys strictly pre-chunk, the
+                    # chunk's own keys under a causal staircase),
+                    # merging the two softmaxes flash-style rather than
+                    # concatenating buffers (a concat materializes
+                    # (B,h,C,K+C) copies per layer — measured, it
+                    # dominated the call); no cell-granular pool
+                    # scatter on the hot path either — the pool write
+                    # happens ONCE below, page-granular
+                    news = []
+                    scale = 1.0 / np.sqrt(float(hd))
+                    old_ok = (jnp.arange(K)[None, None, None, :]
+                              < lengths[:, None, None, None])
+                    stair = (jnp.arange(C)[None, :]
+                             <= jnp.arange(C)[:, None])  # (C, C)
+
+                    # contractions run with (b, h) flattened into one
+                    # batch dim — XLA:CPU dispatches a (B*h)-batched
+                    # 3D dot far better than the 4D einsum (2.2x at
+                    # these shapes); the math is identical
+                    dn_k = (((2,), (2,)), ((0,), (0,)))
+                    dn_v = (((2,), (1,)), ((0,), (0,)))
+
+                    def self_attend(i, q, k_new, v_new):
+                        news.append((k_new, v_new))      # (B, h, C, hd)
+                        kb = kv_k[i][pt].transpose(
+                            0, 2, 1, 3, 4).reshape(B * h, K, hd)
+                        vb = kv_v[i][pt].transpose(
+                            0, 2, 1, 3, 4).reshape(B * h, K, hd)
+                        qf = (q.astype(jnp.float32) * scale).reshape(
+                            B * h, C, hd)
+                        s_old = jnp.where(
+                            old_ok,
+                            jax.lax.dot_general(
+                                qf, kb, dn_k,
+                                preferred_element_type=jnp.float32
+                            ).reshape(B, h, C, K),
+                            _NEG_INF)
+                        s_new = jnp.where(
+                            stair[None, None],
+                            jax.lax.dot_general(
+                                qf, k_new.reshape(B * h, C, hd), dn_k,
+                                preferred_element_type=jnp.float32
+                            ).reshape(B, h, C, C),
+                            _NEG_INF)
+                        # each query attends at least its own chunk key
+                        # (the staircase diagonal), so m is finite
+                        m = jnp.maximum(s_old.max(-1, keepdims=True),
+                                        s_new.max(-1, keepdims=True))
+                        eo = jnp.exp(s_old - m)
+                        en = jnp.exp(s_new - m)
+                        den = (eo.sum(-1, keepdims=True)
+                               + en.sum(-1, keepdims=True))
+                        out = (jax.lax.dot_general(
+                            eo.reshape(B * h, C, K), vb, dn_v,
+                            preferred_element_type=jnp.float32)
+                            + jax.lax.dot_general(
+                                en.reshape(B * h, C, C),
+                                v_new.reshape(B * h, C, hd), dn_v,
+                                preferred_element_type=jnp.float32))
+                        return out.reshape(B, h, C, hd) / den
+
+                    logits, _, _, _, _ = adapter.chunk_forward(
+                        adapter.params, tokens, lengths, None, None,
+                        {}, self_attend=self_attend)
+                    out_k = self._write_chunk_pages(
+                        kv_k, jnp.stack([kn for kn, _ in news]),
+                        page_table, lengths, active)
+                    out_v = self._write_chunk_pages(
+                        kv_v, jnp.stack([vn for _, vn in news]),
+                        page_table, lengths, active)
+                sel_pos = (pos_c + 1).reshape(-1)
+                tok, logp = _select_tokens(
+                    logits.reshape(B * C, -1),
+                    jnp.repeat(keys, C, axis=0), sel_pos,
+                    jnp.repeat(temps, C), jnp.repeat(top_ks, C),
+                    jnp.repeat(top_ps, C))
+                return (out_k, out_v, kv_sk, kv_sv,
+                        tok.reshape(B, C), logp.reshape(B, C))
+
+            # sequential-exact path: k+1 _step_fn bodies under one
+            # lax.scan — fed tokens are the PREDETERMINED chunk, so
+            # there is no data-dependent control flow to trace
+            rows = jnp.arange(B)
+            K = n_blocks * page
+            h, hd = adapter.num_heads, adapter.head_dim
+
+            def body(carry, tok_j):
+                kv_k, kv_v, kv_sk, kv_sv, pos = carry
+                wid = jnp.where(active,
+                                jnp.take_along_axis(
+                                    page_table, (pos // page)[:, None],
+                                    axis=1)[:, 0],
+                                cfg.total_pages)
+                off = pos % page
+                if quant:
+                    from bigdl_tpu.ops.flash_attention import \
+                        paged_decode_attention
+                    from bigdl_tpu.ops.quantized import quantize_pages
+
+                    kv = {"k": kv_k, "v": kv_v, "sk": kv_sk,
+                          "sv": kv_sv}
+
+                    def rmw(pool, scales, i, new):
+                        floor = scales[i, wid]
+                        pg = (pool[i, wid].astype(jnp.float32)
+                              * floor[:, None, None, None])
+                        pg = pg.at[rows, :, off].set(new[:, :, 0])
+                        q, s = quantize_pages(pg, floor_scales=floor)
+                        return (pool.at[i, wid].set(q, mode="drop"),
+                                scales.at[i, wid].set(s, mode="drop"))
+
+                    def self_attend(i, q, k_new, v_new):
+                        kv["k"], kv["sk"] = rmw(kv["k"], kv["sk"], i,
+                                                k_new)
+                        kv["v"], kv["sv"] = rmw(kv["v"], kv["sv"], i,
+                                                v_new)
+                        if use_flash:
+                            out = paged_decode_attention(
+                                q[:, :, 0], kv["k"][i], kv["v"][i], pt,
+                                pos, k_scales=kv["sk"][i],
+                                v_scales=kv["sv"][i])
+                            return out.astype(jnp.float32)[:, :, None]
+
+                        def deq(pool, scales):
+                            g = (pool[i][pt].astype(jnp.float32)
+                                 * scales[i][pt][..., None, None, None])
+                            return g.transpose(0, 2, 1, 3, 4).reshape(
+                                B, h, K, hd)
+
+                        valid = (jnp.arange(K)[None, :]
+                                 <= pos[:, None])[:, None, :]
+                        return adapter._attend(
+                            q, deq(kv["k"], kv["sk"]),
+                            deq(kv["v"], kv["sv"]), valid)
+
+                    logits, _, _, _, _ = adapter.chunk_forward(
+                        adapter.params, tok_j[:, None], pos, None,
+                        None, {}, self_attend=self_attend)
+                    kv_k, kv_v = kv["k"], kv["v"]
+                    kv_sk, kv_sv = kv["sk"], kv["sv"]
+                else:
+                    kbuf = self._gather(kv_k, pt)
+                    vbuf = self._gather(kv_v, pt)
+                    logits, _, _, k_new, v_new = adapter.chunk_forward(
+                        adapter.params, tok_j[:, None], pos, kbuf,
+                        vbuf, {})
+                    kv_k = kv_k.at[:, wid, :, off].set(
+                        k_new[:, :, :, 0].astype(kv_k.dtype),
+                        mode="drop")
+                    kv_v = kv_v.at[:, wid, :, off].set(
+                        v_new[:, :, :, 0].astype(kv_v.dtype),
+                        mode="drop")
+                tok, logp = _select_tokens(logits[:, 0], keys, pos + 1,
+                                           temps, top_ks, top_ps)
+                return ((kv_k, kv_v, kv_sk, kv_sv, pos + 1),
+                        (tok, logp))
+
+            carry, (toks, logps) = jax.lax.scan(
+                body, (kv_k, kv_v, kv_sk, kv_sv, lengths),
+                jnp.moveaxis(tokens, 0, 1))
+            kv_k, kv_v, kv_sk, kv_sv, _ = carry
+            return (kv_k, kv_v, kv_sk, kv_sv,
+                    jnp.moveaxis(toks, 0, 1), jnp.moveaxis(logps, 0, 1))
+
+        fn = jax.jit(verify, donate_argnums=(0, 1, 2, 3))
+        self._verify_fns[(n_blocks, chunk_mode)] = fn
+        return fn
+
+    def _draft_prefill_fn(self, n_blocks: int):
+        """Mirror of the f32 prefill scatter for the DRAFT pool: the
+        draft twin consumes each prompt chunk so a freshly admitted (or
+        mid-flight) request has draft KV for its whole prompt before
+        its first draft step.  No token selection — the first generated
+        token is the TARGET prefill's, identical to spec-off.  A
+        handoff-imported slot skips this (its draft pages stay cold:
+        drafts start uninformed, acceptance recovers as positions
+        fill in; correctness never depends on draft contents)."""
+        fn = self._draft_prefill_fns.get(n_blocks)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        adapter = self.adapter
+        page = cfg.page_size
+        C = cfg.prompt_chunk
+        draft_model = self._draft_model
+
+        def draft_prefill(dr_k, dr_v, pt_rows, tokens, position,
+                          active):
+            pt = pt_rows[:, :n_blocks]
+            kbuf = self._gather(dr_k, pt)
+            vbuf = self._gather(dr_v, pt)
+            _, _, _, k_new, v_new = adapter.chunk_forward(
+                adapter.params, tokens, position, kbuf, vbuf, {},
+                model=draft_model)
+            pos_c = position[:, None] + jnp.arange(C)[None, :]
+            pid = jnp.take_along_axis(
+                pt_rows, jnp.clip(pos_c // page, 0,
+                                  cfg.pages_per_slot - 1), axis=1)
+            ok = active[:, None] & (pos_c < cfg.cap)
+            pid = jnp.where(ok, pid, cfg.total_pages)
+            off = pos_c % page
+            dr_k = dr_k.at[:, pid, :, off].set(
+                k_new.transpose(0, 3, 1, 2, 4), mode="drop")
+            dr_v = dr_v.at[:, pid, :, off].set(
+                v_new.transpose(0, 3, 1, 2, 4), mode="drop")
+            return dr_k, dr_v
+
+        fn = jax.jit(draft_prefill, donate_argnums=(0, 1))
+        self._draft_prefill_fns[n_blocks] = fn
+        return fn
+
     def _ctx_write(self):
         if self._ctx_write_fn is None:
             def write(bufs, slot, values):
@@ -1499,7 +2174,18 @@ class DecodeEngine:
                     self._expire(now)
                     self._admit(now)
                     did = self._decode_step()
-                    did = self._prefill_one() or did
+                    # one prefill call per EMITTED token, not per
+                    # iteration: a speculative iteration advances the
+                    # decode streams up to k+1 tokens, so a prefilling
+                    # slot gets the same interleave bandwidth it would
+                    # under plain decode — otherwise admission latency
+                    # stretches by the whole chunk factor
+                    for _ in range(1 if self._spec is None
+                                   else self._spec.k + 1):
+                        pf = self._prefill_one()
+                        did = pf or did
+                        if not pf:
+                            break
                 if not did:
                     # queued work blocked on slots/pages (or an empty
                     # beat between admission and prefill): wait for a
@@ -1549,9 +2235,15 @@ class DecodeEngine:
         unreserved page."""
         cfg = self.cfg
         C = cfg.prompt_chunk
+        # under speculation every iteration writes up to k positions
+        # past the emitted length (draft lookahead + verify chunk), so
+        # the worst-case reservation grows by k — admission-time
+        # reservation is what keeps _ensure_pages infallible mid-flight
+        spec_k = self._spec.k if self._spec is not None else 0
         padded_prompt = min(start + -(-(prompt_len - start) // C) * C,
                             cfg.cap)
-        worst = min(max(padded_prompt, prompt_len + max_new), cfg.cap)
+        worst = min(max(padded_prompt, prompt_len + max_new + spec_k),
+                    cfg.cap)
         return -(-worst // cfg.page_size)
 
     def _admit(self, now: float) -> None:
@@ -1774,6 +2466,12 @@ class DecodeEngine:
             sc["temps"], sc["top_ks"], sc["top_ps"])
         self._kv_k, self._kv_v = kv_k, kv_v
         self._kv_sk, self._kv_sv = kv_sk, kv_sv
+        if self._spec is not None:
+            # the draft twin consumes the same chunk rows so its page
+            # pool tracks the prompt position-for-position
+            self._dr_k, self._dr_v = self._draft_prefill_fn(nb)(
+                self._dr_k, self._dr_v, sc["pt_rows"], sc["tokens"],
+                sc["position"], sc["active"])
         toks = np.asarray(tok)
         logps = np.asarray(logp, np.float32)
         now = time.time()
@@ -1802,6 +2500,8 @@ class DecodeEngine:
     # -- decode -------------------------------------------------------------
     def _decode_step(self) -> bool:
         cfg = self.cfg
+        if self._spec is not None:
+            return self._spec_step()
         if not cfg.continuous and any(
                 s is not None and s.prefilling for s in self._slots):
             # whole-batch-restart mode: the legacy scan only starts
@@ -1883,6 +2583,123 @@ class DecodeEngine:
                     if self._slots[s] is not None:
                         self._release_slot(s)
                 self._wave_steps = 0
+        self._export_gauges(now)
+        return True
+
+    def _spec_step(self) -> bool:
+        """One speculative iteration: draft k (+1 cache-filling) tokens
+        with the sparse twin, verify the chunk with ONE target call,
+        then accept the longest agreeing prefix on the host.  Emitted
+        tokens are ALWAYS the verify's target selections — the drafted
+        token at index j only gates whether the selection CONDITIONED
+        on it (index j+1 onward) is usable — so the accepted stream is
+        the spec-off stream by construction; speculation only changes
+        how many tokens one iteration yields (1 mismatch-correction up
+        to k+1 on full agreement, the bonus token included)."""
+        cfg = self.cfg
+        k = self._spec.k
+        active = [s for s in range(cfg.slots) if self._active_mask[s]]
+        if not active:
+            return False
+        faults.fire("fleet_worker_kill")
+        for s in active:
+            self._ensure_pages(s, min(int(self._lengths[s]) + 1 + k,
+                                      cfg.cap))
+        nb = cfg.bucket_pages(
+            min(int(self._lengths[active].max()) + 1 + k, cfg.cap))
+        self._flush_fresh_scales()
+        t0 = time.time()
+        dr_k, dr_v, d_toks = self._draft_fn(nb)(
+            self._dr_k, self._dr_v, self._page_table, self._lengths,
+            self._last_tokens, self._active_mask, self._seeds,
+            self._temps, self._top_ks, self._top_ps)
+        self._dr_k, self._dr_v = dr_k, dr_v
+        # enqueue the verify BEHIND the still-running draft — it
+        # consumes d_toks on device (the verify row is assembled inside
+        # the program), so no host sync sits between the two dispatches.
+        # Any sampled slot in the batch routes the iteration to the
+        # scan tracing: top-k/top-p thresholds are discontinuous in
+        # the chunk attention's ulp drift (see _verify_fn)
+        sampled = bool(np.any(np.asarray(self._temps)[active] > 0.0))
+        kv_k, kv_v, kv_sk, kv_sv, g_toks, g_logps = self._verify_fn(
+            nb, force_scan=sampled)(
+            self._kv_k, self._kv_v, self._kv_sk, self._kv_sv,
+            self._page_table, self._last_tokens, d_toks, self._lengths,
+            self._active_mask, self._seeds, self._temps, self._top_ks,
+            self._top_ps)
+        self._kv_k, self._kv_v = kv_k, kv_v
+        self._kv_sk, self._kv_sv = kv_sk, kv_sv
+        jax.block_until_ready(d_toks)   # draft done (verify may still run)
+        t1 = time.time()
+        d_host = np.asarray(d_toks)                          # (S, k+1)
+        g_toks = np.asarray(g_toks)
+        g_logps = np.asarray(g_logps, np.float32)
+        now = time.time()
+        self.stats["steps"] += 1
+        self.metrics.inc("serving.decode.steps")
+        self.metrics.observe("serving.decode.spec_draft_step_s",
+                             t1 - t0)
+        self.metrics.observe("serving.decode.spec_verify_step_s",
+                             now - t1)
+        self.events.append(("spec_step", len(active), nb))
+        if self._last_step_t:
+            # under speculation the step gap covers up to k+1 tokens
+            # per stream — still the honest stream-stall figure
+            self.metrics.observe("serving.decode.inter_token_s",
+                                 now - self._last_step_t)
+        self._last_step_t = now
+        n_tok = 0
+        drafted = accepted = rejected = 0
+        tr = trace.active()
+        for s in active:
+            seq = self._slots[s]
+            emitted = 0
+            mismatch = False
+            for j in range(k + 1):
+                if j >= 1 and int(d_host[s, j - 1]) != int(
+                        g_toks[s, j - 1]):
+                    # the token fed at query j disagreed with the
+                    # target's selection for that position (which was
+                    # already emitted as the correction): everything
+                    # from j on is conditioned on a token the target
+                    # did not pick — stale pool K/V past ``lengths`` is
+                    # overwritten before the next iteration attends
+                    mismatch = True
+                    break
+                self._lengths[s] += 1   # the fed token's K/V landed
+                self._emit_token(s, seq, int(g_toks[s, j]),
+                                 g_logps[s, j], now)
+                emitted += 1
+                n_tok += 1
+                if self._slots[s] is not seq or seq.done:
+                    break               # eos / length freed the slot
+            # accepted = draft tokens the target agreed with; rejected
+            # = mismatch only (at most 1 per chunk — it ends the
+            # chunk).  Drafts past an eos/length finish were never
+            # adjudicated: they count as drafted (wasted work shows in
+            # drafted - accepted - rejected) but not rejected, so a
+            # dense twin (sparsity=0.0) pins acceptance at exactly 1.0
+            acc = min(max(emitted - 1, 0), k)
+            drafted += k
+            accepted += acc
+            rejected += 1 if mismatch else 0
+            if tr is not None:
+                tr.add_event("decode/spec_step", t0, now,
+                             request_id=seq.req.rid, slot=s,
+                             emitted=emitted, accepted=acc)
+        self.stats["tokens"] += n_tok
+        self.stats["spec_drafted"] += drafted
+        self.stats["spec_accepted"] += accepted
+        self.stats["spec_rejected"] += rejected
+        self.metrics.inc("serving.decode.tokens_total", n_tok)
+        self.metrics.inc("serving.decode.spec_drafted_tokens", drafted)
+        self.metrics.inc("serving.decode.spec_accepted_tokens",
+                         accepted)
+        self.metrics.inc("serving.decode.spec_rejected_tokens",
+                         rejected)
+        self._accept_window.append((now, accepted, accepted + rejected))
+        self._tokens_window.append((now, n_tok))
+        self.metrics.observe("serving.decode.step_s", now - t0)
         self._export_gauges(now)
         return True
 
@@ -2082,6 +2899,14 @@ class DecodeEngine:
             if span > 0:
                 self.metrics.gauge("serving.decode.tokens_per_s",
                                    sum(n for _, n in window) / span)
+        if self._spec is not None:
+            w = [(t, a, d) for t, a, d in self._accept_window
+                 if now - t <= 2.0]
+            total = sum(d for _, _, d in w)
+            if total:
+                self.metrics.gauge(
+                    "serving.decode.spec_accept_rate",
+                    sum(a for _, a, _ in w) / total)
 
     # -- the one-scan whole-sequence parity reference -----------------------
     def static_generate(self, requests: Sequence[DecodeRequest]

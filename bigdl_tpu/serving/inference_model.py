@@ -130,7 +130,11 @@ class InferenceModel:
         # autoregressive decode path (docs/serving.md §Autoregressive
         # decode): a DecodeConfig attaches the paged-KV continuous
         # decode engine; generate()/generate_stream() and the server's
-        # generate requests route through it
+        # generate requests route through it.  A DecodeConfig with
+        # speculative=SpecConfig(...) additionally builds the weight-
+        # shared block-sparse draft twin from this model's (already
+        # laid-out, already-quantized) params at load time
+        # (docs/serving.md §Speculative decoding)
         self.decode_engine = None
         if decode is not None:
             from bigdl_tpu.serving.decode_engine import (DecodeEngine,
